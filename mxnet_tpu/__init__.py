@@ -1,0 +1,32 @@
+"""mxnet_tpu — a TPU-native deep learning framework with MXNet's capabilities.
+
+A from-scratch rebuild of Apache MXNet's capability surface (reference:
+kalakuer/incubator-mxnet) designed for TPU hardware: NDArrays are PJRT
+buffers, operators are XLA computations (Pallas for the hot fused kernels),
+``hybridize()`` lowers a captured graph to a single XLA executable, and the
+KVStore runs on XLA collectives over ICI/DCN instead of NCCL/ps-lite.
+
+Usage mirrors MXNet::
+
+    import mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu())
+    with mx.autograd.record():
+        y = (x * 2).sum()
+    y.backward()
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .base import MXNetError  # noqa: F401
+from .context import (  # noqa: F401
+    Context, cpu, cpu_pinned, gpu, tpu, num_gpus, num_tpus, current_context,
+)
+from . import engine  # noqa: F401
+from . import random  # noqa: F401
+from . import autograd  # noqa: F401
+from . import ndarray  # noqa: F401
+from . import ndarray as nd  # noqa: F401
+from .ndarray import NDArray  # noqa: F401
+
+from .ndarray import waitall  # noqa: F401

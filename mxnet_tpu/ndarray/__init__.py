@@ -1,0 +1,34 @@
+"""``mx.nd`` — imperative tensor namespace.
+
+Op functions are generated at import from the live registry, mirroring the
+reference's codegen-at-import (``python/mxnet/ndarray/register.py``).
+"""
+from __future__ import annotations
+
+# ensure op modules register before namespace generation
+from ..ops import tensor as _t  # noqa: F401
+from ..ops import nn as _n  # noqa: F401
+from ..ops import random_ops as _r  # noqa: F401
+from ..ops import optimizer_ops as _o  # noqa: F401
+from ..ops import contrib as _c  # noqa: F401
+
+from .ndarray import (  # noqa: F401
+    NDArray, array, empty, zeros, ones, full, arange, zeros_like, ones_like,
+    concatenate, moveaxis, save, load, waitall,
+)
+from . import random  # noqa: F401
+from . import sparse  # noqa: F401
+from .register import populate as _populate
+
+_populate(globals())
+
+# contrib sub-namespace: ops named _contrib_* surface as nd.contrib.<name>
+class _ContribNS:
+    def __getattr__(self, item):
+        fn = globals().get("_contrib_" + item)
+        if fn is None:
+            raise AttributeError("nd.contrib.%s" % item)
+        return fn
+
+
+contrib = _ContribNS()

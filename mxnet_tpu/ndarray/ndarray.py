@@ -1,0 +1,669 @@
+"""NDArray: the tensor type, on PJRT buffers.
+
+Reference: ``include/mxnet/ndarray.h:82`` + ``python/mxnet/ndarray/ndarray.py``
+— a ref-counted Chunk holding a Storage handle plus an engine Var, with lazy
+allocation and view semantics.
+
+TPU-native: the chunk is a ``jax.Array`` (PJRT buffer) — already asynchronous
+(dispatch returns futures), already pooled (PJRT allocator, reference
+``src/storage/pooled_storage_manager.h`` has no work left to do).  MXNet-style
+*mutation* (``a += b``, ``a[1:3] = x``, optimizer in-place updates) is
+implemented as functional update + buffer swap, with the engine ``Var`` version
+bumped so caches can observe writes.  Slicing returns copies, not aliasing
+views: XLA buffers are immutable, so write-through views cannot exist — writes
+must go through the base array (documented deviation; the test suites of the
+reference never rely on write-through slices).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..engine import Engine, Var
+from .. import autograd
+from ..ops import registry as _reg
+
+_DTYPE_ALIASES = {
+    "float16": jnp.float16, "float32": jnp.float32, "float64": jnp.float64,
+    "bfloat16": jnp.bfloat16, "uint8": jnp.uint8, "int8": jnp.int8,
+    "int32": jnp.int32, "int64": jnp.int64, "bool": jnp.bool_,
+}
+
+
+def _to_jax_dtype(dtype):
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        return _DTYPE_ALIASES.get(dtype, jnp.dtype(dtype))
+    return jnp.dtype(dtype)
+
+
+class NDArray:
+    """A mutable-by-convention tensor over an immutable XLA buffer."""
+
+    __slots__ = (
+        "_data", "_ctx", "_var",
+        "_marked", "_grad", "_grad_req", "_grad_gen",
+        "_tape_node", "_tape_index",
+        "__weakref__",
+    )
+
+    def __init__(self, data, ctx=None, dtype=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        jdt = _to_jax_dtype(dtype)
+        if not isinstance(data, jax.Array):
+            data = _np.asarray(data, dtype=jdt or None)
+            if data.dtype == _np.float64 and jdt is None:
+                data = data.astype(_np.float32)
+            ctx = ctx if ctx is not None else current_context()
+            data = jax.device_put(data, ctx.jax_device)
+        elif jdt is not None and data.dtype != jdt:
+            data = data.astype(jdt)
+        self._data = data
+        self._ctx = ctx if ctx is not None else current_context()
+        self._var = Var()
+        self._marked = False
+        self._grad = None
+        self._grad_req = "write"
+        self._grad_gen = -1
+        self._tape_node = None
+        self._tape_index = 0
+
+    # ------------------------------------------------------------------
+    # core accessors
+    # ------------------------------------------------------------------
+    def data(self):
+        """The raw jax.Array (framework-internal)."""
+        return self._data
+
+    def _set_data(self, new_data):
+        """In-place write: swap buffer + bump the engine var version."""
+        self._data = new_data
+        self._var.on_write()
+
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def _in_graph(self):
+        return self._marked or self._tape_node is not None
+
+    # ------------------------------------------------------------------
+    # sync / host transfer
+    # ------------------------------------------------------------------
+    def wait_to_read(self):
+        self._var.rethrow()
+        self._data.block_until_ready()
+        return self
+
+    def asnumpy(self):
+        self._var.rethrow()
+        return _np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("the array is not scalar-sized")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("ambiguous truth value of multi-element NDArray")
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __repr__(self):
+        return "\n%s\n<NDArray %s @%s>" % (
+            _np.asarray(self._data), "x".join(map(str, self.shape)), self._ctx)
+
+    # ------------------------------------------------------------------
+    # autograd
+    # ------------------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        """Mark for gradient collection (parity: ndarray.py attach_grad)."""
+        self._marked = True
+        self._grad_req = grad_req
+        self._grad = jnp.zeros(self.shape, self.dtype) if grad_req != "null" else None
+
+    @property
+    def grad(self):
+        if self._grad is None:
+            return None
+        return NDArray(self._grad, ctx=self._ctx)
+
+    def _accumulate_grad(self, ct):
+        # MXNet 'write' semantics: a new backward pass overwrites .grad, but
+        # multiple contributions WITHIN one pass sum.  The pass generation
+        # counter (autograd._backward_gen) distinguishes the two cases.
+        if self._grad_req == "null":
+            return
+        ct = ct.astype(self.dtype)
+        gen = autograd.current_backward_gen()
+        fresh = self._grad_gen != gen
+        self._grad_gen = gen
+        if self._grad is None or (fresh and self._grad_req == "write"):
+            self._grad = ct
+        else:
+            self._grad = self._grad + ct
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad = jnp.zeros(self.shape, self.dtype)
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    def detach(self):
+        out = NDArray(self._data, ctx=self._ctx)
+        return out
+
+    # ------------------------------------------------------------------
+    # conversion / copies
+    # ------------------------------------------------------------------
+    def astype(self, dtype, copy=True):
+        jdt = _to_jax_dtype(dtype)
+        if not copy and self.dtype == jdt:
+            return self
+        return _reg.invoke("cast", [self], {"dtype": _np.dtype(jdt).name})
+
+    def copy(self):
+        return NDArray(self._data, ctx=self._ctx)
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            if other.shape != self.shape:
+                raise ValueError("copyto shape mismatch")
+            other._set_data(
+                jax.device_put(self._data, other._ctx.jax_device).astype(other.dtype))
+            return other
+        if isinstance(other, Context):
+            return self.as_in_context(other)
+        raise TypeError("copyto target must be NDArray or Context")
+
+    def as_in_context(self, context):
+        if context == self._ctx:
+            return self
+        out = NDArray(jax.device_put(self._data, context.jax_device), ctx=context)
+        out._tape_node = self._tape_node
+        out._tape_index = self._tape_index
+        return out
+
+    def as_in_ctx(self, context):
+        return self.as_in_context(context)
+
+    def as_nd_ndarray(self):
+        return self
+
+    def to_dlpack_for_read(self):
+        return jax.dlpack.to_dlpack(self._data)  # pragma: no cover
+
+    def tostype(self, stype):
+        if stype != "default":
+            from ..ndarray import sparse as _sp
+
+            return _sp.dense_to(self, stype)
+        return self
+
+    # ------------------------------------------------------------------
+    # shape ops (method forms)
+    # ------------------------------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        if not shape and "shape" in kwargs:
+            shape = tuple(kwargs["shape"])
+        return _reg.invoke("reshape", [self], {"shape": tuple(shape)})
+
+    def reshape_like(self, other):
+        return _reg.invoke("reshape_like", [self, other])
+
+    def flatten(self):
+        return _reg.invoke("flatten", [self])
+
+    def expand_dims(self, axis):
+        return _reg.invoke("expand_dims", [self], {"axis": axis})
+
+    def squeeze(self, axis=None):
+        return _reg.invoke("squeeze", [self], {"axis": axis})
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return _reg.invoke("transpose", [self], {"axes": axes or None})
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def swapaxes(self, dim1, dim2):
+        return _reg.invoke("swapaxes", [self], {"dim1": dim1, "dim2": dim2})
+
+    def broadcast_to(self, shape):
+        return _reg.invoke("broadcast_to", [self], {"shape": tuple(shape)})
+
+    def broadcast_like(self, other):
+        return _reg.invoke("broadcast_like", [self, other])
+
+    def tile(self, reps):
+        return _reg.invoke("tile", [self], {"reps": tuple(reps)})
+
+    def slice(self, begin, end, step=None):
+        return _reg.invoke("slice", [self],
+                           {"begin": tuple(begin), "end": tuple(end),
+                            "step": tuple(step) if step else ()})
+
+    def slice_axis(self, axis, begin, end):
+        return _reg.invoke("slice_axis", [self],
+                           {"axis": axis, "begin": begin, "end": end})
+
+    def take(self, indices, axis=0, mode="clip"):
+        return _reg.invoke("take", [self, _as_nd(indices, self._ctx)],
+                           {"axis": axis, "mode": mode})
+
+    def one_hot(self, depth, **kwargs):
+        kwargs["depth"] = depth
+        return _reg.invoke("one_hot", [self], kwargs)
+
+    # reductions as methods
+    def sum(self, axis=None, keepdims=False):
+        return _reg.invoke("sum", [self], {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return _reg.invoke("mean", [self], {"axis": axis, "keepdims": keepdims})
+
+    def max(self, axis=None, keepdims=False):
+        return _reg.invoke("max", [self], {"axis": axis, "keepdims": keepdims})
+
+    def min(self, axis=None, keepdims=False):
+        return _reg.invoke("min", [self], {"axis": axis, "keepdims": keepdims})
+
+    def prod(self, axis=None, keepdims=False):
+        return _reg.invoke("prod", [self], {"axis": axis, "keepdims": keepdims})
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return _reg.invoke("norm", [self],
+                           {"ord": ord, "axis": axis, "keepdims": keepdims})
+
+    def argmax(self, axis=None, keepdims=False):
+        return _reg.invoke("argmax", [self], {"axis": axis, "keepdims": keepdims})
+
+    def argmin(self, axis=None, keepdims=False):
+        return _reg.invoke("argmin", [self], {"axis": axis, "keepdims": keepdims})
+
+    def clip(self, a_min, a_max):
+        return _reg.invoke("clip", [self], {"a_min": a_min, "a_max": a_max})
+
+    def abs(self):
+        return _reg.invoke("abs", [self])
+
+    def sign(self):
+        return _reg.invoke("sign", [self])
+
+    def sqrt(self):
+        return _reg.invoke("sqrt", [self])
+
+    def square(self):
+        return _reg.invoke("square", [self])
+
+    def exp(self):
+        return _reg.invoke("exp", [self])
+
+    def log(self):
+        return _reg.invoke("log", [self])
+
+    def relu(self):
+        return _reg.invoke("relu", [self])
+
+    def sigmoid(self):
+        return _reg.invoke("sigmoid", [self])
+
+    def tanh(self):
+        return _reg.invoke("tanh", [self])
+
+    def softmax(self, axis=-1):
+        return _reg.invoke("softmax", [self], {"axis": axis})
+
+    def log_softmax(self, axis=-1):
+        return _reg.invoke("log_softmax", [self], {"axis": axis})
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return _reg.invoke("dot", [self, other],
+                           {"transpose_a": transpose_a, "transpose_b": transpose_b})
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return _reg.invoke("topk", [self],
+                           {"axis": axis, "k": k, "ret_typ": ret_typ,
+                            "is_ascend": is_ascend})
+
+    def sort(self, axis=-1, is_ascend=True):
+        return _reg.invoke("sort", [self], {"axis": axis, "is_ascend": is_ascend})
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return _reg.invoke("argsort", [self], {"axis": axis, "is_ascend": is_ascend})
+
+    def flip(self, axis):
+        return _reg.invoke("reverse", [self], {"axis": axis})
+
+    def pad(self, mode, pad_width, constant_value=0.0):
+        return _reg.invoke("pad", [self],
+                           {"mode": mode, "pad_width": tuple(pad_width),
+                            "constant_value": constant_value})
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return _reg.invoke("split", [self],
+                           {"num_outputs": num_outputs, "axis": axis,
+                            "squeeze_axis": squeeze_axis})
+
+    # ------------------------------------------------------------------
+    # arithmetic operators
+    # ------------------------------------------------------------------
+    def _binary(self, other, op, scalar_op, rscalar_op=None, reverse=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return _reg.invoke(op, [a, b])
+        if isinstance(other, (int, float, bool, _np.number)):
+            name = (rscalar_op or scalar_op) if reverse else scalar_op
+            return _reg.invoke(name, [self], {"scalar": float(other)})
+        if isinstance(other, (_np.ndarray, list, tuple)):
+            return self._binary(NDArray(other, ctx=self._ctx), op, scalar_op,
+                                rscalar_op, reverse)
+        return NotImplemented
+
+    def __add__(self, o):
+        return self._binary(o, "broadcast_add", "_plus_scalar")
+
+    def __radd__(self, o):
+        return self._binary(o, "broadcast_add", "_plus_scalar", reverse=True)
+
+    def __sub__(self, o):
+        return self._binary(o, "broadcast_sub", "_minus_scalar", "_rminus_scalar")
+
+    def __rsub__(self, o):
+        return self._binary(o, "broadcast_sub", "_minus_scalar", "_rminus_scalar",
+                            reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "broadcast_mul", "_mul_scalar")
+
+    def __rmul__(self, o):
+        return self._binary(o, "broadcast_mul", "_mul_scalar", reverse=True)
+
+    def __truediv__(self, o):
+        return self._binary(o, "broadcast_div", "_div_scalar", "_rdiv_scalar")
+
+    def __rtruediv__(self, o):
+        return self._binary(o, "broadcast_div", "_div_scalar", "_rdiv_scalar",
+                            reverse=True)
+
+    def __mod__(self, o):
+        return self._binary(o, "broadcast_mod", "_mod_scalar", "_rmod_scalar")
+
+    def __rmod__(self, o):
+        return self._binary(o, "broadcast_mod", "_mod_scalar", "_rmod_scalar",
+                            reverse=True)
+
+    def __pow__(self, o):
+        return self._binary(o, "broadcast_power", "_power_scalar", "_rpower_scalar")
+
+    def __rpow__(self, o):
+        return self._binary(o, "broadcast_power", "_power_scalar", "_rpower_scalar",
+                            reverse=True)
+
+    def __neg__(self):
+        return _reg.invoke("negative", [self])
+
+    def __abs__(self):
+        return _reg.invoke("abs", [self])
+
+    def __eq__(self, o):
+        if o is None:
+            return False
+        return self._binary(o, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._binary(o, "broadcast_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, o):
+        return self._binary(o, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binary(o, "broadcast_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binary(o, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binary(o, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    __hash__ = None  # mutable; matches reference NDArray unhashability
+
+    # in-place (functional under the hood; tape-aware like reference += )
+    def __iadd__(self, o):
+        res = self.__add__(o)
+        self._adopt(res)
+        return self
+
+    def __isub__(self, o):
+        res = self.__sub__(o)
+        self._adopt(res)
+        return self
+
+    def __imul__(self, o):
+        res = self.__mul__(o)
+        self._adopt(res)
+        return self
+
+    def __itruediv__(self, o):
+        res = self.__truediv__(o)
+        self._adopt(res)
+        return self
+
+    def _adopt(self, res):
+        self._set_data(res._data)
+        self._tape_node = res._tape_node
+        self._tape_index = res._tape_index
+
+    # ------------------------------------------------------------------
+    # indexing
+    # ------------------------------------------------------------------
+    def _conv_index(self, key):
+        if isinstance(key, NDArray):
+            return self._data_index(key)
+        if isinstance(key, tuple):
+            return tuple(self._data_index(k) if isinstance(k, NDArray) else k
+                         for k in key)
+        return key
+
+    @staticmethod
+    def _data_index(k):
+        d = k.data()
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            d = d.astype(jnp.int32)
+        return d
+
+    def __getitem__(self, key):
+        key = self._conv_index(key)
+        out = NDArray(self._data[key], ctx=self._ctx)
+        if self._tape_node is not None and autograd.is_recording():
+            # route through an op so slicing stays differentiable on tape
+            raise MXNetError(
+                "basic indexing on taped arrays: use nd.slice/slice_axis"
+            )
+        return out
+
+    def __setitem__(self, key, value):
+        if autograd.is_recording() and self._in_graph:
+            raise MXNetError("in-place assignment on a taped array")
+        key = self._conv_index(key)
+        if isinstance(value, NDArray):
+            value = value._data
+        elif not isinstance(value, jax.Array):
+            value = _np.asarray(value)
+        if key is Ellipsis or (isinstance(key, slice) and key == slice(None)):
+            new = jnp.broadcast_to(jnp.asarray(value, dtype=self.dtype),
+                                   self.shape)
+        else:
+            new = self._data.at[key].set(jnp.asarray(value, dtype=self.dtype))
+        self._set_data(jnp.asarray(new, dtype=self.dtype))
+
+    # ------------------------------------------------------------------
+    # serialization handled in ndarray.utils (save/load)
+    # ------------------------------------------------------------------
+
+
+def _as_nd(x, ctx=None):
+    if isinstance(x, NDArray):
+        return x
+    return NDArray(x, ctx=ctx)
+
+
+# ----------------------------------------------------------------------------
+# creation helpers (parity: python/mxnet/ndarray/utils.py + ndarray.py)
+# ----------------------------------------------------------------------------
+
+
+def array(source_array, ctx=None, dtype=None):
+    return NDArray(source_array, ctx=ctx, dtype=dtype)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    dtype = dtype or "float32"
+    ctx = ctx or current_context()
+    return NDArray(jnp.zeros(shape, _to_jax_dtype(dtype)), ctx=ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    dtype = dtype or "float32"
+    ctx = ctx or current_context()
+    return NDArray(jnp.ones(shape, _to_jax_dtype(dtype)), ctx=ctx)
+
+
+def full(shape, val, ctx=None, dtype=None):
+    if isinstance(shape, int):
+        shape = (shape,)
+    dtype = dtype or "float32"
+    ctx = ctx or current_context()
+    return NDArray(jnp.full(shape, val, _to_jax_dtype(dtype)), ctx=ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    dtype = dtype or "float32"
+    out = jnp.arange(start, stop, step, _to_jax_dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return NDArray(out, ctx=ctx or current_context())
+
+
+def zeros_like(a):
+    return _reg.invoke("zeros_like", [a])
+
+
+def ones_like(a):
+    return _reg.invoke("ones_like", [a])
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return _reg.invoke("concat", list(arrays), {"dim": axis})
+
+
+def moveaxis(tensor, source, destination):
+    axes = list(range(tensor.ndim))
+    axes.remove(source % tensor.ndim)
+    axes.insert(destination % tensor.ndim, source % tensor.ndim)
+    return tensor.transpose(axes)
+
+
+def save(fname, data):
+    """Save NDArray / list / dict of NDArrays (parity: MXNDArraySave).
+
+    Format: numpy .npz with a manifest key encoding the container kind —
+    portable, versioned by numpy, loadable without this framework.
+    """
+    import io
+    import os
+
+    if isinstance(data, NDArray):
+        payload = {"__kind__": _np.asarray("single"), "arr_0": data.asnumpy()}
+    elif isinstance(data, (list, tuple)):
+        payload = {"__kind__": _np.asarray("list")}
+        for i, a in enumerate(data):
+            payload["arr_%d" % i] = a.asnumpy()
+    elif isinstance(data, dict):
+        payload = {"__kind__": _np.asarray("dict")}
+        for k, a in data.items():
+            payload["key:" + k] = a.asnumpy()
+    else:
+        raise TypeError("unsupported save payload")
+    with open(fname, "wb") as f:
+        _np.savez(f, **payload)
+
+
+def load(fname, ctx=None):
+    """Load what :func:`save` wrote (parity: MXNDArrayLoad)."""
+    with _np.load(fname, allow_pickle=False) as z:
+        kind = str(z["__kind__"])
+        if kind == "single":
+            return NDArray(z["arr_0"], ctx=ctx)
+        if kind == "list":
+            n = len([k for k in z.files if k.startswith("arr_")])
+            return [NDArray(z["arr_%d" % i], ctx=ctx) for i in range(n)]
+        out = {}
+        for k in z.files:
+            if k.startswith("key:"):
+                out[k[4:]] = NDArray(z[k], ctx=ctx)
+        return out
+
+
+def waitall():
+    Engine.get().wait_for_all()

@@ -1,0 +1,48 @@
+"""Generate the ``mx.nd.*`` op namespace at import.
+
+Reference: ``python/mxnet/ndarray/register.py:116-264`` — introspects the C op
+registry (``MXSymbolListAtomicSymbolCreators``) and ``exec``-generates Python
+wrappers.  Here the registry is in-process (``ops.registry``), so generation
+is a plain closure per op: positional NDArray args + tensor kwargs are routed
+to the op's declared input fields, everything else becomes static attrs.
+"""
+from __future__ import annotations
+
+from ..ops import registry as _reg
+
+
+def make_op_func(op_name):
+    reg = _reg.get(op_name)
+
+    def generic(*args, **kwargs):
+        from .ndarray import NDArray
+
+        out = kwargs.pop("out", None)
+        kwargs.pop("name", None)
+        if reg.variadic:
+            inputs = [a for a in args if isinstance(a, NDArray)]
+            attrs = kwargs
+            fields = None
+        else:
+            inputs = list(args)
+            fields = list(reg.input_names[: len(inputs)])
+            for nm in reg.input_names[len(inputs):]:
+                if nm in kwargs and isinstance(kwargs[nm], NDArray):
+                    inputs.append(kwargs.pop(nm))
+                    fields.append(nm)
+            attrs = kwargs
+        return _reg.invoke(op_name, inputs, attrs, out=out,
+                           fields=tuple(fields) if fields is not None else None)
+
+    generic.__name__ = op_name
+    generic.__doc__ = reg.doc
+    return generic
+
+
+def populate(namespace_dict, exclude_internal=False):
+    """Install every registered op into a module namespace (mx.nd / mx.sym)."""
+    for name in _reg.list_ops():
+        public = name
+        if name.startswith("_") and exclude_internal:
+            continue
+        namespace_dict.setdefault(public, make_op_func(name))

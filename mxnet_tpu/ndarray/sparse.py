@@ -1,0 +1,189 @@
+"""Sparse NDArrays: row_sparse + CSR.
+
+Reference: ``include/mxnet/ndarray.h:61-65`` (three storage types) and
+``python/mxnet/ndarray/sparse.py``.  XLA has no native sparse support
+(SURVEY.md §7 hard-part 4), so these are *structured dense pairs*:
+
+* ``RowSparseNDArray`` — (indices (K,), values (K, ...cols)) — the format the
+  KVStore rowwise push/pull and sparse Embedding gradients use.  Ops that
+  matter for the sparse training path (retain, sparse dot, conversion,
+  sgd/adam sparse update via scatter) are implemented on the pair directly;
+  everything else densifies explicitly via ``tostype('default')``.
+* ``CSRNDArray`` — (indptr, indices, data) for 2-D matrices; dot with dense
+  uses segment-sum (gather/scatter ride the VPU; fine for IO-bound workloads).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..context import current_context
+from .ndarray import NDArray, _to_jax_dtype
+
+
+class BaseSparseNDArray:
+    @property
+    def _in_graph(self):
+        return False
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    stype = "row_sparse"
+
+    def __init__(self, data, indices, shape, ctx=None):
+        self.values = data if isinstance(data, NDArray) else NDArray(data, ctx=ctx)
+        self.indices = (indices if isinstance(indices, NDArray)
+                        else NDArray(indices, ctx=ctx, dtype="int64"))
+        self._shape = tuple(shape)
+        self._ctx = ctx or current_context()
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def context(self):
+        return self._ctx
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            dense = jnp.zeros(self._shape, self.values.dtype)
+            dense = dense.at[self.indices.data().astype(jnp.int32)].set(
+                self.values.data())
+            return NDArray(dense, ctx=self._ctx)
+        raise MXNetError("cannot convert row_sparse to %s" % stype)
+
+    def asnumpy(self):
+        return self.tostype("default").asnumpy()
+
+    def copyto(self, other):
+        return self.tostype("default").copyto(other)
+
+    def __repr__(self):
+        return "<RowSparseNDArray %s @%s>" % (
+            "x".join(map(str, self._shape)), self._ctx)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    stype = "csr"
+
+    def __init__(self, data, indptr, indices, shape, ctx=None):
+        self.data_arr = data if isinstance(data, NDArray) else NDArray(data, ctx=ctx)
+        self.indptr = (indptr if isinstance(indptr, NDArray)
+                       else NDArray(indptr, ctx=ctx, dtype="int64"))
+        self.indices = (indices if isinstance(indices, NDArray)
+                        else NDArray(indices, ctx=ctx, dtype="int64"))
+        self._shape = tuple(shape)
+        self._ctx = ctx or current_context()
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self.data_arr.dtype
+
+    @property
+    def context(self):
+        return self._ctx
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            m, n = self._shape
+            indptr = self.indptr.asnumpy().astype(_np.int64)
+            indices = self.indices.asnumpy().astype(_np.int64)
+            vals = self.data_arr.asnumpy()
+            dense = _np.zeros((m, n), vals.dtype)
+            for r in range(m):
+                dense[r, indices[indptr[r]:indptr[r + 1]]] = vals[
+                    indptr[r]:indptr[r + 1]]
+            return NDArray(dense, ctx=self._ctx)
+        raise MXNetError("cannot convert csr to %s" % stype)
+
+    def asnumpy(self):
+        return self.tostype("default").asnumpy()
+
+    def __repr__(self):
+        return "<CSRNDArray %s @%s>" % ("x".join(map(str, self._shape)), self._ctx)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        return RowSparseNDArray(_np.asarray(data, dtype=dtype or "float32"),
+                                _np.asarray(indices), shape, ctx=ctx)
+    dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1,
+                        dtype=dtype or "float32")
+    nz = _np.where(_np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
+    return RowSparseNDArray(dense[nz], nz.astype(_np.int64), dense.shape, ctx=ctx)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(_np.asarray(data, dtype=dtype or "float32"),
+                          _np.asarray(indptr), _np.asarray(indices), shape, ctx=ctx)
+    dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1,
+                        dtype=dtype or "float32")
+    m, n = dense.shape
+    indptr = [0]
+    indices = []
+    vals = []
+    for r in range(m):
+        cols = _np.where(dense[r] != 0)[0]
+        indices.extend(cols.tolist())
+        vals.extend(dense[r, cols].tolist())
+        indptr.append(len(indices))
+    return CSRNDArray(_np.asarray(vals, dense.dtype), _np.asarray(indptr),
+                      _np.asarray(indices), (m, n), ctx=ctx)
+
+
+def dense_to(arr, stype):
+    if stype == "row_sparse":
+        return row_sparse_array(arr)
+    if stype == "csr":
+        return csr_matrix(arr)
+    raise MXNetError("unknown stype %s" % stype)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    dt = dtype or "float32"
+    if stype == "row_sparse":
+        cols = shape[1:] if len(shape) > 1 else ()
+        return RowSparseNDArray(_np.zeros((0,) + tuple(cols), dt),
+                                _np.zeros((0,), "int64"), shape, ctx=ctx)
+    if stype == "csr":
+        return CSRNDArray(_np.zeros((0,), dt), _np.zeros((shape[0] + 1,), "int64"),
+                          _np.zeros((0,), "int64"), shape, ctx=ctx)
+    from .ndarray import zeros as dzeros
+
+    return dzeros(shape, ctx=ctx, dtype=dt)
+
+
+def retain(data, indices):
+    """Keep only given rows of a RowSparseNDArray (parity: sparse_retain op)."""
+    keep = _np.asarray(indices.asnumpy() if isinstance(indices, NDArray)
+                       else indices).astype(_np.int64)
+    cur_idx = data.indices.asnumpy()
+    mask = _np.isin(cur_idx, keep)
+    return RowSparseNDArray(NDArray(data.values.data()[_np.where(mask)[0]]),
+                            cur_idx[mask], data.shape, ctx=data.context)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """csr · dense and rowsparse-aware dot."""
+    if isinstance(lhs, CSRNDArray):
+        dense = lhs.tostype("default")
+        return dense.dot(rhs, transpose_a=transpose_a, transpose_b=transpose_b)
+    return lhs.dot(rhs, transpose_a=transpose_a, transpose_b=transpose_b)

@@ -1,0 +1,146 @@
+"""Contrib operators (reference: src/operator/contrib/).
+
+The fused attention matmuls live in ops/nn.py; here: bounding-box / NMS-ish
+utilities, FFT, index ops, and the boolean_mask family with static-shape
+semantics (XLA needs static shapes; see each docstring for the deviation).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("_contrib_fft")
+def _fft(data, compute_size=128):
+    out = jnp.fft.fft(data.astype(jnp.complex64))
+    return jnp.stack([out.real, out.imag], axis=-1).reshape(
+        data.shape[:-1] + (2 * data.shape[-1],)).astype(jnp.float32)
+
+
+@register("_contrib_ifft")
+def _ifft(data, compute_size=128):
+    c = data.reshape(data.shape[:-1] + (data.shape[-1] // 2, 2))
+    comp = c[..., 0] + 1j * c[..., 1]
+    return jnp.fft.ifft(comp).real.astype(jnp.float32) * comp.shape[-1]
+
+
+@register("_contrib_index_copy")
+def _index_copy(old, idx, new):
+    return old.at[idx.astype(jnp.int32)].set(new)
+
+
+@register("_contrib_index_array")
+def _index_array(data, axes=None):
+    shape = data.shape
+    if axes is None:
+        axes = tuple(range(len(shape)))
+    grids = jnp.meshgrid(*[jnp.arange(shape[a]) for a in axes], indexing="ij")
+    return jnp.stack(grids, axis=-1).astype(jnp.int64)
+
+
+@register("_contrib_getnnz")
+def _getnnz(data, axis=None):
+    return jnp.sum((data != 0).astype(jnp.int64), axis=axis)
+
+
+@register("_contrib_gradientmultiplier")
+def _gradientmultiplier(data, scalar=1.0):
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        return (g * scalar,)
+
+    f.defvjp(fwd, bwd)
+    return f(data)
+
+
+@register("_contrib_box_iou")
+def _box_iou(lhs, rhs, format="corner"):
+    """IoU matrix between two box sets (parity: bounding_box.cc box_iou)."""
+    if format == "center":
+        def to_corner(b):
+            cx, cy, w, h = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+            return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+
+        lhs, rhs = to_corner(lhs), to_corner(rhs)
+    l = lhs[..., :, None, :]
+    r = rhs[..., None, :, :]
+    tl = jnp.maximum(l[..., :2], r[..., :2])
+    br = jnp.minimum(l[..., 2:], r[..., 2:])
+    wh = jnp.maximum(br - tl, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_l = (l[..., 2] - l[..., 0]) * (l[..., 3] - l[..., 1])
+    area_r = (r[..., 2] - r[..., 0]) * (r[..., 3] - r[..., 1])
+    return inter / jnp.maximum(area_l + area_r - inter, 1e-12)
+
+
+@register("_contrib_box_nms", num_outputs=2)
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
+             score_index=1, id_index=-1, background_id=-1, force_suppress=False,
+             in_format="corner", out_format="corner"):
+    """Greedy NMS with static shapes via lax.fori_loop (suppressed → score -1)."""
+    shape = data.shape
+    flat = data.reshape((-1,) + shape[-2:])  # (B, N, E)
+    B, N, E = flat.shape
+
+    def nms_one(boxes):
+        scores = boxes[:, score_index]
+        order = jnp.argsort(-scores)
+        sorted_boxes = boxes[order]
+        coords = sorted_boxes[:, coord_start:coord_start + 4]
+        ious = _box_iou(coords, coords, format=in_format)
+        valid0 = sorted_boxes[:, score_index] > valid_thresh
+
+        def body(i, keep):
+            sup = jnp.logical_and(keep[i], ious[i] > overlap_thresh)
+            sup = sup.at[:i + 1].set(False)
+            return jnp.logical_and(keep, ~sup)
+
+        keep = lax.fori_loop(0, N, body, valid0)
+        out = jnp.where(keep[:, None], sorted_boxes,
+                        sorted_boxes.at[:, score_index].set(-1.0) * 0 - 1.0)
+        out = jnp.where(keep[:, None], sorted_boxes, -jnp.ones_like(sorted_boxes))
+        return out, order.astype(jnp.float32)
+
+    outs, idxs = jax.vmap(nms_one)(flat)
+    return outs.reshape(shape), idxs.reshape(shape[:-1])
+
+
+@register("_contrib_quantize", num_outputs=3)
+def _quantize(data, min_range, max_range, out_type="uint8"):
+    """Linear quantization (parity: src/operator/quantization/quantize.cc)."""
+    if out_type == "uint8":
+        qmin, qmax = 0.0, 255.0
+        dt = jnp.uint8
+    else:
+        qmin, qmax = -127.0, 127.0
+        dt = jnp.int8
+    scale = (qmax - qmin) / jnp.maximum(max_range - min_range, 1e-12)
+    q = jnp.clip(jnp.round((data - min_range) * scale + qmin), qmin, qmax)
+    return q.astype(dt), min_range, max_range
+
+
+@register("_contrib_dequantize")
+def _dequantize(data, min_range, max_range, out_type="float32"):
+    if data.dtype == jnp.uint8:
+        qmin, qmax = 0.0, 255.0
+    else:
+        qmin, qmax = -127.0, 127.0
+    scale = (max_range - min_range) / (qmax - qmin)
+    return (data.astype(jnp.float32) - qmin) * scale + min_range
+
+
+@register("_contrib_count_sketch")
+def _count_sketch(data, h, s, out_dim=16, processing_batch_size=32):
+    idx = h.astype(jnp.int32).reshape(-1)
+    sign = s.reshape(-1)
+    out = jnp.zeros(data.shape[:-1] + (out_dim,), data.dtype)
+    return out.at[..., idx].add(data * sign)

@@ -1,0 +1,727 @@
+"""Neural-net operators on XLA.
+
+Reference: ``src/operator/nn/`` (conv/FC/pool/norm/softmax/dropout, cuDNN and
+MKL-DNN backed) and ``src/operator/rnn.cc`` (monolithic RNN op).  TPU-native:
+convolutions are ``lax.conv_general_dilated`` (MXU-tiled by XLA), pooling is
+``lax.reduce_window``, norms are fused elementwise trees XLA folds into
+neighbouring matmuls, RNN is a ``lax.scan`` so the whole unrolled sequence
+compiles to a single executable with static shapes.
+
+Layout: MXNet's native layout is NCHW.  Every spatial op takes a ``layout``
+attr and also accepts NHWC — the layout XLA/TPU prefers — and the gluon layers
+default to NHWC-on-TPU while presenting NCHW-compatible semantics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+# ----------------------------------------------------------------------------
+# FullyConnected
+# ----------------------------------------------------------------------------
+
+
+@register("FullyConnected", aliases=("fully_connected",),
+          inputs=("data", "weight", "bias"))
+def _fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False,
+                     flatten=True):
+    """Parity: src/operator/nn/fully_connected.cc:258 (y = x·Wᵀ + b).
+
+    Weight layout matches reference: (num_hidden, in_units); compute stays in
+    the input dtype (bf16 in, bf16 out) with MXU accumulation in fp32.
+    """
+    x = data.reshape(data.shape[0], -1) if flatten else data
+    y = lax.dot_general(
+        x, weight,
+        (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    if not no_bias and bias is not None:
+        y = y + bias
+    return y
+
+
+# ----------------------------------------------------------------------------
+# Convolution / Deconvolution
+# ----------------------------------------------------------------------------
+
+_CONV_DIMNUMS = {
+    # layout -> (lhs_spec, rhs_spec, out_spec) for lax.conv_general_dilated
+    "NCHW": ("NCHW", "OIHW", "NCHW"),
+    "NHWC": ("NHWC", "HWIO", "NHWC"),
+    "NCW": ("NCH", "OIH", "NCH"),
+    "NWC": ("NHC", "HIO", "NHC"),
+    "NCDHW": ("NCDHW", "OIDHW", "NCDHW"),
+    "NDHWC": ("NDHWC", "DHWIO", "NDHWC"),
+}
+
+
+def _as_tuple(v, n):
+    if isinstance(v, (tuple, list)):
+        return tuple(v)
+    return (v,) * n
+
+
+@register("Convolution", aliases=("convolution",),
+          inputs=("data", "weight", "bias"))
+def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(),
+                 num_filter=0, num_group=1, no_bias=False, layout="NCHW",
+                 cudnn_tune=None, cudnn_off=False, workspace=1024):
+    """Parity: src/operator/nn/convolution.cc. XLA lowers straight to the MXU.
+
+    ``weight`` is stored in the layout the dimension-numbers expect:
+    OIHW for NCHW graphs, HWIO for NHWC graphs (TPU-preferred).
+    """
+    nd = len(kernel) if kernel else 2
+    stride = _as_tuple(stride, nd) if stride else (1,) * nd
+    dilate = _as_tuple(dilate, nd) if dilate else (1,) * nd
+    pad = _as_tuple(pad, nd) if pad else (0,) * nd
+    specs = _CONV_DIMNUMS[layout]
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, specs)
+    out = lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.float32,
+    ).astype(data.dtype)
+    if not no_bias and bias is not None:
+        if layout.endswith("C") or layout in ("NWC", "NHWC", "NDHWC"):
+            out = out + bias
+        else:
+            out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register("Deconvolution", aliases=("deconvolution",),
+          inputs=("data", "weight", "bias"))
+def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(),
+                   adj=(), target_shape=(), num_filter=0, num_group=1, no_bias=True,
+                   layout="NCHW", cudnn_tune=None, cudnn_off=False, workspace=1024):
+    """Transposed conv (parity: src/operator/nn/deconvolution.cc)."""
+    nd = len(kernel) if kernel else 2
+    stride = _as_tuple(stride, nd) if stride else (1,) * nd
+    pad = _as_tuple(pad, nd) if pad else (0,) * nd
+    dilate = _as_tuple(dilate, nd) if dilate else (1,) * nd
+    specs = _CONV_DIMNUMS[layout]
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, specs)
+    out = lax.conv_transpose(
+        data, weight,
+        strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        transpose_kernel=True,
+    ).astype(data.dtype)
+    if not no_bias and bias is not None:
+        if layout in ("NWC", "NHWC", "NDHWC"):
+            out = out + bias
+        else:
+            out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+# ----------------------------------------------------------------------------
+# Pooling
+# ----------------------------------------------------------------------------
+
+
+@register("Pooling", aliases=("pooling",))
+def _pooling(data, kernel=(), pool_type="max", stride=(), pad=(), global_pool=False,
+             pooling_convention="valid", layout="NCHW", count_include_pad=True,
+             cudnn_off=False):
+    """Parity: src/operator/nn/pooling.cc via lax.reduce_window."""
+    if layout in ("NCHW", "NCW", "NCDHW"):
+        spatial = tuple(range(2, data.ndim))
+    else:
+        spatial = tuple(range(1, data.ndim - 1))
+    if global_pool:
+        if pool_type == "max":
+            return jnp.max(data, axis=spatial, keepdims=True)
+        return jnp.mean(data, axis=spatial, keepdims=True)
+    nd = len(kernel)
+    stride = _as_tuple(stride, nd) if stride else (1,) * nd
+    pad = _as_tuple(pad, nd) if pad else (0,) * nd
+    window = [1] * data.ndim
+    strides = [1] * data.ndim
+    pads = [(0, 0)] * data.ndim
+    for i, ax in enumerate(spatial):
+        window[ax] = kernel[i]
+        strides[ax] = stride[i]
+        lo = pad[i]
+        hi = pad[i]
+        if pooling_convention == "full":
+            # ceil-mode: add extra high padding so the last window fits
+            size = data.shape[ax] + 2 * pad[i] - kernel[i]
+            rem = size % stride[i]
+            if rem:
+                hi += stride[i] - rem
+        pads[ax] = (lo, hi)
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max,
+                                 window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        summed = lax.reduce_window(data, jnp.asarray(0, data.dtype), lax.add,
+                                   window, strides, pads)
+        if pool_type == "sum":
+            return summed
+        if count_include_pad:
+            denom = 1
+            for i in range(nd):
+                denom *= kernel[i]
+            return summed / jnp.asarray(denom, data.dtype)
+        ones = jnp.ones(data.shape, data.dtype)
+        counts = lax.reduce_window(ones, jnp.asarray(0, data.dtype), lax.add,
+                                   window, strides, pads)
+        return summed / counts
+    if pool_type == "lp":
+        p2 = lax.reduce_window(jnp.abs(data) ** 2, jnp.asarray(0, data.dtype),
+                               lax.add, window, strides, pads)
+        return jnp.sqrt(p2)
+    raise ValueError("unknown pool_type %r" % pool_type)
+
+
+# ----------------------------------------------------------------------------
+# Normalization
+# ----------------------------------------------------------------------------
+
+
+@register("BatchNorm", aliases=("batch_norm",), needs_mode=True, num_outputs=3)
+def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
+                fix_gamma=True, use_global_stats=False, output_mean_var=False,
+                axis=1, cudnn_off=False, _mode="predict"):
+    """Parity: src/operator/nn/batch_norm.cc.
+
+    Returns (out, new_moving_mean, new_moving_var); the imperative/gluon layer
+    writes the aux outputs back into its running-stat arrays (the reference
+    mutates aux states in place inside the op — impossible on immutable XLA
+    buffers, so state threading is explicit).
+    """
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    reduce_axes = tuple(i for i in range(data.ndim) if i != axis % data.ndim)
+    bshape = [1] * data.ndim
+    bshape[axis % data.ndim] = data.shape[axis % data.ndim]
+    if _mode == "train" and not use_global_stats:
+        mean = jnp.mean(data.astype(jnp.float32), axis=reduce_axes)
+        var = jnp.var(data.astype(jnp.float32), axis=reduce_axes)
+        new_mm = moving_mean * momentum + mean.astype(moving_mean.dtype) * (1 - momentum)
+        new_mv = moving_var * momentum + var.astype(moving_var.dtype) * (1 - momentum)
+    else:
+        mean, var = moving_mean.astype(jnp.float32), moving_var.astype(jnp.float32)
+        new_mm, new_mv = moving_mean, moving_var
+    inv = lax.rsqrt(var + eps)
+    scale = (g.astype(jnp.float32) * inv).reshape(bshape)
+    shift = (beta.astype(jnp.float32) - mean * g.astype(jnp.float32) * inv).reshape(bshape)
+    out = (data.astype(jnp.float32) * scale + shift).astype(data.dtype)
+    return out, lax.stop_gradient(new_mm), lax.stop_gradient(new_mv)
+
+
+@register("LayerNorm", aliases=("layer_norm",))
+def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    """Parity: src/operator/nn/layer_norm.cc. Stats in fp32 for bf16 inputs."""
+    x = data.astype(jnp.float32)
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    shape = [1] * data.ndim
+    shape[axis % data.ndim] = data.shape[axis % data.ndim]
+    out = (x - mean) * lax.rsqrt(var + eps)
+    out = out * gamma.astype(jnp.float32).reshape(shape) + beta.astype(jnp.float32).reshape(shape)
+    return out.astype(data.dtype)
+
+
+@register("InstanceNorm", aliases=("instance_norm",))
+def _instance_norm(data, gamma, beta, eps=1e-3):
+    x = data.astype(jnp.float32)
+    axes = tuple(range(2, data.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    out = (x - mean) * lax.rsqrt(var + eps)
+    out = out * gamma.astype(jnp.float32).reshape(shape) + beta.astype(jnp.float32).reshape(shape)
+    return out.astype(data.dtype)
+
+
+@register("GroupNorm", aliases=("group_norm",))
+def _group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
+    n, c = data.shape[:2]
+    x = data.astype(jnp.float32).reshape((n, num_groups, c // num_groups) + data.shape[2:])
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    x = (x - mean) * lax.rsqrt(var + eps)
+    x = x.reshape(data.shape)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    out = x * gamma.astype(jnp.float32).reshape(shape) + beta.astype(jnp.float32).reshape(shape)
+    return out.astype(data.dtype)
+
+
+@register("L2Normalization", aliases=("l2_normalization",))
+def _l2_normalization(data, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        axes = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    else:  # spatial
+        axes = tuple(range(2, data.ndim))
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=True) + eps)
+    return data / norm
+
+
+@register("RMSNorm", aliases=("rms_norm",))
+def _rms_norm(data, gamma, axis=-1, eps=1e-6):
+    """TPU-era addition (no reference counterpart; used by Llama-family models)."""
+    x = data.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(x), axis=axis, keepdims=True)
+    out = x * lax.rsqrt(ms + eps) * gamma.astype(jnp.float32)
+    return out.astype(data.dtype)
+
+
+# ----------------------------------------------------------------------------
+# Activations / softmax
+# ----------------------------------------------------------------------------
+
+
+@register("Activation", aliases=("activation",))
+def _activation(data, act_type="relu"):
+    fns = {
+        "relu": jax.nn.relu,
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "softrelu": jax.nn.softplus,
+        "softsign": jax.nn.soft_sign,
+        "gelu": jax.nn.gelu,
+        "silu": jax.nn.silu,
+        "erf": jax.scipy.special.erf,
+    }
+    return fns[act_type](data)
+
+
+@register("LeakyReLU", aliases=("leaky_relu",), needs_rng=True, needs_mode=True,
+          inputs=("data", "gamma"))
+def _leaky_relu(key, data, gamma=None, act_type="leaky", slope=0.25,
+                lower_bound=0.125, upper_bound=0.334, _mode="predict"):
+    if act_type == "leaky":
+        return jnp.where(data > 0, data, slope * data)
+    if act_type == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2)) if data.ndim > 2 else gamma
+        return jnp.where(data > 0, data, g * data)
+    if act_type == "elu":
+        return jnp.where(data > 0, data, slope * (jnp.exp(data) - 1.0))
+    if act_type == "selu":
+        a, s = 1.6732632423543772, 1.0507009873554805
+        return s * jnp.where(data > 0, data, a * (jnp.exp(data) - 1.0))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "rrelu":
+        if _mode == "train":
+            s = jax.random.uniform(key, data.shape, jnp.float32, lower_bound, upper_bound)
+            return jnp.where(data > 0, data, s.astype(data.dtype) * data)
+        s = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data > 0, data, s * data)
+    raise ValueError("unknown act_type %r" % act_type)
+
+
+@register("softmax", inputs=("data", "length"))
+def _softmax(data, axis=-1, temperature=None, length=None, use_length=False,
+             dtype=None):
+    x = data if temperature in (None, 1.0) else data / temperature
+    if use_length and length is not None:
+        steps = jnp.arange(x.shape[axis])
+        shape = [1] * x.ndim
+        shape[axis] = x.shape[axis]
+        mask = steps.reshape(shape) < length.reshape(
+            [x.shape[0]] + [1] * (x.ndim - 1)
+        )
+        x = jnp.where(mask, x, -jnp.inf)
+    out = jax.nn.softmax(x, axis=axis)
+    if dtype is not None:
+        out = out.astype(jnp.dtype(dtype))
+    return out
+
+
+@register("log_softmax")
+def _log_softmax(data, axis=-1, temperature=None, dtype=None):
+    x = data if temperature in (None, 1.0) else data / temperature
+    out = jax.nn.log_softmax(x, axis=axis)
+    if dtype is not None:
+        out = out.astype(jnp.dtype(dtype))
+    return out
+
+
+@register("softmin")
+def _softmin(data, axis=-1):
+    return jax.nn.softmax(-data, axis=axis)
+
+
+@register("SoftmaxActivation")
+def _softmax_activation(data, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+from functools import partial
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _softmax_output_impl(data, label, grad_scale, ignore_label, use_ignore,
+                         normalization):
+    return jax.nn.softmax(data, axis=-1)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore,
+                        normalization):
+    prob = jax.nn.softmax(data, axis=-1)
+    return prob, (prob, label)
+
+
+def _softmax_output_bwd(grad_scale, ignore_label, use_ignore, normalization,
+                        res, g):
+    # Reference semantics (src/operator/softmax_output-inl.h): backward IGNORES
+    # the incoming head gradient and emits (p - onehot) * grad_scale.
+    prob, label = res
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), prob.shape[-1], dtype=prob.dtype)
+    scale = grad_scale
+    if normalization == "batch":
+        scale = scale / prob.shape[0]
+    elif normalization == "valid" and use_ignore:
+        valid = jnp.sum((label != ignore_label).astype(prob.dtype))
+        scale = scale / jnp.maximum(valid, 1.0)
+    grad = (prob - onehot) * scale
+    if use_ignore:
+        keep = (label != ignore_label).astype(prob.dtype)[..., None]
+        grad = grad * keep
+    return grad.astype(prob.dtype), jnp.zeros_like(label)
+
+
+_softmax_output_impl.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
+@register("SoftmaxOutput", aliases=("softmax_output",))
+def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                    multi_output=False, use_ignore=False, preserve_shape=False,
+                    normalization="null", out_grad=False, smooth_alpha=0.0):
+    """Legacy fused softmax+CE head (parity: src/operator/softmax_output.cc)."""
+    return _softmax_output_impl(data, label, grad_scale, ignore_label,
+                                bool(use_ignore), normalization)
+
+
+# ----------------------------------------------------------------------------
+# Dropout / Embedding
+# ----------------------------------------------------------------------------
+
+
+@register("Dropout", aliases=("dropout",), needs_rng=True, needs_mode=True)
+def _dropout(key, data, p=0.5, mode="training", axes=(), cudnn_off=False,
+             _mode="predict"):
+    if _mode != "train" and mode != "always":
+        return data
+    if p <= 0.0:
+        return data
+    shape = list(data.shape)
+    for ax in axes or ():
+        shape[ax] = 1
+    keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+    return jnp.where(keep, data / (1.0 - p), jnp.zeros((), data.dtype))
+
+
+@register("Embedding", aliases=("embedding",))
+def _embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
+               sparse_grad=False):
+    """Parity: src/operator/tensor/indexing_op.cc Embedding. take → one MXU gather."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+# ----------------------------------------------------------------------------
+# Loss-ish ops
+# ----------------------------------------------------------------------------
+
+
+@register("smooth_l1")
+def _smooth_l1(data, scalar=1.0):
+    s2 = scalar * scalar
+    return jnp.where(
+        jnp.abs(data) < 1.0 / s2,
+        0.5 * s2 * jnp.square(data),
+        jnp.abs(data) - 0.5 / s2,
+    )
+
+
+@register("softmax_cross_entropy")
+def _softmax_cross_entropy(data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), data.shape[-1], dtype=logp.dtype)
+    return jnp.sum(-onehot * logp)
+
+
+@register("CTCLoss", aliases=("ctc_loss",), num_outputs=2,
+          inputs=("data", "label", "data_lengths", "label_lengths"))
+def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
+              use_data_lengths=False, use_label_lengths=False, blank_label="first"):
+    """CTC forward-backward in log space via lax.scan.
+
+    Parity: src/operator/nn/ctc_loss.cc (warpctc).  data: (T, B, C) logits.
+    Blank index 0 (`first`) or C-1 (`last`).  Returns (loss(B,), grads-alias).
+    """
+    T, B, C = data.shape
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    blank = 0 if blank_label == "first" else C - 1
+    labels = label.astype(jnp.int32)  # (B, L)
+    L = labels.shape[1]
+    if use_label_lengths and label_lengths is not None:
+        lab_len = label_lengths.astype(jnp.int32)
+    else:
+        pad = 0 if blank_label == "first" else -1
+        lab_len = jnp.sum((labels != pad).astype(jnp.int32), axis=1)
+    if use_data_lengths and data_lengths is not None:
+        dat_len = data_lengths.astype(jnp.int32)
+    else:
+        dat_len = jnp.full((B,), T, jnp.int32)
+    # extended label seq: blank l1 blank l2 ... blank  (len S = 2L+1)
+    S = 2 * L + 1
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    NEG = jnp.float32(-1e30)
+    pos = jnp.arange(S)[None, :]
+    # alpha init
+    alpha0 = jnp.full((B, S), NEG)
+    alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
+    first_lab = jnp.take_along_axis(logp[0], ext[:, 1:2], axis=1)[:, 0]
+    alpha0 = alpha0.at[:, 1].set(jnp.where(lab_len > 0, first_lab, NEG))
+
+    same_as_prev2 = jnp.concatenate(
+        [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1
+    )
+    is_blank = ext == blank
+
+    def step(alpha, t):
+        shift1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+        shift2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
+        allow2 = jnp.logical_and(~is_blank, ~same_as_prev2)
+        merged = jnp.logaddexp(alpha, shift1)
+        merged = jnp.where(allow2, jnp.logaddexp(merged, shift2), merged)
+        emit = jnp.take_along_axis(logp[t], ext, axis=1)
+        new_alpha = merged + emit
+        # past data length: freeze
+        active = (t < dat_len)[:, None]
+        new_alpha = jnp.where(active, new_alpha, alpha)
+        return new_alpha, None
+
+    alphaT, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    endpos = 2 * lab_len - 1
+    a_last = jnp.take_along_axis(alphaT, jnp.maximum(endpos, 0)[:, None], axis=1)[:, 0]
+    a_blank = jnp.take_along_axis(alphaT, (2 * lab_len)[:, None], axis=1)[:, 0]
+    ll = jnp.logaddexp(jnp.where(lab_len > 0, a_last, NEG), a_blank)
+    loss = -ll
+    return loss.astype(data.dtype), jnp.zeros_like(data)
+
+
+# ----------------------------------------------------------------------------
+# RNN (vanilla/LSTM/GRU) as lax.scan — parity: src/operator/rnn.cc:299
+# ----------------------------------------------------------------------------
+
+
+def _rnn_cell_step(mode, x, h, c, wx, wh, bx, bh):
+    if mode == "rnn_tanh":
+        return jnp.tanh(x @ wx.T + bx + h @ wh.T + bh), c
+    if mode == "rnn_relu":
+        return jax.nn.relu(x @ wx.T + bx + h @ wh.T + bh), c
+    if mode == "lstm":
+        gates = x @ wx.T + bx + h @ wh.T + bh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c2 = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
+        return h2, c2
+    if mode == "gru":
+        gx = x @ wx.T + bx
+        gh = h @ wh.T + bh
+        rx, zx, nx = jnp.split(gx, 3, axis=-1)
+        rh, zh, nh = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(rx + rh)
+        z = jax.nn.sigmoid(zx + zh)
+        n = jnp.tanh(nx + r * nh)
+        return (1 - z) * n + z * h, c
+    raise ValueError(mode)
+
+
+def _gates(mode):
+    return {"rnn_tanh": 1, "rnn_relu": 1, "lstm": 4, "gru": 3}[mode]
+
+
+def _unpack_rnn_params(params, mode, num_layers, input_size, state_size,
+                       bidirectional):
+    """Unflatten the reference's packed parameter vector (rnn-inl.h layout):
+    for each layer/direction: W_x (G*H, in), W_h (G*H, H); then all biases."""
+    G = _gates(mode)
+    dirs = 2 if bidirectional else 1
+    offset = 0
+    weights = []
+    for layer in range(num_layers):
+        in_size = input_size if layer == 0 else state_size * dirs
+        for _ in range(dirs):
+            wx = lax.dynamic_slice(params, (offset,), (G * state_size * in_size,)).reshape(
+                G * state_size, in_size)
+            offset += G * state_size * in_size
+            wh = lax.dynamic_slice(params, (offset,), (G * state_size * state_size,)).reshape(
+                G * state_size, state_size)
+            offset += G * state_size * state_size
+            weights.append((wx, wh))
+    biases = []
+    for layer in range(num_layers):
+        for _ in range(dirs):
+            bx = lax.dynamic_slice(params, (offset,), (G * state_size,))
+            offset += G * state_size
+            bh = lax.dynamic_slice(params, (offset,), (G * state_size,))
+            offset += G * state_size
+            biases.append((bx, bh))
+    return [(wx, wh, bx, bh) for (wx, wh), (bx, bh) in zip(weights, biases)]
+
+
+def rnn_param_size(mode, num_layers, input_size, state_size, bidirectional=False):
+    G = _gates(mode)
+    dirs = 2 if bidirectional else 1
+    total = 0
+    for layer in range(num_layers):
+        in_size = input_size if layer == 0 else state_size * dirs
+        total += dirs * G * state_size * (in_size + state_size + 2)
+    return total
+
+
+@register("RNN", aliases=("rnn",), needs_rng=True, needs_mode=True, num_outputs=3,
+          inputs=("data", "parameters", "state", "state_cell"))
+def _rnn(key, data, parameters, state, state_cell=None, state_size=0, num_layers=1,
+         mode="lstm", bidirectional=False, p=0.0, state_outputs=True,
+         projection_size=None, use_sequence_length=False, _mode="predict"):
+    """Monolithic RNN op (parity: rnn.cc:299). data: (T, B, I); scan over T.
+
+    Outputs (out(T,B,H*dirs), h_n, c_n).  The whole multi-layer loop is one
+    lax.scan-per-layer chain → single fused executable; XLA pipelines the
+    per-step matmuls on the MXU.
+    """
+    T, B, I = data.shape
+    dirs = 2 if bidirectional else 1
+    layers = _unpack_rnn_params(parameters, mode, num_layers, I, state_size,
+                                bidirectional)
+    h0 = state  # (L*dirs, B, H)
+    c0 = state_cell if state_cell is not None else jnp.zeros_like(state)
+    x = data
+    h_out, c_out = [], []
+    for layer in range(num_layers):
+        outs_dir = []
+        for d in range(dirs):
+            wx, wh, bx, bh = layers[layer * dirs + d]
+            hh = h0[layer * dirs + d]
+            cc = c0[layer * dirs + d]
+            seq = x if d == 0 else jnp.flip(x, axis=0)
+
+            def step(carry, xt, wx=wx, wh=wh, bx=bx, bh=bh):
+                h, c = carry
+                h2, c2 = _rnn_cell_step(mode, xt, h, c, wx, wh, bx, bh)
+                return (h2, c2), h2
+
+            (hT, cT), ys = lax.scan(step, (hh, cc), seq)
+            if d == 1:
+                ys = jnp.flip(ys, axis=0)
+            outs_dir.append(ys)
+            h_out.append(hT)
+            c_out.append(cT)
+        x = outs_dir[0] if dirs == 1 else jnp.concatenate(outs_dir, axis=-1)
+        if p > 0.0 and _mode == "train" and layer < num_layers - 1:
+            key, sub = jax.random.split(key)
+            keep = jax.random.bernoulli(sub, 1.0 - p, x.shape)
+            x = jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype))
+    return x, jnp.stack(h_out), jnp.stack(c_out)
+
+
+# ----------------------------------------------------------------------------
+# Attention (reference: src/operator/contrib/transformer.cc:650-780)
+# ----------------------------------------------------------------------------
+
+
+@register("_contrib_interleaved_matmul_selfatt_qk")
+def _interleaved_matmul_selfatt_qk(queries_keys_values, heads=1):
+    """(T, B, 3*H*D) interleaved qkv → scaled QKᵀ (B*heads, T, T)."""
+    T, B, _ = queries_keys_values.shape
+    x = queries_keys_values.reshape(T, B, heads, 3, -1)
+    q = x[:, :, :, 0, :]
+    k = x[:, :, :, 1, :]
+    D = q.shape[-1]
+    q = jnp.transpose(q, (1, 2, 0, 3)).reshape(B * heads, T, D)
+    k = jnp.transpose(k, (1, 2, 0, 3)).reshape(B * heads, T, D)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32)).astype(q.dtype)
+    return jnp.matmul(q * scale, jnp.swapaxes(k, -1, -2))
+
+
+@register("_contrib_interleaved_matmul_selfatt_valatt")
+def _interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads=1):
+    T, B, _ = queries_keys_values.shape
+    x = queries_keys_values.reshape(T, B, heads, 3, -1)
+    v = x[:, :, :, 2, :]
+    D = v.shape[-1]
+    v = jnp.transpose(v, (1, 2, 0, 3)).reshape(B * heads, T, D)
+    out = jnp.matmul(attention, v)  # (B*heads, T, D)
+    out = out.reshape(B, heads, T, D)
+    return jnp.transpose(out, (2, 0, 1, 3)).reshape(T, B, heads * D)
+
+
+@register("_contrib_arange_like")
+def _arange_like(data, start=0.0, step=1.0, axis=None):
+    if axis is None:
+        n = data.size
+        return jnp.arange(start, start + step * n, step, dtype=data.dtype).reshape(
+            data.shape)
+    n = data.shape[axis]
+    return jnp.arange(start, start + step * n, step, dtype=data.dtype)
+
+
+@register("_contrib_div_sqrt_dim")
+def _div_sqrt_dim(data):
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], data.dtype))
+
+
+# ----------------------------------------------------------------------------
+# Upsampling / image-ish nn ops
+# ----------------------------------------------------------------------------
+
+
+@register("UpSampling", aliases=("upsampling",))
+def _upsampling(*data, scale=2, sample_type="nearest", num_args=1, num_filter=0,
+                multi_input_mode="concat", workspace=512):
+    x = data[0]
+    n, c, h, w = x.shape
+    out = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+    return out
+
+
+@register("BilinearSampler", aliases=("bilinear_sampler",))
+def _bilinear_sampler(data, grid, cudnn_off=False):
+    """Parity: src/operator/bilinear_sampler.cc. grid in [-1, 1], NCHW."""
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1.0) * (w - 1) / 2.0
+    gy = (grid[:, 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(yy, xx):
+        yy = jnp.clip(yy.astype(jnp.int32), 0, h - 1)
+        xx = jnp.clip(xx.astype(jnp.int32), 0, w - 1)
+        batch_idx = jnp.arange(n).reshape(n, 1, 1)
+        return data[batch_idx, :, yy, xx]  # (n, ho, wo, c)
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    wx_ = wx[..., None]
+    wy_ = wy[..., None]
+    out = (v00 * (1 - wx_) * (1 - wy_) + v01 * wx_ * (1 - wy_)
+           + v10 * (1 - wx_) * wy_ + v11 * wx_ * wy_)
+    return jnp.transpose(out, (0, 3, 1, 2))

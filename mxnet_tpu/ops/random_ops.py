@@ -1,0 +1,122 @@
+"""Random sampling operators.
+
+Reference: ``src/operator/random/sample_op.cc`` (uniform/normal/gamma/...),
+``multisample_op.cc``, ``shuffle_op.cc``, ``pdf_op.cc``.  TPU-native: every op
+takes a threefry key (threaded in by the dispatcher, see registry.needs_rng) —
+stateless, reproducible, and splittable across a device mesh without the
+per-GPU generator state of the reference (``src/resource.cc`` kRandom).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+@register("_random_uniform", aliases=("uniform", "random_uniform"), needs_rng=True)
+def _random_uniform(key, low=0.0, high=1.0, shape=(), dtype="float32"):
+    return jax.random.uniform(key, _shape(shape), jnp.dtype(dtype), low, high)
+
+
+@register("_random_normal", aliases=("normal", "random_normal"), needs_rng=True)
+def _random_normal(key, loc=0.0, scale=1.0, shape=(), dtype="float32"):
+    return loc + scale * jax.random.normal(key, _shape(shape), jnp.dtype(dtype))
+
+
+@register("_random_gamma", aliases=("gamma_sample", "random_gamma"), needs_rng=True)
+def _random_gamma(key, alpha=1.0, beta=1.0, shape=(), dtype="float32"):
+    return beta * jax.random.gamma(key, alpha, _shape(shape), jnp.dtype(dtype))
+
+
+@register("_random_exponential", aliases=("random_exponential",), needs_rng=True)
+def _random_exponential(key, lam=1.0, shape=(), dtype="float32"):
+    return jax.random.exponential(key, _shape(shape), jnp.dtype(dtype)) / lam
+
+
+@register("_random_poisson", aliases=("random_poisson",), needs_rng=True)
+def _random_poisson(key, lam=1.0, shape=(), dtype="float32"):
+    return jax.random.poisson(key, lam, _shape(shape)).astype(jnp.dtype(dtype))
+
+
+@register("_random_negative_binomial", needs_rng=True)
+def _random_negative_binomial(key, k=1, p=1.0, shape=(), dtype="float32"):
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, k, _shape(shape)) * (1.0 - p) / p
+    return jax.random.poisson(k2, lam, _shape(shape)).astype(jnp.dtype(dtype))
+
+
+@register("_random_randint", aliases=("random_randint",), needs_rng=True)
+def _random_randint(key, low=0, high=1, shape=(), dtype="int32"):
+    return jax.random.randint(key, _shape(shape), low, high, jnp.dtype(dtype))
+
+
+@register("_random_bernoulli", aliases=("bernoulli",), needs_rng=True)
+def _random_bernoulli(key, prob=0.5, shape=(), dtype="float32"):
+    return jax.random.bernoulli(key, prob, _shape(shape)).astype(jnp.dtype(dtype))
+
+
+# sample_* variants: per-element distribution parameters given as input arrays
+# (reference multisample_op.cc)
+
+
+@register("_sample_uniform", aliases=("sample_uniform",), needs_rng=True)
+def _sample_uniform(key, low, high, shape=(), dtype="float32"):
+    s = _shape(shape)
+    out_shape = low.shape + s
+    u = jax.random.uniform(key, out_shape, jnp.dtype(dtype))
+    low_b = low.reshape(low.shape + (1,) * len(s)).astype(jnp.dtype(dtype))
+    high_b = high.reshape(high.shape + (1,) * len(s)).astype(jnp.dtype(dtype))
+    return low_b + u * (high_b - low_b)
+
+
+@register("_sample_normal", aliases=("sample_normal",), needs_rng=True)
+def _sample_normal(key, mu, sigma, shape=(), dtype="float32"):
+    s = _shape(shape)
+    out_shape = mu.shape + s
+    z = jax.random.normal(key, out_shape, jnp.dtype(dtype))
+    mu_b = mu.reshape(mu.shape + (1,) * len(s)).astype(jnp.dtype(dtype))
+    sg_b = sigma.reshape(sigma.shape + (1,) * len(s)).astype(jnp.dtype(dtype))
+    return mu_b + z * sg_b
+
+
+@register("_sample_gamma", aliases=("sample_gamma",), needs_rng=True)
+def _sample_gamma(key, alpha, beta, shape=(), dtype="float32"):
+    s = _shape(shape)
+    out_shape = alpha.shape + s
+    a_b = alpha.reshape(alpha.shape + (1,) * len(s)).astype(jnp.dtype(dtype))
+    b_b = beta.reshape(beta.shape + (1,) * len(s)).astype(jnp.dtype(dtype))
+    return jax.random.gamma(key, a_b, out_shape, jnp.dtype(dtype)) * b_b
+
+
+@register("_sample_multinomial", aliases=("sample_multinomial",), needs_rng=True)
+def _sample_multinomial(key, data, shape=(), get_prob=False, dtype="int32"):
+    """data: (..., K) probabilities; sample indices (parity: sample_multinomial_op.h)."""
+    s = _shape(shape)
+    n = 1
+    for d in s:
+        n *= d
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    flat = logits.reshape(-1, logits.shape[-1])
+    samp = jax.random.categorical(key, flat[:, None, :], axis=-1,
+                                  shape=(flat.shape[0], max(n, 1)))
+    out = samp.reshape(data.shape[:-1] + (s if s else ()))
+    return out.astype(jnp.dtype(dtype))
+
+
+@register("_shuffle", aliases=("shuffle",), needs_rng=True)
+def _shuffle(key, data):
+    return jax.random.permutation(key, data, axis=0)
+
+
+@register("_random_gumbel", needs_rng=True)
+def _random_gumbel(key, loc=0.0, scale=1.0, shape=(), dtype="float32"):
+    return loc + scale * jax.random.gumbel(key, _shape(shape), jnp.dtype(dtype))

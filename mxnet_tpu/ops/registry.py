@@ -1,0 +1,221 @@
+"""Operator registry + imperative dispatcher.
+
+Reference: nnvm's ``Op`` registry (``NNVM_REGISTER_OP``, 500 ops in
+``src/operator/``) and the imperative hot path ``MXImperativeInvokeEx →
+Imperative::Invoke → PushFCompute`` (``src/imperative/imperative.cc:89``,
+``imperative_utils.h:395``).
+
+TPU-native design: an op is a pure JAX function ``forward(*tensors, **attrs)``
+returning one array or a tuple.  Per (op, static attrs, input-field set) we
+build ONE jitted callable — XLA then caches compiled executables by input
+shape/dtype, which replaces both the reference's per-op FCompute kernels and
+its engine push: dispatching the jitted callable enqueues the kernel on the
+PJRT stream asynchronously.  Shape/dtype inference (reference
+``FInferShape/FInferType``) falls out of ``jax.eval_shape`` on the same
+function, so ops can never disagree with their inference — a class of
+reference bugs gone by design.
+
+RNG ops declare ``needs_rng``: the dispatcher prepends a fresh threefry key
+from the global ``mxnet_tpu.random`` state (reference: ``kRandom`` resource,
+``src/resource.cc``).  Mode-aware ops (dropout, BN) declare ``needs_mode`` and
+receive ``_mode='train'|'predict'`` as a static attr.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+
+import jax
+
+from ..base import MXNetError
+from .. import autograd
+from ..engine import Engine
+
+_REGISTRY = {}
+_ALIASES = {}
+
+
+class OpReg:
+    __slots__ = ("name", "forward", "needs_rng", "needs_mode", "num_outputs",
+                 "doc", "input_names", "variadic")
+
+    def __init__(self, name, forward, needs_rng=False, needs_mode=False,
+                 num_outputs=1, inputs=None):
+        self.name = name
+        self.forward = forward
+        self.needs_rng = needs_rng
+        self.needs_mode = needs_mode
+        self.num_outputs = num_outputs
+        self.doc = forward.__doc__ or ""
+        self.input_names, self.variadic = self._infer_inputs(forward, inputs)
+
+    def _infer_inputs(self, fn, explicit):
+        """Ordered tensor-parameter names.  Default: leading params without
+        defaults.  Ops with optional/late tensor params declare ``inputs=``."""
+        try:
+            sig = inspect.signature(fn)
+        except (TypeError, ValueError):
+            return (), True
+        params = list(sig.parameters.values())
+        if self.needs_rng and params and params[0].name == "key":
+            params = params[1:]
+        if any(p.kind == inspect.Parameter.VAR_POSITIONAL for p in params):
+            return (), True
+        if explicit is not None:
+            return tuple(explicit), False
+        names = []
+        for p in params:
+            if p.default is inspect.Parameter.empty:
+                names.append(p.name)
+            else:
+                break
+        return tuple(names), False
+
+
+def register(name, needs_rng=False, needs_mode=False, num_outputs=1, aliases=(),
+             inputs=None):
+    """Decorator: register a JAX forward under an MXNet op name."""
+
+    def deco(fn):
+        if name in _REGISTRY:
+            raise MXNetError("op %s already registered" % name)
+        _REGISTRY[name] = OpReg(name, fn, needs_rng, needs_mode, num_outputs,
+                                inputs=inputs)
+        for a in aliases:
+            _ALIASES[a] = name
+        return fn
+
+    return deco
+
+
+def alias(new, old):
+    _ALIASES[new] = old
+
+
+def get(name):
+    reg = _REGISTRY.get(name)
+    if reg is None:
+        reg = _REGISTRY.get(_ALIASES.get(name, ""))
+    if reg is None:
+        raise MXNetError("operator %r is not registered" % (name,))
+    return reg
+
+
+def list_ops():
+    return sorted(set(_REGISTRY) | set(_ALIASES))
+
+
+def _freeze(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    return v
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted(name, fields, attrs_key):
+    """One jitted callable per (op, input fields, static attrs).
+
+    This cache is the TPU analogue of the reference's per-op FCompute
+    dispatch table + CachedOp executable cache (cached_op.cc:417): XLA adds
+    the per-shape/dtype level underneath automatically.
+    """
+    reg = get(name)
+    attrs = dict(attrs_key)
+
+    if reg.variadic:
+        def run(*arrays):
+            out = reg.forward(*arrays, **attrs)
+            return out if isinstance(out, tuple) else (out,)
+    else:
+        def run(*arrays):
+            if reg.needs_rng:
+                kw = dict(zip(("key",) + fields, arrays))
+            else:
+                kw = dict(zip(fields, arrays))
+            out = reg.forward(**kw, **attrs)
+            return out if isinstance(out, tuple) else (out,)
+
+    run.__name__ = name.lstrip("_") or name
+    return jax.jit(run)
+
+
+def _prep(reg, datas, attrs, fields):
+    """Normalize (datas, attrs, fields) and resolve the jitted callable."""
+    attrs = {k: v for k, v in (attrs or {}).items() if v is not None or True}
+    if reg.needs_mode and "_mode" not in attrs:
+        attrs["_mode"] = "train" if autograd.is_training() else "predict"
+    n_rng = 0
+    if reg.needs_rng:
+        from .. import random as _random
+
+        datas = (_random.next_key(),) + tuple(datas)
+        n_rng = 1
+    if fields is None:
+        fields = reg.input_names[: len(datas) - n_rng]
+    fn = _jitted(reg.name, tuple(fields), _freeze(attrs))
+    return fn, tuple(datas), n_rng
+
+
+def invoke_raw(name, datas, attrs=None, fields=None):
+    """Invoke on raw jax arrays → (outputs_tuple, vjp_or_None, n_rng)."""
+    reg = get(name)
+    fn, datas, n_rng = _prep(reg, tuple(datas), attrs, fields)
+    eng = Engine.get()
+    if autograd.is_recording():
+        outs, vjp = eng.push(lambda: jax.vjp(fn, *datas), op_name=name)
+    else:
+        outs = eng.push(lambda: fn(*datas), op_name=name)
+        vjp = None
+    for o in outs:
+        eng.track(o)
+    return outs, vjp, n_rng
+
+
+def invoke(name, inputs, attrs=None, out=None, fields=None):
+    """Imperative invoke on NDArrays (parity: Imperative::Invoke).
+
+    Records a tape node when autograd is recording and any input is in-graph.
+    """
+    from ..ndarray.ndarray import NDArray
+
+    reg = get(name)
+    datas = tuple(x.data() for x in inputs)
+    recording = autograd.is_recording() and any(x._in_graph for x in inputs)
+    eng = Engine.get()
+    node = None
+    if recording:
+        fn, datas2, n_rng = _prep(reg, datas, attrs, fields)
+        outs, vjp = eng.push(lambda: jax.vjp(fn, *datas2), op_name=name)
+        node = autograd.TapeNode(
+            vjp,
+            list(inputs),
+            [(o.shape, o.dtype) for o in outs],
+            skip_grad_inputs=n_rng,
+            op_name=name,
+        )
+    else:
+        fn, datas2, _ = _prep(reg, datas, attrs, fields)
+        outs = eng.push(lambda: fn(*datas2), op_name=name)
+    for o in outs:
+        eng.track(o)
+
+    ctx = inputs[0].context if inputs else None
+    results = []
+    for i, o in enumerate(outs):
+        arr = NDArray(o, ctx=ctx)
+        if node is not None:
+            arr._tape_node = node
+            arr._tape_index = i
+        results.append(arr)
+    if out is not None:
+        outs_list = out if isinstance(out, (list, tuple)) else [out]
+        for dst, src in zip(outs_list, results):
+            dst._set_data(src.data())
+            dst._tape_node = src._tape_node
+            dst._tape_index = src._tape_index
+        return out
+    if len(results) == 1:
+        return results[0]
+    return results

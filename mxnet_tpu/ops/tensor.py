@@ -1,0 +1,738 @@
+"""Tensor operators (elementwise / broadcast / reduce / index / init / linalg).
+
+Reference: ``src/operator/tensor/`` — 36 .cc/.cu files of mshadow kernels
+(elemwise_binary*, broadcast_reduce*, indexing_op, matrix_op, ordering_op,
+init_op, dot, la_op).  Here every op is a closed-form JAX/XLA expression;
+gradients come from XLA's autodiff of the same expression, so the reference's
+hand-written ``FGradient`` entries (``elemwise_binary_op_basic.cc`` etc.)
+have no counterpart to maintain.
+
+MXNet semantics preserved where they differ from NumPy:
+* ``sum/mean/...`` accept ``axis=()`` meaning ALL axes (legacy nd semantics),
+  plus ``exclude`` to invert the axis set (``broadcast_reduce_op.h``).
+* elementwise binary ops require equal shapes; ``broadcast_*`` variants do
+  NumPy broadcasting (``elemwise_binary_broadcast_op.h``).
+* ``Reshape`` supports the magic codes 0/-1/-2/-3/-4 (``matrix_op-inl.h``).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+# ----------------------------------------------------------------------------
+# helpers
+# ----------------------------------------------------------------------------
+
+
+def _norm_axis(axis, ndim, exclude=False):
+    if axis is None:
+        axes = tuple(range(ndim))
+    elif isinstance(axis, (tuple, list)):
+        axes = tuple(a % ndim for a in axis) if axis else tuple(range(ndim))
+    else:
+        axes = (int(axis) % ndim,)
+    if exclude:
+        axes = tuple(a for a in range(ndim) if a not in axes)
+    return axes
+
+
+def _reduce(fn_name):
+    jfn = getattr(jnp, fn_name)
+
+    def op(x, axis=None, keepdims=False, exclude=False):
+        axes = _norm_axis(axis, x.ndim, exclude)
+        return jfn(x, axis=axes if axes else None, keepdims=keepdims)
+
+    return op
+
+
+# ----------------------------------------------------------------------------
+# elementwise binary (same-shape) + scalar + broadcast variants
+# ----------------------------------------------------------------------------
+
+_BINARY = {
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "mul": jnp.multiply,
+    "div": jnp.divide,
+    "mod": jnp.mod,
+    "power": jnp.power,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "hypot": jnp.hypot,
+    "equal": lambda a, b: (a == b).astype(a.dtype),
+    "not_equal": lambda a, b: (a != b).astype(a.dtype),
+    "greater": lambda a, b: (a > b).astype(a.dtype),
+    "greater_equal": lambda a, b: (a >= b).astype(a.dtype),
+    "lesser": lambda a, b: (a < b).astype(a.dtype),
+    "lesser_equal": lambda a, b: (a <= b).astype(a.dtype),
+    "logical_and": lambda a, b: jnp.logical_and(a, b).astype(a.dtype),
+    "logical_or": lambda a, b: jnp.logical_or(a, b).astype(a.dtype),
+    "logical_xor": lambda a, b: jnp.logical_xor(a, b).astype(a.dtype),
+}
+
+for _name, _fn in _BINARY.items():
+    # elemwise_* (same shape) — internal names match reference (_plus etc.)
+    _ew_name = {
+        "add": "elemwise_add", "sub": "elemwise_sub", "mul": "elemwise_mul",
+        "div": "elemwise_div",
+    }.get(_name, "_" + _name)
+    register(_ew_name, aliases=("_" + _name,) if _ew_name != "_" + _name else ())(
+        (lambda f: lambda lhs, rhs: f(lhs, rhs))(_fn)
+    )
+    register("broadcast_" + _name)((lambda f: lambda lhs, rhs: f(lhs, rhs))(_fn))
+
+alias("broadcast_plus", "broadcast_add")
+alias("broadcast_minus", "broadcast_sub")
+
+_SCALAR_BINARY = {
+    "_plus_scalar": lambda x, scalar: x + scalar,
+    "_minus_scalar": lambda x, scalar: x - scalar,
+    "_rminus_scalar": lambda x, scalar: scalar - x,
+    "_mul_scalar": lambda x, scalar: x * scalar,
+    "_div_scalar": lambda x, scalar: x / scalar,
+    "_rdiv_scalar": lambda x, scalar: scalar / x,
+    "_mod_scalar": lambda x, scalar: jnp.mod(x, scalar),
+    "_rmod_scalar": lambda x, scalar: jnp.mod(scalar, x),
+    "_power_scalar": lambda x, scalar: jnp.power(x, scalar),
+    "_rpower_scalar": lambda x, scalar: jnp.power(scalar, x),
+    "_maximum_scalar": lambda x, scalar: jnp.maximum(x, scalar),
+    "_minimum_scalar": lambda x, scalar: jnp.minimum(x, scalar),
+    "_equal_scalar": lambda x, scalar: (x == scalar).astype(x.dtype),
+    "_not_equal_scalar": lambda x, scalar: (x != scalar).astype(x.dtype),
+    "_greater_scalar": lambda x, scalar: (x > scalar).astype(x.dtype),
+    "_greater_equal_scalar": lambda x, scalar: (x >= scalar).astype(x.dtype),
+    "_lesser_scalar": lambda x, scalar: (x < scalar).astype(x.dtype),
+    "_lesser_equal_scalar": lambda x, scalar: (x <= scalar).astype(x.dtype),
+    "_logical_and_scalar": lambda x, scalar: jnp.logical_and(x, scalar).astype(x.dtype),
+    "_logical_or_scalar": lambda x, scalar: jnp.logical_or(x, scalar).astype(x.dtype),
+    "_logical_xor_scalar": lambda x, scalar: jnp.logical_xor(x, scalar).astype(x.dtype),
+}
+for _name, _fn in _SCALAR_BINARY.items():
+    register(_name)(_fn)
+
+# ----------------------------------------------------------------------------
+# elementwise unary
+# ----------------------------------------------------------------------------
+
+_UNARY = {
+    "abs": jnp.abs, "sign": jnp.sign, "ceil": jnp.ceil, "floor": jnp.floor,
+    "rint": jnp.rint, "round": jnp.round, "trunc": jnp.trunc, "fix": jnp.trunc,
+    "exp": jnp.exp, "log": jnp.log, "log2": jnp.log2, "log10": jnp.log10,
+    "log1p": jnp.log1p, "expm1": jnp.expm1, "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: lax.rsqrt(x), "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x), "square": jnp.square,
+    "reciprocal": lambda x: 1.0 / x, "negative": jnp.negative,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "arcsin": jnp.arcsin, "arccos": jnp.arccos, "arctan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "sigmoid": jax.nn.sigmoid, "softsign": jax.nn.soft_sign,
+    "relu": jax.nn.relu, "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+    "logical_not": lambda x: jnp.logical_not(x).astype(x.dtype),
+    "isnan": jnp.isnan, "isinf": jnp.isinf, "isfinite": jnp.isfinite,
+}
+for _name, _fn in _UNARY.items():
+    register(_name)((lambda f: lambda data: f(data))(_fn))
+
+alias("_copy", "identity")
+register("identity")(lambda data: data)
+register("stop_gradient", aliases=("BlockGrad", "make_loss_grad_stop"))(
+    lambda data: lax.stop_gradient(data)
+)
+register("make_loss")(lambda data: data)
+register("shape_array")(lambda data: jnp.asarray(data.shape, dtype=jnp.int64))
+register("size_array")(lambda data: jnp.asarray([data.size], dtype=jnp.int64))
+
+# ----------------------------------------------------------------------------
+# casts
+# ----------------------------------------------------------------------------
+
+
+@register("cast", aliases=("Cast",))
+def _cast(data, dtype="float32"):
+    return data.astype(jnp.dtype(dtype))
+
+
+@register("amp_cast")
+def _amp_cast(data, dtype="float16"):
+    return data.astype(jnp.dtype(dtype))
+
+
+@register("amp_multicast", num_outputs=-1)
+def _amp_multicast(*data, num_outputs=1):
+    widest = jnp.result_type(*[d.dtype for d in data])
+    return tuple(d.astype(widest) for d in data)
+
+
+# ----------------------------------------------------------------------------
+# reductions
+# ----------------------------------------------------------------------------
+
+for _name in ("sum", "mean", "prod", "max", "min", "nansum", "nanprod"):
+    register(_name, aliases=("sum_axis",) if _name == "sum" else ())(_reduce(_name))
+
+
+@register("norm")
+def _norm(data, ord=2, axis=None, keepdims=False):
+    axes = _norm_axis(axis, data.ndim) if axis is not None else None
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=axes, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=keepdims))
+
+
+@register("argmax")
+def _argmax(data, axis=None, keepdims=False):
+    out = jnp.argmax(data, axis=axis, keepdims=bool(keepdims))
+    return out.astype(jnp.float32)
+
+
+@register("argmin")
+def _argmin(data, axis=None, keepdims=False):
+    out = jnp.argmin(data, axis=axis, keepdims=bool(keepdims))
+    return out.astype(jnp.float32)
+
+
+@register("argmax_channel")
+def _argmax_channel(data):
+    return jnp.argmax(data, axis=-1).astype(jnp.float32)
+
+
+@register("cumsum")
+def _cumsum(a, axis=None, dtype=None):
+    return jnp.cumsum(a, axis=axis, dtype=dtype)
+
+
+@register("logsumexp")
+def _logsumexp(data, axis=None, keepdims=False):
+    axes = _norm_axis(axis, data.ndim) if axis is not None else None
+    return jax.scipy.special.logsumexp(data, axis=axes, keepdims=keepdims)
+
+
+# ----------------------------------------------------------------------------
+# shape manipulation
+# ----------------------------------------------------------------------------
+
+
+def _infer_reshape(src_shape, target):
+    """MXNet Reshape magic codes 0/-1/-2/-3/-4 (reference matrix_op-inl.h)."""
+    out = []
+    src = list(src_shape)
+    i = 0  # index into src
+    t = 0
+    target = list(target)
+    while t < len(target):
+        d = target[t]
+        if d == 0:
+            out.append(src[i]); i += 1
+        elif d == -1:
+            out.append(-1); i += 1  # placeholder, fixed below
+        elif d == -2:
+            out.extend(src[i:]); i = len(src)
+        elif d == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif d == -4:
+            d1, d2 = target[t + 1], target[t + 2]
+            cur = src[i]; i += 1
+            if d1 == -1:
+                d1 = cur // d2
+            if d2 == -1:
+                d2 = cur // d1
+            out.extend([d1, d2]); t += 2
+        else:
+            out.append(d); i += 1 if i < len(src) else 0
+        t += 1
+    if -1 in out:
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        total = 1
+        for d in src_shape:
+            total *= d
+        out[out.index(-1)] = total // max(known, 1)
+    return tuple(out)
+
+
+@register("reshape", aliases=("Reshape",))
+def _reshape(data, shape=None, reverse=False):
+    tgt = _infer_reshape(data.shape, shape)
+    return jnp.reshape(data, tgt)
+
+
+@register("reshape_like")
+def _reshape_like(lhs, rhs):
+    return jnp.reshape(lhs, rhs.shape)
+
+
+@register("flatten", aliases=("Flatten",))
+def _flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("transpose")
+def _transpose(data, axes=None):
+    if axes is None or axes == ():
+        axes = tuple(reversed(range(data.ndim)))
+    return jnp.transpose(data, axes)
+
+
+@register("swapaxes", aliases=("SwapAxis",))
+def _swapaxes(data, dim1=0, dim2=0):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register("expand_dims")
+def _expand_dims(data, axis=0):
+    return jnp.expand_dims(data, axis)
+
+
+@register("squeeze")
+def _squeeze(data, axis=None):
+    return jnp.squeeze(data, axis=axis)
+
+
+@register("depth_to_space")
+def _depth_to_space(data, block_size=2):
+    n, c, h, w = data.shape
+    b = block_size
+    x = jnp.reshape(data, (n, b, b, c // (b * b), h, w))
+    x = jnp.transpose(x, (0, 3, 4, 1, 5, 2))
+    return jnp.reshape(x, (n, c // (b * b), h * b, w * b))
+
+
+@register("space_to_depth")
+def _space_to_depth(data, block_size=2):
+    n, c, h, w = data.shape
+    b = block_size
+    x = jnp.reshape(data, (n, c, h // b, b, w // b, b))
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return jnp.reshape(x, (n, c * b * b, h // b, w // b))
+
+
+@register("broadcast_to")
+def _broadcast_to(data, shape=None):
+    tgt = tuple(s if t == 0 else t for s, t in zip(data.shape, shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@register("broadcast_like")
+def _broadcast_like(lhs, rhs):
+    return jnp.broadcast_to(lhs, rhs.shape)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def _broadcast_axis(data, axis=(), size=()):
+    axes = axis if isinstance(axis, (tuple, list)) else (axis,)
+    sizes = size if isinstance(size, (tuple, list)) else (size,)
+    tgt = list(data.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a] = s
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+@register("tile")
+def _tile(data, reps=()):
+    return jnp.tile(data, reps)
+
+
+@register("repeat")
+def _repeat(data, repeats=1, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register("reverse", aliases=("flip",))
+def _reverse(data, axis=()):
+    axes = axis if isinstance(axis, (tuple, list)) else (axis,)
+    return jnp.flip(data, axis=axes)
+
+
+@register("concat", aliases=("Concat",), num_outputs=1)
+def _concat(*data, dim=1, num_args=None):
+    return jnp.concatenate(data, axis=dim)
+
+
+@register("stack")
+def _stack(*data, axis=0, num_args=None):
+    return jnp.stack(data, axis=axis)
+
+
+@register("split", aliases=("SliceChannel",), num_outputs=-1)
+def _split(data, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("split_v2", num_outputs=-1)
+def _split_v2(data, indices=(), axis=0, squeeze_axis=False, sections=0):
+    if sections:
+        parts = jnp.split(data, sections, axis=axis)
+    else:
+        parts = jnp.split(data, list(indices), axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("slice")
+def _slice(data, begin=(), end=(), step=()):
+    slices = []
+    step = step or (None,) * len(begin)
+    for i, (b, e) in enumerate(zip(begin, end)):
+        s = step[i] if i < len(step) else None
+        slices.append(slice(b, e, s))
+    return data[tuple(slices)]
+
+
+@register("slice_axis")
+def _slice_axis(data, axis=0, begin=0, end=None):
+    sl = [slice(None)] * data.ndim
+    sl[axis] = slice(begin, end)
+    return data[tuple(sl)]
+
+
+@register("slice_like")
+def _slice_like(data, shape_like, axes=()):
+    axes = axes if axes else tuple(range(min(data.ndim, shape_like.ndim)))
+    sl = [slice(None)] * data.ndim
+    for a in axes:
+        sl[a] = slice(0, shape_like.shape[a])
+    return data[tuple(sl)]
+
+
+@register("pad", aliases=("Pad",))
+def _pad(data, mode="constant", pad_width=(), constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(data, pw, mode="constant", constant_values=constant_value)
+    return jnp.pad(data, pw, mode=jmode)
+
+
+@register("clip")
+def _clip(data, a_min=None, a_max=None):
+    return jnp.clip(data, a_min, a_max)
+
+
+# ----------------------------------------------------------------------------
+# indexing / gather / scatter
+# ----------------------------------------------------------------------------
+
+
+@register("take")
+def _take(a, indices, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    if mode == "wrap":
+        idx = jnp.mod(idx, a.shape[axis])
+        mode = "clip"
+    return jnp.take(a, idx, axis=axis, mode="clip")
+
+
+@register("pick")
+def _pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    out = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("gather_nd")
+def _gather_nd(data, indices):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return data[tuple(idx[i] for i in range(m))]
+
+
+@register("scatter_nd")
+def _scatter_nd(data, indices, shape=()):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(shape, data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].set(data)
+
+
+@register("_scatter_set_nd")
+def _scatter_set_nd(lhs, rhs, indices, shape=()):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return lhs.at[tuple(idx[i] for i in range(m))].set(rhs)
+
+
+@register("one_hot")
+def _one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=jnp.dtype(dtype))
+    return oh * (on_value - off_value) + off_value
+
+
+@register("where")
+def _where(condition, x, y):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@register("boolean_mask_fill")
+def _boolean_mask_fill(data, mask, value=0.0):
+    """Static-shape stand-in for boolean_mask (dynamic shapes don't jit)."""
+    return jnp.where(mask.astype(bool), data, value)
+
+
+# ----------------------------------------------------------------------------
+# ordering
+# ----------------------------------------------------------------------------
+
+
+@register("sort")
+def _sort(data, axis=-1, is_ascend=True):
+    out = jnp.sort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register("argsort")
+def _argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    out = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(jnp.dtype(dtype))
+
+
+@register("topk", num_outputs=-1)
+def _topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    x = jnp.moveaxis(data, axis, -1)
+    if is_ascend:
+        vals, idx = lax.top_k(-x, k)
+        vals = -vals
+    else:
+        vals, idx = lax.top_k(x, k)
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis).astype(jnp.dtype(dtype))
+    if ret_typ == "value":
+        return (vals,)
+    if ret_typ == "both":
+        return (vals, idx)
+    return (idx,)
+
+
+# ----------------------------------------------------------------------------
+# init ops (no-input)
+# ----------------------------------------------------------------------------
+
+
+@register("_zeros")
+def _zeros(shape=(), dtype="float32"):
+    return jnp.zeros(shape, jnp.dtype(dtype))
+
+
+@register("_ones")
+def _ones(shape=(), dtype="float32"):
+    return jnp.ones(shape, jnp.dtype(dtype))
+
+
+@register("_full")
+def _full(shape=(), value=0.0, dtype="float32"):
+    return jnp.full(shape, value, jnp.dtype(dtype))
+
+
+@register("_arange")
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32"):
+    out = jnp.arange(start, stop, step, dtype=jnp.dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_linspace")
+def _linspace(start=0.0, stop=1.0, num=50, endpoint=True, dtype="float32"):
+    return jnp.linspace(start, stop, int(num), endpoint=endpoint, dtype=jnp.dtype(dtype))
+
+
+@register("_eye")
+def _eye(N=0, M=0, k=0, dtype="float32"):
+    return jnp.eye(int(N), int(M) or None, k=int(k), dtype=jnp.dtype(dtype))
+
+
+@register("zeros_like")
+def _zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like")
+def _ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register("full_like")
+def _full_like(data, fill_value=0.0):
+    return jnp.full_like(data, fill_value)
+
+
+@register("diag")
+def _diag(data, k=0):
+    return jnp.diag(data, k=k) if data.ndim <= 2 else jnp.diagonal(data, offset=k)
+
+
+# ----------------------------------------------------------------------------
+# linalg: dot / batch_dot / einsum + la_op subset
+# ----------------------------------------------------------------------------
+
+
+@register("dot")
+def _dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    a = lhs.T if transpose_a and lhs.ndim == 2 else lhs
+    b = rhs.T if transpose_b and rhs.ndim == 2 else rhs
+    if transpose_a and lhs.ndim > 2:
+        a = jnp.moveaxis(lhs, 0, -1)
+    if transpose_b and rhs.ndim > 2:
+        b = jnp.moveaxis(rhs, -1, 0)
+    # MXNet dot: contract last axis of a with first axis of b
+    return jnp.tensordot(a, b, axes=1)
+
+
+@register("batch_dot")
+def _batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+@register("_npi_einsum", aliases=("einsum",))
+def _einsum(*operands, subscripts=""):
+    return jnp.einsum(subscripts, *operands)
+
+
+@register("_linalg_gemm2", aliases=("linalg_gemm2",))
+def _linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("_linalg_gemm", aliases=("linalg_gemm",))
+def _linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0,
+                 axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("_linalg_syrk", aliases=("linalg_syrk",))
+def _linalg_syrk(A, transpose=False, alpha=1.0):
+    at = jnp.swapaxes(A, -1, -2)
+    return alpha * (jnp.matmul(at, A) if transpose else jnp.matmul(A, at))
+
+
+@register("_linalg_potrf", aliases=("linalg_potrf",))
+def _linalg_potrf(A):
+    return jnp.linalg.cholesky(A)
+
+
+@register("_linalg_trsm", aliases=("linalg_trsm",))
+def _linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    low = lower != transpose
+    if rightside:
+        x = jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(a, -1, -2), jnp.swapaxes(alpha * B, -1, -2), lower=not low
+        )
+        return jnp.swapaxes(x, -1, -2)
+    return jax.scipy.linalg.solve_triangular(a, alpha * B, lower=low)
+
+
+@register("_linalg_sumlogdiag", aliases=("linalg_sumlogdiag",))
+def _linalg_sumlogdiag(A):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("_linalg_extractdiag", aliases=("linalg_extractdiag",))
+def _linalg_extractdiag(A, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("_linalg_makediag", aliases=("linalg_makediag",))
+def _linalg_makediag(A, offset=0):
+    eye = jnp.eye(A.shape[-1] + abs(offset), dtype=A.dtype)
+    return A[..., None] * eye[: A.shape[-1]] if offset == 0 else jnp.zeros(())
+
+
+@register("_linalg_svd", aliases=("linalg_svd",), num_outputs=3)
+def _linalg_svd(A):
+    u, s, vt = jnp.linalg.svd(A, full_matrices=False)
+    return u, s, vt
+
+
+@register("_linalg_inverse", aliases=("linalg_inverse",))
+def _linalg_inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@register("_linalg_det", aliases=("linalg_det",))
+def _linalg_det(A):
+    return jnp.linalg.det(A)
+
+
+@register("khatri_rao")
+def _khatri_rao(*args, num_args=None):
+    out = args[0]
+    for m in args[1:]:
+        out = jnp.einsum("ir,jr->ijr", out, m).reshape(-1, out.shape[-1])
+    return out
+
+
+# ----------------------------------------------------------------------------
+# sequence ops (reference: src/operator/sequence_*.cc)
+# ----------------------------------------------------------------------------
+
+
+@register("SequenceMask", aliases=("sequence_mask",),
+          inputs=("data", "sequence_length"))
+def _sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0,
+                   axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    mask = steps[:, None] < sequence_length[None, :]  # (T, B)
+    if axis == 1:
+        mask = mask.T
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    shape[1 - axis] = data.shape[1 - axis]
+    mask = jnp.reshape(mask, shape)
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@register("SequenceLast", aliases=("sequence_last",),
+          inputs=("data", "sequence_length"))
+def _sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.take(data, data.shape[axis] - 1, axis=axis)
+    idx = (sequence_length - 1).astype(jnp.int32)
+    moved = jnp.moveaxis(data, axis, 0)  # (T, B, ...)
+    return jnp.take_along_axis(
+        moved, idx.reshape((1, -1) + (1,) * (moved.ndim - 2)), axis=0
+    )[0]
+
+
+@register("SequenceReverse", aliases=("sequence_reverse",),
+          inputs=("data", "sequence_length"))
+def _sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    T = data.shape[0]
+    steps = jnp.arange(T)[:, None]
+    lengths = sequence_length[None, :].astype(jnp.int32)
+    rev_idx = jnp.where(steps < lengths, lengths - 1 - steps, steps)
+    moved = data  # (T, B, ...)
+    idx = rev_idx.reshape((T, -1) + (1,) * (moved.ndim - 2))
+    idx = jnp.broadcast_to(idx, moved.shape)
+    return jnp.take_along_axis(moved, idx, axis=0)

@@ -1,0 +1,40 @@
+"""Global RNG state on stateless threefry keys.
+
+Reference: mshadow PRNG streams seeded via ``mx.random.seed``
+(``python/mxnet/random.py``, ``src/resource.cc`` kRandom/kParallelRandom).
+TPU-native: one process-level threefry key, split per op invocation — every
+random op is reproducible given ``seed()`` and the op sequence, and each
+compiled executable takes its key as a runtime argument so no recompilation
+happens across calls.  Bit-exactness with mshadow streams is explicitly NOT a
+goal (SURVEY.md §7 hard-part 6); tests use statistical tolerances.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _st():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(_DEFAULT_SEED)
+    return _state
+
+
+def seed(seed_state, ctx="all"):
+    """Reset the global stream (parity: mx.random.seed)."""
+    st = _st()
+    st.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    st = _st()
+    st.key, sub = jax.random.split(st.key)
+    return sub
+
+
+def current_key():
+    return _st().key

@@ -1,0 +1,17 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh BEFORE jax imports.
+
+Multi-chip sharding paths (kvstore device mode, parallel/ trainers) are
+exercised on virtual CPU devices exactly as the driver's dryrun does; the
+real-TPU numbers come from bench.py, not the unit suite.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,167 @@
+"""Autograd tape (model: reference tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_simple_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_chain():
+    x = nd.array([0.5])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(nd.sin(x))
+    y.backward()
+    expect = np.exp(np.sin(0.5)) * np.cos(0.5)
+    assert np.allclose(x.grad.asnumpy(), [expect], atol=1e-5)
+
+
+def test_multi_path_accumulation():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + x * 2  # dy/dx = 2x + 2 = 8
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [8.0])
+
+
+def test_two_leaves():
+    a = nd.array([2.0])
+    b = nd.array([5.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        y = a * b + b
+    y.backward()
+    assert np.allclose(a.grad.asnumpy(), [5.0])
+    assert np.allclose(b.grad.asnumpy(), [3.0])
+
+
+def test_head_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 100.0]))
+    assert np.allclose(x.grad.asnumpy(), [30, 300])
+
+
+def test_detach_blocks_grad():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x  # dz/dx through second factor only = y = 4
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_stop_gradient_op():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.stop_gradient(x * x) * x
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_grad_function():
+    x = nd.array([1.0, 2.0, 3.0])
+    g = autograd.grad
+    with autograd.record():
+        x.attach_grad()
+        y = (x ** 3).sum()
+    grads = g(y, x)
+    assert np.allclose(grads.asnumpy(), 3 * np.array([1, 4, 9]), atol=1e-4)
+
+
+def test_matmul_grad():
+    a = nd.array(np.random.rand(3, 4).astype(np.float32))
+    b = nd.array(np.random.rand(4, 2).astype(np.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        y = nd.dot(a, b).sum()
+    y.backward()
+    assert np.allclose(a.grad.asnumpy(),
+                       (np.ones((3, 2)) @ b.asnumpy().T), atol=1e-5)
+    assert np.allclose(b.grad.asnumpy(),
+                       (a.asnumpy().T @ np.ones((3, 2))), atol=1e-5)
+
+
+def test_training_mode_flags():
+    assert not autograd.is_training()
+    with autograd.record(train_mode=True):
+        assert autograd.is_training()
+        assert autograd.is_recording()
+        with autograd.pause():
+            assert not autograd.is_recording()
+    assert not autograd.is_recording()
+
+
+def test_dropout_modes():
+    x = nd.ones((1000,))
+    with autograd.record(train_mode=True):
+        y = nd.Dropout(x, p=0.5)
+    frac_zero = float((y == 0).mean().asscalar())
+    assert 0.4 < frac_zero < 0.6
+    y2 = nd.Dropout(x, p=0.5)  # predict mode outside record
+    assert np.allclose(y2.asnumpy(), x.asnumpy())
+
+
+def test_retain_graph():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    g1 = x.grad.asnumpy().copy()
+    y.backward()
+    assert np.allclose(g1, [4.0])
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = 1.0 / (1.0 + nd.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    f = Sigmoid()
+    x = nd.array([0.0])
+    x.attach_grad()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [0.25])
+
+
+def test_rnn_op_grad_flows():
+    from mxnet_tpu.ops.nn import rnn_param_size
+
+    T, B, I, H = 4, 2, 3, 5
+    psize = rnn_param_size("lstm", 1, I, H)
+    x = nd.random.normal(0, 1, shape=(T, B, I))
+    p = nd.random.normal(0, 0.1, shape=(psize,))
+    h0 = nd.zeros((1, B, H))
+    c0 = nd.zeros((1, B, H))
+    p.attach_grad()
+    with autograd.record():
+        out, hn, cn = nd.RNN(x, p, h0, c0, state_size=H, num_layers=1,
+                             mode="lstm")
+        loss = out.sum()
+    loss.backward()
+    assert p.grad is not None
+    assert float(np.abs(p.grad.asnumpy()).sum()) > 0

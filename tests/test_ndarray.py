@@ -1,0 +1,189 @@
+"""NDArray basics (model: reference tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation():
+    a = nd.zeros((2, 3))
+    assert a.shape == (2, 3)
+    assert a.dtype == np.float32
+    b = nd.ones((4,), dtype="int32")
+    assert b.asnumpy().tolist() == [1, 1, 1, 1]
+    c = nd.array([[1, 2], [3, 4]])
+    assert c.asnumpy().tolist() == [[1, 2], [3, 4]]
+    d = nd.full((2, 2), 7.5)
+    assert float(d.asnumpy()[0, 0]) == 7.5
+    e = nd.arange(0, 10, 2)
+    assert e.asnumpy().tolist() == [0, 2, 4, 6, 8]
+
+
+def test_arithmetic():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([4.0, 5.0, 6.0])
+    assert (a + b).asnumpy().tolist() == [5, 7, 9]
+    assert (a - b).asnumpy().tolist() == [-3, -3, -3]
+    assert (a * b).asnumpy().tolist() == [4, 10, 18]
+    assert np.allclose((a / b).asnumpy(), [0.25, 0.4, 0.5])
+    assert (a + 1).asnumpy().tolist() == [2, 3, 4]
+    assert (1 + a).asnumpy().tolist() == [2, 3, 4]
+    assert (2 - a).asnumpy().tolist() == [1, 0, -1]
+    assert (a ** 2).asnumpy().tolist() == [1, 4, 9]
+    assert (-a).asnumpy().tolist() == [-1, -2, -3]
+    assert np.allclose((2 / a).asnumpy(), [2, 1, 2 / 3])
+
+
+def test_inplace():
+    a = nd.ones((3,))
+    a += 2
+    assert a.asnumpy().tolist() == [3, 3, 3]
+    a *= 2
+    assert a.asnumpy().tolist() == [6, 6, 6]
+
+
+def test_broadcast_ops():
+    a = nd.ones((2, 1))
+    b = nd.ones((1, 3))
+    assert (a + b).shape == (2, 3)
+    c = nd.broadcast_to(a, shape=(2, 4))
+    assert c.shape == (2, 4)
+
+
+def test_comparisons():
+    a = nd.array([1.0, 2.0, 3.0])
+    assert (a > 1.5).asnumpy().tolist() == [0, 1, 1]
+    assert (a == 2).asnumpy().tolist() == [0, 1, 0]
+
+
+def test_indexing():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    assert a[1].asnumpy().tolist() == [4, 5, 6, 7]
+    assert a[1, 2].asscalar() == 6
+    assert a[0:2].shape == (2, 4)
+    a[0, 0] = 99
+    assert a[0, 0].asscalar() == 99
+    a[1] = 0
+    assert a[1].asnumpy().tolist() == [0, 0, 0, 0]
+
+
+def test_reshape_transpose():
+    a = nd.array(np.arange(6).reshape(2, 3))
+    assert a.reshape(3, 2).shape == (3, 2)
+    assert a.reshape((-1,)).shape == (6,)
+    assert a.T.shape == (3, 2)
+    assert a.reshape(0, -1).shape == (2, 3)  # magic 0 keeps dim
+    b = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert b.reshape(-3, 4).shape == (6, 4)  # -3 merges two dims
+    assert b.transpose((2, 0, 1)).shape == (4, 2, 3)
+
+
+def test_reductions():
+    a = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert a.sum().asscalar() == 15
+    assert a.sum(axis=0).asnumpy().tolist() == [3, 5, 7]
+    assert a.mean(axis=1).asnumpy().tolist() == [1, 4]
+    assert a.max().asscalar() == 5
+    # MXNet legacy: exclude inverts the axis set
+    assert nd.sum(a, axis=0, exclude=True).asnumpy().tolist() == [3, 12]
+
+
+def test_dot():
+    a = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = nd.array(np.arange(6, dtype=np.float32).reshape(3, 2))
+    c = nd.dot(a, b)
+    assert np.allclose(c.asnumpy(), a.asnumpy() @ b.asnumpy())
+    d = nd.dot(a, b.T, transpose_b=True)  # b.T then transposed back
+    assert np.allclose(d.asnumpy(), a.asnumpy() @ b.asnumpy())
+
+
+def test_concat_split_stack():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    parts = nd.split(c, num_outputs=2, axis=0)
+    assert parts[0].shape == (2, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_astype_copy():
+    a = nd.ones((2, 2))
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = a.copy()
+    c[0, 0] = 5
+    assert a[0, 0].asscalar() == 1
+
+
+def test_copyto_context():
+    a = nd.ones((2, 2), ctx=mx.cpu())
+    b = nd.zeros((2, 2), ctx=mx.cpu())
+    a.copyto(b)
+    assert b.asnumpy().tolist() == [[1, 1], [1, 1]]
+    c = a.as_in_context(mx.cpu(0))
+    assert c.context.device_type == "cpu"
+
+
+def test_save_load(tmp_path):
+    f = str(tmp_path / "x.params")
+    a = nd.array([1.0, 2.0])
+    nd.save(f, a)
+    assert nd.load(f).asnumpy().tolist() == [1, 2]
+    nd.save(f, [a, a * 2])
+    lst = nd.load(f)
+    assert lst[1].asnumpy().tolist() == [2, 4]
+    nd.save(f, {"w": a, "b": a * 3})
+    dct = nd.load(f)
+    assert dct["b"].asnumpy().tolist() == [3, 6]
+
+
+def test_take_pick_onehot():
+    a = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    idx = nd.array([0, 2], dtype="int32")
+    t = nd.take(a, idx, axis=0)
+    assert t.shape == (2, 4)
+    assert t.asnumpy()[1].tolist() == [8, 9, 10, 11]
+    p = nd.pick(a, nd.array([1, 0, 3]), axis=1)
+    assert p.asnumpy().tolist() == [1, 4, 11]
+    oh = nd.one_hot(nd.array([0, 2], dtype="int32"), depth=3)
+    assert oh.asnumpy().tolist() == [[1, 0, 0], [0, 0, 1]]
+
+
+def test_topk_sort():
+    a = nd.array([3.0, 1.0, 2.0])
+    v = nd.topk(a, k=2, ret_typ="value")
+    assert v.asnumpy().tolist() == [3, 2]
+    s = nd.sort(a)
+    assert s.asnumpy().tolist() == [1, 2, 3]
+    idx = nd.argsort(a)
+    assert idx.asnumpy().tolist() == [1, 2, 0]
+
+
+def test_waitall_and_engine():
+    a = nd.ones((64, 64))
+    for _ in range(5):
+        a = nd.dot(a, a) * 1e-3
+    mx.waitall()
+    assert np.isfinite(a.asnumpy()).all()
+
+
+def test_random_ops_statistics():
+    mx.random.seed(42)
+    u = nd.random.uniform(0, 1, shape=(10000,))
+    assert 0.45 < float(u.mean().asscalar()) < 0.55
+    n = nd.random.normal(0, 1, shape=(10000,))
+    assert abs(float(n.mean().asscalar())) < 0.05
+    mx.random.seed(42)
+    u2 = nd.random.uniform(0, 1, shape=(10000,))
+    assert np.allclose(u.asnumpy(), u2.asnumpy())  # reproducible
+
+
+def test_where_clip():
+    a = nd.array([-1.0, 0.5, 2.0])
+    c = nd.clip(a, a_min=0.0, a_max=1.0)
+    assert c.asnumpy().tolist() == [0, 0.5, 1]
+    w = nd.where(a > 0, a, nd.zeros_like(a))
+    assert w.asnumpy().tolist() == [0, 0.5, 2]
