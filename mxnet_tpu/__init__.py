@@ -30,3 +30,10 @@ from . import ndarray as nd  # noqa: F401
 from .ndarray import NDArray  # noqa: F401
 
 from .ndarray import waitall  # noqa: F401
+
+from . import initializer  # noqa: F401
+from . import initializer as init  # noqa: F401
+from . import lr_scheduler  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import kvstore  # noqa: F401
+from . import gluon  # noqa: F401

@@ -1,0 +1,8 @@
+"""Gluon: the imperative high-level API (parity: python/mxnet/gluon/)."""
+from .parameter import (  # noqa: F401
+    Parameter, Constant, ParameterDict, DeferredInitializationError,
+)
+from .block import Block, HybridBlock, SymbolBlock  # noqa: F401
+from .trainer import Trainer  # noqa: F401
+from . import nn  # noqa: F401
+from . import loss  # noqa: F401

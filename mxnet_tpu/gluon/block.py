@@ -1,0 +1,576 @@
+"""Gluon Block / HybridBlock.
+
+Reference: ``python/mxnet/gluon/block.py`` — ``Block:229``, ``HybridBlock:839``
+whose ``hybridize():1043`` traces ``hybrid_forward`` with Symbol proxies into
+an nnvm graph executed by ``CachedOp`` (``_build_cache:933``).
+
+TPU-native rebuild: there is no separate symbolic tracer — the jaxpr IS the
+captured graph.  ``hybridize()`` arms a cache; on a cache miss the whole
+imperative forward is traced by ``jax.jit`` with (rng_key, *params, *inputs)
+as arguments, producing ONE XLA executable per (input shapes/dtypes, mode)
+— the direct analogue of ``CachedOp::SetForwardGraph``'s shape-keyed
+executable (``src/imperative/cached_op.cc:417``), with XLA doing memory
+planning (= ``MXPlanMemory``) and fusion (= pointwise fusion pass) for free.
+Autograd records the executable as ONE tape node via ``jax.vjp``.  Aux state
+(BatchNorm moving stats) written during the trace is routed out as extra
+outputs through a trace-time side channel and assigned back after each run.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+
+import jax
+
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray.ndarray import NDArray
+from .. import ndarray as _nd_module
+from .. import autograd
+from .. import random as _random
+from ..engine import Engine
+from .parameter import (
+    Parameter, ParameterDict, DeferredInitializationError,
+)
+
+# ---------------------------------------------------------------------------
+# trace plumbing (hybridize)
+# ---------------------------------------------------------------------------
+_trace_state = threading.local()
+
+
+def _trace_st():
+    if not hasattr(_trace_state, "param_map"):
+        _trace_state.param_map = None   # id(Parameter) -> NDArray(tracer)
+        _trace_state.aux_updates = None  # list of (Parameter, jax array)
+        _trace_state.active = False
+    return _trace_state
+
+
+def _trace_param_lookup(param):
+    st = _trace_st()
+    if st.param_map is None:
+        return None
+    return st.param_map.get(id(param))
+
+
+def is_tracing():
+    return _trace_st().active
+
+
+def record_aux_update(param, value):
+    """Write an aux parameter; inside a hybridize trace the write is deferred
+    and returned from the compiled executable instead (side-channel)."""
+    st = _trace_st()
+    data = value.data() if isinstance(value, NDArray) else value
+    if st.aux_updates is not None:
+        st.aux_updates.append((param, data))
+    else:
+        param.set_data(data)
+
+
+class _BlockScope:
+    """Name manager for nested blocks (parity: block.py _BlockScope)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                prefix = _name_manager_next(hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            current._counter[hint] = count + 1
+            prefix = "%s%d_" % (hint, count)
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, *a):
+        if self._block._empty_prefix:
+            return
+        _BlockScope._current.value = self._old_scope
+
+
+_name_counter = {}
+_name_lock = threading.Lock()
+
+
+def _name_manager_next(hint):
+    with _name_lock:
+        c = _name_counter.get(hint, 0)
+        _name_counter[hint] = c + 1
+    return "%s%d" % (hint, c)
+
+
+# ---------------------------------------------------------------------------
+# Block
+# ---------------------------------------------------------------------------
+class Block:
+    """Base building block (parity: gluon.Block, block.py:229)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = OrderedDict()
+        self._reg_params = OrderedDict()
+        self._forward_hooks = OrderedDict()
+        self._forward_pre_hooks = OrderedDict()
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def __repr__(self):
+        lines = []
+        for name, child in self._children.items():
+            block_repr = repr(child).replace("\n", "\n  ")
+            lines.append("  (%s): %s" % (name, block_repr))
+        return "%s(\n%s\n)" % (self.__class__.__name__, "\n".join(lines)) \
+            if lines else "%s()" % self.__class__.__name__
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            reg = self.__dict__.get("_reg_params")
+            if reg is not None:
+                reg[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks[len(self._forward_hooks)] = hook
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks[len(self._forward_pre_hooks)] = hook
+
+    def collect_params(self, select=None):
+        """All params of self + descendants, optionally regex-filtered.
+
+        Parity: Block.collect_params (block.py:378).
+        """
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({
+                name: value for name, value in self.params.items()
+                if pattern.match(name)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select=select))
+        return ret
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for param in self.params.values():
+            param.cast(dtype)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def zero_grad(self):
+        self.collect_params().zero_grad()
+
+    # -- checkpointing ---------------------------------------------------
+    def save_parameters(self, filename, deduplicate=False):
+        """Parity: Block.save_parameters (block.py:417); block-local names."""
+        params = self._collect_params_with_prefix()
+        from ..ndarray import ndarray as _ndm
+
+        _ndm.save(filename, {k: v.data() for k, v in params.items()})
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False):
+        from ..ndarray import ndarray as _ndm
+
+        loaded = _ndm.load(filename, ctx=ctx)
+        params = self._collect_params_with_prefix()
+        if not isinstance(loaded, dict):
+            raise MXNetError("%s is not a parameter dict file" % filename)
+        for name, p in params.items():
+            if name not in loaded:
+                if not allow_missing:
+                    raise MXNetError(
+                        "parameter %s missing in %s" % (name, filename))
+                continue
+            arr = loaded[name]
+            if p._data is None:
+                p.shape = tuple(arr.shape)
+                if p._deferred_init is not None:
+                    p._finish_deferred_init()
+                else:
+                    p.initialize(ctx=ctx)
+            p.set_data(arr)
+        if not ignore_extra:
+            extra = set(loaded) - set(params)
+            if extra:
+                raise MXNetError(
+                    "%s has extra parameters %s" % (filename, sorted(extra)))
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + name: p for name, p in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    # -- forward ---------------------------------------------------------
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        """Print a per-layer summary by running a forward with hooks."""
+        summary_rows = []
+
+        def make_hook(name):
+            def hook(block, ins, outs):
+                out = outs[0] if isinstance(outs, (list, tuple)) else outs
+                n_params = sum(
+                    int(p.data().size) for p in block._reg_params.values()
+                    if p._data is not None)
+                summary_rows.append((name or "(root)",
+                                     block.__class__.__name__,
+                                     tuple(out.shape), n_params))
+            return hook
+
+        handles = []
+        for name, child in self._iter_blocks():
+            child._forward_hooks[("__summary__", name)] = make_hook(name)
+            handles.append(child)
+        try:
+            self(*inputs)
+        finally:
+            for child in handles:
+                child._forward_hooks = OrderedDict(
+                    (k, v) for k, v in child._forward_hooks.items()
+                    if not (isinstance(k, tuple) and k[0] == "__summary__"))
+        header = "%-30s %-20s %-20s %10s" % ("Layer", "Type", "Output Shape",
+                                             "Params")
+        lines = [header, "-" * len(header)]
+        total = 0
+        for name, typ, shape, n in summary_rows:
+            lines.append("%-30s %-20s %-20s %10d" % (name, typ, shape, n))
+            total += n
+        lines.append("-" * len(header))
+        lines.append("Total params: %d" % total)
+        print("\n".join(lines))
+
+    def _iter_blocks(self, prefix=""):
+        yield prefix, self
+        for name, child in self._children.items():
+            yield from child._iter_blocks(prefix + ("." if prefix else "")
+                                          + name)
+
+
+# ---------------------------------------------------------------------------
+# HybridBlock
+# ---------------------------------------------------------------------------
+class HybridBlock(Block):
+    """Block that can be compiled to one XLA executable (see module doc).
+
+    Subclasses implement ``hybrid_forward(F, x, *args, **params)`` exactly as
+    in the reference; ``F`` is always the ``mxnet_tpu.ndarray`` module here
+    because tracing happens at the XLA level, not the symbol level.
+    """
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._active = False
+        self._cached_ops = {}      # (shapes,dtypes,mode) -> compiled record
+        self._warmed_up = False
+        self._flags = {}
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  **kwargs):
+        """Arm/disarm compilation (parity: HybridBlock.hybridize:1043).
+
+        ``static_alloc``/``static_shape`` accepted for API parity; XLA's
+        buffer assignment always behaves like static_alloc=True.
+        """
+        self._active = active
+        self._flags = dict(static_alloc=static_alloc,
+                           static_shape=static_shape, **kwargs)
+        if not active:
+            self._cached_ops = {}
+            self._warmed_up = False
+        super().hybridize(active, static_alloc=static_alloc,
+                          static_shape=static_shape, **kwargs)
+
+    def clear_cache(self):
+        self._cached_ops = {}
+        self._warmed_up = False
+
+    def cast(self, dtype):
+        self.clear_cache()
+        super().cast(dtype)
+
+    def infer_shape(self, *args):
+        """Resolve deferred parameter shapes given example inputs.
+
+        Built-in layers override ``_shape_hint``; composite blocks recurse by
+        simply running a forward (each layer resolves itself en route).
+        """
+        self._shape_hint(*args)
+
+    def _shape_hint(self, *args):
+        return None
+
+    # -- forward dispatch -------------------------------------------------
+    def forward(self, x, *args):
+        if not isinstance(x, NDArray):
+            raise MXNetError(
+                "HybridBlock.forward expects NDArray inputs, got %s"
+                % type(x).__name__)
+        if self._active and not is_tracing():
+            return self._call_cached(x, *args)
+        return self._forward_imperative(x, *args)
+
+    def _forward_imperative(self, x, *args):
+        self._shape_hint(x, *args)
+        try:
+            params = {name: p.data() for name, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._shape_hint(x, *args)
+            for p in self._reg_params.values():
+                p._finish_deferred_init()
+            params = {name: p.data() for name, p in self._reg_params.items()}
+        return self.hybrid_forward(_nd_module, x, *args, **params)
+
+    def hybrid_forward(self, F, x, *args, **params):  # pragma: no cover
+        raise NotImplementedError
+
+    # -- cached (compiled) path ------------------------------------------
+    def _call_cached(self, *inputs):
+        if not self._warmed_up:
+            # First call after hybridize(): run imperatively — this resolves
+            # deferred parameter shapes (CachedOp's _deferred_infer_shape) and
+            # gives the answer for free; compile on the next call.
+            self._warmed_up = True
+            return self._forward_imperative(*inputs)
+        key = (tuple((tuple(a.shape), str(a.dtype)) for a in inputs),
+               autograd.is_training())
+        rec = self._cached_ops.get(key)
+        if rec is None:
+            rec = self._build_cache(inputs)
+            self._cached_ops[key] = rec
+        return self._run_cached(rec, inputs)
+
+    def _build_cache(self, inputs):
+        """Trace the full imperative forward into one jitted executable."""
+        params = list(self.collect_params().values())
+        for p in params:
+            p._check_initialized()
+        n_params = len(params)
+        outer = self
+        meta = {}  # filled at trace time: n_outputs, aux param order
+
+        def fn(rng_key, *arrays):
+            st = _trace_st()
+            prev = (st.param_map, st.aux_updates, st.active)
+            st.param_map = {
+                id(p): NDArray(a) for p, a in zip(params, arrays[:n_params])
+            }
+            st.aux_updates = []
+            st.active = True
+            try:
+                with _random.trace_key_scope(rng_key):
+                    nd_in = [NDArray(a) for a in arrays[n_params:]]
+                    out = outer._forward_imperative(*nd_in)
+                outs = [out] if isinstance(out, NDArray) else list(out)
+                meta["n_outputs"] = len(outs)
+                meta["aux_params"] = [p for p, _ in st.aux_updates]
+                flat = [o.data() for o in outs] + [v for _, v in
+                                                   st.aux_updates]
+                return tuple(flat)
+            finally:
+                st.param_map, st.aux_updates, st.active = prev
+
+        jitted = jax.jit(fn)
+        return {"fn": jitted, "params": params, "meta": meta}
+
+    def _run_cached(self, rec, inputs):
+        params = rec["params"]
+        datas = (
+            (_random.next_key(),)
+            + tuple(p.data().data() for p in params)
+            + tuple(x.data() for x in inputs)
+        )
+        eng = Engine.get()
+        fn = rec["fn"]
+        recording = autograd.is_recording()
+        node = None
+        if recording:
+            flat, vjp = eng.push(lambda: jax.vjp(fn, *datas),
+                                 op_name=self.name + "_cached")
+            tape_inputs = [p.data() for p in params] + list(inputs)
+            node = autograd.TapeNode(
+                vjp, tape_inputs,
+                [(o.shape, o.dtype) for o in flat],
+                skip_grad_inputs=1,
+                op_name=self.name + "_cached")
+        else:
+            flat = eng.push(lambda: fn(*datas),
+                            op_name=self.name + "_cached")
+        meta = rec["meta"]
+        n_out = meta["n_outputs"]
+        ctx = inputs[0].context if inputs else current_context()
+        outs = []
+        for i in range(n_out):
+            arr = NDArray(flat[i], ctx=ctx)
+            if node is not None:
+                arr._tape_node = node
+                arr._tape_index = i
+            outs.append(arr)
+        # write back aux updates (moving stats); not taped
+        for p, new in zip(meta["aux_params"], flat[n_out:]):
+            p.set_data(new)
+        return outs[0] if n_out == 1 else tuple(outs)
+
+    # -- export -----------------------------------------------------------
+    def export(self, path, epoch=0):
+        """Serialize compiled-form params (parity: HybridBlock.export:1081).
+
+        Emits ``path-symbol.json`` (a structural description: op-level jaxpr
+        text of the cached executable if built, else the block tree) and
+        ``path-%04d.params``.
+        """
+        import json as _json
+
+        params = self.collect_params()
+        from ..ndarray import ndarray as _ndm
+
+        arg = {}
+        for name, p in params.items():
+            if p._data is not None:
+                arg["arg:" + name] = p.data()
+        _ndm.save("%s-%04d.params" % (path, epoch), arg)
+        desc = {"framework": "mxnet_tpu", "block": self.__class__.__name__,
+                "name": self.name,
+                "params": {k: list(p.shape or ()) for k, p in params.items()}}
+        with open(path + "-symbol.json", "w") as f:
+            _json.dump(desc, f, indent=2)
+
+
+class SymbolBlock(HybridBlock):
+    """Construct a block from a Symbol graph (parity: block.py:1194)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=params)
+        from ..symbol.symbol import Symbol
+
+        if isinstance(outputs, (list, tuple)) and len(outputs) == 1:
+            outputs = outputs[0]
+        if not isinstance(outputs, Symbol):
+            raise MXNetError("SymbolBlock outputs must be a Symbol")
+        if isinstance(inputs, Symbol):
+            inputs = [inputs]
+        self._sym_outputs = outputs
+        self._sym_inputs = [i.name for i in inputs]
+        input_set = set(self._sym_inputs)
+        for name in outputs.list_arguments():
+            if name not in input_set:
+                self._reg_params[name] = self.params.get(
+                    name, allow_deferred_init=True)
+        for name in outputs.list_auxiliary_states():
+            self._reg_params[name] = self.params.get(
+                name, grad_req="null", allow_deferred_init=True)
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from ..symbol import load as sym_load
+
+        sym = sym_load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        from ..symbol import var
+
+        inputs = [var(n) for n in input_names]
+        ret = SymbolBlock(sym, inputs)
+        if param_file is not None:
+            ret.load_parameters(param_file, ctx=ctx,
+                                allow_missing=False, ignore_extra=True)
+        return ret
+
+    def forward(self, *args):
+        bindings = dict(zip(self._sym_inputs, args))
+        for name, p in self._reg_params.items():
+            if p._data is None and p.shape is not None and \
+                    all(s != 0 for s in p.shape):
+                p.initialize()
+            if p._data is not None:
+                bindings[name] = p.data()
+        out = self._sym_outputs.eval_imperative(bindings)
+        return out[0] if len(out) == 1 else out
+
+    def hybrid_forward(self, F, *args, **kwargs):  # pragma: no cover
+        raise NotImplementedError

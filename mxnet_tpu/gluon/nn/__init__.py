@@ -1,0 +1,15 @@
+"""Gluon neural-network layers (parity: python/mxnet/gluon/nn/)."""
+from .activations import (  # noqa: F401
+    Activation, LeakyReLU, PReLU, ELU, SELU, GELU, Swish,
+)
+from .basic_layers import (  # noqa: F401
+    Sequential, HybridSequential, Dense, Dropout, BatchNorm, SyncBatchNorm,
+    LayerNorm, GroupNorm, InstanceNorm, Embedding, Flatten, Identity, Lambda,
+    HybridLambda,
+)
+from .conv_layers import (  # noqa: F401
+    Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,
+    MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    GlobalMaxPool1D, GlobalMaxPool2D, GlobalMaxPool3D,
+    GlobalAvgPool1D, GlobalAvgPool2D, GlobalAvgPool3D, ReflectionPad2D,
+)

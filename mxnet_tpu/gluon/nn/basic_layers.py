@@ -1,0 +1,395 @@
+"""Gluon basic neural-network layers.
+
+Reference: ``python/mxnet/gluon/nn/basic_layers.py`` (Dense, Dropout,
+BatchNorm, Embedding, ...) — same API; compute goes through the op registry
+onto XLA (each op is a jitted XLA computation; under ``hybridize()`` the
+whole net fuses into one executable).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...base import MXNetError
+from ..block import Block, HybridBlock, record_aux_update
+from .activations import Activation
+
+
+class Sequential(Block):
+    """Stack of blocks run in order (parity: nn.Sequential)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def forward(self, x):
+        for block in self._children.values():
+            x = block(x)
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)(prefix=self._prefix)
+            with net.name_scope():
+                net.add(*layers[key])
+            return net
+        return layers[key]
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+class HybridSequential(HybridBlock):
+    """Stack compiled as one executable when hybridized."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def hybrid_forward(self, F, x):
+        for block in self._children.values():
+            x = block(x)
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)(prefix=self._prefix)
+            with net.name_scope():
+                net.add(*layers[key])
+            return net
+        return layers[key]
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class Dense(HybridBlock):
+    """Fully-connected layer (parity: nn.Dense).
+
+    Weight layout (units, in_units) matches the reference FullyConnected op
+    (``src/operator/nn/fully_connected.cc:258``); in_units=0 defers shape to
+    first forward.
+    """
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None,
+                 bias_initializer="zeros", in_units=0, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        self._flatten = flatten
+        self._use_bias = use_bias
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(units, in_units), dtype=dtype,
+                init=weight_initializer, allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get(
+                    "bias", shape=(units,), dtype=dtype,
+                    init=bias_initializer, allow_deferred_init=True)
+            self.act = Activation(activation, prefix=activation + "_") \
+                if activation is not None else None
+
+    def _shape_hint(self, x, *args):
+        if self.weight.shape and self.weight.shape[1] == 0:
+            in_units = int(_np.prod(x.shape[1:])) if self._flatten \
+                else x.shape[-1]
+            self.weight.shape = (self._units, in_units)
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        out = F.FullyConnected(x, weight, bias, num_hidden=self._units,
+                               flatten=self._flatten,
+                               no_bias=not self._use_bias)
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+    def __repr__(self):
+        shape = self.weight.shape
+        return "Dense(%s -> %s, %s)" % (
+            shape[1] if shape and len(shape) > 1 else None, shape[0] if shape else None,
+            "linear" if self.act is None else self.act)
+
+
+class Dropout(HybridBlock):
+    """Parity: nn.Dropout — active only in train mode (autograd.record)."""
+
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._rate = rate
+        self._axes = tuple(axes)
+
+    def hybrid_forward(self, F, x):
+        if self._rate == 0:
+            return x
+        return F.Dropout(x, p=self._rate, axes=self._axes)
+
+    def __repr__(self):
+        return "Dropout(p = %s, axes=%s)" % (self._rate, self._axes)
+
+
+class BatchNorm(HybridBlock):
+    """Parity: nn.BatchNorm — moving stats updated each training forward.
+
+    The XLA BatchNorm op returns (out, new_mean, new_var); aux writes route
+    through ``record_aux_update`` so they work both imperatively and inside a
+    compiled (hybridized) executable.
+    """
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._kwargs = {"axis": axis, "eps": epsilon, "momentum": momentum,
+                        "fix_gamma": not scale,
+                        "use_global_stats": use_global_stats}
+        self._axis = axis
+        self.gamma = self.params.get(
+            "gamma", grad_req="write" if scale else "null",
+            shape=(in_channels,), init=gamma_initializer,
+            allow_deferred_init=True)
+        self.beta = self.params.get(
+            "beta", grad_req="write" if center else "null",
+            shape=(in_channels,), init=beta_initializer,
+            allow_deferred_init=True)
+        self.running_mean = self.params.get(
+            "running_mean", grad_req="null", shape=(in_channels,),
+            init=running_mean_initializer, allow_deferred_init=True)
+        self.running_var = self.params.get(
+            "running_var", grad_req="null", shape=(in_channels,),
+            init=running_variance_initializer, allow_deferred_init=True)
+
+    def _shape_hint(self, x, *args):
+        if self.gamma.shape and self.gamma.shape[0] == 0:
+            channels = x.shape[self._axis]
+            for p in (self.gamma, self.beta, self.running_mean,
+                      self.running_var):
+                p.shape = (channels,)
+
+    def cast(self, dtype):
+        if dtype in ("float16", "bfloat16"):
+            dtype = "float32"  # stats stay fp32 (parity: basic_layers.py cast)
+        super().cast(dtype)
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        out, new_mean, new_var = F.BatchNorm(
+            x, gamma, beta, running_mean, running_var, **self._kwargs)
+        from ... import autograd
+
+        if autograd.is_training():
+            record_aux_update(self.running_mean, new_mean)
+            record_aux_update(self.running_var, new_var)
+        return out
+
+    def __repr__(self):
+        shape = self.gamma.shape
+        return "BatchNorm(axis=%s, in_channels=%s)" % (
+            self._axis, shape[0] if shape else None)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm (parity: contrib SyncBatchNorm,
+    ``src/operator/contrib/sync_batch_norm.cc``).
+
+    On TPU, batch stats are reduced with ``jax.lax.pmean`` automatically when
+    the forward runs inside a ``shard_map``/pjit data-parallel region — the
+    op's mean/var become global means because XLA inserts the collective.
+    Single-device semantics are identical to BatchNorm.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True, use_global_stats=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", prefix=None,
+                 params=None):
+        super().__init__(1, momentum, epsilon, center, scale,
+                         use_global_stats, beta_initializer,
+                         gamma_initializer, running_mean_initializer,
+                         running_variance_initializer, in_channels,
+                         prefix=prefix, params=params)
+
+
+class LayerNorm(HybridBlock):
+    """Parity: nn.LayerNorm."""
+
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._axis = axis
+        self._epsilon = epsilon
+        self.gamma = self.params.get(
+            "gamma", grad_req="write" if scale else "null",
+            shape=(in_channels,), init=gamma_initializer,
+            allow_deferred_init=True)
+        self.beta = self.params.get(
+            "beta", grad_req="write" if center else "null",
+            shape=(in_channels,), init=beta_initializer,
+            allow_deferred_init=True)
+
+    def _shape_hint(self, x, *args):
+        if self.gamma.shape and self.gamma.shape[0] == 0:
+            channels = x.shape[self._axis]
+            self.gamma.shape = (channels,)
+            self.beta.shape = (channels,)
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.LayerNorm(x, gamma, beta, axis=self._axis,
+                           eps=self._epsilon)
+
+    def __repr__(self):
+        return "LayerNorm(axis=%s, eps=%s)" % (self._axis, self._epsilon)
+
+
+class GroupNorm(HybridBlock):
+    """Parity: nn.GroupNorm."""
+
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self.gamma = self.params.get(
+            "gamma", grad_req="write" if scale else "null",
+            shape=(in_channels,), init=gamma_initializer,
+            allow_deferred_init=True)
+        self.beta = self.params.get(
+            "beta", grad_req="write" if center else "null",
+            shape=(in_channels,), init=beta_initializer,
+            allow_deferred_init=True)
+
+    def _shape_hint(self, x, *args):
+        if self.gamma.shape and self.gamma.shape[0] == 0:
+            self.gamma.shape = (x.shape[1],)
+            self.beta.shape = (x.shape[1],)
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.GroupNorm(x, gamma, beta, num_groups=self._num_groups,
+                           eps=self._epsilon)
+
+
+class InstanceNorm(HybridBlock):
+    """Parity: nn.InstanceNorm."""
+
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._epsilon = epsilon
+        self.gamma = self.params.get(
+            "gamma", grad_req="write" if scale else "null",
+            shape=(in_channels,), init=gamma_initializer,
+            allow_deferred_init=True)
+        self.beta = self.params.get(
+            "beta", grad_req="write" if center else "null",
+            shape=(in_channels,), init=beta_initializer,
+            allow_deferred_init=True)
+
+    def _shape_hint(self, x, *args):
+        if self.gamma.shape and self.gamma.shape[0] == 0:
+            self.gamma.shape = (x.shape[1],)
+            self.beta.shape = (x.shape[1],)
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.InstanceNorm(x, gamma, beta, eps=self._epsilon)
+
+
+class Embedding(HybridBlock):
+    """Parity: nn.Embedding — gathers rows of a (input_dim, output_dim) table."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self.weight = self.params.get(
+            "weight", shape=(input_dim, output_dim), dtype=dtype,
+            init=weight_initializer, allow_deferred_init=True)
+
+    def hybrid_forward(self, F, x, weight):
+        return F.Embedding(x, weight, input_dim=self._input_dim,
+                           output_dim=self._output_dim)
+
+    def __repr__(self):
+        return "Embedding(%s -> %s)" % (self._input_dim, self._output_dim)
+
+
+class Flatten(HybridBlock):
+    """Parity: nn.Flatten."""
+
+    def hybrid_forward(self, F, x):
+        return F.flatten(x)
+
+    def __repr__(self):
+        return "Flatten"
+
+
+class Identity(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class Lambda(Block):
+    """Wrap an nd-level function (parity: nn.Lambda)."""
+
+    def __init__(self, function, prefix=None):
+        super().__init__(prefix=prefix)
+        if isinstance(function, str):
+            from ... import ndarray as nd
+
+            if not hasattr(nd, function):
+                raise MXNetError("function %s not found in mx.nd" % function)
+            self._func = getattr(nd, function)
+            self._func_name = function
+        elif callable(function):
+            self._func = function
+            self._func_name = getattr(function, "__name__", "lambda")
+        else:
+            raise MXNetError("function must be str or callable")
+
+    def forward(self, *args):
+        return self._func(*args)
+
+    def __repr__(self):
+        return "Lambda(%s)" % self._func_name
+
+
+class HybridLambda(HybridBlock):
+    """Wrap an F-level function (parity: nn.HybridLambda)."""
+
+    def __init__(self, function, prefix=None):
+        super().__init__(prefix=prefix)
+        if isinstance(function, str):
+            self._func = None
+            self._func_name = function
+        elif callable(function):
+            self._func = function
+            self._func_name = getattr(function, "__name__", "lambda")
+        else:
+            raise MXNetError("function must be str or callable")
+
+    def hybrid_forward(self, F, x, *args):
+        if self._func is None:
+            return getattr(F, self._func_name)(x, *args)
+        return self._func(F, x, *args)
+
+    def __repr__(self):
+        return "HybridLambda(%s)" % self._func_name

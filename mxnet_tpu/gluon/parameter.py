@@ -1,0 +1,401 @@
+"""Gluon Parameter / ParameterDict.
+
+Reference: ``python/mxnet/gluon/parameter.py`` — ``Parameter`` (``:47``) with
+deferred shape init (``DeferredInitializationError:43``), per-context data
+replicas, and ``ParameterDict`` (``:706``).
+
+TPU-native: a parameter owns ONE logical NDArray; multi-device placement is a
+*sharding* of that array over a ``jax.sharding.Mesh`` (annotated via
+``mxnet_tpu.parallel``), not per-context replicas — so ``list_data()`` has a
+single entry and replication is the GSPMD compiler's job.  Deferred shape
+inference is kept: layers created with unknown in-features materialize on
+first forward.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray.ndarray import NDArray, _to_jax_dtype
+from .. import initializer as init_mod
+from .. import autograd
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter accessed before its shape is known (parity: parameter.py:43)."""
+
+
+class Parameter:
+    """A weight/bias/aux tensor with gradient bookkeeping.
+
+    Parity: ``gluon.Parameter`` (parameter.py:47).  ``shape`` entries of 0 mean
+    unknown-until-first-forward (deferred init).
+    """
+
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self.name = name
+        self._grad_req = grad_req if differentiable else "null"
+        if isinstance(shape, int):
+            shape = (shape,)
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._data = None          # NDArray once initialized
+        self._deferred_init = None  # (init, ctx, default_init) awaiting shape
+        self._sharding = None       # optional jax.sharding spec (parallel pkg)
+
+    # ------------------------------------------------------------------
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        if len(self._shape) != len(new_shape) or any(
+            a != b for a, b in zip(self._shape, new_shape) if a != 0
+        ):
+            raise MXNetError(
+                "cannot reset shape of %s from %s to %s"
+                % (self.name, self._shape, new_shape))
+        self._shape = tuple(new_shape)
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if req not in ("write", "add", "null"):
+            raise MXNetError("invalid grad_req %r" % req)
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._data._grad = None
+                self._data._marked = False
+            else:
+                self._data.attach_grad(req)
+
+    # ------------------------------------------------------------------
+    # init
+    # ------------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """Materialize data (parity: Parameter.initialize, parameter.py:360)."""
+        if self._data is not None and not force_reinit:
+            return
+        if default_init is None:
+            default_init = init_mod.Uniform()
+        if ctx is not None and isinstance(ctx, (list, tuple)):
+            ctx = ctx[0]  # single logical array; placement is sharding's job
+        if self._shape is None or any(s == 0 for s in self._shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise MXNetError(
+                "cannot initialize parameter %s of unknown shape %s; "
+                "set allow_deferred_init=True or specify the full shape"
+                % (self.name, self._shape))
+        self._init_impl(init, ctx, default_init)
+
+    def _init_impl(self, init, ctx, default_init):
+        ctx = ctx or current_context()
+        initializer = init_mod.create(init) if init is not None else (
+            init_mod.create(self.init) if self.init is not None
+            else init_mod.create(default_init))
+        desc = init_mod.InitDesc(self.name)
+        data = initializer(desc, self._shape, _to_jax_dtype(self.dtype))
+        self._data = NDArray(data, ctx=ctx)
+        if self._grad_req != "null":
+            self._data.attach_grad(self._grad_req)
+        self._deferred_init = None
+
+    def _finish_deferred_init(self):
+        if self._deferred_init is None:
+            return
+        if self._shape is None or any(s == 0 for s in self._shape):
+            raise DeferredInitializationError(
+                "parameter %s still has unknown shape %s"
+                % (self.name, self._shape))
+        init, ctx, default_init = self._deferred_init
+        # Initializer RNG must not run under an active jax trace (hybridize's
+        # shape pass) — autograd.pause keeps tape clean; numpy/jax const ok.
+        with autograd.pause():
+            self._init_impl(init, ctx, default_init)
+
+    # ------------------------------------------------------------------
+    # access
+    # ------------------------------------------------------------------
+    def _check_initialized(self):
+        if self._data is not None:
+            return
+        if self._deferred_init is not None:
+            raise DeferredInitializationError(
+                "parameter %s was not initialized yet: unknown shape %s"
+                % (self.name, self._shape))
+        raise MXNetError(
+            "parameter %s has not been initialized; call .initialize() "
+            "or block.initialize()" % self.name)
+
+    def data(self, ctx=None):
+        """The parameter NDArray (single logical copy; see module docstring)."""
+        self._check_initialized()
+        from .block import _trace_param_lookup
+
+        traced = _trace_param_lookup(self)
+        if traced is not None:
+            return traced
+        return self._data
+
+    def list_data(self):
+        return [self.data()]
+
+    def grad(self, ctx=None):
+        self._check_initialized()
+        if self._grad_req == "null":
+            raise MXNetError(
+                "parameter %s has grad_req='null'" % self.name)
+        g = self._data.grad
+        if g is None:
+            g = NDArray(jnp.zeros(self._data.shape, self._data.dtype),
+                        ctx=self._data.context)
+        return g
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        if self._data is None:
+            if self._deferred_init is not None:
+                ctx = self._deferred_init[1]
+                return [ctx or current_context()]
+            raise MXNetError("parameter %s not initialized" % self.name)
+        return [self._data.context]
+
+    def set_data(self, data):
+        """Replace the value, preserving grad bookkeeping."""
+        if self._data is None:
+            if self._deferred_init is not None:
+                self.shape = tuple(data.shape)
+                self._finish_deferred_init()
+            else:
+                raise MXNetError("parameter %s not initialized" % self.name)
+        d = data.data() if isinstance(data, NDArray) else jnp.asarray(data)
+        self._data._set_data(d.astype(self._data.dtype))
+
+    def zero_grad(self):
+        if self._data is not None:
+            self._data.zero_grad()
+
+    def reset_ctx(self, ctx):
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0]
+        if self._data is not None:
+            self._data = self._data.as_in_context(ctx)
+            if self._grad_req != "null":
+                self._data.attach_grad(self._grad_req)
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is not None:
+            marked = self._data._marked
+            self._data = self._data.astype(dtype)
+            if marked:
+                self._data.attach_grad(self._grad_req)
+
+    def var(self):
+        """Symbol placeholder for this parameter (symbolic API)."""
+        from ..symbol import var
+
+        return var(self.name, shape=self._shape, dtype=self.dtype)
+
+    def __repr__(self):
+        return "Parameter %s (shape=%s, dtype=%s)" % (
+            self.name, self._shape, self.dtype)
+
+
+class Constant(Parameter):
+    """Non-differentiable constant parameter (parity: gluon.Constant)."""
+
+    def __init__(self, name, value):
+        if isinstance(value, NDArray):
+            value = value.asnumpy()
+        value = _np.asarray(value)
+        if value.dtype == _np.float64:
+            value = value.astype(_np.float32)
+        elif value.dtype == _np.int64:
+            value = value.astype(_np.int32)
+        self.value = value
+        super().__init__(
+            name, grad_req="null", shape=value.shape,
+            dtype=str(value.dtype),
+            init=init_mod.Constant(0.0))
+        # bake the value in via a closure-initializer
+        outer = self
+
+        class _ValueInit(init_mod.Initializer):
+            def _init_weight(self, desc, shape, dtype):
+                return jnp.asarray(outer.value, dtype)
+
+            def __call__(self, desc, shape, dtype=jnp.float32):
+                return self._init_weight(desc, shape, dtype)
+
+        self.init = _ValueInit()
+
+
+class ParameterDict:
+    """Ordered name→Parameter mapping with prefix + shared-dict lookup.
+
+    Parity: ``gluon.ParameterDict`` (parameter.py:706).
+    """
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def __contains__(self, name):
+        return name in self._params
+
+    def __getitem__(self, name):
+        return self._params[name]
+
+    def __repr__(self):
+        body = "\n".join("  %s" % p for p in self._params.values())
+        return "ParameterDict '%s' (\n%s\n)" % (self._prefix, body)
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        """Get or create ``prefix+name`` (parity: ParameterDict.get)."""
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+            return param
+        for k, v in kwargs.items():
+            if k == "shape":
+                if v is not None:
+                    param.shape = tuple(
+                        v if not isinstance(v, int) else (v,))
+            elif k == "init":
+                if v is not None and param.init is None:
+                    param.init = v
+            elif getattr(param, k, None) in (None,) and v is not None:
+                setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise MXNetError(
+                    "no constant %s and no value given" % name)
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError("duplicate parameter name %s" % k)
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            init = init_mod.Uniform()
+        for p in self._params.values():
+            p.initialize(None, ctx, default_init=init,
+                         force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self._params.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self._params.values():
+            p.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for p in self._params.values():
+            setattr(p, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        from ..ndarray import ndarray as _ndm
+
+        arg = {}
+        for p in self._params.values():
+            name = p.name
+            if strip_prefix and name.startswith(strip_prefix):
+                name = name[len(strip_prefix):]
+            arg[name] = p.data()
+        _ndm.save(filename, arg)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        from ..ndarray import ndarray as _ndm
+
+        loaded = _ndm.load(filename, ctx=ctx)
+        if not isinstance(loaded, dict):
+            raise MXNetError("parameter file %s is not a dict" % filename)
+        if restore_prefix:
+            loaded = {restore_prefix + k: v for k, v in loaded.items()}
+        for name, p in self._params.items():
+            if name not in loaded:
+                if not allow_missing:
+                    raise MXNetError(
+                        "parameter %s missing in file %s" % (name, filename))
+                continue
+            arr = loaded[name]
+            if p._data is None:
+                p.shape = tuple(arr.shape)
+                if p._deferred_init is not None:
+                    p._finish_deferred_init()
+                else:
+                    p.initialize(ctx=ctx)
+            p.set_data(arr)
+        if not ignore_extra:
+            extra = set(loaded) - set(self._params)
+            if extra:
+                raise MXNetError(
+                    "file %s has extra parameters %s (pass ignore_extra=True)"
+                    % (filename, sorted(extra)))

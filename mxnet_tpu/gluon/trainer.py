@@ -1,0 +1,230 @@
+"""Gluon Trainer.
+
+Reference: ``python/mxnet/gluon/trainer.py`` — applies an Optimizer to a set
+of Parameters, routing gradient aggregation through a KVStore
+(``_init_kvstore:174``, ``step:320``, ``allreduce_grads:349``).
+
+TPU-native: on a single logical device the optimizer runs as ONE jitted XLA
+computation over the whole parameter list with donated buffers — the
+reference's multi-tensor fused-optimizer path (``multi_sgd_update``,
+``multi_lamb.cc``) generalized to every optimizer.  Multi-device gradient
+aggregation is an XLA ``psum`` compiled into the training step by the
+``parallel`` package (kvstore='device' semantics over ICI); the explicit
+KVStore object remains for API parity and for the dist_* modes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .. import optimizer as opt_mod
+from .parameter import ParameterDict, Parameter
+
+
+class Trainer:
+    """Parity: gluon.Trainer (trainer.py:28)."""
+
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError(
+                "params should be a list / dict / ParameterDict, got %s"
+                % type(params).__name__)
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise MXNetError(
+                    "invalid parameter of type %s" % type(param).__name__)
+            self._param2idx[param.name] = i
+            self._params.append(param)
+        self._compression_params = compression_params
+        self._kvstore_str = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._states = [None] * len(self._params)
+        self._states_created = [False] * len(self._params)
+        self._fused_cache = {}
+
+        optimizer_params = optimizer_params or {}
+        self._init_optimizer(optimizer, optimizer_params)
+        self._scale = self._optimizer.rescale_grad
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            if optimizer_params:
+                raise MXNetError(
+                    "optimizer_params must be None when optimizer is an "
+                    "Optimizer instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt_mod.create(
+                optimizer, param_dict=param_dict, **optimizer_params)
+
+    # ------------------------------------------------------------------
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def _init_kvstore(self):
+        """Resolve the kvstore string (parity: trainer.py:174)."""
+        from ..kvstore import create as kv_create
+
+        if self._kvstore_str is None:
+            self._kvstore = None
+        elif isinstance(self._kvstore_str, str):
+            self._kvstore = kv_create(self._kvstore_str)
+        else:
+            self._kvstore = self._kvstore_str
+        self._kv_initialized = True
+
+    @property
+    def kvstore(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        return self._kvstore
+
+    # ------------------------------------------------------------------
+    def _ensure_states(self):
+        for i, param in enumerate(self._params):
+            if not self._states_created[i] and param.grad_req != "null":
+                self._states[i] = self._optimizer.create_state(
+                    i, param.data())
+                self._states_created[i] = True
+
+    def _check_and_rescale_grad(self, scale):
+        self._optimizer.rescale_grad = scale
+
+    def allreduce_grads(self):
+        """Sum gradients across devices (parity: trainer.py:349).
+
+        Single-chip: no-op.  Under SPMD (pjit'd train step built by
+        ``mxnet_tpu.parallel``) the psum is compiled into the step itself —
+        this method exists for the explicit-kvstore path.
+        """
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore is not None and self._kvstore.size > 1:
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null" and param._data is not None:
+                    out = param.grad()
+                    self._kvstore.pushpull(i, param.grad(), out=out)
+                    # .grad() returns a fresh wrapper; write the aggregated
+                    # value back into the parameter's real gradient buffer
+                    param._data._grad = out.data()
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Rescale by 1/batch_size, aggregate, and apply one update.
+
+        Parity: Trainer.step (trainer.py:320).
+        """
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self.allreduce_grads()
+        self.update(batch_size, ignore_stale_grad, _rescaled=True)
+
+    def update(self, batch_size, ignore_stale_grad=False, _rescaled=False):
+        if not _rescaled:
+            self._optimizer.rescale_grad = self._scale / batch_size
+        self._ensure_states()
+        opt = self._optimizer
+
+        active = []
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if param._data is None:
+                if not ignore_stale_grad:
+                    raise MXNetError(
+                        "parameter %s has not been initialized" % param.name)
+                continue
+            if param._data._grad is None or not param._data._fresh_grad:
+                if ignore_stale_grad:
+                    continue
+                raise MXNetError(
+                    "gradient of parameter %s has not been updated by "
+                    "backward() since the last step; this could mean a bug "
+                    "in your model that made it only use a subset of the "
+                    "parameters for this iteration; pass "
+                    "ignore_stale_grad=True to suppress"
+                    % param.name)
+            active.append(i)
+        if not active:
+            return
+
+        # one fused XLA update over all parameters (multi-tensor path)
+        key = (tuple(active), float(opt.rescale_grad))
+        fused = self._fused_cache.get(key)
+        if fused is None:
+            def fused_fn(weights, grads, states, lrs, wds, ts):
+                new_w, new_s = [], []
+                for w, g, s, lr, wd, t in zip(weights, grads, states, lrs,
+                                              wds, ts):
+                    nw, ns = opt._step(w, g, s, lr, wd, t)
+                    new_w.append(nw)
+                    new_s.append(ns)
+                return new_w, new_s
+
+            fused = jax.jit(fused_fn, donate_argnums=(0, 2))
+            self._fused_cache[key] = fused
+
+        weights, grads, states, lrs, wds, ts = [], [], [], [], [], []
+        for i in active:
+            param = self._params[i]
+            opt._update_count(i)
+            weights.append(param.data().data())
+            grads.append(param._data._grad)
+            states.append(self._states[i])
+            lrs.append(jnp.float32(opt._get_lr(i)))
+            wds.append(jnp.float32(opt._get_wd(i)))
+            ts.append(jnp.int32(opt._index_update_count[i]))
+
+        new_weights, new_states = fused(weights, grads, states, lrs, wds, ts)
+        for i, nw, ns in zip(active, new_weights, new_states):
+            self._params[i]._data._set_data(nw)
+            self._params[i]._data._fresh_grad = False
+            self._states[i] = ns
+
+    # ------------------------------------------------------------------
+    def save_states(self, fname):
+        """Parity: Trainer.save_states."""
+        import pickle
+
+        assert self._optimizer is not None
+        self._ensure_states()
+        payload = {
+            "states": [jax.device_get(s) if s is not None else None
+                       for s in self._states],
+            "num_update": self._optimizer.num_update,
+            "index_update_count": self._optimizer._index_update_count,
+        }
+        with open(fname, "wb") as f:
+            pickle.dump(payload, f)
+
+    def load_states(self, fname):
+        import pickle
+
+        with open(fname, "rb") as f:
+            payload = pickle.load(f)
+        self._states = [
+            jax.tree_util.tree_map(jnp.asarray, s) if s is not None else None
+            for s in payload["states"]]
+        self._states_created = [True] * len(self._states)
+        self._optimizer.num_update = payload["num_update"]
+        self._optimizer._index_update_count = payload["index_update_count"]

@@ -1,0 +1,326 @@
+"""Weight initializers.
+
+Reference: ``python/mxnet/initializer.py`` — an ``Initializer`` registry
+(``@register``, string/alias lookup) whose instances are callables writing
+into pre-allocated arrays, with name-pattern dispatch (``_bias``→zero etc.)
+via ``InitDesc``.
+
+TPU-native: initializers *return* fresh device arrays (functional, XLA
+buffers are immutable) drawn from the global threefry stream, instead of
+mutating a buffer in place.  The registry, string-construction
+(``mx.init.Xavier(magnitude=2)`` or ``"xavier"``) and name-pattern defaults
+are preserved.
+"""
+from __future__ import annotations
+
+import json
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .base import MXNetError
+from . import random as _random
+
+_INIT_REGISTRY = {}
+
+
+def register(klass):
+    """Register an initializer class under its lowercased name."""
+    name = klass.__name__.lower()
+    _INIT_REGISTRY[name] = klass
+    return klass
+
+
+class InitDesc(str):
+    """Parameter name + attrs hint passed to initializers.
+
+    Parity: ``python/mxnet/initializer.py`` InitDesc — lets one initializer
+    dispatch on parameter naming conventions (``*_bias`` → zeros, ...).
+    """
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    """Base initializer (parity: initializer.Initializer)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, shape, dtype=jnp.float32):
+        """Produce the initial array for parameter ``desc`` of ``shape``."""
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(str(desc))
+        init = desc.attrs.get("__init__", "")
+        if init:
+            return create(init)._init_impl(desc, shape, dtype)
+        name = str(desc)
+        if name.endswith("weight"):
+            return self._init_weight(desc, shape, dtype)
+        if name.endswith("bias"):
+            return self._init_zero(desc, shape, dtype)
+        if name.endswith("gamma"):
+            return self._init_one(desc, shape, dtype)
+        if name.endswith("beta"):
+            return self._init_zero(desc, shape, dtype)
+        if name.endswith("running_mean") or name.endswith("moving_mean"):
+            return self._init_zero(desc, shape, dtype)
+        if name.endswith("running_var") or name.endswith("moving_var"):
+            return self._init_one(desc, shape, dtype)
+        return self._init_weight(desc, shape, dtype)
+
+    def _init_impl(self, desc, shape, dtype):
+        return self._init_weight(desc, shape, dtype)
+
+    def _init_weight(self, desc, shape, dtype):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    @staticmethod
+    def _init_zero(desc, shape, dtype):
+        return jnp.zeros(shape, dtype)
+
+    @staticmethod
+    def _init_one(desc, shape, dtype):
+        return jnp.ones(shape, dtype)
+
+    def __repr__(self):
+        return "%s(%s)" % (self.__class__.__name__, self._kwargs)
+
+
+def create(init, **kwargs):
+    """Resolve a string / instance / json-dumps into an Initializer."""
+    if isinstance(init, Initializer):
+        return init
+    if init is None:
+        return Uniform()
+    if isinstance(init, str):
+        s = init.strip()
+        if s.startswith("["):  # dumps() round-trip
+            name, kw = json.loads(s)
+            return _INIT_REGISTRY[name](**kw)
+        key = s.lower()
+        if key not in _INIT_REGISTRY:
+            raise MXNetError("unknown initializer %r" % init)
+        return _INIT_REGISTRY[key](**kwargs)
+    raise TypeError("cannot create initializer from %r" % (init,))
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, desc, shape, dtype):
+        return jnp.zeros(shape, dtype)
+
+
+_INIT_REGISTRY["zeros"] = Zero
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, desc, shape, dtype):
+        return jnp.ones(shape, dtype)
+
+
+_INIT_REGISTRY["ones"] = One
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, desc, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, desc, shape, dtype):
+        return jax.random.uniform(
+            _random.next_key(), shape, jnp.float32, -self.scale, self.scale
+        ).astype(dtype)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, desc, shape, dtype):
+        return (self.sigma * jax.random.normal(
+            _random.next_key(), shape, jnp.float32)).astype(dtype)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, desc, shape, dtype):
+        nout = shape[0]
+        nin = int(_np.prod(shape[1:])) if len(shape) > 1 else 1
+        key = _random.next_key()
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(key, (nout, nin), jnp.float32, -1.0, 1.0)
+        else:
+            tmp = jax.random.normal(key, (nout, nin), jnp.float32)
+        u, _, v = jnp.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        return (self.scale * q.reshape(shape)).astype(dtype)
+
+
+def _fan_in_out(shape, factor_type):
+    hw_scale = 1.0
+    if len(shape) < 2:
+        raise MXNetError(
+            "Xavier-family initializers need >=2-d shapes, got %s" % (shape,))
+    if len(shape) > 2:
+        hw_scale = float(_np.prod(shape[2:]))
+    fan_in = shape[1] * hw_scale
+    fan_out = shape[0] * hw_scale
+    return fan_in, fan_out
+
+
+@register
+class Xavier(Initializer):
+    """Parity: initializer.Xavier (rnd_type, factor_type, magnitude)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, desc, shape, dtype):
+        fan_in, fan_out = _fan_in_out(shape, self.factor_type)
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise MXNetError("invalid factor_type %r" % self.factor_type)
+        scale = math.sqrt(self.magnitude / factor)
+        key = _random.next_key()
+        if self.rnd_type == "uniform":
+            out = jax.random.uniform(key, shape, jnp.float32, -scale, scale)
+        elif self.rnd_type == "gaussian":
+            out = scale * jax.random.normal(key, shape, jnp.float32)
+        else:
+            raise MXNetError("invalid rnd_type %r" % self.rnd_type)
+        return out.astype(dtype)
+
+
+@register
+class MSRAPrelu(Xavier):
+    """Parity: initializer.MSRAPrelu."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernels (parity: initializer.Bilinear)."""
+
+    def _init_weight(self, desc, shape, dtype):
+        weight = _np.zeros(int(_np.prod(shape)), dtype=_np.float32)
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(_np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        return jnp.asarray(weight.reshape(shape), dtype)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = 1, rest 0 (parity: initializer.LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, desc, shape, dtype):
+        b = _np.zeros(shape, dtype=_np.float32)
+        n = shape[0] // 4
+        b[n:2 * n] = self.forget_bias  # gate order i, f, g, o
+        return jnp.asarray(b, dtype)
+
+
+@register
+class FusedRNN(Initializer):
+    """Initialize a packed RNN parameter blob by delegating to ``init``."""
+
+    def __init__(self, init=None, state_size=0, num_layers=1, mode="lstm",
+                 bidirectional=False, forget_bias=1.0):
+        super().__init__()
+        self._init = create(init) if init is not None else Uniform(0.1)
+        self._forget = forget_bias
+
+    def _init_weight(self, desc, shape, dtype):
+        return self._init._init_weight(desc, shape, dtype)
+
+
+class Load:
+    """Initialize from a dict of arrays, falling back to ``default_init``."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        self.param = {
+            k.replace("arg:", "").replace("aux:", ""): v
+            for k, v in param.items()
+        }
+        self.default_init = default_init
+
+    def __call__(self, desc, shape, dtype=jnp.float32):
+        name = str(desc)
+        if name in self.param:
+            arr = self.param[name]
+            arr = arr.data() if hasattr(arr, "data") else jnp.asarray(arr)
+            if tuple(arr.shape) != tuple(shape):
+                raise MXNetError(
+                    "Load: shape mismatch for %s: %s vs %s"
+                    % (name, arr.shape, shape))
+            return arr.astype(dtype)
+        if self.default_init is None:
+            raise MXNetError("Load: no init for %s" % name)
+        return self.default_init(desc, shape, dtype)
+
+
+class Mixed:
+    """Pattern-dispatch initializer (parity: initializer.Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        import re
+
+        if len(patterns) != len(initializers):
+            raise MXNetError("patterns and initializers must pair up")
+        self.map = [(re.compile(p), init) for p, init in
+                    zip(patterns, initializers)]
+
+    def __call__(self, desc, shape, dtype=jnp.float32):
+        for prog, init in self.map:
+            if prog.match(str(desc)):
+                return init(desc, shape, dtype)
+        raise MXNetError("no matching pattern for %s" % str(desc))

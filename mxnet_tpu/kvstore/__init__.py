@@ -1,0 +1,3 @@
+"""KVStore package (parity: python/mxnet/kvstore/)."""
+from .base import KVStoreBase  # noqa: F401
+from .kvstore import KVStore, TestStore, create  # noqa: F401

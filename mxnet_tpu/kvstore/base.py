@@ -1,0 +1,62 @@
+"""KVStore base interface + registry.
+
+Reference: ``python/mxnet/kvstore/base.py`` — ``KVStoreBase`` with the
+``@register`` plugin mechanism (``base.py:75,229-248``) so alternative stores
+(test stores, Horovod-style) can be slotted in by name.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+
+class KVStoreBase:
+    """Abstract key-value store (parity: kvstore.base.KVStoreBase)."""
+
+    kv_registry = {}
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        KVStoreBase.kv_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def is_capable(capability):
+        raise NotImplementedError
+
+    OPTIMIZER = "optimizer"
+
+    # -- interface ---------------------------------------------------------
+    def broadcast(self, key, value, out, priority=0):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        raise NotImplementedError
+
+    def set_optimizer(self, optimizer):
+        raise NotImplementedError
+
+    @property
+    def type(self):
+        raise NotImplementedError
+
+    @property
+    def rank(self):
+        raise NotImplementedError
+
+    @property
+    def num_workers(self):
+        raise NotImplementedError
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        raise NotImplementedError
+
+    def load_optimizer_states(self, fname):
+        raise NotImplementedError
+
+
+def create_via_registry(name, **kwargs):
+    name = name.lower()
+    if name not in KVStoreBase.kv_registry:
+        raise MXNetError("no kvstore type %r registered" % name)
+    return KVStoreBase.kv_registry[name](**kwargs)

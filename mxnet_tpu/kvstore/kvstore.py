@@ -1,0 +1,226 @@
+"""KVStore implementations.
+
+Reference: ``src/kvstore/`` — ``KVStoreLocal`` (+ ``Comm`` reduce hierarchy,
+``comm.h:103,451``), ``KVStoreNCCL``, ``KVStoreDist`` over ps-lite.
+
+TPU-native: on one host, "devices" are mesh shards of a single logical array,
+so local/device aggregation is an XLA ``add_n`` (and, when values are sharded
+jax Arrays, the sum lowers to an ICI all-reduce automatically — the
+``CommDevice``/NCCL role).  Multi-host (``dist_*``) rides
+``jax.distributed`` + DCN collectives; see ``mxnet_tpu.parallel``.  The
+string-dispatch factory mirrors ``KVStore::Create`` (``kvstore.cc:40-77``).
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .base import KVStoreBase, create_via_registry
+
+
+def _as_list(v):
+    return v if isinstance(v, (list, tuple)) else [v]
+
+
+@KVStoreBase.register
+class KVStore(KVStoreBase):
+    """Single-process store: 'local' and 'device' modes.
+
+    Parity: ``KVStoreLocal`` (``src/kvstore/kvstore_local.h:69``).  Values
+    pushed from multiple "devices" are reduced by summation; ``device`` mode
+    differs from ``local`` only in *where* the reference reduced (GPU vs
+    CPU) — on TPU the sum runs wherever the buffers live, so both modes
+    share one implementation.
+    """
+
+    def __init__(self, name="local"):
+        self._type = name
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+
+    @staticmethod
+    def is_capable(capability):
+        return capability in (KVStoreBase.OPTIMIZER,)
+
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    size = num_workers
+
+    # ------------------------------------------------------------------
+    def init(self, key, value):
+        keys = _as_list(key)
+        values = _as_list(value)
+        if len(keys) != len(values):
+            values = [value] * len(keys)
+        for k, v in zip(keys, values):
+            self._store[str(k)] = v.copy() if isinstance(v, NDArray) else \
+                NDArray(v)
+
+    def _reduce(self, values):
+        vals = _as_list(values)
+        acc = vals[0].data()
+        for v in vals[1:]:
+            acc = acc + v.data()
+        return acc
+
+    def push(self, key, value, priority=0):
+        keys = _as_list(key)
+        if len(keys) == 1:
+            values = [value]
+        else:
+            values = value
+        for k, v in zip(keys, values):
+            k = str(k)
+            agg = self._reduce(v)
+            if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError("key %s not initialized" % k)
+                self._updater(int(k) if k.isdigit() else k,
+                              NDArray(agg), self._store[k])
+            else:
+                self._store[k] = NDArray(agg)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys = _as_list(key)
+        if len(keys) == 1:
+            outs = [out]
+        else:
+            outs = out
+        for k, o in zip(keys, outs):
+            k = str(k)
+            if k not in self._store:
+                raise MXNetError("key %s not initialized" % k)
+            src = self._store[k]
+            for dst in _as_list(o):
+                dst._set_data(src.data().astype(dst.dtype))
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused push+pull (parity: KVStore.pushpull)."""
+        keys = _as_list(key)
+        if len(keys) == 1:
+            values, outs = [value], [out]
+        else:
+            values, outs = value, out
+        for k, v, o in zip(keys, values, outs):
+            agg = self._reduce(v)
+            kstr = str(k)
+            if self._updater is not None:
+                if kstr not in self._store:
+                    raise MXNetError("key %s not initialized" % kstr)
+                self._updater(int(kstr) if kstr.isdigit() else kstr,
+                              NDArray(agg), self._store[kstr])
+                agg = self._store[kstr].data()
+            if o is not None:
+                for dst in _as_list(o):
+                    dst._set_data(agg.astype(dst.dtype))
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the rows in ``row_ids`` (parity: kvstore_dist row path)."""
+        if row_ids is None:
+            return self.pull(key, out, priority)
+        k = str(_as_list(key)[0])
+        src = self._store[k]
+        rows = row_ids.data() if isinstance(row_ids, NDArray) else \
+            jnp.asarray(row_ids)
+        gathered = src.data()[rows.astype(jnp.int32)]
+        for dst in _as_list(out):
+            full = jnp.zeros(src.shape, src.dtype).at[
+                rows.astype(jnp.int32)].set(gathered)
+            dst._set_data(full.astype(dst.dtype))
+
+    # ------------------------------------------------------------------
+    def set_optimizer(self, optimizer):
+        from .. import optimizer as opt_mod
+
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer set")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer set")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def barrier(self):
+        pass
+
+
+@KVStoreBase.register
+class TestStore(KVStoreBase):
+    """Minimal reference store used by tests (parity: base.py TestStore)."""
+
+    def __init__(self):
+        self._store = {}
+
+    @staticmethod
+    def is_capable(capability):
+        return False
+
+    @property
+    def type(self):
+        return "teststore"
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    size = num_workers
+
+    def broadcast(self, key, value, out, priority=0):
+        for dst in _as_list(out):
+            dst._set_data(_as_list(value)[0].data())
+
+    def pushpull(self, key, value, out=None, priority=0):
+        vals = _as_list(value)
+        acc = vals[0].data()
+        for v in vals[1:]:
+            acc = acc + v.data()
+        for dst in _as_list(out):
+            dst._set_data(acc.astype(dst.dtype))
+
+
+def create(name="local", **kwargs):
+    """String-dispatch factory (parity: KVStore::Create, kvstore.cc:40)."""
+    if not isinstance(name, str):
+        raise MXNetError("name must be a string")
+    name = name.lower()
+    if name in ("local", "device", "local_allreduce_cpu",
+                "local_allreduce_device", "nccl", "tpu"):
+        return KVStore("device" if name in ("device", "nccl", "tpu")
+                       else "local")
+    if name.startswith("dist"):
+        from ..parallel.dist_kvstore import DistKVStore
+
+        return DistKVStore(name)
+    return create_via_registry(name, **kwargs)

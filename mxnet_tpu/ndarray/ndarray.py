@@ -47,7 +47,7 @@ class NDArray:
 
     __slots__ = (
         "_data", "_ctx", "_var",
-        "_marked", "_grad", "_grad_req", "_grad_gen",
+        "_marked", "_grad", "_grad_req", "_grad_gen", "_fresh_grad",
         "_tape_node", "_tape_index",
         "__weakref__",
     )
@@ -71,6 +71,7 @@ class NDArray:
         self._grad = None
         self._grad_req = "write"
         self._grad_gen = -1
+        self._fresh_grad = False
         self._tape_node = None
         self._tape_index = 0
 
@@ -181,6 +182,7 @@ class NDArray:
         gen = autograd.current_backward_gen()
         fresh = self._grad_gen != gen
         self._grad_gen = gen
+        self._fresh_grad = True
         if self._grad is None or (fresh and self._grad_req == "write"):
             self._grad = ct
         else:

@@ -24,8 +24,9 @@ def make_op_func(op_name):
             attrs = kwargs
             fields = None
         else:
-            inputs = list(args)
-            fields = list(reg.input_names[: len(inputs)])
+            named = list(zip(reg.input_names, args))
+            inputs = [a for _, a in named if a is not None]
+            fields = [f for f, a in named if a is not None]
             for nm in reg.input_names[len(inputs):]:
                 if nm in kwargs and isinstance(kwargs[nm], NDArray):
                     inputs.append(kwargs.pop(nm))

@@ -1,0 +1,6 @@
+"""Optimizer package (parity: python/mxnet/optimizer/)."""
+from .optimizer import (  # noqa: F401
+    Optimizer, SGD, NAG, SGLD, Adam, AdamW, AdaGrad, AdaDelta, RMSProp, Ftrl,
+    Signum, FTML, DCASGD, Nadam, LAMB, LARS, Test, Updater, get_updater,
+    create, register,
+)

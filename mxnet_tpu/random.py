@@ -32,9 +32,33 @@ def seed(seed_state, ctx="all"):
 
 def next_key():
     st = _st()
+    srcs = getattr(st, "trace_sources", None)
+    if srcs:
+        # Inside a hybridize trace: derive from the traced key argument so the
+        # compiled executable takes fresh randomness at run time instead of
+        # baking in a constant drawn at trace time.
+        srcs[-1], sub = jax.random.split(srcs[-1])
+        return sub
     st.key, sub = jax.random.split(st.key)
     return sub
 
 
 def current_key():
     return _st().key
+
+
+class trace_key_scope:
+    """Scope routing ``next_key`` to splits of ``key`` (hybridize tracing)."""
+
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        st = _st()
+        if not hasattr(st, "trace_sources"):
+            st.trace_sources = []
+        st.trace_sources.append(self._key)
+        return self
+
+    def __exit__(self, *a):
+        _st().trace_sources.pop()

@@ -1,0 +1,360 @@
+"""Gluon core tests (model: tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier")
+    assert p.data().shape == (10, 10)
+    assert p.list_ctx() == [mx.current_context()]
+    assert p.grad().shape == (10, 10)
+
+
+def test_parameter_deferred():
+    p = gluon.Parameter("weight", shape=(10, 0), allow_deferred_init=True)
+    p.initialize()
+    with pytest.raises(gluon.DeferredInitializationError):
+        p.data()
+    p.shape = (10, 5)
+    p._finish_deferred_init()
+    assert p.data().shape == (10, 5)
+
+
+def test_constant():
+    const = gluon.Constant("const", [[1, 2], [3, 4]])
+    const.initialize()
+    np.testing.assert_allclose(const.data().asnumpy(),
+                               [[1, 2], [3, 4]])
+    assert const.grad_req == "null"
+
+
+def test_paramdict():
+    params = gluon.ParameterDict("net_")
+    w = params.get("weight", shape=(10, 10))
+    assert w.name == "net_weight"
+    assert params.get("weight") is w
+    params.initialize(ctx=mx.cpu())
+    params.save("/tmp/test_paramdict.params")
+    params.load("/tmp/test_paramdict.params", mx.cpu())
+
+
+def test_dense():
+    net = nn.Dense(5, in_units=3, use_bias=True)
+    net.initialize()
+    x = mx.nd.ones((2, 3))
+    y = net(x)
+    assert y.shape == (2, 5)
+    w = net.weight.data().asnumpy()
+    b = net.bias.data().asnumpy()
+    # TPU MXU matmul uses bf16 passes for fp32 inputs — tolerance reflects it
+    np.testing.assert_allclose(
+        y.asnumpy(), np.ones((2, 3)) @ w.T + b, rtol=1e-2, atol=1e-3)
+
+
+def test_dense_deferred():
+    net = nn.Dense(5)
+    net.initialize()
+    x = mx.nd.ones((2, 7))
+    y = net(x)
+    assert y.shape == (2, 5)
+    assert net.weight.shape == (5, 7)
+
+
+def test_sequential():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"),
+                nn.Dense(8, activation="relu"),
+                nn.Dense(4))
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(2, 10))
+    y = net(x)
+    assert y.shape == (2, 4)
+    assert len(net) == 3
+    assert isinstance(net[0], nn.Dense)
+    assert len(net.collect_params()) == 6
+
+
+def test_block_naming():
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(4))
+        net.add(nn.Dense(4))
+    names = list(net.collect_params().keys())
+    assert all(n.startswith("model_") for n in names)
+    assert len(set(names)) == 4
+
+
+def test_hybridize_matches_imperative():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(3, 8))
+    y_imp = net(x).asnumpy()
+    net.hybridize()
+    y1 = net(x).asnumpy()   # warm-up (imperative internally)
+    y2 = net(x).asnumpy()   # compiled
+    y3 = net(x).asnumpy()   # cached executable
+    np.testing.assert_allclose(y_imp, y1, rtol=1e-5)
+    np.testing.assert_allclose(y_imp, y2, rtol=1e-5)
+    np.testing.assert_allclose(y_imp, y3, rtol=1e-5)
+
+
+def test_hybridize_grad():
+    def run(hybridize):
+        mx.random.seed(7)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(8, activation="tanh", in_units=4), nn.Dense(2, in_units=8))
+        net.initialize(mx.init.Xavier())
+        if hybridize:
+            net.hybridize()
+            x0 = mx.nd.ones((5, 4))
+            net(x0)  # warm-up pass
+        x = mx.nd.array(np.linspace(-1, 1, 20).reshape(5, 4))
+        with mx.autograd.record():
+            y = net(x)
+            loss = (y * y).sum()
+        loss.backward()
+        return [p.grad().asnumpy() for p in net.collect_params().values()]
+
+    g_imp = run(False)
+    g_hyb = run(True)
+    for a, b in zip(g_imp, g_hyb):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d():
+    net = nn.Conv2D(8, kernel_size=3, padding=1, in_channels=3)
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(2, 3, 16, 16))
+    y = net(x)
+    assert y.shape == (2, 8, 16, 16)
+
+
+def test_conv_deferred_channels():
+    net = nn.Conv2D(4, kernel_size=3)
+    net.initialize()
+    y = net(mx.nd.ones((1, 5, 8, 8)))
+    assert y.shape == (1, 4, 6, 6)
+    assert net.weight.shape == (4, 5, 3, 3)
+
+
+def test_pooling_layers():
+    x = mx.nd.random.uniform(shape=(2, 3, 8, 8))
+    assert nn.MaxPool2D(2)(x).shape == (2, 3, 4, 4)
+    assert nn.AvgPool2D(2)(x).shape == (2, 3, 4, 4)
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+
+
+def test_batchnorm_updates_stats():
+    net = nn.BatchNorm(in_channels=4)
+    net.initialize()
+    x = mx.nd.random.normal(shape=(8, 4, 3, 3)) + 5.0
+    with mx.autograd.record():
+        net(x)
+    mean = net.running_mean.data().asnumpy()
+    assert np.all(mean > 0.1), mean  # moved toward batch mean ~5
+    # predict mode: stats unchanged
+    before = net.running_mean.data().asnumpy()
+    net(x)
+    np.testing.assert_allclose(net.running_mean.data().asnumpy(), before)
+
+
+def test_batchnorm_hybrid_stats():
+    net = nn.BatchNorm(in_channels=2)
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.random.normal(shape=(4, 2)) + 3.0
+    with mx.autograd.record():
+        net(x)  # warm-up
+        net(x)  # compiled — aux side-channel path
+    assert np.all(net.running_mean.data().asnumpy() > 0.1)
+
+
+def test_dropout_modes():
+    net = nn.Dropout(0.5)
+    net.initialize()
+    x = mx.nd.ones((100, 100))
+    y_pred = net(x)
+    np.testing.assert_allclose(y_pred.asnumpy(), x.asnumpy())  # identity
+    with mx.autograd.record():
+        y_train = net(x)
+    frac = (y_train.asnumpy() == 0).mean()
+    assert 0.3 < frac < 0.7
+
+
+def test_embedding():
+    net = nn.Embedding(10, 4)
+    net.initialize()
+    x = mx.nd.array([[1, 2], [3, 4]])
+    y = net(x)
+    assert y.shape == (2, 2, 4)
+
+
+def test_trainer_sgd_step():
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize(mx.init.Constant(0.5))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = mx.nd.array([[1.0, 2.0]])
+    with mx.autograd.record():
+        y = net(x)
+    y.backward()
+    trainer.step(1)
+    # w -= lr * x  (dy/dw = x)
+    np.testing.assert_allclose(
+        net.weight.data().asnumpy(), [[0.4, 0.3]], rtol=1e-5)
+
+
+def test_trainer_momentum_matches_numpy():
+    net = nn.Dense(1, in_units=3, use_bias=False)
+    net.initialize(mx.init.Constant(1.0))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    w_ref = np.ones((1, 3), np.float32)
+    mom = np.zeros_like(w_ref)
+    x_np = np.array([[1.0, -2.0, 3.0]], np.float32)
+    for _ in range(3):
+        x = mx.nd.array(x_np)
+        with mx.autograd.record():
+            y = net(x)
+        y.backward()
+        trainer.step(1)
+        mom = 0.9 * mom - 0.1 * x_np
+        w_ref = w_ref + mom
+        np.testing.assert_allclose(net.weight.data().asnumpy(), w_ref,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_trainer_adam():
+    net = nn.Dense(2, in_units=2, use_bias=False)
+    net.initialize(mx.init.Constant(1.0))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    for _ in range(2):
+        x = mx.nd.ones((4, 2))
+        with mx.autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        trainer.step(4)
+    assert not np.allclose(net.weight.data().asnumpy(), 1.0)
+
+
+def test_losses():
+    from mxnet_tpu.gluon import loss as gloss
+
+    pred = mx.nd.array([[0.3, 0.7], [0.9, 0.1]])
+    label = mx.nd.array([[0.0, 1.0], [1.0, 0.0]])
+    l2 = gloss.L2Loss()(pred, label).asnumpy()
+    np.testing.assert_allclose(
+        l2, 0.5 * ((pred.asnumpy() - label.asnumpy()) ** 2).mean(axis=1),
+        rtol=1e-5)
+    l1 = gloss.L1Loss()(pred, label).asnumpy()
+    np.testing.assert_allclose(
+        l1, np.abs(pred.asnumpy() - label.asnumpy()).mean(axis=1), rtol=1e-5)
+
+    logits = mx.nd.array([[2.0, 1.0, 0.1], [0.5, 2.5, 0.3]])
+    sparse_label = mx.nd.array([0, 1])
+    ce = gloss.SoftmaxCrossEntropyLoss()(logits, sparse_label).asnumpy()
+    p = np.exp(logits.asnumpy())
+    p /= p.sum(axis=1, keepdims=True)
+    expect = -np.log(p[np.arange(2), [0, 1]])
+    np.testing.assert_allclose(ce, expect, rtol=1e-5)
+
+
+def test_loss_backward():
+    from mxnet_tpu.gluon import loss as gloss
+
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    ce = gloss.SoftmaxCrossEntropyLoss()
+    x = mx.nd.random.uniform(shape=(2, 4))
+    y = mx.nd.array([0, 2])
+    with mx.autograd.record():
+        loss = ce(net(x), y)
+    loss.backward()
+    assert net.weight.grad().asnumpy().shape == (3, 4)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+    x = mx.nd.ones((1, 3))
+    y_ref = net(x).asnumpy()
+
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net2.load_parameters(f)
+    np.testing.assert_allclose(net2(x).asnumpy(), y_ref, rtol=1e-6)
+
+
+def test_lr_scheduler():
+    from mxnet_tpu.lr_scheduler import FactorScheduler, CosineScheduler
+
+    sched = FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert sched(1) == 1.0
+    assert sched(25) == 0.25
+    cos = CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.0)
+    assert abs(cos(0) - 1.0) < 1e-6
+    assert cos(100) < 1e-6
+
+
+def test_trainer_lr_scheduler():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+
+    net = nn.Dense(1, in_units=1, use_bias=False)
+    net.initialize()
+    trainer = gluon.Trainer(
+        net.collect_params(), "sgd",
+        {"learning_rate": 1.0,
+         "lr_scheduler": FactorScheduler(step=1, factor=0.5)})
+    assert trainer.learning_rate == 1.0
+
+
+def test_kvstore_local():
+    kv = mx.kvstore.create("local")
+    kv.init(3, mx.nd.ones((2, 3)))
+    out = mx.nd.zeros((2, 3))
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 1.0)
+    kv.push(3, [mx.nd.ones((2, 3)), mx.nd.ones((2, 3)) * 2])
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 3.0)
+
+
+def test_trainer_stale_grad_raises():
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "wd": 0.5})
+    with pytest.raises(mx.MXNetError):
+        trainer.step(1)  # no backward ran — must not silently decay weights
+    # and ignore_stale_grad skips without touching weights
+    w_before = net.weight.data().asnumpy()
+    trainer.step(1, ignore_stale_grad=True)
+    np.testing.assert_allclose(net.weight.data().asnumpy(), w_before)
+
+
+def test_optimizer_rescale_grad_not_baked():
+    from mxnet_tpu import optimizer as opt_mod
+
+    opt = opt_mod.SGD(learning_rate=1.0, rescale_grad=1.0)
+    w = mx.nd.zeros((3,))
+    g = mx.nd.ones((3,))
+    opt.update(0, w, g, None)
+    np.testing.assert_allclose(w.asnumpy(), -1.0)
+    opt.rescale_grad = 0.0
+    opt.update(0, w, g, None)
+    np.testing.assert_allclose(w.asnumpy(), -1.0)  # zero-scaled grad
