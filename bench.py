@@ -1,0 +1,71 @@
+"""Headline benchmark: ResNet-50 training throughput, one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: the reference's best published single-GPU ResNet-50 training
+number — 363.69 img/s (batch 128, 1x V100, fp32; BASELINE.md, perf.md:254).
+
+The whole train step (fwd+bwd+SGD) is one XLA executable with donated
+buffers (mxnet_tpu.parallel.JitTrainStep); inputs are bf16 NHWC-friendly
+batches fed asynchronously.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 363.69
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    platform = jax.devices()[0].platform
+
+    mx.random.seed(0)
+    net = vision.resnet50_v1()
+    net.initialize(mx.init.Xavier())
+    if platform != "cpu":
+        net.cast('bfloat16')  # MXU-native dtype; BN math still f32 inside
+
+    step = parallel.JitTrainStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        'sgd', {'learning_rate': 0.1, 'momentum': 0.9})
+
+    rng = np.random.RandomState(0)
+    dtype = np.float32 if platform == "cpu" else 'bfloat16'
+    x = rng.rand(batch, 3, 224, 224).astype(np.float32)
+    if dtype != np.float32:
+        import jax.numpy as jnp
+        x = jnp.asarray(x, jnp.bfloat16)
+    y = rng.randint(0, 1000, batch).astype(np.float32)
+
+    # warmup: first call compiles
+    for _ in range(3):
+        loss = step.step(x, y)
+    jax.block_until_ready(loss)
+
+    n_steps = 20
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        loss = step.step(x, y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_s = batch * n_steps / dt
+    print(json.dumps({
+        "metric": "resnet50_train_throughput",
+        "value": round(img_s, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -36,4 +36,10 @@ from . import initializer as init  # noqa: F401
 from . import lr_scheduler  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import kvstore  # noqa: F401
+from . import registry  # noqa: F401
+from . import metric  # noqa: F401
+from . import recordio  # noqa: F401
+from . import io  # noqa: F401
+from . import image  # noqa: F401
+from . import parallel  # noqa: F401
 from . import gluon  # noqa: F401

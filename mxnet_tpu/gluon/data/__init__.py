@@ -1,0 +1,9 @@
+"""Gluon data API (parity: ``python/mxnet/gluon/data/``)."""
+from .dataset import (  # noqa: F401
+    Dataset, SimpleDataset, ArrayDataset, RecordFileDataset,
+)
+from .sampler import (  # noqa: F401
+    Sampler, SequentialSampler, RandomSampler, BatchSampler,
+)
+from .dataloader import DataLoader  # noqa: F401
+from . import vision  # noqa: F401

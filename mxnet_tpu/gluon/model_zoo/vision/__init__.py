@@ -1,0 +1,65 @@
+"""Vision model zoo (parity: gluon/model_zoo/vision/__init__.py).
+
+All the reference's architecture families, defined natively on
+``mxnet_tpu.gluon.nn`` layers: AlexNet, DenseNet, Inception-v3, MobileNet
+(v1/v2), ResNet (v1/v2, 18-152), SqueezeNet, VGG (11-19, ±BN).
+No pretrained weights are shipped (no egress): ``pretrained=True`` loads
+from a local ``root`` directory when the .params file exists there.
+"""
+from .alexnet import alexnet, AlexNet  # noqa: F401
+from .densenet import (  # noqa: F401
+    DenseNet, densenet121, densenet161, densenet169, densenet201,
+)
+from .inception import inception_v3, Inception3  # noqa: F401
+from .mobilenet import (  # noqa: F401
+    MobileNet, MobileNetV2,
+    mobilenet1_0, mobilenet0_75, mobilenet0_5, mobilenet0_25,
+    mobilenet_v2_1_0, mobilenet_v2_0_75, mobilenet_v2_0_5,
+    mobilenet_v2_0_25,
+)
+from .resnet import (  # noqa: F401
+    ResNetV1, ResNetV2, BasicBlockV1, BasicBlockV2,
+    BottleneckV1, BottleneckV2, get_resnet,
+    resnet18_v1, resnet34_v1, resnet50_v1, resnet101_v1, resnet152_v1,
+    resnet18_v2, resnet34_v2, resnet50_v2, resnet101_v2, resnet152_v2,
+)
+from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa
+from .vgg import (  # noqa: F401
+    VGG, vgg11, vgg13, vgg16, vgg19,
+    vgg11_bn, vgg13_bn, vgg16_bn, vgg19_bn,
+)
+
+from ....base import MXNetError
+
+_models = {
+    'resnet18_v1': resnet18_v1, 'resnet34_v1': resnet34_v1,
+    'resnet50_v1': resnet50_v1, 'resnet101_v1': resnet101_v1,
+    'resnet152_v1': resnet152_v1,
+    'resnet18_v2': resnet18_v2, 'resnet34_v2': resnet34_v2,
+    'resnet50_v2': resnet50_v2, 'resnet101_v2': resnet101_v2,
+    'resnet152_v2': resnet152_v2,
+    'vgg11': vgg11, 'vgg13': vgg13, 'vgg16': vgg16, 'vgg19': vgg19,
+    'vgg11_bn': vgg11_bn, 'vgg13_bn': vgg13_bn, 'vgg16_bn': vgg16_bn,
+    'vgg19_bn': vgg19_bn,
+    'alexnet': alexnet,
+    'densenet121': densenet121, 'densenet161': densenet161,
+    'densenet169': densenet169, 'densenet201': densenet201,
+    'squeezenet1.0': squeezenet1_0, 'squeezenet1.1': squeezenet1_1,
+    'inceptionv3': inception_v3,
+    'mobilenet1.0': mobilenet1_0, 'mobilenet0.75': mobilenet0_75,
+    'mobilenet0.5': mobilenet0_5, 'mobilenet0.25': mobilenet0_25,
+    'mobilenetv2_1.0': mobilenet_v2_1_0,
+    'mobilenetv2_0.75': mobilenet_v2_0_75,
+    'mobilenetv2_0.5': mobilenet_v2_0_5,
+    'mobilenetv2_0.25': mobilenet_v2_0_25,
+}
+
+
+def get_model(name, **kwargs):
+    """Create a model by name (parity: model_zoo/vision get_model)."""
+    name = name.lower()
+    if name not in _models:
+        raise MXNetError(
+            "Model %s is not supported. Available: %s"
+            % (name, sorted(_models)))
+    return _models[name](**kwargs)

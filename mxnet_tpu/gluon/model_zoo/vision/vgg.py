@@ -1,0 +1,103 @@
+"""VGG 11/13/16/19 ±BN (parity: gluon/model_zoo/vision/vgg.py)."""
+from __future__ import annotations
+
+import os
+
+from ...block import HybridBlock
+from ... import nn
+from ....context import cpu
+from .... import initializer as init
+
+
+class VGG(HybridBlock):
+    def __init__(self, layers, filters, classes=1000, batch_norm=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        assert len(layers) == len(filters)
+        with self.name_scope():
+            self.features = self._make_features(layers, filters,
+                                                batch_norm)
+            self.features.add(nn.Dense(
+                4096, activation='relu',
+                weight_initializer='normal'))
+            self.features.add(nn.Dropout(rate=0.5))
+            self.features.add(nn.Dense(
+                4096, activation='relu',
+                weight_initializer='normal'))
+            self.features.add(nn.Dropout(rate=0.5))
+            self.output = nn.Dense(classes, weight_initializer='normal')
+
+    def _make_features(self, layers, filters, batch_norm):
+        featurizer = nn.HybridSequential(prefix='')
+        for i, num in enumerate(layers):
+            for _ in range(num):
+                featurizer.add(nn.Conv2D(
+                    filters[i], kernel_size=3, padding=1,
+                    weight_initializer=init.Xavier(
+                        rnd_type='gaussian', factor_type='out',
+                        magnitude=2)))
+                if batch_norm:
+                    featurizer.add(nn.BatchNorm())
+                featurizer.add(nn.Activation('relu'))
+            featurizer.add(nn.MaxPool2D(strides=2))
+        return featurizer
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+vgg_spec = {
+    11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
+    13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
+    16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
+    19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512]),
+}
+
+
+def get_vgg(num_layers, pretrained=False, ctx=cpu(),
+            root=os.path.join('~', '.mxnet', 'models'), **kwargs):
+    layers, filters = vgg_spec[num_layers]
+    net = VGG(layers, filters, **kwargs)
+    if pretrained:
+        batch_norm_suffix = '_bn' if kwargs.get('batch_norm') else ''
+        net.load_parameters(os.path.join(
+            os.path.expanduser(root),
+            'vgg%d%s.params' % (num_layers, batch_norm_suffix)), ctx=ctx)
+    return net
+
+
+def vgg11(**kwargs):
+    return get_vgg(11, **kwargs)
+
+
+def vgg13(**kwargs):
+    return get_vgg(13, **kwargs)
+
+
+def vgg16(**kwargs):
+    return get_vgg(16, **kwargs)
+
+
+def vgg19(**kwargs):
+    return get_vgg(19, **kwargs)
+
+
+def vgg11_bn(**kwargs):
+    kwargs['batch_norm'] = True
+    return get_vgg(11, **kwargs)
+
+
+def vgg13_bn(**kwargs):
+    kwargs['batch_norm'] = True
+    return get_vgg(13, **kwargs)
+
+
+def vgg16_bn(**kwargs):
+    kwargs['batch_norm'] = True
+    return get_vgg(16, **kwargs)
+
+
+def vgg19_bn(**kwargs):
+    kwargs['batch_norm'] = True
+    return get_vgg(19, **kwargs)

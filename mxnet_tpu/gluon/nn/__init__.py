@@ -5,7 +5,7 @@ from .activations import (  # noqa: F401
 from .basic_layers import (  # noqa: F401
     Sequential, HybridSequential, Dense, Dropout, BatchNorm, SyncBatchNorm,
     LayerNorm, GroupNorm, InstanceNorm, Embedding, Flatten, Identity, Lambda,
-    HybridLambda,
+    HybridLambda, Concurrent, HybridConcurrent,
 )
 from .conv_layers import (  # noqa: F401
     Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,

@@ -347,6 +347,32 @@ class Identity(HybridBlock):
         return x
 
 
+class Concurrent(Sequential):
+    """Run children on the same input, concat outputs on ``axis``
+    (parity: gluon/contrib/nn Concurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from ... import ndarray as F
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (parity: gluon/contrib/nn HybridConcurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
 class Lambda(Block):
     """Wrap an nd-level function (parity: nn.Lambda)."""
 

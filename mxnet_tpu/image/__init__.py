@@ -1,0 +1,10 @@
+"""Image API (parity: ``python/mxnet/image/``)."""
+from .image import (  # noqa: F401
+    imdecode, imread, imresize, resize_short, fixed_crop, center_crop,
+    random_crop, random_size_crop, color_normalize, scale_down,
+    Augmenter, SequentialAug, RandomOrderAug, ResizeAug, ForceResizeAug,
+    RandomCropAug, RandomSizedCropAug, CenterCropAug, BrightnessJitterAug,
+    ContrastJitterAug, SaturationJitterAug, HueJitterAug, ColorJitterAug,
+    LightingAug, ColorNormalizeAug, RandomGrayAug, HorizontalFlipAug,
+    CastAug, CreateAugmenter, ImageIter,
+)

@@ -1,0 +1,553 @@
+"""Image read/augment utilities.
+
+Capability parity with the reference's ``python/mxnet/image/image.py``
+(imdecode, imresize, crops, color_normalize, the ``Augmenter`` class
+hierarchy, ``CreateAugmenter:1089``, ``ImageIter:1178``) whose heavy ops run
+through OpenCV on GPU-adjacent hosts.  TPU-native stance: augmentation is
+host-side NumPy feeding the device pipeline (the TPU never decodes JPEGs);
+arrays are HWC uint8/float32 like the reference.
+"""
+from __future__ import annotations
+
+import os
+import random as pyrandom
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+from .. import io as _io
+from .. import recordio
+
+
+def _to_np(img):
+    return img.asnumpy() if isinstance(img, NDArray) else np.asarray(img)
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an image byte buffer → HWC NDArray (parity: image.py:imdecode).
+
+    Accepts .npy payloads natively; JPEG/PNG via PIL when importable.
+    """
+    arr = recordio._decode_image_bytes(bytes(buf))
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if flag == 0 and arr.shape[2] == 3:
+        arr = arr.mean(axis=2, keepdims=True).astype(arr.dtype)
+    return nd.array(arr)
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """Read an image file (parity: image.py:imread)."""
+    if filename.endswith('.npy'):
+        arr = np.load(filename)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return nd.array(arr)
+    with open(filename, 'rb') as f:
+        return imdecode(f.read(), flag, to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    """Resize HWC image to (h, w) (parity: image.py:imresize).
+
+    Nearest/bilinear on host NumPy (interp 0/1; other codes fall back to
+    bilinear — OpenCV's exotic modes are out of scope).
+    """
+    arr = _to_np(src).astype(np.float32)
+    ih, iw = arr.shape[:2]
+    if interp == 0:
+        yy = np.clip((np.arange(h) * ih / float(h)).astype(int), 0, ih - 1)
+        xx = np.clip((np.arange(w) * iw / float(w)).astype(int), 0, iw - 1)
+        out = arr[yy][:, xx]
+    else:
+        y = (np.arange(h) + 0.5) * ih / float(h) - 0.5
+        x = (np.arange(w) + 0.5) * iw / float(w) - 0.5
+        y0 = np.clip(np.floor(y).astype(int), 0, ih - 1)
+        x0 = np.clip(np.floor(x).astype(int), 0, iw - 1)
+        y1 = np.clip(y0 + 1, 0, ih - 1)
+        x1 = np.clip(x0 + 1, 0, iw - 1)
+        wy = np.clip(y - y0, 0, 1)[:, None, None]
+        wx = np.clip(x - x0, 0, 1)[None, :, None]
+        out = (arr[y0][:, x0] * (1 - wy) * (1 - wx) +
+               arr[y1][:, x0] * wy * (1 - wx) +
+               arr[y0][:, x1] * (1 - wy) * wx +
+               arr[y1][:, x1] * wy * wx)
+    if _to_np(src).dtype == np.uint8:
+        out = np.clip(np.rint(out), 0, 255).astype(np.uint8)
+    return nd.array(out)
+
+
+def scale_down(src_size, size):
+    """Scale size down to fit in src_size (parity: image.py:scale_down)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the shorter edge is ``size`` (parity: image.py:resize_short)."""
+    h, w = _to_np(src).shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp=interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = _to_np(src)[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        return imresize(nd.array(out), size[0], size[1], interp=interp)
+    return nd.array(out)
+
+
+def random_crop(src, size, interp=2):
+    h, w = _to_np(src).shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    h, w = _to_np(src).shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    h, w = _to_np(src).shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = pyrandom.uniform(area[0], area[1]) * src_area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        new_ratio = np.exp(pyrandom.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * new_ratio)))
+        new_h = int(round(np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = pyrandom.randint(0, w - new_w)
+            y0 = pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    src = src if isinstance(src, NDArray) else nd.array(src)
+    src = nd.cast(src, 'float32')
+    mean = mean if isinstance(mean, NDArray) or mean is None \
+        else nd.array(np.asarray(mean, dtype=np.float32))
+    std = std if isinstance(std, NDArray) or std is None \
+        else nd.array(np.asarray(std, dtype=np.float32))
+    if mean is not None:
+        src = src - mean
+    if std is not None:
+        src = src / std
+    return src
+
+
+# ---------------------------------------------------------------------------
+# Augmenters (parity: image.py Augmenter:662 hierarchy)
+# ---------------------------------------------------------------------------
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for aug in self.ts:
+            src = aug(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        pyrandom.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size = size
+        self.area = area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        return nd.cast(src, 'float32') * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        arr = _to_np(src).astype(np.float32)
+        gray = (arr * self.coef).sum() * 3.0 / arr.size
+        return nd.array(arr * alpha + gray * (1.0 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        arr = _to_np(src).astype(np.float32)
+        gray = (arr * self.coef).sum(axis=2, keepdims=True)
+        return nd.array(arr * alpha + gray * (1.0 - alpha))
+
+
+class HueJitterAug(Augmenter):
+    tyiq = np.array([[0.299, 0.587, 0.114],
+                     [0.596, -0.274, -0.321],
+                     [0.211, -0.523, 0.311]], dtype=np.float32)
+    ityiq = np.array([[1.0, 0.956, 0.621],
+                      [1.0, -0.272, -0.647],
+                      [1.0, -1.107, 1.705]], dtype=np.float32)
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        alpha = pyrandom.uniform(-self.hue, self.hue)
+        u = np.cos(alpha * np.pi)
+        w = np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
+                      dtype=np.float32)
+        t = self.ityiq @ bt @ self.tyiq
+        arr = _to_np(src).astype(np.float32)
+        return nd.array(arr @ t.T)
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, dtype=np.float32)
+        self.eigvec = np.asarray(eigvec, dtype=np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,)).astype(
+            np.float32)
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return nd.cast(src, 'float32') + nd.array(
+            rgb.reshape(1, 1, 3).astype(np.float32))
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = mean
+        self.std = std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class RandomGrayAug(Augmenter):
+    mat = np.array([[0.21, 0.21, 0.21],
+                    [0.72, 0.72, 0.72],
+                    [0.07, 0.07, 0.07]], dtype=np.float32)
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            arr = _to_np(src).astype(np.float32)
+            return nd.array(arr @ self.mat)
+        return src if isinstance(src, NDArray) else nd.array(src)
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            return nd.flip(src if isinstance(src, NDArray)
+                           else nd.array(src), axis=1)
+        return src if isinstance(src, NDArray) else nd.array(src)
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ='float32'):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return nd.cast(src if isinstance(src, NDArray) else nd.array(src),
+                       self.typ)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Standard augmenter list (parity: image.py CreateAugmenter:1089)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    elif mean is not None:
+        mean = np.asarray(mean)
+        assert mean.shape[0] in [1, 3]
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    elif std is not None:
+        std = np.asarray(std)
+        assert std.shape[0] in [1, 3]
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(_io.DataIter):
+    """Image iterator over .rec or .lst+dir with augmenters
+    (parity: image.py ImageIter:1178)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 shuffle=False, aug_list=None, imglist=None,
+                 data_name='data', label_name='softmax_label', **kwargs):
+        super().__init__(batch_size)
+        assert path_imgrec or path_imglist or imglist is not None
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape, **{
+                k: v for k, v in kwargs.items()
+                if k in ('resize', 'rand_crop', 'rand_resize',
+                         'rand_mirror', 'mean', 'std', 'brightness',
+                         'contrast', 'saturation', 'hue', 'pca_noise',
+                         'rand_gray', 'inter_method')})
+        self.imgrec = None
+        self.seq = None
+        self.imglist = None
+        self.path_root = path_root
+        if path_imgrec:
+            idx_path = os.path.splitext(path_imgrec)[0] + '.idx'
+            if os.path.exists(idx_path):
+                self.imgrec = recordio.MXIndexedRecordIO(
+                    idx_path, path_imgrec, 'r')
+                self.seq = list(self.imgrec.keys)
+            else:
+                records = list(recordio.RecordIOIterable(path_imgrec))
+                self.imglist = {
+                    i: recordio.unpack(r) for i, r in enumerate(records)}
+                self.seq = list(range(len(records)))
+        elif path_imglist:
+            self.imglist = {}
+            with open(path_imglist) as fin:
+                for line in fin:
+                    parts = line.strip().split('\t')
+                    label = np.array(
+                        [float(x) for x in parts[1:-1]], dtype=np.float32)
+                    self.imglist[int(parts[0])] = (
+                        recordio.IRHeader(0, label if len(label) > 1
+                                          else float(label[0]),
+                                          int(parts[0]), 0),
+                        parts[-1])
+            self.seq = sorted(self.imglist)
+        else:
+            self.imglist = {}
+            for i, (label, fname) in enumerate(imglist):
+                self.imglist[i] = (
+                    recordio.IRHeader(0, label, i, 0), fname)
+            self.seq = list(range(len(imglist)))
+        self.cur = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [_io.DataDesc('data', (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [_io.DataDesc('softmax_label', shape)]
+
+    def reset(self):
+        if self.shuffle:
+            pyrandom.shuffle(self.seq)
+        self.cur = 0
+
+    def next_sample(self):
+        if self.cur >= len(self.seq):
+            raise StopIteration
+        idx = self.seq[self.cur]
+        self.cur += 1
+        if self.imgrec is not None:
+            header, img = recordio.unpack(self.imgrec.read_idx(idx))
+            return header.label, img
+        header, payload = self.imglist[idx]
+        if isinstance(payload, str):
+            path = payload if self.path_root is None else \
+                os.path.join(self.path_root, payload)
+            return header.label, imread(path)
+        return header.label, payload
+
+    def next(self):
+        c, h, w = self.data_shape
+        batch_data = np.zeros((self.batch_size, c, h, w), dtype=np.float32)
+        batch_label = np.zeros((self.batch_size, self.label_width),
+                               dtype=np.float32)
+        i = 0
+        try:
+            while i < self.batch_size:
+                label, img = self.next_sample()
+                if isinstance(img, (bytes, bytearray)):
+                    img = imdecode(img)
+                elif not isinstance(img, NDArray):
+                    img = nd.array(np.asarray(img))
+                for aug in self.auglist:
+                    img = aug(img)
+                arr = _to_np(img).astype(np.float32)
+                if arr.shape[:2] != (h, w):
+                    arr = _to_np(imresize(nd.array(arr), w, h))
+                batch_data[i] = arr.transpose(2, 0, 1)
+                batch_label[i] = np.atleast_1d(
+                    np.asarray(label, dtype=np.float32))[:self.label_width]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        label_out = batch_label[:, 0] if self.label_width == 1 \
+            else batch_label
+        return _io.DataBatch(
+            data=[nd.array(batch_data)], label=[nd.array(label_out)],
+            pad=self.batch_size - i)

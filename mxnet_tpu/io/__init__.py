@@ -1,0 +1,5 @@
+"""Data iterators (parity: ``python/mxnet/io/`` + ``src/io/``)."""
+from .io import (  # noqa: F401
+    DataDesc, DataBatch, DataIter, ResizeIter, PrefetchingIter,
+    NDArrayIter, CSVIter, MNISTIter, ImageRecordIter,
+)

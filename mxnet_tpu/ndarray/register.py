@@ -24,7 +24,8 @@ def make_op_func(op_name):
             attrs = kwargs
             fields = None
         else:
-            named = list(zip(reg.input_names, args))
+            n_in = len(reg.input_names)
+            named = list(zip(reg.input_names, args[:n_in]))
             inputs = [a for _, a in named if a is not None]
             fields = [f for f, a in named if a is not None]
             for nm in reg.input_names[len(inputs):]:
@@ -32,6 +33,20 @@ def make_op_func(op_name):
                     inputs.append(kwargs.pop(nm))
                     fields.append(nm)
             attrs = kwargs
+            # excess positional args are attrs, in signature order
+            # (e.g. transpose(x, (2, 0, 1)))
+            extra = args[n_in:]
+            if len(extra) > len(reg.attr_names):
+                raise TypeError(
+                    "%s takes at most %d positional arguments (%d given)"
+                    % (op_name, n_in + len(reg.attr_names),
+                       len(args)))
+            for nm, val in zip(reg.attr_names, extra):
+                if nm in attrs:
+                    raise TypeError(
+                        "%s got multiple values for argument %r"
+                        % (op_name, nm))
+                attrs[nm] = val
         return _reg.invoke(op_name, inputs, attrs, out=out,
                            fields=tuple(fields) if fields is not None else None)
 

@@ -162,12 +162,16 @@ def _pooling(data, kernel=(), pool_type="max", stride=(), pad=(), global_pool=Fa
             if rem:
                 hi += stride[i] - rem
         pads[ax] = (lo, hi)
+    # NOTE: init values must be Python scalars — an array init stops jax
+    # from lowering to the reduce_window_max/add primitives that carry the
+    # autodiff rules ("Linearization failed..." under vjp-of-jit).
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
-        return lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max,
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \
+            else int(jnp.iinfo(data.dtype).min)
+        return lax.reduce_window(data, init, lax.max,
                                  window, strides, pads)
     if pool_type in ("avg", "sum"):
-        summed = lax.reduce_window(data, jnp.asarray(0, data.dtype), lax.add,
+        summed = lax.reduce_window(data, 0., lax.add,
                                    window, strides, pads)
         if pool_type == "sum":
             return summed
@@ -177,11 +181,11 @@ def _pooling(data, kernel=(), pool_type="max", stride=(), pad=(), global_pool=Fa
                 denom *= kernel[i]
             return summed / jnp.asarray(denom, data.dtype)
         ones = jnp.ones(data.shape, data.dtype)
-        counts = lax.reduce_window(ones, jnp.asarray(0, data.dtype), lax.add,
+        counts = lax.reduce_window(ones, 0., lax.add,
                                    window, strides, pads)
         return summed / counts
     if pool_type == "lp":
-        p2 = lax.reduce_window(jnp.abs(data) ** 2, jnp.asarray(0, data.dtype),
+        p2 = lax.reduce_window(jnp.abs(data) ** 2, 0.,
                                lax.add, window, strides, pads)
         return jnp.sqrt(p2)
     raise ValueError("unknown pool_type %r" % pool_type)

@@ -37,7 +37,7 @@ _ALIASES = {}
 
 class OpReg:
     __slots__ = ("name", "forward", "needs_rng", "needs_mode", "num_outputs",
-                 "doc", "input_names", "variadic")
+                 "doc", "input_names", "variadic", "attr_names")
 
     def __init__(self, name, forward, needs_rng=False, needs_mode=False,
                  num_outputs=1, inputs=None):
@@ -48,6 +48,19 @@ class OpReg:
         self.num_outputs = num_outputs
         self.doc = forward.__doc__ or ""
         self.input_names, self.variadic = self._infer_inputs(forward, inputs)
+        self.attr_names = self._infer_attrs(forward)
+
+    def _infer_attrs(self, fn):
+        """Ordered non-tensor parameter names (for positional attr args)."""
+        try:
+            sig = inspect.signature(fn)
+        except (TypeError, ValueError):
+            return ()
+        names = [p.name for p in sig.parameters.values()
+                 if p.kind in (inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                               inspect.Parameter.KEYWORD_ONLY)]
+        return tuple(n for n in names
+                     if n != "key" and n not in self.input_names)
 
     def _infer_inputs(self, fn, explicit):
         """Ordered tensor-parameter names.  Default: leading params without

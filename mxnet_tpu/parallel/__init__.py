@@ -1,0 +1,20 @@
+"""Parallel training on device meshes.
+
+This package is the TPU-native answer to three reference subsystems at once
+(SURVEY.md §2.4):
+
+* ``DataParallelExecutorGroup`` (``python/mxnet/module/executor_group.py:144``)
+  — batch slicing across devices → here: a sharded batch axis on a
+  ``jax.sharding.Mesh``, XLA inserting the gradient all-reduce over ICI.
+* KVStore ``device``/``nccl`` gradient aggregation (``src/kvstore/comm.h:451``)
+  — collectives are *compiled into the train-step executable* instead of
+  being scheduled as separate engine ops.
+* ``ctx_group`` manual model parallelism (``AssignContext``,
+  ``src/executor/graph_executor.cc:1043``) — generalized to tensor/pipeline
+  sharding rules over named mesh axes.
+"""
+from .mesh import (  # noqa: F401
+    make_mesh, current_mesh, data_sharding, replicated, shard_params,
+    MeshScope,
+)
+from .train_step import JitTrainStep  # noqa: F401

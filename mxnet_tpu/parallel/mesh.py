@@ -1,0 +1,84 @@
+"""Device-mesh helpers.
+
+The mesh is the TPU-native replacement for the reference's device lists
+(``ctx=[mx.gpu(0), mx.gpu(1), ...]`` in ``Module.bind`` /
+``Trainer``): axes are named (``data``, ``model``, ``pipe``, ``seq``,
+``expert``) and shardings are expressed as ``PartitionSpec`` over those
+names; XLA lowers them to ICI/DCN collectives (scaling-book recipe).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_state = threading.local()
+
+
+def make_mesh(axes=None, devices=None):
+    """Create a named Mesh.
+
+    ``axes``: dict name->size (-1 once for 'remaining devices'), or None
+    for a 1-axis data mesh over all devices.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if axes is None:
+        axes = {"data": n}
+    names = list(axes)
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total > n:
+        raise ValueError(
+            "mesh %s needs %d devices, have %d" % (axes, total, n))
+    arr = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def current_mesh():
+    return getattr(_state, "mesh", None)
+
+
+class MeshScope:
+    """``with MeshScope(mesh):`` — sets the ambient mesh for Trainer/KVStore."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        self._prev = getattr(_state, "mesh", None)
+        _state.mesh = self.mesh
+        return self.mesh
+
+    def __exit__(self, *a):
+        _state.mesh = self._prev
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+def data_sharding(mesh, axis="data", ndim=1):
+    """Shard dim 0 (batch) over ``axis``, replicate the rest."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def shard_params(mesh, params, rule=None):
+    """Device_put parameter arrays with shardings from ``rule``.
+
+    ``rule(name, shape) -> PartitionSpec`` (None → replicate).  This is the
+    entry point for tensor parallelism: e.g. megatron-style rules return
+    ``P(None, 'model')`` for up-projections.
+    """
+    out = {}
+    for name, arr in params.items():
+        spec = rule(name, arr.shape) if rule is not None else None
+        sh = NamedSharding(mesh, spec if spec is not None else P())
+        out[name] = jax.device_put(arr, sh)
+    return out

@@ -1,0 +1,218 @@
+"""JitTrainStep — the whole training step as ONE XLA executable.
+
+This is the training-side completion of the ``CachedOp`` mapping
+(SURVEY.md §3.3): where the reference runs forward (CachedOp), backward
+(``CachedOp::Backward``, ``src/imperative/cached_op.cc:1254``) and the
+optimizer (``optimizer_op.cc`` fused kernels, pushed per-parameter through
+the engine) as hundreds of engine ops, here the gluon net's imperative
+forward is traced once, ``jax.value_and_grad`` builds the backward, the
+optimizer's pure ``_step`` updates every parameter, and XLA compiles the
+lot into a single executable with donated parameter buffers (zero-copy
+"mutation", the aliasing discipline from SURVEY §7 hard-part 1).
+
+Distributed: given a ``Mesh``, parameters/optimizer state are placed with
+``shard_params`` rules and the batch is sharded on its ``data`` axis; the
+gradient all-reduce over ICI is inserted by XLA (GSPMD) *inside* the same
+executable — the compiled equivalent of KVStore device mode.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .. import ndarray as nd
+from .. import random as _random
+from .. import autograd as _autograd
+from .. import optimizer as _opt_mod
+from ..gluon import block as _block_mod
+
+
+class JitTrainStep:
+    """Compile net+loss+optimizer into one donated-buffer train step.
+
+    Parameters
+    ----------
+    net : HybridBlock (initialized)
+    loss : gluon loss Block, or None (net's first output IS the loss)
+    optimizer : str or Optimizer
+    optimizer_params : dict, for the str form
+    mesh : jax.sharding.Mesh or None
+    data_axis : mesh axis name carrying the batch dimension
+    param_rule : fn(param_name, shape) -> PartitionSpec or None
+        tensor-parallel sharding rule; None replicates parameters.
+    """
+
+    def __init__(self, net, loss=None, optimizer='sgd',
+                 optimizer_params=None, mesh=None, data_axis='data',
+                 param_rule=None, donate=True):
+        self._net = net
+        self._loss = loss
+        if isinstance(optimizer, str):
+            optimizer = _opt_mod.create(optimizer,
+                                        **(optimizer_params or {}))
+        self._opt = optimizer
+        self._mesh = mesh
+        self._data_axis = data_axis
+        self._param_rule = param_rule
+        self._params = None
+        self._t = 0
+        self._step_fn = None
+        self._n_outputs = 1
+        self._last_loss = None
+
+    def _ensure_init(self, batch_nd):
+        """Snapshot parameters; resolves deferred shapes with one forward."""
+        if self._params is not None:
+            return
+        n_label = 1 if self._loss is not None else 0
+        n_data = len(batch_nd) - n_label
+        weights_ok = all(
+            p._data is not None
+            for p in self._net.collect_params().values())
+        if not weights_ok:
+            # a single throwaway forward resolves every deferred shape
+            self._net(*batch_nd[:n_data])
+        self._params = list(self._net.collect_params().values())
+        for p in self._params:
+            p._check_initialized()
+        self._train_idx = [i for i, p in enumerate(self._params)
+                           if p.grad_req != 'null']
+        self._train_set = set(self._train_idx)
+        # device copies of weights/state live here between steps
+        self._weights = [p.data().data() for p in self._params]
+        self._opt_state = [
+            self._opt.create_state(i, self._weights[i])
+            if i in self._train_set else None
+            for i in range(len(self._params))]
+        if self._mesh is not None:
+            self._place_on_mesh(self._param_rule)
+
+    # -- mesh placement ----------------------------------------------------
+    def _place_on_mesh(self, param_rule):
+        mesh = self._mesh
+        def spec_for(p):
+            s = param_rule(p.name, tuple(p.shape)) if param_rule else None
+            return s if s is not None else P()
+        self._param_shardings = [
+            NamedSharding(mesh, spec_for(p)) for p in self._params]
+        self._weights = [
+            jax.device_put(w, s)
+            for w, s in zip(self._weights, self._param_shardings)]
+        self._opt_state = [
+            None if st is None else jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, sh), st)
+            for st, sh in zip(self._opt_state, self._param_shardings)]
+
+    def _batch_sharding(self, arr):
+        return NamedSharding(
+            self._mesh, P(self._data_axis, *([None] * (arr.ndim - 1))))
+
+    # -- the pure step ----------------------------------------------------
+    def _build(self, batch_arrays):
+        net, loss_block = self._net, self._loss
+        params = self._params
+        train_idx = list(self._train_idx)
+        opt = self._opt
+        n_label = 1 if loss_block is not None else 0
+        n_data = len(batch_arrays) - n_label
+        meta = {}
+
+        def forward_loss(train_ws, all_ws, batch):
+            st = _block_mod._trace_st()
+            prev = (st.param_map, st.aux_updates, st.active)
+            ws = list(all_ws)
+            for i, w in zip(train_idx, train_ws):
+                ws[i] = w
+            st.param_map = {
+                id(p): NDArray(w) for p, w in zip(params, ws)}
+            st.aux_updates = []
+            st.active = True
+            try:
+                data_nd = [NDArray(b) for b in batch[:n_data]]
+                # train mode (not recording): BN/dropout use batch stats;
+                # the grad comes from jax.value_and_grad, not the tape
+                with _autograd.train_mode():
+                    out = net._forward_imperative(*data_nd)
+                    outs = [out] if isinstance(out, NDArray) else list(out)
+                    if loss_block is not None:
+                        label_nd = [NDArray(b) for b in batch[n_data:]]
+                        loss = loss_block(outs[0], *label_nd)
+                    else:
+                        loss = outs[0]
+                loss_val = jnp.mean(loss.data())
+                idx_of = {id(p): i for i, p in enumerate(params)}
+                aux = [(idx_of[id(p)], v) for p, v in st.aux_updates]
+                meta['n_outputs'] = len(outs)
+                return loss_val, aux
+            finally:
+                st.param_map, st.aux_updates, st.active = prev
+
+        def step(key, lr, weights, opt_state, t, *batch):
+            with _random.trace_key_scope(key):
+                train_ws = [weights[i] for i in train_idx]
+                (loss_val, aux), grads = jax.value_and_grad(
+                    forward_loss, has_aux=True)(train_ws, weights, batch)
+            new_weights = list(weights)
+            new_state = list(opt_state)
+            for j, i in enumerate(train_idx):
+                g = grads[j]
+                w, st_i = weights[i], opt_state[i]
+                wd = opt._get_wd(i)
+                lr_i = lr * opt.lr_mult.get(
+                    params[i].name, opt.lr_mult.get(i, 1.0))
+                # _step applies clip/rescale itself (see Optimizer._step
+                # implementations)
+                nw, ns = opt._step(w, g, st_i, lr_i, wd, t)
+                new_weights[i] = nw
+                new_state[i] = ns
+            for i, v in aux:
+                new_weights[i] = v
+            return new_weights, new_state, loss_val
+
+        jit_kwargs = {}
+        if self._mesh is not None:
+            out_sh = (
+                self._param_shardings,
+                [None if st is None else jax.tree_util.tree_map(
+                    lambda _, s=sh: s, st)
+                 for st, sh in zip(self._opt_state,
+                                   self._param_shardings)],
+                NamedSharding(self._mesh, P()))
+            jit_kwargs['out_shardings'] = out_sh
+        return jax.jit(step,
+                       donate_argnums=(2, 3),
+                       **jit_kwargs)
+
+    # -- public API --------------------------------------------------------
+    def step(self, *batch):
+        """Run one train step; returns the (device, async) scalar loss."""
+        batch_nd = [b if isinstance(b, NDArray) else nd.array(b)
+                    for b in batch]
+        self._ensure_init(batch_nd)
+        arrays = [b.data() for b in batch_nd]
+        if self._mesh is not None:
+            arrays = [jax.device_put(a, self._batch_sharding(a))
+                      for a in arrays]
+        if self._step_fn is None:
+            self._step_fn = self._build(arrays)
+        self._t += 1
+        self._opt.num_update = self._t
+        self._weights, self._opt_state, loss = self._step_fn(
+            _random.next_key(),
+            jnp.asarray(self._opt.learning_rate, jnp.float32),
+            self._weights, self._opt_state,
+            jnp.asarray(self._t, jnp.int32), *arrays)
+        self._last_loss = loss
+        return loss
+
+    def sync_params(self):
+        """Write the jitted weights back into the gluon Parameters."""
+        for p, w in zip(self._params, self._weights):
+            p.set_data(w)
+
+    @property
+    def loss(self):
+        return None if self._last_loss is None else float(self._last_loss)

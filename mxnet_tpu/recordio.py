@@ -1,0 +1,284 @@
+"""RecordIO: sequential and indexed record files.
+
+Capability parity with the reference's ``python/mxnet/recordio.py``
+(``MXRecordIO``, ``MXIndexedRecordIO``, ``IRHeader``/``pack``/``unpack``/
+``pack_img``/``unpack_img``) and the dmlc-core on-disk format it wraps:
+each record is ``[magic:u32][lrec:u32][data][pad to 4B]`` where the top 3
+bits of ``lrec`` encode a continuation flag for records > 512MB (we write
+only single-part records, but read multi-part ones).
+
+Pure-Python host-side IO — record packing feeds the input pipeline which
+runs on CPU regardless of backend, so there is no device-specific code here.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import numbers
+from collections import namedtuple
+
+import numpy as np
+
+from .base import MXNetError
+
+_MAGIC = 0xced7230a
+_LREC_KIND_BITS = 29
+_LREC_MASK = (1 << _LREC_KIND_BITS) - 1
+
+
+def _encode_lrec(kind, length):
+    return (kind << _LREC_KIND_BITS) | length
+
+
+class MXRecordIO:
+    """Sequential RecordIO reader/writer (parity: recordio.py:36)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.pid = None
+        self.record = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.record = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.record = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.pid = os.getpid()
+        self.is_open = True
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        """Override pickling behavior (DataLoader workers fork/pickle us)."""
+        is_open = self.is_open
+        self.close()
+        d = dict(self.__dict__)
+        d['is_open'] = is_open
+        d.pop('record', None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        is_open = d['is_open']
+        self.is_open = False
+        self.record = None
+        if is_open:
+            self.open()
+
+    def _check_pid(self, allow_reset=False):
+        # after fork the file offset is shared with the parent: reopen
+        if self.pid != os.getpid():
+            if allow_reset:
+                self.reset()
+            else:
+                raise MXNetError(
+                    "RecordIO handle inherited across fork; call reset()")
+
+    def close(self):
+        if not self.is_open:
+            return
+        self.record.close()
+        self.is_open = False
+        self.pid = None
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        assert self.writable
+        self._check_pid(allow_reset=False)
+        data = bytes(buf)
+        header = struct.pack('<II', _MAGIC, _encode_lrec(0, len(data)))
+        self.record.write(header)
+        self.record.write(data)
+        pad = (4 - (len(data) % 4)) % 4
+        if pad:
+            self.record.write(b'\x00' * pad)
+
+    def tell(self):
+        assert self.writable
+        return self.record.tell()
+
+    def read(self):
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        parts = []
+        while True:
+            header = self.record.read(8)
+            if len(header) < 8:
+                return b''.join(parts) if parts else None
+            magic, lrec = struct.unpack('<II', header)
+            if magic != _MAGIC:
+                raise MXNetError("Invalid RecordIO magic in %s" % self.uri)
+            kind = lrec >> _LREC_KIND_BITS
+            length = lrec & _LREC_MASK
+            data = self.record.read(length)
+            pad = (4 - (length % 4)) % 4
+            if pad:
+                self.record.read(pad)
+            parts.append(data)
+            # kind: 0 = whole record, 1 = first part, 2 = middle, 3 = last
+            if kind in (0, 3):
+                return b''.join(parts)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access RecordIO with a .idx sidecar (parity: recordio.py:161)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        self.fidx = open(self.idx_path, self.flag)
+        if not self.writable:
+            for line in iter(self.fidx.readline, ''):
+                line = line.strip().split('\t')
+                key = self.key_type(line[0])
+                self.idx[key] = int(line[1])
+                self.keys.append(key)
+
+    def close(self):
+        if not self.is_open:
+            return
+        super().close()
+        if self.fidx is not None:
+            self.fidx.close()
+        self.fidx = None
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        d.pop('fidx', None)
+        return d
+
+    def seek(self, idx):
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        pos = self.idx[idx]
+        self.record.seek(pos)
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write('%s\t%d\n' % (str(key), pos))
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+class RecordIOIterable:
+    """Iterate all records of a RecordIO file (used by ImageRecordIter)."""
+
+    def __init__(self, uri):
+        self.uri = uri
+
+    def __iter__(self):
+        rec = MXRecordIO(self.uri, 'r')
+        try:
+            while True:
+                item = rec.read()
+                if item is None:
+                    return
+                yield item
+        finally:
+            rec.close()
+
+
+# -- image record packing (parity: recordio.py IRHeader/pack/unpack) --------
+IRHeader = namedtuple('HEADER', ['flag', 'label', 'id', 'id2'])
+_IR_FORMAT = 'IfQQ'
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack a header + raw bytes into one record payload."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        header = header._replace(flag=0)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        s = label.tobytes() + s
+    s = struct.pack(_IR_FORMAT, *header) + s
+    return s
+
+
+def unpack(s):
+    """Unpack a record payload into (IRHeader, raw bytes)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack a record into (IRHeader, decoded image ndarray HWC).
+
+    Decodes raw-ndarray payloads natively; JPEG/PNG payloads require an
+    image codec which is not bundled (no OpenCV in image) — those raise.
+    """
+    header, s = unpack(s)
+    img = _decode_image_bytes(s)
+    return header, img
+
+
+def _decode_image_bytes(s):
+    # npy payload (our pack_img writes this) — portable, codec-free
+    if s[:6] == b'\x93NUMPY':
+        import io as _io
+        return np.load(_io.BytesIO(s), allow_pickle=False)
+    try:
+        from PIL import Image  # optional, if present in the image
+        import io as _io
+        return np.asarray(Image.open(_io.BytesIO(s)))
+    except ImportError:
+        raise MXNetError(
+            "Compressed image payloads need an image codec (PIL); "
+            "re-pack with pack_img(..., quality=0) for raw npy payloads")
+
+
+def pack_img(header, img, quality=95, img_fmt='.npy'):
+    """Pack a header + image array. Default payload is lossless .npy
+    (codec-free); '.jpg'/'.png' used when PIL is available."""
+    img = np.asarray(img)
+    if img_fmt in ('.npy', None) or quality == 0:
+        import io as _io
+        buf = _io.BytesIO()
+        np.save(buf, img, allow_pickle=False)
+        return pack(header, buf.getvalue())
+    try:
+        from PIL import Image
+        import io as _io
+        buf = _io.BytesIO()
+        Image.fromarray(img.astype(np.uint8)).save(
+            buf, format='JPEG' if img_fmt == '.jpg' else 'PNG',
+            quality=quality)
+        return pack(header, buf.getvalue())
+    except ImportError:
+        import io as _io
+        buf = _io.BytesIO()
+        np.save(buf, img, allow_pickle=False)
+        return pack(header, buf.getvalue())

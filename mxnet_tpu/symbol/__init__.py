@@ -1,0 +1,13 @@
+"""Symbolic graph API (parity: ``python/mxnet/symbol/``)."""
+from .symbol import (  # noqa: F401
+    Symbol, var, Variable, Group, load, load_json, zeros, ones, arange,
+)
+from .executor import Executor  # noqa: F401
+from . import symbol as _symbol_mod
+from ..ops import registry as _reg
+
+# install every registered op as a symbol-building function (the symbol
+# analogue of mx.nd codegen-at-import, reference register.py:116-264)
+for _name in _reg.list_ops():
+    globals().setdefault(_name, _symbol_mod.make_symbol_op(_name))
+del _name

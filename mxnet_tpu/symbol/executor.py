@@ -1,0 +1,210 @@
+"""Executor: a bound Symbol compiled to XLA executables.
+
+Capability parity with the reference executor
+(``include/mxnet/executor.h:143``, ``GraphExecutor``,
+``src/executor/graph_executor.cc:393``): holds argument/gradient/aux
+arrays, ``forward(is_train)``, ``backward(out_grads)``, shared-memory
+``reshape``, monitor callback.
+
+TPU-native mechanism: ONE jitted callable for forward
+(args, auxs, key) → (outputs, new_auxs) per mode, and one for
+forward+vjp when training — replacing the reference's per-node engine op
+chain (``InitCachedOps``, ``graph_executor.cc:1220``) and memory plan
+(``MXPlanMemory``; XLA's buffer assignment does this).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .. import ndarray as nd
+from .. import random as _random
+from ..context import current_context
+from ..ops import registry as _reg
+
+
+class Executor:
+    """Parity: mxnet.executor.Executor (python/mxnet/executor.py)."""
+
+    def __init__(self, symbol, ctx, args, auxs, grad_req="write",
+                 args_grad=None):
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.output_names = symbol.list_outputs()
+        missing = [n for n in self.arg_names if n not in args]
+        if missing:
+            raise MXNetError("bind: missing arguments %s" % missing)
+        self.arg_dict = {n: args[n] for n in self.arg_names}
+        self.aux_dict = {n: auxs[n] for n in self.aux_names}
+        self.arg_arrays = [self.arg_dict[n] for n in self.arg_names]
+        self.aux_arrays = [self.aux_dict[n] for n in self.aux_names]
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in self.arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(self.arg_names, grad_req))
+        else:
+            self._grad_req = {n: grad_req.get(n, "null")
+                              for n in self.arg_names}
+        if args_grad is None:
+            self.grad_dict = {
+                n: nd.zeros(self.arg_dict[n].shape)
+                for n in self.arg_names
+                if self._grad_req.get(n, "null") != "null"}
+        elif isinstance(args_grad, (list, tuple)):
+            self.grad_dict = dict(zip(self.arg_names, args_grad))
+        else:
+            self.grad_dict = dict(args_grad)
+        self.grad_arrays = [self.grad_dict.get(n) for n in self.arg_names]
+        self.outputs = []
+        self._fns = {}
+        self._vjp = None
+        self._monitor = None
+        self._aux_update_names = [
+            n for n, _ in symbol._aux_update_entries()]
+        self._grad_input_names = [
+            n for n in self.arg_names
+            if self._grad_req.get(n, "null") != "null"]
+
+    # -- compiled callables -------------------------------------------------
+    def _extended_symbol(self):
+        """Symbol whose outputs are (user outputs) + (updated aux values)."""
+        from .symbol import Symbol
+
+        aux_entries = self._symbol._aux_update_entries()
+        return Symbol(self._symbol._outputs + [e for _, e in aux_entries])
+
+    def _get_fn(self, mode):
+        fn = self._fns.get(mode)
+        if fn is None:
+            ext = self._extended_symbol()
+            input_names = ext.list_inputs()
+            raw = ext._make_fn(input_names, mode=mode)
+
+            def run(key, args, auxs):
+                with _random.trace_key_scope(key):
+                    bindings = {}
+                    bindings.update(args)
+                    bindings.update(auxs)
+                    return raw(bindings)
+
+            fn = jax.jit(run)
+            self._fns[mode] = fn
+        return fn
+
+    def _get_train_fn(self):
+        fn = self._fns.get("train_grad")
+        if fn is None:
+            ext = self._extended_symbol()
+            raw = ext._make_fn(ext.list_inputs(), mode="train")
+
+            def run(key, grad_args, other_args, auxs):
+                with _random.trace_key_scope(key):
+                    bindings = dict(other_args)
+                    bindings.update(auxs)
+                    bindings.update(grad_args)
+                    return raw(bindings)
+
+            fn = run
+            self._fns["train_grad"] = fn
+        return fn
+
+    # -- API ---------------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown argument %r" % k)
+            self.arg_dict[k] = v if isinstance(v, NDArray) else nd.array(v)
+        for i, n in enumerate(self.arg_names):
+            self.arg_arrays[i] = self.arg_dict[n]
+        args = {n: a.data() for n, a in self.arg_dict.items()}
+        auxs = {n: a.data() for n, a in self.aux_dict.items()}
+        key = _random.next_key()
+        if is_train:
+            fn = self._get_train_fn()
+            grad_names = self._grad_input_names
+            grad_args = {n: args[n] for n in grad_names}
+            other = {n: v for n, v in args.items()
+                     if n not in set(grad_names)}
+
+            def wrt(ga):
+                return fn(key, ga, other, auxs)
+
+            outs, vjp = jax.vjp(wrt, grad_args)
+            self._vjp = (vjp, [o.dtype for o in outs],
+                         [o.shape for o in outs])
+        else:
+            outs = self._get_fn("predict")(key, args, auxs)
+            self._vjp = None
+        # split user outputs from updated aux values and write the latter
+        n_user = len(self._symbol._outputs)
+        for name, val in zip(self._aux_update_names, outs[n_user:]):
+            self.aux_dict[name]._set_data(val)
+        outs = outs[:n_user]
+        self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
+        if self._monitor is not None:
+            for name, arr in zip(self.output_names, self.outputs):
+                self._monitor(name, arr)
+        return self.outputs
+
+    def backward(self, out_grads=None, retain_graph=False):
+        if self._vjp is None:
+            raise MXNetError("backward called before forward(is_train=True)")
+        vjp, dtypes, shapes = self._vjp
+        n_user = len(self._symbol._outputs)
+        if out_grads is None:
+            cts = [jnp.ones(s, d)
+                   for s, d in zip(shapes[:n_user], dtypes[:n_user])]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cts = [g.data() if isinstance(g, NDArray) else jnp.asarray(g)
+                   for g in out_grads]
+        # zero cotangents for the appended aux-update outputs
+        cts = tuple(cts + [jnp.zeros(s, d) for s, d in
+                           zip(shapes[n_user:], dtypes[n_user:])])
+        (grads,) = vjp(cts)
+        for n, g in grads.items():
+            req = self._grad_req.get(n, "null")
+            dst = self.grad_dict.get(n)
+            if dst is None or req == "null":
+                continue
+            if req == "add":
+                dst._set_data(dst.data() + g)
+            else:
+                dst._set_data(g)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False,
+                **kwargs):
+        new_args = {}
+        for n, arr in self.arg_dict.items():
+            if n in kwargs:
+                new_args[n] = nd.zeros(kwargs[n])
+            else:
+                new_args[n] = arr
+        return Executor(self._symbol, self._ctx, new_args,
+                        dict(self.aux_dict), self._grad_req)
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for n, v in arg_params.items():
+            if n in self.arg_dict:
+                self.arg_dict[n][:] = v
+            elif not allow_extra_params:
+                raise MXNetError("unknown parameter %r" % n)
+        if aux_params:
+            for n, v in aux_params.items():
+                if n in self.aux_dict:
+                    self.aux_dict[n][:] = v
+                elif not allow_extra_params:
+                    raise MXNetError("unknown aux state %r" % n)
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor = callback
+
+    @property
+    def output_dict(self):
+        return dict(zip(self.output_names, self.outputs))

@@ -1,0 +1,124 @@
+"""Per-op weight-shape hints for symbol shape inference.
+
+The reference's ``FInferShape`` attributes solve parameter shapes backwards
+from data shapes (e.g. ``FullyConnectedShape``,
+``src/operator/nn/fully_connected.cc``).  XLA only infers forwards, so the
+few parameterized ops that need backwards solving declare a hint here;
+everything else is solved by ``jax.eval_shape`` forward propagation.
+"""
+from __future__ import annotations
+
+
+def _as_tuple(v, n=None):
+    if isinstance(v, int):
+        return (v,) * (n or 1)
+    return tuple(v)
+
+
+def hint(op, input_names, shapes, attrs):
+    """Return per-input shapes (or None) given known ones; None = no hint."""
+    fn = _HINTS.get(op)
+    if fn is None:
+        return None
+    known = dict(zip(input_names, shapes))
+    out = fn(known, attrs)
+    if out is None:
+        return None
+    return [out.get(nm) for nm in input_names]
+
+
+def _fully_connected(known, attrs):
+    data = known.get("data")
+    if data is None:
+        return None
+    num_hidden = int(attrs.get("num_hidden", 0))
+    flatten = attrs.get("flatten", True)
+    in_units = 1
+    if flatten:
+        for d in data[1:]:
+            in_units *= d
+    else:
+        in_units = data[-1]
+    out = {"weight": (num_hidden, in_units)}
+    if not attrs.get("no_bias", False):
+        out["bias"] = (num_hidden,)
+    return out
+
+
+def _convolution(known, attrs):
+    data = known.get("data")
+    if data is None:
+        return None
+    kernel = _as_tuple(attrs.get("kernel", ()))
+    num_filter = int(attrs.get("num_filter", 0))
+    num_group = int(attrs.get("num_group", 1))
+    in_c = data[1]
+    out = {"weight": (num_filter, in_c // num_group) + kernel}
+    if not attrs.get("no_bias", False):
+        out["bias"] = (num_filter,)
+    return out
+
+
+def _deconvolution(known, attrs):
+    data = known.get("data")
+    if data is None:
+        return None
+    kernel = _as_tuple(attrs.get("kernel", ()))
+    num_filter = int(attrs.get("num_filter", 0))
+    num_group = int(attrs.get("num_group", 1))
+    in_c = data[1]
+    out = {"weight": (in_c, num_filter // num_group) + kernel}
+    if not attrs.get("no_bias", True):
+        out["bias"] = (num_filter,)
+    return out
+
+
+def _batch_norm(known, attrs):
+    data = known.get("data")
+    if data is None:
+        return None
+    axis = int(attrs.get("axis", 1))
+    c = data[axis % len(data)]
+    return {"gamma": (c,), "beta": (c,),
+            "moving_mean": (c,), "moving_var": (c,)}
+
+
+def _norm_1d(known, attrs):
+    data = known.get("data")
+    if data is None:
+        return None
+    axis = int(attrs.get("axis", -1))
+    c = data[axis % len(data)]
+    return {"gamma": (c,), "beta": (c,)}
+
+
+def _instance_norm(known, attrs):
+    data = known.get("data")
+    if data is None:
+        return None
+    return {"gamma": (data[1],), "beta": (data[1],)}
+
+
+def _embedding(known, attrs):
+    input_dim = int(attrs.get("input_dim", 0))
+    output_dim = int(attrs.get("output_dim", 0))
+    if not input_dim or not output_dim:
+        return None
+    return {"weight": (input_dim, output_dim)}
+
+
+_HINTS = {
+    "FullyConnected": _fully_connected,
+    "Convolution": _convolution,
+    "Deconvolution": _deconvolution,
+    "BatchNorm": _batch_norm,
+    "SyncBatchNorm": _batch_norm,
+    "LayerNorm": _norm_1d,
+    "RMSNorm": lambda known, attrs: (
+        {"gamma": (known["data"][int(attrs.get("axis", -1))
+                                 % len(known["data"])],)}
+        if known.get("data") else None),
+    "InstanceNorm": _instance_norm,
+    "GroupNorm": _instance_norm,
+    "Embedding": _embedding,
+}

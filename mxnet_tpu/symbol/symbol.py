@@ -1,0 +1,605 @@
+"""Symbol: declarative graph composition lowered to XLA.
+
+Capability parity with the reference's symbol layer
+(``python/mxnet/symbol/symbol.py`` + nnvm Graph/Op registry): compose op
+nodes into a DAG, auto-create missing weight/bias variables, infer
+shapes/types, serialize to JSON, and bind into an Executor.
+
+TPU-native mechanism: a Symbol's graph *is* the program — ``bind`` emits a
+pure jax function evaluated topologically over the node DAG and jits it,
+which is exactly the "lower nnvm graph → HLO module → one XLA executable"
+north star (the reference instead walks the graph pushing one engine op
+per node, ``GraphExecutor::InitCachedOps``,
+``src/executor/graph_executor.cc:1220``).  Shape/type inference =
+``jax.eval_shape`` over the same function (the reference's
+``InferShape/InferType`` passes, ``src/executor/exec_pass.h:238-264``,
+cannot disagree with execution here by construction).
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ops import registry as _reg
+from .. import autograd as _autograd
+from .. import random as _random
+
+# op name -> input names that are auxiliary states (mutable, not learnable)
+_AUX_INPUTS = {
+    "BatchNorm": ("moving_mean", "moving_var"),
+    "SyncBatchNorm": ("moving_mean", "moving_var"),
+}
+# op name -> {aux input name: op output index carrying its updated value}
+_AUX_OUTPUTS = {
+    "BatchNorm": {"moving_mean": 1, "moving_var": 2},
+    "SyncBatchNorm": {"moving_mean": 1, "moving_var": 2},
+}
+
+_name_lock = threading.Lock()
+_name_counters = {}
+
+
+def _auto_name(hint):
+    hint = hint.lstrip("_").lower()
+    with _name_lock:
+        c = _name_counters.get(hint, 0)
+        _name_counters[hint] = c + 1
+    return "%s%d" % (hint, c)
+
+
+class _Node:
+    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs", "_extra")
+
+    def __init__(self, op, name, attrs=None, inputs=(), num_outputs=1):
+        self.op = op          # None for variables
+        self.name = name
+        self.attrs = dict(attrs or {})
+        self.inputs = list(inputs)   # list of (node, out_index)
+        self.num_outputs = num_outputs
+        self._extra = {}
+
+    @property
+    def is_variable(self):
+        return self.op is None
+
+
+class Symbol:
+    """An ordered set of graph output entries (parity: symbol.Symbol)."""
+
+    def __init__(self, outputs):
+        self._outputs = list(outputs)  # list of (node, idx)
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def __repr__(self):
+        return "<Symbol %s>" % (self.name or "group[%d]"
+                                % len(self._outputs))
+
+    def __iter__(self):
+        return (Symbol([o]) for o in self._outputs)
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise MXNetError("output %s not found" % index)
+            return Symbol([self._outputs[names.index(index)]])
+        if isinstance(index, slice):
+            return Symbol(self._outputs[index])
+        return Symbol([self._outputs[index]])
+
+    # -- arithmetic (parity: symbol operators) ----------------------------
+    def __add__(self, other):
+        return _binary("broadcast_add", "_plus_scalar", self, other)
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __sub__(self, other):
+        return _binary("broadcast_sub", "_minus_scalar", self, other)
+
+    def __rsub__(self, other):
+        return _binary("broadcast_sub", "_rminus_scalar", self, other)
+
+    def __mul__(self, other):
+        return _binary("broadcast_mul", "_mul_scalar", self, other)
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __truediv__(self, other):
+        return _binary("broadcast_div", "_div_scalar", self, other)
+
+    def __rtruediv__(self, other):
+        return _binary("broadcast_div", "_rdiv_scalar", self, other)
+
+    def __pow__(self, other):
+        return _binary("broadcast_power", "_power_scalar", self, other)
+
+    def __neg__(self):
+        return self.__mul__(-1.0)
+
+    # -- graph inspection -------------------------------------------------
+    def _topo_nodes(self):
+        seen = {}
+        order = []
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen[id(node)] = node
+            for inp, _ in node.inputs:
+                visit(inp)
+            order.append(node)
+
+        for node, _ in self._outputs:
+            visit(node)
+        return order
+
+    def list_arguments(self):
+        out = []
+        aux = set(self.list_auxiliary_states())
+        for node in self._topo_nodes():
+            if node.is_variable and node.name not in aux:
+                out.append(node.name)
+        return out
+
+    def list_auxiliary_states(self):
+        out = []
+        for node in self._topo_nodes():
+            if node.is_variable:
+                continue
+            aux_names = _AUX_INPUTS.get(node.op, ())
+            if not aux_names:
+                continue
+            reg = _reg.get(node.op)
+            for nm, (inp, _) in zip(reg.input_names, node.inputs):
+                if nm in aux_names and inp.is_variable:
+                    out.append(inp.name)
+        return out
+
+    def list_outputs(self):
+        names = []
+        for node, idx in self._outputs:
+            if node.num_outputs == 1:
+                names.append(node.name + "_output")
+            else:
+                names.append("%s_output%d" % (node.name, idx))
+        return names
+
+    def list_inputs(self):
+        return [n.name for n in self._topo_nodes() if n.is_variable]
+
+    def _aux_update_entries(self):
+        """[(aux_var_name, (node, out_idx))]: where each aux state's updated
+        value appears among op outputs (train-mode write-back)."""
+        out = []
+        for node in self._topo_nodes():
+            if node.is_variable or node.op not in _AUX_OUTPUTS:
+                continue
+            mapping = _AUX_OUTPUTS[node.op]
+            reg = _reg.get(node.op)
+            for nm, (inp, _) in zip(reg.input_names, node.inputs):
+                if nm in mapping and inp.is_variable:
+                    out.append((inp.name, (node, mapping[nm])))
+        return out
+
+    def get_internals(self):
+        entries = []
+        for node in self._topo_nodes():
+            for i in range(node.num_outputs):
+                entries.append((node, i))
+        return Symbol(entries)
+
+    def list_attr(self):
+        if len(self._outputs) == 1:
+            return {k: str(v)
+                    for k, v in self._outputs[0][0].attrs.items()}
+        return {}
+
+    def attr(self, key):
+        return self.list_attr().get(key)
+
+    def attr_dict(self):
+        return {n.name: {k: str(v) for k, v in n.attrs.items()}
+                for n in self._topo_nodes() if n.attrs}
+
+    def _set_attr(self, **kwargs):
+        for node, _ in self._outputs:
+            node.attrs.update(kwargs)
+
+    # -- composition -------------------------------------------------------
+    @staticmethod
+    def Group(symbols):
+        entries = []
+        for s in symbols:
+            entries.extend(s._outputs)
+        return Symbol(entries)
+
+    # -- evaluation --------------------------------------------------------
+    def _make_fn(self, arg_names, mode="predict"):
+        """Pure function mapping {name: array} -> tuple of outputs."""
+        nodes = self._topo_nodes()
+
+        def fn(bindings):
+            vals = {}
+            for node in nodes:
+                if node.is_variable:
+                    if node.name not in bindings:
+                        raise MXNetError(
+                            "unbound variable %r" % node.name)
+                    vals[id(node)] = (bindings[node.name],)
+                    continue
+                reg = _reg.get(node.op)
+                ins = [vals[id(inp)][idx] for inp, idx in node.inputs]
+                attrs = dict(node.attrs)
+                attrs.pop("__name__", None)
+                if reg.needs_mode:
+                    attrs["_mode"] = mode
+                if reg.needs_rng:
+                    ins = [_random.next_key()] + ins
+                out = reg.forward(*ins, **attrs)
+                vals[id(node)] = out if isinstance(out, tuple) else (out,)
+            return tuple(vals[id(node)][idx]
+                         for node, idx in self._outputs)
+
+        return fn
+
+    def eval_imperative(self, bindings):
+        """Evaluate with NDArray bindings → list of NDArrays (SymbolBlock)."""
+        from ..ndarray.ndarray import NDArray
+        from ..context import current_context
+
+        mode = "train" if _autograd.is_training() else "predict"
+        fn = self._make_fn(list(bindings), mode=mode)
+        datas = {k: (v.data() if isinstance(v, NDArray) else jnp.asarray(v))
+                 for k, v in bindings.items()}
+        outs = fn(datas)
+        return [NDArray(o, ctx=current_context()) for o in outs]
+
+    def eval(self, ctx=None, **kwargs):
+        return self.eval_imperative(kwargs)
+
+    # -- inference ---------------------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except MXNetError:
+            raise
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        known = {}
+        if args:
+            for name, shape in zip(arg_names, args):
+                if shape is not None:
+                    known[name] = shape
+        known.update({k: v for k, v in kwargs.items() if v is not None})
+        solved = _solve_shapes(self, known, partial)
+        if solved is None:
+            return None, None, None
+        arg_shapes = [solved.get(n) for n in arg_names]
+        aux_shapes = [solved.get(n) for n in aux_names]
+        out_shapes = solved["__outputs__"]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        known = dict(zip(arg_names, args)) if args else dict(kwargs)
+        shapes = {}
+        # types need shapes too for eval_shape: use dummy 1-element shapes
+        # when unknown; dtype propagation doesn't depend on them.
+        sd = {}
+        for n in self.list_inputs():
+            dt = known.get(n, _np.float32)
+            sd[n] = jax.ShapeDtypeStruct((1,) * 4, _np.dtype(dt))
+        try:
+            fn = self._make_fn(list(sd))
+            outs = jax.eval_shape(fn, sd)
+            out_types = [o.dtype for o in outs]
+        except Exception:
+            out_types = [_np.float32] * len(self._outputs)
+        arg_types = [_np.dtype(known.get(n, _np.float32))
+                     for n in arg_names]
+        aux_types = [_np.float32] * len(self.list_auxiliary_states())
+        return arg_types, out_types, aux_types
+
+    # -- serialization -----------------------------------------------------
+    def tojson(self):
+        nodes = self._topo_nodes()
+        index = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        for n in nodes:
+            jnodes.append({
+                "op": n.op or "null",
+                "name": n.name,
+                "attrs": {k: json.dumps(v) if not isinstance(v, str)
+                          else v for k, v in n.attrs.items()},
+                "inputs": [[index[id(inp)], idx, 0]
+                           for inp, idx in n.inputs],
+            })
+        heads = [[index[id(n)], idx, 0] for n, idx in self._outputs]
+        return json.dumps({
+            "nodes": jnodes,
+            "arg_nodes": [i for i, n in enumerate(nodes)
+                          if n.is_variable],
+            "heads": heads,
+            "attrs": {"mxnet_version": ["int", 10700],
+                      "framework": ["str", "mxnet_tpu"]},
+        }, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- binding -----------------------------------------------------------
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        from .executor import Executor
+
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        if arg_shapes is None or any(s is None for s in arg_shapes):
+            raise MXNetError(
+                "simple_bind could not infer all argument shapes from %s"
+                % kwargs)
+        from .. import ndarray as nd
+
+        args = {n: nd.zeros(s) for n, s in zip(self.list_arguments(),
+                                               arg_shapes)}
+        auxs = {n: nd.zeros(s) for n, s in
+                zip(self.list_auxiliary_states(), aux_shapes)}
+        return Executor(self, ctx, args, auxs, grad_req)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from .executor import Executor
+
+        arg_names = self.list_arguments()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(arg_names, args))
+        aux_names = self.list_auxiliary_states()
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(aux_names, aux_states))
+        return Executor(self, ctx, args or {}, aux_states or {}, grad_req,
+                        args_grad=args_grad)
+
+
+def _solve_shapes(sym, known, partial):
+    """Shape inference via jax.eval_shape with iterative unknown-resolution.
+
+    Unknown input shapes can't generally be solved backwards (XLA infers
+    forward); reference parity cases (weights of FC/conv given data shape)
+    are handled by the op's shape-hint when available.
+    """
+    input_names = sym.list_inputs()
+    missing = [n for n in input_names if n not in known]
+    if missing:
+        hinted = _hint_missing(sym, dict(known), missing)
+        if hinted is None:
+            if partial:
+                hinted = dict(known)
+            else:
+                raise MXNetError(
+                    "infer_shape: cannot infer %s from given inputs"
+                    % missing)
+        known = hinted
+        missing = [n for n in input_names if n not in known]
+        if missing and not partial:
+            raise MXNetError(
+                "infer_shape: unresolved inputs %s" % missing)
+        if missing:
+            return {**known, "__outputs__": [None] * len(sym._outputs)}
+    sd = {n: jax.ShapeDtypeStruct(tuple(known[n]), _np.float32)
+          for n in input_names}
+    fn = sym._make_fn(input_names)
+    outs = jax.eval_shape(fn, sd)
+    solved = dict(known)
+    solved["__outputs__"] = [tuple(o.shape) for o in outs]
+    return solved
+
+
+def _hint_missing(sym, known, missing):
+    """Forward-propagate shapes node by node, using per-op weight-shape
+    hints (FullyConnected/Convolution/BatchNorm...) to fill parameters."""
+    from . import shape_hints
+
+    vals = {}
+    for node in sym._topo_nodes():
+        if node.is_variable:
+            if node.name in known:
+                vals[id(node)] = (tuple(known[node.name]),)
+            else:
+                vals[id(node)] = (None,)
+            continue
+        in_shapes = []
+        names = _reg.get(node.op).input_names
+        entries = node.inputs
+        shapes_in = [vals[id(inp)][idx] for inp, idx in entries]
+        # let the op hint missing variable inputs from the known ones
+        hinted = shape_hints.hint(node.op, names, shapes_in, node.attrs)
+        if hinted:
+            for (inp, idx), s in zip(entries, hinted):
+                if s is not None and vals[id(inp)][idx] is None and \
+                        inp.is_variable:
+                    vals[id(inp)] = (tuple(s),)
+                    known[inp.name] = tuple(s)
+        shapes_in = [vals[id(inp)][idx] for inp, idx in entries]
+        if any(s is None for s in shapes_in):
+            return None
+        # run eval_shape on this single node
+        reg = _reg.get(node.op)
+        attrs = dict(node.attrs)
+        attrs.pop("__name__", None)
+        if reg.needs_mode:
+            attrs["_mode"] = "predict"
+        def one(*arrs):
+            ins = list(arrs)
+            if reg.needs_rng:
+                ins = [jax.random.PRNGKey(0)] + ins
+            out = reg.forward(*ins, **attrs)
+            return out if isinstance(out, tuple) else (out,)
+        try:
+            outs = jax.eval_shape(
+                one, *[jax.ShapeDtypeStruct(s, _np.float32)
+                       for s in shapes_in])
+        except Exception:
+            return None
+        vals[id(node)] = tuple(tuple(o.shape) for o in outs)
+    for n in missing:
+        if n not in known:
+            return None
+    return known
+
+
+# ---------------------------------------------------------------------------
+# construction helpers
+# ---------------------------------------------------------------------------
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+        init=None, stype=None, **kwargs):
+    """Create a variable symbol (parity: symbol.var)."""
+    attrs = dict(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        attrs["__dtype__"] = str(dtype)
+    if lr_mult is not None:
+        attrs["__lr_mult__"] = lr_mult
+    if wd_mult is not None:
+        attrs["__wd_mult__"] = wd_mult
+    attrs.update(kwargs)
+    return Symbol([(_Node(None, name, attrs), 0)])
+
+
+Variable = var
+
+
+def Group(symbols):
+    return Symbol.Group(symbols)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    nodes = []
+    for jn in data["nodes"]:
+        op = jn["op"]
+        attrs = {}
+        for k, v in jn.get("attrs", {}).items():
+            try:
+                attrs[k] = json.loads(v) if not isinstance(v, str) else v
+            except Exception:
+                attrs[k] = v
+        node = _Node(None if op == "null" else op, jn["name"], attrs)
+        node.inputs = [(nodes[i], oi) for i, oi, _ in jn["inputs"]]
+        if node.op is not None:
+            node.num_outputs = _reg.get(node.op).num_outputs
+        nodes.append(node)
+    heads = [(nodes[i], oi) for i, oi, _ in data["heads"]]
+    return Symbol(heads)
+
+
+def make_symbol_op(op_name):
+    """Build the mx.sym.<op> composition function."""
+    reg = _reg.get(op_name)
+
+    def sym_op(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        kwargs.pop("attr", None)
+        if name is None:
+            name = _auto_name(op_name)
+        # split tensor inputs from attrs
+        inputs = {}
+        pos = list(args)
+        n_in = len(reg.input_names)
+        for nm, a in zip(reg.input_names, pos[:n_in]):
+            if a is not None:
+                inputs[nm] = a
+        extra = pos[n_in:]
+        attrs = {}
+        for nm, val in zip(reg.attr_names, extra):
+            attrs[nm] = val
+        for k, v in list(kwargs.items()):
+            if isinstance(v, Symbol):
+                inputs[k] = v
+            else:
+                attrs[k] = v
+        if reg.variadic:
+            entry_inputs = []
+            for a in pos:
+                if isinstance(a, Symbol):
+                    if len(a._outputs) != 1:
+                        entry_inputs.extend(a._outputs)
+                    else:
+                        entry_inputs.append(a._outputs[0])
+            node = _Node(op_name, name, attrs, entry_inputs,
+                         reg.num_outputs)
+            return Symbol([(node, i) for i in range(reg.num_outputs)]) \
+                if reg.num_outputs > 1 else Symbol([(node, 0)])
+        # auto-create missing trailing variable inputs (weights etc.)
+        entries = []
+        aux_names = _AUX_INPUTS.get(op_name, ())
+        for nm in reg.input_names:
+            if nm in inputs:
+                s = inputs[nm]
+                if not isinstance(s, Symbol):
+                    raise MXNetError(
+                        "input %s of %s must be a Symbol" % (nm, op_name))
+                if len(s._outputs) != 1:
+                    raise MXNetError(
+                        "input %s of %s must be a single-output Symbol"
+                        % (nm, op_name))
+                entries.append(s._outputs[0])
+            else:
+                vnode = _Node(None, "%s_%s" % (name, nm), {})
+                entries.append((vnode, 0))
+        node = _Node(op_name, name, attrs, entries, reg.num_outputs)
+        if reg.num_outputs > 1:
+            return Symbol([(node, i) for i in range(reg.num_outputs)])
+        return Symbol([(node, 0)])
+
+    sym_op.__name__ = op_name
+    sym_op.__doc__ = reg.doc
+    return sym_op
+
+
+def _binary(broadcast_op, scalar_op, lhs, rhs):
+    if isinstance(rhs, Symbol):
+        return make_symbol_op(broadcast_op)(lhs, rhs)
+    return make_symbol_op(scalar_op)(lhs, scalar=float(rhs))
+
+
+def zeros(shape, dtype=None, name=None):
+    return make_symbol_op("zeros")(shape=shape, dtype=dtype or "float32",
+                                   name=name)
+
+
+def ones(shape, dtype=None, name=None):
+    return make_symbol_op("ones")(shape=shape, dtype=dtype or "float32",
+                                  name=name)
+
+
+def arange(start, stop=None, step=1.0, dtype=None, name=None):
+    return make_symbol_op("arange")(start=start, stop=stop, step=step,
+                                    dtype=dtype or "float32", name=name)

@@ -7,11 +7,20 @@ real-TPU numbers come from bench.py, not the unit suite.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # override ambient axon/tpu setting
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The image's sitecustomize imports jax at interpreter startup with
+# JAX_PLATFORMS=axon already in the env, so jax.config captured 'axon'
+# before this file ran — push the override through the config API too
+# (backends aren't instantiated until first use, so this is still early
+# enough).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

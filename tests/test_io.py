@@ -1,0 +1,237 @@
+"""IO / data pipeline tests (model: tests/python/unittest/test_io.py,
+test_gluon_data.py, test_recordio.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.gluon import data as gdata
+
+
+def test_ndarray_iter():
+    data = np.ones([1000, 2, 2])
+    labels = np.ones([1000, 1])
+    for i in range(1000):
+        data[i] = i / 100
+        labels[i] = i / 100
+    it = mx.io.NDArrayIter(data, labels, 128, True,
+                           last_batch_handle='pad')
+    batch_count = 0
+    labelcount = [0] * 10
+    for batch in it:
+        label = batch.label[0].asnumpy().flatten()
+        assert (batch.data[0].asnumpy()[:, 0, 0] == label).all()
+        for l in label:
+            labelcount[int(l)] += 1
+        batch_count += 1
+    assert batch_count == 8  # ceil(1000/128)
+    # padded tail wraps to head
+    assert sum(labelcount) == 8 * 128
+
+
+def test_ndarray_iter_discard():
+    data = np.arange(100).reshape(100, 1)
+    it = mx.io.NDArrayIter(data, None, 32, False,
+                           last_batch_handle='discard')
+    batches = list(it)
+    assert len(batches) == 3
+    for b in batches:
+        assert b.data[0].shape == (32, 1)
+
+
+def test_ndarray_iter_reset():
+    data = np.arange(60).reshape(60, 1)
+    it = mx.io.NDArrayIter(data, batch_size=20)
+    n1 = len(list(it))
+    it.reset()
+    n2 = len(list(it))
+    assert n1 == n2 == 3
+
+
+def test_resize_iter():
+    data = np.arange(40).reshape(40, 1)
+    base = mx.io.NDArrayIter(data, batch_size=10)
+    resized = mx.io.ResizeIter(base, 7)
+    assert len(list(resized)) == 7
+
+
+def test_prefetching_iter():
+    data = np.arange(80).reshape(80, 1)
+    base = mx.io.NDArrayIter(data, batch_size=20)
+    pre = mx.io.PrefetchingIter(base)
+    seen = []
+    for batch in pre:
+        seen.append(batch.data[0].asnumpy())
+    assert len(seen) == 4
+    np.testing.assert_array_equal(
+        np.concatenate(seen).ravel(), np.arange(80))
+
+
+def test_csv_iter(tmp_path):
+    path = str(tmp_path / 'data.csv')
+    arr = np.random.rand(20, 3).astype(np.float32)
+    np.savetxt(path, arr, delimiter=',')
+    it = mx.io.CSVIter(data_csv=path, data_shape=(3,), batch_size=5)
+    got = np.concatenate([b.data[0].asnumpy() for b in it])
+    np.testing.assert_allclose(got, arr, rtol=1e-5)
+
+
+def test_recordio(tmp_path):
+    frec = str(tmp_path / 'test.rec')
+    N = 10
+    writer = recordio.MXRecordIO(frec, 'w')
+    for i in range(N):
+        writer.write(bytes(str(i), 'utf-8'))
+    del writer
+    reader = recordio.MXRecordIO(frec, 'r')
+    for i in range(N):
+        res = reader.read()
+        assert res == bytes(str(i), 'utf-8')
+    assert reader.read() is None
+
+
+def test_indexed_recordio(tmp_path):
+    fidx = str(tmp_path / 'test.idx')
+    frec = str(tmp_path / 'test.rec')
+    N = 10
+    writer = recordio.MXIndexedRecordIO(fidx, frec, 'w')
+    for i in range(N):
+        writer.write_idx(i, bytes(str(i), 'utf-8'))
+    del writer
+    reader = recordio.MXIndexedRecordIO(fidx, frec, 'r')
+    keys = list(reader.keys)
+    np.random.shuffle(keys)
+    for k in keys:
+        assert reader.read_idx(k) == bytes(str(k), 'utf-8')
+
+
+def test_recordio_pack_img_roundtrip(tmp_path):
+    img = (np.random.rand(8, 9, 3) * 255).astype(np.uint8)
+    header = recordio.IRHeader(0, 3.0, 7, 0)
+    s = recordio.pack_img(header, img, img_fmt='.npy')
+    header2, img2 = recordio.unpack_img(s)
+    assert header2.label == 3.0
+    assert header2.id == 7
+    np.testing.assert_array_equal(img, img2)
+
+
+def test_recordio_list_label():
+    label = np.array([1.0, 2.0, 3.0], dtype=np.float32)
+    s = recordio.pack(recordio.IRHeader(0, label, 1, 0), b'payload')
+    header, payload = recordio.unpack(s)
+    np.testing.assert_array_equal(header.label, label)
+    assert payload == b'payload'
+
+
+def test_image_record_iter(tmp_path):
+    frec = str(tmp_path / 'imgs.rec')
+    writer = recordio.MXRecordIO(frec, 'w')
+    imgs = []
+    for i in range(12):
+        img = (np.random.rand(8, 8, 3) * 255).astype(np.uint8)
+        imgs.append(img)
+        writer.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), img,
+            img_fmt='.npy'))
+    writer.close()
+    it = mx.io.ImageRecordIter(
+        path_imgrec=frec, data_shape=(3, 8, 8), batch_size=4)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 3, 8, 8)
+    assert batches[0].label[0].shape == (4,)
+
+
+# ---------------- gluon.data ----------------
+
+def test_array_dataset():
+    X = np.random.uniform(size=(10, 20))
+    Y = np.random.uniform(size=(10,))
+    dataset = gdata.ArrayDataset(X, Y)
+    loader = gdata.DataLoader(dataset, 2)
+    for i, (x, y) in enumerate(loader):
+        assert x.shape == (2, 20)
+        assert y.shape == (2,)
+    assert i == 4
+
+
+def test_dataloader_shuffle_and_workers():
+    X = np.arange(100).reshape(100, 1).astype('float32')
+    dataset = gdata.ArrayDataset(X)
+    loader = gdata.DataLoader(dataset, 10, shuffle=True, num_workers=2)
+    seen = np.sort(np.concatenate(
+        [b.asnumpy().ravel() for b in loader]))
+    np.testing.assert_array_equal(seen, np.arange(100))
+
+
+def test_dataloader_last_batch():
+    X = np.arange(25).reshape(25, 1).astype('float32')
+    ds = gdata.ArrayDataset(X)
+    assert len(list(gdata.DataLoader(ds, 10))) == 3
+    assert len(list(gdata.DataLoader(ds, 10, last_batch='discard'))) == 2
+    ro = gdata.DataLoader(ds, 10, last_batch='rollover')
+    assert len(list(ro)) == 2
+    assert len(list(ro)) == 3  # rolled-over 5 + fresh 25 = 30
+
+
+def test_dataset_transform_shard_take():
+    ds = gdata.SimpleDataset(list(range(10)))
+    doubled = ds.transform(lambda x: 2 * x)
+    assert doubled[3] == 6
+    sharded = ds.shard(3, 0)
+    assert len(sharded) == 4  # 10 = 4 + 3 + 3
+    assert len(ds.shard(3, 2)) == 3
+    assert len(ds.take(4)) == 4
+    filtered = ds.filter(lambda x: x % 2 == 0)
+    assert len(filtered) == 5
+
+
+def test_record_file_dataset(tmp_path):
+    fidx = str(tmp_path / 'd.idx')
+    frec = str(tmp_path / 'd.rec')
+    writer = recordio.MXIndexedRecordIO(fidx, frec, 'w')
+    for i in range(5):
+        writer.write_idx(i, bytes('rec%d' % i, 'utf-8'))
+    writer.close()
+    ds = gdata.RecordFileDataset(frec)
+    assert len(ds) == 5
+    assert ds[3] == b'rec3'
+
+
+def test_sampler():
+    seq = list(gdata.SequentialSampler(5))
+    assert seq == [0, 1, 2, 3, 4]
+    rnd = sorted(gdata.RandomSampler(5))
+    assert rnd == [0, 1, 2, 3, 4]
+    bs = gdata.BatchSampler(gdata.SequentialSampler(7), 3, 'keep')
+    assert [len(b) for b in bs] == [3, 3, 1]
+    bs = gdata.BatchSampler(gdata.SequentialSampler(7), 3, 'discard')
+    assert [len(b) for b in bs] == [3, 3]
+
+
+def test_transforms():
+    from mxnet_tpu.gluon.data.vision import transforms
+    img = mx.nd.array((np.random.rand(8, 9, 3) * 255).astype('uint8'),
+                      dtype='uint8')
+    out = transforms.ToTensor()(img)
+    assert out.shape == (3, 8, 9)
+    assert str(out.dtype).startswith('float32')
+    norm = transforms.Normalize(mean=(0.5, 0.5, 0.5),
+                                std=(0.25, 0.25, 0.25))(out)
+    np.testing.assert_allclose(
+        norm.asnumpy(),
+        (out.asnumpy() - 0.5) / 0.25, rtol=1e-5)
+    resized = transforms.Resize(4)(img)
+    assert resized.shape == (4, 4, 3)
+    cropped = transforms.CenterCrop(4)(img)
+    assert cropped.shape == (4, 4, 3)
+    rrc = transforms.RandomResizedCrop(5)(img)
+    assert rrc.shape == (5, 5, 3)
+    flipped = transforms.RandomFlipLeftRight(p=1.0)(img)
+    np.testing.assert_array_equal(
+        flipped.asnumpy(), img.asnumpy()[:, ::-1])
+    compose = transforms.Compose([transforms.ToTensor(),
+                                  transforms.Normalize(0.5, 0.5)])
+    assert compose(img).shape == (3, 8, 9)

@@ -1,0 +1,142 @@
+"""Metric tests (model: tests/python/unittest/test_metric.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import metric
+
+
+def test_accuracy():
+    m = metric.Accuracy()
+    pred = mx.nd.array([[0.3, 0.7], [0, 1.], [0.4, 0.6]])
+    label = mx.nd.array([0, 1, 1])
+    m.update([label], [pred])
+    name, acc = m.get()
+    assert name == 'accuracy'
+    assert acc == pytest.approx(2.0 / 3)
+
+
+def test_accuracy_2d():
+    m = metric.Accuracy()
+    # classes on axis 1: shape (batch=2, classes=2, positions=3)
+    pred = mx.nd.array(np.random.rand(2, 2, 3))
+    label = mx.nd.array(np.random.randint(0, 2, (2, 3)))
+    m.update([label], [pred])
+    _, acc = m.get()
+    expected_acc = (np.argmax(pred.asnumpy(), axis=1) ==
+                    label.asnumpy()).mean()
+    assert acc == pytest.approx(float(expected_acc))
+
+
+def test_top_k_accuracy():
+    m = metric.create('top_k_acc', top_k=3)
+    pred = mx.nd.array(np.random.rand(10, 10))
+    label = mx.nd.array(np.random.randint(0, 10, (10,)))
+    m.update([label], [pred])
+    name, acc = m.get()
+    assert name == 'top_k_accuracy_3'
+    p = pred.asnumpy()
+    l = label.asnumpy().astype(int)
+    expected = np.mean([
+        l[i] in np.argsort(p[i])[-3:] for i in range(10)])
+    assert acc == pytest.approx(float(expected))
+
+
+def test_f1():
+    microF1 = metric.create("f1", average="micro")
+    macroF1 = metric.F1(average="macro")
+    assert np.isnan(macroF1.get()[1])
+    assert np.isnan(microF1.get()[1])
+
+    pred11 = mx.nd.array([[0.1, 0.9], [0.5, 0.5]])
+    label11 = mx.nd.array([1, 0])
+    pred12 = mx.nd.array([[0.85, 0.15], [1.0, 0.0]])
+    label12 = mx.nd.array([1, 0])
+    microF1.update([label11, label12], [pred11, pred12])
+    macroF1.update([label11, label12], [pred11, pred12])
+    assert microF1.num_inst == 4
+    assert macroF1.num_inst == 1
+    # tp=1 fp=0 fn=1 -> precision=1, recall=0.5, f1=2/3
+    fscore1 = 2. * (1.) * (0.5) / (1. + 0.5)
+    assert microF1.get()[1] == pytest.approx(fscore1)
+    assert macroF1.get()[1] == pytest.approx(fscore1)
+
+
+def test_mcc():
+    micro_mcc = metric.create("mcc", average="micro")
+    assert np.isnan(micro_mcc.get()[1])
+    pred = mx.nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7], [0.6, 0.4]])
+    label = mx.nd.array([1, 0, 0, 1])
+    micro_mcc.update([label], [pred])
+    # tp=1 tn=1 fp=1 fn=1 -> mcc = 0
+    assert micro_mcc.get()[1] == pytest.approx(0.0)
+
+
+def test_perplexity():
+    m = metric.create('perplexity', ignore_label=None)
+    pred = mx.nd.array([[0.8, 0.2], [0.2, 0.8], [0.5, 0.5]])
+    label = mx.nd.array([0, 1, 1])
+    m.update([label], [pred])
+    _, ppl = m.get()
+    expected = np.exp(-np.mean(np.log([0.8, 0.8, 0.5])))
+    assert ppl == pytest.approx(float(expected), rel=1e-5)
+
+
+def test_regression_metrics():
+    pred = mx.nd.array([1., 2., 3., 4.])
+    label = mx.nd.array([1.5, 2.5, 2.5, 4.5])
+    mae = metric.create('mae')
+    mse = metric.create('mse')
+    rmse = metric.create('rmse')
+    for m in (mae, mse, rmse):
+        m.update([label], [pred])
+    assert mae.get()[1] == pytest.approx(0.5)
+    assert mse.get()[1] == pytest.approx(0.25)
+    assert rmse.get()[1] == pytest.approx(0.5)
+
+
+def test_pearson():
+    pred = mx.nd.array([[0.7, 0.3], [0.1, 0.9], [1., 0]])
+    label = mx.nd.array([[0, 1], [1, 0], [1, 0]])
+    m = metric.create('pearsonr')
+    m.update([label], [pred])
+    _, pcc = m.get()
+    expected = np.corrcoef(pred.asnumpy().ravel(),
+                           label.asnumpy().ravel())[0, 1]
+    assert pcc == pytest.approx(float(expected), rel=1e-5)
+
+
+def test_loss_metric():
+    m = metric.create('loss')
+    m.update(None, [mx.nd.array([2.0, 4.0])])
+    assert m.get()[1] == pytest.approx(3.0)
+
+
+def test_composite():
+    m = metric.create([
+        'acc', {'metric': 'topkaccuracy', 'top_k': 2}])
+    pred = mx.nd.array([[0.1, 0.7, 0.2], [0.0, 0.3, 0.7]])
+    label = mx.nd.array([1, 1])
+    m.update([label], [pred])
+    names, values = m.get()
+    assert names == ['accuracy', 'top_k_accuracy_2']
+    assert values[0] == pytest.approx(0.5)
+    assert values[1] == pytest.approx(1.0)
+
+
+def test_custom_metric():
+    def custom(label, pred):
+        return float(np.abs(label - pred).mean())
+    m = metric.np(custom)
+    m.update([mx.nd.array([1., 2.])], [mx.nd.array([1.5, 2.5])])
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_global_local_tracking():
+    m = metric.Accuracy()
+    pred = mx.nd.array([[0.3, 0.7], [0, 1.], [0.4, 0.6]])
+    label = mx.nd.array([0, 1, 1])
+    m.update([label], [pred])
+    m.reset_local()
+    assert np.isnan(m.get()[1])
+    assert m.get_global()[1] == pytest.approx(2.0 / 3)

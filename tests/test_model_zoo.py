@@ -1,0 +1,78 @@
+"""Model zoo smoke tests (model: tests/python/unittest/test_gluon_model_zoo.py).
+
+Each family gets one small forward; resnet18 also checks hybridize
+numerics. Full-size variants are constructed but not run (construction
+exercises the layer graph)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+@pytest.mark.parametrize('name', [
+    'resnet18_v1', 'resnet18_v2', 'mobilenet0.25', 'mobilenetv2_0.25',
+])
+def test_small_models_forward(name):
+    net = vision.get_model(name, classes=10)
+    net.initialize()
+    x = mx.nd.random_uniform(shape=(2, 3, 32, 32))
+    y = net(x)
+    assert y.shape == (2, 10)
+    assert np.isfinite(y.asnumpy()).all()
+
+
+def test_resnet18_hybridize_matches_imperative():
+    net = vision.get_model('resnet18_v1', classes=10)
+    net.initialize()
+    x = mx.nd.random_uniform(shape=(2, 3, 32, 32))
+    y_imp = net(x).asnumpy()
+    net.hybridize()
+    net(x)  # warmup
+    y_hyb = net(x).asnumpy()
+    np.testing.assert_allclose(y_imp, y_hyb, rtol=1e-4, atol=1e-4)
+
+
+def test_alexnet_vgg_forward():
+    net = vision.alexnet(classes=10)
+    net.initialize()
+    y = net(mx.nd.random_uniform(shape=(1, 3, 224, 224)))
+    assert y.shape == (1, 10)
+
+    net = vision.vgg11(classes=10)
+    net.initialize()
+    y = net(mx.nd.random_uniform(shape=(1, 3, 224, 224)))
+    assert y.shape == (1, 10)
+
+
+def test_squeezenet_forward():
+    net = vision.squeezenet1_1(classes=10)
+    net.initialize()
+    y = net(mx.nd.random_uniform(shape=(1, 3, 224, 224)))
+    assert y.shape == (1, 10)
+
+
+def test_densenet_forward():
+    net = vision.densenet121(classes=10)
+    net.initialize()
+    y = net(mx.nd.random_uniform(shape=(1, 3, 224, 224)))
+    assert y.shape == (1, 10)
+
+
+def test_inception_forward():
+    net = vision.inception_v3(classes=10)
+    net.initialize()
+    y = net(mx.nd.random_uniform(shape=(1, 3, 299, 299)))
+    assert y.shape == (1, 10)
+
+
+def test_get_model_unknown():
+    with pytest.raises(mx.MXNetError):
+        vision.get_model('resnet1337')
+
+
+def test_resnet50_construct():
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize()
+    params = net.collect_params()
+    assert len(params) > 100

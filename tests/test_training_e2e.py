@@ -1,0 +1,103 @@
+"""End-to-end training convergence (model: tests/python/train/test_mlp.py,
+tests/nightly/dist_lenet.py — scaled to unit-test size).
+
+The SURVEY §7 stage-3 milestone: LeNet trained imperatively and hybridized
+on a synthetic separable 'MNIST-shaped' problem must reach high accuracy.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def _synthetic_mnist(n=512, seed=0):
+    """10-class images where class k lights up block k; learnable fast."""
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n)
+    imgs = rng.rand(n, 1, 28, 28).astype('float32') * 0.1
+    for i, lbl in enumerate(labels):
+        r, c = divmod(lbl, 4)
+        imgs[i, 0, r * 7:(r + 1) * 7, c * 7:(c + 1) * 7] += 1.0
+    return imgs, labels.astype('float32')
+
+
+def _lenet():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=5, activation='relu'),
+            nn.MaxPool2D(pool_size=2, strides=2),
+            nn.Conv2D(16, kernel_size=3, activation='relu'),
+            nn.MaxPool2D(pool_size=2, strides=2),
+            nn.Flatten(),
+            nn.Dense(64, activation='relu'),
+            nn.Dense(10))
+    return net
+
+
+def _train(net, imgs, labels, epochs=4, batch_size=64, hybridize=False):
+    mx.random.seed(42)
+    np.random.seed(42)
+    net.initialize(mx.init.Xavier(), force_reinit=True)
+    if hybridize:
+        net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.1, 'momentum': 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    train_iter = mx.io.NDArrayIter(imgs, labels, batch_size, shuffle=True)
+    acc = mx.metric.Accuracy()
+    for _ in range(epochs):
+        train_iter.reset()
+        acc.reset()
+        for batch in train_iter:
+            data = batch.data[0]
+            label = batch.label[0]
+            with mx.autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            acc.update([label], [out])
+    return acc.get()[1]
+
+
+def test_lenet_convergence_imperative():
+    imgs, labels = _synthetic_mnist()
+    final_acc = _train(_lenet(), imgs, labels)
+    assert final_acc > 0.95, "LeNet failed to converge: %.3f" % final_acc
+
+
+def test_lenet_convergence_hybridized():
+    imgs, labels = _synthetic_mnist()
+    final_acc = _train(_lenet(), imgs, labels, hybridize=True)
+    assert final_acc > 0.95, \
+        "hybridized LeNet failed to converge: %.3f" % final_acc
+
+
+def test_mlp_with_dataloader():
+    """gluon.data pipeline end-to-end with an MLP."""
+    mx.random.seed(11)
+    np.random.seed(11)
+    rng = np.random.RandomState(1)
+    X = rng.rand(256, 20).astype('float32')
+    w = rng.rand(20).astype('float32')
+    y = (X @ w > np.median(X @ w)).astype('float32')
+    dataset = gluon.data.ArrayDataset(X, y)
+    loader = gluon.data.DataLoader(dataset, batch_size=32, shuffle=True)
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation='relu'), nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': 0.01})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    acc = mx.metric.Accuracy()
+    for _ in range(25):
+        acc.reset()
+        for data, label in loader:
+            with mx.autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            acc.update([label], [out])
+    assert acc.get()[1] > 0.9
