@@ -43,3 +43,11 @@ from . import io  # noqa: F401
 from . import image  # noqa: F401
 from . import parallel  # noqa: F401
 from . import gluon  # noqa: F401
+from . import symbol  # noqa: F401
+from . import symbol as sym  # noqa: F401
+from . import model  # noqa: F401
+from . import callback  # noqa: F401
+from . import module  # noqa: F401
+from . import monitor  # noqa: F401
+from . import visualization  # noqa: F401
+from .monitor import Monitor  # noqa: F401

@@ -361,10 +361,11 @@ class NDArrayIter(DataIter):
     def reset(self):
         if self.shuffle:
             self._shuffle_data()
+        # epoch ended mid-tail: negative cursor in (-batch_size, 0) makes
+        # _batchify concat the cached tail with the head of this epoch
         if self.last_batch_handle == 'roll_over' and \
-                0 < self.cursor < self.num_data:
-            self.cursor = -self.batch_size + \
-                (self.cursor % self.num_data) % self.batch_size
+                self.num_data - self.batch_size < self.cursor < self.num_data:
+            self.cursor = self.cursor - self.num_data - self.batch_size
         else:
             self.cursor = -self.batch_size
 
@@ -378,11 +379,13 @@ class NDArrayIter(DataIter):
         data = self.getdata()
         label = self.getlabel()
         if data[0].shape[0] != self.batch_size:
-            # discard incomplete tail batch
             if self.last_batch_handle == 'discard':
                 raise StopIteration
+            # roll_over: cache the short tail for the next epoch and end
+            # this one (the caller never sees an inconsistent-size batch)
             self._cache_data = data
             self._cache_label = label
+            raise StopIteration
         return DataBatch(data=data, label=label,
                          pad=self.getpad(), index=None)
 
@@ -409,8 +412,12 @@ class NDArrayIter(DataIter):
                 -self.batch_size < self.cursor < 0:
             assert self._cache_data is not None or \
                 self._cache_label is not None
-            cache = self._cache_data if self._cache_data is not None \
-                else self._cache_label
+            # getdata consumes _cache_data first, then getlabel finds it
+            # cleared and consumes _cache_label — each cache is used once
+            if self._cache_data is not None:
+                cache, self._cache_data = self._cache_data, None
+            else:
+                cache, self._cache_label = self._cache_label, None
             second = self._getdata(
                 data_source, end=self.cursor + self.batch_size)
             return self._concat(cache, second)
@@ -426,15 +433,9 @@ class NDArrayIter(DataIter):
         return first
 
     def getdata(self):
-        if self.last_batch_handle == 'roll_over' and \
-                self._cache_data is not None and self.cursor >= 0:
-            self._cache_data = None
         return self._batchify(self.data)
 
     def getlabel(self):
-        if self.last_batch_handle == 'roll_over' and \
-                self._cache_label is not None and self.cursor >= 0:
-            self._cache_label = None
         return self._batchify(self.label)
 
     def getpad(self):

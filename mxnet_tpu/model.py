@@ -1,0 +1,51 @@
+"""Checkpoint helpers + training-callback params.
+
+Capability parity: ``python/mxnet/model.py`` (``save_checkpoint:407``,
+``load_checkpoint:456``, ``BatchEndParam:80``).  TPU-native storage: the
+params file is the framework's ``.npz``-container NDArray format
+(``mxnet_tpu/ndarray/ndarray.py:629``) instead of the reference's magic-
+number binary; the symbol file is the same JSON idea.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+from . import ndarray as nd
+from .base import MXNetError
+
+BatchEndParam = namedtuple(
+    "BatchEndParam", ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """Checkpoint symbol + parameters to ``prefix-symbol.json`` and
+    ``prefix-%04d.params``."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, save_dict)
+    return param_name
+
+
+def load_checkpoint(prefix, epoch):
+    """Returns (symbol, arg_params, aux_params) saved by save_checkpoint."""
+    from . import symbol as sym
+
+    try:
+        symbol = sym.load("%s-symbol.json" % prefix)
+    except FileNotFoundError:
+        symbol = None
+    loaded = nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        tp, _, name = k.partition(":")
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+        else:
+            raise MXNetError("invalid param file entry %r" % k)
+    return symbol, arg_params, aux_params

@@ -1,0 +1,8 @@
+"""Module API (parity: ``python/mxnet/module/``) — symbolic training.
+
+``Module`` drives one GSPMD-sharded XLA executor; ``BucketingModule``
+adds per-bucket executables with shared parameters.
+"""
+from .base_module import BaseModule  # noqa: F401
+from .module import Module  # noqa: F401
+from .bucketing_module import BucketingModule  # noqa: F401

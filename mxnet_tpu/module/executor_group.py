@@ -1,0 +1,186 @@
+"""DataParallelExecutorGroup — the Module API's execution backend.
+
+Capability parity: ``python/mxnet/module/executor_group.py:144``.  The
+reference slices every batch across GPU contexts (``decide_slices:282``)
+and holds one ``GraphExecutor`` per device, re-implementing data
+parallelism in Python.  TPU-native mechanism: ONE ``Executor`` whose
+callables are single XLA programs; when a ``jax.sharding.Mesh`` is
+supplied the batch inputs are GSPMD-sharded over the mesh's data axis and
+XLA compiles the gradient all-reduce into the same executable — the
+slicing, per-device arg copies, and Python-side gradient summing all
+disappear into the partitioner.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .. import ndarray as nd
+
+
+class DataParallelExecutorGroup:
+    """One GSPMD-sharded executor presenting the reference group API.
+
+    Parameters
+    ----------
+    symbol : Symbol
+    contexts : list of Context (API parity; placement is mesh-driven)
+    data_shapes, label_shapes : list of (name, shape) or DataDesc
+    param_names : list of str — which arguments are parameters
+    for_training : bool
+    grad_req : str/list/dict
+    mesh : optional jax.sharding.Mesh for multi-chip data parallelism
+    data_axis : mesh axis carrying the batch dimension
+    """
+
+    def __init__(self, symbol, contexts, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad=False,
+                 shared_group=None, logger=None, fixed_param_names=None,
+                 grad_req='write', state_names=None, mesh=None,
+                 data_axis='data'):
+        self.symbol = symbol
+        self.contexts = contexts
+        self.param_names = list(param_names)
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = set(fixed_param_names or [])
+        self.mesh = mesh
+        self.data_axis = data_axis
+
+        # normalize (name, shape[, dtype…]) DataDescs to (name, shape)
+        self.data_names = [d[0] for d in data_shapes]
+        self.label_names = [l[0] for l in label_shapes] \
+            if label_shapes else []
+        self.data_shapes = [(d[0], tuple(d[1])) for d in data_shapes]
+        self.label_shapes = [(l[0], tuple(l[1])) for l in label_shapes] \
+            if label_shapes else []
+        self.batch_size = self.data_shapes[0][1][0]
+
+        arg_names = symbol.list_arguments()
+        self.arg_names = arg_names
+        self.aux_names = symbol.list_auxiliary_states()
+        input_names = set(self.data_names) | set(self.label_names)
+        req = {}
+        for name in arg_names:
+            if name in self.fixed_param_names:
+                req[name] = 'null'
+            elif name in self.param_names:
+                req[name] = grad_req if isinstance(grad_req, str) \
+                    else grad_req.get(name, 'write')
+            elif name in input_names:
+                req[name] = 'write' if (
+                    inputs_need_grad and name in self.data_names) \
+                    else 'null'
+            else:
+                req[name] = 'null'
+        if not for_training:
+            req = {n: 'null' for n in arg_names}
+        self._grad_req = req
+
+        shapes = dict(self.data_shapes + self.label_shapes)
+        if shared_group is not None:
+            # bucketing: share parameter/grad arrays with the master group
+            exec_ = self._bind_shared(shared_group, shapes)
+        else:
+            exec_ = symbol.simple_bind(
+                ctx=contexts[0] if contexts else None,
+                grad_req=req, **shapes)
+        self.execs = [exec_]
+        self._exec = exec_
+
+    def _bind_shared(self, shared_group, shapes):
+        master = shared_group._exec
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**shapes)
+        args = {}
+        for name, shape in zip(self.symbol.list_arguments(), arg_shapes):
+            if name in master.arg_dict and \
+                    tuple(master.arg_dict[name].shape) == tuple(shape):
+                args[name] = master.arg_dict[name]
+            else:
+                args[name] = nd.zeros(shape)
+        auxs = {}
+        for name, shape in zip(self.symbol.list_auxiliary_states(),
+                               aux_shapes):
+            if name in master.aux_dict and \
+                    tuple(master.aux_dict[name].shape) == tuple(shape):
+                auxs[name] = master.aux_dict[name]
+            else:
+                auxs[name] = nd.zeros(shape)
+        args_grad = {n: g for n, g in master.grad_dict.items()
+                     if g is not None}
+        return self.symbol.bind(
+            ctx=self.contexts[0] if self.contexts else None,
+            args=args, aux_states=auxs, grad_req=self._grad_req,
+            args_grad=args_grad)
+
+    # -- sharding ---------------------------------------------------------
+    def _shard_batch(self, arr):
+        if self.mesh is None:
+            return arr
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = P(self.data_axis, *([None] * (arr.data().ndim - 1)))
+        return NDArray(jax.device_put(
+            arr.data(), NamedSharding(self.mesh, spec)))
+
+    # -- parameter plumbing ----------------------------------------------
+    def set_params(self, arg_params, aux_params, allow_extra=False):
+        self._exec.copy_params_from(arg_params, aux_params,
+                                    allow_extra_params=allow_extra)
+
+    def get_params(self, arg_params, aux_params):
+        """Copy current (device) params into the given dicts."""
+        for name in self.param_names:
+            if name in self._exec.arg_dict:
+                arg_params[name] = self._exec.arg_dict[name].copy()
+        for name in self.aux_names:
+            aux_params[name] = self._exec.aux_dict[name].copy()
+
+    # -- execution --------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training
+        feed = {}
+        for name, arr in zip(self.data_names, data_batch.data):
+            arr = arr if isinstance(arr, NDArray) else nd.array(arr)
+            feed[name] = self._shard_batch(arr)
+        if self.label_names and data_batch.label:
+            for name, arr in zip(self.label_names, data_batch.label):
+                arr = arr if isinstance(arr, NDArray) else nd.array(arr)
+                feed[name] = self._shard_batch(arr)
+        self._exec.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        if not self.for_training:
+            raise MXNetError("re-bind with for_training=True to run backward")
+        self._exec.backward(out_grads=out_grads)
+
+    def get_outputs(self, merge_multi_context=True):
+        return list(self._exec.outputs)
+
+    def get_input_grads(self, merge_multi_context=True):
+        if not self.inputs_need_grad:
+            raise MXNetError(
+                "bind with inputs_need_grad=True to get input grads")
+        return [self._exec.grad_dict[n] for n in self.data_names]
+
+    @property
+    def grad_arrays(self):
+        return [[self._exec.grad_dict[n]] for n in self.param_names
+                if self._exec.grad_dict.get(n) is not None]
+
+    def grad_dict(self):
+        return self._exec.grad_dict
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update_dict(
+            dict(zip(self.label_names, labels)),
+            dict(zip(self.symbol.list_outputs(), self.get_outputs())))
+
+    def install_monitor(self, mon):
+        for exe in self.execs:
+            exe.set_monitor_callback(mon.tip if hasattr(mon, 'tip')
+                                     else mon)

@@ -1,0 +1,76 @@
+"""Monitor — per-layer output/statistic taps during training.
+
+Capability parity: ``python/mxnet/monitor.py`` (Monitor installed via
+``Executor.set_monitor_callback``; ``tic/toc/toc_print`` batch protocol).
+TPU-native note: outputs surface as NDArrays backed by device buffers; the
+stat function runs host-side on asnumpy'd values at ``toc`` time so no
+monitoring code ends up inside the compiled executable.
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+from .ndarray.ndarray import NDArray
+
+
+class Monitor:
+    """Parameters
+    ----------
+    interval : int — call stats every `interval` batches
+    stat_func : fn(NDArray) -> NDArray, default mean(abs(x))
+    pattern : regex selecting which names to monitor
+    sort : sort output statistics by name
+    """
+
+    def __init__(self, interval, stat_func=None, pattern='.*', sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                return float(abs(x).mean().asscalar()) \
+                    if isinstance(x, NDArray) else float(x)
+
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+    def stat_helper(self, name, array):
+        """The callback wired into executors."""
+        if not self.activated or not self.re_prog.match(str(name)):
+            return
+        self.queue.append((self.step, str(name), self.stat_func(array)))
+
+    # alias used by install_monitor plumbing
+    @property
+    def tip(self):
+        return self.stat_helper
+
+    def install(self, exe):
+        exe.set_monitor_callback(self.stat_helper)
+        self.exes.append(exe)
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        if not self.activated:
+            return []
+        self.activated = False
+        res = []
+        queue = sorted(self.queue) if self.sort else self.queue
+        for n, k, v_list in queue:
+            res.append((n, k, str(v_list)))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        for n, k, v in self.toc():
+            logging.info('Batch: %7d %30s %s', n, k, v)

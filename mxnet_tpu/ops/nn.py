@@ -81,6 +81,10 @@ def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(
     pad = _as_tuple(pad, nd) if pad else (0,) * nd
     specs = _CONV_DIMNUMS[layout]
     dn = lax.conv_dimension_numbers(data.shape, weight.shape, specs)
+    # No preferred_element_type for sub-f32 inputs: the MXU already
+    # accumulates bf16 products in f32 internally, and jax's conv transpose
+    # rule cannot differentiate a widened-accumulation conv (cotangent f32
+    # vs operand bf16 → dtype mismatch in the backward conv).
     out = lax.conv_general_dilated(
         data, weight,
         window_strides=stride,
@@ -88,8 +92,7 @@ def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=num_group,
-        preferred_element_type=jnp.float32,
-    ).astype(data.dtype)
+    )
     if not no_bias and bias is not None:
         if layout.endswith("C") or layout in ("NWC", "NHWC", "NDHWC"):
             out = out + bias

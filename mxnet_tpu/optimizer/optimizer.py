@@ -151,9 +151,15 @@ class Optimizer:
                 saved = (opt.rescale_grad, opt.clip_gradient)
                 opt.rescale_grad, opt.clip_gradient = rescale, clip
                 try:
-                    return opt._step(weight, grad, state, lr, wd, t)
+                    new_w, new_s = opt._step(weight, grad, state, lr, wd, t)
                 finally:
                     opt.rescale_grad, opt.clip_gradient = saved
+                # keep weight/state dtypes stable under f32 lr/wd scalars
+                # (bf16 params would otherwise be silently promoted)
+                new_w = new_w.astype(weight.dtype)
+                new_s = jax.tree_util.tree_map(
+                    lambda a, b: a.astype(b.dtype), new_s, state)
+                return new_w, new_s
 
             fn = jax.jit(_step_with_consts)
             self._jit_cache[key] = fn

@@ -81,8 +81,11 @@ class JitTrainStep:
         self._train_idx = [i for i, p in enumerate(self._params)
                            if p.grad_req != 'null']
         self._train_set = set(self._train_idx)
-        # device copies of weights/state live here between steps
-        self._weights = [p.data().data() for p in self._params]
+        # device copies of weights/state live here between steps; copied
+        # (not aliased) because the step donates them — donating the very
+        # buffers the gluon Parameters hold would invalidate p.data() after
+        # step 1 on TPU (CPU ignores donation, which hid this in tests)
+        self._weights = [jnp.array(p.data().data()) for p in self._params]
         self._opt_state = [
             self._opt.create_state(i, self._weights[i])
             if i in self._train_set else None
@@ -166,10 +169,14 @@ class JitTrainStep:
                 # _step applies clip/rescale itself (see Optimizer._step
                 # implementations)
                 nw, ns = opt._step(w, g, st_i, lr_i, wd, t)
-                new_weights[i] = nw
-                new_state[i] = ns
+                # pin dtypes: f32 lr/wd scalars promote bf16 updates to
+                # f32, which would change the carried weight dtype and
+                # force a retrace (+ mixed-dtype convs) on the next step
+                new_weights[i] = nw.astype(w.dtype)
+                new_state[i] = jax.tree_util.tree_map(
+                    lambda a, b: a.astype(b.dtype), ns, st_i)
             for i, v in aux:
-                new_weights[i] = v
+                new_weights[i] = v.astype(weights[i].dtype)
             return new_weights, new_state, loss_val
 
         jit_kwargs = {}

@@ -96,6 +96,7 @@ class Executor:
         return fn
 
     def _get_train_fn(self):
+        """Jitted train-mode forward: (key, grad_args, other, auxs) → outs."""
         fn = self._fns.get("train_grad")
         if fn is None:
             ext = self._extended_symbol()
@@ -108,8 +109,37 @@ class Executor:
                     bindings.update(grad_args)
                     return raw(bindings)
 
-            fn = run
+            fn = jax.jit(run)
             self._fns["train_grad"] = fn
+        return fn
+
+    def _get_bwd_fn(self):
+        """One jitted executable computing forward+vjp.
+
+        The forward is rematerialized inside the backward executable (the
+        TPU-favoured memory/compute trade; XLA fuses and shares what it
+        can) so no un-jittable vjp closure ever crosses a call boundary.
+        Same key as the forward call → identical dropout/rng draws.
+        """
+        fn = self._fns.get("train_bwd")
+        if fn is None:
+            ext = self._extended_symbol()
+            raw = ext._make_fn(ext.list_inputs(), mode="train")
+
+            def run_bwd(key, grad_args, other_args, auxs, cts):
+                def wrt(ga):
+                    with _random.trace_key_scope(key):
+                        bindings = dict(other_args)
+                        bindings.update(auxs)
+                        bindings.update(ga)
+                        return tuple(raw(bindings))
+
+                _, vjp = jax.vjp(wrt, grad_args)
+                (grads,) = vjp(tuple(cts))
+                return grads
+
+            fn = jax.jit(run_bwd)
+            self._fns["train_bwd"] = fn
         return fn
 
     # -- API ---------------------------------------------------------------
@@ -124,17 +154,13 @@ class Executor:
         auxs = {n: a.data() for n, a in self.aux_dict.items()}
         key = _random.next_key()
         if is_train:
-            fn = self._get_train_fn()
             grad_names = self._grad_input_names
             grad_args = {n: args[n] for n in grad_names}
             other = {n: v for n, v in args.items()
                      if n not in set(grad_names)}
-
-            def wrt(ga):
-                return fn(key, ga, other, auxs)
-
-            outs, vjp = jax.vjp(wrt, grad_args)
-            self._vjp = (vjp, [o.dtype for o in outs],
+            outs = self._get_train_fn()(key, grad_args, other, auxs)
+            self._vjp = ((key, grad_args, other, auxs),
+                         [o.dtype for o in outs],
                          [o.shape for o in outs])
         else:
             outs = self._get_fn("predict")(key, args, auxs)
@@ -153,7 +179,7 @@ class Executor:
     def backward(self, out_grads=None, retain_graph=False):
         if self._vjp is None:
             raise MXNetError("backward called before forward(is_train=True)")
-        vjp, dtypes, shapes = self._vjp
+        (key, grad_args, other, auxs), dtypes, shapes = self._vjp
         n_user = len(self._symbol._outputs)
         if out_grads is None:
             cts = [jnp.ones(s, d)
@@ -166,7 +192,7 @@ class Executor:
         # zero cotangents for the appended aux-update outputs
         cts = tuple(cts + [jnp.zeros(s, d) for s, d in
                            zip(shapes[n_user:], dtypes[n_user:])])
-        (grads,) = vjp(cts)
+        grads = self._get_bwd_fn()(key, grad_args, other, auxs, cts)
         for n, g in grads.items():
             req = self._grad_req.get(n, "null")
             dst = self.grad_dict.get(n)

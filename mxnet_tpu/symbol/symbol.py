@@ -507,9 +507,15 @@ def load_json(json_str):
         op = jn["op"]
         attrs = {}
         for k, v in jn.get("attrs", {}).items():
-            try:
-                attrs[k] = json.loads(v) if not isinstance(v, str) else v
-            except Exception:
+            # tojson stores non-string attrs json-encoded and genuine
+            # strings raw, so decoding must try json.loads on every string
+            # and keep the raw value when it isn't valid JSON ('relu', …)
+            if isinstance(v, str):
+                try:
+                    attrs[k] = json.loads(v)
+                except ValueError:
+                    attrs[k] = v
+            else:
                 attrs[k] = v
         node = _Node(None if op == "null" else op, jn["name"], attrs)
         node.inputs = [(nodes[i], oi) for i, oi, _ in jn["inputs"]]

@@ -1,0 +1,162 @@
+"""Network visualization (parity: ``python/mxnet/visualization.py``).
+
+``print_summary`` — layer-by-layer table with output shapes and parameter
+counts; ``plot_network`` — graphviz DOT rendering when graphviz is
+importable (gated, not required).
+"""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Prints a summary table of the symbol's nodes.
+
+    Parameters
+    ----------
+    symbol : Symbol
+    shape : dict of input name -> shape, for output-shape inference
+    """
+    if positions is None:
+        positions = [.44, .64, .74, 1.]
+    show_shape = shape is not None
+    internals = symbol.get_internals()
+    if show_shape:
+        _, out_shapes, _ = internals.infer_shape_partial(**shape)
+        if out_shapes is None:
+            raise MXNetError("Input shape is incomplete")
+        shape_dict = dict(zip(internals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    if positions[-1] <= 1:
+        positions = [int(line_length * p) for p in positions]
+    to_display = ['Layer (type)', 'Output Shape', 'Param #',
+                  'Previous Layer']
+
+    def print_row(fields, positions):
+        line = ''
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:positions[i]]
+            line += ' ' * (positions[i] - len(line))
+        print(line)
+
+    print('_' * line_length)
+    print_row(to_display, positions)
+    print('=' * line_length)
+
+    def print_layer_summary(node, out_shape):
+        op = node["op"]
+        pre_node = []
+        pre_filter = 0
+        if op != "null":
+            inputs = node["inputs"]
+            for item in inputs:
+                input_node = nodes[item[0]]
+                input_name = input_node["name"]
+                if input_node["op"] != "null" or item[0] in heads:
+                    pre_node.append(input_name)
+                    if show_shape:
+                        key = input_name
+                        if input_node["op"] != "null":
+                            key += "_output"
+                        if key in shape_dict:
+                            shape = shape_dict[key][1:]
+                            pre_filter = pre_filter + int(shape[0]) \
+                                if shape else pre_filter
+        cur_param = 0
+        attrs = node.get("attrs", {})
+        if op == 'Convolution':
+            num_filter = int(attrs.get("num_filter", 0))
+            kernel = attrs.get("kernel", "()")
+            if isinstance(kernel, str):
+                kernel = eval(kernel)  # attr round-trips as str or list
+            k = 1
+            for dim in kernel:
+                k *= int(dim)
+            cur_param = pre_filter * num_filter * k
+            if attrs.get("no_bias") not in ('True', True, 'true'):
+                cur_param += num_filter
+        elif op == 'FullyConnected':
+            num_hidden = int(attrs.get("num_hidden", 0))
+            cur_param = pre_filter * num_hidden
+            if attrs.get("no_bias") not in ('True', True, 'true'):
+                cur_param += num_hidden
+        elif op == 'BatchNorm':
+            cur_param = pre_filter * 4
+        elif op == 'Embedding':
+            cur_param = (int(attrs.get("input_dim", 0)) *
+                         int(attrs.get("output_dim", 0)))
+        first_connection = pre_node[0] if pre_node else ''
+        fields = [node['name'] + '(' + op + ')',
+                  "x".join(str(x) for x in out_shape),
+                  cur_param, first_connection]
+        print_row(fields, positions)
+        for i in range(1, len(pre_node)):
+            fields = ['', '', '', pre_node[i]]
+            print_row(fields, positions)
+        return cur_param
+
+    total_params = 0
+    heads = set(conf["arg_nodes"])
+    for i, node in enumerate(nodes):
+        out_shape = []
+        op = node["op"]
+        if op == "null" and i > 0:
+            continue
+        if op != "null" or i in heads:
+            if show_shape:
+                key = node["name"] + ("_output" if op != "null" else "")
+                if key in shape_dict:
+                    out_shape = shape_dict[key][1:]
+        total_params += print_layer_summary(node, out_shape)
+        if i == len(nodes) - 1:
+            print('=' * line_length)
+        else:
+            print('_' * line_length)
+    print("Total params: {params}".format(params=total_params))
+    print('_' * line_length)
+    return total_params
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 dtype=None, node_attrs=None, hide_weights=True):
+    """Creates a graphviz Digraph of the symbol (requires graphviz)."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("plot_network requires the graphviz package")
+    node_attrs = node_attrs or {}
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    node_attr = {"shape": "box", "fixedsize": "true",
+                 "width": "1.3", "height": "0.8034", "style": "filled"}
+    node_attr.update(node_attrs)
+    dot = Digraph(name=title, format=save_format)
+    hidden_nodes = set()
+    for node in nodes:
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            if name.endswith(("_weight", "_bias", "_gamma", "_beta",
+                              "_moving_mean", "_moving_var",
+                              "_running_mean", "_running_var")) \
+                    and hide_weights:
+                hidden_nodes.add(name)
+                continue
+            dot.node(name=name, label=name, fillcolor="#8dd3c7",
+                     **node_attr)
+        else:
+            dot.node(name=name, label="%s\n%s" % (op, name),
+                     fillcolor="#fb8072", **node_attr)
+    for node in nodes:
+        if node["op"] == "null":
+            continue
+        for item in node["inputs"]:
+            input_node = nodes[item[0]]
+            if input_node["name"] not in hidden_nodes:
+                dot.edge(tail_name=input_node["name"],
+                         head_name=node["name"])
+    return dot

@@ -1,0 +1,183 @@
+"""Module API tests (parity model: tests/python/unittest/test_module.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import module as mod_pkg
+from mxnet_tpu.io import NDArrayIter, DataBatch
+from mxnet_tpu.module import Module, BucketingModule
+
+
+def _lenet_symbol(num_classes=10):
+    data = mx.sym.var('data')
+    c1 = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, name='c1')
+    a1 = mx.sym.Activation(c1, act_type='relu')
+    p1 = mx.sym.Pooling(a1, kernel=(2, 2), stride=(2, 2), pool_type='max')
+    f1 = mx.sym.Flatten(p1)
+    fc1 = mx.sym.FullyConnected(f1, num_hidden=32, name='fc1')
+    a2 = mx.sym.Activation(fc1, act_type='relu')
+    fc2 = mx.sym.FullyConnected(a2, num_hidden=num_classes, name='fc2')
+    label = mx.sym.var('softmax_label')
+    return mx.sym.SoftmaxOutput(fc2, label, name='softmax')
+
+
+def _toy_data(n=64, num_classes=10, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 1, 8, 8).astype(np.float32)
+    # learnable labels: a fixed random linear readout of the image
+    w = rng.rand(64, num_classes)
+    y = (x.reshape(n, -1) @ w).argmax(axis=1).astype(np.float32)
+    return x, y
+
+
+def test_module_bind_forward():
+    sym = _lenet_symbol()
+    mod = Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[('data', (4, 1, 8, 8))],
+             label_shapes=[('softmax_label', (4,))])
+    mod.init_params(mx.init.Xavier())
+    x, y = _toy_data(4)
+    batch = DataBatch(data=[mx.nd.array(x)], label=[mx.nd.array(y)])
+    mod.forward(batch, is_train=False)
+    outs = mod.get_outputs()
+    assert len(outs) == 1
+    assert outs[0].shape == (4, 10)
+    probs = outs[0].asnumpy()
+    assert np.allclose(probs.sum(axis=1), 1.0, atol=1e-4)
+
+
+def test_module_fit_reduces_loss():
+    sym = _lenet_symbol()
+    x, y = _toy_data(64)
+    train_iter = NDArrayIter(x, y, batch_size=16, shuffle=False)
+    mod = Module(sym, context=mx.cpu())
+    mod.fit(train_iter, num_epoch=3, optimizer='sgd',
+            optimizer_params={'learning_rate': 0.5},
+            initializer=mx.init.Xavier(),
+            eval_metric='acc')
+    score = mod.score(train_iter, 'acc')
+    assert score[0][1] > 0.3, score  # learned something on toy data
+
+
+def test_module_predict():
+    sym = _lenet_symbol()
+    x, y = _toy_data(32)
+    mod = Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[('data', (8, 1, 8, 8))],
+             label_shapes=[('softmax_label', (8,))])
+    mod.init_params(mx.init.Xavier())
+    pred_iter = NDArrayIter(x, None, batch_size=8)
+    out = mod.predict(pred_iter)
+    assert out.shape == (32, 10)
+
+
+def test_module_get_set_params_roundtrip():
+    sym = _lenet_symbol()
+    mod = Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[('data', (4, 1, 8, 8))],
+             label_shapes=[('softmax_label', (4,))])
+    mod.init_params(mx.init.Xavier())
+    args, auxs = mod.get_params()
+    assert 'fc1_weight' in args
+    mod2 = Module(_lenet_symbol(), context=mx.cpu())
+    mod2.bind(data_shapes=[('data', (4, 1, 8, 8))],
+              label_shapes=[('softmax_label', (4,))])
+    mod2.init_params(mx.init.Xavier())
+    mod2.set_params(args, auxs)
+    a2, _ = mod2.get_params()
+    np.testing.assert_allclose(args['fc1_weight'].asnumpy(),
+                               a2['fc1_weight'].asnumpy())
+
+
+def test_module_save_load_checkpoint(tmp_path):
+    sym = _lenet_symbol()
+    mod = Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[('data', (4, 1, 8, 8))],
+             label_shapes=[('softmax_label', (4,))])
+    mod.init_params(mx.init.Xavier())
+    prefix = str(tmp_path / "lenet")
+    mod.save_checkpoint(prefix, 1)
+    mod2 = Module.load(prefix, 1, context=mx.cpu())
+    mod2.bind(data_shapes=[('data', (4, 1, 8, 8))],
+              label_shapes=[('softmax_label', (4,))])
+    x, y = _toy_data(4)
+    batch = DataBatch(data=[mx.nd.array(x)], label=[mx.nd.array(y)])
+    mod.forward(batch, is_train=False)
+    mod2.forward(batch, is_train=False)
+    np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(),
+                               mod2.get_outputs()[0].asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_module_input_grads():
+    sym = _lenet_symbol()
+    mod = Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[('data', (4, 1, 8, 8))],
+             label_shapes=[('softmax_label', (4,))],
+             inputs_need_grad=True)
+    mod.init_params(mx.init.Xavier())
+    x, y = _toy_data(4)
+    batch = DataBatch(data=[mx.nd.array(x)], label=[mx.nd.array(y)])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    (dgrad,) = mod.get_input_grads()
+    assert dgrad.shape == (4, 1, 8, 8)
+    assert float(np.abs(dgrad.asnumpy()).sum()) > 0
+
+
+def _bucket_sym(seq_len):
+    data = mx.sym.var('data')
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name='fc1')
+    a = mx.sym.Activation(fc1, act_type='relu')
+    fc2 = mx.sym.FullyConnected(a, num_hidden=4, name='fc2')
+    label = mx.sym.var('softmax_label')
+    return (mx.sym.SoftmaxOutput(fc2, label, name='softmax'),
+            ('data',), ('softmax_label',))
+
+
+def test_bucketing_module():
+    rng = np.random.RandomState(0)
+    buckets = [8, 12]
+    bm = BucketingModule(_bucket_sym, default_bucket_key=max(buckets),
+                         context=mx.cpu())
+    bm.bind(data_shapes=[('data', (4, 12))],
+            label_shapes=[('softmax_label', (4,))])
+    bm.init_params(mx.init.Xavier())
+    bm.init_optimizer(optimizer='sgd',
+                      optimizer_params={'learning_rate': 0.1})
+    metric = mx.metric.create('acc')
+    for _ in range(4):
+        for key in buckets:
+            x = rng.rand(4, key).astype(np.float32)
+            y = rng.randint(0, 4, 4).astype(np.float32)
+            batch = DataBatch(data=[mx.nd.array(x)],
+                              label=[mx.nd.array(y)],
+                              bucket_key=key)
+            bm.forward(batch, is_train=True)
+            bm.backward()
+            bm.update()
+            bm.update_metric(metric, batch.label)
+    # both buckets share fc1 weights: switch back and check identity
+    bm.switch_bucket(8, None, None)
+    w8 = bm._curr_module._exec_group._exec.arg_dict['fc1_weight']
+    bm.switch_bucket(12, None, None)
+    w12 = bm._curr_module._exec_group._exec.arg_dict['fc1_weight']
+    assert w8 is w12  # literally shared NDArrays
+
+
+def test_module_fit_with_callbacks(tmp_path):
+    sym = _lenet_symbol()
+    x, y = _toy_data(32)
+    train_iter = NDArrayIter(x, y, batch_size=8)
+    seen = []
+    mod = Module(sym, context=mx.cpu())
+    speed = mx.callback.Speedometer(batch_size=8, frequent=2)
+    mod.fit(train_iter, num_epoch=1, optimizer='sgd',
+            optimizer_params={'learning_rate': 0.1},
+            initializer=mx.init.Xavier(),
+            batch_end_callback=[speed, lambda p: seen.append(p.nbatch)],
+            epoch_end_callback=mx.callback.do_checkpoint(
+                str(tmp_path / "cb"), period=1))
+    assert seen, "batch_end_callback never fired"
+    assert (tmp_path / "cb-symbol.json").exists()
+    assert (tmp_path / "cb-0001.params").exists()
