@@ -1,0 +1,7 @@
+"""Gluon recurrent API (parity: ``python/mxnet/gluon/rnn/__init__.py``).
+
+Cells step-by-step (rnn_cell.py), fused layers on ``lax.scan``
+(rnn_layer.py).
+"""
+from .rnn_cell import *  # noqa: F401,F403
+from .rnn_layer import *  # noqa: F401,F403

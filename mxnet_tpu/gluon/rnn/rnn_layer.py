@@ -1,0 +1,235 @@
+"""Fused recurrent layers (parity: ``python/mxnet/gluon/rnn/rnn_layer.py``).
+
+``RNN``/``LSTM``/``GRU`` keep per-layer/direction ``{l,r}{i}_{i2h,h2h}_
+{weight,bias}`` parameters exactly like the reference (rnn_layer.py:34) but
+run the whole multi-layer recurrence through the monolithic ``RNN`` op
+(ops/nn.py, parity rnn.cc:299) whose time loop is a ``lax.scan`` — one XLA
+executable regardless of sequence length, per-step matmuls on the MXU.
+"""
+from __future__ import annotations
+
+from ... import ndarray as nd_mod
+from ...base import MXNetError
+from ...ops.nn import _gates
+from ..block import HybridBlock
+
+__all__ = ['RNN', 'LSTM', 'GRU']
+
+
+class _RNNLayer(HybridBlock):
+    """Base fused layer (parity: rnn_layer.py:34)."""
+
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, mode, projection_size=None,
+                 prefix=None, params=None):
+        self._mode = mode  # before super().__init__: _alias() needs it
+        super().__init__(prefix=prefix, params=params)
+        assert layout in ('TNC', 'NTC'), \
+            "Invalid layout %s; must be one of ['TNC', 'NTC']" % layout
+        if projection_size:
+            raise MXNetError("projection_size (LSTMP) is not supported")
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = _gates(mode)
+
+        ng, ni, nh = self._gates, input_size, hidden_size
+        with self.name_scope():
+            for i in range(num_layers):
+                for j in ['l', 'r'][:self._dir]:
+                    for name, shape, init in [
+                            ('i2h_weight', (ng * nh, ni),
+                             i2h_weight_initializer),
+                            ('h2h_weight', (ng * nh, nh),
+                             h2h_weight_initializer),
+                            ('i2h_bias', (ng * nh,), i2h_bias_initializer),
+                            ('h2h_bias', (ng * nh,), h2h_bias_initializer)]:
+                        pname = '%s%d_%s' % (j, i, name)
+                        setattr(self, pname, self.params.get(
+                            pname, shape=shape, init=init,
+                            allow_deferred_init=True))
+                ni = nh * self._dir
+
+    def __repr__(self):
+        s = '{name}({mapping}, {_layout}'
+        if self._num_layers != 1:
+            s += ', num_layers={_num_layers}'
+        if self._dropout != 0:
+            s += ', dropout={_dropout}'
+        if self._dir == 2:
+            s += ', bidirectional'
+        s += ')'
+        shape = self.l0_i2h_weight.shape
+        mapping = '%s -> %s' % (shape[1] if shape[1] else None,
+                                shape[0] // self._gates)
+        return s.format(name=self.__class__.__name__, mapping=mapping,
+                        **self.__dict__)
+
+    def _alias(self):
+        return self._mode
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial recurrent states for a batch (zeros by default)."""
+        if func is None:
+            func = nd_mod.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            info = dict(info)
+            info.update(kwargs)
+            shape = info.pop('shape')
+            info.pop('__layout__', None)
+            states.append(func(shape, **info))
+        return states
+
+    def _ordered_param_names(self):
+        """Registered param names in the packed-vector layout the RNN op
+        expects (ops/nn.py _unpack_rnn_params; reference rnn-inl.h): all
+        weights per layer/direction (W_x then W_h), then all biases."""
+        weights, biases = [], []
+        for i in range(self._num_layers):
+            for j in ['l', 'r'][:self._dir]:
+                weights.append('%s%d_i2h_weight' % (j, i))
+                weights.append('%s%d_h2h_weight' % (j, i))
+                biases.append('%s%d_i2h_bias' % (j, i))
+                biases.append('%s%d_h2h_bias' % (j, i))
+        return weights + biases
+
+    def _shape_hint(self, inputs, *states):
+        if self.l0_i2h_weight.shape and self.l0_i2h_weight.shape[1] == 0:
+            ni = inputs.shape[2]
+            for j in ['l', 'r'][:self._dir]:
+                p = getattr(self, '%s0_i2h_weight' % j)
+                p.shape = (self._gates * self._hidden_size, ni)
+
+    def forward(self, inputs, states=None):
+        """Run the fused recurrence.
+
+        Returns ``output`` if ``states`` is None, else
+        ``(output, new_states)`` — matching the reference (_RNNLayer
+        .forward semantics, rnn_layer.py:244).
+        """
+        skip_states = states is None
+        if not skip_states:
+            if isinstance(states, nd_mod.NDArray):
+                states = [states]
+            out = super().forward(inputs, *states)
+        else:
+            out = super().forward(inputs)
+        if skip_states:
+            return out[0]
+        return out[0], list(out[1:])
+
+    def hybrid_forward(self, F, inputs, *states, **params):
+        if self._layout == 'NTC':
+            inputs = F.swapaxes(inputs, dim1=0, dim2=1)
+        batch_size = inputs.shape[1]
+        states = list(states)
+        if not states:
+            states = self.begin_state(
+                batch_size, func=nd_mod.zeros, dtype=inputs.dtype,
+                ctx=getattr(inputs, 'context', None))
+        flat = [params[name] for name in self._ordered_param_names()]
+        param_vec = F.concat(*[w.reshape((-1,)) for w in flat], dim=0)
+        rnn_args = [inputs, param_vec, states[0]]
+        if self._mode == 'lstm':
+            rnn_args.append(states[1])
+        out = F.RNN(*rnn_args, state_size=self._hidden_size,
+                    num_layers=self._num_layers,
+                    bidirectional=self._dir == 2, mode=self._mode,
+                    p=self._dropout, state_outputs=True)
+        outputs, state_h, state_c = out[0], out[1], out[2]
+        if self._layout == 'NTC':
+            outputs = F.swapaxes(outputs, dim1=0, dim2=1)
+        if self._mode == 'lstm':
+            return outputs, state_h, state_c
+        return outputs, state_h
+
+    def unroll(self, length, inputs, begin_state=None, layout='NTC',
+               merge_outputs=None, valid_length=None):
+        """Cell-style unroll API on the fused layer (convenience)."""
+        from .rnn_cell import _format_sequence
+        F, inputs, axis, batch_size = _format_sequence(length, inputs,
+                                                       layout, True)
+        states = begin_state or self.begin_state(batch_size, func=F.zeros)
+        outputs, states = self.forward(
+            inputs if layout == self._layout
+            else F.swapaxes(inputs, dim1=0, dim2=1), states)
+        if layout != self._layout:
+            outputs = F.swapaxes(outputs, dim1=0, dim2=1)
+        if valid_length is not None:
+            outputs = F.SequenceMask(outputs, sequence_length=valid_length,
+                                     use_sequence_length=True, axis=axis)
+        if merge_outputs is False:
+            outputs = F.split(outputs, num_outputs=length, axis=axis,
+                              squeeze_axis=True)
+        return outputs, states
+
+
+class RNN(_RNNLayer):
+    """Multi-layer Elman RNN with tanh/relu nonlinearity
+    (parity: rnn_layer.py:307)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation='relu',
+                 layout='TNC', dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer='zeros', h2h_bias_initializer='zeros',
+                 input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         'rnn_' + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{'shape': (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), '__layout__': 'LNC'}]
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer LSTM (parity: rnn_layer.py:404)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout='TNC', dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer='zeros', h2h_bias_initializer='zeros',
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         'lstm', **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{'shape': (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), '__layout__': 'LNC'},
+                {'shape': (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), '__layout__': 'LNC'}]
+
+
+class GRU(_RNNLayer):
+    """Multi-layer GRU (cuDNN variant: reset gate applied to h2h output)
+    (parity: rnn_layer.py:535)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout='TNC', dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer='zeros', h2h_bias_initializer='zeros',
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         'gru', **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{'shape': (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), '__layout__': 'LNC'}]

@@ -1,0 +1,322 @@
+"""gluon.rnn tests: cells + fused layers vs NumPy recurrences, hybridize,
+and an end-to-end char-RNN training run.
+
+Modeled on the reference's test_gluon_rnn.py strategy (numeric parity with
+a hand-written recurrence, consistency between cell-unroll and the fused
+layer, shape checks for combinators).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import rnn, nn
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_lstm_step(x, h, c, wx, wh, bx, bh):
+    gates = x @ wx.T + bx + h @ wh.T + bh
+    i, f, g, o = np.split(gates, 4, axis=-1)
+    c2 = _sigmoid(f) * c + _sigmoid(i) * np.tanh(g)
+    h2 = _sigmoid(o) * np.tanh(c2)
+    return h2, c2
+
+
+def _np_gru_step(x, h, wx, wh, bx, bh):
+    gx = x @ wx.T + bx
+    gh = h @ wh.T + bh
+    rx, zx, nx = np.split(gx, 3, axis=-1)
+    rh, zh, nh = np.split(gh, 3, axis=-1)
+    r = _sigmoid(rx + rh)
+    z = _sigmoid(zx + zh)
+    n = np.tanh(nx + r * nh)
+    return (1 - z) * n + z * h
+
+
+def test_lstm_cell_numpy_parity():
+    rng = np.random.RandomState(0)
+    cell = rnn.LSTMCell(6, input_size=4)
+    cell.initialize(mx.init.Xavier())
+    x = rng.rand(2, 5, 4).astype(np.float32)
+    outs, states = cell.unroll(5, mx.nd.array(x), layout='NTC',
+                               merge_outputs=True)
+
+    wx = cell.i2h_weight.data().asnumpy()
+    wh = cell.h2h_weight.data().asnumpy()
+    bx = cell.i2h_bias.data().asnumpy()
+    bh = cell.h2h_bias.data().asnumpy()
+    h = np.zeros((2, 6), np.float32)
+    c = np.zeros((2, 6), np.float32)
+    ref = []
+    for t in range(5):
+        h, c = _np_lstm_step(x[:, t], h, c, wx, wh, bx, bh)
+        ref.append(h)
+    ref = np.stack(ref, axis=1)
+    np.testing.assert_allclose(outs.asnumpy(), ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(states[0].asnumpy(), h, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(states[1].asnumpy(), c, rtol=1e-5, atol=1e-5)
+
+
+def test_gru_cell_numpy_parity():
+    rng = np.random.RandomState(1)
+    cell = rnn.GRUCell(5, input_size=3)
+    cell.initialize(mx.init.Xavier())
+    x = rng.rand(4, 3, 3).astype(np.float32)
+    outs, states = cell.unroll(3, mx.nd.array(x), layout='NTC',
+                               merge_outputs=True)
+    wx = cell.i2h_weight.data().asnumpy()
+    wh = cell.h2h_weight.data().asnumpy()
+    bx = cell.i2h_bias.data().asnumpy()
+    bh = cell.h2h_bias.data().asnumpy()
+    h = np.zeros((4, 5), np.float32)
+    ref = []
+    for t in range(3):
+        h = _np_gru_step(x[:, t], h, wx, wh, bx, bh)
+        ref.append(h)
+    ref = np.stack(ref, axis=1)
+    np.testing.assert_allclose(outs.asnumpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_rnn_cell_relu_tanh():
+    rng = np.random.RandomState(2)
+    for act in ('relu', 'tanh'):
+        cell = rnn.RNNCell(4, activation=act, input_size=3)
+        cell.initialize(mx.init.Xavier())
+        x = rng.rand(2, 3).astype(np.float32)
+        h0 = rng.rand(2, 4).astype(np.float32)
+        out, states = cell(mx.nd.array(x), [mx.nd.array(h0)])
+        wx = cell.i2h_weight.data().asnumpy()
+        wh = cell.h2h_weight.data().asnumpy()
+        bx = cell.i2h_bias.data().asnumpy()
+        bh = cell.h2h_bias.data().asnumpy()
+        pre = x @ wx.T + bx + h0 @ wh.T + bh
+        ref = np.maximum(pre, 0) if act == 'relu' else np.tanh(pre)
+        np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_fused_lstm_layer_matches_cell():
+    """rnn.LSTM (lax.scan path) == LSTMCell.unroll (python-loop path)."""
+    rng = np.random.RandomState(3)
+    layer = rnn.LSTM(7, input_size=4)
+    layer.initialize(mx.init.Xavier())
+    x = rng.rand(6, 2, 4).astype(np.float32)  # TNC
+    out = layer(mx.nd.array(x))
+
+    cell = rnn.LSTMCell(7, input_size=4)
+    cell.initialize()
+    cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+    ref, _ = cell.unroll(6, mx.nd.array(x), layout='TNC',
+                         merge_outputs=True)
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_fused_layer_states_roundtrip():
+    layer = rnn.LSTM(5, num_layers=2, layout='NTC')
+    layer.initialize()
+    x = mx.nd.array(np.random.RandomState(4).rand(3, 4, 2).astype(np.float32))
+    states = layer.begin_state(batch_size=3)
+    assert [s.shape for s in states] == [(2, 3, 5), (2, 3, 5)]
+    out, new_states = layer(x, states)
+    assert out.shape == (3, 4, 5)
+    assert [s.shape for s in new_states] == [(2, 3, 5), (2, 3, 5)]
+    # h_n must equal the last output step for the top layer
+    np.testing.assert_allclose(new_states[0].asnumpy()[-1],
+                               out.asnumpy()[:, -1], rtol=1e-5, atol=1e-5)
+
+
+def test_bidirectional_layer_shapes_and_directions():
+    rng = np.random.RandomState(5)
+    layer = rnn.GRU(4, bidirectional=True, input_size=3)
+    layer.initialize(mx.init.Xavier())
+    x = rng.rand(5, 2, 3).astype(np.float32)
+    out, states = layer(mx.nd.array(x), layer.begin_state(batch_size=2))
+    assert out.shape == (5, 2, 8)
+    assert states[0].shape == (2, 2, 4)
+    # forward half of the last step == forward state; backward half of the
+    # FIRST step == backward state
+    np.testing.assert_allclose(states[0].asnumpy()[0], out.asnumpy()[-1, :, :4],
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(states[0].asnumpy()[1], out.asnumpy()[0, :, 4:],
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bidirectional_cell_matches_layer():
+    rng = np.random.RandomState(6)
+    layer = rnn.LSTM(4, bidirectional=True, input_size=3)
+    layer.initialize(mx.init.Xavier())
+    x = rng.rand(5, 2, 3).astype(np.float32)
+    out = layer(mx.nd.array(x))
+
+    l_cell = rnn.LSTMCell(4, input_size=3)
+    r_cell = rnn.LSTMCell(4, input_size=3)
+    bi = rnn.BidirectionalCell(l_cell, r_cell)
+    bi.initialize()
+    l_cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    l_cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    l_cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    l_cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+    r_cell.i2h_weight.set_data(layer.r0_i2h_weight.data())
+    r_cell.h2h_weight.set_data(layer.r0_h2h_weight.data())
+    r_cell.i2h_bias.set_data(layer.r0_i2h_bias.data())
+    r_cell.h2h_bias.set_data(layer.r0_h2h_bias.data())
+    ref, _ = bi.unroll(5, mx.nd.array(x), layout='TNC', merge_outputs=True)
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_sequential_and_residual_cells():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(8))
+    stack.add(rnn.ResidualCell(rnn.GRUCell(8)))
+    stack.initialize()
+    x = mx.nd.array(np.random.RandomState(7).rand(2, 4, 8).astype(np.float32))
+    outs, states = stack.unroll(4, x, layout='NTC', merge_outputs=True)
+    assert outs.shape == (2, 4, 8)
+    assert len(states) == 3  # lstm h,c + gru h
+    assert len(stack) == 2
+    assert isinstance(stack[1], rnn.ResidualCell)
+
+
+def test_residual_cell_is_residual():
+    base = rnn.RNNCell(4, input_size=4)
+    res = rnn.ResidualCell(base)
+    res.initialize()
+    x = np.random.RandomState(8).rand(2, 3, 4).astype(np.float32)
+    outs, _ = res.unroll(3, mx.nd.array(x), layout='NTC',
+                         merge_outputs=True)
+    base._modified = False
+    inner, _ = base.unroll(3, mx.nd.array(x), layout='NTC',
+                           merge_outputs=True)
+    base._modified = True
+    np.testing.assert_allclose(outs.asnumpy(), inner.asnumpy() + x,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_zoneout_predict_is_identity_passthrough():
+    # In predict mode Dropout is identity, so zoneout keeps the new states.
+    cell = rnn.ZoneoutCell(rnn.LSTMCell(6, input_size=4), 0.5, 0.5)
+    cell.initialize()
+    x = np.random.RandomState(9).rand(2, 3, 4).astype(np.float32)
+    outs, _ = cell.unroll(3, mx.nd.array(x), layout='NTC',
+                          merge_outputs=True)
+    assert outs.shape == (2, 3, 6)
+    assert np.isfinite(outs.asnumpy()).all()
+
+
+def test_dropout_cell_train_vs_predict():
+    cell = rnn.DropoutCell(0.5)
+    x = mx.nd.ones((2, 3, 4))
+    outs, _ = cell.unroll(3, x, layout='NTC', merge_outputs=True)
+    np.testing.assert_allclose(outs.asnumpy(), np.ones((2, 3, 4)))
+
+
+def test_unroll_valid_length_masks_tail():
+    cell = rnn.LSTMCell(4, input_size=2)
+    cell.initialize()
+    x = mx.nd.array(np.random.RandomState(10).rand(2, 5, 2)
+                    .astype(np.float32))
+    valid = mx.nd.array(np.array([3, 5], np.float32))
+    outs, states = cell.unroll(5, x, layout='NTC', merge_outputs=True,
+                               valid_length=valid)
+    o = outs.asnumpy()
+    assert o.shape == (2, 5, 4)
+    # sample 0 masked beyond t=3
+    assert np.abs(o[0, 3:]).sum() == 0
+    assert np.abs(o[0, :3]).sum() > 0
+
+
+def test_rnn_layer_hybridize_and_grad():
+    layer = rnn.GRU(8, num_layers=2, layout='NTC', input_size=4)
+    layer.initialize()
+    layer.hybridize()
+    x = mx.nd.array(np.random.RandomState(11).rand(2, 6, 4)
+                    .astype(np.float32))
+    with mx.autograd.record():
+        out = layer(x)
+        loss = (out * out).sum()
+    loss.backward()
+    g = layer.l0_i2h_weight.grad()
+    assert g.shape == layer.l0_i2h_weight.shape
+    assert float(np.abs(g.asnumpy()).sum()) > 0
+    # second call hits the executable cache
+    out2 = layer(x)
+    assert out2.shape == (2, 6, 8)
+
+
+def test_char_rnn_end_to_end_training():
+    """e2e: embedding -> LSTM -> dense trains next-char prediction and the
+    loss decreases (reference example/rnn char-rnn pattern)."""
+    rng = np.random.RandomState(12)
+    vocab, seq_len, batch, hidden = 16, 8, 8, 32
+    # learnable structure: each sequence counts up from a random start
+    starts = rng.randint(0, vocab, (64, 1))
+    data = (starts + np.arange(seq_len + 1)) % vocab
+
+    class CharRNN(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.emb = nn.Embedding(vocab, 12)
+                self.lstm = rnn.LSTM(hidden, layout='NTC', input_size=12)
+                self.out = nn.Dense(vocab, flatten=False)
+
+        def hybrid_forward(self, F, x):
+            h = self.emb(x)
+            h = self.lstm(h)
+            return self.out(h)
+
+    net = CharRNN()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': 0.01})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    losses = []
+    for epoch in range(6):
+        epoch_loss = 0.0
+        for i in range(0, 64, batch):
+            xb = mx.nd.array(data[i:i + batch, :-1].astype(np.float32))
+            yb = mx.nd.array(data[i:i + batch, 1:].astype(np.float32))
+            with mx.autograd.record():
+                logits = net(xb)
+                loss = loss_fn(logits, yb)
+            loss.backward()
+            trainer.step(batch)
+            epoch_loss += float(loss.mean().asnumpy())
+        losses.append(epoch_loss)
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_fused_layer_unroll_layout_and_valid_length():
+    layer = rnn.LSTM(5, input_size=3)  # internal layout TNC
+    layer.initialize()
+    x = mx.nd.array(np.random.RandomState(14).rand(2, 4, 3)
+                    .astype(np.float32))  # NTC
+    outs, states = layer.unroll(4, x, layout='NTC', merge_outputs=True,
+                                valid_length=mx.nd.array([2., 4.]))
+    assert outs.shape == (2, 4, 5)  # caller layout preserved
+    o = outs.asnumpy()
+    assert np.abs(o[0, 2:]).sum() == 0  # masked beyond valid_length
+    assert np.abs(o[0, :2]).sum() > 0
+
+
+def test_rnn_layer_save_load_roundtrip(tmp_path):
+    layer = rnn.LSTM(6, num_layers=2, input_size=3)
+    layer.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(13).rand(4, 2, 3)
+                    .astype(np.float32))
+    ref = layer(x).asnumpy()
+    path = str(tmp_path / "lstm.params")
+    layer.save_parameters(path)
+
+    layer2 = rnn.LSTM(6, num_layers=2, input_size=3)
+    layer2.load_parameters(path)
+    np.testing.assert_allclose(layer2(x).asnumpy(), ref, rtol=1e-6,
+                               atol=1e-6)

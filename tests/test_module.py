@@ -126,8 +126,12 @@ def test_module_input_grads():
 
 
 def _bucket_sym(seq_len):
-    data = mx.sym.var('data')
-    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name='fc1')
+    # Bucket key varies the time axis only; all param shapes are
+    # bucket-invariant (the BucketingModule contract: buckets share
+    # literally the same weight arrays).
+    data = mx.sym.var('data')                     # (N, seq_len, 8)
+    pooled = mx.sym.mean(data, axis=1)            # (N, 8)
+    fc1 = mx.sym.FullyConnected(pooled, num_hidden=16, name='fc1')
     a = mx.sym.Activation(fc1, act_type='relu')
     fc2 = mx.sym.FullyConnected(a, num_hidden=4, name='fc2')
     label = mx.sym.var('softmax_label')
@@ -140,7 +144,7 @@ def test_bucketing_module():
     buckets = [8, 12]
     bm = BucketingModule(_bucket_sym, default_bucket_key=max(buckets),
                          context=mx.cpu())
-    bm.bind(data_shapes=[('data', (4, 12))],
+    bm.bind(data_shapes=[('data', (4, 12, 8))],
             label_shapes=[('softmax_label', (4,))])
     bm.init_params(mx.init.Xavier())
     bm.init_optimizer(optimizer='sgd',
@@ -148,7 +152,7 @@ def test_bucketing_module():
     metric = mx.metric.create('acc')
     for _ in range(4):
         for key in buckets:
-            x = rng.rand(4, key).astype(np.float32)
+            x = rng.rand(4, key, 8).astype(np.float32)
             y = rng.randint(0, 4, 4).astype(np.float32)
             batch = DataBatch(data=[mx.nd.array(x)],
                               label=[mx.nd.array(y)],
