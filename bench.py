@@ -68,7 +68,10 @@ def _run(platform):
     net = vision.resnet50_v1()
     net.initialize(mx.init.Xavier())
     if on_accel:
-        net.cast('bfloat16')  # MXU-native dtype; accumulation f32 in hardware
+        # AMP: matmul/conv in bf16 (MXU-native), sensitive ops in f32
+        from mxnet_tpu import amp
+        amp.init('bfloat16')
+        amp.convert_hybrid_block(net)
 
     step = parallel.JitTrainStep(
         net, gluon.loss.SoftmaxCrossEntropyLoss(),

@@ -104,20 +104,33 @@ class TapeNode:
         "skip_grad_inputs",
         "cotangents",
         "op_name",
+        "prim",
         "__weakref__",
     )
 
-    def __init__(self, vjp_fn, inputs, out_avals, skip_grad_inputs=0, op_name=""):
+    def __init__(self, vjp_fn, inputs, out_avals, skip_grad_inputs=0, op_name="",
+                 prim=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs
         self.out_avals = out_avals
         self.skip_grad_inputs = skip_grad_inputs
         self.cotangents = None
         self.op_name = op_name
+        # (fn, datas, n_rng): the primal callable + raw input arrays, kept so
+        # create_graph=True can RE-linearize (jax.vjp closures bake the primal
+        # point in, so higher order needs the function itself; reference:
+        # second-order FGradient entries like _backward_backward_FullyConnected,
+        # src/operator/nn/fully_connected.cc:363)
+        self.prim = prim
 
     def seed(self, idx, ct):
         if self.cotangents is None:
             self.cotangents = [None] * len(self.out_avals)
+        dtype = self.out_avals[idx][1]
+        if getattr(ct, "dtype", None) != dtype:
+            # consumers may run in a different dtype than this op produced
+            # (AMP dispatch-time casts); vjp demands exact cotangent dtypes
+            ct = ct.astype(dtype)
         cur = self.cotangents[idx]
         self.cotangents[idx] = ct if cur is None else cur + ct
 
@@ -233,21 +246,151 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
                 node.cotangents = None
 
 
+def _apply_node_vjp_taped(node, cts):
+    """Apply a node's backward as a RECORDED op (create_graph support).
+
+    ``cts`` are NDArray cotangents for each node output.  Re-linearizes the
+    stored primal (``node.prim``) so the produced input-cotangents carry
+    their own tape nodes — grads of grads (and third order, recursively)
+    just work.  Returns NDArray-or-None per ``node.inputs`` entry.
+    """
+    import jax
+
+    from .ndarray.ndarray import NDArray
+
+    raw_cts = tuple(c.data() for c in cts)
+    if node.prim is None:
+        # opaque vjp (custom Function, hybridized cache): first-order only
+        raw = node.vjp_fn(raw_cts)
+        skip = node.skip_grad_inputs
+        raw = raw[skip:] if skip else raw
+        return [None if g is None else NDArray(g) for g in raw]
+
+    fn, datas, n_rng = node.prim
+    n_prim = len(datas)
+
+    def full(*args):
+        prim, ct = args[:n_prim], args[n_prim:]
+        _, vjp = jax.vjp(fn, *prim)
+        return vjp(tuple(ct))
+
+    args = tuple(datas) + raw_cts
+    outs, vjp2 = jax.vjp(full, *args)
+    new_node = TapeNode(
+        vjp2,
+        list(node.inputs) + list(cts),
+        [(o.shape, o.dtype) for o in outs],
+        skip_grad_inputs=n_rng,
+        op_name="_backward_" + node.op_name,
+        prim=(full, args, n_rng),
+    )
+    results = []
+    for i in range(n_rng, n_prim):
+        arr = NDArray(outs[i])
+        arr._tape_node = new_node
+        arr._tape_index = i
+        results.append(arr)
+    return results
+
+
+def _taped_backward(heads, head_grads, train_mode=True):
+    """NDArray-valued reverse pass that records itself (create_graph=True).
+
+    Returns ``{id(leaf NDArray): grad NDArray}`` for every reachable marked
+    leaf; grad NDArrays carry tape nodes, so a second ``backward``/``grad``
+    differentiates through them.
+    """
+    import jax.numpy as jnp
+
+    from .ndarray.ndarray import NDArray
+
+    seeds = {}
+    node_by_id = {}
+
+    def seed_nd(node, idx, ct):
+        node_by_id[id(node)] = node
+        lst = seeds.setdefault(id(node), [None] * len(node.out_avals))
+        lst[idx] = ct if lst[idx] is None else lst[idx] + ct
+
+    leaf_grads = {}
+
+    def leaf_nd(leaf, ct):
+        cur = leaf_grads.get(id(leaf))
+        leaf_grads[id(leaf)] = ct if cur is None else cur + ct
+
+    roots = []
+    with record(train_mode):
+        for h, hg in zip(heads, head_grads):
+            g = hg if isinstance(hg, NDArray) else NDArray(
+                jnp.ones(h.shape, h.dtype) if hg is None
+                else jnp.asarray(hg))
+            node = h._tape_node
+            if node is None:
+                if h._marked:
+                    leaf_nd(h, g)
+                    continue
+                raise MXNetError(
+                    "cannot differentiate a head that is not in the "
+                    "recorded graph")
+            seed_nd(node, h._tape_index, g)
+            roots.append(node)
+
+        for node in _topo_order(roots):
+            lst = seeds.get(id(node))
+            if lst is None:
+                continue
+            cts = [c if c is not None else NDArray(jnp.zeros(s, d))
+                   for c, (s, d) in zip(lst, node.out_avals)]
+            in_cts = _apply_node_vjp_taped(node, cts)
+            for inp, ct in zip(node.inputs, in_cts):
+                if ct is None:
+                    continue
+                child = inp._tape_node
+                if child is not None:
+                    seed_nd(child, inp._tape_index, ct)
+                elif inp._marked:
+                    leaf_nd(inp, ct)
+    return leaf_grads
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
          train_mode=True):
     """Return grads of ``heads`` w.r.t. ``variables`` without touching ``.grad``.
 
-    Parity: ``autograd.grad`` (python/mxnet/autograd.py:273).  ``create_graph``
-    (higher-order grad) is served by re-taping: we rerun the VJPs; since every
-    VJP is itself a jax-transformable closure, second order works by recording
-    the backward ops — not yet wired, raises for now.
+    Parity: ``autograd.grad`` (python/mxnet/autograd.py:273).  With
+    ``create_graph=True`` the backward pass itself is recorded (each node's
+    primal is re-linearized via ``jax.vjp``), so the returned grads can be
+    differentiated again — arbitrary order (ref test_higher_order_grad.py).
     """
     from .ndarray.ndarray import NDArray
 
     if create_graph:
-        raise MXNetError(
-            "create_graph=True: use hybridized grad-of-grad (symbol.grad) instead"
-        )
+        single = isinstance(variables, NDArray)
+        var_list = [variables] if single else list(variables)
+        if isinstance(heads, NDArray):
+            heads = [heads]
+            if head_grads is not None and not isinstance(
+                    head_grads, (list, tuple)):
+                head_grads = [head_grads]
+        if head_grads is None:
+            head_grads = [None] * len(heads)
+        saved = [v._marked for v in var_list]
+        for v in var_list:
+            v._marked = True
+        try:
+            leaf_map = _taped_backward(heads, head_grads, train_mode)
+        finally:
+            for v, m in zip(var_list, saved):
+                v._marked = m
+        outs = []
+        for v in var_list:
+            g = leaf_map.get(id(v))
+            if g is None:
+                import jax.numpy as jnp
+
+                g = NDArray(jnp.zeros(v.shape, v.dtype), ctx=v.context)
+            outs.append(g)
+        return outs[0] if single else outs
     single = isinstance(variables, NDArray)
     if single:
         variables = [variables]

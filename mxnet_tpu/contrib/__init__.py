@@ -1,0 +1,2 @@
+"""``mx.contrib`` — contrib namespaces (parity: python/mxnet/contrib/)."""
+from .. import amp  # noqa: F401

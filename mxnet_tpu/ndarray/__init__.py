@@ -22,6 +22,13 @@ from .register import populate as _populate
 
 _populate(globals())
 
+# control-flow operators (lax.scan/while/cond lowering; ops/control_flow.py)
+from ..ops.control_flow import (  # noqa: E402
+    foreach as _contrib_foreach,
+    while_loop as _contrib_while_loop,
+    cond as _contrib_cond,
+)
+
 # contrib sub-namespace: ops named _contrib_* surface as nd.contrib.<name>
 class _ContribNS:
     def __getattr__(self, item):

@@ -129,6 +129,12 @@ class NDArray:
         self._var.rethrow()
         return _np.asarray(self._data)
 
+    def __array__(self, dtype=None, copy=None):
+        # numpy protocol: without this np.asarray() would fall back to
+        # element-wise __getitem__ iteration (one device gather per scalar)
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
     def asscalar(self):
         if self.size != 1:
             raise ValueError("the array is not scalar-sized")
@@ -528,13 +534,16 @@ class NDArray:
 
     def __getitem__(self, key):
         key = self._conv_index(key)
-        out = NDArray(self._data[key], ctx=self._ctx)
-        if self._tape_node is not None and autograd.is_recording():
-            # route through an op so slicing stays differentiable on tape
-            raise MXNetError(
-                "basic indexing on taped arrays: use nd.slice/slice_axis"
-            )
-        return out
+        if autograd.is_recording() and self._in_graph:
+            # route through a recorded op so indexing stays differentiable
+            # (reference supports basic-index reads under autograd;
+            # index arrays are gather constants — no grad w.r.t. them)
+            from ..ops.registry import invoke_fn
+
+            (out,) = invoke_fn(lambda d: (d[key],), [self],
+                               op_name="_index")
+            return out
+        return NDArray(self._data[key], ctx=self._ctx)
 
     def __setitem__(self, key, value):
         if autograd.is_recording() and self._in_graph:

@@ -101,12 +101,10 @@ def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
 
         def body(i, keep):
             sup = jnp.logical_and(keep[i], ious[i] > overlap_thresh)
-            sup = sup.at[:i + 1].set(False)
+            sup = jnp.logical_and(sup, jnp.arange(N) > i)
             return jnp.logical_and(keep, ~sup)
 
         keep = lax.fori_loop(0, N, body, valid0)
-        out = jnp.where(keep[:, None], sorted_boxes,
-                        sorted_boxes.at[:, score_index].set(-1.0) * 0 - 1.0)
         out = jnp.where(keep[:, None], sorted_boxes, -jnp.ones_like(sorted_boxes))
         return out, order.astype(jnp.float32)
 

@@ -1,0 +1,239 @@
+"""Control-flow operators: ``foreach`` / ``while_loop`` / ``cond``.
+
+Reference: ``src/operator/control_flow.cc:475-531`` (``_foreach``,
+``_while_loop``, ``_cond`` — stateful ops executing sub-CachedOps) and the
+Python frontend ``python/mxnet/ndarray/contrib.py`` (foreach:216,
+while_loop:360, cond:537).
+
+TPU-native design: the Python body is traced ONCE over NDArray-wrapped
+tracers (the same trick ``hybridize()`` uses) and lowered to a single
+``lax.scan`` / masked-scan / ``lax.cond`` — XLA-compilable, so a foreach
+inside a jitted train step costs one fused loop instead of per-iteration
+dispatch.  Gradients flow through ``registry.invoke_fn`` (tape node with a
+re-linearizable prim), so first- and higher-order autograd work.
+
+Deviations (all from XLA's static-shape rule):
+- ``while_loop`` always runs ``max_iterations`` scan steps with a liveness
+  mask; outputs are padded to ``max_iterations`` rows (the reference's
+  *symbolic* while_loop does the same; its imperative one trims).
+- ``cond`` evaluates the predicate eagerly when it is concrete (imperative
+  mode — only the taken branch runs, like the reference); under a trace it
+  lowers to ``lax.cond`` with both branches traced.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .. import autograd
+from . import registry as _reg
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def foreach(body, data, init_states):
+    """Iterate ``body(data_t, states) -> (outputs, new_states)`` over axis 0.
+
+    Parity: ``mx.nd.contrib.foreach`` (ndarray/contrib.py:216).  Returns
+    (outputs stacked on axis 0, final states), mirroring the input nesting
+    (single NDArray in → single NDArray out).
+    """
+    from ..ndarray.ndarray import NDArray
+
+    data_list = _as_list(data)
+    states_list = _as_list(init_states)
+    data_single = not isinstance(data, (list, tuple))
+    states_single = not isinstance(init_states, (list, tuple))
+    n_data, n_states = len(data_list), len(states_list)
+
+    if autograd.is_recording():
+        # imperative reference semantics (ndarray/contrib.py foreach is a
+        # Python loop): every step tapes normally, so gradients also flow
+        # to arrays the body merely closes over — which the one-op scan
+        # lowering below cannot see.
+        from .. import ndarray as nd
+
+        T = data_list[0].shape[0]
+        states = init_states
+        outs_acc = None
+        out_single = True
+        for t in range(T):
+            xs = [d[t] for d in data_list]
+            outs, states = body(xs[0] if data_single else xs, states)
+            outs_l = _as_list(outs)
+            if outs_acc is None:
+                outs_acc = [[] for _ in outs_l]
+                out_single = not isinstance(outs, (list, tuple))
+            for acc, o in zip(outs_acc, outs_l):
+                acc.append(o)
+        stacked = [nd.stack(*acc, axis=0) for acc in (outs_acc or [])]
+        if outs_acc is None:
+            return [], states
+        return (stacked[0] if out_single else stacked), states
+
+    meta = {}
+
+    def fn(*arrays):
+        xs = list(arrays[:n_data])
+        carry0 = list(arrays[n_data:])
+
+        def step(carry, x):
+            with autograd.pause():
+                xs_nd = [NDArray(a) for a in x]
+                st_nd = [NDArray(a) for a in carry]
+                outs, new_states = body(
+                    xs_nd[0] if data_single else xs_nd,
+                    st_nd[0] if states_single else st_nd)
+            outs_l = _as_list(outs)
+            ns_l = _as_list(new_states)
+            meta["n_out"] = len(outs_l)
+            meta["out_single"] = not isinstance(outs, (list, tuple))
+            return ([s.data() for s in ns_l],
+                    [o.data() for o in outs_l])
+
+        final, ys = lax.scan(step, carry0, xs)
+        return tuple(ys) + tuple(final)
+
+    results = _reg.invoke_fn(fn, data_list + states_list, op_name="_foreach")
+    n_out = meta["n_out"]
+    outs, states = results[:n_out], results[n_out:]
+    if meta["out_single"]:
+        outs = outs[0]
+    if states_single:
+        states = states[0]
+    return outs, states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Loop ``func(*loop_vars) -> (outputs, new_loop_vars)`` while
+    ``cond(*loop_vars)`` holds, at most ``max_iterations`` times.
+
+    Parity: ``mx.nd.contrib.while_loop`` (ndarray/contrib.py:360).  Lowered
+    to a masked ``lax.scan`` of length ``max_iterations`` so the loop is
+    reverse-differentiable and static-shaped; rows of ``outputs`` beyond
+    the actual step count are zero (symbolic-mode padding semantics).
+    """
+    from ..ndarray.ndarray import NDArray
+
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations on TPU "
+                         "(static shapes)")
+    lv_list = _as_list(loop_vars)
+    n_lv = len(lv_list)
+
+    if autograd.is_recording():
+        # imperative reference semantics: eager Python loop, outputs
+        # trimmed to actual steps (ndarray-mode while_loop), grads taped
+        # per step (incl. closure-captured arrays)
+        from .. import ndarray as nd
+
+        lv = list(lv_list)
+        outs_acc = None
+        steps = 0
+        while steps < max_iterations and bool(cond(*lv).asnumpy().item()):
+            outs, new_lv = func(*lv)
+            lv = _as_list(new_lv)
+            outs_l = _as_list(outs)
+            if outs_acc is None:
+                outs_acc = [[] for _ in outs_l]
+                out_single = not isinstance(outs, (list, tuple))
+            for acc, o in zip(outs_acc, outs_l):
+                acc.append(o)
+            steps += 1
+        stacked = [nd.stack(*acc, axis=0) for acc in (outs_acc or [])]
+        if outs_acc is None:
+            stacked, out_single = [], True
+        outs_ret = (stacked[0] if out_single and stacked else stacked)
+        lv_ret = lv if isinstance(loop_vars, (list, tuple)) else lv[0]
+        return outs_ret, lv_ret
+
+    meta = {}
+
+    def fn(*arrays):
+        lv0 = list(arrays)
+
+        def trace_cond(lv):
+            with autograd.pause():
+                p = cond(*[NDArray(a) for a in lv])
+            return p.data().astype(jnp.bool_).reshape(())
+
+        def trace_step(lv):
+            with autograd.pause():
+                outs, new_lv = func(*[NDArray(a) for a in lv])
+            outs_l = _as_list(outs)
+            new_l = _as_list(new_lv)
+            meta["n_out"] = len(outs_l)
+            meta["out_single"] = not isinstance(outs, (list, tuple))
+            if len(new_l) != n_lv:
+                raise MXNetError("func must return as many loop_vars as it "
+                                 "received")
+            return ([o.data() for o in outs_l],
+                    [s.data() for s in new_l])
+
+        def step(carry, _):
+            alive, lv = carry
+            outs, new_lv = trace_step(lv)
+            lv_next = [jnp.where(alive, n, o) for n, o in zip(new_lv, lv)]
+            ys = [jnp.where(alive, o, jnp.zeros_like(o)) for o in outs]
+            alive_next = jnp.logical_and(alive, trace_cond(lv_next))
+            return (alive_next, lv_next), (ys, alive)
+
+        alive0 = trace_cond(lv0)
+        (_, lv_fin), (ys, alive_hist) = lax.scan(
+            step, (alive0, lv0), None, length=int(max_iterations))
+        n_steps = jnp.sum(alive_hist.astype(jnp.int32))
+        return tuple(ys) + tuple(lv_fin) + (n_steps,)
+
+    results = _reg.invoke_fn(fn, lv_list, op_name="_while_loop")
+    n_out = meta["n_out"]
+    outs = results[:n_out]
+    states = results[n_out:n_out + n_lv]
+    if meta["out_single"]:
+        outs = outs[0]
+    if not isinstance(loop_vars, (list, tuple)):
+        states = states[0]
+    return outs, states
+
+
+def cond(pred, then_func, else_func):
+    """Run ``then_func()`` if ``pred`` else ``else_func()``.
+
+    Parity: ``mx.nd.contrib.cond`` (ndarray/contrib.py:537).  With a
+    concrete predicate only the taken branch executes (imperative
+    reference semantics, fully taped); under a jax trace both branches
+    are traced into one ``lax.cond``.
+    """
+    from ..ndarray.ndarray import NDArray
+
+    p = pred.data() if isinstance(pred, NDArray) else jnp.asarray(pred)
+    try:
+        taken = bool(p)
+    except jax.errors.TracerBoolConversionError:
+        taken = None
+    if taken is not None:
+        return then_func() if taken else else_func()
+
+    meta = {}
+
+    def _branch(func):
+        def run():
+            with autograd.pause():
+                out = func()
+            single = not isinstance(out, (list, tuple))
+            meta.setdefault("single", single)
+            if meta["single"] != single:
+                raise MXNetError("cond branches must return the same "
+                                 "structure")
+            return tuple(o.data() for o in _as_list(out))
+        return run
+
+    # branch bodies are traced INSIDE lax.cond, so only the taken branch
+    # executes at runtime (and XLA never evaluates the untaken one)
+    outs = lax.cond(p.reshape(()).astype(jnp.bool_),
+                    _branch(then_func), _branch(else_func))
+    wrapped = [NDArray(o) for o in outs]
+    return wrapped[0] if meta["single"] else wrapped

@@ -106,20 +106,52 @@ def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(
 def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(),
                    adj=(), target_shape=(), num_filter=0, num_group=1, no_bias=True,
                    layout="NCHW", cudnn_tune=None, cudnn_off=False, workspace=1024):
-    """Transposed conv (parity: src/operator/nn/deconvolution.cc)."""
+    """Transposed conv (parity: src/operator/nn/deconvolution.cc).
+
+    out = (in-1)*stride - 2*pad + dilate*(kernel-1) + 1 + adj per spatial
+    dim (deconvolution-inl.h InferShape).  Lowered as the true transpose:
+    ``conv_general_dilated`` with lhs_dilation=stride, a spatially-flipped
+    kernel, and edge padding ``dilate*(k-1) - pad`` — the gradient of the
+    matching Convolution, so XLA fuses it onto the MXU like any conv.
+    """
+    if layout not in ("NCHW", "NCW", "NCDHW"):
+        raise ValueError("Deconvolution: channel-first layouts only")
     nd = len(kernel) if kernel else 2
     stride = _as_tuple(stride, nd) if stride else (1,) * nd
     pad = _as_tuple(pad, nd) if pad else (0,) * nd
     dilate = _as_tuple(dilate, nd) if dilate else (1,) * nd
+    adj = _as_tuple(adj, nd) if adj else (0,) * nd
+    if target_shape:
+        # reference solves pad from the requested output size, absorbing
+        # an odd remainder into adj (deconvolution-inl.h InferPad:
+        # pad = (total+1)/2, adj = total % 2)
+        target = _as_tuple(target_shape, nd)
+        total = [dilate[i] * (kernel[i] - 1) + stride[i]
+                 * (data.shape[2 + i] - 1) + 1 - target[i]
+                 for i in range(nd)]
+        pad = tuple((t + 1) // 2 for t in total)
+        adj = tuple(t % 2 for t in total)
+    g = num_group
+    c_in = weight.shape[0]
+    # weight layout (C_in, C_out/g, *k) → flip spatial, regroup to
+    # (C_out, C_in/g, *k) for OIHW dimension numbers
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
+    w = w.reshape((g, c_in // g) + w.shape[1:])
+    w = jnp.swapaxes(w, 1, 2)
+    w = w.reshape((g * w.shape[1], c_in // g) + tuple(kernel))
     specs = _CONV_DIMNUMS[layout]
-    dn = lax.conv_dimension_numbers(data.shape, weight.shape, specs)
-    out = lax.conv_transpose(
-        data, weight,
-        strides=stride,
-        padding=[(p, p) for p in pad],
+    dn = lax.conv_dimension_numbers(data.shape, w.shape, specs)
+    pads = [(dilate[i] * (kernel[i] - 1) - pad[i],
+             dilate[i] * (kernel[i] - 1) - pad[i] + adj[i])
+            for i in range(nd)]
+    out = lax.conv_general_dilated(
+        data, w,
+        window_strides=(1,) * nd,
+        padding=pads,
+        lhs_dilation=stride,
         rhs_dilation=dilate,
         dimension_numbers=dn,
-        transpose_kernel=True,
+        feature_group_count=g,
     ).astype(data.dtype)
     if not no_bias and bias is not None:
         if layout in ("NWC", "NHWC", "NDHWC"):

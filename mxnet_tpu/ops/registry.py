@@ -159,6 +159,12 @@ def _prep(reg, datas, attrs, fields):
     attrs = {k: v for k, v in (attrs or {}).items() if v is not None or True}
     if reg.needs_mode and "_mode" not in attrs:
         attrs["_mode"] = "train" if autograd.is_training() else "predict"
+    from .. import amp as _amp
+
+    if _amp.is_active():
+        # AMP's dispatch-time dtype rewrite (amp/__init__.py) — the
+        # imperative+trace analogue of the reference's low_precision_pass
+        datas = _amp.transform_inputs(reg.name, tuple(datas))
     n_rng = 0
     if reg.needs_rng:
         from .. import random as _random
@@ -186,6 +192,45 @@ def invoke_raw(name, datas, attrs=None, fields=None):
     return outs, vjp, n_rng
 
 
+def invoke_fn(fn, inputs, op_name="custom", n_outputs=None):
+    """Invoke an ad-hoc traceable ``fn(*raw arrays) → tuple`` on NDArrays
+    with full tape integration (recording, prim for higher-order grads).
+
+    The escape hatch behind the control-flow ops (`lax.scan`-built
+    closures have no registry entry) — the TPU analogue of the reference's
+    stateful control-flow ops executing sub-CachedOps
+    (src/operator/control_flow.cc).
+    """
+    from ..ndarray.ndarray import NDArray
+
+    datas = tuple(x.data() for x in inputs)
+    recording = autograd.is_recording() and any(x._in_graph for x in inputs)
+    eng = Engine.get()
+    node = None
+    if recording:
+        outs, vjp = eng.push(lambda: jax.vjp(fn, *datas), op_name=op_name)
+        node = autograd.TapeNode(
+            vjp,
+            list(inputs),
+            [(o.shape, o.dtype) for o in outs],
+            op_name=op_name,
+            prim=(fn, datas, 0),
+        )
+    else:
+        outs = eng.push(lambda: fn(*datas), op_name=op_name)
+    for o in outs:
+        eng.track(o)
+    ctx = inputs[0].context if inputs else None
+    results = []
+    for i, o in enumerate(outs):
+        arr = NDArray(o, ctx=ctx)
+        if node is not None:
+            arr._tape_node = node
+            arr._tape_index = i
+        results.append(arr)
+    return results
+
+
 def invoke(name, inputs, attrs=None, out=None, fields=None):
     """Imperative invoke on NDArrays (parity: Imperative::Invoke).
 
@@ -207,6 +252,7 @@ def invoke(name, inputs, attrs=None, out=None, fields=None):
             [(o.shape, o.dtype) for o in outs],
             skip_grad_inputs=n_rng,
             op_name=name,
+            prim=(fn, datas2, n_rng),
         )
     else:
         fn, datas2, _ = _prep(reg, datas, attrs, fields)

@@ -112,7 +112,8 @@ _SCALAR_BINARY = {
     "_logical_xor_scalar": lambda x, scalar: jnp.logical_xor(x, scalar).astype(x.dtype),
 }
 for _name, _fn in _SCALAR_BINARY.items():
-    register(_name)(_fn)
+    # inputs declared explicitly: ``scalar`` is a static attr, not a tensor
+    register(_name, inputs=("x",))(_fn)
 
 # ----------------------------------------------------------------------------
 # elementwise unary
@@ -481,8 +482,14 @@ def _where(condition, x, y):
 
 @register("boolean_mask_fill")
 def _boolean_mask_fill(data, mask, value=0.0):
-    """Static-shape stand-in for boolean_mask (dynamic shapes don't jit)."""
-    return jnp.where(mask.astype(bool), data, value)
+    """Static-shape stand-in for boolean_mask (dynamic shapes don't jit).
+
+    The mask selects along leading axes (reference boolean_mask semantics),
+    so it broadcasts over data's trailing dims.
+    """
+    m = mask.astype(bool).reshape(
+        mask.shape + (1,) * (data.ndim - mask.ndim))
+    return jnp.where(m, data, value)
 
 
 # ----------------------------------------------------------------------------

@@ -1,0 +1,292 @@
+"""``mx.profiler`` — profiling API with chrome-trace export.
+
+Capability parity with the reference profiler
+(``python/mxnet/profiler.py:33-224`` API; ``src/profiler/profiler.h:251``
+engine-hooked op stats; ``DumpProfile:299`` chrome://tracing JSON;
+``aggregate_stats.cc`` summary tables; Domain/Task/Frame/Counter/Marker
+primitives ``profiler.h:768-910``).
+
+TPU-native mechanism: eager-mode op timings come from the engine's push
+hook (each dispatched executable reports wall time); device-side detail
+comes from the XLA/PJRT profiler — ``set_config(xla_trace_dir=...)``
+arms ``jax.profiler`` so a ``run``→``stop`` window also captures an
+xplane trace (viewable in TensorBoard/Perfetto, the TPU analogue of the
+reference's NVTX/VTune emitters).  ``dump()`` writes standard
+chrome://tracing JSON.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+_lock = threading.Lock()
+_config = {
+    "filename": "profile.json",
+    "profile_all": False,
+    "profile_imperative": True,
+    "profile_symbolic": True,
+    "profile_memory": False,
+    "profile_api": False,
+    "aggregate_stats": False,
+    "continuous_dump": False,
+    "xla_trace_dir": None,
+}
+_state = {"running": False, "paused": False, "hook": None,
+          "xla_active": False}
+_events = []  # chrome trace event dicts
+_t0 = time.perf_counter()
+
+
+def _now_us():
+    return (time.perf_counter() - _t0) * 1e6
+
+
+def set_config(**kwargs):
+    """Configure the profiler (parity: profiler.py:33).
+
+    Accepted keys: ``filename``, ``profile_all``, ``profile_symbolic``,
+    ``profile_imperative``, ``profile_memory``, ``profile_api``,
+    ``aggregate_stats``, ``continuous_dump`` and the TPU-specific
+    ``xla_trace_dir`` (directory for the PJRT xplane trace).
+    """
+    unknown = set(kwargs) - set(_config)
+    if unknown:
+        raise ValueError("invalid profiler options: %s" % sorted(unknown))
+    _config.update(kwargs)
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """Deprecated alias (parity: profiler.py:70)."""
+    set_config(filename=filename)
+
+
+def _engine_hook(op_name, t_start, t_end):
+    if not _state["running"] or _state["paused"]:
+        return
+    with _lock:
+        _events.append({
+            "name": op_name, "ph": "X", "cat": "operator",
+            "ts": (t_start - _t0) * 1e6,
+            "dur": (t_end - t_start) * 1e6,
+            "pid": 0, "tid": 0,
+        })
+
+
+def set_state(state="stop", profile_process="worker"):
+    """Start ('run') or stop ('stop') profiling (parity: profiler.py:89)."""
+    from .engine import Engine
+
+    if state not in ("run", "stop"):
+        raise ValueError("state must be 'run' or 'stop'")
+    eng = Engine.get()
+    if state == "run" and not _state["running"]:
+        _state["running"] = True
+        _state["paused"] = False
+        if _state["hook"] is None:
+            _state["hook"] = _engine_hook
+            eng.add_hook(_engine_hook)
+        if _config["xla_trace_dir"]:
+            try:
+                import jax
+
+                jax.profiler.start_trace(_config["xla_trace_dir"])
+                _state["xla_active"] = True
+            except Exception:  # device-side tracing is best-effort
+                _state["xla_active"] = False
+    elif state == "stop" and _state["running"]:
+        _state["running"] = False
+        if _state["hook"] is not None:
+            eng.remove_hook(_state["hook"])
+            _state["hook"] = None
+        if _state["xla_active"]:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            _state["xla_active"] = False
+
+
+def profiler_set_state(state="stop"):
+    """Deprecated alias (parity: profiler.py:109)."""
+    set_state(state)
+
+
+def pause(profile_process="worker"):
+    """Suspend event collection without tearing down (parity: :193)."""
+    _state["paused"] = True
+
+
+def resume(profile_process="worker"):
+    _state["paused"] = False
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write collected events as chrome://tracing JSON (parity: :122)."""
+    if finished and _state["running"]:
+        set_state("stop")
+    with _lock:
+        trace = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+    with open(_config["filename"], "w") as f:
+        json.dump(trace, f)
+    if not _config["continuous_dump"]:
+        with _lock:
+            _events.clear()
+
+
+def dump_profile():
+    """Deprecated alias (parity: :143)."""
+    dump(finished=False)
+
+
+def dumps(reset=False, format="table", sort_by="total", ascending=False):
+    """Aggregate per-op summary (parity: :151, aggregate_stats.cc)."""
+    with _lock:
+        stats = {}
+        for e in _events:
+            if e["ph"] != "X":
+                continue
+            s = stats.setdefault(e["name"],
+                                 {"count": 0, "total": 0.0,
+                                  "min": float("inf"), "max": 0.0})
+            s["count"] += 1
+            s["total"] += e["dur"]
+            s["min"] = min(s["min"], e["dur"])
+            s["max"] = max(s["max"], e["dur"])
+        if reset:
+            _events.clear()
+    for s in stats.values():
+        s["avg"] = s["total"] / max(s["count"], 1)
+    if format == "json":
+        return json.dumps(stats)
+    key = {"total": "total", "avg": "avg", "min": "min", "max": "max",
+           "count": "count"}.get(sort_by, "total")
+    rows = sorted(stats.items(), key=lambda kv: kv[1][key],
+                  reverse=not ascending)
+    lines = ["%-40s %8s %12s %12s %12s %12s"
+             % ("Name", "Calls", "Total(us)", "Avg(us)", "Min(us)",
+                "Max(us)")]
+    for name, s in rows:
+        lines.append("%-40s %8d %12.1f %12.1f %12.1f %12.1f"
+                     % (name[:40], s["count"], s["total"], s["avg"],
+                        s["min"], s["max"]))
+    return "\n".join(lines)
+
+
+class Domain:
+    """Named grouping for custom profiling objects (parity: :225)."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def __str__(self):
+        return self.name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_frame(self, name):
+        return Frame(self, name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+
+class _Span:
+    _tid = 1
+
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+        self._start = None
+        cls = _Span
+        self._tid_id = cls._tid
+        cls._tid = cls._tid + 1
+
+    def start(self):
+        self._start = _now_us()
+
+    def stop(self):
+        if self._start is None:
+            return
+        with _lock:
+            _events.append({
+                "name": self.name, "ph": "X",
+                "cat": str(self.domain), "ts": self._start,
+                "dur": _now_us() - self._start,
+                "pid": 0, "tid": self._tid_id,
+            })
+        self._start = None
+
+    def __str__(self):
+        return self.name
+
+
+class Task(_Span):
+    """Nestable named span (parity: :284)."""
+
+
+class Frame(_Span):
+    """Per-iteration span, e.g. one training step (parity: :326)."""
+
+
+class Counter:
+    """Numeric time-series value (parity: :368); chrome 'C' events."""
+
+    def __init__(self, domain, name, value=None):
+        self.domain = domain
+        self.name = name
+        self._value = 0
+        if value is not None:
+            self.set_value(value)
+
+    def set_value(self, value):
+        self._value = value
+        with _lock:
+            _events.append({"name": self.name, "ph": "C",
+                            "ts": _now_us(), "pid": 0,
+                            "args": {self.name: value}})
+
+    def increment(self, delta=1):
+        self.set_value(self._value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self._value - delta)
+
+    def __iadd__(self, v):
+        self.increment(v)
+        return self
+
+    def __isub__(self, v):
+        self.decrement(v)
+        return self
+
+    def __str__(self):
+        return self.name
+
+
+class Marker:
+    """Instant event (parity: :430); chrome 'i' events."""
+
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+
+    def mark(self, scope="process"):
+        with _lock:
+            _events.append({"name": self.name, "ph": "i",
+                            "ts": _now_us(), "pid": 0, "tid": 0,
+                            "s": {"process": "p", "thread": "t",
+                                  "global": "g"}.get(scope, "p")})
+
+    def __str__(self):
+        return self.name
+
+
+def set_kvstore_handle(handle):  # parity stub (server-side profiling)
+    pass

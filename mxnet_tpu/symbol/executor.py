@@ -187,8 +187,9 @@ class Executor:
         else:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
-            cts = [g.data() if isinstance(g, NDArray) else jnp.asarray(g)
-                   for g in out_grads]
+            cts = [(g.data() if isinstance(g, NDArray)
+                    else jnp.asarray(g)).astype(d)
+                   for g, d in zip(out_grads, dtypes)]
         # zero cotangents for the appended aux-update outputs
         cts = tuple(cts + [jnp.zeros(s, d) for s, d in
                            zip(shapes[n_user:], dtypes[n_user:])])

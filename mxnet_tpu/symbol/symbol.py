@@ -526,6 +526,24 @@ def load_json(json_str):
     return Symbol(heads)
 
 
+def _truthy(v):
+    return v in (True, 1, "1", "true", "True")
+
+
+def _unused_inputs(op_name, attrs):
+    """Trailing inputs an op ignores under these attrs (attr-aware
+    FListInputNames, reference fully_connected.cc:258 no_bias)."""
+    if op_name in ("FullyConnected", "Convolution") \
+            and _truthy(attrs.get("no_bias", False)):
+        return ("bias",)
+    if op_name == "Deconvolution" \
+            and _truthy(attrs.get("no_bias", True)):
+        return ("bias",)
+    if op_name == "softmax" and not _truthy(attrs.get("use_length", False)):
+        return ("length",)
+    return ()
+
+
 def make_symbol_op(op_name):
     """Build the mx.sym.<op> composition function."""
     reg = _reg.get(op_name)
@@ -563,10 +581,15 @@ def make_symbol_op(op_name):
                          reg.num_outputs)
             return Symbol([(node, i) for i in range(reg.num_outputs)]) \
                 if reg.num_outputs > 1 else Symbol([(node, 0)])
-        # auto-create missing trailing variable inputs (weights etc.)
+        # auto-create missing trailing variable inputs (weights etc.),
+        # except inputs the op ignores under the given attrs (e.g. bias
+        # under no_bias=1 — the reference's FListInputNames is attr-aware)
+        skip = _unused_inputs(op_name, attrs)
         entries = []
         aux_names = _AUX_INPUTS.get(op_name, ())
         for nm in reg.input_names:
+            if nm in skip and nm not in inputs:
+                continue
             if nm in inputs:
                 s = inputs[nm]
                 if not isinstance(s, Symbol):

@@ -1,0 +1,874 @@
+"""Per-op numerics sweep over the whole registry.
+
+The TPU analogue of the reference's two op-coverage layers:
+``tests/python/unittest/test_operator.py`` (forward-vs-NumPy goldens +
+``check_numeric_gradient`` FD backward checks) and ``benchmark/opperf``
+(every registered op exercised with default shapes).  Every op in
+``ops.registry`` must appear either in ``SPECS`` below or in ``EXCLUDED``
+with a justification; ``test_registry_fully_covered`` enforces it.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import scipy.special as sps
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import test_utils as tu
+from mxnet_tpu.ops import registry
+
+
+def _r(seed):
+    return np.random.RandomState(seed)
+
+
+def randn(shape, seed=0, scale=1.0):
+    return (_r(seed).randn(*shape) * scale).astype(np.float32)
+
+
+def pos(shape, seed=0, lo=0.5, hi=2.0):
+    return _r(seed).uniform(lo, hi, shape).astype(np.float32)
+
+
+def unit(shape, seed=0):
+    return _r(seed).uniform(-0.9, 0.9, shape).astype(np.float32)
+
+
+class S:
+    """One sweep spec: inputs, attrs, forward oracle, FD-grad toggle."""
+
+    def __init__(self, inputs, attrs=None, ref=None, check=None, grad=False,
+                 rtol=1e-4, atol=1e-5, grad_rtol=5e-2, grad_atol=5e-3,
+                 eps=1e-3, grad_nodes=None):
+        self.inputs = [np.asarray(i) for i in inputs]
+        self.attrs = attrs or {}
+        self.ref = ref
+        self.check = check
+        self.grad = grad
+        self.rtol, self.atol = rtol, atol
+        self.grad_rtol, self.grad_atol, self.eps = grad_rtol, grad_atol, eps
+        self.grad_nodes = grad_nodes
+
+
+SPECS = {}
+
+# ---------------------------------------------------------------------------
+# unary elementwise: (numpy ref, input domain, differentiable)
+# ---------------------------------------------------------------------------
+_UNARY = {
+    "abs": (np.abs, "any", True),
+    "sign": (np.sign, "any", False),
+    "ceil": (np.ceil, "any", False),
+    "floor": (np.floor, "any", False),
+    "rint": (np.rint, "any", False),
+    "round": (np.round, "any", False),
+    "trunc": (np.trunc, "any", False),
+    "fix": (np.trunc, "any", False),
+    "exp": (np.exp, "any", True),
+    "log": (np.log, "pos", True),
+    "log2": (np.log2, "pos", True),
+    "log10": (np.log10, "pos", True),
+    "log1p": (np.log1p, "pos", True),
+    "expm1": (np.expm1, "any", True),
+    "sqrt": (np.sqrt, "pos", True),
+    "rsqrt": (lambda x: 1 / np.sqrt(x), "pos", True),
+    "cbrt": (np.cbrt, "pos", True),
+    "rcbrt": (lambda x: 1 / np.cbrt(x), "pos", True),
+    "square": (np.square, "any", True),
+    "reciprocal": (lambda x: 1 / x, "pos", True),
+    "negative": (np.negative, "any", True),
+    "sin": (np.sin, "any", True),
+    "cos": (np.cos, "any", True),
+    "tan": (np.tan, "unit", True),
+    "arcsin": (np.arcsin, "unit", True),
+    "arccos": (np.arccos, "unit", True),
+    "arctan": (np.arctan, "any", True),
+    "sinh": (np.sinh, "any", True),
+    "cosh": (np.cosh, "any", True),
+    "tanh": (np.tanh, "any", True),
+    "arcsinh": (np.arcsinh, "any", True),
+    "arccosh": (np.arccosh, "gt1", True),
+    "arctanh": (np.arctanh, "unit", True),
+    "degrees": (np.degrees, "any", True),
+    "radians": (np.radians, "any", True),
+    "sigmoid": (lambda x: 1 / (1 + np.exp(-x)), "any", True),
+    "softsign": (lambda x: x / (1 + np.abs(x)), "any", True),
+    "relu": (lambda x: np.maximum(x, 0), "pos", True),
+    "erf": (sps.erf, "any", True),
+    "erfinv": (sps.erfinv, "unit", True),
+    "gamma": (sps.gamma, "pos", True),
+    "gammaln": (sps.gammaln, "pos", True),
+    "logical_not": (lambda x: (~(x != 0)).astype(np.float32), "any", False),
+    "isnan": (np.isnan, "any", False),
+    "isinf": (np.isinf, "any", False),
+    "isfinite": (np.isfinite, "any", False),
+    "identity": (lambda x: x, "any", True),
+    "stop_gradient": (lambda x: x, "any", False),
+    "make_loss": (lambda x: x, "any", True),
+}
+_DOMAIN = {"any": randn, "pos": pos, "unit": unit,
+           "gt1": lambda s, seed=0: pos(s, seed, 1.1, 3.0)}
+for _name, (_ref, _dom, _diff) in _UNARY.items():
+    SPECS[_name] = S([_DOMAIN[_dom]((2, 3), seed=hash(_name) % 1000)],
+                     ref=_ref, grad=_diff)
+
+# special-value coverage for the float classifiers
+for _name in ("isnan", "isinf", "isfinite"):
+    SPECS[_name].inputs = [np.array([[1.0, np.nan], [np.inf, -np.inf]],
+                                    np.float32)]
+
+# ---------------------------------------------------------------------------
+# binary: elemwise + broadcast + scalar
+# ---------------------------------------------------------------------------
+_BIN_REFS = {
+    "add": np.add, "sub": np.subtract, "mul": np.multiply,
+    "div": np.divide, "mod": np.mod, "power": np.power,
+    "maximum": np.maximum, "minimum": np.minimum, "hypot": np.hypot,
+    "equal": lambda a, b: (a == b).astype(np.float32),
+    "not_equal": lambda a, b: (a != b).astype(np.float32),
+    "greater": lambda a, b: (a > b).astype(np.float32),
+    "greater_equal": lambda a, b: (a >= b).astype(np.float32),
+    "lesser": lambda a, b: (a < b).astype(np.float32),
+    "lesser_equal": lambda a, b: (a <= b).astype(np.float32),
+    "logical_and": lambda a, b: ((a != 0) & (b != 0)).astype(np.float32),
+    "logical_or": lambda a, b: ((a != 0) | (b != 0)).astype(np.float32),
+    "logical_xor": lambda a, b: ((a != 0) ^ (b != 0)).astype(np.float32),
+}
+_BIN_DIFF = {"add", "sub", "mul", "div", "power", "maximum", "minimum",
+             "hypot"}
+for _name, _ref in _BIN_REFS.items():
+    gen = pos if _name in ("mod", "power", "div", "hypot") else randn
+    a, b = gen((2, 3), seed=1), gen((2, 3), seed=2)
+    ew = {"add": "elemwise_add", "sub": "elemwise_sub",
+          "mul": "elemwise_mul", "div": "elemwise_div"}.get(
+              _name, "_" + _name)
+    SPECS[ew] = S([a, b], ref=_ref, grad=_name in _BIN_DIFF)
+    bb = gen((2, 1, 3), seed=3)
+    SPECS["broadcast_" + _name] = S(
+        [bb, gen((1, 4, 3), seed=4)],
+        ref=_ref, grad=_name in _BIN_DIFF)
+
+_SCALAR_REFS = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_mod_scalar": lambda x, s: np.mod(x, s),
+    "_rmod_scalar": lambda x, s: np.mod(s, x),
+    "_power_scalar": lambda x, s: np.power(x, s),
+    "_rpower_scalar": lambda x, s: np.power(s, x),
+    "_maximum_scalar": lambda x, s: np.maximum(x, s),
+    "_minimum_scalar": lambda x, s: np.minimum(x, s),
+    "_equal_scalar": lambda x, s: (x == s).astype(np.float32),
+    "_not_equal_scalar": lambda x, s: (x != s).astype(np.float32),
+    "_greater_scalar": lambda x, s: (x > s).astype(np.float32),
+    "_greater_equal_scalar": lambda x, s: (x >= s).astype(np.float32),
+    "_lesser_scalar": lambda x, s: (x < s).astype(np.float32),
+    "_lesser_equal_scalar": lambda x, s: (x <= s).astype(np.float32),
+    "_logical_and_scalar": lambda x, s: ((x != 0) & (s != 0)).astype(np.float32),
+    "_logical_or_scalar": lambda x, s: ((x != 0) | (s != 0)).astype(np.float32),
+    "_logical_xor_scalar": lambda x, s: ((x != 0) ^ (s != 0)).astype(np.float32),
+}
+_SCALAR_DIFF = {"_plus_scalar", "_minus_scalar", "_rminus_scalar",
+                "_mul_scalar", "_div_scalar", "_rdiv_scalar",
+                "_power_scalar", "_maximum_scalar", "_minimum_scalar"}
+for _name, _ref in _SCALAR_REFS.items():
+    SPECS[_name] = S([pos((2, 3), seed=5)], attrs={"scalar": 1.7},
+                     ref=lambda x, _f=_ref: _f(x, 1.7),
+                     grad=_name in _SCALAR_DIFF)
+
+# ---------------------------------------------------------------------------
+# reductions / argreductions
+# ---------------------------------------------------------------------------
+SPECS["sum"] = S([randn((2, 3, 4), 6)], {"axis": 1},
+                 ref=lambda x: x.sum(1), grad=True)
+SPECS["mean"] = S([randn((2, 3, 4), 7)], {"axis": (0, 2)},
+                  ref=lambda x: x.mean((0, 2)), grad=True)
+SPECS["max"] = S([randn((2, 3), 8)], {"axis": 1, "keepdims": True},
+                 ref=lambda x: x.max(1, keepdims=True), grad=True)
+SPECS["min"] = S([randn((2, 3), 9)], {"axis": 0},
+                 ref=lambda x: x.min(0), grad=True)
+SPECS["prod"] = S([pos((2, 3), 10)], {"axis": 1},
+                  ref=lambda x: x.prod(1), grad=True)
+_nan_in = randn((2, 3), 11)
+_nan_in[0, 1] = np.nan
+SPECS["nansum"] = S([_nan_in], {"axis": 1}, ref=lambda x: np.nansum(x, 1))
+SPECS["nanprod"] = S([_nan_in], {"axis": 1}, ref=lambda x: np.nanprod(x, 1))
+SPECS["norm"] = S([randn((2, 3), 12)], {"ord": 2, "axis": 1},
+                  ref=lambda x: np.linalg.norm(x, 2, 1), grad=True)
+SPECS["logsumexp"] = S([randn((2, 3), 13)], {"axis": 1},
+                       ref=lambda x: sps.logsumexp(x, 1), grad=True)
+SPECS["argmax"] = S([randn((2, 5), 14)], {"axis": 1},
+                    ref=lambda x: x.argmax(1).astype(np.float32))
+SPECS["argmin"] = S([randn((2, 5), 15)], {"axis": 1},
+                    ref=lambda x: x.argmin(1).astype(np.float32))
+SPECS["argmax_channel"] = S([randn((2, 5), 16)],
+                            ref=lambda x: x.argmax(1).astype(np.float32))
+SPECS["cumsum"] = S([randn((2, 4), 17)], {"axis": 1},
+                    ref=lambda x: np.cumsum(x, 1), grad=True)
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+SPECS["reshape"] = S([randn((2, 6), 18)], {"shape": (3, 4)},
+                     ref=lambda x: x.reshape(3, 4), grad=True)
+SPECS["reshape_like"] = S([randn((2, 6), 19), randn((3, 4), 20)],
+                          ref=lambda a, b: a.reshape(3, 4))
+SPECS["flatten"] = S([randn((2, 3, 4), 21)],
+                     ref=lambda x: x.reshape(2, 12), grad=True)
+SPECS["transpose"] = S([randn((2, 3, 4), 22)], {"axes": (2, 0, 1)},
+                       ref=lambda x: x.transpose(2, 0, 1), grad=True)
+SPECS["swapaxes"] = S([randn((2, 3, 4), 23)], {"dim1": 0, "dim2": 2},
+                      ref=lambda x: x.swapaxes(0, 2))
+SPECS["expand_dims"] = S([randn((2, 3), 24)], {"axis": 1},
+                         ref=lambda x: x[:, None, :])
+SPECS["squeeze"] = S([randn((2, 1, 3), 25)], {"axis": 1},
+                     ref=lambda x: x.squeeze(1))
+SPECS["depth_to_space"] = S(
+    [randn((1, 8, 2, 2), 26)], {"block_size": 2},
+    ref=lambda x: x.reshape(1, 2, 2, 2, 2, 2).transpose(0, 3, 4, 1, 5, 2)
+    .reshape(1, 2, 4, 4))
+SPECS["space_to_depth"] = S(
+    [randn((1, 2, 4, 4), 27)], {"block_size": 2},
+    ref=lambda x: x.reshape(1, 2, 2, 2, 2, 2).transpose(0, 3, 5, 1, 2, 4)
+    .reshape(1, 8, 2, 2))
+SPECS["broadcast_to"] = S([randn((1, 3), 28)], {"shape": (4, 3)},
+                          ref=lambda x: np.broadcast_to(x, (4, 3)))
+SPECS["broadcast_like"] = S([randn((1, 3), 29), randn((4, 3), 30)],
+                            ref=lambda a, b: np.broadcast_to(a, (4, 3)))
+SPECS["broadcast_axis"] = S([randn((1, 3), 31)], {"axis": 0, "size": 4},
+                            ref=lambda x: np.broadcast_to(x, (4, 3)))
+SPECS["tile"] = S([randn((2, 3), 32)], {"reps": (2, 2)},
+                  ref=lambda x: np.tile(x, (2, 2)), grad=True)
+SPECS["repeat"] = S([randn((2, 3), 33)], {"repeats": 2, "axis": 1},
+                    ref=lambda x: np.repeat(x, 2, 1))
+SPECS["reverse"] = S([randn((2, 3), 34)], {"axis": 1},
+                     ref=lambda x: x[:, ::-1])
+SPECS["concat"] = S([randn((2, 2), 35), randn((2, 3), 36)], {"dim": 1},
+                    ref=lambda a, b: np.concatenate([a, b], 1))
+SPECS["stack"] = S([randn((2, 3), 37), randn((2, 3), 38)], {"axis": 1},
+                   ref=lambda a, b: np.stack([a, b], 1))
+SPECS["split"] = S([randn((2, 4), 39)], {"num_outputs": 2, "axis": 1},
+                   ref=lambda x: (x[:, :2], x[:, 2:]))
+SPECS["split_v2"] = S([randn((6, 2), 40)], {"indices": (2, 5), "axis": 0},
+                      ref=lambda x: (x[:2], x[2:5], x[5:]))
+SPECS["slice"] = S([randn((4, 5), 41)], {"begin": (1, 0), "end": (3, 4)},
+                   ref=lambda x: x[1:3, 0:4], grad=True)
+SPECS["slice_axis"] = S([randn((4, 5), 42)],
+                        {"axis": 1, "begin": 1, "end": 4},
+                        ref=lambda x: x[:, 1:4])
+SPECS["slice_like"] = S([randn((4, 5), 43), randn((2, 3), 44)],
+                        ref=lambda a, b: a[:2, :3])
+SPECS["pad"] = S([randn((1, 1, 2, 3), 45)],
+                 {"mode": "constant", "pad_width": (0, 0, 0, 0, 1, 1, 2, 2),
+                  "constant_value": 0.5},
+                 ref=lambda x: np.pad(x, ((0, 0), (0, 0), (1, 1), (2, 2)),
+                                      constant_values=0.5))
+SPECS["clip"] = S([randn((3, 3), 46)], {"a_min": -0.5, "a_max": 0.5},
+                  ref=lambda x: np.clip(x, -0.5, 0.5), grad=True)
+SPECS["diag"] = S([randn((3, 3), 47)], {"k": 1},
+                  ref=lambda x: np.diag(x, 1))
+
+# ---------------------------------------------------------------------------
+# indexing / gather / scatter / selection
+# ---------------------------------------------------------------------------
+_idx = np.array([2, 0, 1], np.int32)
+SPECS["take"] = S([randn((4, 3), 48), _idx], {"axis": 0},
+                  ref=lambda a, i: a[i], grad=True, grad_nodes=["v0"])
+SPECS["pick"] = S([randn((3, 4), 49), np.array([0, 3, 1], np.int32)],
+                  {"axis": 1},
+                  ref=lambda a, i: a[np.arange(3), i])
+SPECS["gather_nd"] = S(
+    [randn((3, 4), 50), np.array([[0, 2], [1, 3]], np.int32)],
+    ref=lambda a, i: a[i[0], i[1]])
+SPECS["scatter_nd"] = S(
+    [np.array([9.0, 8.0], np.float32),
+     np.array([[0, 2], [1, 3]], np.int32)],
+    {"shape": (3, 4)},
+    ref=lambda d, i: _scatter_ref(d, i, (3, 4)))
+
+
+def _scatter_ref(d, i, shape):
+    out = np.zeros(shape, np.float32)
+    out[tuple(i)] = d
+    return out
+
+
+SPECS["_scatter_set_nd"] = S(
+    [np.zeros((3, 4), np.float32), np.array([9.0, 8.0], np.float32),
+     np.array([[0, 2], [1, 3]], np.int32)],
+    {"shape": (3, 4)},
+    ref=lambda l, r, i: _scatter_ref(r, i, (3, 4)))
+SPECS["one_hot"] = S([np.array([1, 0, 2], np.int32)], {"depth": 4},
+                     ref=lambda i: np.eye(4, dtype=np.float32)[i])
+SPECS["where"] = S([np.array([1, 0, 1], np.float32),
+                    randn((3,), 51), randn((3,), 52)],
+                   ref=lambda c, x, y: np.where(c != 0, x, y))
+SPECS["boolean_mask_fill"] = S(
+    [randn((3, 2), 53), np.array([1, 0, 1], np.float32)],
+    {"value": -1.0},
+    ref=lambda d, m: np.where((m != 0)[:, None], d, -1.0))
+SPECS["sort"] = S([randn((3, 4), 54)], {"axis": 1},
+                  ref=lambda x: np.sort(x, 1))
+SPECS["argsort"] = S([randn((3, 4), 55)], {"axis": 1},
+                     ref=lambda x: np.argsort(x, 1,
+                                              kind="stable").astype(np.float32))
+SPECS["topk"] = S([randn((3, 5), 56)], {"axis": 1, "k": 2},
+                  ref=lambda x: np.argsort(-x, 1)[:, :2].astype(np.float32))
+SPECS["_contrib_index_copy"] = S(
+    [np.zeros((4, 2), np.float32), np.array([1, 3], np.int32),
+     np.ones((2, 2), np.float32)],
+    ref=lambda o, i, n: _index_copy_ref(o, i, n))
+
+
+def _index_copy_ref(o, i, n):
+    out = o.copy()
+    out[i] = n
+    return out
+
+
+# ---------------------------------------------------------------------------
+# creation ops (no tensor inputs)
+# ---------------------------------------------------------------------------
+SPECS["_zeros"] = S([], {"shape": (2, 3)}, ref=lambda: np.zeros((2, 3)))
+SPECS["_ones"] = S([], {"shape": (2, 3)}, ref=lambda: np.ones((2, 3)))
+SPECS["_full"] = S([], {"shape": (2, 3), "value": 2.5},
+                   ref=lambda: np.full((2, 3), 2.5, np.float32))
+SPECS["_eye"] = S([], {"N": 3, "M": 4, "k": 1},
+                  ref=lambda: np.eye(3, 4, 1, dtype=np.float32))
+SPECS["_arange"] = S([], {"start": 1.0, "stop": 7.0, "step": 2.0},
+                     ref=lambda: np.arange(1.0, 7.0, 2.0, np.float32))
+SPECS["_linspace"] = S([], {"start": 0.0, "stop": 1.0, "num": 5},
+                       ref=lambda: np.linspace(0, 1, 5, dtype=np.float32))
+SPECS["zeros_like"] = S([randn((2, 3), 57)], ref=np.zeros_like)
+SPECS["ones_like"] = S([randn((2, 3), 58)], ref=np.ones_like)
+SPECS["full_like"] = S([randn((2, 3), 59)], {"fill_value": 3.0},
+                       ref=lambda x: np.full_like(x, 3.0))
+SPECS["_contrib_arange_like"] = S(
+    [randn((2, 3), 60)], {"axis": None},
+    ref=lambda x: np.arange(6, dtype=np.float32).reshape(2, 3))
+SPECS["shape_array"] = S([randn((2, 3), 61)],
+                         ref=lambda x: np.array([2, 3], np.int64))
+SPECS["size_array"] = S([randn((2, 3), 62)],
+                        ref=lambda x: np.array([6], np.int64))
+SPECS["cast"] = S([randn((2, 3), 63)], {"dtype": "int32"},
+                  ref=lambda x: x.astype(np.int32))
+SPECS["amp_cast"] = S([randn((2, 3), 64)], {"dtype": "float16"},
+                      ref=lambda x: x.astype(np.float16), rtol=1e-2,
+                      atol=1e-2)
+SPECS["amp_multicast"] = S(
+    [randn((2, 2), 65), randn((2, 2), 66).astype(np.float16)],
+    {"num_outputs": 2},
+    check=lambda outs, ins: all(o.dtype == np.float32 for o in outs))
+
+# ---------------------------------------------------------------------------
+# linear algebra
+# ---------------------------------------------------------------------------
+SPECS["dot"] = S([randn((2, 3), 67), randn((3, 4), 68)],
+                 ref=lambda a, b: a @ b, grad=True)
+SPECS["batch_dot"] = S([randn((2, 2, 3), 69), randn((2, 3, 2), 70)],
+                       ref=lambda a, b: a @ b, grad=True)
+SPECS["_npi_einsum"] = S(
+    [randn((2, 3), 71), randn((3, 4), 72)], {"subscripts": "ij,jk->ik"},
+    ref=lambda a, b: np.einsum("ij,jk->ik", a, b), grad=True)
+SPECS["khatri_rao"] = S(
+    [randn((2, 3), 73), randn((4, 3), 74)],
+    ref=lambda a, b: np.vstack([np.kron(a[:, j], b[:, j])
+                                for j in range(3)]).T)
+SPECS["_linalg_gemm2"] = S(
+    [randn((2, 3), 75), randn((3, 4), 76)], {"alpha": 2.0},
+    ref=lambda a, b: 2.0 * (a @ b), grad=True)
+SPECS["_linalg_gemm"] = S(
+    [randn((2, 3), 77), randn((3, 4), 78), randn((2, 4), 79)],
+    {"alpha": 1.5, "beta": 0.5},
+    ref=lambda a, b, c: 1.5 * (a @ b) + 0.5 * c, grad=True)
+SPECS["_linalg_syrk"] = S([randn((2, 3), 80)], {"alpha": 1.0},
+                          ref=lambda a: a @ a.T, grad=True)
+_spd = randn((3, 3), 81) @ randn((3, 3), 81).T + 3 * np.eye(3, dtype=np.float32)
+SPECS["_linalg_potrf"] = S([_spd], ref=np.linalg.cholesky, grad=True,
+                           grad_rtol=8e-2)
+_tri = np.tril(pos((3, 3), 82)) + np.eye(3, dtype=np.float32)
+SPECS["_linalg_trsm"] = S(
+    [_tri, randn((3, 2), 83)],
+    ref=lambda a, b: np.linalg.solve(a, b), grad=True)
+SPECS["_linalg_sumlogdiag"] = S([_spd], ref=lambda a: np.log(np.diag(a)).sum(),
+                                grad=True)
+SPECS["_linalg_extractdiag"] = S([randn((3, 3), 84)],
+                                 ref=lambda a: np.diag(a))
+SPECS["_linalg_makediag"] = S([randn((3,), 85)], ref=np.diag)
+SPECS["_linalg_det"] = S([_spd], ref=np.linalg.det, grad=True, rtol=1e-3,
+                         atol=1e-3)
+SPECS["_linalg_inverse"] = S([_spd], ref=np.linalg.inv, grad=True,
+                             rtol=1e-3, atol=1e-3)
+SPECS["_linalg_svd"] = S(
+    [randn((2, 3), 86)],
+    check=lambda outs, ins: np.allclose(
+        np.asarray(outs[0]) @ np.diag(np.asarray(outs[1]))
+        @ np.asarray(outs[2]),
+        ins[0], atol=1e-4))
+
+# ---------------------------------------------------------------------------
+# neural network ops
+# ---------------------------------------------------------------------------
+SPECS["FullyConnected"] = S(
+    [randn((2, 4), 87), randn((3, 4), 88), randn((3,), 89)],
+    {"num_hidden": 3},
+    ref=lambda x, w, b: x @ w.T + b, grad=True)
+SPECS["Convolution"] = S(
+    [randn((1, 2, 5, 5), 90), randn((3, 2, 3, 3), 91), randn((3,), 92)],
+    {"kernel": (3, 3), "num_filter": 3, "pad": (1, 1)},
+    check=lambda outs, ins: np.asarray(outs[0]).shape == (1, 3, 5, 5),
+    grad=True)
+SPECS["Deconvolution"] = S(
+    [randn((1, 3, 3, 3), 93), randn((3, 2, 2, 2), 94)],
+    {"kernel": (2, 2), "num_filter": 2},
+    check=lambda outs, ins: np.asarray(outs[0]).shape == (1, 2, 4, 4),
+    grad=True)
+SPECS["Pooling"] = [
+    S([randn((1, 2, 4, 4), 95)],
+      {"kernel": (2, 2), "stride": (2, 2), "pool_type": "max"},
+      ref=lambda x: x.reshape(1, 2, 2, 2, 2, 2).max((3, 5)), grad=True),
+    S([randn((1, 2, 4, 4), 96)],
+      {"kernel": (2, 2), "stride": (2, 2), "pool_type": "avg"},
+      ref=lambda x: x.reshape(1, 2, 2, 2, 2, 2).mean((3, 5)), grad=True),
+]
+
+
+def _bn_predict_ref(x, g, b, mm, mv):
+    return (x - mm[None, :, None, None]) / np.sqrt(
+        mv[None, :, None, None] + 1e-3) * g[None, :, None, None] \
+        + b[None, :, None, None]
+
+
+SPECS["BatchNorm"] = S(
+    [randn((2, 3, 2, 2), 97), pos((3,), 98), randn((3,), 99),
+     randn((3,), 100), pos((3,), 101)],
+    {"fix_gamma": False},
+    ref=_bn_predict_ref, rtol=1e-3, atol=1e-4)
+
+
+def _ln_ref(x, g, b):
+    m = x.mean(-1, keepdims=True)
+    v = x.var(-1, keepdims=True)
+    return (x - m) / np.sqrt(v + 1e-5) * g + b
+
+
+SPECS["LayerNorm"] = S(
+    [randn((2, 4), 102), pos((4,), 103), randn((4,), 104)],
+    ref=_ln_ref, rtol=1e-3, atol=1e-4, grad=True, grad_rtol=8e-2)
+
+
+def _in_ref(x, g, b):
+    m = x.mean((2, 3), keepdims=True)
+    v = x.var((2, 3), keepdims=True)
+    return (x - m) / np.sqrt(v + 1e-3) * g[None, :, None, None] \
+        + b[None, :, None, None]
+
+
+SPECS["InstanceNorm"] = S(
+    [randn((2, 2, 3, 3), 105), pos((2,), 106), randn((2,), 107)],
+    ref=_in_ref, rtol=1e-3, atol=1e-4)
+
+
+def _gn_ref(x, g, b):
+    n, c, h, w = x.shape
+    xr = x.reshape(n, 2, c // 2, h, w)
+    m = xr.mean((2, 3, 4), keepdims=True)
+    v = xr.var((2, 3, 4), keepdims=True)
+    out = ((xr - m) / np.sqrt(v + 1e-5)).reshape(n, c, h, w)
+    return out * g[None, :, None, None] + b[None, :, None, None]
+
+
+SPECS["GroupNorm"] = S(
+    [randn((2, 4, 3, 3), 108), pos((4,), 109), randn((4,), 110)],
+    {"num_groups": 2}, ref=_gn_ref, rtol=1e-3, atol=1e-4)
+SPECS["RMSNorm"] = S(
+    [randn((2, 4), 111), pos((4,), 112)],
+    ref=lambda x, g: x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * g,
+    rtol=1e-3, atol=1e-4, grad=True)
+SPECS["L2Normalization"] = S(
+    [randn((2, 4), 113)],
+    ref=lambda x: x / np.sqrt((x ** 2).sum(1, keepdims=True) + 1e-10),
+    grad=True)
+SPECS["Activation"] = S(
+    [randn((2, 3), 114)], {"act_type": "softrelu"},
+    ref=lambda x: np.log1p(np.exp(x)), grad=True)
+SPECS["LeakyReLU"] = S(
+    [randn((2, 3), 115)], {"act_type": "leaky", "slope": 0.25},
+    ref=lambda x: np.where(x > 0, x, 0.25 * x))
+
+
+def _softmax_ref(x, axis=-1):
+    e = np.exp(x - x.max(axis, keepdims=True))
+    return e / e.sum(axis, keepdims=True)
+
+
+SPECS["softmax"] = S([randn((2, 4), 116)], ref=_softmax_ref, grad=True)
+SPECS["log_softmax"] = S([randn((2, 4), 117)],
+                         ref=lambda x: np.log(_softmax_ref(x)), grad=True)
+SPECS["softmin"] = S([randn((2, 4), 118)],
+                     ref=lambda x: _softmax_ref(-x), grad=True)
+SPECS["SoftmaxActivation"] = S([randn((2, 4), 119)], ref=_softmax_ref)
+SPECS["SoftmaxOutput"] = S(
+    [randn((2, 4), 120), np.array([1.0, 3.0], np.float32)],
+    ref=lambda x, y: _softmax_ref(x))
+SPECS["smooth_l1"] = S(
+    [randn((2, 3), 121, scale=2.0)], {"scalar": 1.0},
+    ref=lambda x: np.where(np.abs(x) < 1, 0.5 * x ** 2, np.abs(x) - 0.5),
+    grad=True)
+SPECS["softmax_cross_entropy"] = S(
+    [randn((3, 4), 122), np.array([0, 2, 1], np.float32)],
+    ref=lambda x, y: np.array(
+        -np.log(_softmax_ref(x))[np.arange(3), y.astype(int)].sum(),
+        np.float32))
+SPECS["Embedding"] = S(
+    [np.array([1, 0, 2], np.int32), randn((4, 3), 123)],
+    {"input_dim": 4, "output_dim": 3},
+    ref=lambda i, w: w[i], grad=True, grad_nodes=["v1"])
+SPECS["UpSampling"] = S(
+    [randn((1, 2, 2, 2), 124)], {"scale": 2, "sample_type": "nearest"},
+    ref=lambda x: x.repeat(2, 2).repeat(2, 3))
+
+
+def _bilinear_identity_grid(n, h, w):
+    ys = np.linspace(-1, 1, h, dtype=np.float32)
+    xs = np.linspace(-1, 1, w, dtype=np.float32)
+    gx, gy = np.meshgrid(xs, ys)
+    return np.broadcast_to(np.stack([gx, gy])[None], (n, 2, h, w)).copy()
+
+
+SPECS["BilinearSampler"] = S(
+    [randn((1, 1, 3, 3), 125), _bilinear_identity_grid(1, 3, 3)],
+    ref=lambda x, g: x, rtol=1e-3, atol=1e-4)
+
+_seq = randn((3, 2, 2), 126)  # (T, N, C)
+_seqlen = np.array([2, 3], np.float32)
+SPECS["SequenceMask"] = S(
+    [_seq, _seqlen], {"use_sequence_length": True, "value": -1.0},
+    ref=lambda d, l: np.where(
+        (np.arange(3)[:, None] < l[None, :])[:, :, None], d, -1.0))
+SPECS["SequenceLast"] = S(
+    [_seq, _seqlen], {"use_sequence_length": True},
+    ref=lambda d, l: d[l.astype(int) - 1, np.arange(2)])
+SPECS["SequenceReverse"] = S(
+    [_seq, _seqlen], {"use_sequence_length": True},
+    ref=lambda d, l: _seqrev_ref(d, l))
+
+
+def _seqrev_ref(d, l):
+    out = d.copy()
+    for b in range(d.shape[1]):
+        n = int(l[b])
+        out[:n, b] = d[:n, b][::-1]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# contrib
+# ---------------------------------------------------------------------------
+SPECS["_contrib_div_sqrt_dim"] = S(
+    [randn((2, 4), 127)], ref=lambda x: x / np.sqrt(4.0))
+SPECS["_contrib_gradientmultiplier"] = S(
+    [randn((2, 3), 128)], {"scalar": 0.5}, ref=lambda x: x)
+SPECS["_contrib_index_array"] = S(
+    [randn((2, 3), 129)],
+    ref=lambda x: np.stack(np.meshgrid(np.arange(2), np.arange(3),
+                                       indexing="ij"), -1).astype(np.int64))
+SPECS["_contrib_getnnz"] = S(
+    [np.array([[1.0, 0.0], [0.0, 2.0]], np.float32)],
+    ref=lambda x: np.array(2, np.int64))
+_boxes_a = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+_boxes_b = np.array([[0, 0, 2, 2]], np.float32)
+SPECS["_contrib_box_iou"] = S(
+    [_boxes_a, _boxes_b],
+    ref=lambda a, b: np.array([[1.0], [1.0 / 7.0]], np.float32))
+SPECS["_contrib_box_nms"] = S(
+    [np.array([[[0, 0.9, 0, 0, 2, 2], [1, 0.8, 0, 0, 2, 2],
+                [2, 0.7, 5, 5, 7, 7]]], np.float32)],
+    {"overlap_thresh": 0.5},
+    check=lambda outs, ins: (np.asarray(outs[0]).shape == (1, 3, 6)
+                             and np.asarray(outs[0])[0, 1, 1] == -1.0))
+_fft_in = randn((2, 4), 130)
+SPECS["_contrib_fft"] = S(
+    [_fft_in],
+    ref=lambda x: np.stack([np.fft.fft(x).real, np.fft.fft(x).imag],
+                           -1).reshape(2, 8).astype(np.float32),
+    rtol=1e-3, atol=1e-4)
+_fft_out = np.stack([np.fft.fft(_fft_in).real, np.fft.fft(_fft_in).imag],
+                    -1).reshape(2, 8).astype(np.float32)
+SPECS["_contrib_ifft"] = S(
+    [_fft_out], ref=lambda x: _fft_in * 4.0, rtol=1e-3, atol=1e-4)
+SPECS["_contrib_quantize"] = S(
+    [randn((2, 3), 131), np.array(-2.0, np.float32),
+     np.array(2.0, np.float32)],
+    check=lambda outs, ins: np.asarray(outs[0]).dtype == np.uint8)
+_qdata = np.array([[0, 128, 255]], np.uint8)
+SPECS["_contrib_dequantize"] = S(
+    [_qdata, np.array(-1.0, np.float32), np.array(1.0, np.float32)],
+    ref=lambda q, lo, hi: (q.astype(np.float32) / 255.0) * 2.0 - 1.0,
+    rtol=1e-2, atol=1e-2)
+SPECS["_contrib_count_sketch"] = S(
+    [randn((2, 4), 132), np.array([0, 2, 1, 2], np.float32),
+     np.array([1, -1, 1, 1], np.float32)],
+    {"out_dim": 3},
+    ref=lambda d, h, s: _count_sketch_ref(d, h, s, 3))
+
+
+def _count_sketch_ref(d, h, s, out_dim):
+    out = np.zeros(d.shape[:-1] + (out_dim,), np.float32)
+    for j in range(d.shape[-1]):
+        out[..., int(h[j])] += d[..., j] * s[j]
+    return out
+
+
+def _selfatt_qk_ref(qkv, heads):
+    # qkv: (T, N, 3*H*D) interleaved per head → (N*H, T, T) scores
+    t, n, c = qkv.shape
+    d = c // (3 * heads)
+    proj = qkv.reshape(t, n, heads, 3, d)
+    q = proj[:, :, :, 0]
+    k = proj[:, :, :, 1]
+    q = q.transpose(1, 2, 0, 3).reshape(n * heads, t, d)
+    k = k.transpose(1, 2, 0, 3).reshape(n * heads, t, d)
+    return (q / np.sqrt(d)) @ k.transpose(0, 2, 1)
+
+
+SPECS["_contrib_interleaved_matmul_selfatt_qk"] = S(
+    [randn((3, 2, 12), 133)], {"heads": 2},
+    ref=lambda qkv: _selfatt_qk_ref(qkv, 2), rtol=1e-3, atol=1e-4)
+
+
+def _selfatt_valatt_ref(qkv, att, heads):
+    t, n, c = qkv.shape
+    d = c // (3 * heads)
+    proj = qkv.reshape(t, n, heads, 3, d)
+    v = proj[:, :, :, 2].transpose(1, 2, 0, 3).reshape(n * heads, t, d)
+    out = att @ v  # (N*H, T, D)
+    return out.reshape(n, heads, t, d).transpose(2, 0, 1, 3).reshape(
+        t, n, heads * d)
+
+
+_qkv = randn((3, 2, 12), 134)
+_att = _softmax_ref(_selfatt_qk_ref(_qkv, 2))
+SPECS["_contrib_interleaved_matmul_selfatt_valatt"] = S(
+    [_qkv, _att.astype(np.float32)], {"heads": 2},
+    ref=lambda qkv, att: _selfatt_valatt_ref(qkv, att, 2),
+    rtol=1e-3, atol=1e-4)
+
+# ---------------------------------------------------------------------------
+# optimizer update ops (golden numpy re-implementations)
+# ---------------------------------------------------------------------------
+_w, _g = pos((3, 2), 140), randn((3, 2), 141)
+_m1, _v1 = randn((3, 2), 142, 0.1), pos((3, 2), 143, 0.01, 0.1)
+SPECS["sgd_update"] = S(
+    [_w, _g], {"lr": 0.1, "wd": 0.01},
+    ref=lambda w, g: w - 0.1 * (g + 0.01 * w))
+SPECS["sgd_mom_update"] = S(
+    [_w, _g, _m1], {"lr": 0.1, "momentum": 0.9},
+    ref=lambda w, g, m: (w + (0.9 * m - 0.1 * g), 0.9 * m - 0.1 * g))
+SPECS["nag_mom_update"] = S(
+    [_w, _g, _m1], {"lr": 0.1, "momentum": 0.9},
+    ref=lambda w, g, m: (w - 0.1 * (g + 0.9 * (0.9 * m + g)),
+                         0.9 * m + g))
+SPECS["adam_update"] = S(
+    [_w, _g, _m1, _v1], {"lr": 0.01},
+    ref=lambda w, g, m, v: _adam_ref(w, g, m, v))
+
+
+def _adam_ref(w, g, m, v, lr=0.01, b1=0.9, b2=0.999, eps=1e-8):
+    m2 = b1 * m + (1 - b1) * g
+    v2 = b2 * v + (1 - b2) * g ** 2
+    return w - lr * m2 / (np.sqrt(v2) + eps), m2, v2
+
+
+SPECS["adamw_update"] = S(
+    [_w, _g, _m1, _v1], {"lr": 0.01, "wd": 0.01, "eta": 1.0},
+    ref=lambda w, g, m, v: _adamw_ref(w, g, m, v))
+
+
+def _adamw_ref(w, g, m, v, lr=0.01, b1=0.9, b2=0.999, eps=1e-8, wd=0.01):
+    m2 = b1 * m + (1 - b1) * g
+    v2 = b2 * v + (1 - b2) * g ** 2
+    return w - (lr * m2 / (np.sqrt(v2) + eps) + wd * w), m2, v2
+
+
+SPECS["rmsprop_update"] = S(
+    [_w, _g, _v1], {"lr": 0.01, "gamma1": 0.9},
+    ref=lambda w, g, n: (
+        w - 0.01 * g / (np.sqrt(0.9 * n + 0.1 * g ** 2) + 1e-8),
+        0.9 * n + 0.1 * g ** 2))
+SPECS["rmspropalex_update"] = S(
+    [_w, _g, _v1, _m1, randn((3, 2), 144, 0.01)],
+    {"lr": 0.01},
+    check=lambda outs, ins: all(np.isfinite(np.asarray(o)).all()
+                                for o in outs))
+SPECS["ftrl_update"] = S(
+    [_w, _g, _m1, _v1], {"lr": 0.1},
+    check=lambda outs, ins: all(np.isfinite(np.asarray(o)).all()
+                                for o in outs))
+SPECS["signsgd_update"] = S(
+    [_w, _g], {"lr": 0.1}, ref=lambda w, g: w - 0.1 * np.sign(g))
+SPECS["signum_update"] = S(
+    [_w, _g, _m1], {"lr": 0.1, "momentum": 0.9},
+    ref=lambda w, g, m: (w + 0.1 * np.sign(0.9 * m - 0.1 * g),
+                         0.9 * m - 0.1 * g))
+SPECS["lamb_update_phase1"] = S(
+    [_w, _g, _m1, _v1], {"t": 1},
+    ref=lambda w, g, m, v: _lamb1_ref(w, g, m, v))
+
+
+def _lamb1_ref(w, g, m, v, b1=0.9, b2=0.999, eps=1e-6):
+    m2 = b1 * m + (1 - b1) * g
+    v2 = b2 * v + (1 - b2) * g ** 2
+    mh = m2 / (1 - b1)
+    vh = v2 / (1 - b2)
+    return mh / (np.sqrt(vh) + eps)
+
+
+SPECS["lamb_update_phase2"] = S(
+    [_w, _g, np.array(2.0, np.float32), np.array(4.0, np.float32)],
+    {"lr": 0.1},
+    ref=lambda w, g, r1, r2: w - 0.1 * 0.5 * g)
+SPECS["multi_sum_sq"] = S(
+    [randn((2, 2), 145), randn((3,), 146)], {"num_arrays": 2},
+    ref=lambda a, b: (np.sum(a ** 2), np.sum(b ** 2)))
+
+# ---------------------------------------------------------------------------
+# random ops (statistical / support checks; draws are threefry-stateless)
+# ---------------------------------------------------------------------------
+
+
+def _stat(lo=None, hi=None, dtype=None, integral=False):
+    def chk(outs, ins):
+        x = np.asarray(outs[0]).astype(np.float64)
+        assert np.isfinite(x).all()
+        if lo is not None:
+            assert (x >= lo).all(), "values below support"
+        if hi is not None:
+            assert (x <= hi).all(), "values above support"
+        if integral:
+            assert np.allclose(x, np.round(x))
+        return True
+    return chk
+
+
+_RSHAPE = {"shape": (200,)}
+SPECS["_random_uniform"] = S([], dict(_RSHAPE, low=-1.0, high=2.0),
+                             check=_stat(-1.0, 2.0))
+SPECS["_random_normal"] = S([], dict(_RSHAPE, loc=1.0, scale=2.0),
+                            check=_stat())
+SPECS["_random_gamma"] = S([], dict(_RSHAPE, alpha=2.0, beta=1.0),
+                           check=_stat(lo=0.0))
+SPECS["_random_exponential"] = S([], dict(_RSHAPE, lam=2.0),
+                                 check=_stat(lo=0.0))
+SPECS["_random_poisson"] = S([], dict(_RSHAPE, lam=3.0),
+                             check=_stat(lo=0.0, integral=True))
+SPECS["_random_negative_binomial"] = S([], dict(_RSHAPE, k=3, p=0.5),
+                                       check=_stat(lo=0.0, integral=True))
+SPECS["_random_randint"] = S([], dict(_RSHAPE, low=2, high=9),
+                             check=_stat(2, 8, integral=True))
+SPECS["_random_bernoulli"] = S([], dict(_RSHAPE, prob=0.3),
+                               check=_stat(0.0, 1.0, integral=True))
+SPECS["_random_gumbel"] = S([], dict(_RSHAPE), check=_stat())
+SPECS["_sample_uniform"] = S(
+    [np.array([0.0, 5.0], np.float32), np.array([1.0, 6.0], np.float32)],
+    {"shape": (40,)}, check=_stat(0.0, 6.0))
+SPECS["_sample_normal"] = S(
+    [np.array([0.0, 10.0], np.float32), np.array([1.0, 1.0], np.float32)],
+    {"shape": (40,)}, check=_stat())
+SPECS["_sample_gamma"] = S(
+    [np.array([2.0, 3.0], np.float32), np.array([1.0, 1.0], np.float32)],
+    {"shape": (40,)}, check=_stat(lo=0.0))
+SPECS["_sample_multinomial"] = S(
+    [np.array([[0.2, 0.8], [0.5, 0.5]], np.float32)], {"shape": (30,)},
+    check=_stat(0, 1, integral=True))
+SPECS["_shuffle"] = S(
+    [np.arange(12, dtype=np.float32)],
+    check=lambda outs, ins: np.array_equal(
+        np.sort(np.asarray(outs[0])), ins[0]))
+SPECS["Dropout"] = S(
+    [pos((50,), 147)], {"p": 0.5},
+    check=lambda outs, ins: np.isfinite(np.asarray(outs[0])).all())
+
+# ---------------------------------------------------------------------------
+# ops excluded from the sweep — each covered by a dedicated test elsewhere
+# ---------------------------------------------------------------------------
+EXCLUDED = {
+    "RNN": "fused multi-layer scan op; NumPy-recurrence parity in "
+           "tests/test_gluon_rnn.py",
+    "CTCLoss": "alignment-marginalising loss; golden + grad tests in "
+               "tests/test_gluon.py (gluon.loss.CTCLoss)",
+}
+
+
+def _all_specs():
+    for name, spec in sorted(SPECS.items()):
+        specs = spec if isinstance(spec, list) else [spec]
+        for i, s in enumerate(specs):
+            yield ("%s#%d" % (name, i) if len(specs) > 1 else name), name, s
+
+
+def _fwd(name, spec):
+    inputs = [nd.array(x) for x in spec.inputs]
+    fn = getattr(mx.nd, name, None)
+    if fn is None:
+        from mxnet_tpu.ndarray.register import make_op_func
+        fn = make_op_func(name)
+    out = fn(*inputs, **spec.attrs)
+    return out if isinstance(out, list) else [out]
+
+
+@pytest.mark.parametrize("label,name,spec",
+                         list(_all_specs()),
+                         ids=[l for l, _, _ in _all_specs()])
+def test_forward(label, name, spec):
+    mx.random.seed(7)
+    outs = _fwd(name, spec)
+    if spec.check is not None:
+        assert spec.check(outs, spec.inputs), "check failed for %s" % name
+        return
+    if spec.ref is None:
+        for o in outs:
+            assert np.isfinite(o.asnumpy().astype(np.float64)).all()
+        return
+    expect = spec.ref(*spec.inputs)
+    if not isinstance(expect, tuple):
+        expect = (expect,)
+    for o, e in zip(outs, expect):
+        tu.assert_almost_equal(o.asnumpy(), np.asarray(e),
+                               rtol=spec.rtol, atol=spec.atol,
+                               names=("%s_out" % name, "ref"))
+
+
+_GRAD_SPECS = [(l, n, s) for l, n, s in _all_specs() if s.grad]
+
+
+@pytest.mark.parametrize("label,name,spec", _GRAD_SPECS,
+                         ids=[l for l, _, _ in _GRAD_SPECS])
+def test_fd_gradient(label, name, spec):
+    sym_fn = getattr(mx.sym, name, None)
+    if sym_fn is None:
+        from mxnet_tpu.symbol.symbol import make_symbol_op
+        sym_fn = make_symbol_op(name)
+    vars_ = [mx.sym.var("v%d" % i) for i in range(len(spec.inputs))]
+    out = sym_fn(*vars_, **spec.attrs)
+    if isinstance(out, list):
+        out = out[0]
+    loc = {"v%d" % i: x for i, x in enumerate(spec.inputs)}
+    tu.check_numeric_gradient(
+        out, loc, numeric_eps=spec.eps, rtol=spec.grad_rtol,
+        atol=spec.grad_atol, grad_nodes=spec.grad_nodes)
+
+
+def test_registry_fully_covered():
+    """Every registered op has a sweep spec or a justified exclusion."""
+    all_ops = set(registry._REGISTRY)
+    covered = set(SPECS) | set(EXCLUDED)
+    missing = sorted(all_ops - covered)
+    assert not missing, "ops missing sweep specs: %s" % missing
+    assert len(EXCLUDED) < 10, "too many exclusions"
+    stale = sorted(set(SPECS) - all_ops)
+    assert not stale, "specs for unregistered ops: %s" % stale

@@ -1,0 +1,74 @@
+"""Profiler API + chrome-trace export (ref tests/python/unittest/test_profiler.py)."""
+import json
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, profiler
+
+
+def test_profiler_collects_op_events(tmp_path):
+    fname = str(tmp_path / "profile.json")
+    profiler.set_config(filename=fname, aggregate_stats=True)
+    profiler.set_state("run")
+    a = nd.array(np.random.randn(32, 32).astype(np.float32))
+    b = nd.array(np.random.randn(32, 32).astype(np.float32))
+    for _ in range(3):
+        c = nd.dot(a, b)
+        c = nd.relu(c)
+    c.wait_to_read()
+    table = profiler.dumps()
+    assert "dot" in table and "relu" in table
+    profiler.set_state("stop")
+    profiler.dump()
+    with open(fname) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    names = {e["name"] for e in events}
+    assert "dot" in names and "relu" in names
+    assert all("ts" in e for e in events)
+    dots = [e for e in events if e["name"] == "dot"]
+    assert len(dots) == 3
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in dots)
+
+
+def test_profiler_pause_resume(tmp_path):
+    fname = str(tmp_path / "p2.json")
+    profiler.set_config(filename=fname)
+    profiler.set_state("run")
+    x = nd.array(np.ones((4, 4), np.float32))
+    profiler.pause()
+    _ = nd.exp(x)
+    profiler.resume()
+    _ = nd.log(nd.abs(x) + 1.0)
+    profiler.set_state("stop")
+    profiler.dump()
+    with open(fname) as f:
+        names = {e["name"] for e in json.load(f)["traceEvents"]}
+    assert "exp" not in names
+    assert "log" in names
+
+
+def test_profiler_custom_objects(tmp_path):
+    fname = str(tmp_path / "p3.json")
+    profiler.set_config(filename=fname)
+    profiler.set_state("run")
+    dom = profiler.Domain("custom")
+    task = dom.new_task("epoch")
+    task.start()
+    ctr = dom.new_counter("loss_scale", 7)
+    ctr += 3
+    dom.new_marker("checkpoint").mark()
+    task.stop()
+    profiler.set_state("stop")
+    profiler.dump()
+    with open(fname) as f:
+        events = json.load(f)["traceEvents"]
+    by_name = {}
+    for e in events:
+        by_name.setdefault(e["name"], []).append(e)
+    assert "epoch" in by_name and by_name["epoch"][0]["ph"] == "X"
+    assert "loss_scale" in by_name
+    assert by_name["loss_scale"][-1]["args"]["loss_scale"] == 10
+    assert "checkpoint" in by_name and by_name["checkpoint"][0]["ph"] == "i"
