@@ -235,6 +235,8 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
                 continue
             child = inp._tape_node
             if child is not None:
+                if hasattr(ct, "tostype"):  # sparse ct into an interior node
+                    ct = ct.tostype("default").data()
                 child.seed(inp._tape_index, ct)
             elif inp._marked:
                 inp._accumulate_grad(ct)

@@ -1,2 +1,3 @@
 """``mx.contrib`` — contrib namespaces (parity: python/mxnet/contrib/)."""
 from .. import amp  # noqa: F401
+from . import quantization  # noqa: F401

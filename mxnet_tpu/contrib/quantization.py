@@ -1,0 +1,391 @@
+"""INT8 quantization (parity: python/mxnet/contrib/quantization.py).
+
+Reference mechanism: a graph pass inserts quantize/dequantize/requantize
+around supported ops (``quantize_graph_pass.cc``), calibrated over a
+dataset by min/max ("naive") or KL-divergence thresholds ("entropy",
+``calibrate.cc``), executed by MKL-DNN/cuDNN int8 kernels.
+
+TPU-native mechanism: ``quantize_net`` walks a Gluon network and swaps
+Dense/Conv2D blocks for int8 equivalents whose matmul runs as an int8×int8
+``dot_general`` with int32 accumulation — the MXU's native int8 mode —
+then dequantizes with the calibrated scales.  ``quantize_model`` /
+``quantize_graph`` (the symbolic API) rewrite the Symbol with
+fake-quantize nodes (quantize→dequantize in f32): bit-identical numerics
+to the int8 path for calibration/accuracy work, while the int8 *speed*
+path is the Gluon converter (documented deviation: XLA fuses the symbolic
+graph itself, so a symbol-level int8 op swap would not change the kernels
+XLA picks).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .. import autograd
+from ..ndarray.ndarray import NDArray
+from ..gluon import nn as _nn
+from ..gluon.block import HybridBlock
+
+
+# ---------------------------------------------------------------------------
+# calibration
+# ---------------------------------------------------------------------------
+
+def _kl_divergence(p, q):
+    p = p / max(p.sum(), 1e-12)
+    q = q / max(q.sum(), 1e-12)
+    mask = p > 0
+    return float(np.sum(p[mask] * np.log(p[mask] /
+                                         np.maximum(q[mask], 1e-12))))
+
+
+def _get_optimal_threshold(samples, num_bins=1001, num_quantized_bins=255):
+    """KL-optimal |threshold| for int8 (parity: calibrate.cc
+    GetOptimalThreshold — minimize KL(P||Q) over truncation points)."""
+    arr = np.abs(np.concatenate([np.asarray(s).ravel() for s in samples]))
+    max_val = float(arr.max()) if arr.size else 1.0
+    if max_val == 0.0:
+        return 1e-8
+    hist, edges = np.histogram(arr, bins=num_bins, range=(0, max_val))
+    best_kl, best_t = None, max_val
+    for i in range(num_quantized_bins, num_bins + 1,
+                   max(1, (num_bins - num_quantized_bins) // 64)):
+        threshold = edges[i] if i < len(edges) else max_val
+        sliced = hist[:i].astype(np.float64)
+        if sliced.size == 0:
+            continue
+        # P: clipped distribution — outlier mass folds into the last bin;
+        # Q: the QUANTIZED version of the unclipped slice.  Building Q
+        # without the outliers is what makes KL punish aggressive
+        # truncation (reference calibrate.cc / TensorRT formulation).
+        p = sliced.copy()
+        p[-1] += hist[i:].sum()
+        factor = sliced.size / num_quantized_bins
+        q = np.zeros_like(sliced)
+        for j in range(num_quantized_bins):
+            lo = int(j * factor)
+            hi = int((j + 1) * factor) if j < num_quantized_bins - 1 \
+                else sliced.size
+            chunk = sliced[lo:hi]
+            nz = (chunk > 0).sum()
+            if nz:
+                q[lo:hi] = np.where(chunk > 0, chunk.sum() / nz, 0)
+        kl = _kl_divergence(p, q)
+        if best_kl is None or kl < best_kl:
+            best_kl, best_t = kl, threshold
+    return max(best_t, 1e-8)
+
+
+class _Calibrator:
+    """Collect per-layer input ranges over calibration batches."""
+
+    def __init__(self, mode="naive"):
+        if mode not in ("naive", "entropy"):
+            raise MXNetError("calib_mode must be naive or entropy")
+        self.mode = mode
+        self.samples = {}
+
+    def observe(self, name, arr):
+        a = np.asarray(arr.asnumpy() if hasattr(arr, "asnumpy") else arr)
+        self.samples.setdefault(name, []).append(a)
+
+    def threshold(self, name):
+        samples = self.samples.get(name)
+        if not samples:
+            return 1.0
+        if self.mode == "naive":
+            return max(float(np.abs(s).max()) for s in samples) or 1e-8
+        return _get_optimal_threshold(samples)
+
+
+# ---------------------------------------------------------------------------
+# int8 blocks
+# ---------------------------------------------------------------------------
+
+def _quant_params(threshold):
+    # symmetric int8: scale maps [-t, t] → [-127, 127]
+    return 127.0 / float(threshold)
+
+
+class QuantizedDense(HybridBlock):
+    """Dense with int8 weights/activations, int32 MXU accumulation."""
+
+    def __init__(self, dense, act_threshold, prefix=None):
+        super().__init__(prefix=prefix)
+        w = dense.weight.data().asnumpy()
+        self._w_scale = _quant_params(np.abs(w).max() or 1e-8)
+        self._w_q = jnp.asarray(
+            np.clip(np.round(w * self._w_scale), -127, 127), jnp.int8)
+        self._x_scale = _quant_params(act_threshold)
+        self._bias = None
+        if getattr(dense, "bias", None) is not None:
+            self._bias = jnp.asarray(dense.bias.data().asnumpy())
+        self._flatten = getattr(dense, "_flatten", True)
+        self._act = getattr(dense, "act", None)
+
+    def hybrid_forward(self, F, x):
+        from ..ops.registry import invoke_fn
+
+        w_q, w_scale, x_scale, bias = (self._w_q, self._w_scale,
+                                       self._x_scale, self._bias)
+        flatten = self._flatten
+
+        def fn(raw):
+            flat = raw.reshape(raw.shape[0], -1) if flatten else raw
+            xq = jnp.clip(jnp.round(flat * x_scale), -127, 127) \
+                .astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                xq, w_q, (((flat.ndim - 1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) / (x_scale * w_scale)
+            if bias is not None:
+                out = out + bias
+            return (out,)
+
+        (out,) = invoke_fn(fn, [x], op_name="quantized_dense")
+        if self._act is not None:
+            out = self._act(out)
+        return out
+
+
+class QuantizedConv2D(HybridBlock):
+    """Conv2D with int8 weights/activations, int32 accumulation."""
+
+    def __init__(self, conv, act_threshold, prefix=None):
+        super().__init__(prefix=prefix)
+        w = conv.weight.data().asnumpy()
+        self._w_scale = _quant_params(np.abs(w).max() or 1e-8)
+        self._w_q = jnp.asarray(
+            np.clip(np.round(w * self._w_scale), -127, 127), jnp.int8)
+        self._x_scale = _quant_params(act_threshold)
+        self._bias = None
+        if getattr(conv, "bias", None) is not None:
+            self._bias = jnp.asarray(conv.bias.data().asnumpy())
+        self._opkw = dict(conv._kwargs)
+        self._act = getattr(conv, "act", None)
+
+    def hybrid_forward(self, F, x):
+        from ..ops.registry import invoke_fn
+        from ..ops.nn import _CONV_DIMNUMS, _as_tuple
+
+        w_q, w_scale, x_scale, bias = (self._w_q, self._w_scale,
+                                       self._x_scale, self._bias)
+        kw = self._opkw
+        layout = kw.get("layout", "NCHW")
+
+        def fn(raw):
+            nd_ = w_q.ndim - 2
+            st = _as_tuple(kw.get("stride") or (1,) * nd_, nd_)
+            pd = _as_tuple(kw.get("pad") or (0,) * nd_, nd_)
+            xq = jnp.clip(jnp.round(raw * x_scale), -127, 127) \
+                .astype(jnp.int8)
+            dn = jax.lax.conv_dimension_numbers(
+                raw.shape, w_q.shape, _CONV_DIMNUMS[layout])
+            acc = jax.lax.conv_general_dilated(
+                xq, w_q, window_strides=st,
+                padding=[(p, p) for p in pd],
+                dimension_numbers=dn,
+                feature_group_count=kw.get("num_group", 1),
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) / (x_scale * w_scale)
+            if bias is not None:
+                if layout != "NCHW" and layout.endswith("C"):
+                    out = out + bias
+                else:
+                    out = out + bias.reshape((1, -1) + (1,) * nd_)
+            return (out,)
+
+        (out,) = invoke_fn(fn, [x], op_name="quantized_conv")
+        if self._act is not None:
+            out = self._act(out)
+        return out
+
+
+_QUANTIZABLE = {}
+
+
+def _register_quantizable():
+    _QUANTIZABLE[_nn.Dense] = QuantizedDense
+    _QUANTIZABLE[_nn.Conv2D] = QuantizedConv2D
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def quantize_net_v2(network, quantized_dtype="int8", calib_mode="naive",
+                    calib_data=None, num_calib_batches=None,
+                    exclude_layers=None, **kwargs):
+    """Quantize a Gluon net in place (parity: quantization.py:826).
+
+    Runs ``calib_data`` through the net observing each quantizable
+    layer's input range (naive min/max or KL-entropy threshold), then
+    replaces Dense/Conv2D children with int8 blocks.
+    """
+    if quantized_dtype not in ("int8", "auto"):
+        raise MXNetError("quantized_dtype must be int8 (TPU MXU mode)")
+    if calib_data is None:
+        raise MXNetError("calib_data is required")
+    _register_quantizable()
+    exclude = set(exclude_layers or ())
+
+    # find quantizable sub-blocks and hook their inputs
+    targets = []
+
+    def walk(block, path):
+        for name, child in list(block._children.items()):
+            full = "%s.%s" % (path, name) if path else name
+            if type(child) in _QUANTIZABLE and full not in exclude \
+                    and child.name not in exclude:
+                targets.append((block, name, full, child))
+            else:
+                walk(child, full)
+
+    walk(network, "")
+    if not targets:
+        raise MXNetError("no quantizable layers found")
+
+    calib = _Calibrator(calib_mode)
+    hooked = []
+    for _, _, full, child in targets:
+        orig = child.hybrid_forward
+
+        def make_spy(full_name, block, orig_fn):
+            def spy(F, x, *a, **kw):
+                calib.observe(full_name, x)
+                return orig_fn(F, x, *a, **kw)
+            return spy
+
+        child.hybrid_forward = make_spy(full, child, orig)
+        hooked.append((child, orig))
+
+    n = 0
+    with autograd.predict_mode():
+        for batch in calib_data:
+            data = batch[0] if isinstance(batch, (list, tuple)) else batch
+            network(data if isinstance(data, NDArray) else NDArray(data))
+            n += 1
+            if num_calib_batches is not None and n >= num_calib_batches:
+                break
+    for child, orig in hooked:
+        child.hybrid_forward = orig
+
+    for parent, name, full, child in targets:
+        qcls = _QUANTIZABLE[type(child)]
+        parent._children[name] = qcls(child, calib.threshold(full))
+        try:
+            setattr(parent, name, parent._children[name])
+        except Exception:
+            pass
+    return network
+
+
+def quantize_net(network, **kwargs):
+    return quantize_net_v2(network, **kwargs)
+
+
+def quantize_graph(sym, arg_params, aux_params, th_dict=None,
+                   excluded_sym_names=None, quantized_dtype="int8",
+                   **kwargs):
+    """Symbol rewrite inserting fake-quantize around FC/Conv inputs
+    (parity: quantization.py:651).  Numerics match the int8 path;
+    see module docstring for the TPU execution story."""
+    from .. import sym as _sym
+
+    th_dict = th_dict or {}
+    excluded = set(excluded_sym_names or ())
+
+    def fake_quant(s, threshold):
+        scale = 127.0 / max(float(threshold), 1e-8)
+        q = _sym.clip(_sym.round(s * scale), -127.0, 127.0)
+        return q / scale
+
+    # rebuild the graph bottom-up
+    from ..symbol.symbol import Symbol, _Node
+
+    memo = {}
+
+    def rebuild(node):
+        if id(node) in memo:
+            return memo[id(node)]
+        if node.is_variable:
+            memo[id(node)] = node
+            return node
+        new_inputs = []
+        for inp, idx in node.inputs:
+            new_inputs.append((rebuild(inp), idx))
+        nn_node = _Node(node.op, node.name, dict(node.attrs),
+                        new_inputs, node.num_outputs)
+        if node.op in ("FullyConnected", "Convolution") \
+                and node.name not in excluded:
+            # wrap data+weight entries in fake-quant subgraphs; the
+            # threshold belongs to the PRODUCER of each input (the
+            # calibrated tensor), weights use their exact |max|
+            wrapped = []
+            for j, (inp, idx) in enumerate(new_inputs):
+                if j <= 1:  # data, weight
+                    pname = inp.name
+                    if pname in arg_params:
+                        import numpy as _np
+
+                        t = float(_np.abs(
+                            arg_params[pname].asnumpy()).max()) or 1e-8
+                    else:
+                        t = th_dict.get(
+                            pname, th_dict.get(pname + "_output", 1.0))
+                    s_in = Symbol([(inp, idx)])
+                    fq = fake_quant(s_in, t)
+                    wrapped.append(fq._outputs[0])
+                else:
+                    wrapped.append((inp, idx))
+            nn_node.inputs = wrapped
+        memo[id(node)] = nn_node
+        return nn_node
+
+    heads = [(rebuild(n), i) for n, i in sym._outputs]
+    return Symbol(heads), arg_params, aux_params
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   ctx=None, excluded_sym_names=None, calib_mode="naive",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", **kwargs):
+    """Parity: quantization.py:463.  Calibrates thresholds by evaluating
+    the symbol over calib_data, then applies ``quantize_graph``."""
+    th_dict = {}
+    if calib_data is not None:
+        exe_inputs = {}
+        # naive per-head-input calibration: run forward, record FC/Conv
+        # input magnitudes via the internals
+        internals = sym.get_internals()
+        seen = 0
+        samples = {}
+        for batch in calib_data:
+            data = batch[0] if isinstance(batch, (list, tuple)) else batch
+            arr = data if isinstance(data, NDArray) else NDArray(data)
+            exe_inputs[data_names[0]] = arr
+            bindings = dict(exe_inputs)
+            for name, value in arg_params.items():
+                bindings[name] = value
+            for name, value in (aux_params or {}).items():
+                bindings[name] = value
+            outs = internals.eval_imperative(bindings)
+            for name, out in zip(internals.list_outputs(), outs):
+                samples.setdefault(name, []).append(out.asnumpy())
+            seen += arr.shape[0]
+            if num_calib_examples is not None and \
+                    seen >= num_calib_examples:
+                break
+        for name, arrs in samples.items():
+            if calib_mode == "entropy":
+                th_dict[name] = _get_optimal_threshold(arrs)
+            else:
+                th_dict[name] = max(float(np.abs(a).max()) for a in arrs) \
+                    or 1e-8
+    qsym, qarg, qaux = quantize_graph(
+        sym, arg_params, aux_params, th_dict=th_dict,
+        excluded_sym_names=excluded_sym_names,
+        quantized_dtype=quantized_dtype)
+    return qsym, qarg, qaux

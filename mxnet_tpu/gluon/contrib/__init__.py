@@ -1,0 +1,2 @@
+"""gluon.contrib (parity: python/mxnet/gluon/contrib/)."""
+from . import estimator  # noqa: F401

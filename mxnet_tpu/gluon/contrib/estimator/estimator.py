@@ -1,0 +1,149 @@
+"""Estimator: high-level fit/evaluate loop (parity:
+gluon/contrib/estimator/estimator.py:42).
+
+Drives a Gluon net through epochs of a DataLoader with pluggable event
+handlers.  The inner step is the ordinary imperative record/backward/step
+triple — on TPU the heavy path is already one XLA executable per step via
+the hybridized net (hybridize() before fit for the fused path).
+"""
+from __future__ import annotations
+
+from .... import autograd
+from ....base import MXNetError
+from ....metric import EvalMetric, Loss as LossMetric
+from ... import trainer as trainer_mod
+from ...loss import Loss
+from .event_handler import (
+    TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin, BatchEnd,
+    StoppingHandler, MetricHandler, LoggingHandler, ValidationHandler,
+)
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Estimator:
+    """Parity: estimator.py:42 (fit:305, evaluate:199, logger wiring)."""
+
+    def __init__(self, net, loss, metrics=None, trainer=None, context=None,
+                 evaluation_loss=None):
+        self.net = net
+        self.loss = loss
+        if not isinstance(loss, Loss):
+            raise MXNetError("loss must be a gluon.loss.Loss")
+        self.evaluation_loss = evaluation_loss or loss
+        self.train_metrics = _as_list(metrics)
+        for m in self.train_metrics:
+            if not isinstance(m, EvalMetric):
+                raise MXNetError("metrics must be EvalMetric instances")
+        # mirrored val metrics (fresh instances would need constructor
+        # args; reuse types where trivially possible, else share)
+        self.val_metrics = [type(m)() if type(m).__init__ is
+                            EvalMetric.__init__ else m
+                            for m in self.train_metrics]
+        self.train_loss_metric = LossMetric(name="loss")
+        self.val_loss_metric = LossMetric(name="validation loss")
+        self.trainer = trainer
+        self.context = context
+        self.stop_training = False
+
+    # ------------------------------------------------------------------
+    def _ensure_trainer(self):
+        if self.trainer is None:
+            self.trainer = trainer_mod.Trainer(
+                self.net.collect_params(), "adam",
+                {"learning_rate": 1e-3})
+
+    def _batch_fn(self, batch):
+        if isinstance(batch, (list, tuple)):
+            data, label = batch[0], batch[1]
+        else:
+            data, label = batch.data[0], batch.label[0]
+        return data, label
+
+    def evaluate(self, val_data, batch_fn=None):
+        """One pass over val_data updating val metrics (ref :199)."""
+        for m in self.val_metrics:
+            m.reset()
+        self.val_loss_metric.reset()
+        for batch in val_data:
+            data, label = (batch_fn or self._batch_fn)(batch)
+            with autograd.predict_mode():
+                pred = self.net(data)
+                loss = self.evaluation_loss(pred, label)
+            for m in self.val_metrics:
+                m.update(label, pred)
+            self.val_loss_metric.update(0, loss)
+        return [self.val_loss_metric] + self.val_metrics
+
+    def fit(self, train_data, val_data=None, epochs=None,
+            event_handlers=None, batches=None, batch_fn=None):
+        """Train for ``epochs`` (or ``batches``) with event hooks (ref :305)."""
+        if epochs is None and batches is None:
+            raise MXNetError("pass epochs or batches")
+        self._ensure_trainer()
+        handlers = self._prepare_handlers(val_data, event_handlers,
+                                          epochs, batches)
+        train_begin, epoch_begin, batch_begin, batch_end, epoch_end, \
+            train_end = self._categorize(handlers)
+
+        self.stop_training = False
+        for h in train_begin:
+            h.train_begin(self)
+        while not self.stop_training:
+            for h in epoch_begin:
+                h.epoch_begin(self)
+            for batch in train_data:
+                data, label = (batch_fn or self._batch_fn)(batch)
+                for h in batch_begin:
+                    h.batch_begin(self, batch=batch)
+                with autograd.record():
+                    pred = self.net(data)
+                    loss = self.loss(pred, label)
+                loss.backward()
+                batch_size = data.shape[0]
+                self.trainer.step(batch_size)
+                self.train_loss_metric.update(0, loss)
+                for h in batch_end:
+                    if h.batch_end(self, batch=batch, pred=pred,
+                                   label=label, loss=loss):
+                        self.stop_training = True
+                if self.stop_training:
+                    break
+            for h in epoch_end:
+                if h.epoch_end(self):
+                    self.stop_training = True
+        for h in train_end:
+            h.train_end(self)
+        return self
+
+    # ------------------------------------------------------------------
+    def _prepare_handlers(self, val_data, event_handlers, epochs, batches):
+        handlers = _as_list(event_handlers)
+        if not any(isinstance(h, StoppingHandler) for h in handlers):
+            handlers.append(StoppingHandler(epochs, batches))
+        if not any(isinstance(h, MetricHandler) for h in handlers):
+            handlers.append(MetricHandler(
+                [self.train_loss_metric] + self.train_metrics))
+        if val_data is not None and \
+                not any(isinstance(h, ValidationHandler) for h in handlers):
+            handlers.append(ValidationHandler(val_data, self.evaluate))
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler(
+                metrics=[self.train_loss_metric] + self.train_metrics))
+        return handlers
+
+    def _categorize(self, handlers):
+        def order(h):
+            return getattr(h, "priority", 0)
+
+        cats = []
+        for cls in (TrainBegin, EpochBegin, BatchBegin, BatchEnd,
+                    EpochEnd, TrainEnd):
+            cats.append(sorted((h for h in handlers if isinstance(h, cls)),
+                               key=order))
+        tb, eb, bb, be, ee, te = cats
+        return tb, eb, bb, be, ee, te

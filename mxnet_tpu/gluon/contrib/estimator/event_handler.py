@@ -1,0 +1,291 @@
+"""Training event handlers (parity: gluon/contrib/estimator/event_handler.py).
+
+Mixin interfaces (TrainBegin/TrainEnd/EpochBegin/EpochEnd/BatchBegin/
+BatchEnd) plus the stock handlers: stopping, metric bookkeeping,
+validation scheduling, logging, checkpointing, early stopping.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+import numpy as np
+
+
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop after max_epoch epochs or max_batch batches (ref :50)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch >= self.max_batch:
+            self.stop_training = True
+        return self.stop_training
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch >= self.max_epoch:
+            self.stop_training = True
+        return self.stop_training
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Reset train metrics each epoch, update per batch (ref :126)."""
+
+    def __init__(self, metrics, priority=-1000):
+        self.metrics = metrics
+        self.priority = priority
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for m in self.metrics:
+            m.reset()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        pred = kwargs.get("pred")
+        label = kwargs.get("label")
+        loss = kwargs.get("loss")
+        for m in self.metrics:
+            if getattr(m, "name", "").startswith("loss") or \
+                    type(m).__name__ == "Loss":
+                if loss is not None:
+                    m.update(0, loss)
+            elif pred is not None and label is not None:
+                m.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Run evaluation every N epochs/batches (ref :182)."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1, batch_period=None,
+                 priority=-1000):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.priority = priority
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and \
+                self.current_batch % self.batch_period == 0:
+            self.eval_fn(self.val_data)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and \
+                self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(self.val_data)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):
+    """Periodic throughput/metric logging (ref :276, Speedometer-style)."""
+
+    def __init__(self, log_interval="epoch", metrics=None, priority=-3000):
+        self.log_interval = log_interval
+        self.metrics = metrics or []
+        self.priority = priority
+        self.batch_index = 0
+        self.processed_samples = 0
+        self.logger = logging.getLogger("mxnet_tpu.estimator")
+        self._tic = None
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self._train_tic = time.time()
+        self.logger.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        self.logger.info("Training finished in %.1fs",
+                         time.time() - self._train_tic)
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self._tic = time.time()
+        self.batch_index = 0
+        self.processed_samples = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.batch_index += 1
+        batch = kwargs.get("batch")
+        if batch is not None:
+            try:
+                self.processed_samples += batch[0].shape[0]
+            except Exception:
+                pass
+        if isinstance(self.log_interval, int) and \
+                self.batch_index % self.log_interval == 0:
+            self._log("batch %d" % self.batch_index)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        dt = time.time() - (self._tic or time.time())
+        speed = self.processed_samples / dt if dt > 0 else 0.0
+        self._log("epoch done: %.1f samples/sec" % speed)
+
+    def _log(self, prefix):
+        parts = [prefix]
+        for m in self.metrics:
+            name, val = m.get()
+            parts.append("%s=%s" % (name, val))
+        self.logger.info(" ".join(str(p) for p in parts))
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Save parameters (and trainer states) periodically, keeping the best
+    by a monitored metric (ref :392)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 verbose=0, save_best=False, mode="auto", epoch_period=1,
+                 batch_period=None, max_checkpoints=5,
+                 resume_from_checkpoint=False):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.save_best = save_best
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.max_checkpoints = max_checkpoints
+        self.current_epoch = 0
+        self.current_batch = 0
+        self.saved = []
+        if mode == "auto" and monitor is not None:
+            name = monitor.get()[0] if hasattr(monitor, "get") else ""
+            mode = "max" if "acc" in str(name) or "f1" in str(name) \
+                else "min"
+        self._cmp = (lambda a, b: a > b) if mode == "max" \
+            else (lambda a, b: a < b)
+        self.best = None
+
+    def train_begin(self, estimator, *args, **kwargs):
+        os.makedirs(self.model_dir, exist_ok=True)
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and \
+                self.current_batch % self.batch_period == 0:
+            self._save(estimator, "batch%d" % self.current_batch)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and \
+                self.current_epoch % self.epoch_period == 0:
+            self._save(estimator, "epoch%d" % self.current_epoch)
+        if self.save_best and self.monitor is not None:
+            _, val = self.monitor.get()
+            if isinstance(val, (int, float, np.floating)) and \
+                    not np.isnan(val):
+                if self.best is None or self._cmp(val, self.best):
+                    self.best = val
+                    path = os.path.join(
+                        self.model_dir,
+                        "%s-best.params" % self.model_prefix)
+                    estimator.net.save_parameters(path)
+
+    def _save(self, estimator, tag):
+        path = os.path.join(self.model_dir,
+                            "%s-%s.params" % (self.model_prefix, tag))
+        estimator.net.save_parameters(path)
+        if estimator.trainer is not None:
+            try:
+                estimator.trainer.save_states(path + ".states")
+            except Exception:
+                pass
+        self.saved.append(path)
+        while len(self.saved) > self.max_checkpoints:
+            old = self.saved.pop(0)
+            for f in (old, old + ".states"):
+                if os.path.exists(f):
+                    os.remove(f)
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
+    """Stop when a monitored metric stops improving (ref :625)."""
+
+    def __init__(self, monitor, min_delta=0, patience=0, mode="auto",
+                 baseline=None):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.baseline = baseline
+        name = monitor.get()[0] if hasattr(monitor, "get") else ""
+        if mode == "auto":
+            mode = "max" if "acc" in str(name) or "f1" in str(name) \
+                else "min"
+        self._mode = mode
+        self.wait = 0
+        self.best = None
+        self.stop_training = False
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+
+    def _improved(self, val):
+        if self.best is None:
+            return True
+        if self._mode == "max":
+            return val > self.best + self.min_delta
+        return val < self.best - self.min_delta
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.wait = 0
+        self.best = self.baseline
+        self.stop_training = False
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        _, val = self.monitor.get()
+        if not isinstance(val, (int, float, np.floating)) or np.isnan(val):
+            return self.stop_training
+        if self._improved(val):
+            self.best = val
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped_epoch = self.current_epoch
+                self.stop_training = True
+        return self.stop_training
+
+    def train_end(self, estimator, *args, **kwargs):
+        if self.stopped_epoch:
+            logging.getLogger("mxnet_tpu.estimator").info(
+                "Early stopping at epoch %d", self.stopped_epoch)

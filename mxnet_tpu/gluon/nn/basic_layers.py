@@ -320,13 +320,16 @@ class Embedding(HybridBlock):
         super().__init__(prefix=prefix, params=params)
         self._input_dim = input_dim
         self._output_dim = output_dim
+        self._sparse_grad = sparse_grad
         self.weight = self.params.get(
             "weight", shape=(input_dim, output_dim), dtype=dtype,
-            init=weight_initializer, allow_deferred_init=True)
+            init=weight_initializer, allow_deferred_init=True,
+            grad_stype="row_sparse" if sparse_grad else "default")
 
     def hybrid_forward(self, F, x, weight):
         return F.Embedding(x, weight, input_dim=self._input_dim,
-                           output_dim=self._output_dim)
+                           output_dim=self._output_dim,
+                           sparse_grad=self._sparse_grad)
 
     def __repr__(self):
         return "Embedding(%s -> %s)" % (self._input_dim, self._output_dim)

@@ -131,13 +131,43 @@ class Trainer:
     def step(self, batch_size, ignore_stale_grad=False):
         """Rescale by 1/batch_size, aggregate, and apply one update.
 
-        Parity: Trainer.step (trainer.py:320).
+        Parity: Trainer.step (trainer.py:320).  With a ``dist_*`` kvstore
+        the optimizer runs server-side (update_on_kvstore, reference
+        trainer.py:174): grads are pushed, updated weights pulled back.
         """
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
+        kv = self._kvstore
+        if kv is not None and str(kv.type).startswith("dist") \
+                and self._update_on_kvstore is not False:
+            self._dist_step(ignore_stale_grad)
+            return
         self.allreduce_grads()
         self.update(batch_size, ignore_stale_grad, _rescaled=True)
+
+    def _dist_step(self, ignore_stale_grad=False):
+        """Push grads / pull weights through a distributed kvstore whose
+        server runs the optimizer (parity: update_on_kvstore path)."""
+        kv = self._kvstore
+        if not getattr(self, "_dist_initialized", False):
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    kv.init(i, param.data())
+            kv.set_optimizer(self._optimizer)
+            self._dist_initialized = True
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null" or param._data is None:
+                continue
+            if param._data._grad is None or not param._data._fresh_grad:
+                if ignore_stale_grad:
+                    continue
+                raise MXNetError(
+                    "stale gradient for parameter %s" % param.name)
+            kv.push(i, param._data.grad)
+            out = param.data()
+            kv.pull(i, out=out)
+            param._data._fresh_grad = False
 
     def update(self, batch_size, ignore_stale_grad=False, _rescaled=False):
         if not _rescaled:
@@ -165,6 +195,39 @@ class Trainer:
                     "ignore_stale_grad=True to suppress"
                     % param.name)
             active.append(i)
+        if not active:
+            return
+
+        # row-sparse grads take the lazy per-parameter scatter path
+        from ..ndarray.sparse import BaseSparseNDArray
+
+        sparse_active = [i for i in active
+                         if isinstance(self._params[i]._data._grad,
+                                       BaseSparseNDArray)]
+        if sparse_active:
+            active = [i for i in active if i not in set(sparse_active)]
+            for i in sparse_active:
+                param = self._params[i]
+                opt._update_count(i)
+                lr = opt._get_lr(i)
+                wd = opt._get_wd(i)
+                t = opt._index_update_count[i]
+                rsp = param._data._grad.compact()
+                w = param.data().data()
+                dev = list(w.devices())[0] if hasattr(w, "devices") else None
+                idx = rsp.indices.data().astype(jnp.int32)
+                vals = rsp.values.data().astype(w.dtype)
+                if dev is not None:
+                    # grads' index arrays may be committed to the host
+                    # context; the update must run where the weight lives
+                    idx = jax.device_put(idx, dev)
+                    vals = jax.device_put(vals, dev)
+                new_w, new_s = opt._get_sparse_jit_step()(
+                    w, self._states[i], vals, idx,
+                    jnp.float32(lr), jnp.float32(wd), jnp.int32(t))
+                param._data._set_data(new_w)
+                param._data._fresh_grad = False
+                self._states[i] = new_s
         if not active:
             return
 

@@ -77,7 +77,22 @@ class KVStore(KVStoreBase):
             acc = acc + v.data()
         return acc
 
+    @staticmethod
+    def _reduce_sparse(values):
+        """Merge row_sparse pushes: concat (idx, vals) pairs, sum dupes.
+
+        Parity: CommCPU's row_sparse reduce (src/kvstore/comm.h) — the
+        aggregated gradient stays sparse all the way to the updater.
+        """
+        vals = _as_list(values)
+        acc = vals[0]
+        for v in vals[1:]:
+            acc = acc + v
+        return acc.compact()
+
     def push(self, key, value, priority=0):
+        from ..ndarray.sparse import RowSparseNDArray
+
         keys = _as_list(key)
         if len(keys) == 1:
             values = [value]
@@ -85,6 +100,22 @@ class KVStore(KVStoreBase):
             values = value
         for k, v in zip(keys, values):
             k = str(k)
+            first = _as_list(v)[0]
+            if isinstance(first, RowSparseNDArray):
+                agg = self._reduce_sparse(v)
+                if self._updater is not None:
+                    if k not in self._store:
+                        raise MXNetError("key %s not initialized" % k)
+                    self._updater(int(k) if k.isdigit() else k,
+                                  agg, self._store[k])
+                else:
+                    # no updater: REPLACE the stored value with the
+                    # aggregated push, densified — same semantics as the
+                    # dense branch below (reference KVStoreLocal)
+                    self._store[k] = NDArray(
+                        agg.scatter_add_into(
+                            jnp.zeros(agg.shape, agg.dtype)))
+                continue
             agg = self._reduce(v)
             if self._updater is not None:
                 if k not in self._store:

@@ -1,0 +1,42 @@
+"""Server-process bootstrap (parity: python/mxnet/kvstore/kvstore_server.py:30).
+
+A process launched with ``DMLC_ROLE=server`` calls ``KVStoreServer.run()``
+(or just imports mxnet_tpu and calls ``serve_if_server()``, which
+tools/launch.py arranges) and blocks serving pushes/pulls until a worker
+sends STOP.
+"""
+from __future__ import annotations
+
+import os
+
+
+class KVStoreServer:
+    def __init__(self, kvstore=None):
+        self._server_id = int(os.environ.get("DMLC_SERVER_ID", "0"))
+        self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+        self._root_port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+        self._sync = "async" not in os.environ.get(
+            "MXNET_KVSTORE_MODE", "dist_sync")
+
+    def run(self):
+        from ..parallel.dist_kvstore import DistServer, _server_port
+
+        server = DistServer(
+            _server_port(self._root_port, self._server_id),
+            self._num_workers, sync=self._sync)
+        server.run()
+
+
+def serve_if_server():
+    """If this process is a server/scheduler, serve forever then exit.
+
+    The scheduler role of ps-lite collapsed into the servers (workers
+    rendezvous directly on server addresses), so a ``scheduler`` process
+    is a no-op kept for launcher compatibility.
+    """
+    role = os.environ.get("DMLC_ROLE", "worker")
+    if role == "server":
+        KVStoreServer().run()
+        raise SystemExit(0)
+    if role == "scheduler":
+        raise SystemExit(0)

@@ -11,6 +11,7 @@ from ..ops import nn as _n  # noqa: F401
 from ..ops import random_ops as _r  # noqa: F401
 from ..ops import optimizer_ops as _o  # noqa: F401
 from ..ops import contrib as _c  # noqa: F401
+from ..ops import pallas_kernels as _p  # noqa: F401
 
 from .ndarray import (  # noqa: F401
     NDArray, array, empty, zeros, ones, full, arange, zeros_like, ones_like,

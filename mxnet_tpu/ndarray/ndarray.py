@@ -45,6 +45,8 @@ def _to_jax_dtype(dtype):
 class NDArray:
     """A mutable-by-convention tensor over an immutable XLA buffer."""
 
+    _op_result_cls = None  # resolved to NDArray below; mx.np overrides
+
     __slots__ = (
         "_data", "_ctx", "_var",
         "_marked", "_grad", "_grad_req", "_grad_gen", "_fresh_grad",
@@ -176,6 +178,10 @@ class NDArray:
     def grad(self):
         if self._grad is None:
             return None
+        from .sparse import BaseSparseNDArray
+
+        if isinstance(self._grad, BaseSparseNDArray):
+            return self._grad
         return NDArray(self._grad, ctx=self._ctx)
 
     def _accumulate_grad(self, ct):
@@ -184,11 +190,28 @@ class NDArray:
         # counter (autograd._backward_gen) distinguishes the two cases.
         if self._grad_req == "null":
             return
-        ct = ct.astype(self.dtype)
+        from .sparse import BaseSparseNDArray, RowSparseNDArray
+
         gen = autograd.current_backward_gen()
         fresh = self._grad_gen != gen
         self._grad_gen = gen
         self._fresh_grad = True
+        if isinstance(ct, BaseSparseNDArray):
+            # row_sparse gradient (sparse Embedding path): keep it sparse
+            prev = self._grad
+            if prev is None or (fresh and self._grad_req == "write"):
+                self._grad = ct
+            elif isinstance(prev, RowSparseNDArray):
+                self._grad = prev + ct
+            else:
+                self._grad = ct.scatter_add_into(prev)
+            return
+        ct = ct.astype(self.dtype)
+        if isinstance(self._grad, BaseSparseNDArray):
+            prev = self._grad.tostype("default").data() \
+                if not (fresh and self._grad_req == "write") else None
+            self._grad = ct if prev is None else prev + ct
+            return
         if self._grad is None or (fresh and self._grad_req == "write"):
             self._grad = ct
         else:
@@ -563,6 +586,9 @@ class NDArray:
     # ------------------------------------------------------------------
     # serialization handled in ndarray.utils (save/load)
     # ------------------------------------------------------------------
+
+
+NDArray._op_result_cls = NDArray
 
 
 def _as_nd(x, ctx=None):

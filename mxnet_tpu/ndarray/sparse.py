@@ -32,12 +32,48 @@ class BaseSparseNDArray:
 class RowSparseNDArray(BaseSparseNDArray):
     stype = "row_sparse"
 
-    def __init__(self, data, indices, shape, ctx=None):
+    def __init__(self, data, indices, shape, ctx=None, canonical=False):
         self.values = data if isinstance(data, NDArray) else NDArray(data, ctx=ctx)
         self.indices = (indices if isinstance(indices, NDArray)
                         else NDArray(indices, ctx=ctx, dtype="int64"))
         self._shape = tuple(shape)
         self._ctx = ctx or current_context()
+        # canonical = indices known unique+sorted; lets hot paths skip the
+        # host-synchronizing dedup in compact()
+        self._canonical = canonical
+
+    @classmethod
+    def from_dense(cls, arr):
+        """Compress a dense NDArray by dropping all-zero rows."""
+        return row_sparse_array(arr)
+
+    def compact(self):
+        """Return an equivalent RowSparseNDArray with unique sorted indices
+        (duplicate rows summed) — the canonical reference layout."""
+        if self._canonical:
+            return self
+        idx = self.indices.asnumpy().astype(_np.int64)
+        uniq, inv = _np.unique(idx, return_inverse=True)
+        vals = self.values.data()
+        summed = jnp.zeros((len(uniq),) + tuple(vals.shape[1:]), vals.dtype)
+        summed = summed.at[jnp.asarray(inv)].add(vals)
+        return RowSparseNDArray(NDArray(summed), NDArray(uniq), self._shape,
+                                ctx=self._ctx, canonical=True)
+
+    def __add__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            idx = jnp.concatenate([self.indices.data(),
+                                   other.indices.data()])
+            vals = jnp.concatenate([self.values.data(),
+                                    other.values.data()])
+            return RowSparseNDArray(NDArray(vals), NDArray(idx),
+                                    self._shape, ctx=self._ctx).compact()
+        return self.tostype("default") + other
+
+    def scatter_add_into(self, dense_raw):
+        """dense_raw.at[indices].add(values) — sparse apply."""
+        return dense_raw.at[self.indices.data().astype(jnp.int32)].add(
+            self.values.data().astype(dense_raw.dtype))
 
     @property
     def shape(self):
@@ -74,6 +110,10 @@ class RowSparseNDArray(BaseSparseNDArray):
 
 class CSRNDArray(BaseSparseNDArray):
     stype = "csr"
+
+    @classmethod
+    def from_dense(cls, arr):
+        return csr_matrix(arr)
 
     def __init__(self, data, indptr, indices, shape, ctx=None):
         self.data_arr = data if isinstance(data, NDArray) else NDArray(data, ctx=ctx)
@@ -182,8 +222,35 @@ def retain(data, indices):
 
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
-    """csr · dense and rowsparse-aware dot."""
+    """csr · dense without densifying the csr operand.
+
+    Parity: ``src/operator/tensor/dot.cc`` sparse dot.  Each nonzero
+    contributes ``data[k] * rhs[col[k]]`` to row ``row[k]`` — one gather
+    plus one scatter-add, both VPU-friendly; the (static) row index per
+    nonzero is computed host-side from indptr.
+    """
     if isinstance(lhs, CSRNDArray):
-        dense = lhs.tostype("default")
-        return dense.dot(rhs, transpose_a=transpose_a, transpose_b=transpose_b)
+        rhs_raw = rhs.data() if isinstance(rhs, NDArray) else jnp.asarray(rhs)
+        if transpose_b or rhs_raw.ndim != 2:
+            # rare layouts take the dense fallback; the hot sparse path
+            # below assumes a (N, K) rhs gathered by column index
+            dense = lhs.tostype("default")
+            return dense.dot(rhs, transpose_a=transpose_a,
+                             transpose_b=transpose_b)
+        indptr = lhs.indptr.asnumpy().astype(_np.int64)
+        rows = _np.repeat(_np.arange(lhs.shape[0]), _np.diff(indptr))
+        cols = lhs.indices.data().astype(jnp.int32)
+        vals = lhs.data_arr.data()
+        if transpose_a:
+            # (N, M)·(M?, K): out[col] += v * rhs[row]
+            contrib = vals[:, None] * rhs_raw[jnp.asarray(rows)]
+            out = jnp.zeros((lhs.shape[1], rhs_raw.shape[1]), contrib.dtype)
+            out = out.at[cols].add(contrib)
+        else:
+            contrib = vals[:, None] * rhs_raw[cols]
+            out = jnp.zeros((lhs.shape[0], rhs_raw.shape[1]), contrib.dtype)
+            out = out.at[jnp.asarray(rows)].add(contrib)
+        return NDArray(out, ctx=lhs.context)
+    if isinstance(lhs, RowSparseNDArray):
+        lhs = lhs.tostype("default")
     return lhs.dot(rhs, transpose_a=transpose_a, transpose_b=transpose_b)

@@ -1,0 +1,107 @@
+"""``mx.np.linalg`` (parity: python/mxnet/numpy/linalg.py over the
+``_npi_*``/``src/operator/numpy/linalg`` kernels — here lowered straight
+to jnp.linalg through the traced invoke_fn path, so they are
+differentiable and engine-tracked)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _invoke, _as_np, ndarray, array
+from ..ndarray.ndarray import NDArray
+from ..ops import registry as _reg
+
+
+def _one(fn, a, name):
+    return _invoke(fn, [a if isinstance(a, NDArray) else array(a)], name)
+
+
+def norm(x, ord=None, axis=None, keepdims=False):
+    return _one(lambda a: jnp.linalg.norm(a, ord=ord, axis=axis,
+                                          keepdims=keepdims), x,
+                "_np_linalg_norm")
+
+
+def inv(a):
+    return _one(jnp.linalg.inv, a, "_np_linalg_inv")
+
+
+def det(a):
+    return _one(jnp.linalg.det, a, "_np_linalg_det")
+
+
+def slogdet(a):
+    outs = _reg.invoke_fn(
+        lambda x: tuple(jnp.linalg.slogdet(x)),
+        [a if isinstance(a, NDArray) else array(a)],
+        op_name="_np_linalg_slogdet")
+    return tuple(_as_np(o) for o in outs)
+
+
+def cholesky(a):
+    return _one(jnp.linalg.cholesky, a, "_np_linalg_cholesky")
+
+
+def svd(a):
+    outs = _reg.invoke_fn(
+        lambda x: tuple(jnp.linalg.svd(x, full_matrices=False)),
+        [a if isinstance(a, NDArray) else array(a)],
+        op_name="_np_linalg_svd")
+    return tuple(_as_np(o) for o in outs)
+
+
+def eigh(a):
+    outs = _reg.invoke_fn(
+        lambda x: tuple(jnp.linalg.eigh(x)),
+        [a if isinstance(a, NDArray) else array(a)],
+        op_name="_np_linalg_eigh")
+    return tuple(_as_np(o) for o in outs)
+
+
+def eigvalsh(a):
+    return _one(jnp.linalg.eigvalsh, a, "_np_linalg_eigvalsh")
+
+
+def solve(a, b):
+    return _invoke(jnp.linalg.solve,
+                   [a if isinstance(a, NDArray) else array(a),
+                    b if isinstance(b, NDArray) else array(b)],
+                   "_np_linalg_solve")
+
+
+def lstsq(a, b, rcond=None):
+    outs = _reg.invoke_fn(
+        lambda x, y: tuple(jnp.linalg.lstsq(x, y, rcond=rcond)),
+        [a if isinstance(a, NDArray) else array(a),
+         b if isinstance(b, NDArray) else array(b)],
+        op_name="_np_linalg_lstsq")
+    return tuple(_as_np(o) for o in outs)
+
+
+def pinv(a, rcond=1e-15):
+    return _one(lambda x: jnp.linalg.pinv(x, rcond=rcond), a,
+                "_np_linalg_pinv")
+
+
+def matrix_rank(a, tol=None):
+    return _one(lambda x: jnp.linalg.matrix_rank(x, tol=tol), a,
+                "_np_linalg_matrix_rank")
+
+
+def qr(a):
+    outs = _reg.invoke_fn(
+        lambda x: tuple(jnp.linalg.qr(x)),
+        [a if isinstance(a, NDArray) else array(a)],
+        op_name="_np_linalg_qr")
+    return tuple(_as_np(o) for o in outs)
+
+
+def tensorinv(a, ind=2):
+    return _one(lambda x: jnp.linalg.tensorinv(x, ind=ind), a,
+                "_np_linalg_tensorinv")
+
+
+def tensorsolve(a, b, axes=None):
+    return _invoke(lambda x, y: jnp.linalg.tensorsolve(x, y, axes=axes),
+                   [a if isinstance(a, NDArray) else array(a),
+                    b if isinstance(b, NDArray) else array(b)],
+                   "_np_linalg_tensorsolve")

@@ -1,0 +1,111 @@
+"""``mx.np.random`` (parity: python/mxnet/numpy/random.py).
+
+Draws come from the framework's global threefry key chain
+(``mxnet_tpu.random``) — same stateless-PRNG discipline as ``mx.nd.random``,
+so ``mx.random.seed`` reproduces np-frontend draws too.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import ndarray, array, _as_np
+from .. import random as _random
+from ..ndarray.ndarray import NDArray, _to_jax_dtype
+
+
+def _key():
+    return _random.next_key()
+
+
+def _shape(size):
+    if size is None:
+        return ()
+    if isinstance(size, int):
+        return (size,)
+    return tuple(size)
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None):
+    dt = _to_jax_dtype(dtype) if dtype else jnp.float32
+    return ndarray(jax.random.uniform(_key(), _shape(size), dt,
+                                      minval=low, maxval=high), ctx=ctx)
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None):
+    dt = _to_jax_dtype(dtype) if dtype else jnp.float32
+    return ndarray(jax.random.normal(_key(), _shape(size), dt)
+                   * scale + loc, ctx=ctx)
+
+
+def randn(*size):
+    return normal(size=size or None)
+
+
+def rand(*size):
+    return uniform(size=size or None)
+
+
+def randint(low, high=None, size=None, dtype="int32", ctx=None):
+    if high is None:
+        low, high = 0, low
+    return ndarray(jax.random.randint(_key(), _shape(size), low, high,
+                                      _to_jax_dtype(dtype)), ctx=ctx)
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None):
+    if isinstance(a, NDArray):
+        pool = a.data()
+    elif isinstance(a, int):
+        pool = jnp.arange(a)
+    else:
+        pool = jnp.asarray(a)
+    probs = None
+    if p is not None:
+        probs = p.data() if isinstance(p, NDArray) else jnp.asarray(p)
+    return ndarray(jax.random.choice(_key(), pool, _shape(size),
+                                     replace=replace, p=probs), ctx=ctx)
+
+
+def shuffle(x):
+    """In-place permutation along the first axis (numpy semantics)."""
+    perm = jax.random.permutation(_key(), x.shape[0])
+    x._set_data(x.data()[perm])
+
+
+def permutation(x):
+    if isinstance(x, int):
+        return ndarray(jax.random.permutation(_key(), x))
+    raw = x.data() if isinstance(x, NDArray) else jnp.asarray(x)
+    return ndarray(jax.random.permutation(_key(), raw))
+
+
+def beta(a, b, size=None, ctx=None):
+    return ndarray(jax.random.beta(_key(), a, b, _shape(size)), ctx=ctx)
+
+
+def gamma(shape, scale=1.0, size=None, ctx=None):
+    return ndarray(jax.random.gamma(_key(), shape, _shape(size)) * scale,
+                   ctx=ctx)
+
+
+def exponential(scale=1.0, size=None, ctx=None):
+    return ndarray(jax.random.exponential(_key(), _shape(size)) * scale,
+                   ctx=ctx)
+
+
+def poisson(lam=1.0, size=None, ctx=None):
+    return ndarray(jax.random.poisson(_key(), lam, _shape(size)), ctx=ctx)
+
+
+def multinomial(n, pvals, size=None):
+    draws = jax.random.categorical(
+        _key(), jnp.log(jnp.asarray(pvals)),
+        shape=_shape(size) + (n,) if size else (n,))
+    k = len(pvals) if not hasattr(pvals, "shape") else pvals.shape[-1]
+    counts = jax.nn.one_hot(draws, k).sum(axis=-2)
+    return ndarray(counts.astype(jnp.int64))
+
+
+def seed(s):
+    _random.seed(s)

@@ -1,0 +1,148 @@
+"""``mx.npx`` — numpy-extension namespace (parity: python/mxnet/numpy_extension
++ ``npx.set_np`` in python/mxnet/util.py:65).
+
+Operator-style functions (neural-net ops that have no NumPy equivalent)
+are the same registry ops as ``mx.nd.*``; because registry results adopt
+the class of their first input, calling them on ``mx.np.ndarray``s yields
+``mx.np.ndarray``s — no separate op stack.
+
+``set_np`` is a compatibility toggle: zero-dim/zero-size shapes are
+always legal here (XLA handles them natively), so the flag only tracks
+user intent for API parity (``is_np_shape``/``is_np_array`` report it).
+"""
+from __future__ import annotations
+
+import threading
+
+from .. import ndarray as _nd
+
+_flags = threading.local()
+
+
+def _st():
+    if not hasattr(_flags, "np_shape"):
+        _flags.np_shape = False
+        _flags.np_array = False
+    return _flags
+
+
+def set_np(shape=True, array=True):
+    """Enable numpy semantics (parity: util.py set_np)."""
+    if array and not shape:
+        raise ValueError("np_array requires np_shape")
+    st = _st()
+    st.np_shape, st.np_array = shape, array
+
+
+def reset_np():
+    set_np(False, False)
+
+
+def is_np_shape():
+    return _st().np_shape
+
+
+def is_np_array():
+    return _st().np_array
+
+
+def set_np_shape(active):
+    st = _st()
+    prev, st.np_shape = st.np_shape, bool(active)
+    return prev
+
+
+class np_shape:
+    """Context manager forcing the np-shape flag (parity: util.np_shape)."""
+
+    def __init__(self, active=True):
+        self._active = active
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_np_shape(self._active)
+        return self
+
+    def __exit__(self, *a):
+        set_np_shape(self._prev)
+
+
+class np_array:
+    def __init__(self, active=True):
+        self._active = active
+        self._prev = None
+
+    def __enter__(self):
+        st = _st()
+        self._prev = st.np_array
+        st.np_array = bool(self._active)
+        return self
+
+    def __exit__(self, *a):
+        _st().np_array = self._prev
+
+
+def use_np(func):
+    """Decorator running ``func`` under np semantics (parity: util.use_np)."""
+    import functools
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        st = _st()
+        prev = (st.np_shape, st.np_array)
+        st.np_shape = st.np_array = True
+        try:
+            return func(*args, **kwargs)
+        finally:
+            st.np_shape, st.np_array = prev
+
+    return wrapper
+
+
+# -- operator namespace: registry ops surfaced for np arrays ----------------
+activation = _nd.Activation
+batch_norm = _nd.BatchNorm
+convolution = _nd.Convolution
+deconvolution = _nd.Deconvolution
+fully_connected = _nd.FullyConnected
+pooling = _nd.Pooling
+dropout = _nd.Dropout
+embedding = _nd.Embedding
+layer_norm = _nd.LayerNorm
+group_norm = _nd.GroupNorm
+instance_norm = _nd.InstanceNorm
+l2_normalization = _nd.L2Normalization
+rnn = _nd.RNN
+leaky_relu = _nd.LeakyReLU
+softmax = _nd.softmax
+log_softmax = _nd.log_softmax
+sequence_mask = _nd.SequenceMask
+topk = _nd.topk
+pick = _nd.pick
+one_hot = _nd.one_hot
+gather_nd = _nd.gather_nd
+scatter_nd = _nd.scatter_nd
+reshape_like = _nd.reshape_like
+arange_like = _nd.contrib.arange_like
+batch_dot = _nd.batch_dot
+smooth_l1 = _nd.smooth_l1
+sigmoid = _nd.sigmoid
+relu = _nd.relu
+erf = _nd.erf
+erfinv = _nd.erfinv
+gamma = _nd.gamma
+gammaln = _nd.gammaln
+cumsum = _nd.cumsum
+foreach = _nd.contrib.foreach
+while_loop = _nd.contrib.while_loop
+cond = _nd.contrib.cond
+
+
+def seed(s):
+    from .. import random as _random
+
+    _random.seed(s)
+
+
+def waitall():
+    _nd.waitall()

@@ -764,3 +764,63 @@ def _bilinear_sampler(data, grid, cudnn_off=False):
     out = (v00 * (1 - wx_) * (1 - wy_) + v01 * wx_ * (1 - wy_)
            + v10 * (1 - wx_) * wy_ + v11 * wx_ * wy_)
     return jnp.transpose(out, (0, 3, 1, 2))
+
+
+# ----------------------------------------------------------------------------
+# Embedding with row_sparse gradient (imperative path)
+# ----------------------------------------------------------------------------
+
+
+def _embedding_sparse_invoke(inputs, attrs, out):
+    """Imperative Embedding with ``sparse_grad=True``: the weight gradient
+    is produced as a RowSparseNDArray (unique ids, summed cotangent rows)
+    instead of a dense table-sized array.
+
+    Parity: indexing_op.cc Embedding's kRowSparseStorage backward.  Only
+    active while recording imperatively; under hybridize/JitTrainStep the
+    whole graph is one XLA executable and scatter fusion already avoids
+    the dense materialization.
+    """
+    from .. import autograd as _ag
+    from ..engine import Engine
+    from ..ndarray.ndarray import NDArray
+    from ..ndarray import sparse as _sp
+    import numpy as _onp
+
+    truthy = attrs.get("sparse_grad") in (True, 1, "1", "true", "True")
+    if not truthy or out is not None:
+        return NotImplemented
+    if not (_ag.is_recording() and inputs[1]._in_graph):
+        return NotImplemented
+    data, weight = inputs[0], inputs[1]
+    ids = data.data().astype(jnp.int32)
+    eng = Engine.get()
+    out_raw = eng.push(
+        lambda: jnp.take(weight.data(), ids, axis=0, mode="clip"),
+        op_name="Embedding")
+    eng.track(out_raw)
+    w_shape = tuple(weight.shape)
+
+    def vjp_fn(cts):
+        ct = cts[0]
+        flat_ids = _onp.asarray(ids).reshape(-1)
+        vals = ct.reshape(-1, ct.shape[-1])
+        uniq, inv = _onp.unique(flat_ids, return_inverse=True)
+        summed = jnp.zeros((len(uniq), vals.shape[-1]), vals.dtype)
+        summed = summed.at[jnp.asarray(inv)].add(vals)
+        rsp = _sp.RowSparseNDArray(NDArray(summed), NDArray(uniq), w_shape,
+                                   ctx=weight.context, canonical=True)
+        return (None, rsp)
+
+    node = _ag.TapeNode(vjp_fn, [data, weight],
+                        [(out_raw.shape, out_raw.dtype)],
+                        op_name="Embedding")
+    res = NDArray(out_raw, ctx=weight.context)
+    res._tape_node = node
+    res._tape_index = 0
+    return res
+
+
+from .registry import register_invoke_override  # noqa: E402
+
+register_invoke_override("Embedding", _embedding_sparse_invoke)

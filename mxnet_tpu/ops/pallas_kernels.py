@@ -1,0 +1,171 @@
+"""Pallas TPU kernels: fused flash attention.
+
+The reference's fused-attention story is two CUDA kernels
+(``_contrib_interleaved_matmul_selfatt_qk``/``_valatt``,
+``src/operator/contrib/transformer.cc:650-780``) that still materialize
+the (T, T) score matrix.  TPU-native replacement: one Pallas kernel doing
+blocked online-softmax attention (flash attention) — scores never leave
+VMEM, HBM traffic is O(T·D) instead of O(T²), and the MXU sees back-to-
+back (block_q × D)·(D × block_k) matmuls.
+
+On non-TPU backends the kernel runs through the Pallas interpreter
+(tests), or falls back to a plain jnp attention when shapes don't tile.
+Backward: the forward saves only (q, k, v) — O(T·D) residuals — and the
+backward RECOMPUTES attention in plain XLA, which materializes the (T, T)
+score matrix transiently.  The forward memory win (inference, frozen
+backbones, activation checkpointing boundaries) is real; a fully blocked
+backward kernel is future work, so very long TRAINING sequences should
+use ring attention (parallel/ring_attention.py) to shard T first.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _use_interpret():
+    try:
+        return jax.default_backend() not in ("tpu",)
+    except Exception:
+        return True
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q,
+                      block_k, scale, causal):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32) * scale           # (bq, D)
+    t_kv = k_ref.shape[1]
+    n_k = t_kv // block_k
+    qi = pl.program_id(1)
+    row = qi * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(i, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.dslice(i * block_k, block_k), :] \
+            .astype(jnp.float32)                        # (bk, D)
+        v = v_ref[0, pl.dslice(i * block_k, block_k), :] \
+            .astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (bq, bk)
+        if causal:
+            col = i * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(col <= row, s, -jnp.inf)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    m, l, acc = lax.fori_loop(0, n_k, body, (m0, l0, acc0))
+    safe_l = jnp.where(l == 0, 1.0, l)
+    o_ref[0] = (acc / safe_l).astype(o_ref.dtype)
+
+
+def _flash_pallas(q, k, v, scale, causal, block_q, block_k):
+    from jax.experimental import pallas as pl
+
+    bh, t_q, d = q.shape
+    t_kv = k.shape[1]
+    kernel = functools.partial(
+        _flash_fwd_kernel, block_q=block_q, block_k=block_k,
+        scale=scale, causal=causal)
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, t_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+        interpret=_use_interpret(),
+    )(q, k, v)
+    return out
+
+
+def _attention_ref(q, k, v, scale, causal):
+    """Plain jnp attention (fallback + backward recompute)."""
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        t_q, t_kv = s.shape[-2], s.shape[-1]
+        row = jnp.arange(t_q)[:, None]
+        col = jnp.arange(t_kv)[None, :]
+        s = jnp.where(col <= row, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)) \
+        .astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention(q, k, v, scale, causal, block_q, block_k):
+    return _flash_pallas(q, k, v, scale, causal, block_q, block_k)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    out = _flash_pallas(q, k, v, scale, causal, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q_, k_, v_:
+                     _attention_ref(q_, k_, v_, scale, causal), q, k, v)
+    return vjp(g)
+
+
+_flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _tiles(t, preferred):
+    for b in (preferred, 128, 64, 32, 16, 8):
+        if b <= t and t % b == 0:
+            return b
+    return None
+
+
+@register("_contrib_flash_attention", inputs=("query", "key", "value"))
+def flash_attention(query, key, value, scale=None, causal=False,
+                    block_q=128, block_k=128):
+    """Fused multi-head attention, one Pallas kernel per (batch·head).
+
+    Inputs (B, H, T, D) [or (BH, T, D)]; returns same shape.  Scores are
+    computed blockwise with an online softmax; ``scale`` defaults to
+    1/sqrt(D).  Falls back to plain XLA attention when T doesn't tile.
+    """
+    squeeze = query.ndim == 3
+    if squeeze:
+        query, key, value = (x[:, None] if x.ndim == 3 else x
+                             for x in (query, key, value))
+    b, h, t_q, d = query.shape
+    t_kv = key.shape[2]
+    if scale is None or scale == 0:
+        scale = 1.0 / (d ** 0.5)
+    q3 = query.reshape(b * h, t_q, d)
+    k3 = key.reshape(b * h, t_kv, d)
+    v3 = value.reshape(b * h, t_kv, d)
+    bq = _tiles(t_q, int(block_q))
+    bk = _tiles(t_kv, int(block_k))
+    if bq is None or bk is None:
+        out3 = _attention_ref(q3, k3, v3, scale, causal)
+    else:
+        out3 = _flash_attention(q3, k3, v3, float(scale), bool(causal),
+                                bq, bk)
+    out = out3.reshape(b, h, t_q, d)
+    return out[:, 0] if squeeze else out

@@ -221,14 +221,24 @@ def invoke_fn(fn, inputs, op_name="custom", n_outputs=None):
     for o in outs:
         eng.track(o)
     ctx = inputs[0].context if inputs else None
+    cls = inputs[0]._op_result_cls if inputs else NDArray
     results = []
     for i, o in enumerate(outs):
-        arr = NDArray(o, ctx=ctx)
+        arr = cls(o, ctx=ctx)
         if node is not None:
             arr._tape_node = node
             arr._tape_index = i
         results.append(arr)
     return results
+
+
+# op-specific imperative overrides (e.g. Embedding's row_sparse gradient);
+# a handler returns NotImplemented to fall through to the generic path
+_INVOKE_OVERRIDES = {}
+
+
+def register_invoke_override(name, handler):
+    _INVOKE_OVERRIDES[name] = handler
 
 
 def invoke(name, inputs, attrs=None, out=None, fields=None):
@@ -237,6 +247,12 @@ def invoke(name, inputs, attrs=None, out=None, fields=None):
     Records a tape node when autograd is recording and any input is in-graph.
     """
     from ..ndarray.ndarray import NDArray
+
+    handler = _INVOKE_OVERRIDES.get(name)
+    if handler is not None:
+        res = handler(inputs, attrs or {}, out)
+        if res is not NotImplemented:
+            return res
 
     reg = get(name)
     datas = tuple(x.data() for x in inputs)
@@ -261,9 +277,12 @@ def invoke(name, inputs, attrs=None, out=None, fields=None):
         eng.track(o)
 
     ctx = inputs[0].context if inputs else None
+    # op results adopt the frontend class of the first input, so mx.np
+    # arrays stay mx.np arrays through every registry op
+    cls = inputs[0]._op_result_cls if inputs else NDArray
     results = []
     for i, o in enumerate(outs):
-        arr = NDArray(o, ctx=ctx)
+        arr = cls(o, ctx=ctx)
         if node is not None:
             arr._tape_node = node
             arr._tape_index = i

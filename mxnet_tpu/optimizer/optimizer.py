@@ -165,8 +165,46 @@ class Optimizer:
             self._jit_cache[key] = fn
         return fn
 
+    def _get_sparse_jit_step(self):
+        """Lazy (row-sparse) update executable: only rows present in the
+        gradient are touched — weight AND optimizer state (reference
+        lazy_update semantics, optimizer_op.cc sparse SGD/Adam variants).
+
+        Generic over any optimizer whose ``_step`` is row-wise elementwise:
+        gather the touched rows of weight/state, run the dense ``_step`` on
+        the slice, scatter back.
+        """
+        if not hasattr(self, "_sparse_jit_cache"):
+            self._sparse_jit_cache = {}
+        key = (self.rescale_grad, self.clip_gradient)
+        fn = self._sparse_jit_cache.get(key)
+        if fn is None:
+            rescale, clip = key
+            opt = self
+
+            def run(w, st, g, i, lr_, wd_, t_):
+                saved = (opt.rescale_grad, opt.clip_gradient)
+                opt.rescale_grad, opt.clip_gradient = rescale, clip
+                try:
+                    w_rows = w[i]
+                    st_rows = jax.tree_util.tree_map(lambda s: s[i], st)
+                    nw, nst = opt._step(w_rows, g, st_rows, lr_, wd_, t_)
+                finally:
+                    opt.rescale_grad, opt.clip_gradient = saved
+                w_new = w.at[i].set(nw.astype(w.dtype))
+                st_new = jax.tree_util.tree_map(
+                    lambda s, ns: s.at[i].set(ns.astype(s.dtype)),
+                    st, nst)
+                return w_new, st_new
+
+            fn = jax.jit(run)
+            self._sparse_jit_cache[key] = fn
+        return fn
+
     # -- imperative API (parity: Optimizer.update) -------------------------
     def update(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
+
         if isinstance(index, (list, tuple)):
             for i, w, g, s in zip(index, weight, grad, state):
                 self.update(i, w, g, s)
@@ -175,6 +213,21 @@ class Optimizer:
         lr = self._get_lr(index)
         wd = self._get_wd(index)
         t = self._index_update_count[index]
+        if isinstance(grad, RowSparseNDArray):
+            w = weight.data() if isinstance(weight, NDArray) else weight
+            rsp = grad.compact()
+            idx = rsp.indices.data().astype(jnp.int32)
+            vals = rsp.values.data().astype(w.dtype)
+            if hasattr(w, "devices"):
+                dev = list(w.devices())[0]
+                idx = jax.device_put(idx, dev)
+                vals = jax.device_put(vals, dev)
+            new_w, new_s = self._get_sparse_jit_step()(
+                w, state, vals, idx,
+                jnp.float32(lr), jnp.float32(wd), jnp.int32(t))
+            if isinstance(weight, NDArray):
+                weight._set_data(new_w)
+            return new_w, new_s
         w = weight.data() if isinstance(weight, NDArray) else weight
         g = grad.data() if isinstance(grad, NDArray) else grad
         new_w, new_s = self._get_jit_step()(

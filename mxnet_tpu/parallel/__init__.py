@@ -18,3 +18,4 @@ from .mesh import (  # noqa: F401
     MeshScope,
 )
 from .train_step import JitTrainStep  # noqa: F401
+from .ring_attention import ring_attention, ring_attention_sharded  # noqa: F401,E501

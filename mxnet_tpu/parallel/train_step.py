@@ -84,10 +84,23 @@ class JitTrainStep:
         # device copies of weights/state live here between steps; copied
         # (not aliased) because the step donates them — donating the very
         # buffers the gluon Parameters hold would invalidate p.data() after
-        # step 1 on TPU (CPU ignores donation, which hid this in tests)
-        self._weights = [jnp.array(p.data().data()) for p in self._params]
+        # step 1 on TPU (CPU ignores donation, which hid this in tests).
+        # device_put COMMITS them to the accelerator: (a) jit outputs are
+        # committed, so uncommitted initial weights would flip the cache
+        # key after step 1 and recompile the whole executable; (b) NDArray
+        # batches arrive committed to the DEFAULT context (cpu — reference
+        # semantics), and a single cpu-committed argument would drag the
+        # entire train step onto the host.
+        from ..context import _best_context
+
+        self._device = _best_context().jax_device
+        dev = self._device
+        self._weights = [jax.device_put(jnp.array(p.data().data()), dev)
+                         for p in self._params]
         self._opt_state = [
-            self._opt.create_state(i, self._weights[i])
+            jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, dev),
+                self._opt.create_state(i, self._weights[i]))
             if i in self._train_set else None
             for i in range(len(self._params))]
         if self._mesh is not None:
@@ -203,6 +216,8 @@ class JitTrainStep:
         if self._mesh is not None:
             arrays = [jax.device_put(a, self._batch_sharding(a))
                       for a in arrays]
+        else:
+            arrays = [jax.device_put(a, self._device) for a in arrays]
         if self._step_fn is None:
             self._step_fn = self._build(arrays)
         self._t += 1

@@ -1,0 +1,249 @@
+"""Distributed KVStore: sync/async aggregation, sparse, compression,
+server-side optimizer, and a real multi-process launch.
+
+Model: the reference nightly suite ``tests/nightly/dist_sync_kvstore.py:16-60``
+— deterministic expected values per rank asserted exactly.
+"""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+from mxnet_tpu.parallel.dist_kvstore import (
+    DistKVStore, DistServer, GradientCompression, _server_port)
+from mxnet_tpu.test_utils import assert_almost_equal
+
+_PORT_SEQ = [21310]
+
+
+def _start_cluster(num_workers, sync=True, num_servers=1):
+    _PORT_SEQ[0] += 10
+    root_port = _PORT_SEQ[0]
+    servers = []
+    for sid in range(num_servers):
+        srv = DistServer(_server_port(root_port, sid), num_workers,
+                         sync=sync)
+        t = threading.Thread(target=srv.run, daemon=True)
+        t.start()
+        servers.append(srv)
+    time.sleep(0.2)
+
+    def make_worker(rank):
+        os.environ["DMLC_PS_ROOT_PORT"] = str(root_port)
+        os.environ["DMLC_NUM_WORKER"] = str(num_workers)
+        os.environ["DMLC_NUM_SERVER"] = str(num_servers)
+        kv = DistKVStore("dist_sync" if sync else "dist_async")
+        kv._rank = rank
+        return kv
+
+    return servers, make_worker
+
+
+def test_dist_sync_exact_aggregation():
+    n = 3
+    servers, make_worker = _start_cluster(n, sync=True)
+    kvs = [make_worker(r) for r in range(n)]
+    results = [None] * n
+
+    def worker(rank):
+        kv = kvs[rank]
+        kv.init("w", nd.zeros((4, 2)))  # rank 0 inits; all ranks barrier
+        # each rank pushes rank+1 everywhere; sync sum = 1+2+3 = 6
+        kv.push("w", nd.array(np.full((4, 2), rank + 1.0, np.float32)))
+        out = nd.zeros((4, 2))
+        kv.pull("w", out=out)
+        results[rank] = out.asnumpy()
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    expect = np.full((4, 2), 6.0, np.float32)
+    for r in range(n):
+        assert results[r] is not None, "worker %d hung" % r
+        assert_almost_equal(results[r], expect)
+    kvs[0].stop()
+
+
+def test_dist_async_immediate_apply():
+    servers, make_worker = _start_cluster(1, sync=False)
+    kv = make_worker(0)
+    kv.init("k", nd.zeros((2,)))
+    kv.push("k", nd.array(np.array([1.0, 2.0], np.float32)))
+    out = nd.zeros((2,))
+    kv.pull("k", out=out)
+    assert_almost_equal(out.asnumpy(), np.array([1.0, 2.0], np.float32))
+    kv.stop()
+
+
+def test_dist_sparse_push_and_row_sparse_pull():
+    n = 2
+    servers, make_worker = _start_cluster(n, sync=True)
+    kvs = [make_worker(r) for r in range(n)]
+
+    def worker(rank):
+        kvs[rank].init("emb", nd.zeros((6, 2)))
+        rsp = sparse.RowSparseNDArray(
+            np.full((1, 2), rank + 1.0, np.float32),
+            np.array([2 * rank, ], np.int64), (6, 2))
+        kvs[rank].push("emb", rsp)
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    out = nd.zeros((6, 2))
+    kvs[0].row_sparse_pull("emb", out=out,
+                           row_ids=nd.array(np.array([0.0, 2.0])))
+    expect = np.zeros((6, 2), np.float32)
+    expect[0] = 1.0
+    expect[2] = 2.0
+    assert_almost_equal(out.asnumpy(), expect)
+    kvs[0].stop()
+
+
+def test_dist_server_side_optimizer():
+    servers, make_worker = _start_cluster(1, sync=True)
+    kv = make_worker(0)
+    w0 = np.ones((3,), np.float32)
+    kv.init("p", nd.array(w0))
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.5))
+    g = np.array([1.0, 2.0, 3.0], np.float32)
+    kv.push("p", nd.array(g))
+    out = nd.zeros((3,))
+    kv.pull("p", out=out)
+    assert_almost_equal(out.asnumpy(), w0 - 0.5 * g, rtol=1e-5, atol=1e-6)
+    kv.stop()
+
+
+def test_gradient_compression_2bit():
+    gc = GradientCompression(threshold=0.5)
+    g = np.array([0.9, -0.7, 0.2, 0.0], np.float32)
+    codes = gc.compress("k", g)
+    assert codes.dtype == np.int8
+    assert codes.tolist() == [1, -1, 0, 0]
+    # error feedback: residual carries the quantization error forward
+    assert_almost_equal(gc._residual["k"],
+                        np.array([0.4, -0.2, 0.2, 0.0], np.float32))
+    codes2 = gc.compress("k", np.array([0.2, 0.0, 0.2, 0.0], np.float32))
+    assert codes2.tolist() == [1, 0, 0, 0]  # 0.4+0.2 >= 0.5 fires now
+    dec = gc.decompress(codes)
+    assert_almost_equal(dec, np.array([0.5, -0.5, 0.0, 0.0], np.float32))
+
+
+def test_dist_push_with_compression():
+    servers, make_worker = _start_cluster(1, sync=True)
+    kv = make_worker(0)
+    kv.set_gradient_compression({"type": "2bit", "threshold": 1.0})
+    kv.init("c", nd.zeros((3,)))
+    kv.push("c", nd.array(np.array([2.0, -2.0, 0.1], np.float32)))
+    out = nd.zeros((3,))
+    kv.pull("c", out=out)
+    assert_almost_equal(out.asnumpy(), np.array([1.0, -1.0, 0.0],
+                                                np.float32))
+    kv.stop()
+
+
+_WORKER_SCRIPT = r"""
+import os
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+kv = mx.kvstore.create(os.environ.get("MXNET_KVSTORE_MODE", "dist_sync"))
+rank, n = kv.rank, kv.num_workers
+assert n == 2, n
+kv.init("x", nd.zeros((2, 3)))
+kv.push("x", nd.array(np.full((2, 3), rank + 1.0, np.float32)))
+out = nd.zeros((2, 3))
+kv.pull("x", out=out)
+expect = np.full((2, 3), 3.0, np.float32)  # 1 + 2
+assert np.allclose(out.asnumpy(), expect), out.asnumpy()
+kv.barrier()
+if rank == 0:
+    kv.stop()
+print("worker %d ok" % rank)
+"""
+
+
+def test_multiprocess_launch():
+    """tools/launch.py spawns servers+workers; exact sums across processes."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools.launch import launch
+
+    rc = launch(2, 1, [sys.executable, "-c", _WORKER_SCRIPT],
+                kv_store="dist_sync",
+                env_extra={"JAX_PLATFORMS": "cpu"})
+    assert rc == 0
+
+
+def test_trainer_dist_step_server_side_optimizer():
+    """gluon.Trainer with a dist kvstore: optimizer runs on the server."""
+    from mxnet_tpu import autograd, gluon
+
+    servers, make_worker = _start_cluster(1, sync=True)
+    kv = make_worker(0)
+    net = gluon.nn.Dense(2)
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(0).randn(4, 3).astype(np.float32))
+    y = nd.array(np.random.RandomState(1).randn(4, 2).astype(np.float32))
+    net(x)  # resolve shapes
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=kv)
+    loss_fn = gluon.loss.L2Loss()
+    w_before = net.weight.data().asnumpy().copy()
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    g = net.weight.grad.asnumpy() if not callable(net.weight.grad) \
+        else net.weight.grad().asnumpy()
+    trainer.step(4)
+    w_after = net.weight.data().asnumpy()
+    expect = w_before - 0.1 * (g / 4)
+    assert_almost_equal(w_after, expect, rtol=1e-4, atol=1e-5)
+    kv.stop()
+
+
+def test_dist_two_servers_key_sharding():
+    """num_servers=2: deterministic key→server mapping, exact sums."""
+    n = 2
+    servers, make_worker = _start_cluster(n, sync=True, num_servers=2)
+    kvs = [make_worker(r) for r in range(n)]
+    results = [None] * n
+
+    def worker(rank):
+        kv = kvs[rank]
+        for key in ("alpha", "beta", "7"):
+            kv.init(key, nd.zeros((2,)))
+        for key in ("alpha", "beta", "7"):
+            kv.push(key, nd.array(np.full((2,), rank + 1.0, np.float32)))
+        outs = {}
+        for key in ("alpha", "beta", "7"):
+            o = nd.zeros((2,))
+            kv.pull(key, out=o)
+            outs[key] = o.asnumpy()
+        results[rank] = outs
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    for r in range(n):
+        assert results[r] is not None, "worker %d hung" % r
+        for key in ("alpha", "beta", "7"):
+            assert_almost_equal(results[r][key],
+                                np.full((2,), 3.0, np.float32))
+    kvs[0].stop()

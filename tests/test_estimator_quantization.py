@@ -1,0 +1,144 @@
+"""Estimator facade, INT8 quantization, runtime feature query.
+
+Ref: gluon/contrib/estimator/estimator.py:42 + event_handler.py;
+contrib/quantization.py (quantize_net_v2:826, calibrate.cc KL thresholds);
+python/mxnet/runtime.py.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.gluon.contrib.estimator import (
+    Estimator, EarlyStoppingHandler, CheckpointHandler, StoppingHandler)
+from mxnet_tpu.contrib import quantization as quant
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _toy_data(n=64, d=8, classes=3, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, d).astype(np.float32)
+    w = rs.randn(d, classes).astype(np.float32)
+    y = (x @ w).argmax(1).astype(np.float32)
+    return x, y
+
+
+def _loader(x, y, batch=16):
+    return [(nd.array(x[i:i + batch]), nd.array(y[i:i + batch]))
+            for i in range(0, len(x), batch)]
+
+
+def test_estimator_fit_and_evaluate():
+    x, y = _toy_data()
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    acc = mx.metric.Accuracy()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=[acc],
+                    trainer=gluon.Trainer(net.collect_params(), "adam",
+                                          {"learning_rate": 0.05}))
+    est.fit(_loader(x, y), epochs=8)
+    name, train_acc = acc.get()
+    assert train_acc > 0.8, "estimator failed to learn: %s" % train_acc
+    results = est.evaluate(_loader(x, y))
+    val_loss = results[0].get()[1]
+    assert np.isfinite(val_loss)
+
+
+def test_estimator_early_stopping():
+    x, y = _toy_data(32)
+    net = gluon.nn.Dense(3)
+    net.initialize(mx.init.Xavier())
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss())
+    stopper = EarlyStoppingHandler(monitor=est.train_loss_metric,
+                                   patience=1, min_delta=100.0)
+    # min_delta so large nothing counts as improvement → stops after
+    # patience+1 epochs even though we asked for 50
+    est.fit(_loader(x, y), epochs=50, event_handlers=[stopper])
+    assert stopper.stop_training
+    assert stopper.current_epoch < 10
+
+
+def test_estimator_checkpointing(tmp_path):
+    x, y = _toy_data(32)
+    net = gluon.nn.Dense(3)
+    net.initialize(mx.init.Xavier())
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss())
+    ckpt = CheckpointHandler(str(tmp_path), model_prefix="toy",
+                             epoch_period=1, max_checkpoints=2)
+    est.fit(_loader(x, y), epochs=3, event_handlers=[ckpt])
+    files = sorted(os.listdir(tmp_path))
+    params = [f for f in files if f.endswith(".params")]
+    assert len(params) == 2  # max_checkpoints enforced
+    assert "toy-epoch3.params" in params
+
+
+def test_quantize_net_dense_accuracy():
+    rs = np.random.RandomState(3)
+    x = rs.randn(32, 8).astype(np.float32)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    ref = net(nd.array(x)).asnumpy()
+    quant.quantize_net_v2(net, calib_mode="naive",
+                          calib_data=[nd.array(x)])
+    out = net(nd.array(x)).asnumpy()
+    # int8 quantization error should be small relative to output scale
+    denom = np.abs(ref).max() or 1.0
+    assert np.abs(out - ref).max() / denom < 0.08, \
+        np.abs(out - ref).max() / denom
+    # quantized weights actually int8
+    q_layers = [c for c in net._children.values()
+                if isinstance(c, quant.QuantizedDense)]
+    assert len(q_layers) == 2
+    assert q_layers[0]._w_q.dtype == np.int8
+
+
+def test_quantize_net_conv():
+    rs = np.random.RandomState(4)
+    x = rs.randn(2, 3, 8, 8).astype(np.float32)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(4, 3, padding=1, activation="relu"))
+    net.add(gluon.nn.GlobalAvgPool2D(), gluon.nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    ref = net(nd.array(x)).asnumpy()
+    quant.quantize_net_v2(net, calib_mode="entropy",
+                          calib_data=[nd.array(x)])
+    out = net(nd.array(x)).asnumpy()
+    denom = np.abs(ref).max() or 1.0
+    assert np.abs(out - ref).max() / denom < 0.15
+
+
+def test_kl_threshold_sane():
+    rs = np.random.RandomState(5)
+    data = [rs.randn(1000).astype(np.float32)]
+    t = quant._get_optimal_threshold(data)
+    # KL threshold truncates the long gaussian tail: below max, above std
+    assert 1.0 < t <= float(np.abs(data[0]).max())
+
+
+def test_quantize_model_symbolic_fake_quant():
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc1")
+    rs = np.random.RandomState(6)
+    args = {"fc1_weight": nd.array(rs.randn(4, 8).astype(np.float32)),
+            "fc1_bias": nd.array(np.zeros(8, np.float32)[:4])}
+    x = rs.randn(2, 8).astype(np.float32)
+    qsym, qarg, qaux = quant.quantize_model(
+        fc, args, {}, calib_data=[nd.array(x)], calib_mode="naive")
+    ref = fc.eval(data=nd.array(x), **args)[0].asnumpy()
+    out = qsym.eval(data=nd.array(x), **qarg)[0].asnumpy()
+    denom = np.abs(ref).max() or 1.0
+    assert np.abs(out - ref).max() / denom < 0.08
+
+
+def test_runtime_features():
+    feats = mx.runtime.Features()
+    assert feats.is_enabled("XLA")
+    assert feats["bf16"].enabled
+    assert "TPU" in feats
+    fl = mx.runtime.feature_list()
+    assert any(f.name == "INT8" for f in fl)

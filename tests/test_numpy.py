@@ -1,0 +1,263 @@
+"""mx.np / mx.npx frontend: numpy semantics, interop protocols, autograd.
+
+Ports the pattern of the reference's
+``tests/python/unittest/test_numpy_interoperability.py`` (dispatch a slice
+of the NumPy API against mx.np arrays and compare with NumPy) and
+``test_numpy_ndarray.py`` (array semantics: zero-dim, zero-size, boolean
+masks, true division, autograd).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+np = mx.np
+
+
+def _check(mx_out, np_out, rtol=1e-5, atol=1e-6):
+    if isinstance(np_out, (tuple, list)):
+        for m, n in zip(mx_out, np_out):
+            _check(m, n, rtol, atol)
+        return
+    assert isinstance(mx_out, np.ndarray), type(mx_out)
+    assert mx_out.shape == onp.shape(np_out), \
+        (mx_out.shape, onp.shape(np_out))
+    assert_almost_equal(mx_out.asnumpy(), onp.asarray(np_out),
+                        rtol=rtol, atol=atol)
+
+
+# ---------------------------------------------------------------------------
+# interoperability sweep: (function name, args builder)
+# ---------------------------------------------------------------------------
+_A = onp.arange(12, dtype=onp.float32).reshape(3, 4) / 7 + 0.3
+_B = onp.arange(12, dtype=onp.float32).reshape(3, 4)[::-1].copy() / 5 + 0.1
+_SQ = onp.array([[2.0, 0.5], [0.5, 1.0]], onp.float32)
+_V = onp.linspace(0.2, 0.9, 5).astype(onp.float32)
+
+_INTEROP = [
+    ("add", (_A, _B)),
+    ("subtract", (_A, _B)),
+    ("multiply", (_A, _B)),
+    ("divide", (_A, _B)),
+    ("power", (_A, 2.0)),
+    ("maximum", (_A, _B)),
+    ("minimum", (_A, _B)),
+    ("mod", (_A, _B)),
+    ("hypot", (_A, _B)),
+    ("arctan2", (_A, _B)),
+    ("logaddexp", (_A, _B)),
+    ("copysign", (_A, -_B)),
+    ("exp", (_A,)),
+    ("expm1", (_A,)),
+    ("log", (_A,)),
+    ("log2", (_A,)),
+    ("log10", (_A,)),
+    ("log1p", (_A,)),
+    ("sqrt", (_A,)),
+    ("cbrt", (_A,)),
+    ("square", (_A,)),
+    ("reciprocal", (_A,)),
+    ("sin", (_A,)),
+    ("cos", (_A,)),
+    ("tan", (_V,)),
+    ("arcsin", (_V,)),
+    ("arccos", (_V,)),
+    ("arctan", (_A,)),
+    ("sinh", (_V,)),
+    ("cosh", (_V,)),
+    ("tanh", (_A,)),
+    ("arcsinh", (_A,)),
+    ("arctanh", (_V,)),
+    ("degrees", (_A,)),
+    ("radians", (_A,)),
+    ("floor", (_A,)),
+    ("ceil", (_A,)),
+    ("trunc", (_A,)),
+    ("rint", (_A,)),
+    ("absolute", (-_A,)),
+    ("sign", (_A - 1.0,)),
+    ("sum", (_A,)),
+    ("mean", (_A,)),
+    ("std", (_A,)),
+    ("var", (_A,)),
+    ("prod", (_V,)),
+    ("max", (_A,)),
+    ("min", (_A,)),
+    ("argmax", (_A,)),
+    ("argmin", (_A,)),
+    ("cumsum", (_A,)),
+    ("argsort", (_B,)),
+    ("sort", (_B,)),
+    ("median", (_A,)),
+    ("transpose", (_A,)),
+    ("reshape", (_A, (4, 3))),
+    ("swapaxes", (_A, 0, 1)),
+    ("expand_dims", (_A, 1)),
+    ("squeeze", (_A[None],)),
+    ("broadcast_to", (_V, (3, 5))),
+    ("tile", (_A, (2, 1))),
+    ("repeat", (_A, 2, 1)),
+    ("flip", (_A, 0)),
+    ("roll", (_A, 1, 0)),
+    ("rot90", (_A,)),
+    ("concatenate", ([_A, _B],)),
+    ("stack", ([_A, _B],)),
+    ("vstack", ([_A, _B],)),
+    ("hstack", ([_A, _B],)),
+    ("split", (_A, 2, 1)),
+    ("diag", (_V,)),
+    ("tril", (_A,)),
+    ("triu", (_A,)),
+    ("dot", (_A, _B.T)),
+    ("matmul", (_A, _B.T)),
+    ("inner", (_V, _V)),
+    ("outer", (_V, _V)),
+    ("tensordot", (_A, _B.T, 1)),
+    ("kron", (_SQ, _SQ)),
+    ("trace", (_A,)),
+    ("where", (_A > 0.8, _A, _B)),
+    ("isnan", (_A,)),
+    ("isinf", (_A,)),
+    ("isfinite", (_A,)),
+    ("clip", (_A, 0.4, 1.2)),
+    ("round", (_A,)),
+    ("take", (_V, onp.array([0, 2], onp.int64),)),
+    ("zeros_like", (_A,)),
+    ("ones_like", (_A,)),
+    ("unique", (onp.array([1.0, 2.0, 1.0, 3.0], onp.float32),)),
+    ("atleast_1d", (_V,)),
+    ("nansum", (_A,)),
+    ("logical_and", (_A > 0.5, _B > 0.5)),
+    ("logical_or", (_A > 0.5, _B > 0.5)),
+    ("logical_xor", (_A > 0.5, _B > 0.5)),
+    ("logical_not", (_A > 0.5,)),
+    ("average", (_A,)),
+    ("einsum", ("ij,ij->i", _A, _B)),
+    ("pad", (_SQ, ((1, 1), (0, 2)))),
+    ("moveaxis", (_A[None], 0, 2)),
+]
+
+
+@pytest.mark.parametrize("name,args", _INTEROP,
+                         ids=[n for n, _ in _INTEROP])
+def test_interop(name, args):
+    def conv(x):
+        if isinstance(x, onp.ndarray) and x.dtype != onp.int64:
+            return np.array(x)
+        if isinstance(x, list):
+            return [conv(i) for i in x]
+        return x
+
+    mx_args = [conv(a) for a in args]
+    mx_out = getattr(np, name)(*mx_args)
+    np_out = getattr(onp, name)(*args)
+    _check(mx_out, np_out, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name,args", [
+    ("norm", (_A,)),
+    ("inv", (_SQ,)),
+    ("det", (_SQ,)),
+    ("cholesky", (_SQ,)),
+    ("eigvalsh", (_SQ,)),
+    ("solve", (_SQ, onp.array([1.0, 2.0], onp.float32))),
+    ("pinv", (onp.random.RandomState(3).randn(3, 4).astype(onp.float32),)),
+    ("matrix_rank", (_SQ,)),
+], ids=lambda v: v if isinstance(v, str) else "")
+def test_linalg_interop(name, args):
+    mx_out = getattr(np.linalg, name)(*[np.array(a) for a in args])
+    np_out = getattr(onp.linalg, name)(*args)
+    if isinstance(np_out, onp.ndarray) or onp.isscalar(np_out):
+        _check(mx_out, np_out, rtol=1e-3, atol=1e-4)
+    else:
+        assert int(mx_out.item()) == int(np_out)
+
+
+def test_array_function_protocol():
+    """Real numpy functions dispatch to mx.np via __array_function__."""
+    a = np.array(_A)
+    out = onp.concatenate([a, a])
+    assert isinstance(out, np.ndarray)
+    assert out.shape == (6, 4)
+    out2 = onp.sum(a, axis=0)
+    assert isinstance(out2, np.ndarray)
+
+
+def test_array_ufunc_protocol():
+    a = np.array(_A)
+    out = onp.add(a, 1.0)
+    assert isinstance(out, np.ndarray)
+    assert_almost_equal(out.asnumpy(), _A + 1.0)
+    out = onp.exp(a)
+    assert isinstance(out, np.ndarray)
+
+
+def test_zero_dim_and_zero_size():
+    z = np.array(2.5)
+    assert z.shape == () and z.item() == 2.5
+    assert (z * 2).shape == ()
+    e = np.ones((0, 4))
+    assert e.shape == (0, 4) and e.size == 0
+    assert np.sum(e).item() == 0.0
+    assert np.concatenate([e, np.ones((2, 4))]).shape == (2, 4)
+
+
+def test_bool_comparisons_and_masking():
+    a = np.array(_A)
+    m = a > 0.8
+    assert m.dtype == onp.bool_
+    picked = a[m]
+    assert_almost_equal(picked.asnumpy(), _A[_A > 0.8])
+
+
+def test_np_autograd():
+    x = np.array([0.5, 1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = np.sum(x ** 2 * np.exp(x))
+    y.backward()
+    xv = onp.array([0.5, 1.0, 2.0])
+    expect = (2 * xv + xv ** 2) * onp.exp(xv)
+    assert_almost_equal(x.grad.asnumpy(), expect, rtol=1e-4, atol=1e-5)
+    assert isinstance(x.grad, mx.NDArray)
+
+
+def test_npx_ops_return_np_arrays():
+    x = np.array(onp.random.randn(2, 8).astype(onp.float32))
+    out = mx.npx.softmax(x)
+    assert isinstance(out, np.ndarray)
+    assert_almost_equal(np.sum(out, axis=-1).asnumpy(),
+                        onp.ones(2, onp.float32), rtol=1e-5, atol=1e-5)
+    w = np.array(onp.random.randn(3, 8).astype(onp.float32))
+    y = mx.npx.fully_connected(x, w, num_hidden=3, no_bias=True)
+    assert isinstance(y, np.ndarray) and y.shape == (2, 3)
+
+
+def test_set_np_flags():
+    assert not mx.is_np_array()
+    mx.set_np()
+    assert mx.is_np_array() and mx.is_np_shape()
+    mx.reset_np()
+    assert not mx.is_np_shape()
+    with mx.npx.np_shape(True):
+        assert mx.is_np_shape()
+    assert not mx.is_np_shape()
+
+
+def test_as_nd_roundtrip():
+    a = np.array(_A)
+    nd_view = a.as_nd_ndarray()
+    assert type(nd_view) is mx.NDArray
+    back = nd_view.data()
+    assert back is a.data()
+    again = np.array(nd_view)
+    assert isinstance(again, np.ndarray)
+
+
+def test_true_division_int():
+    a = np.array([1, 2, 3], dtype="int32")
+    out = a / 2
+    assert out.dtype.kind == "f"
+    assert_almost_equal(out.asnumpy(), onp.array([0.5, 1.0, 1.5]))

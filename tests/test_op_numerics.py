@@ -658,6 +658,19 @@ SPECS["_contrib_interleaved_matmul_selfatt_valatt"] = S(
     ref=lambda qkv, att: _selfatt_valatt_ref(qkv, att, 2),
     rtol=1e-3, atol=1e-4)
 
+
+def _flash_ref(q, k, v):
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(q.shape[-1])
+    p = _softmax_ref(s)
+    return np.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+SPECS["_contrib_flash_attention"] = S(
+    [randn((1, 2, 16, 4), 135), randn((1, 2, 16, 4), 136),
+     randn((1, 2, 16, 4), 137)],
+    {"block_q": 8, "block_k": 8},
+    ref=_flash_ref, rtol=1e-3, atol=1e-4)
+
 # ---------------------------------------------------------------------------
 # optimizer update ops (golden numpy re-implementations)
 # ---------------------------------------------------------------------------

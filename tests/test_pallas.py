@@ -1,0 +1,122 @@
+"""Pallas kernels: flash attention, ring attention (SP), mx.rtc analog.
+
+Flash attention replaces the reference's fused attention CUDA kernels
+(transformer.cc:650-780); ring attention is the long-context sequence-
+parallel design (no reference counterpart, SURVEY §5.7).  On CPU the
+kernels run through the Pallas interpreter.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _ref_attention(q, k, v, scale, causal=False):
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        t_q, t_kv = s.shape[-2], s.shape[-1]
+        mask = np.arange(t_kv)[None, :] <= np.arange(t_q)[:, None]
+        s = np.where(mask, s, -np.inf)
+    s = s - s.max(-1, keepdims=True)
+    p = np.exp(s)
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_matches_reference(causal):
+    rs = np.random.RandomState(0)
+    b, h, t, d = 2, 2, 32, 8
+    q = rs.randn(b, h, t, d).astype(np.float32)
+    k = rs.randn(b, h, t, d).astype(np.float32)
+    v = rs.randn(b, h, t, d).astype(np.float32)
+    scale = 1.0 / np.sqrt(d)
+    out = mx.nd.contrib.flash_attention(
+        nd.array(q), nd.array(k), nd.array(v), causal=causal,
+        block_q=16, block_k=16)
+    expect = _ref_attention(q, k, v, scale, causal)
+    assert_almost_equal(out.asnumpy(), expect, rtol=1e-4, atol=1e-5)
+
+
+def test_flash_attention_grad():
+    rs = np.random.RandomState(1)
+    t, d = 16, 4
+    q = nd.array(rs.randn(1, 1, t, d).astype(np.float32))
+    k = nd.array(rs.randn(1, 1, t, d).astype(np.float32))
+    v = nd.array(rs.randn(1, 1, t, d).astype(np.float32))
+    for a in (q, k, v):
+        a.attach_grad()
+    with autograd.record():
+        out = mx.nd.contrib.flash_attention(q, k, v, block_q=8, block_k=8)
+        loss = (out * out).sum()
+    loss.backward()
+    # FD check on q
+    eps = 1e-2
+    q_np = q.asnumpy()
+
+    def f(q_raw):
+        o = _ref_attention(q_raw, k.asnumpy(), v.asnumpy(),
+                           1.0 / np.sqrt(d))
+        return (o * o).sum()
+
+    num = np.zeros_like(q_np)
+    for i in range(q_np.size):
+        for sgn in (1.0, -1.0):
+            p = q_np.copy().ravel()
+            p[i] += sgn * eps
+            num.ravel()[i] += sgn * f(p.reshape(q_np.shape))
+    num /= 2 * eps
+    assert_almost_equal(q.grad.asnumpy(), num, rtol=5e-2, atol=1e-2)
+
+
+def test_flash_attention_fallback_odd_shapes():
+    rs = np.random.RandomState(2)
+    q = nd.array(rs.randn(1, 1, 7, 4).astype(np.float32))  # 7 doesn't tile
+    out = mx.nd.contrib.flash_attention(q, q, q)
+    expect = _ref_attention(q.asnumpy(), q.asnumpy(), q.asnumpy(),
+                            1.0 / 2.0)
+    assert_almost_equal(out.asnumpy(), expect, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(causal):
+    import jax
+    from mxnet_tpu import parallel
+    from mxnet_tpu.parallel.ring_attention import ring_attention_sharded
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 virtual devices")
+    mesh = parallel.make_mesh({"sp": 4})
+    rs = np.random.RandomState(3)
+    b, t, d = 2, 32, 8  # t sharded 4-way → 8 per chip
+    q = rs.randn(b, t, d).astype(np.float32)
+    k = rs.randn(b, t, d).astype(np.float32)
+    v = rs.randn(b, t, d).astype(np.float32)
+    out = ring_attention_sharded(
+        jax.numpy.asarray(q), jax.numpy.asarray(k), jax.numpy.asarray(v),
+        mesh, axis_name="sp", causal=causal)
+    expect = _ref_attention(q[:, None], k[:, None], v[:, None],
+                            1.0 / np.sqrt(d), causal)[:, 0]
+    assert_almost_equal(np.asarray(out), expect, rtol=1e-4, atol=1e-5)
+
+
+def test_rtc_pallas_kernel():
+    import jax
+
+    def scale_add(x_ref, y_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0 + y_ref[...]
+
+    kern = mx.rtc.PallasKernel(
+        scale_add,
+        out_shape=jax.ShapeDtypeStruct((8, 128), np.float32))
+    rs = np.random.RandomState(4)
+    x = rs.randn(8, 128).astype(np.float32)
+    y = rs.randn(8, 128).astype(np.float32)
+    out = kern(nd.array(x), nd.array(y))
+    assert_almost_equal(out.asnumpy(), x * 2 + y)
+    mod = mx.rtc.PallasModule(scale_add=kern)
+    assert mod.get_kernel("scale_add") is kern
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.CudaModule("__global__ void k() {}")

@@ -1,0 +1,166 @@
+"""Sparse training path: row_sparse Embedding grads, lazy optimizer
+updates, sparse KVStore aggregation, CSR dot.
+
+Ref: example/sparse (linear+embedding training), optimizer_op.cc sparse
+variants, kvstore_dist.h:344-373 row-sparse protocol,
+tests/python/unittest/test_sparse_operator.py.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.ndarray import sparse
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_embedding_sparse_grad_matches_dense():
+    rs = np.random.RandomState(0)
+    w_np = rs.randn(10, 4).astype(np.float32)
+    ids_np = np.array([1, 3, 3, 7], np.int32)
+    ct = rs.randn(4, 4).astype(np.float32)
+
+    def run(sparse_grad):
+        w = nd.array(w_np)
+        w.attach_grad()
+        with autograd.record():
+            out = nd.Embedding(nd.array(ids_np), w, input_dim=10,
+                               output_dim=4, sparse_grad=sparse_grad)
+        out.backward(nd.array(ct))
+        return w.grad
+
+    g_dense = run(False)
+    g_sparse = run(True)
+    assert isinstance(g_sparse, sparse.RowSparseNDArray)
+    # touched rows only: 1, 3, 7 (3 appears twice → summed)
+    assert sorted(g_sparse.indices.asnumpy().tolist()) == [1, 3, 7]
+    assert_almost_equal(g_sparse.asnumpy(), g_dense.asnumpy())
+
+
+def test_sparse_sgd_lazy_update():
+    rs = np.random.RandomState(1)
+    w0 = rs.randn(8, 3).astype(np.float32)
+    opt = mx.optimizer.create("sgd", learning_rate=0.5, momentum=0.9)
+    state = opt.create_state(0, nd.array(w0))
+    rsp = sparse.RowSparseNDArray(
+        np.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]], np.float32),
+        np.array([2, 5], np.int64), (8, 3))
+    w = nd.array(w0)
+    new_w, new_s = opt.update(0, w, rsp, state)
+    out = w.asnumpy()
+    # untouched rows identical (lazy semantics)
+    untouched = [i for i in range(8) if i not in (2, 5)]
+    assert_almost_equal(out[untouched], w0[untouched])
+    # touched rows follow the dense sgd formula
+    dense_g = rsp.asnumpy()
+    opt2 = mx.optimizer.create("sgd", learning_rate=0.5, momentum=0.9)
+    st2 = opt2.create_state(0, nd.array(w0))
+    w2 = nd.array(w0)
+    opt2.update(0, w2, nd.array(dense_g), st2)
+    assert_almost_equal(out[[2, 5]], w2.asnumpy()[[2, 5]], rtol=1e-5,
+                        atol=1e-6)
+
+
+def test_sparse_adam_momentum_rows_only():
+    """Adam state rows for untouched ids must stay zero (lazy_update)."""
+    w0 = np.ones((6, 2), np.float32)
+    opt = mx.optimizer.create("adam", learning_rate=0.1)
+    state = opt.create_state(0, nd.array(w0))
+    rsp = sparse.RowSparseNDArray(np.ones((1, 2), np.float32),
+                                  np.array([4], np.int64), (6, 2))
+    w = nd.array(w0)
+    _, new_state = opt.update(0, w, rsp, state)
+    flat = [np.asarray(leaf) for leaf in
+            __import__("jax").tree_util.tree_leaves(new_state)]
+    for leaf in flat:
+        if leaf.shape == (6, 2):
+            untouched = [i for i in range(6) if i != 4]
+            assert (leaf[untouched] == 0).all()
+            assert not (leaf[4] == 0).all()
+
+
+def test_gluon_embedding_sparse_e2e():
+    """Linear+embedding model trains with sparse grads == dense grads."""
+    rs = np.random.RandomState(2)
+    ids = rs.randint(0, 20, (16,)).astype(np.int32)
+    y = rs.randn(16, 1).astype(np.float32)
+
+    def train(sparse_grad):
+        mx.random.seed(3)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Embedding(20, 6, sparse_grad=sparse_grad))
+        net.add(gluon.nn.Dense(1))
+        net.initialize(mx.init.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1})
+        loss_fn = gluon.loss.L2Loss()
+        for _ in range(5):
+            with autograd.record():
+                loss = loss_fn(net(nd.array(ids)), nd.array(y))
+            loss.backward()
+            trainer.step(16)
+        return (net[0].weight.data().asnumpy(),
+                float(loss.mean().asnumpy()))
+
+    w_dense, l_dense = train(False)
+    w_sparse, l_sparse = train(True)
+    assert_almost_equal(w_sparse, w_dense, rtol=1e-4, atol=1e-5)
+    assert abs(l_dense - l_sparse) < 1e-5
+
+
+def test_kvstore_sparse_push_pull():
+    kv = mx.kvstore.create("local")
+    kv.init(3, nd.zeros((6, 2)))
+    a = sparse.RowSparseNDArray(np.ones((2, 2), np.float32),
+                                np.array([0, 2], np.int64), (6, 2))
+    b = sparse.RowSparseNDArray(np.full((2, 2), 2.0, np.float32),
+                                np.array([2, 5], np.int64), (6, 2))
+    kv.push(3, [a, b])
+    out = nd.zeros((6, 2))
+    kv.pull(3, out=out)
+    expect = np.zeros((6, 2), np.float32)
+    expect[0] = 1
+    expect[2] = 3  # 1 + 2 summed across pushes
+    expect[5] = 2
+    assert_almost_equal(out.asnumpy(), expect)
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kvstore.create("device")
+    table = np.arange(12, dtype=np.float32).reshape(6, 2)
+    kv.init("emb", nd.array(table))
+    out = nd.zeros((6, 2))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array(
+        np.array([1, 4], np.float32)))
+    expect = np.zeros_like(table)
+    expect[[1, 4]] = table[[1, 4]]
+    assert_almost_equal(out.asnumpy(), expect)
+
+
+def test_csr_dot_sparse_kernel():
+    rs = np.random.RandomState(4)
+    dense = rs.randn(5, 7).astype(np.float32)
+    dense[dense < 0.3] = 0  # sparsify
+    csr = sparse.csr_matrix(dense)
+    rhs = rs.randn(7, 3).astype(np.float32)
+    out = sparse.dot(csr, nd.array(rhs))
+    assert_almost_equal(out.asnumpy(), dense @ rhs, rtol=1e-4, atol=1e-5)
+    out_t = sparse.dot(csr, nd.array(rs.randn(5, 3).astype(np.float32)),
+                       transpose_a=True)
+    assert out_t.shape == (7, 3)
+
+
+def test_rowsparse_add_and_compact():
+    a = sparse.RowSparseNDArray(np.ones((2, 2), np.float32),
+                                np.array([1, 3], np.int64), (5, 2))
+    b = sparse.RowSparseNDArray(np.full((2, 2), 5.0, np.float32),
+                                np.array([3, 0], np.int64), (5, 2))
+    c = a + b
+    assert isinstance(c, sparse.RowSparseNDArray)
+    dense = c.asnumpy()
+    expect = np.zeros((5, 2), np.float32)
+    expect[1] = 1
+    expect[3] = 6
+    expect[0] = 5
+    assert_almost_equal(dense, expect)
+    assert c.indices.asnumpy().tolist() == [0, 1, 3]

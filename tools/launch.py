@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Local cluster launcher (parity: reference tools/launch.py:28 with the
+dmlc "local" tracker).
+
+Spawns S server processes and N worker processes on this machine with the
+reference's DMLC_* environment contract, runs the given command in each
+worker, and waits.  Exit status is non-zero if any worker fails.
+
+Usage:
+    python tools/launch.py -n 2 [-s 1] [--kv-store dist_sync] python train.py
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import time
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch(num_workers, num_servers, command, kv_store="dist_sync",
+           env_extra=None):
+    root_port = _free_port()
+    base_env = dict(os.environ)
+    base_env.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(root_port),
+        "DMLC_NUM_WORKER": str(num_workers),
+        "DMLC_NUM_SERVER": str(num_servers),
+        "MXNET_KVSTORE_MODE": kv_store,
+    })
+    base_env.update(env_extra or {})
+
+    procs = []
+    for sid in range(num_servers):
+        env = dict(base_env)
+        env.update({"DMLC_ROLE": "server", "DMLC_SERVER_ID": str(sid)})
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c",
+             "from mxnet_tpu.kvstore.kvstore_server import KVStoreServer;"
+             "KVStoreServer().run()"],
+            env=env))
+    time.sleep(0.5)  # let servers bind before workers connect
+
+    workers = []
+    for rank in range(num_workers):
+        env = dict(base_env)
+        env.update({"DMLC_ROLE": "worker", "DMLC_RANK": str(rank),
+                    "DMLC_WORKER_ID": str(rank)})
+        workers.append(subprocess.Popen(command, env=env))
+
+    rc = 0
+    for w in workers:
+        rc |= w.wait()
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            p.kill()
+    return rc
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=1)
+    ap.add_argument("--kv-store", default="dist_sync")
+    ap.add_argument("--launcher", default="local",
+                    help="only 'local' is implemented (ssh/mpi/yarn: use "
+                         "your scheduler to run this per host)")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if args.launcher != "local":
+        ap.error("only --launcher local is implemented")
+    if not args.command:
+        ap.error("no command given")
+    sys.exit(launch(args.num_workers, args.num_servers, args.command,
+                    kv_store=args.kv_store))
+
+
+if __name__ == "__main__":
+    main()
