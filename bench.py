@@ -65,6 +65,64 @@ def _init_backend():
     return jax.devices()[0].platform
 
 
+def _run_bert(platform):
+    """Secondary benchmark (`python bench.py bert`): BERT-base MLM train
+    throughput, whole step as one executable.  No reference number exists
+    in-tree (the reference era predates BERT), so vs_baseline is 0."""
+    import jax
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon.model_zoo import bert
+
+    on_accel = platform not in ("cpu",)
+    batch = 32 if on_accel else 2
+    seqlen = 128 if on_accel else 16
+    n_steps = 10 if on_accel else 2
+    mx.random.seed(0)
+    net = bert.bert_base(vocab_size=30522) if on_accel else \
+        bert.bert_small(vocab_size=1000)
+    net.initialize(mx.init.Xavier())
+    if on_accel:
+        from mxnet_tpu import amp
+
+        amp.init("bfloat16")
+        amp.convert_hybrid_block(net)
+    vocab = 30522 if on_accel else 1000
+
+    class MLM(gluon.HybridBlock):
+        def __init__(self, inner):
+            super().__init__()
+            self.inner = inner
+
+        def hybrid_forward(self, F, toks):
+            _, _, logits = self.inner(toks)
+            return F.reshape(logits, shape=(-1, vocab))
+
+    step = parallel.JitTrainStep(
+        MLM(net), gluon.loss.SoftmaxCrossEntropyLoss(),
+        "adam", {"learning_rate": 1e-4})
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, vocab, (batch, seqlen)).astype(np.int32)
+    labels = rng.randint(0, vocab, batch * seqlen).astype(np.float32)
+    t0 = time.perf_counter()
+    loss = step.step(toks, labels)
+    jax.block_until_ready(loss)
+    _log("bert compile+first step: %.1fs loss=%.3f"
+         % (time.perf_counter() - t0, float(loss)))
+    loss = step.step(toks, labels)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        loss = step.step(toks, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    sps = batch * n_steps / dt
+    _log("bert-base b%d seq%d: %.1f samples/s (%.0f tok/s)"
+         % (batch, seqlen, sps, sps * seqlen))
+    return sps
+
+
 def _run(platform):
     import jax
     import jax.numpy as jnp
@@ -73,7 +131,8 @@ def _run(platform):
     from mxnet_tpu.gluon.model_zoo import vision
 
     on_accel = platform not in ("cpu",)
-    batch = int(sys.argv[1]) if len(sys.argv) > 1 else (128 if on_accel else 8)
+    argv_batch = [a for a in sys.argv[1:] if a.isdigit()]
+    batch = int(argv_batch[0]) if argv_batch else (128 if on_accel else 8)
     image = 224 if on_accel else 64
     n_steps = 10 if on_accel else 2
 
@@ -122,18 +181,27 @@ def _run(platform):
 
 
 def main():
+    bert_mode = "bert" in sys.argv[1:]
     try:
         platform = _init_backend()
-        img_s = _run(platform)
+        value = _run_bert(platform) if bert_mode else _run(platform)
     except Exception:
         traceback.print_exc(file=sys.stderr)
         _log("benchmark failed; emitting value 0")
-        img_s = 0.0
+        value = 0.0
+    if bert_mode:
+        print(json.dumps({
+            "metric": "bert_base_train_throughput",
+            "value": round(value, 2),
+            "unit": "samples/sec",
+            "vs_baseline": 0.0,
+        }))
+        return
     print(json.dumps({
         "metric": "resnet50_train_throughput",
-        "value": round(img_s, 2),
+        "value": round(value, 2),
         "unit": "images/sec",
-        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+        "vs_baseline": round(value / BASELINE_IMG_S, 3),
     }))
 
 

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Per-operator forward/backward latency harness.
+
+Parity: the reference's ``benchmark/opperf`` (README:10-17) — run every
+registered operator with default inputs, report fwd (and bwd where the
+op is differentiable) wall time.  Doubles as an op-coverage smoke test:
+the input table is the same spec table the numerics sweep uses
+(tests/test_op_numerics.py), so every op the sweep covers is benchmarked.
+
+Usage:
+    python benchmark/opperf/run_opperf.py [--runs 20] [--ops dot,relu,...]
+        [--output results.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, _ROOT)
+
+
+def run_one(name, spec, runs, mx, nd, autograd):
+    inputs = [nd.array(x) for x in spec.inputs]
+    fn = getattr(mx.nd, name, None)
+    if fn is None:
+        from mxnet_tpu.ndarray.register import make_op_func
+
+        fn = make_op_func(name)
+    mx.random.seed(0)
+
+    def fwd():
+        out = fn(*inputs, **spec.attrs)
+        return out if isinstance(out, list) else [out]
+
+    outs = fwd()  # compile
+    for o in outs:
+        o.wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        outs = fwd()
+    for o in outs:
+        o.wait_to_read()
+    fwd_ms = (time.perf_counter() - t0) / runs * 1e3
+
+    bwd_ms = None
+    if spec.grad:
+        for x in inputs:
+            x.attach_grad()
+
+        def step():
+            with autograd.record():
+                out = fn(*inputs, **spec.attrs)
+                head = out[0] if isinstance(out, list) else out
+                s = head.sum()
+            s.backward()
+            return head
+
+        step()
+        t0 = time.perf_counter()
+        for _ in range(runs):
+            h = step()
+        h.wait_to_read()
+        bwd_ms = (time.perf_counter() - t0) / runs * 1e3 - fwd_ms
+    return {"fwd_ms": round(fwd_ms, 4),
+            "fwd_bwd_extra_ms": None if bwd_ms is None
+            else round(max(bwd_ms, 0.0), 4)}
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--runs", type=int, default=20)
+    ap.add_argument("--ops", default=None,
+                    help="comma-separated subset (default: all)")
+    ap.add_argument("--output", default=None)
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, nd
+    from tests.test_op_numerics import _all_specs
+
+    only = set(args.ops.split(",")) if args.ops else None
+    results = {}
+    for label, name, spec in _all_specs():
+        if only is not None and name not in only:
+            continue
+        try:
+            results[label] = run_one(name, spec, args.runs, mx, nd,
+                                     autograd)
+        except Exception as e:  # a failing op should not kill the sweep
+            results[label] = {"error": str(e)[:120]}
+    ok = {k: v for k, v in results.items() if "error" not in v}
+    errs = {k: v for k, v in results.items() if "error" in v}
+    for k in sorted(ok, key=lambda k: -ok[k]["fwd_ms"]):
+        v = ok[k]
+        extra = ("  +bwd %.3fms" % v["fwd_bwd_extra_ms"]
+                 if v["fwd_bwd_extra_ms"] is not None else "")
+        print("%-40s fwd %.3fms%s" % (k, v["fwd_ms"], extra))
+    if errs:
+        print("\nerrors (%d):" % len(errs))
+        for k, v in sorted(errs.items()):
+            print("  %-38s %s" % (k, v["error"]))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(results, f, indent=1)
+        print("\nwrote %s" % args.output)
+
+
+if __name__ == "__main__":
+    main()

@@ -175,6 +175,38 @@ def current_backward_gen():
     return _backward_gen[0]
 
 
+def _node_backward(node, cts):
+    """Run one node's backward.
+
+    Nodes recorded by the lazy tape carry only their primal
+    (``node.prim``); the vjp runs as ONE cached jitted executable per
+    stable op callable (``fn._mx_bwd``), so neither recording nor
+    backward re-traces ``jax.vjp`` per invocation — the tape-walk
+    analogue of the reference executing a prebuilt backward graph.
+    Ad-hoc closures (invoke_fn, control flow) linearize eagerly.
+    """
+    import jax
+
+    if node.vjp_fn is not None:
+        return node.vjp_fn(cts)
+    fn, datas, _n_rng = node.prim
+    bwd = getattr(fn, "_mx_bwd", None)
+    if bwd is None:
+        def bwd_fn(primals, cotangents):
+            _, vjp = jax.vjp(fn, *primals)
+            return vjp(cotangents)
+
+        if getattr(fn, "_mx_stable", False):
+            bwd = jax.jit(bwd_fn)
+            try:
+                fn._mx_bwd = bwd
+            except Exception:  # wrapper types that reject attributes
+                pass
+        else:
+            bwd = bwd_fn
+    return bwd(tuple(datas), tuple(cts))
+
+
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     """Run the reverse pass from ``heads`` (parity: MXAutogradBackwardEx).
 
@@ -220,15 +252,19 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     for node in _topo_order(roots):
         if node.cotangents is None:
             continue  # not on a path from any head
-        if node.vjp_fn is None:
+        if node.vjp_fn is None and node.prim is None:
             raise MXNetError(
                 "graph already freed by a previous backward; "
                 "pass retain_graph=True to backward() to reuse it"
             )
         cts = node.materialize_cotangents()
-        in_cts = node.vjp_fn(cts)
+        # consume the seeds NOW: a later backward over a retained graph
+        # must start from fresh cotangents, not accumulate onto these
+        node.cotangents = None
+        in_cts = _node_backward(node, cts)
         if not retain_graph:
             node.vjp_fn = None
+            node.prim = None
         skip = node.skip_grad_inputs
         for inp, ct in zip(node.inputs, in_cts[skip:] if skip else in_cts):
             if ct is None:
@@ -241,11 +277,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             elif inp._marked:
                 inp._accumulate_grad(ct)
 
-    if not retain_graph:
-        for h in heads:
-            node = h._tape_node
-            if node is not None:
-                node.cotangents = None
+    # seeds were consumed node-by-node in the loop; nothing left to clear
 
 
 def _apply_node_vjp_taped(node, cts):

@@ -111,8 +111,19 @@ class Engine:
         """Remember a dispatched buffer so wait_for_all() can sync on it."""
         self._inflight.append(data)
         if len(self._inflight) > self._inflight_cap:
-            # oldest buffers are almost certainly done; drop without blocking
-            del self._inflight[: self._inflight_cap // 2]
+            # ring full: SYNC the oldest half before dropping it, so
+            # waitall() semantics stay exact (Engine::WaitForAll blocks on
+            # every outstanding op; silently forgetting buffers could let
+            # waitall() return with work — and async errors — in flight)
+            old, self._inflight = (
+                self._inflight[: self._inflight_cap // 2],
+                self._inflight[self._inflight_cap // 2:],
+            )
+            for d in old:
+                try:
+                    d.block_until_ready()
+                except AttributeError:
+                    pass
 
     # -- sync -------------------------------------------------------------
     def wait_for_var(self, var):

@@ -456,6 +456,10 @@ class HybridBlock(Block):
                 st.param_map, st.aux_updates, st.active = prev
 
         jitted = jax.jit(fn)
+        try:
+            jitted._mx_stable = True  # cacheable backward (lazy tape)
+        except Exception:
+            pass
         return {"fn": jitted, "params": params, "meta": meta}
 
     def _run_cached(self, rec, inputs):
@@ -469,18 +473,19 @@ class HybridBlock(Block):
         fn = rec["fn"]
         recording = autograd.is_recording()
         node = None
+        flat = eng.push(lambda: fn(*datas), op_name=self.name + "_cached")
         if recording:
-            flat, vjp = eng.push(lambda: jax.vjp(fn, *datas),
-                                 op_name=self.name + "_cached")
+            # lazy tape: forward runs its cached executable; backward
+            # re-linearizes through ONE cached jitted vjp per cache entry
+            # (autograd._node_backward) instead of tracing jax.vjp on
+            # every recorded call
             tape_inputs = [p.data() for p in params] + list(inputs)
             node = autograd.TapeNode(
-                vjp, tape_inputs,
+                None, tape_inputs,
                 [(o.shape, o.dtype) for o in flat],
                 skip_grad_inputs=1,
-                op_name=self.name + "_cached")
-        else:
-            flat = eng.push(lambda: fn(*datas),
-                            op_name=self.name + "_cached")
+                op_name=self.name + "_cached",
+                prim=(fn, datas, 1))
         meta = rec["meta"]
         n_out = meta["n_outputs"]
         ctx = inputs[0].context if inputs else current_context()

@@ -1,0 +1,181 @@
+"""Transformer encoder / BERT model family.
+
+The reference era shipped transformer building blocks as fused CUDA ops
+(``src/operator/contrib/transformer.cc``) and left BERT to gluon-nlp;
+the rebuild provides the full model family natively, TPU-first:
+attention runs through the Pallas flash-attention op
+(``_contrib_flash_attention`` — blockwise online softmax on the MXU),
+QKV is ONE fused projection (the interleaved_matmul layout), and
+everything is a HybridBlock so the whole encoder lowers to a single XLA
+executable under ``hybridize()``/``JitTrainStep``.
+
+Long sequences: combine with ``parallel.ring_attention_sharded`` to
+shard T across chips (SURVEY §5.7 long-context design).
+"""
+from __future__ import annotations
+
+import math
+
+from .. import nn
+from ..block import HybridBlock
+
+
+class MultiHeadAttention(HybridBlock):
+    """Self-attention with fused QKV and flash-attention scores."""
+
+    def __init__(self, units, num_heads, dropout=0.0, causal=False,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if units % num_heads:
+            raise ValueError("units must divide num_heads")
+        self._units = units
+        self._heads = num_heads
+        self._causal = causal
+        with self.name_scope():
+            self.qkv = nn.Dense(3 * units, flatten=False, use_bias=True,
+                                prefix="qkv_")
+            self.proj = nn.Dense(units, flatten=False, use_bias=True,
+                                 prefix="proj_")
+            self.drop = nn.Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x):
+        b, t, _ = x.shape
+        h, d = self._heads, self._units // self._heads
+        qkv = self.qkv(x)                                   # (B,T,3C)
+        qkv = F.reshape(qkv, shape=(b, t, 3, h, d))
+        qkv = F.transpose(qkv, axes=(2, 0, 3, 1, 4))        # (3,B,H,T,D)
+        q = F.squeeze(F.slice_axis(qkv, axis=0, begin=0, end=1), axis=0)
+        k = F.squeeze(F.slice_axis(qkv, axis=0, begin=1, end=2), axis=0)
+        v = F.squeeze(F.slice_axis(qkv, axis=0, begin=2, end=3), axis=0)
+        out = F.contrib.flash_attention(
+            q, k, v, scale=1.0 / math.sqrt(d), causal=self._causal)
+        out = F.reshape(F.transpose(out, axes=(0, 2, 1, 3)),
+                        shape=(b, t, self._units))
+        out = self.proj(out)
+        if self.drop is not None:
+            out = self.drop(out)
+        return out
+
+
+class PositionwiseFFN(HybridBlock):
+    """Two-layer MLP with GELU (BERT's FFN)."""
+
+    def __init__(self, units, hidden_size, dropout=0.0, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.fc1 = nn.Dense(hidden_size, flatten=False, prefix="fc1_")
+            self.fc2 = nn.Dense(units, flatten=False, prefix="fc2_")
+            self.drop = nn.Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x):
+        y = self.fc1(x)
+        y = 0.5 * y * (1.0 + F.erf(y / math.sqrt(2.0)))  # exact GELU
+        y = self.fc2(y)
+        if self.drop is not None:
+            y = self.drop(y)
+        return y
+
+
+class TransformerEncoderCell(HybridBlock):
+    """Post-LN encoder layer (BERT convention)."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 causal=False, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.attn = MultiHeadAttention(units, num_heads, dropout,
+                                           causal, prefix="attn_")
+            self.ln1 = nn.LayerNorm(prefix="ln1_")
+            self.ffn = PositionwiseFFN(units, hidden_size, dropout,
+                                       prefix="ffn_")
+            self.ln2 = nn.LayerNorm(prefix="ln2_")
+
+    def hybrid_forward(self, F, x):
+        x = self.ln1(x + self.attn(x))
+        x = self.ln2(x + self.ffn(x))
+        return x
+
+
+class BERTEncoder(HybridBlock):
+    def __init__(self, num_layers, units, hidden_size, num_heads,
+                 dropout=0.0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.layers = nn.HybridSequential(prefix="layers_")
+            for i in range(num_layers):
+                self.layers.add(TransformerEncoderCell(
+                    units, hidden_size, num_heads, dropout,
+                    prefix="cell%d_" % i))
+
+    def hybrid_forward(self, F, x):
+        return self.layers(x)
+
+
+class BERTModel(HybridBlock):
+    """BERT-style masked-LM encoder.
+
+    forward(tokens, token_types) → (sequence_output (B,T,C),
+    pooled_output (B,C) from the CLS position, mlm_logits (B,T,V));
+    the MLM decoder ties the word embedding.
+    """
+
+    def __init__(self, vocab_size, units=768, hidden_size=3072,
+                 num_layers=12, num_heads=12, max_length=512,
+                 type_vocab=2, dropout=0.0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        with self.name_scope():
+            self.word_embed = nn.Embedding(vocab_size, units,
+                                           prefix="word_")
+            self.type_embed = nn.Embedding(type_vocab, units,
+                                           prefix="type_")
+            self.pos_embed = self.params.get(
+                "pos_embed", shape=(max_length, units),
+                init=None, allow_deferred_init=False)
+            self.ln = nn.LayerNorm(prefix="embln_")
+            self.drop = nn.Dropout(dropout) if dropout else None
+            self.encoder = BERTEncoder(num_layers, units, hidden_size,
+                                       num_heads, dropout,
+                                       prefix="enc_")
+            self.pooler = nn.Dense(units, flatten=False,
+                                   activation="tanh", prefix="pooler_")
+            self.mlm_bias = self.params.get(
+                "mlm_bias", shape=(vocab_size,), init="zeros",
+                allow_deferred_init=False)
+
+    def hybrid_forward(self, F, tokens, token_types=None, pos_embed=None,
+                       mlm_bias=None):
+        b, t = tokens.shape
+        emb = self.word_embed(tokens)
+        if token_types is not None:
+            emb = emb + self.type_embed(token_types)
+        pos = F.slice_axis(pos_embed, axis=0, begin=0, end=t)
+        emb = emb + F.expand_dims(pos, axis=0)
+        emb = self.ln(emb)
+        if self.drop is not None:
+            emb = self.drop(emb)
+        seq = self.encoder(emb)
+        pooled = self.pooler(F.squeeze(
+            F.slice_axis(seq, axis=1, begin=0, end=1), axis=1))
+        # tied MLM head: logits = seq · E^T + b
+        w = self.word_embed.weight.data()
+        logits = F.dot(F.reshape(seq, shape=(b * t, self._units)), w,
+                       transpose_b=True)
+        logits = F.reshape(logits, shape=(b, t, -1)) + mlm_bias
+        return seq, pooled, logits
+
+
+def bert_base(vocab_size=30522, **kwargs):
+    """BERT-base (110M params): 12 layers, 768 units, 12 heads."""
+    cfg = dict(units=768, hidden_size=3072, num_layers=12, num_heads=12)
+    cfg.update(kwargs)
+    return BERTModel(vocab_size, **cfg)
+
+
+def bert_small(vocab_size=1000, **kwargs):
+    """Tiny config for tests / dry-runs."""
+    cfg = dict(units=64, hidden_size=128, num_layers=2, num_heads=4,
+               max_length=128)
+    cfg.update(kwargs)
+    return BERTModel(vocab_size, **cfg)

@@ -154,8 +154,15 @@ class Trainer:
             for i, param in enumerate(self._params):
                 if param.grad_req != "null":
                     kv.init(i, param.data())
-            kv.set_optimizer(self._optimizer)
             self._dist_initialized = True
+            self._dist_sent_state = None
+        # the server holds a pickled COPY of the optimizer: re-send it
+        # whenever worker-side mutable knobs change (rescale_grad moves
+        # with batch_size; lr with schedulers)
+        state = (self._optimizer.rescale_grad, self._optimizer.learning_rate)
+        if state != self._dist_sent_state:
+            kv.set_optimizer(self._optimizer)
+            self._dist_sent_state = state
         for i, param in enumerate(self._params):
             if param.grad_req == "null" or param._data is None:
                 continue

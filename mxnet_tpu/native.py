@@ -1,0 +1,137 @@
+"""Native (C++) runtime components: build + ctypes bindings.
+
+The reference's data plane is C++ (dmlc-core RecordIO, the threaded
+image-recordio parser); this module provides the rebuild's native tier.
+``mxnet_tpu/src/*.cc`` are compiled once per machine with the system
+toolchain into a cached shared library (plain ``extern "C"`` ABI loaded
+via ctypes — the image has no pybind11), and every caller degrades to
+the pure-Python implementation if the toolchain is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _src_path():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "src", "recordio_native.cc")
+
+
+def _cache_dir():
+    d = os.environ.get("MXNET_NATIVE_CACHE",
+                       os.path.join(os.path.expanduser("~"), ".cache",
+                                    "mxnet_tpu"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _build():
+    src = _src_path()
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    out = os.path.join(_cache_dir(), "recordio_native-%s.so" % digest)
+    if not os.path.exists(out):
+        tmp = out + ".tmp.%d" % os.getpid()
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src,
+             "-o", tmp],
+            check=True, capture_output=True)
+        os.replace(tmp, out)
+    return out
+
+
+def get_lib():
+    """The loaded native library, or None when unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            lib = ctypes.CDLL(_build())
+        except Exception:
+            return None
+        L = ctypes.c_long
+        P8 = ctypes.POINTER(ctypes.c_uint8)
+        PL = ctypes.POINTER(ctypes.c_long)
+        lib.rio_index.restype = L
+        lib.rio_index.argtypes = [P8, L, PL, PL, PL, L]
+        lib.rio_gather.restype = L
+        lib.rio_gather.argtypes = [P8, PL, PL, L, P8, PL]
+        lib.rio_pack.restype = L
+        lib.rio_pack.argtypes = [P8, PL, PL, L, P8]
+        lib.rio_abi_version.restype = ctypes.c_int
+        if lib.rio_abi_version() != 1:
+            return None
+        _lib = lib
+        return _lib
+
+
+def available():
+    return get_lib() is not None
+
+
+def index_buffer(buf):
+    """Index a RecordIO byte buffer natively.
+
+    Returns (offsets, lengths, flags) int64 arrays — one entry per
+    physical record part — or None if the native lib is unavailable.
+    Raises ValueError on a corrupt stream.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(buf)
+    cap = max(16, n // 12)  # every record needs >= 8B header + padding
+    arr = np.frombuffer(buf, dtype=np.uint8)
+    src = arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    while True:
+        offsets = np.empty(cap, np.int64)
+        lengths = np.empty(cap, np.int64)
+        flags = np.empty(cap, np.int64)
+        count = lib.rio_index(
+            src, n,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+            lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+            flags.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+            cap)
+        if count == -1:
+            raise ValueError("corrupt RecordIO stream")
+        if count < 0:  # capacity: retry bigger
+            cap *= 2
+            continue
+        return offsets[:count].copy(), lengths[:count].copy(), \
+            flags[:count].copy()
+
+
+def gather(buf, offsets, lengths):
+    """Concatenate the given records into one contiguous bytes object;
+    returns (payload bytes, per-record start offsets)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    arr = np.frombuffer(buf, dtype=np.uint8)
+    offs = np.ascontiguousarray(offsets, np.int64)
+    lens = np.ascontiguousarray(lengths, np.int64)
+    total = int(lens.sum())
+    out = np.empty(total, np.uint8)
+    out_offs = np.empty(len(offs), np.int64)
+    w = lib.rio_gather(
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        len(offs),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out_offs.ctypes.data_as(ctypes.POINTER(ctypes.c_long)))
+    assert w == total
+    return out.tobytes(), out_offs

@@ -30,6 +30,9 @@ from ..ops.control_flow import (  # noqa: E402
     cond as _contrib_cond,
 )
 
+# user-defined ops (mx.operator registry; parity: mx.nd.Custom)
+from ..operator import custom as Custom  # noqa: E402,F401
+
 # contrib sub-namespace: ops named _contrib_* surface as nd.contrib.<name>
 class _ContribNS:
     def __getattr__(self, item):

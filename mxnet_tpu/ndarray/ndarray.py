@@ -664,30 +664,40 @@ def moveaxis(tensor, source, destination):
 def save(fname, data):
     """Save NDArray / list / dict of NDArrays (parity: MXNDArraySave).
 
-    Format: numpy .npz with a manifest key encoding the container kind —
-    portable, versioned by numpy, loadable without this framework.
+    Format: the reference's binary ``.params`` container (versioned
+    magic numbers, ``src/ndarray/ndarray.cc:1586-1860``) — files are
+    interchangeable with reference MXNet in both directions.
     """
-    import io
-    import os
+    from . import legacy_io
 
     if isinstance(data, NDArray):
-        payload = {"__kind__": _np.asarray("single"), "arr_0": data.asnumpy()}
+        arrays, names = [data.asnumpy()], []
     elif isinstance(data, (list, tuple)):
-        payload = {"__kind__": _np.asarray("list")}
-        for i, a in enumerate(data):
-            payload["arr_%d" % i] = a.asnumpy()
+        arrays, names = [a.asnumpy() for a in data], []
     elif isinstance(data, dict):
-        payload = {"__kind__": _np.asarray("dict")}
-        for k, a in data.items():
-            payload["key:" + k] = a.asnumpy()
+        names = list(data.keys())
+        arrays = [data[k].asnumpy() for k in names]
     else:
         raise TypeError("unsupported save payload")
-    with open(fname, "wb") as f:
-        _np.savez(f, **payload)
+    legacy_io.save_params(fname, arrays, names)
 
 
 def load(fname, ctx=None):
-    """Load what :func:`save` wrote (parity: MXNDArrayLoad)."""
+    """Load what :func:`save` (or reference MXNet) wrote.
+
+    Accepts the reference binary container in all its versions (pre-V1
+    through V3) and, for back-compat with earlier snapshots of this
+    framework, the .npz container it used to write.
+    """
+    from . import legacy_io
+
+    if legacy_io.is_legacy_file(fname):
+        arrays, names = legacy_io.load_params(fname)
+        nds = [NDArray(a, ctx=ctx) if a is not None else None
+               for a in arrays]
+        if names:
+            return dict(zip(names, nds))
+        return nds
     with _np.load(fname, allow_pickle=False) as z:
         kind = str(z["__kind__"])
         if kind == "single":

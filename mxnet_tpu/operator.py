@@ -1,0 +1,147 @@
+"""``mx.operator`` — user-defined operators in Python.
+
+Capability parity with the reference CustomOp stack
+(``python/mxnet/operator.py``: ``CustomOp``, ``CustomOpProp``,
+``register``; C++ side ``src/operator/custom/custom-inl.h:52`` runs the
+Python callbacks on a dedicated worker thread).
+
+TPU-native mechanism: no callback thread — the imperative path simply
+runs ``forward``/``backward`` eagerly on NDArrays and records one tape
+node whose vjp re-enters ``backward``; under a ``hybridize()`` trace the
+same Python code executes over tracer-backed NDArrays, so *traceable*
+custom ops fuse into the XLA executable (the reference could never fuse
+a CustomOp — a genuine upgrade), while non-traceable ones (asnumpy etc.)
+keep working imperatively exactly like the reference.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from . import autograd
+
+_CUSTOM_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for user ops (parity: operator.py CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write ``src`` into ``dst`` honoring the grad request."""
+        if req in ("null", 0):
+            return
+        if req in ("add", 3):
+            dst._set_data(dst.data() + src.data())
+        else:  # write / inplace
+            dst._set_data(src.data().astype(dst.dtype))
+
+
+class CustomOpProp:
+    """Op metadata + factory (parity: operator.py CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), \
+            [in_type[0]] * len(self.list_auxiliary_states())
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp under ``op_type`` (parity:
+    operator.py:legacy register)."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register expects a CustomOpProp subclass")
+        _CUSTOM_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get_prop_cls(op_type):
+    cls = _CUSTOM_REGISTRY.get(op_type)
+    if cls is None:
+        raise MXNetError("custom op %r is not registered" % (op_type,))
+    return cls
+
+
+def custom(*inputs, op_type=None, **kwargs):
+    """Run a registered custom op (parity: mx.nd.Custom)."""
+    from .ndarray.ndarray import NDArray
+    from . import ndarray as nd
+    from .context import current_context
+
+    if op_type is None:
+        raise MXNetError("Custom requires op_type=")
+    prop = get_prop_cls(op_type)(**{k: str(v) for k, v in kwargs.items()})
+    in_shapes = [tuple(x.shape) for x in inputs]
+    ishapes, oshapes, aux_shapes = prop.infer_shape(list(in_shapes))
+    in_types = [x.dtype for x in inputs]
+    _, otypes, _ = prop.infer_type(list(in_types))
+    ctx = inputs[0].context if inputs else current_context()
+    op = prop.create_operator(ctx, ishapes, in_types)
+
+    out_data = [nd.empty(tuple(s), dtype=t, ctx=ctx)
+                for s, t in zip(oshapes, otypes)]
+    in_list = list(inputs)
+    is_train = autograd.is_training() or autograd.is_recording()
+    with autograd.pause():
+        op.forward(is_train=is_train, req=["write"] * len(out_data),
+                   in_data=in_list, out_data=out_data, aux=[])
+
+    recording = autograd.is_recording() and any(
+        x._in_graph for x in in_list)
+    if recording:
+        def vjp_fn(cts):
+            in_grad = [nd.zeros(x.shape, dtype=x.dtype, ctx=ctx)
+                       for x in in_list]
+            with autograd.pause():
+                op.backward(req=["write"] * len(in_grad),
+                            out_grad=[NDArray(c) for c in cts],
+                            in_data=in_list, out_data=out_data,
+                            in_grad=in_grad, aux=[])
+            return tuple(g.data() for g in in_grad)
+
+        node = autograd.TapeNode(
+            vjp_fn, in_list,
+            [(o.shape, o.dtype) for o in out_data],
+            op_name="Custom:" + op_type)
+        for i, o in enumerate(out_data):
+            o._tape_node = node
+            o._tape_index = i
+    return out_data[0] if len(out_data) == 1 else out_data
+
+
+# surfaced as mx.nd.Custom / mx.sym-compatible callable
+Custom = custom

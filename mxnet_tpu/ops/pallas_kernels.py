@@ -28,11 +28,25 @@ from jax import lax
 from .registry import register
 
 
-def _use_interpret():
-    try:
-        return jax.default_backend() not in ("tpu",)
-    except Exception:
-        return True
+def _flash_dispatch(q, k, v, scale, causal, block_q, block_k):
+    """Pick compiled vs interpreted pallas at LOWERING time.
+
+    ``jax.lax.platform_dependent`` resolves per lowering platform, so the
+    same traced computation runs the real kernel on TPU and the
+    interpreter on the host — regardless of where the surrounding jit or
+    eager dispatch ends up placed (a cpu-committed input must never see
+    the compiled TPU kernel).
+    """
+    import functools as _ft
+
+    run = _ft.partial(_flash_pallas, scale=scale, causal=causal,
+                      block_q=block_q, block_k=block_k)
+    # compiled kernel ONLY on tpu; every other platform (cpu, and
+    # untested cuda/rocm) goes through the interpreter
+    return jax.lax.platform_dependent(
+        q, k, v,
+        tpu=_ft.partial(run, interpret=False),
+        default=_ft.partial(run, interpret=True))
 
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q,
@@ -76,7 +90,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q,
     o_ref[0] = (acc / safe_l).astype(o_ref.dtype)
 
 
-def _flash_pallas(q, k, v, scale, causal, block_q, block_k):
+def _flash_pallas(q, k, v, scale, causal, block_q, block_k,
+                  interpret=False):
     from jax.experimental import pallas as pl
 
     bh, t_q, d = q.shape
@@ -94,7 +109,7 @@ def _flash_pallas(q, k, v, scale, causal, block_q, block_k):
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
-        interpret=_use_interpret(),
+        interpret=interpret,
     )(q, k, v)
     return out
 
@@ -115,11 +130,11 @@ def _attention_ref(q, k, v, scale, causal):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_attention(q, k, v, scale, causal, block_q, block_k):
-    return _flash_pallas(q, k, v, scale, causal, block_q, block_k)
+    return _flash_dispatch(q, k, v, scale, causal, block_q, block_k)
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
-    out = _flash_pallas(q, k, v, scale, causal, block_q, block_k)
+    out = _flash_dispatch(q, k, v, scale, causal, block_q, block_k)
     return out, (q, k, v)
 
 

@@ -151,7 +151,14 @@ def _jitted(name, fields, attrs_key):
             return out if isinstance(out, tuple) else (out,)
 
     run.__name__ = name.lstrip("_") or name
-    return jax.jit(run)
+    jitted = jax.jit(run)
+    try:
+        # marks this callable as cacheable for the lazy tape's jitted
+        # backward (autograd._node_backward)
+        jitted._mx_stable = True
+    except Exception:
+        pass
+    return jitted
 
 
 def _prep(reg, datas, attrs, fields):
@@ -207,17 +214,17 @@ def invoke_fn(fn, inputs, op_name="custom", n_outputs=None):
     recording = autograd.is_recording() and any(x._in_graph for x in inputs)
     eng = Engine.get()
     node = None
+    outs = eng.push(lambda: fn(*datas), op_name=op_name)
     if recording:
-        outs, vjp = eng.push(lambda: jax.vjp(fn, *datas), op_name=op_name)
+        # lazy tape: only the primal (fn, inputs) is recorded; backward
+        # runs through a cached jitted vjp (autograd._prim_backward)
         node = autograd.TapeNode(
-            vjp,
+            None,
             list(inputs),
             [(o.shape, o.dtype) for o in outs],
             op_name=op_name,
             prim=(fn, datas, 0),
         )
-    else:
-        outs = eng.push(lambda: fn(*datas), op_name=op_name)
     for o in outs:
         eng.track(o)
     ctx = inputs[0].context if inputs else None
@@ -259,20 +266,23 @@ def invoke(name, inputs, attrs=None, out=None, fields=None):
     recording = autograd.is_recording() and any(x._in_graph for x in inputs)
     eng = Engine.get()
     node = None
+    fn, datas2, n_rng = _prep(reg, datas, attrs, fields)
+    outs = eng.push(lambda: fn(*datas2), op_name=name)
     if recording:
-        fn, datas2, n_rng = _prep(reg, datas, attrs, fields)
-        outs, vjp = eng.push(lambda: jax.vjp(fn, *datas2), op_name=name)
+        # lazy tape (reference records AGInfo nodes, not gradients):
+        # the forward runs through its cached jitted executable as usual
+        # and the node stores only (fn, primals).  The backward pass
+        # re-linearizes through ONE cached jitted vjp executable per
+        # (op, shapes) — recording adds no tracing cost per call, and
+        # backward stops re-tracing jax.vjp on every invocation.
         node = autograd.TapeNode(
-            vjp,
+            None,
             list(inputs),
             [(o.shape, o.dtype) for o in outs],
             skip_grad_inputs=n_rng,
             op_name=name,
             prim=(fn, datas2, n_rng),
         )
-    else:
-        fn, datas2, _ = _prep(reg, datas, attrs, fields)
-        outs = eng.push(lambda: fn(*datas2), op_name=name)
     for o in outs:
         eng.track(o)
 

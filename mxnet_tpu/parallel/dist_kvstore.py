@@ -260,7 +260,9 @@ class DistServer:
     def run(self):
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        srv.bind(("127.0.0.1", self._port))
+        # all interfaces: workers on OTHER hosts reach this server via
+        # DMLC_PS_ROOT_URI (loopback-only would break true multi-host)
+        srv.bind(("", self._port))
         srv.listen(64)
         srv.settimeout(1.0)
         threads = []

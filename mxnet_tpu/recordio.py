@@ -187,12 +187,44 @@ class MXIndexedRecordIO(MXRecordIO):
 
 
 class RecordIOIterable:
-    """Iterate all records of a RecordIO file (used by ImageRecordIter)."""
+    """Iterate all records of a RecordIO file (used by ImageRecordIter).
+
+    Whole-file scans go through the native index + batch gather when the
+    C++ helper is built (one mmap-style pass instead of per-record Python
+    framing); otherwise the streaming Python reader.
+    """
 
     def __init__(self, uri):
         self.uri = uri
 
     def __iter__(self):
+        import mmap
+
+        from . import native
+
+        if native.available():
+            f = mm = None
+            try:
+                f = open(self.uri, 'rb')
+                mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+                idx = native.index_buffer(mm)
+            except (OSError, ValueError):
+                idx = None
+                if mm is not None:
+                    mm.close()
+                if f is not None:
+                    f.close()
+            if idx is not None:
+                try:
+                    offsets, lengths, flags = idx
+                    if (flags == 0).all():
+                        for o, n in zip(offsets.tolist(),
+                                        lengths.tolist()):
+                            yield bytes(mm[o:o + n])
+                        return
+                finally:
+                    mm.close()
+                    f.close()
         rec = MXRecordIO(self.uri, 'r')
         try:
             while True:

@@ -36,7 +36,7 @@ class PallasKernel:
         if self._interpret is not None:
             return self._interpret
         try:
-            return jax.default_backend() not in ("tpu",)
+            return jax.default_backend() not in ("tpu", "axon")
         except Exception:
             return True
 

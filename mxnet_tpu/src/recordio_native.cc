@@ -1,0 +1,93 @@
+// Native RecordIO chunk parser + batch gather.
+//
+// The reference implements its data plane in C++ (dmlc-core RecordIO +
+// src/io/iter_image_recordio_2.cc multithreaded parser); this is the
+// TPU rebuild's native tier for the same role: scanning a RecordIO
+// buffer into an (offset, length) index and gathering record batches
+// into contiguous memory happen here at memcpy speed, while Python keeps
+// orchestration.  Built as a plain shared library (extern "C" + ctypes —
+// no pybind11 in the image) by mxnet_tpu/native.py at first use.
+//
+// Wire format (dmlc-core recordio; mirrored by mxnet_tpu/recordio.py):
+//   [magic:u32 = 0xced7230a][lrec:u32][data][pad to 4B]
+//   lrec upper 3 bits: continuation flag (0 whole, 1 begin, 2 middle,
+//   3 end); lower 29 bits: data length.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLRecBits = 29;
+constexpr uint32_t kLenMask = (1u << kLRecBits) - 1u;
+
+inline uint32_t read_u32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, sizeof(v));
+  return v;
+}
+}  // namespace
+
+extern "C" {
+
+// Scan `buf[0:n)` and write one entry per *physical* record part:
+// data offset, data length, continuation flag.  Returns the number of
+// parts found, or -cap-1 if `cap` was too small (call again bigger),
+// or -1 on a corrupt stream (bad magic mid-file).
+long rio_index(const uint8_t* buf, long n, long* offsets, long* lengths,
+               long* flags, long cap) {
+  long pos = 0;
+  long count = 0;
+  while (pos + 8 <= n) {
+    if (read_u32(buf + pos) != kMagic) return -1;
+    const uint32_t lrec = read_u32(buf + pos + 4);
+    const long len = static_cast<long>(lrec & kLenMask);
+    const long flag = static_cast<long>(lrec >> kLRecBits);
+    if (pos + 8 + len > n) break;  // truncated tail: stop cleanly
+    if (count >= cap) return -cap - 1;
+    offsets[count] = pos + 8;
+    lengths[count] = len;
+    flags[count] = flag;
+    ++count;
+    long adv = len;
+    if (adv % 4 != 0) adv += 4 - (adv % 4);
+    pos += 8 + adv;
+  }
+  return count;
+}
+
+// Gather `count` records (parallel offset/length arrays) from `buf`
+// into `out` back to back; writes each record's start position within
+// `out` to `out_offsets`.  Returns total bytes written.
+long rio_gather(const uint8_t* buf, const long* offsets,
+                const long* lengths, long count, uint8_t* out,
+                long* out_offsets) {
+  long w = 0;
+  for (long i = 0; i < count; ++i) {
+    std::memcpy(out + w, buf + offsets[i], lengths[i]);
+    out_offsets[i] = w;
+    w += lengths[i];
+  }
+  return w;
+}
+
+// Pack `count` records into RecordIO framing inside `out` (caller sizes
+// out >= sum(lengths) + 12*count).  Returns bytes written.
+long rio_pack(const uint8_t* data, const long* offsets,
+              const long* lengths, long count, uint8_t* out) {
+  long w = 0;
+  for (long i = 0; i < count; ++i) {
+    const uint32_t magic = kMagic;
+    const uint32_t lrec = static_cast<uint32_t>(lengths[i]) & kLenMask;
+    std::memcpy(out + w, &magic, 4);
+    std::memcpy(out + w + 4, &lrec, 4);
+    std::memcpy(out + w + 8, data + offsets[i], lengths[i]);
+    w += 8 + lengths[i];
+    while (w % 4 != 0) out[w++] = 0;
+  }
+  return w;
+}
+
+int rio_abi_version() { return 1; }
+
+}  // extern "C"

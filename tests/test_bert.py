@@ -1,0 +1,108 @@
+"""Transformer/BERT model family: forward shapes, MLM training,
+hybridized one-executable step, causal attention.
+
+No direct reference counterpart (the reference era shipped only fused
+attention matmul ops, transformer.cc:650-780); this is the rebuild's
+BASELINE.json north-star model family (BERT-base training).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd, parallel
+from mxnet_tpu.gluon.model_zoo import bert
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _toy_batch(b=2, t=16, vocab=100, seed=0):
+    rs = np.random.RandomState(seed)
+    toks = nd.array(rs.randint(0, vocab, (b, t)).astype(np.int32))
+    types = nd.array(np.zeros((b, t), np.int32))
+    labels = nd.array(rs.randint(0, vocab, (b, t)).astype(np.float32))
+    return toks, types, labels
+
+
+def test_bert_forward_shapes():
+    net = bert.bert_small(vocab_size=100)
+    net.initialize(mx.init.Xavier())
+    toks, types, _ = _toy_batch()
+    seq, pooled, logits = net(toks, types)
+    assert seq.shape == (2, 16, 64)
+    assert pooled.shape == (2, 64)
+    assert logits.shape == (2, 16, 100)
+    assert np.isfinite(logits.asnumpy()).all()
+
+
+def test_bert_mlm_training_converges():
+    mx.random.seed(0)
+    net = bert.bert_small(vocab_size=50)
+    net.initialize(mx.init.Xavier())
+    toks, types, labels = _toy_batch(vocab=50, seed=1)
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 3e-3})
+    lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for _ in range(12):
+        with autograd.record():
+            _, _, lg = net(toks, types)
+            loss = lossfn(nd.reshape(lg, shape=(32, 50)),
+                          nd.reshape(labels, shape=(32,)))
+        loss.backward()
+        tr.step(2, ignore_stale_grad=True)
+        losses.append(float(loss.mean().asnumpy()))
+    assert losses[-1] < 0.5 * losses[0], losses
+
+
+def test_bert_jit_train_step():
+    """Whole BERT train step as ONE XLA executable (JitTrainStep)."""
+    mx.random.seed(1)
+    net = bert.bert_small(vocab_size=40)
+    net.initialize(mx.init.Xavier())
+
+    class MLMWrapper(gluon.HybridBlock):
+        def __init__(self, inner):
+            super().__init__()
+            self.inner = inner
+
+        def hybrid_forward(self, F, toks):
+            _, _, logits = self.inner(toks)
+            return F.reshape(logits, shape=(-1, 40))
+
+    wrapper = MLMWrapper(net)
+    step = parallel.JitTrainStep(
+        wrapper, gluon.loss.SoftmaxCrossEntropyLoss(),
+        "adam", {"learning_rate": 3e-3})
+    rs = np.random.RandomState(2)
+    toks = rs.randint(0, 40, (2, 8)).astype(np.int32)
+    labels = rs.randint(0, 40, 16).astype(np.float32)
+    l0 = float(step.step(toks, labels))
+    for _ in range(8):
+        loss = step.step(toks, labels)
+    assert float(loss) < l0
+
+
+def test_causal_attention_is_causal():
+    """With causal=True, output at position i ignores positions > i."""
+    mx.random.seed(2)
+    cell = bert.MultiHeadAttention(32, 4, causal=True)
+    cell.initialize(mx.init.Xavier())
+    rs = np.random.RandomState(3)
+    x = rs.randn(1, 8, 32).astype(np.float32)
+    base = cell(nd.array(x)).asnumpy()
+    x2 = x.copy()
+    x2[0, -1] += 10.0  # perturb the LAST position only
+    out2 = cell(nd.array(x2)).asnumpy()
+    # earlier positions must be identical
+    assert_almost_equal(out2[0, :-1], base[0, :-1], rtol=1e-5, atol=1e-6)
+    assert not np.allclose(out2[0, -1], base[0, -1])
+
+
+def test_bert_base_config():
+    net = bert.bert_base(vocab_size=1000, num_layers=1)
+    net.initialize(mx.init.Xavier())
+    toks, types, _ = _toy_batch(b=1, t=8, vocab=1000)
+    seq, pooled, logits = net(toks, types)
+    assert seq.shape == (1, 8, 768)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in net.collect_params().values())
+    assert n_params > 7_000_000  # 1-layer base still has the embeddings

@@ -1,0 +1,201 @@
+"""Legacy .params format compat, mx.operator CustomOp, engine waitall.
+
+Ref: src/ndarray/ndarray.cc:1586-1860 (versioned binary container),
+python/mxnet/operator.py (CustomOp/CustomOpProp/register),
+src/engine Engine::WaitForAll.
+"""
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.ndarray import legacy_io
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_params_roundtrip_dict(tmp_path):
+    f = str(tmp_path / "m.params")
+    d = {"arg:w": nd.array(np.random.randn(3, 4).astype(np.float32)),
+         "aux:m": nd.array(np.ones((2,), np.float32))}
+    nd.save(f, d)
+    back = nd.load(f)
+    assert set(back) == set(d)
+    for k in d:
+        assert_almost_equal(back[k].asnumpy(), d[k].asnumpy())
+    # file leads with the reference list magic
+    with open(f, "rb") as fh:
+        assert struct.unpack("<Q", fh.read(8))[0] == 0x112
+
+
+def test_params_roundtrip_list_and_dtypes(tmp_path):
+    f = str(tmp_path / "l.params")
+    data = [nd.array(np.random.randn(2, 2).astype(np.float32)),
+            nd.array(np.arange(4, dtype=np.int32)),
+            nd.array(np.random.rand(3).astype(np.float16))]
+    nd.save(f, data)
+    back = nd.load(f)
+    assert isinstance(back, list) and len(back) == 3
+    assert back[1].dtype == np.int32
+    assert back[2].dtype == np.float16
+    for a, b in zip(data, back):
+        assert_almost_equal(a.asnumpy(), b.asnumpy())
+
+
+def _ref_bytes_v1(arr):
+    """Hand-build a V1-era entry (int64 shape, no storage type)."""
+    out = [struct.pack("<I", 0xF993FAC8),
+           struct.pack("<i", arr.ndim),
+           struct.pack("<%dq" % arr.ndim, *arr.shape),
+           struct.pack("<ii", 1, 0),
+           struct.pack("<i", 0),
+           arr.astype(np.float32).tobytes()]
+    return b"".join(out)
+
+
+def _ref_bytes_prev1(arr):
+    """Pre-V1 layout: leading uint32 IS the ndim, uint32 dims."""
+    out = [struct.pack("<I", arr.ndim),
+           struct.pack("<%dI" % arr.ndim, *arr.shape),
+           struct.pack("<ii", 1, 0),
+           struct.pack("<i", 0),
+           arr.astype(np.float32).tobytes()]
+    return b"".join(out)
+
+
+@pytest.mark.parametrize("builder", [_ref_bytes_v1, _ref_bytes_prev1],
+                         ids=["v1", "pre-v1"])
+def test_load_reference_written_versions(tmp_path, builder):
+    """Files written by OLD reference versions load transparently."""
+    arr = np.random.randn(2, 3).astype(np.float32)
+    payload = [struct.pack("<QQ", 0x112, 0), struct.pack("<Q", 1),
+               builder(arr), struct.pack("<Q", 1),
+               struct.pack("<Q", 5), b"my__w"]
+    f = str(tmp_path / "old.params")
+    with open(f, "wb") as fh:
+        fh.write(b"".join(payload))
+    back = nd.load(f)
+    assert list(back) == ["my__w"]
+    assert_almost_equal(back["my__w"].asnumpy(), arr)
+
+
+def test_gluon_checkpoint_is_reference_format(tmp_path):
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.Dense(3)
+    net.initialize(mx.init.Xavier())
+    net(nd.array(np.ones((1, 4), np.float32)))
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+    assert legacy_io.is_legacy_file(f)
+    net2 = gluon.nn.Dense(3)
+    net2.load_parameters(f)
+    assert_almost_equal(net2.weight.data().asnumpy(),
+                        net.weight.data().asnumpy())
+
+
+# ---------------------------------------------------------------------------
+# CustomOp
+# ---------------------------------------------------------------------------
+
+class _Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], nd.sigmoid(in_data[0]))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0]
+        self.assign(in_grad[0], req[0], out_grad[0] * y * (1 - y))
+
+
+@mx.operator.register("test_sigmoid")
+class _SigmoidProp(mx.operator.CustomOpProp):
+    def create_operator(self, ctx, shapes, dtypes):
+        return _Sigmoid()
+
+
+def test_custom_op_forward_backward():
+    x = nd.array(np.random.RandomState(0).randn(4, 3).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="test_sigmoid")
+        loss = y.sum()
+    loss.backward()
+    sig = 1 / (1 + np.exp(-x.asnumpy()))
+    assert_almost_equal(y.asnumpy(), sig, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(x.grad.asnumpy(), sig * (1 - sig), rtol=1e-4,
+                        atol=1e-5)
+
+
+class _Scale2(mx.operator.CustomOp):
+    def __init__(self, factor):
+        self._f = factor
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] * self._f)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], out_grad[0] * self._f)
+
+
+@mx.operator.register("test_scale")
+class _ScaleProp(mx.operator.CustomOpProp):
+    def __init__(self, factor="2.0"):
+        super().__init__(need_top_grad=True)
+        self._factor = float(factor)
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _Scale2(self._factor)
+
+
+def test_custom_op_kwargs_and_unregistered():
+    x = nd.array(np.ones((2, 2), np.float32))
+    y = nd.Custom(x, op_type="test_scale", factor=3.0)
+    assert_almost_equal(y.asnumpy(), np.full((2, 2), 3.0, np.float32))
+    with pytest.raises(mx.MXNetError):
+        nd.Custom(x, op_type="nope")
+
+
+def test_custom_op_inside_gluon_block():
+    from mxnet_tpu import gluon
+
+    class Net(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return nd.Custom(x, op_type="test_sigmoid") * 2
+
+    net = Net()
+    x = nd.array(np.zeros((2, 2), np.float32))
+    out = net(x)
+    assert_almost_equal(out.asnumpy(), np.ones((2, 2), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# engine waitall exactness
+# ---------------------------------------------------------------------------
+
+def test_waitall_syncs_overflowed_ring(monkeypatch):
+    from mxnet_tpu.engine import Engine
+
+    eng = Engine.get()
+    eng.wait_for_all()  # drain buffers tracked by earlier tests
+    old_cap = eng._inflight_cap
+    eng._inflight_cap = 8
+    synced = []
+
+    class FakeBuf:
+        def __init__(self, i):
+            self.i = i
+
+        def block_until_ready(self):
+            synced.append(self.i)
+
+    try:
+        for i in range(20):
+            eng.track(FakeBuf(i))
+        # overflow syncs (not silently drops) the oldest entries
+        assert synced, "ring overflow never synced dropped buffers"
+        eng.wait_for_all()
+        assert sorted(synced) == list(range(20))
+    finally:
+        eng._inflight_cap = old_cap
+        eng._inflight = []

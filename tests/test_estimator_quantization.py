@@ -98,6 +98,7 @@ def test_quantize_net_dense_accuracy():
 
 
 def test_quantize_net_conv():
+    mx.random.seed(4)  # init is global-seed dependent; pin it
     rs = np.random.RandomState(4)
     x = rs.randn(2, 3, 8, 8).astype(np.float32)
     net = gluon.nn.HybridSequential()

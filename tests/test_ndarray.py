@@ -131,7 +131,8 @@ def test_save_load(tmp_path):
     f = str(tmp_path / "x.params")
     a = nd.array([1.0, 2.0])
     nd.save(f, a)
-    assert nd.load(f).asnumpy().tolist() == [1, 2]
+    # reference semantics: unnamed saves load back as a list
+    assert nd.load(f)[0].asnumpy().tolist() == [1, 2]
     nd.save(f, [a, a * 2])
     lst = nd.load(f)
     assert lst[1].asnumpy().tolist() == [2, 4]

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""All-reduce bandwidth microbenchmark.
+
+Parity: reference ``tools/bandwidth/measure.py`` (KVStore allreduce
+bandwidth; its README reports ~4.5 GB/s/GPU over PCIe at 8 GPUs).
+Here the collective is an XLA ``psum`` over the device mesh — ICI on a
+real pod, shared-memory on the virtual CPU mesh — which is the rebuild's
+actual gradient-aggregation path (compiled into the train step).
+
+Usage:
+    python tools/bandwidth/measure.py [--size-mb 64] [--runs 10]
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/bandwidth/measure.py   # 8 virtual devices
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--size-mb", type=float, default=64.0)
+    ap.add_argument("--runs", type=int, default=10)
+    args = ap.parse_args()
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # the image's sitecustomize imports jax before this env var is
+        # read; push the platform override through the config API too
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = jax.devices()
+    n = len(devs)
+    if n < 2:
+        print("single device (%s): nothing to all-reduce; use the "
+              "virtual CPU mesh (see --help)" % devs)
+        return
+    mesh = Mesh(np.array(devs), ("d",))
+    elems = int(args.size_mb * 1e6 / 4)
+    x = jnp.ones((n, elems), jnp.float32)
+    x = jax.device_put(x, jax.sharding.NamedSharding(mesh, P("d", None)))
+
+    @jax.jit
+    def allreduce(v):
+        return jax.shard_map(
+            lambda s: jax.lax.psum(s, "d"),
+            mesh=mesh, in_specs=P("d", None), out_specs=P("d", None),
+        )(v)
+
+    out = allreduce(x)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(args.runs):
+        out = allreduce(out)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / args.runs
+    # ring all-reduce moves 2*(n-1)/n of the payload per device
+    payload = elems * 4
+    algo_bw = payload * 2 * (n - 1) / n / dt / 1e9
+    print("devices=%d payload=%.1fMB time=%.3fms alg_bandwidth=%.2f GB/s"
+          % (n, payload / 1e6, dt * 1e3, algo_bw))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Pack an image folder into RecordIO (parity: reference tools/im2rec.py).
+
+Makes a ``.lst`` (index  label  relpath), a ``.rec`` of packed
+(IRHeader + encoded image) records, and a ``.idx`` for random access.
+Decoding uses PIL instead of OpenCV; records are JPEG passthrough when
+the source already is JPEG (no re-encode), matching im2rec's default.
+
+Usage:
+    python tools/im2rec.py PREFIX IMAGE_ROOT [--list] [--resize N]
+        [--quality Q] [--shuffle]
+"""
+from __future__ import annotations
+
+import argparse
+import io
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_EXTS = {".jpg", ".jpeg", ".png", ".bmp"}
+
+
+def find_images(root):
+    """(relpath, label) pairs; label = sorted subdirectory index."""
+    classes = sorted(
+        d for d in os.listdir(root)
+        if os.path.isdir(os.path.join(root, d)))
+    label_of = {c: i for i, c in enumerate(classes)}
+    items = []
+    if classes:
+        for c in classes:
+            for fn in sorted(os.listdir(os.path.join(root, c))):
+                if os.path.splitext(fn)[1].lower() in _EXTS:
+                    items.append((os.path.join(c, fn), label_of[c]))
+    else:
+        for fn in sorted(os.listdir(root)):
+            if os.path.splitext(fn)[1].lower() in _EXTS:
+                items.append((fn, 0))
+    return items
+
+
+def write_list(prefix, items):
+    with open(prefix + ".lst", "w") as f:
+        for i, (rel, label) in enumerate(items):
+            f.write("%d\t%f\t%s\n" % (i, float(label), rel))
+
+
+def read_list(prefix):
+    items = []
+    with open(prefix + ".lst") as f:
+        for line in f:
+            idx, label, rel = line.rstrip("\n").split("\t")
+            items.append((int(idx), float(label), rel))
+    return items
+
+
+def encode_image(path, resize=0, quality=95):
+    from PIL import Image
+
+    raw = open(path, "rb").read()
+    ext = os.path.splitext(path)[1].lower()
+    if not resize and ext in (".jpg", ".jpeg"):
+        return raw  # passthrough, like the reference default
+    img = Image.open(io.BytesIO(raw)).convert("RGB")
+    if resize:
+        w, h = img.size
+        scale = resize / min(w, h)
+        img = img.resize((max(1, int(w * scale)), max(1, int(h * scale))))
+    buf = io.BytesIO()
+    img.save(buf, format="JPEG", quality=quality)
+    return buf.getvalue()
+
+
+def make_rec(prefix, root, items, resize=0, quality=95):
+    from mxnet_tpu import recordio
+
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for idx, label, rel in items:
+        data = encode_image(os.path.join(root, rel), resize, quality)
+        header = recordio.IRHeader(0, label, idx, 0)
+        rec.write_idx(idx, recordio.pack(header, data))
+    rec.close()
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix")
+    ap.add_argument("root")
+    ap.add_argument("--list", action="store_true",
+                    help="only generate the .lst file")
+    ap.add_argument("--resize", type=int, default=0)
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--shuffle", action="store_true")
+    args = ap.parse_args()
+    if args.list or not os.path.exists(args.prefix + ".lst"):
+        items = find_images(args.root)
+        if args.shuffle:
+            random.shuffle(items)
+        write_list(args.prefix, items)
+        print("wrote %s.lst (%d images)" % (args.prefix, len(items)))
+        if args.list:
+            return
+    entries = read_list(args.prefix)
+    make_rec(args.prefix, args.root, entries, args.resize, args.quality)
+    print("wrote %s.rec / %s.idx" % (args.prefix, args.prefix))
+
+
+if __name__ == "__main__":
+    main()

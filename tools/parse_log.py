@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Parse training logs into a metric table (parity: tools/parse_log.py).
+
+Understands the reference's log line shapes::
+
+    Epoch[3] Batch [200]  Speed: 1234.5 samples/sec  accuracy=0.91
+    Epoch[3] Validation-accuracy=0.89
+    Epoch[3] Time cost=12.3
+
+Usage: python tools/parse_log.py LOGFILE [--format markdown|csv]
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+_EPOCH = re.compile(r"Epoch\[(\d+)\]")
+_SPEED = re.compile(r"Speed:\s*([\d.]+)")
+_METRIC = re.compile(r"(\S+?)=([\d.eE+-]+)")
+_TIME = re.compile(r"Time cost=([\d.]+)")
+
+
+def parse(lines):
+    epochs = {}
+    for line in lines:
+        m = _EPOCH.search(line)
+        if not m:
+            continue
+        ep = int(m.group(1))
+        rec = epochs.setdefault(ep, {"speeds": []})
+        sp = _SPEED.search(line)
+        if sp:
+            rec["speeds"].append(float(sp.group(1)))
+        t = _TIME.search(line)
+        if t:
+            rec["time"] = float(t.group(1))
+        for name, val in _METRIC.findall(line):
+            if name.lower().startswith(("speed", "time")):
+                continue
+            rec[name] = float(val)
+    return epochs
+
+
+def render(epochs, fmt="markdown"):
+    cols = sorted({k for rec in epochs.values() for k in rec
+                   if k != "speeds"})
+    header = ["epoch", "speed(avg)"] + cols
+    rows = []
+    for ep in sorted(epochs):
+        rec = epochs[ep]
+        speed = (sum(rec["speeds"]) / len(rec["speeds"])
+                 if rec["speeds"] else float("nan"))
+        rows.append([str(ep), "%.1f" % speed]
+                    + ["%.6g" % rec.get(c, float("nan")) for c in cols])
+    if fmt == "csv":
+        return "\n".join(",".join(r) for r in [header] + rows)
+    out = ["| " + " | ".join(header) + " |",
+           "|" + "---|" * len(header)]
+    out += ["| " + " | ".join(r) + " |" for r in rows]
+    return "\n".join(out)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("logfile")
+    ap.add_argument("--format", default="markdown",
+                    choices=("markdown", "csv"))
+    args = ap.parse_args()
+    with open(args.logfile) as f:
+        print(render(parse(f), args.format))
+
+
+if __name__ == "__main__":
+    main()
