@@ -247,17 +247,25 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0
     bshape = [1] * data.ndim
     bshape[axis % data.ndim] = data.shape[axis % data.ndim]
     if _mode == "train" and not use_global_stats:
-        mean = jnp.mean(data.astype(jnp.float32), axis=reduce_axes)
-        var = jnp.var(data.astype(jnp.float32), axis=reduce_axes)
+        # centered (two-pass) variance: the E[x²]-E[x]² identity
+        # catastrophically cancels in f32 when |mean| >> std
+        x32 = data.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=reduce_axes)
+        var = jnp.var(x32, axis=reduce_axes)
         new_mm = moving_mean * momentum + mean.astype(moving_mean.dtype) * (1 - momentum)
         new_mv = moving_var * momentum + var.astype(moving_var.dtype) * (1 - momentum)
     else:
         mean, var = moving_mean.astype(jnp.float32), moving_var.astype(jnp.float32)
         new_mm, new_mv = moving_mean, moving_var
+    # per-channel scale/shift stay f32; the big elementwise apply runs in
+    # the INPUT dtype (bf16 on TPU) — upcasting the whole activation
+    # tensor to f32 would double HBM traffic through BN fwd AND bwd
     inv = lax.rsqrt(var + eps)
-    scale = (g.astype(jnp.float32) * inv).reshape(bshape)
-    shift = (beta.astype(jnp.float32) - mean * g.astype(jnp.float32) * inv).reshape(bshape)
-    out = (data.astype(jnp.float32) * scale + shift).astype(data.dtype)
+    scale = (g.astype(jnp.float32) * inv).reshape(bshape).astype(data.dtype)
+    shift = (beta.astype(jnp.float32)
+             - mean * g.astype(jnp.float32) * inv).reshape(bshape) \
+        .astype(data.dtype)
+    out = data * scale + shift
     return out, lax.stop_gradient(new_mm), lax.stop_gradient(new_mv)
 
 

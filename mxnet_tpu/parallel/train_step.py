@@ -202,6 +202,7 @@ class JitTrainStep:
                                    self._param_shardings)],
                 NamedSharding(self._mesh, P()))
             jit_kwargs['out_shardings'] = out_sh
+        self._raw_step = step
         return jax.jit(step,
                        donate_argnums=(2, 3),
                        **jit_kwargs)
@@ -227,6 +228,68 @@ class JitTrainStep:
             jnp.asarray(self._opt.learning_rate, jnp.float32),
             self._weights, self._opt_state,
             jnp.asarray(self._t, jnp.int32), *arrays)
+        self._last_loss = loss
+        return loss
+
+    def step_n(self, n, *batch):
+        """Run ``n`` train steps as ONE device-side loop (single dispatch).
+
+        The whole loop — n × (forward, backward, optimizer) — compiles
+        into one executable via ``lax.fori_loop`` with the weights and
+        optimizer state as the carry, so host↔device latency is paid
+        once per n steps instead of per step.  Per-iteration RNG keys
+        are folded from one base key.  Returns the last step's loss.
+        Single-device path only (mesh carries need explicit shardings).
+        """
+        from jax import lax
+
+        if self._mesh is not None:
+            raise MXNetError("step_n: use step() with a mesh")
+        if getattr(self._opt, "lr_scheduler", None) is not None:
+            # the scheduler is arbitrary Python of the update count and
+            # cannot be traced per loop iteration; fall back to per-step
+            # dispatch so every update sees its scheduled lr
+            loss = None
+            for _ in range(int(n)):
+                loss = self.step(*batch)
+            return loss
+        batch_nd = [b if isinstance(b, NDArray) else nd.array(b)
+                    for b in batch]
+        self._ensure_init(batch_nd)
+        arrays = [jax.device_put(b.data(), self._device)
+                  for b in batch_nd]
+        if self._step_fn is None:
+            self._step_fn = self._build(arrays)
+        if not hasattr(self, "_step_n_cache"):
+            self._step_n_cache = {}
+        fn = self._step_n_cache.get(n)
+        if fn is None:
+            raw = self._raw_step
+
+            def loop(key, lr, weights, state, t, *arrs):
+                def body(i, carry):
+                    w, s, _ = carry
+                    # t is the count BEFORE this window; iteration i runs
+                    # update number t+i+1 (step() uses 1-based counts —
+                    # Adam's bias correction divides by 1-beta^t, so a
+                    # 0-based counter would produce 0/0 on step one)
+                    nw, ns, loss = raw(jax.random.fold_in(key, i), lr,
+                                       w, s, t + i + 1, *arrs)
+                    return (nw, ns, loss.astype(jnp.float32))
+
+                return lax.fori_loop(
+                    0, n, body,
+                    (weights, state, jnp.float32(0.0)))
+
+            fn = jax.jit(loop, donate_argnums=(2, 3))
+            self._step_n_cache[n] = fn
+        self._opt.num_update = self._t + n
+        self._weights, self._opt_state, loss = fn(
+            _random.next_key(),
+            jnp.asarray(self._opt.learning_rate, jnp.float32),
+            self._weights, self._opt_state,
+            jnp.asarray(self._t, jnp.int32), *arrays)
+        self._t += n
         self._last_loss = loss
         return loss
 

@@ -116,3 +116,86 @@ def test_shard_params_helper():
         mesh, params,
         rule=lambda n, s: P('model', None) if n == 'w' else None)
     assert out['w'].sharding.spec == P('model', None)
+
+
+def test_step_n_device_loop():
+    """n steps in one dispatch (lax.fori_loop) match n separate steps."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+
+    def train(use_loop):
+        mx.random.seed(5)
+        net = gluon.nn.Dense(4)
+        net.initialize(mx.init.Xavier())
+        step = parallel.JitTrainStep(
+            net, gluon.loss.L2Loss(), "sgd",
+            {"learning_rate": 0.1, "momentum": 0.9})
+        rs = np.random.RandomState(0)
+        x = rs.randn(8, 6).astype(np.float32)
+        y = rs.randn(8, 4).astype(np.float32)
+        if use_loop:
+            loss = step.step_n(6, x, y)
+        else:
+            for _ in range(6):
+                loss = step.step(x, y)
+        step.sync_params()
+        return float(loss), net.weight.data().asnumpy()
+
+    l_loop, w_loop = train(True)
+    l_ref, w_ref = train(False)
+    assert abs(l_loop - l_ref) < 1e-5
+    assert np.allclose(w_loop, w_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_step_n_adam_matches_step():
+    """Adam's t-dependent bias correction must match across the two paths."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+
+    def train(use_loop):
+        mx.random.seed(6)
+        net = gluon.nn.Dense(3)
+        net.initialize(mx.init.Xavier())
+        step = parallel.JitTrainStep(
+            net, gluon.loss.L2Loss(), "adam", {"learning_rate": 0.05})
+        rs = np.random.RandomState(1)
+        x = rs.randn(8, 5).astype(np.float32)
+        y = rs.randn(8, 3).astype(np.float32)
+        if use_loop:
+            loss = step.step_n(5, x, y)
+        else:
+            for _ in range(5):
+                loss = step.step(x, y)
+        step.sync_params()
+        return float(loss), net.weight.data().asnumpy()
+
+    l_loop, w_loop = train(True)
+    l_ref, w_ref = train(False)
+    assert np.isfinite(l_loop)
+    assert abs(l_loop - l_ref) < 1e-5
+    assert np.allclose(w_loop, w_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_step_n_with_lr_scheduler_falls_back():
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+
+    mx.random.seed(7)
+    net = gluon.nn.Dense(2)
+    net.initialize(mx.init.Xavier())
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    step = parallel.JitTrainStep(
+        net, gluon.loss.L2Loss(), "sgd",
+        {"learning_rate": 0.1, "lr_scheduler": sched})
+    rs = np.random.RandomState(2)
+    x = rs.randn(4, 3).astype(np.float32)
+    y = rs.randn(4, 2).astype(np.float32)
+    loss = step.step_n(4, x, y)
+    assert np.isfinite(float(loss))
+    assert step._t == 4  # per-step fallback advanced the counter
