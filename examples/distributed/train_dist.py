@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Multi-process data-parallel training via the distributed KVStore.
+
+Launch (spawns 1 parameter server + N workers on this machine, or run
+one role per host with the DMLC_* env set):
+
+    python tools/launch.py -n 2 --kv-store dist_sync \
+        python examples/distributed/train_dist.py
+
+Each worker computes gradients on its own shard of the data; the server
+sums pushes from all workers per key (barrier-per-key sync) and runs the
+optimizer server-side; workers pull the updated weights back.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon, nd  # noqa: E402
+
+
+def main():
+    kv = mx.kvstore.create(os.environ.get("MXNET_KVSTORE_MODE",
+                                          "dist_sync"))
+    rank, nworker = kv.rank, kv.num_workers
+    print("worker %d/%d up" % (rank, nworker))
+
+    # every worker sees a disjoint shard of one global dataset
+    rs = np.random.RandomState(0)
+    x_all = rs.randn(512, 16).astype(np.float32)
+    w_true = rs.randn(16, 1).astype(np.float32)
+    y_all = (x_all @ w_true).astype(np.float32)
+    shard = slice(rank * 512 // nworker, (rank + 1) * 512 // nworker)
+    x, y = nd.array(x_all[shard]), nd.array(y_all[shard])
+
+    mx.random.seed(0)  # identical init on every worker
+    net = gluon.nn.Dense(1)
+    net.initialize(mx.init.Xavier())
+    net(x[:1])
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=kv)
+    loss_fn = gluon.loss.L2Loss()
+    for i in range(25):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(x.shape[0] * nworker)  # global batch size
+        if rank == 0 and i % 5 == 0:
+            print("step %d loss %.5f" % (i, float(loss.mean().asnumpy())))
+
+    final = float(loss.mean().asnumpy())
+    print("worker %d final loss %.6f" % (rank, final))
+    assert final < 0.05, "distributed training failed to converge"
+    kv.barrier()
+    if rank == 0:
+        kv.stop()
+
+
+if __name__ == "__main__":
+    main()

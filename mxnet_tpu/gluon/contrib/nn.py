@@ -1,0 +1,67 @@
+"""gluon.contrib.nn — auxiliary layers (parity: gluon/contrib/nn/basic_layers.py).
+
+``SyncBatchNorm`` deserves a note: the reference needs a dedicated
+cross-GPU op (``sync_batch_norm.cc``) because each GPU computes batch
+stats over its local slice.  Under this framework's GSPMD training
+(``parallel.JitTrainStep``), arrays are *logically global* — a plain
+BatchNorm's ``mean``/``var`` reduce over the whole sharded batch and
+XLA inserts the ICI all-reduce automatically.  SyncBatchNorm is
+therefore literally BatchNorm here; the class exists for API parity and
+to document the semantics.
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from .. import nn as _nn
+
+
+class Concurrent(_nn.HybridSequential):
+    """Run children on the same input, concat outputs (ref :29)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        outs = [block(x) for block in self._children.values()]
+        return F.concat(*outs, dim=self.axis)
+
+
+class HybridConcurrent(Concurrent):
+    """Alias of Concurrent (everything here hybridizes; ref :77)."""
+
+
+class Identity(HybridBlock):
+    """Pass-through block (ref :127) — useful in Concurrent branches."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(_nn.Embedding):
+    """Embedding with row_sparse gradients (ref :147).
+
+    Sugar for ``nn.Embedding(..., sparse_grad=True)`` — the gradient is
+    a RowSparseNDArray of just the touched rows, applied lazily by the
+    optimizer (gather→step→scatter).
+    """
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(input_dim, output_dim, dtype=dtype,
+                         weight_initializer=weight_initializer,
+                         sparse_grad=True, **kwargs)
+
+
+class SyncBatchNorm(_nn.BatchNorm):
+    """Cross-device BatchNorm (ref :187, ``sync_batch_norm.cc``).
+
+    See the module docstring: under GSPMD sharding the base BatchNorm
+    already reduces over the global batch, so this is an alias whose
+    ``num_devices`` argument is accepted and ignored.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, **kwargs):
+        super().__init__(momentum=momentum, epsilon=epsilon,
+                         in_channels=in_channels, **kwargs)

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from collections import OrderedDict
 
+import jax
 import jax.numpy as jnp
 import numpy as _np
 
@@ -116,6 +117,12 @@ class Parameter:
             else init_mod.create(default_init))
         desc = init_mod.InitDesc(self.name)
         data = initializer(desc, self._shape, _to_jax_dtype(self.dtype))
+        if isinstance(data, jax.Array):
+            # jax-random initializers materialize on the DEFAULT backend
+            # device; commit to the declared context so parameters and
+            # batches agree on placement (a tpu-committed weight plus a
+            # cpu-committed batch is a device-mismatch error at dispatch)
+            data = jax.device_put(data, ctx.jax_device)
         self._data = NDArray(data, ctx=ctx)
         if self._grad_req != "null":
             self._data.attach_grad(self._grad_req)

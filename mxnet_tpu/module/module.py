@@ -278,13 +278,6 @@ class Module(BaseModule):
                 'optimizer already initialized, ignoring...')
             return
 
-        if isinstance(optimizer, str):
-            idx2name = dict(enumerate(self._exec_group.param_names))
-            optimizer_params = dict(optimizer_params)
-            optimizer = opt_mod.create(
-                optimizer, param_idx2name=idx2name, **optimizer_params)
-        self._optimizer = optimizer
-
         from ..kvstore import create as kv_create
 
         if kvstore is None:
@@ -296,6 +289,25 @@ class Module(BaseModule):
         else:
             self._kvstore = kvstore
             self._update_on_kvstore = True
+
+        if isinstance(optimizer, str):
+            idx2name = dict(enumerate(self._exec_group.param_names))
+            optimizer_params = dict(optimizer_params)
+            if "rescale_grad" not in optimizer_params:
+                # loss heads (SoftmaxOutput normalization='null') emit
+                # per-example gradients SUMMED over the batch; the module
+                # divides by the GLOBAL batch size, like the reference
+                # (module.py:506-518: batch_size *= kv.num_workers under
+                # dist-sync) — without it training diverges
+                batch_size = self._exec_group.batch_size
+                kv = self._kvstore
+                if kv is not None and "dist" in str(kv.type) \
+                        and "_sync" in str(kv.type):
+                    batch_size *= kv.num_workers
+                optimizer_params["rescale_grad"] = 1.0 / batch_size
+            optimizer = opt_mod.create(
+                optimizer, param_idx2name=idx2name, **optimizer_params)
+        self._optimizer = optimizer
         self._updater = opt_mod.get_updater(optimizer)
         if self._kvstore is not None and self._update_on_kvstore:
             self._kvstore.set_optimizer(self._optimizer)

@@ -247,8 +247,9 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0
     bshape = [1] * data.ndim
     bshape[axis % data.ndim] = data.shape[axis % data.ndim]
     if _mode == "train" and not use_global_stats:
-        # centered (two-pass) variance: the E[x²]-E[x]² identity
-        # catastrophically cancels in f32 when |mean| >> std
+        # keep jnp.var's centered variance — do NOT "optimize" to the
+        # one-pass E[x²]-E[x]² identity, which catastrophically cancels
+        # in f32 when |mean| >> std
         x32 = data.astype(jnp.float32)
         mean = jnp.mean(x32, axis=reduce_axes)
         var = jnp.var(x32, axis=reduce_axes)
