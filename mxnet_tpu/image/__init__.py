@@ -8,3 +8,7 @@ from .image import (  # noqa: F401
     LightingAug, ColorNormalizeAug, RandomGrayAug, HorizontalFlipAug,
     CastAug, CreateAugmenter, ImageIter,
 )
+from .detection import (  # noqa: F401
+    DetAugmenter, DetBorrowAug, DetRandomSelectAug, DetHorizontalFlipAug,
+    DetRandomCropAug, DetRandomPadAug, CreateDetAugmenter, ImageDetIter,
+)

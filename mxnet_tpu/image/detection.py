@@ -1,0 +1,297 @@
+"""Detection augmenters + iterator (parity: python/mxnet/image/detection.py).
+
+Labels are (num_object, 5+) float arrays per image — rows of
+``[class_id, xmin, ymin, xmax, ymax]`` with coordinates normalized to
+[0, 1] — padded with -1 rows to the batch-wide ``max_objects``
+(reference ImageDetIter label padding semantics).
+"""
+from __future__ import annotations
+
+import random as pyrandom
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+from .image import (
+    Augmenter, CreateAugmenter, ImageIter, _to_np, imdecode, imresize,
+    ResizeAug, ForceResizeAug, ColorNormalizeAug, CastAug,
+    BrightnessJitterAug, ContrastJitterAug, SaturationJitterAug,
+    HueJitterAug, RandomGrayAug, LightingAug,
+)
+
+
+class DetAugmenter:
+    """Base detection augmenter: ``(img, label) -> (img, label)``."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap an image-only Augmenter; label passes through (ref :62)."""
+
+    def __init__(self, augmenter):
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly select one (or none) of several augmenters (ref :80)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        self.aug_list = aug_list
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.skip_prob or not self.aug_list:
+            return src, label
+        return pyrandom.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Flip image and box x-coordinates together (ref :109)."""
+
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.p:
+            arr = _to_np(src)[:, ::-1, :].copy()
+            src = nd.array(arr)
+            label = label.copy()
+            valid = label[:, 0] >= 0
+            x1 = label[valid, 1].copy()
+            x2 = label[valid, 3].copy()
+            label[valid, 1] = 1.0 - x2
+            label[valid, 3] = 1.0 - x1
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """IoU-constrained random crop (SSD-style; ref :135).
+
+    Samples a crop whose coverage of at least one box exceeds
+    ``min_object_covered``; boxes are clipped to the crop and dropped
+    when their center falls outside.
+    """
+
+    def __init__(self, min_object_covered=0.3, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(0.3, 1.0), max_attempts=25):
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+
+    def _coverage(self, crop, boxes):
+        cx1, cy1, cx2, cy2 = crop
+        ix1 = np.maximum(boxes[:, 0], cx1)
+        iy1 = np.maximum(boxes[:, 1], cy1)
+        ix2 = np.minimum(boxes[:, 2], cx2)
+        iy2 = np.minimum(boxes[:, 3], cy2)
+        inter = np.maximum(ix2 - ix1, 0) * np.maximum(iy2 - iy1, 0)
+        area = np.maximum(
+            (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1]),
+            1e-12)
+        return inter / area
+
+    def __call__(self, src, label):
+        h, w = _to_np(src).shape[:2]
+        valid = label[:, 0] >= 0
+        boxes = label[valid, 1:5]
+        if boxes.size == 0:
+            return src, label
+        for _ in range(self.max_attempts):
+            area = pyrandom.uniform(*self.area_range)
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            cw = min(1.0, np.sqrt(area * ratio))
+            ch = min(1.0, np.sqrt(area / ratio))
+            cx = pyrandom.uniform(0, 1 - cw)
+            cy = pyrandom.uniform(0, 1 - ch)
+            crop = (cx, cy, cx + cw, cy + ch)
+            cov = self._coverage(crop, boxes)
+            if cov.max() < self.min_object_covered:
+                continue
+            # keep boxes whose center is inside the crop
+            centers_x = (boxes[:, 0] + boxes[:, 2]) / 2
+            centers_y = (boxes[:, 1] + boxes[:, 3]) / 2
+            keep = ((centers_x > crop[0]) & (centers_x < crop[2])
+                    & (centers_y > crop[1]) & (centers_y < crop[3]))
+            if not keep.any():
+                continue
+            arr = _to_np(src)
+            x0, y0 = int(cx * w), int(cy * h)
+            x1, y1 = int((cx + cw) * w), int((cy + ch) * h)
+            src = nd.array(arr[y0:y1, x0:x1, :].copy())
+            new_label = np.full_like(label, -1.0)
+            kept = label[valid][keep].copy()
+            kept[:, 1] = np.clip((kept[:, 1] - crop[0]) / cw, 0, 1)
+            kept[:, 2] = np.clip((kept[:, 2] - crop[1]) / ch, 0, 1)
+            kept[:, 3] = np.clip((kept[:, 3] - crop[0]) / cw, 0, 1)
+            kept[:, 4] = np.clip((kept[:, 4] - crop[1]) / ch, 0, 1)
+            new_label[:kept.shape[0]] = kept
+            return src, new_label
+        return src, label
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Expand the canvas and place the image randomly (zoom-out; ref :344)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=25,
+                 pad_val=(127, 127, 127)):
+        self.area_range = area_range
+        self.aspect_ratio_range = aspect_ratio_range
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        arr = _to_np(src)
+        h, w = arr.shape[:2]
+        scale = pyrandom.uniform(*self.area_range)
+        if scale <= 1.0:
+            return src, label
+        nw, nh = int(w * np.sqrt(scale)), int(h * np.sqrt(scale))
+        canvas = np.empty((nh, nw, arr.shape[2]), arr.dtype)
+        canvas[:] = np.asarray(self.pad_val, arr.dtype)
+        x0 = pyrandom.randint(0, nw - w)
+        y0 = pyrandom.randint(0, nh - h)
+        canvas[y0:y0 + h, x0:x0 + w, :] = arr
+        label = label.copy()
+        valid = label[:, 0] >= 0
+        label[valid, 1] = (label[valid, 1] * w + x0) / nw
+        label[valid, 3] = (label[valid, 3] * w + x0) / nw
+        label[valid, 2] = (label[valid, 2] * h + y0) / nh
+        label[valid, 4] = (label[valid, 4] * h + y0) / nh
+        return nd.array(canvas), label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0., rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, pca_noise=0,
+                       hue=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), max_attempts=50,
+                       pad_val=(127, 127, 127)):
+    """Build the standard detection pipeline (ref :685)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        crop = DetRandomCropAug(min_object_covered, aspect_ratio_range,
+                                (min(1.0, area_range[0]),
+                                 min(1.0, area_range[1])), max_attempts)
+        auglist.append(DetRandomSelectAug([crop], 1 - rand_crop))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    if rand_pad > 0:
+        pad = DetRandomPadAug(aspect_ratio_range,
+                              (1.0, max(1.0, area_range[1])),
+                              max_attempts, pad_val)
+        auglist.append(DetRandomSelectAug([pad], 1 - rand_pad))
+    auglist.append(DetBorrowAug(
+        ForceResizeAug((data_shape[2], data_shape[1]), inter_method)))
+    for prob, cls in ((brightness, BrightnessJitterAug),
+                      (contrast, ContrastJitterAug),
+                      (saturation, SaturationJitterAug),
+                      (hue, HueJitterAug)):
+        if prob > 0:
+            auglist.append(DetBorrowAug(cls(prob)))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(DetBorrowAug(LightingAug(pca_noise, eigval, eigvec)))
+    if rand_gray > 0:
+        auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    if mean is not None or std is not None:
+        if mean is True or mean is None:
+            mean = np.array([123.68, 116.28, 103.53])
+        if std is True or std is None:
+            std = np.array([58.395, 57.12, 57.375])
+        auglist.append(DetBorrowAug(CastAug()))
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator: images + padded (max_objects, 5) labels
+    (parity: detection.py ImageDetIter:780)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=None, shuffle=False,
+                 aug_list=None, imglist=None, max_objects=None, **kwargs):
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape, **{
+                k: v for k, v in kwargs.items()
+                if k in ("resize", "rand_crop", "rand_pad", "rand_gray",
+                         "rand_mirror", "mean", "std", "brightness",
+                         "contrast", "saturation", "pca_noise", "hue",
+                         "inter_method", "min_object_covered",
+                         "aspect_ratio_range", "area_range",
+                         "max_attempts", "pad_val")})
+        super().__init__(batch_size, data_shape, label_width=1,
+                         path_imgrec=path_imgrec, path_imglist=path_imglist,
+                         path_root=path_root, shuffle=shuffle,
+                         aug_list=[], imglist=imglist)
+        self.det_auglist = aug_list
+        if max_objects is None:
+            max_objects = 1
+            for idx in self.seq:
+                lbl = self._label_of(idx)
+                max_objects = max(max_objects, lbl.shape[0])
+        self.max_objects = max_objects
+
+    def _label_of(self, idx):
+        if self.imgrec is not None:
+            from .. import recordio
+
+            header, _ = recordio.unpack(self.imgrec.read_idx(idx))
+            lbl = np.asarray(header.label, np.float32)
+        else:
+            lbl = np.asarray(self.imglist[idx][0].label, np.float32)
+        return lbl.reshape(-1, 5) if lbl.ndim == 1 else lbl
+
+    @property
+    def provide_label(self):
+        from .. import io as _io
+
+        return [_io.DataDesc(
+            "label", (self.batch_size, self.max_objects, 5))]
+
+    def next(self):
+        from .. import io as _io
+
+        c, h, w = self.data_shape
+        batch_data = np.zeros((self.batch_size, c, h, w), np.float32)
+        batch_label = np.full(
+            (self.batch_size, self.max_objects, 5), -1.0, np.float32)
+        i = 0
+        try:
+            while i < self.batch_size:
+                label, img = self.next_sample()
+                label = np.asarray(label, np.float32)
+                label = label.reshape(-1, 5) if label.ndim == 1 else label
+                padded = np.full((self.max_objects, 5), -1.0, np.float32)
+                padded[:min(len(label), self.max_objects)] = \
+                    label[:self.max_objects]
+                if isinstance(img, (bytes, bytearray)):
+                    img = imdecode(img)
+                elif not isinstance(img, NDArray):
+                    img = nd.array(np.asarray(img))
+                for aug in self.det_auglist:
+                    img, padded = aug(img, padded)
+                arr = _to_np(img).astype(np.float32)
+                if arr.shape[:2] != (h, w):
+                    arr = _to_np(imresize(nd.array(arr), w, h))
+                batch_data[i] = arr.transpose(2, 0, 1)
+                batch_label[i] = padded
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        return _io.DataBatch(
+            data=[nd.array(batch_data)], label=[nd.array(batch_label)],
+            pad=self.batch_size - i)

@@ -720,6 +720,48 @@ def one_hot(indices, depth, dtype="float32"):
         [indices], "_np_one_hot")
 
 
+def searchsorted(a, v, side="left"):
+    return _invoke(lambda x, q: jnp.searchsorted(x, q, side=side),
+                   [a, v], "_np_searchsorted")
+
+
+def bincount(x, weights=None, minlength=0):
+    # length depends on the data → eager host computation
+    xv = x.asnumpy() if isinstance(x, NDArray) else _onp.asarray(x)
+    wv = weights.asnumpy() if isinstance(weights, NDArray) else weights
+    return ndarray(_onp.bincount(xv.astype(_onp.int64), wv, minlength))
+
+
+def interp(x, xp, fp):
+    return _invoke(lambda a, b, c: jnp.interp(a, b, c), [x, xp, fp],
+                   "_np_interp")
+
+
+def diff(a, n=1, axis=-1):
+    return _invoke(lambda x: jnp.diff(x, n=n, axis=axis), [a], "_np_diff")
+
+
+def cross(a, b, axis=-1):
+    return _invoke(lambda x, y: jnp.cross(x, y, axis=axis), [a, b],
+                   "_np_cross")
+
+
+def cumprod(a, axis=None):
+    return _invoke(lambda x: jnp.cumprod(x, axis=axis), [a], "_np_cumprod")
+
+
+def gradient(f, *varargs, axis=None):
+    def fn(x):
+        g = jnp.gradient(x, *varargs, axis=axis)
+        return tuple(g) if isinstance(g, (list, tuple)) else (g,)
+
+    outs = _reg.invoke_fn(
+        fn, [f if isinstance(f, NDArray) else array(f)],
+        op_name="_np_gradient")
+    outs = [_as_np(o) for o in outs]
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
 def take(a, indices, axis=None, mode="clip"):
     if isinstance(indices, NDArray):
         return _invoke(lambda x, i: jnp.take(x, i.astype(jnp.int32),

@@ -1,0 +1,82 @@
+"""Detection augmenters + ImageDetIter (ref python/mxnet/image/detection.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as img
+from mxnet_tpu import nd
+
+
+def _imglist(n=6, seed=0):
+    rs = np.random.RandomState(seed)
+    return [(np.array([[0, 0.2, 0.2, 0.6, 0.7],
+                       [1, 0.5, 0.5, 0.9, 0.9]], np.float32),
+             rs.randint(0, 255, (48, 64, 3)).astype(np.uint8))
+            for _ in range(n)]
+
+
+def test_det_iter_shapes_and_padding():
+    it = img.ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                          imglist=_imglist(), rand_mirror=True,
+                          rand_crop=0.5, rand_pad=0.5, mean=True, std=True)
+    b = it.next()
+    assert b.data[0].shape == (4, 3, 32, 32)
+    assert b.label[0].shape == (4, 2, 5)
+    lbl = b.label[0].asnumpy()
+    valid = lbl[lbl[..., 0] >= 0]
+    assert len(valid) >= 1
+    assert (valid[:, 1:] >= -1e-5).all() and (valid[:, 1:] <= 1 + 1e-5).all()
+
+
+def test_det_flip_boxes():
+    flip = img.DetHorizontalFlipAug(p=1.0)
+    x = nd.array(np.random.RandomState(1).rand(8, 8, 3).astype(np.float32))
+    lab = np.array([[0, 0.1, 0.2, 0.4, 0.6]], np.float32)
+    x2, lab2 = flip(x, lab.copy())
+    assert abs(lab2[0, 1] - 0.6) < 1e-6
+    assert abs(lab2[0, 3] - 0.9) < 1e-6
+    assert np.allclose(x2.asnumpy(), x.asnumpy()[:, ::-1, :])
+
+
+def test_det_random_crop_keeps_coverage():
+    np.random.seed(2)
+    crop = img.DetRandomCropAug(min_object_covered=0.5,
+                                area_range=(0.5, 1.0))
+    x = nd.array(np.random.rand(40, 40, 3).astype(np.float32))
+    lab = np.array([[0, 0.3, 0.3, 0.7, 0.7]], np.float32)
+    for _ in range(5):
+        x2, lab2 = crop(x, lab.copy())
+        valid = lab2[lab2[:, 0] >= 0]
+        if valid.size:
+            assert (valid[:, 1:] >= 0).all() and (valid[:, 1:] <= 1).all()
+            assert (valid[:, 3] > valid[:, 1]).all()
+            assert (valid[:, 4] > valid[:, 2]).all()
+
+
+def test_det_random_pad_scales_boxes():
+    import random as pyrandom
+
+    pyrandom.seed(3)
+    pad = img.DetRandomPadAug(area_range=(2.0, 2.0))
+    x = nd.array(np.random.RandomState(3).rand(20, 20, 3)
+                 .astype(np.float32))
+    lab = np.array([[0, 0.0, 0.0, 1.0, 1.0]], np.float32)
+    x2, lab2 = pad(x, lab.copy())
+    h2, w2 = x2.asnumpy().shape[:2]
+    assert h2 > 20 and w2 > 20
+    # padded boxes shrink relative to the enlarged canvas
+    assert (lab2[0, 3] - lab2[0, 1]) < 1.0
+    assert (lab2[0, 4] - lab2[0, 2]) < 1.0
+
+
+def test_det_borrow_and_select():
+    borrow = img.DetBorrowAug(img.ResizeAug(24))
+    x = nd.array(np.random.RandomState(4).rand(48, 64, 3)
+                 .astype(np.float32))
+    lab = np.array([[0, 0.1, 0.1, 0.5, 0.5]], np.float32)
+    x2, lab2 = borrow(x, lab)
+    assert min(x2.asnumpy().shape[:2]) == 24
+    assert np.array_equal(lab, lab2)
+    sel = img.DetRandomSelectAug([], skip_prob=0.0)
+    x3, lab3 = sel(x, lab)
+    assert x3 is x

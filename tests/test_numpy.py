@@ -261,3 +261,23 @@ def test_true_division_int():
     out = a / 2
     assert out.dtype.kind == "f"
     assert_almost_equal(out.asnumpy(), onp.array([0.5, 1.0, 1.5]))
+
+
+@pytest.mark.parametrize("name,args", [
+    ("searchsorted", (onp.array([1., 2., 4., 8.], onp.float32),
+                      onp.array([3., 0.5], onp.float32))),
+    ("bincount", (onp.array([0, 1, 1, 3], onp.int32),)),
+    ("interp", (onp.array([1.5, 2.5], onp.float32),
+                onp.array([1., 2., 3.], onp.float32),
+                onp.array([10., 20., 30.], onp.float32))),
+    ("diff", (_A,)),
+    ("cross", (onp.array([1., 0., 0.], onp.float32),
+               onp.array([0., 1., 0.], onp.float32))),
+    ("cumprod", (_V,)),
+    ("gradient", (_A,)),
+], ids=lambda v: v if isinstance(v, str) else "")
+def test_np_extras(name, args):
+    mx_args = [np.array(a) if a.dtype != onp.int64 else a for a in args]
+    mx_out = getattr(np, name)(*mx_args)
+    np_out = getattr(onp, name)(*args)
+    _check(mx_out, np_out, rtol=1e-5, atol=1e-6)
