@@ -18,6 +18,7 @@ from .image import (
     ResizeAug, ForceResizeAug, ColorNormalizeAug, CastAug,
     BrightnessJitterAug, ContrastJitterAug, SaturationJitterAug,
     HueJitterAug, RandomGrayAug, LightingAug,
+    IMAGENET_MEAN, IMAGENET_STD, PCA_EIGVAL, PCA_EIGVEC,
 )
 
 
@@ -144,15 +145,25 @@ class DetRandomPadAug(DetAugmenter):
                  pad_val=(127, 127, 127)):
         self.area_range = area_range
         self.aspect_ratio_range = aspect_ratio_range
+        self.max_attempts = max_attempts
         self.pad_val = pad_val
+
+    def _sample_canvas(self, w, h):
+        for _ in range(self.max_attempts):
+            scale = pyrandom.uniform(*self.area_range)
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            nw = int(w * np.sqrt(scale * ratio))
+            nh = int(h * np.sqrt(scale / ratio))
+            if nw >= w and nh >= h and (nw > w or nh > h):
+                return nw, nh
+        return w, h
 
     def __call__(self, src, label):
         arr = _to_np(src)
         h, w = arr.shape[:2]
-        scale = pyrandom.uniform(*self.area_range)
-        if scale <= 1.0:
+        nw, nh = self._sample_canvas(w, h)
+        if (nw, nh) == (w, h):
             return src, label
-        nw, nh = int(w * np.sqrt(scale)), int(h * np.sqrt(scale))
         canvas = np.empty((nh, nw, arr.shape[2]), arr.dtype)
         canvas[:] = np.asarray(self.pad_val, arr.dtype)
         x0 = pyrandom.randint(0, nw - w)
@@ -199,18 +210,21 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
         if prob > 0:
             auglist.append(DetBorrowAug(cls(prob)))
     if pca_noise > 0:
-        eigval = np.array([55.46, 4.794, 1.148])
-        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
-                           [-0.5808, -0.0045, -0.8140],
-                           [-0.5836, -0.6948, 0.4203]])
-        auglist.append(DetBorrowAug(LightingAug(pca_noise, eigval, eigvec)))
+        auglist.append(DetBorrowAug(
+            LightingAug(pca_noise, PCA_EIGVAL, PCA_EIGVEC)))
     if rand_gray > 0:
         auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    # same defaulting rules as CreateAugmenter: only `True` pulls in the
+    # ImageNet constant — passing just std must NOT imply a mean shift
+    if mean is True:
+        mean = IMAGENET_MEAN
+    elif mean is not None:
+        mean = np.asarray(mean)
+    if std is True:
+        std = IMAGENET_STD
+    elif std is not None:
+        std = np.asarray(std)
     if mean is not None or std is not None:
-        if mean is True or mean is None:
-            mean = np.array([123.68, 116.28, 103.53])
-        if std is True or std is None:
-            std = np.array([58.395, 57.12, 57.375])
         auglist.append(DetBorrowAug(CastAug()))
         auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
     return auglist
@@ -222,7 +236,8 @@ class ImageDetIter(ImageIter):
 
     def __init__(self, batch_size, data_shape, path_imgrec=None,
                  path_imglist=None, path_root=None, shuffle=False,
-                 aug_list=None, imglist=None, max_objects=None, **kwargs):
+                 aug_list=None, imglist=None, max_objects=None,
+                 object_width=None, **kwargs):
         if aug_list is None:
             aug_list = CreateDetAugmenter(data_shape, **{
                 k: v for k, v in kwargs.items()
@@ -237,11 +252,14 @@ class ImageDetIter(ImageIter):
                          path_root=path_root, shuffle=shuffle,
                          aug_list=[], imglist=imglist)
         self.det_auglist = aug_list
+        # flat labels have no intrinsic width; default 5 unless told
+        self.object_width = object_width or 5
         if max_objects is None:
             max_objects = 1
             for idx in self.seq:
                 lbl = self._label_of(idx)
                 max_objects = max(max_objects, lbl.shape[0])
+                self.object_width = max(self.object_width, lbl.shape[1])
         self.max_objects = max_objects
 
     def _label_of(self, idx):
@@ -252,31 +270,33 @@ class ImageDetIter(ImageIter):
             lbl = np.asarray(header.label, np.float32)
         else:
             lbl = np.asarray(self.imglist[idx][0].label, np.float32)
-        return lbl.reshape(-1, 5) if lbl.ndim == 1 else lbl
+        return lbl.reshape(-1, self.object_width) if lbl.ndim == 1 else lbl
 
     @property
     def provide_label(self):
         from .. import io as _io
 
         return [_io.DataDesc(
-            "label", (self.batch_size, self.max_objects, 5))]
+            "label",
+            (self.batch_size, self.max_objects, self.object_width))]
 
     def next(self):
         from .. import io as _io
 
         c, h, w = self.data_shape
+        ow = self.object_width
         batch_data = np.zeros((self.batch_size, c, h, w), np.float32)
         batch_label = np.full(
-            (self.batch_size, self.max_objects, 5), -1.0, np.float32)
+            (self.batch_size, self.max_objects, ow), -1.0, np.float32)
         i = 0
         try:
             while i < self.batch_size:
                 label, img = self.next_sample()
                 label = np.asarray(label, np.float32)
-                label = label.reshape(-1, 5) if label.ndim == 1 else label
-                padded = np.full((self.max_objects, 5), -1.0, np.float32)
+                label = label.reshape(-1, ow) if label.ndim == 1 else label
+                padded = np.full((self.max_objects, ow), -1.0, np.float32)
                 padded[:min(len(label), self.max_objects)] = \
-                    label[:self.max_objects]
+                    label[:self.max_objects, :ow]
                 if isinstance(img, (bytes, bytearray)):
                     img = imdecode(img)
                 elif not isinstance(img, NDArray):

@@ -386,6 +386,15 @@ class CastAug(Augmenter):
                        self.typ)
 
 
+# ImageNet preprocessing constants (single source for cls + detection)
+IMAGENET_MEAN = np.array([123.68, 116.28, 103.53])
+IMAGENET_STD = np.array([58.395, 57.12, 57.375])
+PCA_EIGVAL = np.array([55.46, 4.794, 1.148])
+PCA_EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
+                       [-0.5808, -0.0045, -0.8140],
+                       [-0.5836, -0.6948, 0.4203]])
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, hue=0, pca_noise=0,
@@ -412,20 +421,16 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     if hue:
         auglist.append(HueJitterAug(hue))
     if pca_noise > 0:
-        eigval = np.array([55.46, 4.794, 1.148])
-        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
-                           [-0.5808, -0.0045, -0.8140],
-                           [-0.5836, -0.6948, 0.4203]])
-        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+        auglist.append(LightingAug(pca_noise, PCA_EIGVAL, PCA_EIGVEC))
     if rand_gray > 0:
         auglist.append(RandomGrayAug(rand_gray))
     if mean is True:
-        mean = np.array([123.68, 116.28, 103.53])
+        mean = IMAGENET_MEAN
     elif mean is not None:
         mean = np.asarray(mean)
         assert mean.shape[0] in [1, 3]
     if std is True:
-        std = np.array([58.395, 57.12, 57.375])
+        std = IMAGENET_STD
     elif std is not None:
         std = np.asarray(std)
         assert std.shape[0] in [1, 3]

@@ -1,0 +1,83 @@
+"""Pipeline parallelism: GPipe microbatch schedule over a mesh axis.
+
+No reference counterpart (SURVEY §2.4 lists pipeline parallel as absent
+from the reference) — designed TPU-first: one *identical* stage per
+device along the ``pp`` axis, activations hopping stage-to-stage via
+``lax.ppermute`` while a ``lax.scan`` advances the microbatch clock.
+Each tick every device computes its stage on its current activation and
+ships the result one hop down the ring — the classic (M + n - 1)-tick
+GPipe schedule with bubble fraction (n-1)/(M+n-1).
+
+Because the whole schedule is pure jnp (scan + ppermute), ``jax.grad``
+through it yields the reverse pipeline automatically — backward
+activations flow the opposite direction with no hand-written schedule.
+
+Uniform stages fit transformer stacks naturally (N identical encoder
+cells); combine with a ``data`` mesh axis for dp×pp.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..base import MXNetError
+
+
+def gpipe(stage_fn, stacked_params, x_microbatches, mesh, axis_name="pp"):
+    """Run ``n_stages`` copies of ``stage_fn`` as a pipeline.
+
+    Parameters
+    ----------
+    stage_fn : (stage_params, activation) -> activation, shape-preserving
+    stacked_params : pytree whose leaves have leading axis ``n_stages``
+        (stage i's weights at index i) — sharded over ``axis_name``
+    x_microbatches : (M, microbatch, ...) array, replicated
+    mesh : jax.sharding.Mesh containing ``axis_name``
+    Returns the last stage's outputs, (M, microbatch, ...), replicated.
+    """
+    n = mesh.shape[axis_name]
+    m = x_microbatches.shape[0]
+    if n < 2:
+        raise MXNetError("gpipe needs a pipeline axis of size >= 2")
+
+    def per_device(params_local, xs):
+        # shard_map gives each device a leading-axis slice of size 1
+        params = jax.tree_util.tree_map(lambda p: p[0], params_local)
+        idx = lax.axis_index(axis_name)
+        state0 = jnp.zeros(xs.shape[1:], xs.dtype)
+        perm = [(i, i + 1) for i in range(n - 1)]
+
+        def tick(state, t):
+            x_t = xs[jnp.clip(t, 0, m - 1)]
+            inp = jnp.where(idx == 0, x_t, state)
+            out = stage_fn(params, inp)
+            nxt = lax.ppermute(out, axis_name, perm)
+            return nxt, out
+
+        _, outs = lax.scan(tick, state0, jnp.arange(m + n - 1))
+        # the LAST stage's outputs for microbatch j appear at tick
+        # j + (n-1); zero on every other device, then psum-replicate
+        mine = lax.dynamic_slice_in_dim(outs, n - 1, m, axis=0)
+        valid = (idx == n - 1).astype(mine.dtype)
+        return lax.psum(mine * valid, axis_name)
+
+    return jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(axis_name), P()), out_specs=P(),
+        check_vma=False,
+    )(stacked_params, x_microbatches)
+
+
+def gpipe_loss_fn(stage_fn, loss_fn, mesh, axis_name="pp"):
+    """Compose a differentiable pipelined loss:
+    ``f(stacked_params, x_microbatches, y_microbatches) -> scalar``.
+    Gradients (via ``jax.grad``) run the reverse pipeline automatically.
+    """
+
+    def f(stacked_params, x_mb, y_mb):
+        outs = gpipe(stage_fn, stacked_params, x_mb, mesh, axis_name)
+        return loss_fn(outs, y_mb)
+
+    return f

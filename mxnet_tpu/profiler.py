@@ -290,3 +290,11 @@ class Marker:
 
 def set_kvstore_handle(handle):  # parity stub (server-side profiling)
     pass
+
+
+# parity: MXNET_PROFILER_AUTOSTART (env_var.md) — begin collecting as
+# soon as the process imports the framework
+import os as _os  # noqa: E402
+
+if _os.environ.get("MXNET_PROFILER_AUTOSTART", "0") in ("1", "true"):
+    set_state("run")

@@ -170,6 +170,33 @@ def assert_exception(f, exception_type, *args, **kwargs):
     raise AssertionError("did not raise %s" % exception_type)
 
 
+def with_seed(seed=None):
+    """Decorator seeding mx+numpy per test, logging the seed on failure
+    (reference tests/python/unittest/common.py:155)."""
+    import functools as _ft
+
+    def deco(f):
+        @_ft.wraps(f)
+        def wrapper(*args, **kwargs):
+            import random as _pyrandom
+
+            actual = (np.random.randint(0, np.iinfo(np.int32).max)
+                      if seed is None else seed)
+            from . import random as _mxrandom
+
+            _mxrandom.seed(actual)
+            np.random.seed(actual)
+            _pyrandom.seed(actual)
+            try:
+                return f(*args, **kwargs)
+            except Exception:
+                print("*** test failed with seed %d: rerun with "
+                      "with_seed(%d) to reproduce ***" % (actual, actual))
+                raise
+        return wrapper
+    return deco
+
+
 def retry(n):
     """Retry a flaky (randomized) test up to n times (ref common.py)."""
     def deco(f):

@@ -80,3 +80,40 @@ def test_det_borrow_and_select():
     sel = img.DetRandomSelectAug([], skip_prob=0.0)
     x3, lab3 = sel(x, lab)
     assert x3 is x
+
+
+def test_det_iter_wide_labels():
+    """Labels with extra columns beyond [cls, x1, y1, x2, y2] survive."""
+    rs = np.random.RandomState(7)
+    imgs = [(np.array([[0, .2, .2, .6, .7, 0.9]], np.float32),
+             rs.randint(0, 255, (32, 32, 3)).astype(np.uint8))
+            for _ in range(3)]
+    it = img.ImageDetIter(batch_size=2, data_shape=(3, 16, 16),
+                          imglist=imgs)
+    b = it.next()
+    assert b.label[0].shape == (2, 1, 6)
+    lbl = b.label[0].asnumpy()
+    assert abs(lbl[0, 0, 5] - 0.9) < 1e-6
+
+
+def test_det_std_only_no_mean_shift():
+    aug = img.CreateDetAugmenter((3, 16, 16), std=(2.0, 2.0, 2.0))
+    x = nd.array(np.full((16, 16, 3), 100.0, np.float32))
+    lab = np.array([[0, .1, .1, .5, .5]], np.float32)
+    for a in aug:
+        x, lab = a(x, lab)
+    # scaled by 1/2 only — no ImageNet mean subtraction
+    assert abs(float(x.asnumpy().mean()) - 50.0) < 1.0
+
+
+def test_det_pad_aspect_ratio_used():
+    import random as pyrandom
+
+    pyrandom.seed(11)
+    pad = img.DetRandomPadAug(aspect_ratio_range=(2.0, 2.0),
+                              area_range=(2.0, 2.0))
+    x = nd.array(np.zeros((20, 20, 3), np.float32))
+    lab = np.array([[0, 0.0, 0.0, 1.0, 1.0]], np.float32)
+    x2, _ = pad(x, lab.copy())
+    h2, w2 = x2.asnumpy().shape[:2]
+    assert w2 != h2  # the configured aspect ratio actually applied
