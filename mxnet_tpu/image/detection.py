@@ -254,12 +254,17 @@ class ImageDetIter(ImageIter):
         self.det_auglist = aug_list
         # flat labels have no intrinsic width; default 5 unless told
         self.object_width = object_width or 5
-        if max_objects is None:
-            max_objects = 1
+        if max_objects is None or object_width is None:
+            # scan labels for the padded shape; width inference must run
+            # even when max_objects was given, or 2-D labels wider than 5
+            # would be silently reshaped to garbage
+            scanned_max = 1
             for idx in self.seq:
                 lbl = self._label_of(idx)
-                max_objects = max(max_objects, lbl.shape[0])
+                scanned_max = max(scanned_max, lbl.shape[0])
                 self.object_width = max(self.object_width, lbl.shape[1])
+            if max_objects is None:
+                max_objects = scanned_max
         self.max_objects = max_objects
 
     def _label_of(self, idx):
@@ -295,8 +300,10 @@ class ImageDetIter(ImageIter):
                 label = np.asarray(label, np.float32)
                 label = label.reshape(-1, ow) if label.ndim == 1 else label
                 padded = np.full((self.max_objects, ow), -1.0, np.float32)
-                padded[:min(len(label), self.max_objects)] = \
-                    label[:self.max_objects, :ow]
+                clipped = label[:self.max_objects, :ow]
+                # narrower labels right-pad with -1 instead of failing to
+                # broadcast into the (max_objects, ow) buffer
+                padded[:clipped.shape[0], :clipped.shape[1]] = clipped
                 if isinstance(img, (bytes, bytearray)):
                     img = imdecode(img)
                 elif not isinstance(img, NDArray):

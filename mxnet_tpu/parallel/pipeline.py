@@ -60,8 +60,10 @@ def gpipe(stage_fn, stacked_params, x_microbatches, mesh, axis_name="pp"):
         # the LAST stage's outputs for microbatch j appear at tick
         # j + (n-1); zero on every other device, then psum-replicate
         mine = lax.dynamic_slice_in_dim(outs, n - 1, m, axis=0)
-        valid = (idx == n - 1).astype(mine.dtype)
-        return lax.psum(mine * valid, axis_name)
+        # select, don't multiply: dead-lane ticks run stage_fn on zero
+        # bootstrap state, and 0 * NaN would leak NaN through the psum
+        mine = jnp.where(idx == n - 1, mine, jnp.zeros_like(mine))
+        return lax.psum(mine, axis_name)
 
     return jax.shard_map(
         per_device, mesh=mesh,
