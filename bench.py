@@ -198,6 +198,66 @@ def _run_infer(platform):
     return img_s
 
 
+def _run_llama(platform):
+    """`python bench.py llama`: decoder-LM (Llama-architecture) training
+    throughput in tokens/s — RoPE + GQA + SwiGLU + Pallas flash attention,
+    whole step (fwd+bwd+adamw) as one executable.  No reference number
+    exists (the reference era predates decoder LMs), so vs_baseline is 0."""
+    import jax
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon.model_zoo import llama
+
+    on_accel = platform not in ("cpu",)
+    batch = 8 if on_accel else 2
+    seqlen = 512 if on_accel else 16
+    n_steps = 10 if on_accel else 2
+    vocab = 32000 if on_accel else 512
+    mx.random.seed(0)
+    if on_accel:
+        # ~160M-param GPT-2-medium-class geometry with GQA
+        net = llama.LlamaModel(vocab, units=768, hidden_size=2048,
+                               num_layers=12, num_heads=12, num_kv_heads=4)
+    else:
+        net = llama.llama_small()
+    net.initialize(mx.init.Xavier())
+    if on_accel:
+        from mxnet_tpu import amp
+
+        amp.init("bfloat16")
+        amp.convert_hybrid_block(net)
+
+    class LM(gluon.HybridBlock):
+        def __init__(self, inner):
+            super().__init__()
+            self.inner = inner
+
+        def hybrid_forward(self, F, toks):
+            return F.reshape(self.inner(toks), shape=(-1, vocab))
+
+    step = parallel.JitTrainStep(
+        LM(net), gluon.loss.SoftmaxCrossEntropyLoss(),
+        "adamw", {"learning_rate": 1e-4})
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, vocab, (batch, seqlen)).astype(np.int32)
+    labels = rng.randint(0, vocab, batch * seqlen).astype(np.float32)
+    t0 = time.perf_counter()
+    loss = step.step(toks, labels)
+    jax.block_until_ready(loss)
+    _log("llama compile+first step: %.1fs loss=%.3f"
+         % (time.perf_counter() - t0, float(loss)))
+    loss = step.step_n(n_steps, toks, labels)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    loss = step.step_n(n_steps, toks, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    tok_s = batch * seqlen * n_steps / dt
+    _log("llama b%d seq%d: %.0f tokens/s" % (batch, seqlen, tok_s))
+    return tok_s
+
+
 def _run(platform):
     import jax
     import jax.numpy as jnp
@@ -261,12 +321,15 @@ def _run(platform):
 def main():
     bert_mode = "bert" in sys.argv[1:]
     infer_mode = "infer" in sys.argv[1:]
+    llama_mode = "llama" in sys.argv[1:]
     try:
         platform = _init_backend()
         if bert_mode:
             value = _run_bert(platform)
         elif infer_mode:
             value = _run_infer(platform)
+        elif llama_mode:
+            value = _run_llama(platform)
         else:
             value = _run(platform)
     except Exception:
@@ -278,6 +341,14 @@ def main():
             "metric": "bert_base_train_throughput",
             "value": round(value, 2),
             "unit": "samples/sec",
+            "vs_baseline": 0.0,
+        }))
+        return
+    if llama_mode:
+        print(json.dumps({
+            "metric": "llama_decoder_train_throughput",
+            "value": round(value, 2),
+            "unit": "tokens/sec",
             "vs_baseline": 0.0,
         }))
         return

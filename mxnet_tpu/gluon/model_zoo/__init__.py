@@ -1,3 +1,5 @@
 """Model zoo (parity: ``python/mxnet/gluon/model_zoo/``)."""
 from . import vision  # noqa: F401
+from . import bert  # noqa: F401
+from . import llama  # noqa: F401
 from .vision import get_model  # noqa: F401

@@ -1,0 +1,199 @@
+"""Llama-family decoder-only language models.
+
+SURVEY §7 stretch config: the reference era has no decoder-LM counterpart
+(its transformer support stops at fused attention matmuls,
+``src/operator/contrib/transformer.cc``), so this family is designed
+TPU-first rather than ported:
+
+- attention runs through the Pallas flash-attention op
+  (``_contrib_flash_attention`` — blockwise online softmax on the MXU),
+- RoPE is computed inside the traced graph (static T ⇒ XLA constant-folds
+  the tables into the executable),
+- grouped-query attention (GQA) keeps the KV projection small and the
+  repeat happens post-projection, where XLA fuses it into the attention,
+- the whole model is a HybridBlock: one XLA executable under
+  ``hybridize()``/``JitTrainStep``; weights cast to bf16 via
+  ``net.cast('bfloat16')`` or AMP keep every matmul MXU-native.
+
+Long sequences: q/k/v from these blocks drop directly into
+``parallel.ring_attention_sharded`` to shard T across chips over an
+``sp`` mesh axis (SURVEY §5.7 long-context design); tensor-parallel
+sharding of the FFN/attention projections comes from
+``parallel.JitTrainStep(param_rule=...)`` over a ``model`` axis.
+"""
+from __future__ import annotations
+
+import math
+
+from .. import nn
+from ..block import HybridBlock
+
+
+class RMSNorm(HybridBlock):
+    """Root-mean-square norm (no mean subtraction), Llama convention."""
+
+    def __init__(self, units, eps=1e-6, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._eps = eps
+        self.weight = self.params.get("weight", shape=(units,), init="ones",
+                                      allow_deferred_init=False)
+
+    def hybrid_forward(self, F, x, weight=None):
+        return F.RMSNorm(x, weight, axis=-1, eps=self._eps)
+
+
+def _rope(F, x, base=10000.0):
+    """Rotary position embedding on (B, H, T, D); rotate-half convention."""
+    b, h, t, d = x.shape
+    half = d // 2
+    inv = F.arange(0, half, dtype="float32") * (-2.0 / d)
+    inv_freq = F.exp(inv * math.log(base))            # (half,)
+    pos = F.arange(0, t, dtype="float32")             # (T,)
+    freqs = F.reshape(pos, shape=(t, 1)) * F.reshape(inv_freq,
+                                                     shape=(1, half))
+    cos = F.reshape(F.cos(freqs), shape=(1, 1, t, half))
+    sin = F.reshape(F.sin(freqs), shape=(1, 1, t, half))
+    x1 = F.slice_axis(x, axis=3, begin=0, end=half)
+    x2 = F.slice_axis(x, axis=3, begin=half, end=d)
+    return F.concat(x1 * cos - x2 * sin, x2 * cos + x1 * sin, dim=3)
+
+
+class LlamaAttention(HybridBlock):
+    """Causal self-attention with RoPE and grouped-query KV heads."""
+
+    def __init__(self, units, num_heads, num_kv_heads=None,
+                 rope_base=10000.0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        num_kv_heads = num_kv_heads or num_heads
+        if units % num_heads or num_heads % num_kv_heads:
+            raise ValueError("units/num_heads/num_kv_heads mismatch")
+        self._units = units
+        self._heads = num_heads
+        self._kv_heads = num_kv_heads
+        self._base = rope_base
+        d = units // num_heads
+        with self.name_scope():
+            self.q_proj = nn.Dense(units, flatten=False, use_bias=False,
+                                   prefix="q_")
+            self.k_proj = nn.Dense(num_kv_heads * d, flatten=False,
+                                   use_bias=False, prefix="k_")
+            self.v_proj = nn.Dense(num_kv_heads * d, flatten=False,
+                                   use_bias=False, prefix="v_")
+            self.o_proj = nn.Dense(units, flatten=False, use_bias=False,
+                                   prefix="o_")
+
+    def hybrid_forward(self, F, x):
+        b, t, _ = x.shape
+        h, kv, d = self._heads, self._kv_heads, self._units // self._heads
+        q = F.transpose(F.reshape(self.q_proj(x), shape=(b, t, h, d)),
+                        axes=(0, 2, 1, 3))
+        k = F.transpose(F.reshape(self.k_proj(x), shape=(b, t, kv, d)),
+                        axes=(0, 2, 1, 3))
+        v = F.transpose(F.reshape(self.v_proj(x), shape=(b, t, kv, d)),
+                        axes=(0, 2, 1, 3))
+        q = _rope(F, q, self._base)
+        k = _rope(F, k, self._base)
+        if kv != h:
+            # GQA: repeat each KV head h//kv times (XLA fuses the
+            # broadcast into the attention matmuls)
+            k = F.repeat(k, repeats=h // kv, axis=1)
+            v = F.repeat(v, repeats=h // kv, axis=1)
+        out = F.contrib.flash_attention(
+            q, k, v, scale=1.0 / math.sqrt(d), causal=True)
+        out = F.reshape(F.transpose(out, axes=(0, 2, 1, 3)),
+                        shape=(b, t, self._units))
+        return self.o_proj(out)
+
+
+class LlamaFFN(HybridBlock):
+    """SwiGLU feed-forward: down(silu(gate(x)) * up(x))."""
+
+    def __init__(self, units, hidden_size, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.gate = nn.Dense(hidden_size, flatten=False, use_bias=False,
+                                 prefix="gate_")
+            self.up = nn.Dense(hidden_size, flatten=False, use_bias=False,
+                               prefix="up_")
+            self.down = nn.Dense(units, flatten=False, use_bias=False,
+                                 prefix="down_")
+
+    def hybrid_forward(self, F, x):
+        return self.down(F.Activation(self.gate(x), act_type="silu")
+                         * self.up(x))
+
+
+class LlamaBlock(HybridBlock):
+    """Pre-norm decoder block: x + attn(norm(x)); x + ffn(norm(x))."""
+
+    def __init__(self, units, hidden_size, num_heads, num_kv_heads=None,
+                 rope_base=10000.0, eps=1e-6, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.attn_norm = RMSNorm(units, eps, prefix="attnorm_")
+            self.attn = LlamaAttention(units, num_heads, num_kv_heads,
+                                       rope_base, prefix="attn_")
+            self.ffn_norm = RMSNorm(units, eps, prefix="ffnnorm_")
+            self.ffn = LlamaFFN(units, hidden_size, prefix="ffn_")
+
+    def hybrid_forward(self, F, x):
+        x = x + self.attn(self.attn_norm(x))
+        return x + self.ffn(self.ffn_norm(x))
+
+
+class LlamaModel(HybridBlock):
+    """Decoder-only LM.  forward(tokens (B,T)) → logits (B,T,V)."""
+
+    def __init__(self, vocab_size, units=4096, hidden_size=11008,
+                 num_layers=32, num_heads=32, num_kv_heads=None,
+                 rope_base=10000.0, eps=1e-6, tie_embeddings=False,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        self._tie = tie_embeddings
+        with self.name_scope():
+            self.embed = nn.Embedding(vocab_size, units, prefix="embed_")
+            self.blocks = nn.HybridSequential(prefix="blocks_")
+            for i in range(num_layers):
+                self.blocks.add(LlamaBlock(
+                    units, hidden_size, num_heads, num_kv_heads,
+                    rope_base, eps, prefix="block%d_" % i))
+            self.norm = RMSNorm(units, eps, prefix="norm_")
+            if not tie_embeddings:
+                self.lm_head = nn.Dense(vocab_size, flatten=False,
+                                        use_bias=False, prefix="head_")
+
+    def hybrid_forward(self, F, tokens):
+        x = self.embed(tokens)
+        x = self.blocks(x)
+        x = self.norm(x)
+        if self._tie:
+            b, t, c = x.shape
+            w = self.embed.weight.data()
+            logits = F.dot(F.reshape(x, shape=(b * t, c)), w,
+                           transpose_b=True)
+            return F.reshape(logits, shape=(b, t, -1))
+        return self.lm_head(x)
+
+
+def llama3_8b(vocab_size=128256, **kwargs):
+    """Llama-3-8B geometry: 32 layers, 4096 units, GQA 32/8 heads."""
+    cfg = dict(units=4096, hidden_size=14336, num_layers=32, num_heads=32,
+               num_kv_heads=8, rope_base=500000.0)
+    cfg.update(kwargs)
+    return LlamaModel(vocab_size, **cfg)
+
+
+def llama2_7b(vocab_size=32000, **kwargs):
+    """Llama-2-7B geometry: 32 layers, 4096 units, MHA."""
+    cfg = dict(units=4096, hidden_size=11008, num_layers=32, num_heads=32)
+    cfg.update(kwargs)
+    return LlamaModel(vocab_size, **cfg)
+
+
+def llama_small(vocab_size=512, **kwargs):
+    """Tiny config for tests / dry-runs."""
+    cfg = dict(units=64, hidden_size=128, num_layers=2, num_heads=4,
+               num_kv_heads=2)
+    cfg.update(kwargs)
+    return LlamaModel(vocab_size, **cfg)

@@ -254,17 +254,21 @@ class ImageDetIter(ImageIter):
         self.det_auglist = aug_list
         # flat labels have no intrinsic width; default 5 unless told
         self.object_width = object_width or 5
-        if max_objects is None or object_width is None:
-            # scan labels for the padded shape; width inference must run
-            # even when max_objects was given, or 2-D labels wider than 5
-            # would be silently reshaped to garbage
+        if max_objects is None:
+            # full scan: both the padded object count and the label width
             scanned_max = 1
             for idx in self.seq:
                 lbl = self._label_of(idx)
                 scanned_max = max(scanned_max, lbl.shape[0])
                 self.object_width = max(self.object_width, lbl.shape[1])
-            if max_objects is None:
-                max_objects = scanned_max
+            max_objects = scanned_max
+        elif object_width is None and self.seq:
+            # max_objects given: stay O(1) — infer the width from the
+            # first label only (2-D labels wider than 5 would otherwise
+            # be reshaped to garbage); pass object_width explicitly for
+            # mixed-width datasets
+            lbl = self._label_of(self.seq[0])
+            self.object_width = max(self.object_width, lbl.shape[1])
         self.max_objects = max_objects
 
     def _label_of(self, idx):

@@ -142,3 +142,159 @@ def _count_sketch(data, h, s, out_dim=16, processing_batch_size=32):
     sign = s.reshape(-1)
     out = jnp.zeros(data.shape[:-1] + (out_dim,), data.dtype)
     return out.at[..., idx].add(data * sign)
+
+
+# ----------------------------------------------------------------------------
+# encoder-decoder interleaved attention matmuls (parity:
+# src/operator/contrib/transformer.cc:650-780 — the encdec variants of the
+# selfatt ops in ops/nn.py)
+# ----------------------------------------------------------------------------
+
+@register("_contrib_interleaved_matmul_encdec_qk")
+def _interleaved_matmul_encdec_qk(queries, keys_values, heads=1):
+    """queries (Tq, B, H*D), keys_values (Tk, B, 2*H*D) → scaled QKᵀ
+    (B*heads, Tq, Tk)."""
+    tq, b, _ = queries.shape
+    tk = keys_values.shape[0]
+    q = queries.reshape(tq, b, heads, -1)
+    d = q.shape[-1]
+    kv = keys_values.reshape(tk, b, heads, 2, -1)
+    k = kv[:, :, :, 0, :]
+    q = jnp.transpose(q, (1, 2, 0, 3)).reshape(b * heads, tq, d)
+    k = jnp.transpose(k, (1, 2, 0, 3)).reshape(b * heads, tk, d)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32)).astype(q.dtype)
+    return jnp.matmul(q * scale, jnp.swapaxes(k, -1, -2))
+
+
+@register("_contrib_interleaved_matmul_encdec_valatt")
+def _interleaved_matmul_encdec_valatt(keys_values, attention, heads=1):
+    """keys_values (Tk, B, 2*H*D), attention (B*heads, Tq, Tk) →
+    (Tq, B, H*D)."""
+    tk, b, _ = keys_values.shape
+    kv = keys_values.reshape(tk, b, heads, 2, -1)
+    v = kv[:, :, :, 1, :]
+    d = v.shape[-1]
+    v = jnp.transpose(v, (1, 2, 0, 3)).reshape(b * heads, tk, d)
+    out = jnp.matmul(attention, v)  # (B*heads, Tq, D)
+    tq = out.shape[1]
+    out = out.reshape(b, heads, tq, d).transpose(2, 0, 1, 3)
+    return out.reshape(tq, b, heads * d)
+
+
+# ----------------------------------------------------------------------------
+# Hawkes process log-likelihood (parity: src/operator/contrib/hawkes_ll.cc)
+# ----------------------------------------------------------------------------
+
+@register("_contrib_hawkesll", num_outputs=2,
+          aliases=("_contrib_backward_hawkesll",))
+def _hawkesll(lda, alpha, beta, state, lags, marks, valid_length, max_time):
+    """Joint LL of K univariate Hawkes processes with exponential decay
+    (hawkes_ll-inl.h hawkesll_forward + compensator), as one lax.scan."""
+    n, t_len = lags.shape
+    k = lda.shape[1]
+
+    def one(mu_i, st0, lag_i, mark_i, vl_i, mt_i):
+        def step(carry, inp):
+            t, ll, st, last = carry
+            lag_j, mark_j, j = inp
+            valid = j < vl_i
+            ci = mark_j.astype(jnp.int32)
+            t_new = t + lag_j
+            d = t_new - last[ci]
+            ed = jnp.exp(-beta[ci] * d)
+            intensity = mu_i[ci] + alpha[ci] * beta[ci] * st[ci] * ed
+            comp = mu_i[ci] * d + alpha[ci] * st[ci] * (1 - ed)
+            ll = ll + jnp.where(valid, jnp.log(intensity) - comp, 0.0)
+            st = jnp.where(valid, st.at[ci].set(1 + st[ci] * ed), st)
+            last = jnp.where(valid, last.at[ci].set(t_new), last)
+            t = jnp.where(valid, t_new, t)
+            return (t, ll, st, last), None
+
+        init = (jnp.zeros(()), jnp.zeros(()), st0, jnp.zeros((k,)))
+        (t, ll, st, last), _ = lax.scan(
+            step, init,
+            (lag_i, mark_i, jnp.arange(t_len, dtype=jnp.float32)))
+        d = mt_i - last
+        ed = jnp.exp(-beta * d)
+        rem = mu_i * d + alpha * st * (1 - ed)
+        return ll - jnp.sum(rem), ed * st
+
+    return jax.vmap(one)(lda, state, lags,
+                         marks.astype(jnp.int32), valid_length, max_time)
+
+
+# ----------------------------------------------------------------------------
+# boolean_mask: dynamic output shape → imperative host round-trip, the same
+# forced sync the reference's dynamic-shape ops do
+# (src/operator/contrib/boolean_mask.cc)
+# ----------------------------------------------------------------------------
+
+
+def _boolean_mask_override(inputs, attrs, out):
+    import numpy as onp
+
+    from .registry import invoke_fn
+
+    # the mask sync is a host round-trip (dynamic output shape), but the
+    # gather itself is traced via invoke_fn so autograd records a tape
+    # node and gradients flow back to `data` (reference boolean_mask is
+    # differentiable; its backward scatters into the kept rows)
+    mask = inputs[1].asnumpy().astype(bool).reshape(-1)
+    axis = int(attrs.get("axis", 0))
+    idx = jnp.asarray(onp.nonzero(mask)[0], jnp.int32)
+    (res,) = invoke_fn(
+        lambda d: (jnp.take(d, idx, axis=axis),),
+        [inputs[0]], op_name="_contrib_boolean_mask")
+    return res
+
+
+register("_contrib_boolean_mask")(lambda data, index, axis=0: data)
+registry_mod = __import__("mxnet_tpu.ops.registry", fromlist=["x"])
+registry_mod.register_invoke_override("_contrib_boolean_mask",
+                                      _boolean_mask_override)
+
+
+# ----------------------------------------------------------------------------
+# DGL graph helpers on CSR structure (parity: src/operator/contrib/
+# dgl_graph.cc edge_id / adjacency).  These operate on CSRNDArray via the
+# imperative override hook (graph structure is host-resident, like the
+# reference's CPU-only implementations).  The neighbor-sampling and
+# graph-compaction ops (dgl_csr_neighbor_*_sample, dgl_subgraph,
+# dgl_graph_compact) are DGL-integration glue below this framework's scope
+# — DGL itself replaced them — and are intentionally not provided.
+# ----------------------------------------------------------------------------
+
+
+def _edge_id_override(inputs, attrs, out):
+    import numpy as onp
+
+    csr, u, v = inputs
+    indptr = csr.indptr.asnumpy().astype(onp.int64)
+    indices = csr.indices.asnumpy().astype(onp.int64)
+    vals = csr.data_arr.asnumpy()
+    uu = u.asnumpy().astype(onp.int64).reshape(-1)
+    vv = v.asnumpy().astype(onp.int64).reshape(-1)
+    res = onp.full(uu.shape, -1.0, onp.float32)
+    for i, (r, c) in enumerate(zip(uu, vv)):
+        lo, hi = indptr[r], indptr[r + 1]
+        pos = onp.searchsorted(indices[lo:hi], c)
+        if pos < hi - lo and indices[lo + pos] == c:
+            res[i] = vals[lo + pos]
+    from ..ndarray.ndarray import NDArray
+
+    return NDArray(jnp.asarray(res))
+
+
+def _dgl_adjacency_override(inputs, attrs, out):
+    from ..ndarray import sparse as _sp
+
+    csr = inputs[0]
+    ones = type(csr.data_arr)(jnp.ones(csr.data_arr.shape, jnp.float32))
+    return _sp.CSRNDArray(ones, csr.indptr, csr.indices, csr.shape)
+
+
+register("_contrib_edge_id")(lambda data, u, v: data)
+register("_contrib_dgl_adjacency")(lambda data: data)
+registry_mod.register_invoke_override("_contrib_edge_id", _edge_id_override)
+registry_mod.register_invoke_override("_contrib_dgl_adjacency",
+                                      _dgl_adjacency_override)

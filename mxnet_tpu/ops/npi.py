@@ -1,0 +1,447 @@
+"""NumPy-internal operator names (``_np*``/``_npi_*``/``_npx_*``).
+
+Parity: ``src/operator/numpy/*.cc`` — the reference registers ~150 internal
+ops that back ``mx.np``; its frontend dispatches to them via
+``mx.nd._internal``.  Here ``mx.np`` lowers through jnp closures directly
+(numpy/__init__.py), but the internal *names* are part of the operator
+surface (visible in ``mx.nd`` listings, usable from symbols), so this wave
+registers them over the same jnp kernels.
+
+Dynamic-output-shape ops (``_npi_unique``, ``_npx_nonzero``,
+``_npi_delete``) cannot be fixed-shape XLA computations; they run through
+the imperative override hook (host round-trip) exactly like the
+reference's dynamic-shape ops force a synchronization
+(``src/operator/numpy/np_unique_op.cc``).
+"""
+from __future__ import annotations
+
+import numpy as _onp
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias, register_invoke_override
+
+# ---------------------------------------------------------------------------
+# reductions / shape manipulation (_np_* namespace)
+# ---------------------------------------------------------------------------
+
+
+def _ax(axis):
+    return tuple(axis) if isinstance(axis, (tuple, list)) else axis
+
+
+register("_np_all")(lambda data, axis=None, keepdims=False:
+                    jnp.all(data, axis=_ax(axis), keepdims=keepdims))
+register("_np_any")(lambda data, axis=None, keepdims=False:
+                    jnp.any(data, axis=_ax(axis), keepdims=keepdims))
+register("_np_sum")(lambda a, axis=None, dtype=None, keepdims=False:
+                    jnp.sum(a, axis=_ax(axis), keepdims=keepdims))
+register("_np_max")(lambda a, axis=None, keepdims=False:
+                    jnp.max(a, axis=_ax(axis), keepdims=keepdims))
+register("_np_min")(lambda a, axis=None, keepdims=False:
+                    jnp.min(a, axis=_ax(axis), keepdims=keepdims))
+register("_np_prod")(lambda a, axis=None, dtype=None, keepdims=False:
+                     jnp.prod(a, axis=_ax(axis), keepdims=keepdims))
+register("_npi_mean")(lambda a, axis=None, dtype=None, keepdims=False:
+                      jnp.mean(a, axis=_ax(axis), keepdims=keepdims))
+register("_npi_std")(lambda a, axis=None, ddof=0, keepdims=False:
+                     jnp.std(a, axis=_ax(axis), ddof=ddof,
+                             keepdims=keepdims))
+register("_npi_var")(lambda a, axis=None, ddof=0, keepdims=False:
+                     jnp.var(a, axis=_ax(axis), ddof=ddof,
+                             keepdims=keepdims))
+register("_np_cumsum")(lambda a, axis=None, dtype=None:
+                       jnp.cumsum(a, axis=axis))
+register("_np_copy")(lambda a: a + 0)
+register("_np_reshape")(lambda a, newshape=(), order="C":
+                        jnp.reshape(a, tuple(newshape)))
+register("_npx_reshape")(lambda a, newshape=(), reverse=False:
+                         jnp.reshape(a, tuple(newshape)))
+register("_np_squeeze")(lambda a, axis=None: jnp.squeeze(a, axis=_ax(axis)))
+register("_np_transpose")(lambda a, axes=None:
+                          jnp.transpose(a, _ax(axes)))
+register("_np_moveaxis")(lambda a, source=0, destination=0:
+                         jnp.moveaxis(a, _ax(source), _ax(destination)))
+register("_np_roll")(lambda a, shift=0, axis=None:
+                     jnp.roll(a, _ax(shift) if isinstance(shift, (tuple, list))
+                              else shift, axis=_ax(axis)))
+register("_npi_rot90")(lambda a, k=1, axes=(0, 1):
+                       jnp.rot90(a, k=k, axes=tuple(axes)))
+register("_npi_flip")(lambda a, axis=None: jnp.flip(a, axis=_ax(axis)))
+register("_np_diag")(lambda a, k=0: jnp.diag(a, k=k))
+register("_np_diagflat")(lambda a, k=0: jnp.diagflat(a, k=k))
+register("_np_diagonal")(lambda a, offset=0, axis1=0, axis2=1:
+                         jnp.diagonal(a, offset=offset, axis1=axis1,
+                                      axis2=axis2))
+register("_np_trace")(lambda a, offset=0, axis1=0, axis2=1:
+                      jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2))
+register("_npi_tril")(lambda a, k=0: jnp.tril(a, k=k))
+register("_np_dot")(lambda a, b: jnp.dot(a, b))
+register("_npi_broadcast_to")(lambda a, shape=():
+                              jnp.broadcast_to(a, tuple(shape)))
+register("_npi_share_memory")(lambda a, b: jnp.zeros((1,), jnp.bool_))
+
+
+@register("_np_atleast_1d", num_outputs=-1)
+def _np_atleast_1d(*arys):
+    return tuple(jnp.atleast_1d(a) for a in arys)
+
+
+@register("_np_atleast_2d", num_outputs=-1)
+def _np_atleast_2d(*arys):
+    return tuple(jnp.atleast_2d(a) for a in arys)
+
+
+@register("_np_atleast_3d", num_outputs=-1)
+def _np_atleast_3d(*arys):
+    return tuple(jnp.atleast_3d(a) for a in arys)
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary (+ scalar / reflected-scalar variants)
+# ---------------------------------------------------------------------------
+
+
+def _binary(name, jfn):
+    register(name)(lambda lhs, rhs: jfn(lhs, rhs))
+    register(name + "_scalar")(
+        lambda data, scalar=0.0, is_int=False: jfn(
+            data, jnp.asarray(scalar, data.dtype)))
+
+
+def _rbinary(name, jfn):
+    register(name)(lambda data, scalar=0.0, is_int=False: jfn(
+        jnp.asarray(scalar, data.dtype), data))
+
+
+_binary("_npi_add", jnp.add)
+_binary("_npi_subtract", jnp.subtract)
+_rbinary("_npi_rsubtract_scalar", jnp.subtract)
+_binary("_npi_multiply", jnp.multiply)
+_binary("_npi_mod", lambda a, b: jnp.mod(a, b))
+_rbinary("_npi_rmod_scalar", jnp.mod)
+_binary("_npi_power", jnp.power)
+_rbinary("_npi_rpower_scalar", jnp.power)
+_binary("_npi_copysign", jnp.copysign)
+_rbinary("_npi_rcopysign_scalar", jnp.copysign)
+_binary("_npi_arctan2", jnp.arctan2)
+_rbinary("_npi_rarctan2_scalar", jnp.arctan2)
+_binary("_npi_lcm", lambda a, b: jnp.lcm(a.astype(jnp.int32),
+                                         jnp.asarray(b, jnp.int32)))
+_binary("_npi_ldexp", lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)))
+_rbinary("_npi_rldexp_scalar", lambda a, b: jnp.ldexp(
+    a, b.astype(jnp.int32)))
+_binary("_npi_bitwise_or", lambda a, b: jnp.bitwise_or(
+    a.astype(jnp.int32), jnp.asarray(b, jnp.int32)))
+_binary("_npi_bitwise_xor", lambda a, b: jnp.bitwise_xor(
+    a.astype(jnp.int32), jnp.asarray(b, jnp.int32)))
+register("_npi_bitwise_not")(lambda data: jnp.bitwise_not(
+    data.astype(jnp.int32)))
+
+
+@register("_npi_true_divide")
+def _npi_true_divide(lhs, rhs):
+    out = jnp.true_divide(lhs, rhs)
+    return out.astype(jnp.float32) if jnp.issubdtype(
+        out.dtype, jnp.integer) else out
+
+
+register("_npi_true_divide_scalar")(
+    lambda data, scalar=1.0, is_int=False:
+    jnp.true_divide(data, scalar).astype(
+        jnp.float32 if jnp.issubdtype(data.dtype, jnp.integer)
+        else data.dtype))
+register("_npi_rtrue_divide_scalar")(
+    lambda data, scalar=1.0, is_int=False:
+    jnp.true_divide(jnp.asarray(scalar), data).astype(
+        jnp.float32 if jnp.issubdtype(data.dtype, jnp.integer)
+        else data.dtype))
+register("_npi_hypot")(lambda x1, x2: jnp.hypot(x1, x2))
+register("_npi_log")(lambda data: jnp.log(data))
+register("_npi_logical_not")(lambda data: jnp.logical_not(data))
+register("_npi_deg2rad")(lambda data: jnp.deg2rad(data))
+register("_npi_rad2deg")(lambda data: jnp.rad2deg(data))
+register("_npi_around")(lambda data, decimals=0:
+                        jnp.around(data, decimals=decimals))
+register("_npi_nan_to_num", aliases=("_npi_backward_nan_to_num",))(
+    lambda data, copy=True, nan=0.0, posinf=None, neginf=None:
+    jnp.nan_to_num(data, nan=nan, posinf=posinf, neginf=neginf))
+register("_npx_relu")(lambda data: jnp.maximum(data, 0))
+register("_npx_sigmoid")(lambda data: jax.nn.sigmoid(data))
+
+
+@register("_npx_constraint_check")
+def _constraint_check(data, msg="constraint violated"):
+    # reference raises on violation at wait time; value semantics: all()
+    return jnp.all(data)
+
+
+register("_npi_argmax")(lambda data, axis=None, keepdims=False:
+                        jnp.argmax(data, axis=axis, keepdims=keepdims))
+register("_npi_argmin")(lambda data, axis=None, keepdims=False:
+                        jnp.argmin(data, axis=axis, keepdims=keepdims))
+
+
+@register("_npi_average", num_outputs=2,
+          inputs=("a", "weights"))
+def _npi_average(a, weights=None, axis=None, returned=False):
+    if weights is None:
+        avg = jnp.mean(a, axis=_ax(axis))
+        cnt = jnp.asarray(a.size / avg.size, avg.dtype)
+        return avg, jnp.broadcast_to(cnt, avg.shape)
+    w = weights
+    num = jnp.sum(a * w, axis=_ax(axis))
+    den = jnp.sum(jnp.broadcast_to(w, a.shape), axis=_ax(axis))
+    return num / den, den
+
+
+def _bincount_override(inputs, attrs, out):
+    import numpy as onp
+
+    data = inputs[0].asnumpy().astype(onp.int64).reshape(-1)
+    w = inputs[1].asnumpy().reshape(-1) if len(inputs) > 1 else None
+    res = onp.bincount(data, weights=w,
+                       minlength=int(attrs.get("minlength", 0) or 0))
+    return inputs[0]._op_result_cls(jnp.asarray(res))
+
+
+# output length is max(data)+1 — data-dependent, so host path like unique
+register("_npi_bincount")(
+    lambda data, weights=None, minlength=0: data)
+register_invoke_override("_npi_bincount", _bincount_override)
+
+
+@register("_npi_diff")
+def _npi_diff(a, n=1, axis=-1):
+    return jnp.diff(a, n=int(n), axis=axis)
+
+
+# windows
+register("_npi_blackman")(lambda M=1, dtype="float32":
+                          jnp.blackman(int(M)).astype(jnp.dtype(dtype)))
+register("_npi_hamming")(lambda M=1, dtype="float32":
+                         jnp.hamming(int(M)).astype(jnp.dtype(dtype)))
+register("_npi_hanning")(lambda M=1, dtype="float32":
+                         jnp.hanning(int(M)).astype(jnp.dtype(dtype)))
+
+# creation
+register("_npi_zeros")(lambda shape=(), dtype="float32", ctx=None:
+                       jnp.zeros(tuple(shape), jnp.dtype(dtype)))
+register("_npi_ones")(lambda shape=(), dtype="float32", ctx=None:
+                      jnp.ones(tuple(shape), jnp.dtype(dtype)))
+register("_npi_identity")(lambda shape=(), dtype="float32", ctx=None:
+                          jnp.eye(int(shape[0]) if isinstance(
+                              shape, (tuple, list)) else int(shape),
+                              dtype=jnp.dtype(dtype)))
+register("_npi_eye")(lambda N=1, M=None, k=0, dtype="float32", ctx=None:
+                     jnp.eye(int(N), int(M) if M else None, int(k),
+                             dtype=jnp.dtype(dtype)))
+register("_npi_arange")(
+    lambda start=0.0, stop=None, step=1.0, dtype="float32", ctx=None:
+    jnp.arange(start, stop, step, dtype=jnp.dtype(dtype)))
+register("_npi_logspace")(
+    lambda start=0.0, stop=1.0, num=50, endpoint=True, base=10.0,
+    dtype="float32", ctx=None:
+    jnp.logspace(start, stop, int(num), endpoint, base,
+                 dtype=jnp.dtype(dtype)))
+register("_npi_indices")(
+    lambda dimensions=(), dtype="int32", ctx=None:
+    jnp.stack(jnp.meshgrid(*[jnp.arange(d) for d in dimensions],
+                           indexing="ij")).astype(jnp.dtype(dtype)))
+register("_npi_full_like")(
+    lambda a, fill_value=0.0, dtype=None, ctx=None:
+    jnp.full_like(a, fill_value,
+                  dtype=jnp.dtype(dtype) if dtype else None))
+
+# stacking
+register("_npi_concatenate")(
+    lambda *data, axis=0, dim=None, num_args=1:
+    jnp.concatenate(data, axis=int(dim if dim is not None else axis)))
+register("_npi_stack")(lambda *data, axis=0, num_args=1:
+                       jnp.stack(data, axis=axis))
+register("_npi_vstack")(lambda *data, num_args=1: jnp.vstack(data))
+register("_npi_hstack")(lambda *data, num_args=1: jnp.hstack(data))
+register("_npi_dstack")(lambda *data, num_args=1: jnp.dstack(data))
+register("_npi_column_stack")(lambda *data, num_args=1:
+                              jnp.column_stack(data))
+
+
+@register("_npi_hsplit", num_outputs=-1,
+          aliases=("_npi_hsplit_backward",))
+def _npi_hsplit(data, indices=None, axis=1, squeeze_axis=False,
+                sections=0):
+    n = int(sections) if sections else len(indices) + 1
+    if sections:
+        return tuple(jnp.split(data, int(sections),
+                               axis=1 if data.ndim > 1 else 0))
+    return tuple(jnp.split(data, list(indices),
+                           axis=1 if data.ndim > 1 else 0))
+
+
+@register("_npi_where")
+def _npi_where(condition, x, y):
+    return jnp.where(condition.astype(jnp.bool_), x, y)
+
+
+@register("_npi_boolean_mask_assign_scalar")
+def _npi_boolean_mask_assign_scalar(data, mask, value=0.0):
+    return jnp.where(mask.astype(jnp.bool_), jnp.asarray(value, data.dtype),
+                     data)
+
+
+@register("_npi_boolean_mask_assign_tensor")
+def _npi_boolean_mask_assign_tensor(data, mask, value):
+    return jnp.where(mask.astype(jnp.bool_), value, data)
+
+
+# linalg (_npi namespace; the heavier set lives in tensor.py _linalg_*)
+register("_npi_cholesky")(lambda a: jnp.linalg.cholesky(a))
+register("_npi_solve")(lambda a, b: jnp.linalg.solve(a, b))
+register("_npi_pinv")(lambda a, rcond=None:
+                      jnp.linalg.pinv(a, rcond=rcond))
+register("_npi_pinv_scalar_rcond")(
+    lambda a, rcond=1e-15: jnp.linalg.pinv(a, rcond=float(rcond)))
+
+
+@register("_npi_svd", num_outputs=3)
+def _npi_svd(a):
+    u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+    return u, s, vt
+
+
+register("_npi_tensorinv")(lambda a, ind=2: jnp.linalg.tensorinv(a, ind=ind))
+register("_npi_tensorsolve")(
+    lambda a, b, a_axes=None: jnp.linalg.tensorsolve(a, b))
+
+
+@register("_npi_tensordot")
+def _npi_tensordot(a, b, a_axes_summed=(), b_axes_summed=()):
+    return jnp.tensordot(a, b, axes=(tuple(a_axes_summed),
+                                     tuple(b_axes_summed)))
+
+
+register("_npi_tensordot_int_axes")(
+    lambda a, b, axes=2: jnp.tensordot(a, b, axes=int(axes)))
+
+# random (_npi namespace; threefry key prepended by the dispatcher)
+register("_npi_uniform", needs_rng=True, aliases=("_npi_uniform_n",))(
+    lambda key, low=0.0, high=1.0, size=(), ctx=None, dtype="float32":
+    jax.random.uniform(key, tuple(size) if size else (),
+                       jnp.dtype(dtype), low, high))
+register("_npi_normal", needs_rng=True, aliases=("_npi_normal_n",))(
+    lambda key, loc=0.0, scale=1.0, size=(), ctx=None, dtype="float32":
+    loc + scale * jax.random.normal(key, tuple(size) if size else (),
+                                    jnp.dtype(dtype)))
+register("_npi_bernoulli", needs_rng=True)(
+    lambda key, prob=0.5, logit=None, size=(), ctx=None, dtype="float32",
+    is_logit=False:
+    jax.random.bernoulli(
+        key, jax.nn.sigmoid(jnp.asarray(logit)) if is_logit else prob,
+        tuple(size) if size else ()).astype(jnp.dtype(dtype)))
+register("_npi_exponential", needs_rng=True)(
+    lambda key, scale=1.0, size=(), ctx=None:
+    scale * jax.random.exponential(key, tuple(size) if size else ()))
+register("_npi_gamma", needs_rng=True)(
+    lambda key, shape=1.0, scale=1.0, size=(), ctx=None, dtype="float32":
+    scale * jax.random.gamma(key, shape, tuple(size) if size else (),
+                             jnp.dtype(dtype)))
+register("_npi_choice", needs_rng=True)(
+    lambda key, a=1, size=(), replace=True, weights=None, ctx=None:
+    jax.random.choice(key, int(a), tuple(size) if size else (),
+                      replace=bool(replace)).astype(jnp.int64))
+@register("_npi_multinomial", needs_rng=True, inputs=("data",))
+def _npi_multinomial(key, data, n=1, pvals=None, size=(), ctx=None):
+    """np.random.multinomial semantics: ``n`` draws per experiment,
+    returning per-category counts of shape size + (k,)."""
+    k = data.shape[-1]
+    out_shape = tuple(size) if size else ()
+    draws = jax.random.categorical(
+        key, jnp.log(jnp.maximum(data, 1e-30)),
+        shape=(int(n),) + out_shape)
+    return jax.nn.one_hot(draws, k, dtype=jnp.int64).sum(axis=0)
+register("_sample_poisson", needs_rng=True)(
+    lambda key, lam, shape=(): jax.random.poisson(
+        key, lam, shape=tuple(shape) + lam.shape if shape
+        else lam.shape).astype(jnp.float32))
+register("_sample_exponential", needs_rng=True)(
+    lambda key, lam, shape=(): (1.0 / lam) * jax.random.exponential(
+        key, tuple(shape) + lam.shape if shape else lam.shape))
+
+
+@register("_sample_negative_binomial", needs_rng=True)
+def _sample_negative_binomial(key, k, p, shape=()):
+    out_shape = (tuple(shape) + k.shape) if shape else k.shape
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, jnp.broadcast_to(k, out_shape)) \
+        * (1 - p) / jnp.maximum(p, 1e-12)
+    return jax.random.poisson(k2, lam).astype(jnp.float32)
+
+
+@register("_sample_generalized_negative_binomial", needs_rng=True)
+def _sample_gnb(key, mu, alpha, shape=()):
+    out_shape = (tuple(shape) + mu.shape) if shape else mu.shape
+    k1, k2 = jax.random.split(key)
+    a = 1.0 / jnp.maximum(alpha, 1e-12)
+    lam = jax.random.gamma(k1, jnp.broadcast_to(a, out_shape)) \
+        * jnp.broadcast_to(mu, out_shape) / a
+    return jax.random.poisson(k2, lam).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# dynamic-output-shape ops: imperative override (host round-trip), like the
+# reference's dynamic-shape ops (np_unique_op.cc syncs to CPU too)
+# ---------------------------------------------------------------------------
+
+
+def _unique_override(inputs, attrs, out):
+    import numpy as onp
+    from ..ndarray.ndarray import NDArray
+
+    data = inputs[0].asnumpy()
+    ret = onp.unique(
+        data,
+        return_index=bool(attrs.get("return_index", False)),
+        return_inverse=bool(attrs.get("return_inverse", False)),
+        return_counts=bool(attrs.get("return_counts", False)),
+        axis=attrs.get("axis", None))
+    cls = inputs[0]._op_result_cls
+    if isinstance(ret, tuple):
+        return [cls(jnp.asarray(r)) for r in ret]
+    return cls(jnp.asarray(ret))
+
+
+def _nonzero_override(inputs, attrs, out):
+    import numpy as onp
+
+    data = inputs[0].asnumpy()
+    idx = onp.stack(onp.nonzero(data), axis=-1).astype(onp.int64)
+    return inputs[0]._op_result_cls(jnp.asarray(idx))
+
+
+def _delete_override(inputs, attrs, out):
+    import numpy as onp
+
+    data = inputs[0].asnumpy()
+    if len(inputs) > 1:
+        obj = inputs[1].asnumpy().astype(onp.int64)
+    else:
+        start = attrs.get("start", None)
+        if start is not None:
+            obj = slice(int(start), int(attrs.get("stop", 0)),
+                        int(attrs.get("step", 1)))
+        else:
+            obj = int(attrs.get("int_ind", 0))
+    res = onp.delete(data, obj, axis=attrs.get("axis", None))
+    return inputs[0]._op_result_cls(jnp.asarray(res))
+
+
+register("_npi_unique")(lambda data, return_index=False,
+                        return_inverse=False, return_counts=False,
+                        axis=None: data)
+register("_npx_nonzero")(lambda data: data)
+register("_npi_delete")(lambda data, obj=None, start=None, stop=None,
+                        step=None, int_ind=None, axis=None: data)
+register_invoke_override("_npi_unique", _unique_override)
+register_invoke_override("_npx_nonzero", _nonzero_override)
+register_invoke_override("_npi_delete", _delete_override)

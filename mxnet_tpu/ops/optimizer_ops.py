@@ -175,3 +175,409 @@ def _lamb_update_phase2(weight, g, r1, r2, lr=0.01, lower_bound=-1.0,
 def _multi_sum_sq(*arrays, num_arrays=1):
     """Parity: src/operator/contrib/multi_sum_sq.cc (used by LARS/LAMB)."""
     return tuple(jnp.sum(jnp.square(a.astype(jnp.float32))) for a in arrays)
+
+
+# ---------------------------------------------------------------------------
+# FTML (reference: FTMLKernel, src/operator/optimizer_op-inl.h:1205)
+# ---------------------------------------------------------------------------
+
+@register("ftml_update", num_outputs=4)
+def _ftml_update(weight, grad, d, v, z, lr=0.1, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
+                 clip_grad=-1.0):
+    g = rescale_grad * grad + wd * weight
+    if clip_grad >= 0:
+        g = jnp.clip(g, -clip_grad, clip_grad)
+    new_v = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_t = (1 - beta1 ** t) / lr * (jnp.sqrt(new_v / (1 - beta2 ** t))
+                                   + epsilon)
+    new_z = beta1 * z + (1 - beta1) * g - (d_t - beta1 * d) * weight
+    return -new_z / d_t, d_t, new_v, new_z
+
+
+# ---------------------------------------------------------------------------
+# Mixed-precision single-tensor updates: bf16/fp16 weight + f32 master copy
+# (reference: MP_SGD kernels, src/operator/optimizer_op-inl.h).  Functional
+# deviation: the updated master weight is returned instead of written
+# in place.
+# ---------------------------------------------------------------------------
+
+def _rescale_clip(grad, rescale_grad, clip_gradient):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+@register("mp_sgd_update", num_outputs=2)
+def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, lazy_update=True):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    w32 = weight32 - lr * (g + wd * weight32)
+    return w32.astype(weight.dtype), w32
+
+
+@register("mp_sgd_mom_update", num_outputs=3)
+def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                       lazy_update=True):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight32)
+    w32 = weight32 + new_mom
+    return w32.astype(weight.dtype), new_mom, w32
+
+
+@register("mp_nag_mom_update", num_outputs=3)
+def _mp_nag_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    g = g + wd * weight32
+    new_mom = momentum * mom + g
+    w32 = weight32 - lr * (g + momentum * new_mom)
+    return w32.astype(weight.dtype), new_mom, w32
+
+
+@register("_adamw_update", num_outputs=3, inputs=("weight", "grad", "mean",
+                                                  "var", "rescale_grad"))
+def _adamw_update_op(weight, grad, mean, var, rescale_grad, lr=0.001,
+                     beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                     clip_gradient=-1.0):
+    """AdamW with the grad rescale as a device scalar (so a dynamic loss
+    scale never forces a re-jit).  Parity: src/operator/contrib/adamw.cc."""
+    g = grad * jnp.reshape(rescale_grad, ())
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - eta * (lr * m / (jnp.sqrt(v) + epsilon) + wd * weight)
+    return w, m, v
+
+
+@register("_mp_adamw_update", num_outputs=4,
+          inputs=("weight", "grad", "mean", "var", "weight32",
+                  "rescale_grad"))
+def _mp_adamw_update_op(weight, grad, mean, var, weight32, rescale_grad,
+                        lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                        wd=0.0, eta=1.0, clip_gradient=-1.0):
+    g = grad.astype(jnp.float32) * jnp.reshape(rescale_grad, ())
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    w32 = weight32 - eta * (lr * m / (jnp.sqrt(v) + epsilon) + wd * weight32)
+    return w32.astype(weight.dtype), m, v, w32
+
+
+# ---------------------------------------------------------------------------
+# Multi-tensor updates (reference: MultiSGD*, src/operator/optimizer_op.cc;
+# preloaded_* take lrs/wds as device tensors).  One jitted XLA computation
+# updates every tensor — the fusion the reference needed hand-written CUDA
+# kernels for.  Outputs: updated weights for each tensor, then updated
+# state tensors (reference updates states in place).
+# ---------------------------------------------------------------------------
+
+def _multi_sgd_core(arrays, stride, lrs, wds, momentum, rescale_grad,
+                    clip_gradient, has_mom, has_mp):
+    n = len(arrays) // stride
+    ws, moms, w32s = [], [], []
+    for i in range(n):
+        grp = arrays[i * stride:(i + 1) * stride]
+        w, g = grp[0], grp[1]
+        mom = grp[2] if has_mom else None
+        w32 = grp[-1] if has_mp else w
+        lr, wd = lrs[i], wds[i]  # floats (attrs) or device scalars (preloaded)
+        gg = _rescale_clip(g, rescale_grad, clip_gradient) \
+            if has_mp else g * rescale_grad
+        if not has_mp and clip_gradient >= 0:
+            gg = jnp.clip(gg, -clip_gradient, clip_gradient)
+        if has_mom:
+            new_mom = momentum * mom - lr * (gg + wd * w32)
+            new_w32 = w32 + new_mom
+            moms.append(new_mom)
+        else:
+            new_w32 = w32 - lr * (gg + wd * w32)
+        if has_mp:
+            ws.append(new_w32.astype(w.dtype))
+            w32s.append(new_w32)
+        else:
+            ws.append(new_w32)
+    return tuple(ws) + tuple(moms) + tuple(w32s)
+
+
+@register("multi_sgd_update", num_outputs=-1)
+def _multi_sgd_update(*arrays, lrs=(), wds=(), rescale_grad=1.0,
+                      clip_gradient=-1.0, num_weights=1):
+    return _multi_sgd_core(arrays, 2, lrs, wds, 0.0, rescale_grad,
+                           clip_gradient, False, False)
+
+
+@register("multi_sgd_mom_update", num_outputs=-1)
+def _multi_sgd_mom_update(*arrays, lrs=(), wds=(), momentum=0.0,
+                          rescale_grad=1.0, clip_gradient=-1.0,
+                          num_weights=1):
+    return _multi_sgd_core(arrays, 3, lrs, wds, momentum, rescale_grad,
+                           clip_gradient, True, False)
+
+
+@register("multi_mp_sgd_update", num_outputs=-1)
+def _multi_mp_sgd_update(*arrays, lrs=(), wds=(), rescale_grad=1.0,
+                         clip_gradient=-1.0, num_weights=1):
+    return _multi_sgd_core(arrays, 3, lrs, wds, 0.0, rescale_grad,
+                           clip_gradient, False, True)
+
+
+@register("multi_mp_sgd_mom_update", num_outputs=-1)
+def _multi_mp_sgd_mom_update(*arrays, lrs=(), wds=(), momentum=0.0,
+                             rescale_grad=1.0, clip_gradient=-1.0,
+                             num_weights=1):
+    return _multi_sgd_core(arrays, 4, lrs, wds, momentum, rescale_grad,
+                           clip_gradient, True, True)
+
+
+def _preloaded_core(arrays, stride, momentum, rescale_grad, clip_gradient,
+                    has_mom, has_mp):
+    lrs_t, wds_t = arrays[-2], arrays[-1]
+    body = arrays[:-2]
+    n = len(body) // stride
+    lrs = [lrs_t[i] for i in range(n)]
+    wds = [wds_t[i] for i in range(n)]
+    return _multi_sgd_core(body, stride, lrs, wds, momentum, rescale_grad,
+                           clip_gradient, has_mom, has_mp)
+
+
+@register("preloaded_multi_sgd_update", num_outputs=-1)
+def _preloaded_multi_sgd_update(*arrays, rescale_grad=1.0,
+                                clip_gradient=-1.0, num_weights=1):
+    return _preloaded_core(arrays, 2, 0.0, rescale_grad, clip_gradient,
+                           False, False)
+
+
+@register("preloaded_multi_sgd_mom_update", num_outputs=-1)
+def _preloaded_multi_sgd_mom_update(*arrays, momentum=0.0, rescale_grad=1.0,
+                                    clip_gradient=-1.0, num_weights=1):
+    return _preloaded_core(arrays, 3, momentum, rescale_grad, clip_gradient,
+                           True, False)
+
+
+@register("preloaded_multi_mp_sgd_update", num_outputs=-1)
+def _preloaded_multi_mp_sgd_update(*arrays, rescale_grad=1.0,
+                                   clip_gradient=-1.0, num_weights=1):
+    return _preloaded_core(arrays, 3, 0.0, rescale_grad, clip_gradient,
+                           False, True)
+
+
+@register("preloaded_multi_mp_sgd_mom_update", num_outputs=-1)
+def _preloaded_multi_mp_sgd_mom_update(*arrays, momentum=0.0,
+                                       rescale_grad=1.0, clip_gradient=-1.0,
+                                       num_weights=1):
+    return _preloaded_core(arrays, 4, momentum, rescale_grad, clip_gradient,
+                           True, True)
+
+
+@register("multi_lars")
+def _multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
+                eps=1e-8, rescale_grad=1.0):
+    """LARS coefficients from per-tensor norms (parity:
+    src/operator/contrib/multi_lars-inl.h MultiLARSKernel)."""
+    w_norm = jnp.sqrt(weights_sum_sq)
+    valid = jnp.logical_and(w_norm > 0, grads_sum_sq > 0)
+    lars = lrs * eta * w_norm / (jnp.sqrt(grads_sum_sq) * rescale_grad
+                                 + wds * w_norm + eps)
+    return jnp.where(valid, lars, lrs)
+
+
+@register("mp_lamb_update_phase1")
+def _mp_lamb_update_phase1(weight, grad, mean, var, weight32, beta1=0.9,
+                           beta2=0.999, epsilon=1e-6, t=1,
+                           bias_correction=True, wd=0.0, rescale_grad=1.0,
+                           clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    if bias_correction:
+        mh, vh = m / (1 - beta1 ** t), v / (1 - beta2 ** t)
+    else:
+        mh, vh = m, v
+    return mh / (jnp.sqrt(vh) + epsilon) + wd * weight32
+
+
+@register("mp_lamb_update_phase2", num_outputs=2)
+def _mp_lamb_update_phase2(weight, g, r1, r2, weight32, lr=0.01,
+                           lower_bound=-1.0, upper_bound=-1.0):
+    r1c = r1
+    if lower_bound >= 0:
+        r1c = jnp.maximum(r1c, lower_bound)
+    if upper_bound >= 0:
+        r1c = jnp.minimum(r1c, upper_bound)
+    ratio = jnp.where(jnp.logical_and(r1c > 0, r2 > 0), r1c / r2,
+                      jnp.ones_like(r1c))
+    w32 = weight32 - lr * ratio * g
+    return w32.astype(weight.dtype), w32
+
+
+def _lamb_step(w32, g, m, v, lr, wd, beta1, beta2, epsilon, step,
+               bias_correction, lower_bound, upper_bound):
+    new_m = beta1 * m + (1 - beta1) * g
+    new_v = beta2 * v + (1 - beta2) * jnp.square(g)
+    if bias_correction:
+        mh, vh = new_m / (1 - beta1 ** step), new_v / (1 - beta2 ** step)
+    else:
+        mh, vh = new_m, new_v
+    upd = mh / (jnp.sqrt(vh) + epsilon) + wd * w32
+    r1 = jnp.sqrt(jnp.sum(jnp.square(w32)))
+    if lower_bound >= 0:
+        r1 = jnp.maximum(r1, lower_bound)
+    if upper_bound >= 0:
+        r1 = jnp.minimum(r1, upper_bound)
+    r2 = jnp.sqrt(jnp.sum(jnp.square(upd)))
+    ratio = jnp.where(jnp.logical_and(r1 > 0, r2 > 0), r1 / r2, 1.0)
+    return w32 - lr * ratio * upd, new_m, new_v
+
+
+@register("_multi_lamb_update", num_outputs=-1)
+def _multi_lamb_update(*arrays, learning_rates=(), wds=(), step_count=(),
+                       beta1=0.9, beta2=0.999, epsilon=1e-6,
+                       rescale_grad=1.0, lower_bound=-1.0, upper_bound=-1.0,
+                       clip_gradient=-1.0, bias_correction=True,
+                       num_tensors=1):
+    """Fused LAMB over a tensor list (parity:
+    src/operator/contrib/multi_lamb.cc) — one XLA computation, no
+    hand-written multi-tensor CUDA kernel needed."""
+    n = len(arrays) // 4
+    outs, ms, vs = [], [], []
+    for i in range(n):
+        w, g, m, v = arrays[i * 4:(i + 1) * 4]
+        gg = _rescale_clip(g, rescale_grad, clip_gradient)
+        w2, m2, v2 = _lamb_step(w, gg, m, v, float(learning_rates[i]),
+                                float(wds[i]), beta1, beta2, epsilon,
+                                int(step_count[i]), bias_correction,
+                                lower_bound, upper_bound)
+        outs.append(w2), ms.append(m2), vs.append(v2)
+    return tuple(outs) + tuple(ms) + tuple(vs)
+
+
+@register("_multi_mp_lamb_update", num_outputs=-1)
+def _multi_mp_lamb_update(*arrays, learning_rates=(), wds=(), step_count=(),
+                          beta1=0.9, beta2=0.999, epsilon=1e-6,
+                          rescale_grad=1.0, lower_bound=-1.0,
+                          upper_bound=-1.0, clip_gradient=-1.0,
+                          bias_correction=True, num_tensors=1):
+    n = len(arrays) // 5
+    outs, ms, vs, w32s = [], [], [], []
+    for i in range(n):
+        w, g, m, v, w32 = arrays[i * 5:(i + 1) * 5]
+        gg = _rescale_clip(g, rescale_grad, clip_gradient)
+        w2, m2, v2 = _lamb_step(w32, gg, m, v, float(learning_rates[i]),
+                                float(wds[i]), beta1, beta2, epsilon,
+                                int(step_count[i]), bias_correction,
+                                lower_bound, upper_bound)
+        outs.append(w2.astype(w.dtype))
+        ms.append(m2), vs.append(v2), w32s.append(w2)
+    return tuple(outs) + tuple(ms) + tuple(vs) + tuple(w32s)
+
+
+@register("_multi_adamw_update", num_outputs=-1)
+def _multi_adamw_update(*arrays, lrs=(), wds=(), etas=(), beta1=0.9,
+                        beta2=0.999, epsilon=1e-8, clip_gradient=-1.0,
+                        num_weights=1):
+    """Fused AdamW over a tensor list; last input is the device-scalar grad
+    rescale (parity: src/operator/contrib/adamw.cc)."""
+    scale = jnp.reshape(arrays[-1], ())
+    body = arrays[:-1]
+    n = len(body) // 4
+    outs, ms, vs = [], [], []
+    for i in range(n):
+        w, g, m, v = body[i * 4:(i + 1) * 4]
+        gg = g * scale
+        if clip_gradient >= 0:
+            gg = jnp.clip(gg, -clip_gradient, clip_gradient)
+        m2 = beta1 * m + (1 - beta1) * gg
+        v2 = beta2 * v + (1 - beta2) * jnp.square(gg)
+        w2 = w - float(etas[i]) * (float(lrs[i]) * m2
+                                   / (jnp.sqrt(v2) + epsilon)
+                                   + float(wds[i]) * w)
+        outs.append(w2), ms.append(m2), vs.append(v2)
+    return tuple(outs) + tuple(ms) + tuple(vs)
+
+
+@register("_multi_mp_adamw_update", num_outputs=-1)
+def _multi_mp_adamw_update(*arrays, lrs=(), wds=(), etas=(), beta1=0.9,
+                           beta2=0.999, epsilon=1e-8, clip_gradient=-1.0,
+                           num_weights=1):
+    scale = jnp.reshape(arrays[-1], ())
+    body = arrays[:-1]
+    n = len(body) // 5
+    outs, ms, vs, w32s = [], [], [], []
+    for i in range(n):
+        w, g, m, v, w32 = body[i * 5:(i + 1) * 5]
+        gg = g.astype(jnp.float32) * scale
+        if clip_gradient >= 0:
+            gg = jnp.clip(gg, -clip_gradient, clip_gradient)
+        m2 = beta1 * m + (1 - beta1) * gg
+        v2 = beta2 * v + (1 - beta2) * jnp.square(gg)
+        w2 = w32 - float(etas[i]) * (float(lrs[i]) * m2
+                                     / (jnp.sqrt(v2) + epsilon)
+                                     + float(wds[i]) * w32)
+        outs.append(w2.astype(w.dtype))
+        ms.append(m2), vs.append(v2), w32s.append(w2)
+    return tuple(outs) + tuple(ms) + tuple(vs) + tuple(w32s)
+
+
+# ---------------------------------------------------------------------------
+# AdaGrad (dense kernels usable with row-sparse grads through the sparse
+# dispatch layer; reference: _sparse_adagrad_update in optimizer_op.cc and
+# group_adagrad in contrib/optimizer_op-inl.h)
+# ---------------------------------------------------------------------------
+
+@register("_sparse_adagrad_update", num_outputs=2)
+def _sparse_adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7,
+                           wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_hist = history + jnp.square(g)
+    w = weight - lr * (g / (jnp.sqrt(new_hist) + epsilon) + wd * weight)
+    return w, new_hist
+
+
+@register("_contrib_group_adagrad_update", num_outputs=2)
+def _group_adagrad_update(weight, grad, history, lr=0.01, rescale_grad=1.0,
+                          clip_gradient=-1.0, epsilon=1e-5):
+    """Per-row (group) AdaGrad: one shared accumulator per embedding row
+    (parity: GroupAdagradDnsRspKernel)."""
+    g = grad * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    row_axes = tuple(range(1, g.ndim))
+    ssq = jnp.mean(jnp.square(g), axis=row_axes) if g.ndim > 1 \
+        else jnp.square(g)
+    new_hist = history + jnp.reshape(ssq, history.shape)
+    denom = jnp.sqrt(new_hist + epsilon)
+    denom = jnp.reshape(denom, (-1,) + (1,) * (g.ndim - 1))
+    return weight - lr * g / denom, new_hist
+
+
+# ---------------------------------------------------------------------------
+# Gradient hygiene helpers used by AMP/LARS drivers (reference:
+# all_finite.cc, reset_arrays.cc)
+# ---------------------------------------------------------------------------
+
+@register("all_finite")
+def _all_finite(data, init_output=True):
+    """1.0 iff every element is finite.  Functional deviation: with
+    ``init_output=False`` the reference ANDs into the existing output
+    buffer; here the caller ANDs results instead."""
+    return jnp.isfinite(data).all().astype(jnp.float32).reshape((1,))
+
+
+@register("multi_all_finite")
+def _multi_all_finite(*arrays, num_arrays=1, init_output=True):
+    ok = jnp.array(True)
+    for a in arrays:
+        ok = jnp.logical_and(ok, jnp.isfinite(a).all())
+    return ok.astype(jnp.float32).reshape((1,))
+
+
+@register("reset_arrays", num_outputs=-1)
+def _reset_arrays(*arrays, num_arrays=1):
+    return tuple(jnp.zeros_like(a) for a in arrays)

@@ -1,0 +1,268 @@
+"""Quantized (int8) operator family.
+
+Parity: ``src/operator/quantization/*.cc`` — quantize_v2, requantize, and
+the ``_contrib_quantized_*`` compute ops the INT8 graph pass swaps in
+(executed by MKL-DNN/cuDNN in the reference).
+
+TPU-native: int8×int8 contractions run on the MXU with int32 accumulation
+(``preferred_element_type=int32`` on ``dot_general``/``conv``) — the MXU's
+native int8 mode — and elementwise/quantize steps stay in XLA.  Every
+compute op follows the reference's calling convention: quantized tensor
+inputs each carry trailing (min, max) range scalars, and outputs return
+(out, min_out, max_out).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _ranges(min_r, max_r, dtype):
+    """Symmetric-int8 / uint8 scale for a [min, max] float range."""
+    if dtype == jnp.uint8:
+        return 255.0 / jnp.maximum(max_r - min_r, 1e-12), 0.0
+    amax = jnp.maximum(jnp.abs(min_r), jnp.abs(max_r))
+    return 127.0 / jnp.maximum(amax, 1e-12), 0.0
+
+
+@register("_contrib_quantize_v2", num_outputs=3)
+def _quantize_v2(data, out_type="int8", min_calib_range=None,
+                 max_calib_range=None):
+    if min_calib_range is None or max_calib_range is None:
+        min_r = jnp.min(data)
+        max_r = jnp.max(data)
+    else:
+        min_r = jnp.asarray(min_calib_range, jnp.float32)
+        max_r = jnp.asarray(max_calib_range, jnp.float32)
+    if out_type == "uint8":
+        scale = 255.0 / jnp.maximum(max_r - min_r, 1e-12)
+        q = jnp.clip(jnp.round((data - min_r) * scale), 0, 255)
+        return q.astype(jnp.uint8), min_r.reshape(()), max_r.reshape(())
+    amax = jnp.maximum(jnp.abs(min_r), jnp.abs(max_r))
+    scale = 127.0 / jnp.maximum(amax, 1e-12)
+    q = jnp.clip(jnp.round(data * scale), -127, 127)
+    return q.astype(jnp.int8), (-amax).reshape(()), amax.reshape(())
+
+
+@register("_contrib_requantize", num_outputs=3)
+def _requantize(data, min_range, max_range, out_type="int8",
+                min_calib_range=None, max_calib_range=None):
+    """int32 accumulator -> int8 with a new calibrated range."""
+    # float value represented by the int32 accumulator
+    in_scale = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)) \
+        / (2.0 ** 31 - 1)
+    f = data.astype(jnp.float32) * in_scale
+    if min_calib_range is not None and max_calib_range is not None:
+        amax = max(abs(float(min_calib_range)), abs(float(max_calib_range)))
+        amax = jnp.asarray(amax, jnp.float32)
+    else:
+        amax = jnp.maximum(jnp.max(jnp.abs(f)), 1e-12)
+    q = jnp.clip(jnp.round(f * (127.0 / amax)), -127, 127)
+    return q.astype(jnp.int8), -amax, amax
+
+
+def _dequant(q, min_r, max_r):
+    if q.dtype == jnp.uint8:
+        scale = (max_r - min_r) / 255.0
+        return q.astype(jnp.float32) * scale + min_r
+    amax = jnp.maximum(jnp.abs(min_r), jnp.abs(max_r))
+    return q.astype(jnp.float32) * (amax / 127.0)
+
+
+def _int32_out_range(min_a, max_a, min_b, max_b):
+    """Float range represented by the int32 accumulator of an int8×int8
+    contraction (reference: quantization_utils.h
+    GetQuantizedToQuantizedScale)."""
+    sa = jnp.maximum(jnp.abs(min_a), jnp.abs(max_a)) / 127.0
+    sb = jnp.maximum(jnp.abs(min_b), jnp.abs(max_b)) / 127.0
+    out = sa * sb * (2.0 ** 31 - 1)
+    return -out, out
+
+
+@register("_contrib_quantized_fully_connected", num_outputs=3,
+          inputs=("data", "weight", "bias", "min_data", "max_data",
+                  "min_weight", "max_weight", "min_bias", "max_bias"))
+def _quantized_fc(data, weight, bias=None, min_data=None, max_data=None,
+                  min_weight=None, max_weight=None, min_bias=None,
+                  max_bias=None, num_hidden=1, no_bias=False, flatten=True):
+    x = data.reshape(data.shape[0], -1) if flatten else data
+    acc = lax.dot_general(
+        x.astype(jnp.int8), weight.astype(jnp.int8),
+        (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    lo, hi = _int32_out_range(min_data, max_data, min_weight, max_weight)
+    if bias is not None and not no_bias:
+        # rescale int8 bias into the int32 accumulator's scale
+        sb = jnp.maximum(jnp.abs(min_bias), jnp.abs(max_bias)) / 127.0
+        sacc = hi / (2.0 ** 31 - 1)
+        acc = acc + jnp.round(bias.astype(jnp.float32) * sb
+                              / sacc).astype(jnp.int32)
+    return acc, lo, hi
+
+
+@register("_contrib_quantized_conv", num_outputs=3,
+          inputs=("data", "weight", "bias", "min_data", "max_data",
+                  "min_weight", "max_weight", "min_bias", "max_bias"))
+def _quantized_conv(data, weight, bias=None, min_data=None, max_data=None,
+                    min_weight=None, max_weight=None, min_bias=None,
+                    max_bias=None, kernel=(1, 1),
+                    stride=(1, 1), dilate=(1, 1), pad=(0, 0), num_filter=1,
+                    num_group=1, no_bias=False, layout="NCHW"):
+    sh = tuple(int(s) for s in stride) if stride else (1, 1)
+    dl = tuple(int(d) for d in dilate) if dilate else (1, 1)
+    pd = tuple(int(p) for p in pad) if pad else (0, 0)
+    acc = lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8), sh,
+        [(pd[0], pd[0]), (pd[1], pd[1])], rhs_dilation=dl,
+        feature_group_count=int(num_group),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.int32)
+    lo, hi = _int32_out_range(min_data, max_data, min_weight, max_weight)
+    if bias is not None and not no_bias:
+        sb = jnp.maximum(jnp.abs(min_bias), jnp.abs(max_bias)) / 127.0
+        sacc = hi / (2.0 ** 31 - 1)
+        acc = acc + jnp.round(bias.astype(jnp.float32) * sb
+                              / sacc).astype(jnp.int32).reshape(1, -1, 1, 1)
+    return acc, lo, hi
+
+
+@register("_contrib_quantized_pooling", num_outputs=3)
+def _quantized_pooling(data, min_data, max_data, kernel=(1, 1),
+                       stride=(1, 1), pad=(0, 0), pool_type="max",
+                       global_pool=False, pooling_convention="valid"):
+    from .nn import _pooling
+
+    # max/avg pooling commutes with the affine dequantization, so pool the
+    # int values directly (avg in int32 then round back)
+    x = data.astype(jnp.int32)
+    out = _pooling(x.astype(jnp.float32), kernel=kernel, stride=stride,
+                   pad=pad, pool_type=pool_type, global_pool=global_pool,
+                   pooling_convention=pooling_convention)
+    return jnp.round(out).astype(data.dtype), min_data, max_data
+
+
+@register("_contrib_quantized_act", num_outputs=3)
+def _quantized_act(data, min_data, max_data, act_type="relu"):
+    if act_type != "relu":
+        raise NotImplementedError("quantized act: only relu")
+    zero = jnp.zeros((), data.dtype)
+    out = jnp.maximum(data, zero)
+    return out, jnp.maximum(min_data, 0.0), max_data
+
+
+@register("_contrib_quantized_flatten", num_outputs=3)
+def _quantized_flatten(data, min_data, max_data):
+    return data.reshape(data.shape[0], -1), min_data, max_data
+
+
+@register("_contrib_quantized_elemwise_add", num_outputs=3)
+def _quantized_elemwise_add(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
+    f = _dequant(lhs, lhs_min, lhs_max) + _dequant(rhs, rhs_min, rhs_max)
+    amax = jnp.maximum(jnp.max(jnp.abs(f)), 1e-12)
+    q = jnp.clip(jnp.round(f * (127.0 / amax)), -127, 127)
+    return q.astype(jnp.int8), -amax, amax
+
+
+@register("_contrib_quantized_elemwise_mul", num_outputs=3)
+def _quantized_elemwise_mul(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
+    acc = lhs.astype(jnp.int32) * rhs.astype(jnp.int32)
+    sa = jnp.maximum(jnp.abs(lhs_min), jnp.abs(lhs_max)) / 127.0
+    sb = jnp.maximum(jnp.abs(rhs_min), jnp.abs(rhs_max)) / 127.0
+    out = sa * sb * (2.0 ** 31 - 1)
+    return acc, -out, out
+
+
+@register("_contrib_quantized_concat", num_outputs=3)
+def _quantized_concat(*arrays, num_args=1, dim=1):
+    # input layout: [data_0..data_{n-1}, min_0..min_{n-1}, max_0..max_{n-1}]
+    n = len(arrays) // 3
+    qs = arrays[:n]
+    mins = arrays[n:2 * n]
+    maxs = arrays[2 * n:]
+    # requantize every input to the widest range, then concat
+    amax = mins[0] * 0.0
+    for lo, hi in zip(mins, maxs):
+        amax = jnp.maximum(amax, jnp.maximum(jnp.abs(lo), jnp.abs(hi)))
+    outs = []
+    for q, lo, hi in zip(qs, mins, maxs):
+        f = _dequant(q, lo, hi)
+        outs.append(jnp.clip(jnp.round(f * (127.0 / amax)),
+                             -127, 127).astype(jnp.int8))
+    return jnp.concatenate(outs, axis=int(dim)), -amax, amax
+
+
+@register("_contrib_quantized_embedding", num_outputs=3)
+def _quantized_embedding(data, weight, min_weight, max_weight,
+                         input_dim=1, output_dim=1, dtype="float32"):
+    out = jnp.take(weight, data.astype(jnp.int32), axis=0, mode="clip")
+    return out, min_weight, max_weight
+
+
+@register("_contrib_quantized_batch_norm", num_outputs=3,
+          inputs=("data", "gamma", "beta", "moving_mean", "moving_var",
+                  "min_data", "max_data"))
+def _quantized_batch_norm(data, gamma, beta, moving_mean, moving_var,
+                          min_data, max_data, eps=1e-3, momentum=0.9,
+                          fix_gamma=True, use_global_stats=False,
+                          axis=1, min_calib_range=None,
+                          max_calib_range=None):
+    f = _dequant(data, min_data, max_data)
+    g = jnp.ones_like(moving_mean) if fix_gamma else gamma
+    shape = [1] * f.ndim
+    shape[axis] = -1
+    out = ((f - moving_mean.reshape(shape))
+           * (g / jnp.sqrt(moving_var + eps)).reshape(shape)
+           + beta.reshape(shape))
+    if min_calib_range is not None:
+        amax = jnp.asarray(max(abs(float(min_calib_range)),
+                               abs(float(max_calib_range))), jnp.float32)
+    else:
+        amax = jnp.maximum(jnp.max(jnp.abs(out)), 1e-12)
+    q = jnp.clip(jnp.round(out * (127.0 / amax)), -127, 127)
+    return q.astype(jnp.int8), -amax, amax
+
+
+@register("_contrib_calibrate_entropy", num_outputs=2)
+def _calibrate_entropy(hist, hist_edges, num_quantized_bins=255):
+    """KL-entropy calibration threshold from a histogram (reference:
+    quantization/calibrate.cc).  Returns (min, max) of the optimal range.
+
+    The KL search over truncation thresholds is a host-side algorithm in
+    the reference too; here it runs as a small XLA loop over candidate
+    thresholds with fixed bin geometry."""
+    nbins = hist.shape[0]
+    centers = (hist_edges[:-1] + hist_edges[1:]) / 2.0
+    amax = jnp.max(jnp.abs(hist_edges))
+    nq = int(num_quantized_bins)
+    # evaluate KL for a fixed grid of candidate thresholds
+    n_cand = 64
+    fracs = (jnp.arange(n_cand, dtype=jnp.float32) + 1.0) / n_cand
+
+    def kl_for(frac):
+        th = amax * frac
+        w = jnp.abs(centers) <= th
+        p = jnp.where(w, hist, 0.0)
+        outliers = jnp.sum(jnp.where(w, 0.0, hist))
+        # assign outliers to the edge bins like the reference
+        p = p + outliers / jnp.maximum(jnp.sum(w.astype(jnp.float32)), 1.0)
+        # quantize p into nq bins then expand back
+        bin_idx = jnp.clip(((jnp.abs(centers) / jnp.maximum(th, 1e-12))
+                            * (nq / 2)).astype(jnp.int32), 0, nq - 1)
+        q_sums = jnp.zeros((nq,), jnp.float32).at[bin_idx].add(
+            jnp.where(w, p, 0.0))
+        q_cnts = jnp.zeros((nq,), jnp.float32).at[bin_idx].add(
+            w.astype(jnp.float32))
+        q = jnp.where(w, q_sums[bin_idx] / jnp.maximum(q_cnts[bin_idx], 1.0),
+                      0.0)
+        pn = p / jnp.maximum(jnp.sum(p), 1e-12)
+        qn = q / jnp.maximum(jnp.sum(q), 1e-12)
+        return jnp.sum(jnp.where((pn > 0) & (qn > 0),
+                                 pn * jnp.log(pn / jnp.maximum(qn, 1e-12)),
+                                 0.0))
+
+    kls = jax.vmap(kl_for)(fracs)
+    best = fracs[jnp.argmin(kls)] * amax
+    return -best, best

@@ -743,3 +743,74 @@ def _sequence_reverse(data, sequence_length=None, use_sequence_length=False, axi
     idx = rev_idx.reshape((T, -1) + (1,) * (moved.ndim - 2))
     idx = jnp.broadcast_to(idx, moved.shape)
     return jnp.take_along_axis(moved, idx, axis=0)
+
+
+# ----------------------------------------------------------------------------
+# linalg wave 2 (parity: src/operator/tensor/la_op.cc — LAPACK-backed ops;
+# here XLA's native linalg lowerings, which map to MXU-tiled kernels)
+# ----------------------------------------------------------------------------
+
+
+@register("_linalg_extracttrian")
+def _linalg_extracttrian(A, offset=0, lower=True):
+    n = A.shape[-1]
+    k = -int(offset) if lower else int(offset)
+    idx = jnp.tril_indices(n, k) if lower else jnp.triu_indices(n, k)
+    return A[..., idx[0], idx[1]]
+
+
+@register("_linalg_maketrian")
+def _linalg_maketrian(A, offset=0, lower=True):
+    L = A.shape[-1]
+    k = -int(offset) if lower else int(offset)
+    # tril(n, k<=0) holds m(m+1)/2 entries with m = n - |k| (triu(n, k>=0)
+    # symmetric), so n is closed-form from L
+    m = int(round(((8 * L + 1) ** 0.5 - 1) / 2))
+    if m * (m + 1) // 2 != L:
+        raise ValueError("cannot infer triangular size from %d" % L)
+    n = m + abs(k)
+    idx = jnp.tril_indices(n, k) if lower else jnp.triu_indices(n, k)
+    out = jnp.zeros(A.shape[:-1] + (n, n), A.dtype)
+    return out.at[..., idx[0], idx[1]].set(A)
+
+
+@register("_linalg_gelqf", num_outputs=2)
+def _linalg_gelqf(A):
+    """LQ factorization: A = L @ Q with Q orthonormal rows (parity:
+    la_op.cc gelqf).  Computed as the transposed QR of A^T."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("_linalg_potri")
+def _linalg_potri(A, lower=True):
+    """Inverse from a Cholesky factor: potri(L) = (L L^T)^-1."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype),
+                           A.shape)
+    linv = jax.scipy.linalg.solve_triangular(A, eye, lower=lower)
+    return jnp.swapaxes(linv, -1, -2) @ linv if lower \
+        else linv @ jnp.swapaxes(linv, -1, -2)
+
+
+@register("_linalg_slogdet", num_outputs=2)
+def _linalg_slogdet(A):
+    sign, logdet = jnp.linalg.slogdet(A)
+    return sign, logdet
+
+
+@register("_linalg_syevd", num_outputs=2)
+def _linalg_syevd(A):
+    """Symmetric eigendecomposition; rows of U are eigenvectors
+    (A = U^T diag(L) U), matching la_op.cc syevd."""
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("_linalg_trmm")
+def _linalg_trmm(A, B, transpose=False, rightside=False, lower=True,
+                 alpha=1.0):
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    out = (B @ tri) if rightside else (tri @ B)
+    return alpha * out

@@ -1,0 +1,865 @@
+"""Tests for the round-2 operator waves: multi-tensor/mixed-precision
+optimizer ops, misc/legacy ops (loss layers, im2col, LRN, histogram,
+spatial transformer), vision/detection ops (ROI family, deformable conv,
+MultiBox, proposals), extended linalg, and the quantized int8 family.
+
+Oracle style follows tests/test_op_numerics.py: NumPy references computed
+inline, reference semantics cited per case.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+# ---------------------------------------------------------------------------
+# optimizer ops
+# ---------------------------------------------------------------------------
+
+def test_mp_sgd_update():
+    w16 = nd.array(np.ones((4, 3), np.float16))
+    g16 = nd.array(np.full((4, 3), 0.5, np.float16))
+    w32 = nd.array(np.ones((4, 3), np.float32))
+    new_w, new_w32 = nd.mp_sgd_update(w16, g16, w32, lr=0.1)
+    assert new_w.dtype == np.float16
+    assert_almost_equal(new_w32.asnumpy(), np.full((4, 3), 0.95), atol=1e-6)
+
+
+def test_multi_sgd_mom_update_matches_single():
+    rs = np.random.RandomState(0)
+    ws = [rs.rand(3, 2).astype(np.float32) for _ in range(2)]
+    gs = [rs.rand(3, 2).astype(np.float32) for _ in range(2)]
+    ms = [np.zeros((3, 2), np.float32) for _ in range(2)]
+    arrays = []
+    for w, g, m in zip(ws, gs, ms):
+        arrays += [nd.array(w), nd.array(g), nd.array(m)]
+    outs = nd.multi_sgd_mom_update(*arrays, lrs=[0.1, 0.2], wds=[0.0, 0.01],
+                                   momentum=0.9, num_weights=2)
+    for i, (w, g, m) in enumerate(zip(ws, gs, ms)):
+        lr, wd = (0.1, 0.0) if i == 0 else (0.2, 0.01)
+        sw, sm = nd.sgd_mom_update(nd.array(w), nd.array(g), nd.array(m),
+                                   lr=lr, wd=wd, momentum=0.9)
+        assert_almost_equal(outs[i].asnumpy(), sw.asnumpy(), atol=1e-6)
+        assert_almost_equal(outs[2 + i].asnumpy(), sm.asnumpy(), atol=1e-6)
+
+
+def test_preloaded_multi_sgd():
+    w = nd.array(np.ones((2, 2), np.float32))
+    g = nd.array(np.full((2, 2), 1.0, np.float32))
+    lrs = nd.array(np.array([0.5], np.float32))
+    wds = nd.array(np.array([0.0], np.float32))
+    out = nd.preloaded_multi_sgd_update(w, g, lrs, wds, num_weights=1)
+    assert_almost_equal(out.asnumpy(), np.full((2, 2), 0.5), atol=1e-6)
+
+
+def test_multi_lars():
+    lrs = np.array([0.1, 0.2], np.float32)
+    wss = np.array([4.0, 0.0], np.float32)
+    gss = np.array([1.0, 1.0], np.float32)
+    wds = np.array([0.0, 0.0], np.float32)
+    out = nd.multi_lars(nd.array(lrs), nd.array(wss), nd.array(gss),
+                        nd.array(wds), eta=0.01, eps=0.0)
+    # valid: lr*eta*||w||/(||g|| + wd*||w|| + eps); invalid (w_norm 0): lr
+    assert_almost_equal(out.asnumpy(),
+                        np.array([0.1 * 0.01 * 2.0, 0.2]), atol=1e-6)
+
+
+def test_ftml_update_decreases_loss_direction():
+    w = nd.array(np.array([1.0], np.float32))
+    g = nd.array(np.array([2.0], np.float32))
+    d = nd.zeros((1,))
+    v = nd.zeros((1,))
+    z = nd.zeros((1,))
+    new_w, nd_, nv, nz = nd.ftml_update(w, g, d, v, z, lr=0.1, t=1)
+    assert float(new_w.asscalar()) < 1.0
+
+
+def test_all_finite():
+    assert float(nd.all_finite(nd.array(np.ones(4))).asscalar()) == 1.0
+    bad = nd.array(np.array([1.0, np.nan]))
+    assert float(nd.all_finite(bad).asscalar()) == 0.0
+    ok = nd.multi_all_finite(nd.array(np.ones(3)), nd.array(np.ones(2)),
+                             num_arrays=2)
+    assert float(ok.asscalar()) == 1.0
+
+
+def test_adamw_rescale_tensor():
+    w = nd.array(np.ones((2,), np.float32))
+    g = nd.array(np.full((2,), 1.0, np.float32))
+    m = nd.zeros((2,))
+    v = nd.zeros((2,))
+    scale = nd.array(np.array([0.5], np.float32))
+    new_w, nm, nv = nd._adamw_update(w, g, m, v, scale, lr=0.1, wd=0.0)
+    # g_eff = 0.5; m = 0.05; v = 0.00025; update ~ lr*m/(sqrt(v)+eps)
+    assert float(new_w[0].asscalar()) < 1.0
+
+
+def test_sparse_and_group_adagrad():
+    w = np.ones((3, 2), np.float32)
+    g = np.full((3, 2), 2.0, np.float32)
+    h = np.zeros((3, 2), np.float32)
+    nw, nh = nd._sparse_adagrad_update(nd.array(w), nd.array(g), nd.array(h),
+                                       lr=0.1, epsilon=0.0)
+    assert_almost_equal(nh.asnumpy(), np.full((3, 2), 4.0), atol=1e-6)
+    assert_almost_equal(nw.asnumpy(), 1.0 - 0.1 * 2.0 / 2.0 * np.ones((3, 2)),
+                        atol=1e-5)
+    hg = np.zeros((3,), np.float32)
+    nw2, nh2 = nd._contrib_group_adagrad_update(
+        nd.array(w), nd.array(g), nd.array(hg), lr=0.1, epsilon=0.0)
+    assert_almost_equal(nh2.asnumpy(), np.full((3,), 4.0), atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# misc wave
+# ---------------------------------------------------------------------------
+
+def test_regression_outputs_backward():
+    x = np.array([[0.5, -0.2], [0.1, 0.3]], np.float32)
+    lab = np.array([[1.0, 0.0], [0.0, 1.0]], np.float32)
+    xa = nd.array(x)
+    xa.attach_grad()
+    with autograd.record():
+        out = nd.LinearRegressionOutput(xa, nd.array(lab), grad_scale=2.0)
+    out.backward()
+    # grad = (out - label) * grad_scale / num_output, num_output = 2
+    assert_almost_equal(xa.grad.asnumpy(), (x - lab) * 2.0 / 2, atol=1e-6)
+
+    xa2 = nd.array(x)
+    xa2.attach_grad()
+    with autograd.record():
+        out = nd.MAERegressionOutput(xa2, nd.array(lab))
+    out.backward()
+    assert_almost_equal(xa2.grad.asnumpy(), np.sign(x - lab) / 2, atol=1e-6)
+
+
+def test_logistic_regression_output():
+    x = np.array([[0.3, -0.6]], np.float32)
+    lab = np.array([[1.0, 0.0]], np.float32)
+    xa = nd.array(x)
+    xa.attach_grad()
+    with autograd.record():
+        out = nd.LogisticRegressionOutput(xa, nd.array(lab))
+    assert_almost_equal(out.asnumpy(), 1 / (1 + np.exp(-x)), atol=1e-6)
+    out.backward()
+    sig = 1 / (1 + np.exp(-x))
+    assert_almost_equal(xa.grad.asnumpy(), (sig - lab) / 2, atol=1e-6)
+
+
+def test_svm_output_grads():
+    x = np.array([[0.2, -0.5, 0.1]], np.float32)
+    xa = nd.array(x)
+    xa.attach_grad()
+    with autograd.record():
+        o = nd.SVMOutput(xa, nd.array(np.array([0.0], np.float32)))
+    o.backward()
+    # L2-SVM: at true class -2*(1-0.2); others 2*(1+x) when margin > -x
+    expect = np.array([[-1.6, 1.0, 2.2]], np.float32)
+    assert_almost_equal(xa.grad.asnumpy(), expect, atol=1e-5)
+
+
+def test_im2col_col2im_roundtrip():
+    rs = np.random.RandomState(2)
+    x = rs.rand(2, 3, 8, 8).astype(np.float32)
+    col = nd.im2col(nd.array(x), kernel=(3, 3), stride=(1, 1), pad=(1, 1))
+    assert col.shape == (2, 27, 64)
+    # col2im(im2col(x)) counts each pixel once per covering window
+    back = nd.col2im(col, output_size=(8, 8), kernel=(3, 3), stride=(1, 1),
+                     pad=(1, 1))
+    ones = nd.col2im(nd.im2col(nd.array(np.ones_like(x)), kernel=(3, 3),
+                               stride=(1, 1), pad=(1, 1)),
+                     output_size=(8, 8), kernel=(3, 3), stride=(1, 1),
+                     pad=(1, 1))
+    assert_almost_equal(back.asnumpy() / ones.asnumpy(), x, atol=1e-5)
+
+
+def test_lrn_forward():
+    rs = np.random.RandomState(3)
+    x = rs.rand(2, 7, 3, 3).astype(np.float32)
+    out, tmp = nd.LRN(nd.array(x), alpha=1e-3, beta=0.75, knorm=2.0, nsize=5)
+    # NumPy oracle
+    sq = x ** 2
+    pad = np.zeros((2, 7 + 4, 3, 3), np.float32)
+    pad[:, 2:9] = sq
+    win = sum(pad[:, i:i + 7] for i in range(5))
+    norm = 2.0 + (1e-3 / 5) * win
+    assert_almost_equal(out.asnumpy(), x * norm ** -0.75, atol=1e-5)
+
+
+def test_moments_histogram_square_sum():
+    rs = np.random.RandomState(4)
+    x = rs.rand(3, 4).astype(np.float32)
+    mean, var = nd.moments(nd.array(x), axes=(1,))
+    assert_almost_equal(mean.asnumpy(), x.mean(1), atol=1e-6)
+    assert_almost_equal(var.asnumpy(), x.var(1), atol=1e-6)
+    ss = nd._square_sum(nd.array(x), axis=1)
+    assert_almost_equal(ss.asnumpy(), (x ** 2).sum(1), atol=1e-5)
+    cnt, edges = nd._histogram(nd.array(x), bin_cnt=4, range=(0.0, 1.0))
+    ref_cnt, ref_edges = np.histogram(x, bins=4, range=(0.0, 1.0))
+    assert_almost_equal(cnt.asnumpy(), ref_cnt, atol=0)
+
+
+def test_slice_assign_scatter_ops():
+    x = np.zeros((4, 4), np.float32)
+    y = nd._slice_assign(nd.array(x), nd.array(np.ones((2, 2), np.float32)),
+                         begin=(1, 1), end=(3, 3))
+    expect = x.copy()
+    expect[1:3, 1:3] = 1
+    assert_almost_equal(y.asnumpy(), expect, atol=0)
+    z = nd._slice_assign_scalar(nd.array(x), scalar=5.0, begin=(0, 0),
+                                end=(2, 2))
+    assert float(z.asnumpy()[1, 1]) == 5.0
+    s = nd._scatter_plus_scalar(nd.array(np.ones((2,))), scalar=3.0)
+    assert float(s.asnumpy()[0]) == 4.0
+
+
+def test_spatial_transformer_identity_and_shift():
+    img = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    theta = np.array([[1, 0, 0, 0, 1, 0]], np.float32)
+    out = nd.SpatialTransformer(nd.array(img), nd.array(theta),
+                                target_shape=(4, 4))
+    assert_almost_equal(out.asnumpy(), img, atol=1e-5)
+    grid = nd.GridGenerator(nd.array(theta), transform_type="affine",
+                            target_shape=(2, 2))
+    assert grid.shape == (1, 2, 2, 2)
+
+
+def test_adaptive_and_bilinear_resize():
+    img = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    ap = nd._contrib_AdaptiveAvgPooling2D(nd.array(img), output_size=(2, 2))
+    assert_almost_equal(ap.asnumpy().ravel(),
+                        np.array([2.5, 4.5, 10.5, 12.5]), atol=1e-6)
+    br = nd._contrib_BilinearResize2D(nd.array(img), height=2, width=2)
+    # align-corners: corners preserved
+    assert float(br.asnumpy()[0, 0, 0, 0]) == 0.0
+    assert float(br.asnumpy()[0, 0, 1, 1]) == 15.0
+
+
+def test_image_ops():
+    rs = np.random.RandomState(5)
+    img = (rs.rand(6, 8, 3) * 255).astype(np.uint8)
+    t = nd._image_to_tensor(nd.array(img))
+    assert t.shape == (3, 6, 8)
+    assert_almost_equal(t.asnumpy(), img.transpose(2, 0, 1) / 255.0,
+                        atol=1e-6)
+    n = nd._image_normalize(t, mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5))
+    assert_almost_equal(n.asnumpy(), (img.transpose(2, 0, 1) / 255 - 0.5) / 0.5,
+                        atol=1e-5)
+    c = nd._image_crop(nd.array(img), x=1, y=2, width=4, height=3)
+    assert c.shape == (3, 4, 3)
+    r = nd._image_resize(nd.array(img), size=(4, 4))
+    assert r.shape == (4, 4, 3)
+
+
+def test_ste_ops_pass_gradient():
+    x = nd.array(np.array([0.4, -1.6], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = (nd._contrib_round_ste(x) * 2).sum()
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), np.full((2,), 2.0), atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# vision wave
+# ---------------------------------------------------------------------------
+
+def test_roi_pooling():
+    data = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8)
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+    out = nd.ROIPooling(nd.array(data), nd.array(rois), pooled_size=(2, 2),
+                        spatial_scale=1.0)
+    assert_almost_equal(out.asnumpy().ravel(),
+                        np.array([9., 11., 25., 27.]), atol=0)
+
+
+def test_roi_align_matches_interior_average():
+    data = np.ones((1, 2, 6, 6), np.float32) * 7.0
+    rois = np.array([[0, 1, 1, 4, 4]], np.float32)
+    out = nd._contrib_ROIAlign(nd.array(data), nd.array(rois),
+                               pooled_size=(2, 2), spatial_scale=1.0,
+                               sample_ratio=2)
+    assert_almost_equal(out.asnumpy(), np.full((1, 2, 2, 2), 7.0), atol=1e-5)
+
+
+def test_deformable_conv_zero_offsets_is_conv():
+    rs = np.random.RandomState(7)
+    data = rs.rand(2, 4, 9, 9).astype(np.float32)
+    w = rs.rand(4, 2, 3, 3).astype(np.float32)
+    off = np.zeros((2, 18, 5, 5), np.float32)
+    dc = nd._contrib_DeformableConvolution(
+        nd.array(data), nd.array(off), nd.array(w), kernel=(3, 3),
+        stride=(2, 2), pad=(1, 1), num_filter=4, num_group=2, no_bias=True)
+    cv = nd.Convolution(nd.array(data), nd.array(w), kernel=(3, 3),
+                        stride=(2, 2), pad=(1, 1), num_filter=4,
+                        num_group=2, no_bias=True)
+    assert_almost_equal(dc.asnumpy(), cv.asnumpy(), atol=1e-4)
+
+
+def test_multibox_prior():
+    x = nd.zeros((1, 3, 2, 2))
+    pr = nd._contrib_MultiBoxPrior(x, sizes=[0.5], ratios=[1.0])
+    assert pr.shape == (1, 4, 4)
+    # first cell center (0.25, 0.25), half 0.25
+    assert_almost_equal(pr.asnumpy()[0, 0],
+                        np.array([0.0, 0.0, 0.5, 0.5]), atol=1e-6)
+
+
+def test_multibox_target_and_detection():
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5], [0.5, 0.5, 1.0, 1.0]]],
+                       np.float32)
+    label = np.array([[[0, 0.05, 0.05, 0.45, 0.45]]], np.float32)
+    cls_pred = np.zeros((1, 2, 2), np.float32)
+    lt, lm, ct = nd._contrib_MultiBoxTarget(nd.array(anchors),
+                                            nd.array(label),
+                                            nd.array(cls_pred))
+    assert_almost_equal(ct.asnumpy(), np.array([[1.0, 0.0]]), atol=0)
+    assert_almost_equal(lm.asnumpy()[0, :4], np.ones(4), atol=0)
+    cls_prob = np.array([[[0.1, 0.9], [0.9, 0.1]]],
+                        np.float32).transpose(0, 2, 1)
+    det = nd._contrib_MultiBoxDetection(nd.array(cls_prob),
+                                        nd.zeros((1, 8)),
+                                        nd.array(anchors))
+    d = det.asnumpy()[0]
+    assert d.shape == (2, 6)
+    # first anchor's class-0 score 0.9 -> kept with decoded box == anchor
+    assert_almost_equal(d[0], np.array([0., 0.9, 0., 0., 0.5, 0.5]),
+                        atol=1e-5)
+
+
+def test_box_decode_encode():
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5]]], np.float32)
+    deltas = np.zeros((1, 1, 4), np.float32)
+    out = nd._contrib_box_decode(nd.array(deltas), nd.array(anchors))
+    assert_almost_equal(out.asnumpy(), anchors, atol=1e-6)
+
+
+def test_bipartite_matching():
+    sc = nd.array(np.array([[[0.9, 0.1], [0.8, 0.7]]], np.float32))
+    r, c = nd._contrib_bipartite_matching(sc)
+    assert_almost_equal(r.asnumpy(), np.array([[0.0, 1.0]]), atol=0)
+    assert_almost_equal(c.asnumpy(), np.array([[0.0, 1.0]]), atol=0)
+
+
+def test_proposal_shapes():
+    rs = np.random.RandomState(8)
+    cp = rs.rand(2, 6, 4, 4).astype(np.float32)
+    bp = np.zeros((2, 12, 4, 4), np.float32)
+    ii = np.array([[64, 64, 1.0], [64, 64, 1.0]], np.float32)
+    rois, scores = nd._contrib_Proposal(nd.array(cp), nd.array(bp),
+                                        nd.array(ii),
+                                        rpn_pre_nms_top_n=12,
+                                        rpn_post_nms_top_n=5,
+                                        scales=(8,), ratios=(0.5, 1, 2))
+    assert rois.shape == (10, 5)
+    assert scores.shape == (10, 1)
+    # batch indices present
+    assert set(np.unique(rois.asnumpy()[:, 0])) == {0.0, 1.0}
+
+
+def test_sync_batch_norm_matches_bn():
+    rs = np.random.RandomState(9)
+    x = rs.rand(4, 3, 2, 2).astype(np.float32)
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    mm = np.zeros(3, np.float32)
+    mv = np.ones(3, np.float32)
+    args = [nd.array(a) for a in (x, gamma, beta, mm, mv)]
+    sb = nd._contrib_SyncBatchNorm(*args)
+    bn = nd.BatchNorm(*[nd.array(a) for a in (x, gamma, beta, mm, mv)])
+    out_s = sb[0] if isinstance(sb, (list, tuple)) else sb
+    out_b = bn[0] if isinstance(bn, (list, tuple)) else bn
+    assert_almost_equal(out_s.asnumpy(), out_b.asnumpy(), atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# linalg wave
+# ---------------------------------------------------------------------------
+
+def test_linalg_wave():
+    rs = np.random.RandomState(10)
+    a = rs.rand(3, 3).astype(np.float32)
+    A = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+    L = np.linalg.cholesky(A)
+    inv = nd._linalg_potri(nd.array(L))
+    assert_almost_equal(inv.asnumpy(), np.linalg.inv(A), atol=1e-4)
+    s, ld = nd._linalg_slogdet(nd.array(A))
+    ref = np.linalg.slogdet(A)
+    assert float(s.asscalar()) == ref[0]
+    assert abs(float(ld.asscalar()) - ref[1]) < 1e-4
+    U, lam = nd._linalg_syevd(nd.array(A))
+    rec = U.asnumpy().T @ np.diag(lam.asnumpy()) @ U.asnumpy()
+    assert_almost_equal(rec, A, atol=1e-4)
+    tr = nd._linalg_extracttrian(nd.array(A))
+    back = nd._linalg_maketrian(tr)
+    assert_almost_equal(back.asnumpy(), np.tril(A), atol=0)
+    Lq, Q = nd._linalg_gelqf(nd.array(rs.rand(2, 4).astype(np.float32)))
+    assert_almost_equal((Q.asnumpy() @ Q.asnumpy().T), np.eye(2), atol=1e-5)
+    tm = nd._linalg_trmm(nd.array(L), nd.array(A))
+    assert_almost_equal(tm.asnumpy(), np.tril(L) @ A, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# quantized wave
+# ---------------------------------------------------------------------------
+
+def test_quantized_fc_int8_path():
+    rs = np.random.RandomState(11)
+    x = rs.randn(4, 8).astype(np.float32)
+    w = rs.randn(3, 8).astype(np.float32)
+    qx, xmin, xmax = nd._contrib_quantize_v2(nd.array(x))
+    qw, wmin, wmax = nd._contrib_quantize_v2(nd.array(w))
+    assert qx.dtype == np.int8
+    acc, lo, hi = nd._contrib_quantized_fully_connected(
+        qx, qw, None, xmin, xmax, wmin, wmax, None, None,
+        num_hidden=3, no_bias=True)
+    assert acc.dtype == np.int32
+    q8, qlo, qhi = nd._contrib_requantize(acc, lo, hi)
+    approx = q8.asnumpy().astype(np.float32) * float(qhi.asscalar()) / 127
+    exact = x @ w.T
+    rel = np.abs(approx - exact).max() / np.abs(exact).max()
+    assert rel < 0.05
+
+
+def test_quantized_conv_and_pool():
+    rs = np.random.RandomState(12)
+    x = rs.randn(1, 2, 6, 6).astype(np.float32)
+    w = rs.randn(3, 2, 3, 3).astype(np.float32)
+    qx, xmin, xmax = nd._contrib_quantize_v2(nd.array(x))
+    qw, wmin, wmax = nd._contrib_quantize_v2(nd.array(w))
+    acc, lo, hi = nd._contrib_quantized_conv(
+        qx, qw, None, xmin, xmax, wmin, wmax, None, None,
+        kernel=(3, 3), pad=(1, 1), num_filter=3)
+    q8, qlo, qhi = nd._contrib_requantize(acc, lo, hi)
+    approx = q8.asnumpy().astype(np.float32) * float(qhi.asscalar()) / 127
+    exact = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                           pad=(1, 1), num_filter=3, no_bias=True).asnumpy()
+    rel = np.abs(approx - exact).max() / np.abs(exact).max()
+    assert rel < 0.08
+    p, pmin, pmax = nd._contrib_quantized_pooling(
+        qx, xmin, xmax, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    assert p.dtype == np.int8
+    ref = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                     pool_type="max").asnumpy()
+    scale = max(abs(float(pmin.asscalar())), abs(float(pmax.asscalar()))) / 127
+    assert np.abs(p.asnumpy().astype(np.float32) * scale - ref).max() < 0.05
+
+
+def test_quantized_elemwise_and_concat():
+    rs = np.random.RandomState(13)
+    a = rs.randn(3, 4).astype(np.float32)
+    b = rs.randn(3, 4).astype(np.float32)
+    qa, amin, amax = nd._contrib_quantize_v2(nd.array(a))
+    qb, bmin, bmax = nd._contrib_quantize_v2(nd.array(b))
+    s, smin, smax = nd._contrib_quantized_elemwise_add(qa, qb, amin, amax,
+                                                       bmin, bmax)
+    approx = s.asnumpy().astype(np.float32) * float(smax.asscalar()) / 127
+    assert np.abs(approx - (a + b)).max() < 0.1
+    c, cmin, cmax = nd._contrib_quantized_concat(qa, qb, amin, bmin,
+                                                 amax, bmax, num_args=2,
+                                                 dim=1)
+    assert c.shape == (3, 8)
+
+
+# ---------------------------------------------------------------------------
+# contrib: hawkesll, encdec attention, edge_id/adjacency, RROIAlign,
+# boolean_mask, PSROI/deformable-PSROI, mrcnn mask target
+# ---------------------------------------------------------------------------
+
+def test_hawkesll_single_event_golden():
+    # one event of mark 0 at t=1, observed on (0, 2]:
+    # ll = log(mu) - mu*1  - [mu*(2-1) + alpha*(1 - e^{-beta*1})]
+    ll, st = nd._contrib_hawkesll(
+        nd.array(np.array([[1.0]], np.float32)),
+        nd.array(np.array([0.5], np.float32)),
+        nd.array(np.array([1.0], np.float32)),
+        nd.array(np.array([[0.0]], np.float32)),
+        nd.array(np.array([[1.0]], np.float32)),
+        nd.array(np.array([[0]], np.float32)),
+        nd.array(np.array([1.0], np.float32)),
+        nd.array(np.array([2.0], np.float32)))
+    expect = -1.0 - (1.0 + 0.5 * (1 - np.exp(-1.0)))
+    assert abs(float(ll.asscalar()) - expect) < 1e-5
+    # final state: one event decayed over (2-1): e^{-1}
+    assert abs(float(st.asscalar()) - np.exp(-1.0)) < 1e-5
+
+
+def test_encdec_attention_matches_selfatt():
+    rs = np.random.RandomState(20)
+    T, B, H, D = 3, 2, 2, 4
+    qkv = rs.rand(T, B, 3 * H * D).astype(np.float32)
+    att_self = nd._contrib_interleaved_matmul_selfatt_qk(
+        nd.array(qkv), heads=H)
+    # build the encdec inputs carrying the same q/k/v
+    x = qkv.reshape(T, B, H, 3, D)
+    q = x[:, :, :, 0, :].reshape(T, B, H * D)
+    kv = np.stack([x[:, :, :, 1, :], x[:, :, :, 2, :]],
+                  axis=3).reshape(T, B, 2 * H * D)
+    att_encdec = nd._contrib_interleaved_matmul_encdec_qk(
+        nd.array(q), nd.array(kv), heads=H)
+    assert_almost_equal(att_self.asnumpy(), att_encdec.asnumpy(), atol=1e-5)
+    out_self = nd._contrib_interleaved_matmul_selfatt_valatt(
+        nd.array(qkv), att_self, heads=H)
+    out_encdec = nd._contrib_interleaved_matmul_encdec_valatt(
+        nd.array(kv), att_encdec, heads=H)
+    assert_almost_equal(out_self.asnumpy(), out_encdec.asnumpy(), atol=1e-5)
+
+
+def test_edge_id_and_dgl_adjacency():
+    from mxnet_tpu.ndarray import sparse as sp
+
+    dense = np.array([[0, 1, 0], [2, 0, 3]], np.float32)
+    csr = sp.csr_matrix(dense)
+    eid = nd._contrib_edge_id(csr,
+                              nd.array(np.array([0, 1, 1], np.float32)),
+                              nd.array(np.array([1, 0, 1], np.float32)))
+    assert_almost_equal(eid.asnumpy(), np.array([1.0, 2.0, -1.0]), atol=0)
+    adj = nd._contrib_dgl_adjacency(csr)
+    assert_almost_equal(adj.tostype("default").asnumpy(),
+                        (dense != 0).astype(np.float32), atol=0)
+
+
+def test_rroi_align_axis_aligned_matches_constant():
+    data = nd.array(np.full((1, 2, 8, 8), 3.0, np.float32))
+    rois = nd.array(np.array([[0, 4, 4, 4, 4, 0]], np.float32))
+    out = nd._contrib_RROIAlign(data, rois, pooled_size=(2, 2))
+    assert_almost_equal(out.asnumpy(), np.full((1, 2, 2, 2), 3.0),
+                        atol=1e-5)
+
+
+def test_psroi_pooling_constant():
+    # constant input -> every bin averages to the constant, whatever the
+    # position-sensitive channel mapping picks
+    data = nd.array(np.full((1, 8, 6, 6), 2.0, np.float32))
+    rois = nd.array(np.array([[0, 0, 0, 5, 5]], np.float32))
+    out = nd._contrib_PSROIPooling(data, rois, spatial_scale=1.0,
+                                   output_dim=2, pooled_size=2,
+                                   group_size=2)
+    assert_almost_equal(out.asnumpy(), np.full((1, 2, 2, 2), 2.0),
+                        atol=1e-5)
+    dout, _ = nd._contrib_DeformablePSROIPooling(
+        data, rois, None, spatial_scale=1.0, output_dim=2, group_size=2,
+        pooled_size=2, no_trans=True)
+    assert_almost_equal(dout.asnumpy(), np.full((1, 2, 2, 2), 2.0),
+                        atol=1e-5)
+
+
+def test_mrcnn_mask_target_shapes():
+    rs = np.random.RandomState(21)
+    rois = rs.rand(2, 3, 4).astype(np.float32) * 10
+    gt_masks = (rs.rand(2, 2, 16, 16) > 0.5).astype(np.float32)
+    matches = np.zeros((2, 3), np.float32)
+    cls_t = np.ones((2, 3), np.float32)
+    t, w = nd._contrib_mrcnn_mask_target(
+        nd.array(rois), nd.array(gt_masks), nd.array(matches),
+        nd.array(cls_t), num_rois=3, num_classes=4, mask_size=(7, 7))
+    assert t.shape == (2, 3, 4, 7, 7)
+    assert w.shape == (2, 3, 4, 7, 7)
+    # weights only on the target class channel
+    assert float(w.asnumpy()[:, :, 0].max()) == 0.0
+    assert float(w.asnumpy()[:, :, 1].max()) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# mixed-precision / multi-tensor optimizer variants: each must match its
+# single-tensor f32 counterpart on f32 inputs
+# ---------------------------------------------------------------------------
+
+def _rand_wgm(seed, n=2, shape=(3, 2)):
+    rs = np.random.RandomState(seed)
+    return ([rs.rand(*shape).astype(np.float32) for _ in range(n)],
+            [rs.rand(*shape).astype(np.float32) for _ in range(n)],
+            [np.zeros(shape, np.float32) for _ in range(n)])
+
+
+def test_mp_sgd_mom_and_nag_match_f32():
+    rs = np.random.RandomState(30)
+    w = rs.rand(3, 2).astype(np.float32)
+    g = rs.rand(3, 2).astype(np.float32)
+    m = np.zeros((3, 2), np.float32)
+    ref_w, ref_m = nd.sgd_mom_update(nd.array(w), nd.array(g), nd.array(m),
+                                     lr=0.1, momentum=0.9)
+    mp_w, mp_m, mp_w32 = nd.mp_sgd_mom_update(
+        nd.array(w), nd.array(g), nd.array(m), nd.array(w),
+        lr=0.1, momentum=0.9)
+    assert_almost_equal(mp_w32.asnumpy(), ref_w.asnumpy(), atol=1e-6)
+    assert_almost_equal(mp_m.asnumpy(), ref_m.asnumpy(), atol=1e-6)
+    ref_w2, ref_m2 = nd.nag_mom_update(nd.array(w), nd.array(g),
+                                       nd.array(m), lr=0.1, momentum=0.9)
+    nag_w, nag_m, nag_w32 = nd.mp_nag_mom_update(
+        nd.array(w), nd.array(g), nd.array(m), nd.array(w),
+        lr=0.1, momentum=0.9)
+    assert_almost_equal(nag_w32.asnumpy(), ref_w2.asnumpy(), atol=1e-6)
+
+
+def test_mp_adamw_matches_adamw():
+    rs = np.random.RandomState(31)
+    w = rs.rand(3, 2).astype(np.float32)
+    g = rs.rand(3, 2).astype(np.float32)
+    m = np.zeros((3, 2), np.float32)
+    v = np.zeros((3, 2), np.float32)
+    scale = nd.array(np.array([1.0], np.float32))
+    ref = nd._adamw_update(nd.array(w), nd.array(g), nd.array(m),
+                           nd.array(v), scale, lr=0.01, wd=0.1)
+    mp = nd._mp_adamw_update(nd.array(w), nd.array(g), nd.array(m),
+                             nd.array(v), nd.array(w), scale,
+                             lr=0.01, wd=0.1)
+    assert_almost_equal(mp[3].asnumpy(), ref[0].asnumpy(), atol=1e-6)
+
+
+def test_multi_mp_sgd_variants_match_single():
+    ws, gs, ms = _rand_wgm(32)
+    arrays = []
+    for w, g, w32 in zip(ws, gs, ws):
+        arrays += [nd.array(w), nd.array(g), nd.array(w32)]
+    outs = nd.multi_mp_sgd_update(*arrays, lrs=[0.1, 0.2], wds=[0.0, 0.0],
+                                  num_weights=2)
+    for i in range(2):
+        ref = nd.sgd_update(nd.array(ws[i]), nd.array(gs[i]),
+                            lr=[0.1, 0.2][i])
+        assert_almost_equal(outs[2 + i].asnumpy(), ref.asnumpy(), atol=1e-6)
+    arrays = []
+    for w, g, m in zip(ws, gs, ms):
+        arrays += [nd.array(w), nd.array(g), nd.array(m), nd.array(w)]
+    outs = nd.multi_mp_sgd_mom_update(*arrays, lrs=[0.1, 0.2],
+                                      wds=[0.0, 0.0], momentum=0.9,
+                                      num_weights=2)
+    for i in range(2):
+        ref_w, _ = nd.sgd_mom_update(nd.array(ws[i]), nd.array(gs[i]),
+                                     nd.array(ms[i]), lr=[0.1, 0.2][i],
+                                     momentum=0.9)
+        assert_almost_equal(outs[4 + i].asnumpy(), ref_w.asnumpy(),
+                            atol=1e-6)
+
+
+def test_preloaded_variants_match_attr_versions():
+    ws, gs, ms = _rand_wgm(33)
+    lrs_t = nd.array(np.array([0.1, 0.2], np.float32))
+    wds_t = nd.array(np.array([0.0, 0.01], np.float32))
+    arrays = []
+    for w, g, m in zip(ws, gs, ms):
+        arrays += [nd.array(w), nd.array(g), nd.array(m)]
+    pre = nd.preloaded_multi_sgd_mom_update(*(arrays + [lrs_t, wds_t]),
+                                            momentum=0.9, num_weights=2)
+    attr = nd.multi_sgd_mom_update(*arrays, lrs=[0.1, 0.2],
+                                   wds=[0.0, 0.01], momentum=0.9,
+                                   num_weights=2)
+    for p, a in zip(pre, attr):
+        assert_almost_equal(p.asnumpy(), a.asnumpy(), atol=1e-6)
+    arrays_mp = []
+    for w, g in zip(ws, gs):
+        arrays_mp += [nd.array(w), nd.array(g), nd.array(w)]
+    pre_mp = nd.preloaded_multi_mp_sgd_update(
+        *(arrays_mp + [lrs_t, wds_t]), num_weights=2)
+    assert_almost_equal(pre_mp[2].asnumpy(),
+                        nd.sgd_update(nd.array(ws[0]), nd.array(gs[0]),
+                                      lr=0.1).asnumpy(), atol=1e-6)
+    arrays_mpm = []
+    for w, g, m in zip(ws, gs, ms):
+        arrays_mpm += [nd.array(w), nd.array(g), nd.array(m), nd.array(w)]
+    pre_mpm = nd.preloaded_multi_mp_sgd_mom_update(
+        *(arrays_mpm + [lrs_t, wds_t]), momentum=0.9, num_weights=2)
+    ref_w, _ = nd.sgd_mom_update(nd.array(ws[1]), nd.array(gs[1]),
+                                 nd.array(ms[1]), lr=0.2, wd=0.01,
+                                 momentum=0.9)
+    assert_almost_equal(pre_mpm[5].asnumpy(), ref_w.asnumpy(), atol=1e-6)
+
+
+def test_lamb_mp_and_multi_match_phases():
+    rs = np.random.RandomState(34)
+    w = rs.rand(4, 3).astype(np.float32)
+    g = rs.rand(4, 3).astype(np.float32)
+    m = np.zeros((4, 3), np.float32)
+    v = np.zeros((4, 3), np.float32)
+    # reference composition: phase1 -> norms -> phase2
+    upd = nd.lamb_update_phase1(nd.array(w), nd.array(g), nd.array(m),
+                                nd.array(v), t=1, wd=0.01)
+    r1 = nd.array(np.array(np.linalg.norm(w), np.float32).reshape(1))
+    r2 = nd.array(np.array(np.linalg.norm(upd.asnumpy()),
+                           np.float32).reshape(1))
+    ref = nd.lamb_update_phase2(nd.array(w), upd, r1, r2, lr=0.1)
+    # mp phases with identity master copy agree
+    upd_mp = nd.mp_lamb_update_phase1(nd.array(w), nd.array(g),
+                                      nd.array(m), nd.array(v),
+                                      nd.array(w), t=1, wd=0.01)
+    assert_almost_equal(upd_mp.asnumpy(), upd.asnumpy(), atol=1e-6)
+    w_mp, w32_mp = nd.mp_lamb_update_phase2(nd.array(w), upd_mp, r1, r2,
+                                            nd.array(w), lr=0.1)
+    assert_almost_equal(w32_mp.asnumpy(), ref.asnumpy(), atol=1e-6)
+    # fused multi-tensor lamb agrees with the phase composition
+    outs = nd._multi_lamb_update(nd.array(w), nd.array(g), nd.array(m),
+                                 nd.array(v), learning_rates=[0.1],
+                                 wds=[0.01], step_count=[1], num_tensors=1)
+    assert_almost_equal(outs[0].asnumpy(), ref.asnumpy(), atol=1e-5)
+    outs_mp = nd._multi_mp_lamb_update(
+        nd.array(w), nd.array(g), nd.array(m), nd.array(v), nd.array(w),
+        learning_rates=[0.1], wds=[0.01], step_count=[1], num_tensors=1)
+    assert_almost_equal(outs_mp[3].asnumpy(), ref.asnumpy(), atol=1e-5)
+
+
+def test_multi_adamw_matches_single():
+    rs = np.random.RandomState(35)
+    w = rs.rand(3, 2).astype(np.float32)
+    g = rs.rand(3, 2).astype(np.float32)
+    m = np.zeros((3, 2), np.float32)
+    v = np.zeros((3, 2), np.float32)
+    scale = nd.array(np.array([1.0], np.float32))
+    ref = nd._adamw_update(nd.array(w), nd.array(g), nd.array(m),
+                           nd.array(v), scale, lr=0.01, wd=0.1, eta=1.0)
+    outs = nd._multi_adamw_update(
+        nd.array(w), nd.array(g), nd.array(m), nd.array(v), scale,
+        lrs=[0.01], wds=[0.1], etas=[1.0], num_weights=1)
+    assert_almost_equal(outs[0].asnumpy(), ref[0].asnumpy(), atol=1e-6)
+    outs_mp = nd._multi_mp_adamw_update(
+        nd.array(w), nd.array(g), nd.array(m), nd.array(v), nd.array(w),
+        scale, lrs=[0.01], wds=[0.1], etas=[1.0], num_weights=1)
+    assert_almost_equal(outs_mp[3].asnumpy(), ref[0].asnumpy(), atol=1e-6)
+
+
+def test_reset_arrays():
+    outs = nd.reset_arrays(nd.array(np.ones((2, 2))),
+                           nd.array(np.ones(3)), num_arrays=2)
+    for o in outs:
+        assert float(np.abs(o.asnumpy()).max()) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# misc wave leftovers
+# ---------------------------------------------------------------------------
+
+def test_make_loss_and_kl_sparse_reg():
+    x = nd.array(np.array([[1.0, -2.0]], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        out = nd.MakeLoss(x, grad_scale=3.0)
+    out.backward()
+    assert_almost_equal(x.grad.asnumpy(), np.full((1, 2), 3.0), atol=0)
+    x2 = nd.array(np.full((4, 2), 0.5, np.float32))
+    x2.attach_grad()
+    with autograd.record():
+        out = nd.IdentityAttachKLSparseReg(x2, sparseness_target=0.1,
+                                           penalty=0.001)
+    assert_almost_equal(out.asnumpy(), x2.asnumpy(), atol=0)
+    out.backward()
+    # grad = 1 (identity head) + penalty * (-rho/rho_hat + (1-rho)/(1-rho_hat))
+    expect = 1.0 + 0.001 * (-0.1 / 0.5 + 0.9 / 0.5)
+    assert_almost_equal(x2.grad.asnumpy(), np.full((4, 2), expect),
+                        atol=1e-6)
+
+
+def test_crop_and_correlation():
+    img = nd.array(np.arange(32, dtype=np.float32).reshape(1, 2, 4, 4))
+    c = nd.Crop(img, offset=(1, 1), h_w=(2, 2))
+    assert c.shape == (1, 2, 2, 2)
+    assert float(c.asnumpy()[0, 0, 0, 0]) == 5.0
+    cc = nd.Crop(img, center_crop=True, h_w=(2, 2))
+    assert float(cc.asnumpy()[0, 0, 0, 0]) == 5.0
+    # correlation of identical constant maps at zero displacement = mean sq
+    a = nd.array(np.full((1, 2, 5, 5), 2.0, np.float32))
+    out, tmp = nd.Correlation(a, a, kernel_size=1, max_displacement=1,
+                              stride1=1, stride2=1, pad_size=1,
+                              is_multiply=True)
+    d = out.shape[1]
+    assert d == 9
+    center = out.asnumpy()[0, 4]
+    # at zero displacement every (interior) position sees 2*2 averaged
+    # over C=2 channels with sumelems = 1*1*2 -> 4*2/2 = 4
+    assert abs(center[2, 2] - 4.0) < 1e-5
+
+
+def test_sign_ste_passes_gradient():
+    x = nd.array(np.array([0.4, -1.6], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = (nd._contrib_sign_ste(x) * 3).sum()
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), np.full((2,), 3.0), atol=1e-6)
+    assert_almost_equal(
+        nd._contrib_sign_ste(x).asnumpy(), np.sign(x.asnumpy()), atol=0)
+
+
+def test_box_encode_targets():
+    samples = nd.array(np.array([[1.0]], np.float32))
+    matches = nd.array(np.array([[0.0]], np.float32))
+    anchors = nd.array(np.array([[[0.0, 0.0, 1.0, 1.0]]], np.float32))
+    refs = nd.array(np.array([[[0.0, 0.0, 1.0, 1.0]]], np.float32))
+    t, mask = nd._contrib_box_encode(samples, matches, anchors, refs)
+    # identical boxes -> zero offsets scaled by stds
+    assert_almost_equal(t.asnumpy(), np.zeros((1, 1, 4)), atol=1e-6)
+    assert_almost_equal(mask.asnumpy(), np.ones((1, 1, 4)), atol=0)
+
+
+def test_multi_proposal_matches_proposal():
+    rs = np.random.RandomState(36)
+    cp = rs.rand(2, 6, 4, 4).astype(np.float32)
+    bp = np.zeros((2, 12, 4, 4), np.float32)
+    ii = np.array([[64, 64, 1.0], [64, 64, 1.0]], np.float32)
+    kw = dict(rpn_pre_nms_top_n=12, rpn_post_nms_top_n=5, scales=(8,),
+              ratios=(0.5, 1, 2))
+    r1, s1 = nd._contrib_Proposal(nd.array(cp), nd.array(bp), nd.array(ii),
+                                  **kw)
+    r2, s2 = nd._contrib_MultiProposal(nd.array(cp), nd.array(bp),
+                                       nd.array(ii), **kw)
+    assert_almost_equal(r1.asnumpy(), r2.asnumpy(), atol=0)
+    assert_almost_equal(s1.asnumpy(), s2.asnumpy(), atol=0)
+
+
+# ---------------------------------------------------------------------------
+# quantized wave leftovers
+# ---------------------------------------------------------------------------
+
+def test_quantized_act_flatten_embedding():
+    rs = np.random.RandomState(37)
+    x = rs.randn(2, 3, 4).astype(np.float32)
+    qx, xmin, xmax = nd._contrib_quantize_v2(nd.array(x))
+    a, amin, amax = nd._contrib_quantized_act(qx, xmin, xmax,
+                                              act_type="relu")
+    assert (a.asnumpy() >= 0).all()
+    assert float(amin.asscalar()) >= 0.0
+    f, fmin, fmax = nd._contrib_quantized_flatten(qx, xmin, xmax)
+    assert f.shape == (2, 12)
+    w = rs.randn(10, 4).astype(np.float32)
+    ids = nd.array(np.array([1, 3], np.float32))
+    e, emin, emax = nd._contrib_quantized_embedding(
+        ids, nd.array(w), nd.array(np.float32(-1)),
+        nd.array(np.float32(1)), input_dim=10, output_dim=4)
+    assert e.shape == (2, 4)
+    assert_almost_equal(e.asnumpy(), w[[1, 3]], atol=0)
+
+
+def test_quantized_elemwise_mul_and_batch_norm():
+    rs = np.random.RandomState(38)
+    a = rs.randn(3, 4).astype(np.float32)
+    b = rs.randn(3, 4).astype(np.float32)
+    qa, amin, amax = nd._contrib_quantize_v2(nd.array(a))
+    qb, bmin, bmax = nd._contrib_quantize_v2(nd.array(b))
+    p, pmin, pmax = nd._contrib_quantized_elemwise_mul(qa, qb, amin, amax,
+                                                       bmin, bmax)
+    assert p.dtype == np.int32
+    approx = p.asnumpy().astype(np.float64) \
+        * float(pmax.asscalar()) / (2.0 ** 31 - 1)
+    assert np.abs(approx - a * b).max() < 0.05
+    x = rs.randn(2, 3, 4, 4).astype(np.float32)
+    qx, xmin, xmax = nd._contrib_quantize_v2(nd.array(x))
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    mm = x.mean((0, 2, 3))
+    mv = x.var((0, 2, 3))
+    qo, omin, omax = nd._contrib_quantized_batch_norm(
+        qx, nd.array(gamma), nd.array(beta), nd.array(mm), nd.array(mv),
+        xmin, xmax, eps=1e-5)
+    approx = qo.asnumpy().astype(np.float32) * float(omax.asscalar()) / 127
+    ref = (x - mm.reshape(1, -1, 1, 1)) / np.sqrt(
+        mv.reshape(1, -1, 1, 1) + 1e-5)
+    assert np.abs(approx - ref).max() < 0.1
+
+
+def test_calibrate_entropy_reasonable_threshold():
+    rs = np.random.RandomState(39)
+    h, e = np.histogram(rs.randn(20000), bins=255)
+    lo, hi = nd._contrib_calibrate_entropy(nd.array(h.astype(np.float32)),
+                                           nd.array(e.astype(np.float32)))
+    # optimal int8 threshold for a standard normal is well inside the tails
+    assert 0.5 < float(hi.asscalar()) < 4.5
+    assert abs(float(lo.asscalar()) + float(hi.asscalar())) < 1e-5
