@@ -544,6 +544,8 @@ class ImageRecordIter(DataIter):
         self.scale = scale
         self.rand_crop = rand_crop
         self.rand_mirror = rand_mirror
+        self._native = None  # tri-state: None = try, False = opted out
+        self._threads = max(1, int(preprocess_threads))
         self._img = img_mod
         self._records = list(self._record)
         self._order = np.arange(len(self._records))
@@ -573,20 +575,107 @@ class ImageRecordIter(DataIter):
         if not self.iter_next():
             raise StopIteration
         c, h, w = self.data_shape
-        data = np.empty((self.batch_size, c, h, w), dtype=np.float32)
         labels = np.empty((self.batch_size, self.label_width),
                           dtype=np.float32)
-        for i in range(self.batch_size):
-            rec = self._records[self._order[self.cursor + i]]
-            header, img = self._unpack(rec)
-            arr = self._prep(img, h, w)
-            data[i] = arr
-            lbl = np.atleast_1d(np.asarray(header.label, dtype=np.float32))
-            labels[i] = lbl[:self.label_width]
+        data = self._next_native(c, h, w, labels)
+        if data is None:
+            data = np.empty((self.batch_size, c, h, w), dtype=np.float32)
+            for i in range(self.batch_size):
+                rec = self._records[self._order[self.cursor + i]]
+                header, img = self._unpack(rec)
+                arr = self._prep(img, h, w)
+                data[i] = arr
+                lbl = np.atleast_1d(np.asarray(header.label,
+                                               dtype=np.float32))
+                labels[i] = lbl[:self.label_width]
         self.cursor += self.batch_size
         label_out = labels[:, 0] if self.label_width == 1 else labels
         return DataBatch(data=[nd.array(data)],
                          label=[nd.array(label_out)], pad=0)
+
+    def _next_native(self, c, h, w, labels):
+        """Native fast path: the whole batch is decoded, cropped, resized,
+        flipped and normalized by the C++ thread pool
+        (src/image_decode_native.cc) in ONE call outside the GIL — the
+        rebuild's ImageRecordIOParser2.  Crop/flip decisions come from the
+        same np.random call sequence as _prep, so the two paths produce
+        identical batches for a given seed; payload probing happens
+        BEFORE any RNG draw so bailing to the python path never shifts
+        the stream.  Returns the (N, C, H, W) float32 batch or None
+        (non-JPEG payloads / non-RGB target / no native lib)."""
+        from .. import native
+        from .. import recordio as rio
+
+        if self._native is False or c != 3                 or not native.jpeg_available():
+            self._native = False
+            return None
+        # pass 1 (no RNG): unpack, verify JPEG, probe dims
+        bufs, dims_list = [], []
+        for i in range(self.batch_size):
+            rec = self._records[self._order[self.cursor + i]]
+            header, payload = rio.unpack(rec)
+            if payload[:2] != b"\xff\xd8":  # not JPEG: python path
+                self._native = False
+                return None
+            dims = native.jpeg_probe(payload)
+            if dims is None:
+                self._native = False
+                return None
+            bufs.append(payload)
+            dims_list.append(dims)
+            lbl = np.atleast_1d(np.asarray(header.label, dtype=np.float32))
+            labels[i] = lbl[:self.label_width]
+        # pass 2: draw crop/flip decisions in _prep's exact RNG order
+        crops = np.empty((self.batch_size, 4), np.int64)
+        flips = np.zeros(self.batch_size, np.uint8)
+        for i, (ih, iw) in enumerate(dims_list):
+            if self.rand_crop and ih >= h and iw >= w:
+                y0 = np.random.randint(0, ih - h + 1)
+                x0 = np.random.randint(0, iw - w + 1)
+                crops[i] = (x0, y0, w, h)
+            elif ih >= h and iw >= w:
+                crops[i] = ((iw - w) // 2, (ih - h) // 2, w, h)
+            else:
+                crops[i] = (-1, -1, -1, -1)  # full frame + resize
+            if self.rand_mirror and np.random.rand() < 0.5:
+                flips[i] = 1
+        self._native = True
+        out, ok = native.decode_aug_batch(
+            bufs, h, w, crops=crops, flips=flips, interp=0,
+            mean=tuple(self.mean.reshape(-1)), scale=(self.scale,) * 3,
+            nthreads=self._threads)
+        if not ok.all():
+            # strict libjpeg rejects streams PIL tolerates (truncated
+            # scans): re-decode just the failed records on the python
+            # path, REUSING the drawn crop/flip so the RNG stream and
+            # augmentations stay identical to a pure-python run
+            for i in np.nonzero(ok == 0)[0]:
+                rec = self._records[self._order[self.cursor + i]]
+                _, img = self._unpack(rec)
+                out[i] = self._apply_aug(img, crops[i], bool(flips[i]),
+                                         h, w)
+        return out
+
+    def _apply_aug(self, img, crop, flip, h, w):
+        """Apply an already-drawn (crop, flip) decision the way _prep
+        would — used by the native path's per-record fallback."""
+        arr = np.asarray(img, dtype=np.float32)
+        if arr.ndim == 2:
+            arr = arr[:, :, None].repeat(3, axis=2)
+        ih, iw = arr.shape[:2]
+        x0, y0, cw, ch = (int(v) for v in crop)
+        if cw > 0 and ch > 0:
+            arr = arr[y0:y0 + ch, x0:x0 + cw]
+        else:  # full frame + nearest resize (matches _prep)
+            yy = np.clip(
+                (np.arange(h) * ih / float(h)).astype(int), 0, ih - 1)
+            xx = np.clip(
+                (np.arange(w) * iw / float(w)).astype(int), 0, iw - 1)
+            arr = arr[yy][:, xx]
+        if flip:
+            arr = arr[:, ::-1]
+        arr = arr.transpose(2, 0, 1)
+        return (arr - self.mean) * self.scale
 
     def _prep(self, img, h, w):
         arr = np.asarray(img, dtype=np.float32)

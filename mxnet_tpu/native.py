@@ -22,9 +22,12 @@ _lib = None
 _tried = False
 
 
+def _src_dir():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "src")
+
+
 def _src_path():
-    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "src", "recordio_native.cc")
+    return os.path.join(_src_dir(), "recordio_native.cc")
 
 
 def _cache_dir():
@@ -36,18 +39,56 @@ def _cache_dir():
 
 
 def _build():
-    src = _src_path()
-    with open(src, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    out = os.path.join(_cache_dir(), "recordio_native-%s.so" % digest)
-    if not os.path.exists(out):
-        tmp = out + ".tmp.%d" % os.getpid()
-        subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src,
-             "-o", tmp],
-            check=True, capture_output=True)
-        os.replace(tmp, out)
-    return out
+    """Compile the native tier into one cached .so.
+
+    Preferred build includes the libjpeg-backed image pipeline; if that
+    fails (no libjpeg on this machine) the RecordIO-only core is built
+    instead and image functions stay unavailable.
+    """
+    srcs = [_src_path()]
+    img_src = os.path.join(_src_dir(), "image_decode_native.cc")
+    has_img = os.path.exists(img_src)
+    h = hashlib.sha256()
+    for p in srcs + ([img_src] if has_img else []):
+        with open(p, "rb") as f:
+            h.update(f.read())
+    digest = h.hexdigest()[:16]
+    # the variant is part of the cache name: a core-only fallback build
+    # must not shadow a later successful libjpeg build (e.g. after the
+    # user installs libjpeg-dev) — the full variant is re-attempted on
+    # every fresh process until it exists
+    full = os.path.join(_cache_dir(), "mxnet_native-%s-jpeg.so" % digest)
+    core = os.path.join(_cache_dir(), "mxnet_native-%s-core.so" % digest)
+    if os.path.exists(full):
+        return full
+    # a marker records a failed libjpeg link so later processes skip the
+    # doomed compile; deleting it (or installing libjpeg and clearing the
+    # cache dir) re-enables the attempt
+    marker = full + ".failed"
+    if has_img and not os.path.exists(marker):
+        tmp = full + ".tmp.%d" % os.getpid()
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+                 _src_path(), img_src, "-ljpeg", "-o", tmp],
+                check=True, capture_output=True)
+            os.replace(tmp, full)
+            return full
+        except Exception:
+            try:
+                with open(marker, "w") as f:
+                    f.write("libjpeg build failed; delete to retry\n")
+            except OSError:
+                pass
+    if os.path.exists(core):
+        return core
+    tmp = core + ".tmp.%d" % os.getpid()
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _src_path(),
+         "-o", tmp],
+        check=True, capture_output=True)
+    os.replace(tmp, core)
+    return core
 
 
 def get_lib():
@@ -73,6 +114,21 @@ def get_lib():
         lib.rio_abi_version.restype = ctypes.c_int
         if lib.rio_abi_version() != 1:
             return None
+        # image pipeline is optional (needs libjpeg at build time)
+        PF = ctypes.POINTER(ctypes.c_float)
+        try:
+            lib.img_jpeg_probe.restype = ctypes.c_int
+            lib.img_jpeg_probe.argtypes = [P8, L,
+                                           ctypes.POINTER(ctypes.c_int),
+                                           ctypes.POINTER(ctypes.c_int)]
+            lib.img_decode_aug_batch.restype = L
+            lib.img_decode_aug_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p), PL, L,
+                ctypes.c_int, ctypes.c_int, PL, P8, ctypes.c_int,
+                PF, PF, PF, P8, ctypes.c_int]
+            lib._has_image = True
+        except AttributeError:
+            lib._has_image = False
         _lib = lib
         return _lib
 
@@ -135,3 +191,68 @@ def gather(buf, offsets, lengths):
         out_offs.ctypes.data_as(ctypes.POINTER(ctypes.c_long)))
     assert w == total
     return out.tobytes(), out_offs
+
+
+def jpeg_available():
+    lib = get_lib()
+    return bool(lib is not None and getattr(lib, "_has_image", False))
+
+
+def decode_aug_batch(bufs, out_h, out_w, crops=None, flips=None, interp=1,
+                     mean=(0.0, 0.0, 0.0), scale=(1.0, 1.0, 1.0),
+                     nthreads=4):
+    """Decode+augment a batch of JPEG byte strings natively.
+
+    Returns (batch float32 (N, 3, out_h, out_w), ok uint8 (N,)) or None
+    when the native image pipeline is unavailable.  ``crops`` is an
+    (N, 4) int array of source (x, y, w, h) windows (w/h <= 0 = full
+    frame); ``flips`` an (N,) bool/uint8 array; normalization is
+    ``out = (pixel - mean[c]) * scale[c]`` per RGB channel.
+    """
+    if not jpeg_available():
+        return None
+    lib = get_lib()
+    n = len(bufs)
+    keep = [np.frombuffer(b, np.uint8) for b in bufs]  # keepalive
+    ptrs = (ctypes.c_void_p * n)(
+        *[k.ctypes.data_as(ctypes.c_void_p).value for k in keep])
+    lens = np.asarray([len(b) for b in bufs], np.int64)
+    if crops is None:
+        crops = np.full((n, 4), -1, np.int64)
+    crops = np.ascontiguousarray(crops, np.int64)
+    if flips is None:
+        flips = np.zeros(n, np.uint8)
+    flips = np.ascontiguousarray(flips, np.uint8)
+    mean_a = np.asarray(mean, np.float32)
+    scale_a = np.asarray(scale, np.float32)
+    out = np.empty((n, 3, out_h, out_w), np.float32)
+    ok = np.zeros(n, np.uint8)
+    lib.img_decode_aug_batch(
+        ctypes.cast(ptrs, ctypes.POINTER(ctypes.c_void_p)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_long)), n,
+        out_h, out_w,
+        crops.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        flips.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        int(interp),
+        mean_a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        scale_a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        int(nthreads))
+    return out, ok
+
+
+def jpeg_probe(buf):
+    """(h, w) of a JPEG byte string via a header-only parse, or None."""
+    if not jpeg_available():
+        return None
+    lib = get_lib()
+    arr = np.frombuffer(buf, np.uint8)
+    h = ctypes.c_int(0)
+    w = ctypes.c_int(0)
+    rc = lib.img_jpeg_probe(
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(buf),
+        ctypes.byref(h), ctypes.byref(w))
+    if rc != 0:
+        return None
+    return h.value, w.value

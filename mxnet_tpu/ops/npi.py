@@ -346,10 +346,20 @@ register("_npi_gamma", needs_rng=True)(
     lambda key, shape=1.0, scale=1.0, size=(), ctx=None, dtype="float32":
     scale * jax.random.gamma(key, shape, tuple(size) if size else (),
                              jnp.dtype(dtype)))
-register("_npi_choice", needs_rng=True)(
-    lambda key, a=1, size=(), replace=True, weights=None, ctx=None:
-    jax.random.choice(key, int(a), tuple(size) if size else (),
-                      replace=bool(replace)).astype(jnp.int64))
+@register("_npi_choice", needs_rng=True, inputs=("input1", "input2"))
+def _npi_choice(key, input1=None, input2=None, a=None, size=(),
+                replace=True, weights=None, ctx=None):
+    """np.random.choice: the pool is either the int attr ``a`` or a 1-D
+    array input; optional probability weights are the next array input
+    (reference: numpy/random/np_choice_op.cc input layout)."""
+    if a is not None:
+        pool, p = int(a), input1
+    else:
+        pool, p = input1, input2
+    if p is not None:
+        p = p / jnp.sum(p)
+    return jax.random.choice(key, pool, tuple(size) if size else (),
+                             replace=bool(replace), p=p).astype(jnp.int64)
 @register("_npi_multinomial", needs_rng=True, inputs=("data",))
 def _npi_multinomial(key, data, n=1, pvals=None, size=(), ctx=None):
     """np.random.multinomial semantics: ``n`` draws per experiment,

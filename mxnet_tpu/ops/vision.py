@@ -509,26 +509,31 @@ def _bipartite_matching(data, is_ascend=False, threshold=0.0, topk=-1):
     sign = 1.0 if not is_ascend else -1.0
 
     def one(scores):
-        s = scores * sign
+        s = scores * sign  # maximize sm regardless of direction
 
         def body(_, st):
-            row_m, col_m, sm = st
+            row_m, col_m, sm, count = st
             flat_i = jnp.argmax(sm)
             ri, ci = flat_i // m, flat_i % m
-            ok = sm[ri, ci] >= (threshold * sign if not is_ascend
-                                else -1e30)
+            raw = sm[ri, ci] * sign
+            # reference gate (bounding_box-inl.h:700): descending keeps
+            # scores > threshold, ascending keeps scores < threshold
+            ok = (raw > threshold) if not is_ascend else (raw < threshold)
             ok = ok & (sm[ri, ci] > _NEG / 2)
+            if topk > 0:
+                ok = ok & (count < topk)
             row_m = jnp.where(ok, row_m.at[ri].set(ci.astype(jnp.float32)),
                               row_m)
             col_m = jnp.where(ok, col_m.at[ci].set(ri.astype(jnp.float32)),
                               col_m)
             sm = jnp.where(ok, sm.at[ri, :].set(_NEG), sm)
             sm = jnp.where(ok, sm.at[:, ci].set(_NEG), sm)
-            return row_m, col_m, sm
+            return row_m, col_m, sm, count + ok.astype(jnp.int32)
 
         row0 = jnp.full((n,), -1.0)
         col0 = jnp.full((m,), -1.0)
-        row_m, col_m, _ = lax.fori_loop(0, min(n, m), body, (row0, col0, s))
+        row_m, col_m, _, _ = lax.fori_loop(
+            0, min(n, m), body, (row0, col0, s, jnp.int32(0)))
         return row_m, col_m
 
     rows, cols = jax.vmap(one)(flat.astype(jnp.float32))

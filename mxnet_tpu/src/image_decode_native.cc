@@ -1,0 +1,211 @@
+// Native JPEG decode + augment + batch assembly.
+//
+// The reference's image data plane is C++ (ImageRecordIOParser2 in
+// src/io/iter_image_recordio_2.cc: multithreaded RecordIO chunk read +
+// OpenCV JPEG decode + augment).  This is the TPU rebuild's native tier
+// for the same role: a libjpeg-backed thread pool decodes a batch of
+// JPEG payloads, crops/resizes/flips/normalizes each image, and writes
+// the finished NCHW float32 batch into one contiguous buffer — all
+// outside the Python GIL.  Python keeps orchestration (shuffle order,
+// RNG for crop/flip decisions, label handling), which preserves
+// reproducibility across the native and pure-Python paths.
+//
+// Built by mxnet_tpu/native.py with the system toolchain (g++ -ljpeg,
+// plain extern "C" ABI via ctypes — no pybind11 in the image).
+
+#include <csetjmp>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include <jpeglib.h>
+
+namespace {
+
+struct ErrMgr {
+  jpeg_error_mgr pub;
+  std::jmp_buf jmp;
+};
+
+void err_exit(j_common_ptr cinfo) {
+  ErrMgr* e = reinterpret_cast<ErrMgr*>(cinfo->err);
+  std::longjmp(e->jmp, 1);
+}
+
+// Decode one JPEG into an RGB HWC uint8 buffer (caller frees).
+// Returns true on success and sets (h, w).
+bool decode_rgb(const uint8_t* buf, long len, std::vector<uint8_t>* out,
+                int* h, int* w) {
+  jpeg_decompress_struct cinfo;
+  ErrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = err_exit;
+  if (setjmp(jerr.jmp)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, buf, static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = JCS_RGB;  // grayscale upsamples to RGB
+  jpeg_start_decompress(&cinfo);
+  *h = static_cast<int>(cinfo.output_height);
+  *w = static_cast<int>(cinfo.output_width);
+  out->resize(static_cast<size_t>(*h) * *w * 3);
+  const int stride = *w * 3;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out->data() +
+        static_cast<size_t>(cinfo.output_scanline) * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// Sample source pixel (bilinear interp=1, nearest interp=0) from an RGB
+// HWC crop window and write a normalized CHW float pixel.
+inline void resample_to(const uint8_t* src, int sh, int sw, int x0, int y0,
+                        int cw, int ch, int out_h, int out_w, int interp,
+                        bool flip, const float* mean, const float* scale,
+                        float* dst) {
+  const long plane = static_cast<long>(out_h) * out_w;
+  for (int oy = 0; oy < out_h; ++oy) {
+    // only the bilinear branch reads fy; when ch == out_h the formula
+    // reduces to oy exactly, so no special case
+    const float fy = interp ? (oy + 0.5f) * ch / out_h - 0.5f : 0.f;
+    for (int ox = 0; ox < out_w; ++ox) {
+      const int oxx = flip ? (out_w - 1 - ox) : ox;
+      float r, g, b;
+      if (cw == out_w && ch == out_h) {
+        const uint8_t* p = src +
+            (static_cast<long>(y0 + oy) * sw + (x0 + ox)) * 3;
+        r = p[0]; g = p[1]; b = p[2];
+      } else if (!interp) {
+        int sy = y0 + static_cast<int>(oy * static_cast<float>(ch) / out_h);
+        int sx = x0 + static_cast<int>(ox * static_cast<float>(cw) / out_w);
+        if (sy > y0 + ch - 1) sy = y0 + ch - 1;
+        if (sx > x0 + cw - 1) sx = x0 + cw - 1;
+        const uint8_t* p = src + (static_cast<long>(sy) * sw + sx) * 3;
+        r = p[0]; g = p[1]; b = p[2];
+      } else {
+        float fx = (ox + 0.5f) * cw / out_w - 0.5f;
+        float yy = fy < 0 ? 0 : fy;
+        float xx = fx < 0 ? 0 : fx;
+        if (yy > ch - 1) yy = static_cast<float>(ch - 1);
+        if (xx > cw - 1) xx = static_cast<float>(cw - 1);
+        const int iy = static_cast<int>(yy), ix = static_cast<int>(xx);
+        const int iy1 = iy + 1 > ch - 1 ? iy : iy + 1;
+        const int ix1 = ix + 1 > cw - 1 ? ix : ix + 1;
+        const float wy = yy - iy, wx = xx - ix;
+        const uint8_t* p00 = src +
+            (static_cast<long>(y0 + iy) * sw + (x0 + ix)) * 3;
+        const uint8_t* p01 = src +
+            (static_cast<long>(y0 + iy) * sw + (x0 + ix1)) * 3;
+        const uint8_t* p10 = src +
+            (static_cast<long>(y0 + iy1) * sw + (x0 + ix)) * 3;
+        const uint8_t* p11 = src +
+            (static_cast<long>(y0 + iy1) * sw + (x0 + ix1)) * 3;
+        r = (1 - wy) * ((1 - wx) * p00[0] + wx * p01[0]) +
+            wy * ((1 - wx) * p10[0] + wx * p11[0]);
+        g = (1 - wy) * ((1 - wx) * p00[1] + wx * p01[1]) +
+            wy * ((1 - wx) * p10[1] + wx * p11[1]);
+        b = (1 - wy) * ((1 - wx) * p00[2] + wx * p01[2]) +
+            wy * ((1 - wx) * p10[2] + wx * p11[2]);
+      }
+      float* px = dst + static_cast<long>(oy) * out_w + oxx;
+      px[0] = (r - mean[0]) * scale[0];
+      px[plane] = (g - mean[1]) * scale[1];
+      px[2 * plane] = (b - mean[2]) * scale[2];
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Probe JPEG dimensions without a full decode.  Returns 0 on success.
+int img_jpeg_probe(const uint8_t* buf, long len, int* h, int* w) {
+  jpeg_decompress_struct cinfo;
+  ErrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = err_exit;
+  if (setjmp(jerr.jmp)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, buf, static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  *h = static_cast<int>(cinfo.image_height);
+  *w = static_cast<int>(cinfo.image_width);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+// Decode + augment a batch of n JPEGs into a contiguous NCHW float32
+// batch.  Per image i:
+//   crop_xywh[4i..4i+3]: source crop rect; cw/ch <= 0 means full frame
+//     (the Python side passes exact (out_w, out_h) windows for the
+//     random/center-crop path, or full frame for the resize path),
+//   flips[i]: horizontal mirror,
+//   interp: 0 nearest / 1 bilinear for the resize path,
+//   mean/scale: per-RGB-channel normalization out = (pix - mean)*scale.
+// ok[i] gets 1/0 per image; returns the number decoded successfully.
+long img_decode_aug_batch(const uint8_t* const* bufs, const long* lens,
+                          long n, int out_h, int out_w,
+                          const long* crop_xywh, const uint8_t* flips,
+                          int interp, const float* mean,
+                          const float* scale, float* out, uint8_t* ok,
+                          int nthreads) {
+  if (nthreads < 1) nthreads = 1;
+  if (nthreads > n) nthreads = static_cast<int>(n);
+  std::vector<long> done(nthreads, 0);
+
+  auto work = [&](int tid) {
+    std::vector<uint8_t> rgb;
+    for (long i = tid; i < n; i += nthreads) {
+      int h = 0, w = 0;
+      if (!decode_rgb(bufs[i], lens[i], &rgb, &h, &w)) {
+        ok[i] = 0;
+        continue;
+      }
+      long x0 = crop_xywh[4 * i], y0 = crop_xywh[4 * i + 1];
+      long cw = crop_xywh[4 * i + 2], ch = crop_xywh[4 * i + 3];
+      if (cw <= 0 || ch <= 0) { x0 = 0; y0 = 0; cw = w; ch = h; }
+      if (x0 < 0) x0 = 0;
+      if (y0 < 0) y0 = 0;
+      if (x0 + cw > w) cw = w - x0;
+      if (y0 + ch > h) ch = h - y0;
+      if (cw <= 0 || ch <= 0) {
+        ok[i] = 0;
+        continue;
+      }
+      resample_to(rgb.data(), h, w, static_cast<int>(x0),
+                  static_cast<int>(y0), static_cast<int>(cw),
+                  static_cast<int>(ch), out_h, out_w, interp,
+                  flips[i] != 0, mean, scale,
+                  out + static_cast<long>(i) * 3 * out_h * out_w);
+      ok[i] = 1;
+      ++done[tid];
+    }
+  };
+
+  std::vector<std::thread> pool;
+  for (int t = 1; t < nthreads; ++t) pool.emplace_back(work, t);
+  work(0);
+  for (auto& th : pool) th.join();
+  long total = 0;
+  for (long d : done) total += d;
+  return total;
+}
+
+}  // extern "C"

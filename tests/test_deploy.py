@@ -1,0 +1,74 @@
+"""StableHLO deployment artifacts (mxnet_tpu/deploy.py): export, load,
+predict, weight swap, signature checks.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, deploy
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+
+
+def _net():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def test_export_predict_roundtrip():
+    mx.random.seed(0)
+    net = _net()
+    x = nd.array(np.random.RandomState(0).rand(2, 8).astype(np.float32))
+    ref = net(x).asnumpy()
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "m.mxtpu")
+        meta = deploy.export_model(net, (x,), path)
+        assert meta["n_inputs"] == 1
+        pred = deploy.Predictor(path)
+        out = pred.predict(x)
+        # XLA may fuse the exported module differently from the eager
+        # per-op path; tolerance covers reassociation, not bugs
+        assert np.abs(out.asnumpy() - ref).max() < 1e-2
+        assert "stablehlo" in pred.mlir or "func.func" in pred.mlir
+
+
+def test_separate_params_and_swap():
+    mx.random.seed(1)
+    net = _net()
+    x = nd.array(np.random.RandomState(1).rand(3, 8).astype(np.float32))
+    ref = net(x).asnumpy()
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "m.mxtpu")
+        deploy.export_model(net, (x,), path, embed_params=False)
+        pred = deploy.Predictor(path)
+        assert np.abs(pred.predict(x).asnumpy() - ref).max() < 1e-2
+        pred.set_params([np.zeros_like(w) for w in pred._weights])
+        assert np.abs(pred.predict(x).asnumpy()).max() == 0.0
+
+
+def test_signature_checked():
+    mx.random.seed(2)
+    net = _net()
+    x = nd.array(np.random.RandomState(2).rand(2, 8).astype(np.float32))
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "m.mxtpu")
+        deploy.export_model(net, (x,), path)
+        pred = deploy.Predictor(path)
+        with pytest.raises(MXNetError):
+            pred.predict(nd.array(np.zeros((2, 9), np.float32)))
+        with pytest.raises(MXNetError):
+            pred.predict(x, x)
+
+
+def test_bad_file_rejected():
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "junk")
+        with open(path, "wb") as f:
+            f.write(b"not a model")
+        with pytest.raises(MXNetError):
+            deploy.Predictor(path)

@@ -1,0 +1,167 @@
+"""Native JPEG decode/augment pipeline (src/image_decode_native.cc).
+
+Golden parity: the native C++ path must produce byte-identical batches to
+the pure-Python (PIL + numpy) path under the same np.random seed, since
+crop/flip decisions share one RNG stream.
+"""
+import io as _io
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import native, recordio as rio
+from mxnet_tpu import io as mio
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+pytestmark = pytest.mark.skipif(not native.jpeg_available(),
+                                reason="native image pipeline unavailable")
+
+
+def _jpeg_bytes(arr, quality=95):
+    buf = _io.BytesIO()
+    Image.fromarray(arr).save(buf, "JPEG", quality=quality)
+    return buf.getvalue()
+
+
+def test_decode_matches_pil():
+    rs = np.random.RandomState(0)
+    img = (rs.rand(37, 53, 3) * 255).astype(np.uint8)
+    jpg = _jpeg_bytes(img)
+    out, ok = native.decode_aug_batch([jpg], 37, 53, interp=0)
+    assert ok.all()
+    pil = np.asarray(Image.open(_io.BytesIO(jpg))).astype(np.float32)
+    assert np.abs(out[0].transpose(1, 2, 0) - pil).max() == 0.0
+
+
+def test_probe():
+    img = np.zeros((24, 31, 3), np.uint8)
+    h, w = native.jpeg_probe(_jpeg_bytes(img))
+    assert (h, w) == (24, 31)
+    assert native.jpeg_probe(b"not a jpeg") is None
+
+
+def test_crop_flip_normalize():
+    rs = np.random.RandomState(1)
+    img = (rs.rand(40, 50, 3) * 255).astype(np.uint8)
+    jpg = _jpeg_bytes(img)
+    pil = np.asarray(Image.open(_io.BytesIO(jpg))).astype(np.float32)
+    crops = np.array([[10, 5, 16, 16]], np.int64)
+    flips = np.array([1], np.uint8)
+    out, ok = native.decode_aug_batch(
+        [jpg], 16, 16, crops=crops, flips=flips,
+        mean=(127.5,) * 3, scale=(1 / 127.5,) * 3)
+    assert ok.all()
+    ref = (pil[5:21, 10:26][:, ::-1] - 127.5) / 127.5
+    assert np.abs(out[0].transpose(1, 2, 0) - ref).max() < 1e-6
+
+
+def test_grayscale_upsamples_to_rgb():
+    img = (np.arange(32 * 32, dtype=np.uint8).reshape(32, 32) % 255)
+    jpg = _jpeg_bytes(img)
+    out, ok = native.decode_aug_batch([jpg], 32, 32, interp=0)
+    assert ok.all()
+    # all three channels identical
+    assert np.abs(out[0][0] - out[0][1]).max() == 0.0
+
+
+def test_corrupt_stream_flags_not_ok():
+    out, ok = native.decode_aug_batch([b"\xff\xd8garbage"], 8, 8)
+    assert not ok.any()
+
+
+def _make_rec(tmp, n=16, hw=(48, 56)):
+    rec_path = os.path.join(tmp, "data.rec")
+    rs = np.random.RandomState(0)
+    w = rio.MXRecordIO(rec_path, "w")
+    for i in range(n):
+        img = (rs.rand(*hw, 3) * 255).astype(np.uint8)
+        w.write(rio.pack_img(rio.IRHeader(0, float(i % 4), i, 0), img,
+                             img_fmt=".jpg"))
+    w.close()
+    return rec_path
+
+
+def test_image_record_iter_native_matches_python():
+    with tempfile.TemporaryDirectory() as tmp:
+        rec = _make_rec(tmp)
+        kw = dict(data_shape=(3, 32, 32), batch_size=4, rand_crop=True,
+                  rand_mirror=True, mean_r=127.0, mean_g=127.0,
+                  mean_b=127.0, scale=1 / 128.0)
+        np.random.seed(42)
+        it = mio.ImageRecordIter(rec, **kw)
+        b_native = it.next()
+        assert it._native is True
+        np.random.seed(42)
+        it2 = mio.ImageRecordIter(rec, **kw)
+        it2._native = False
+        b_py = it2.next()
+        assert np.array_equal(b_native.data[0].asnumpy(),
+                              b_py.data[0].asnumpy())
+        assert np.array_equal(b_native.label[0].asnumpy(),
+                              b_py.label[0].asnumpy())
+
+
+def test_image_record_iter_small_images_resize_path():
+    # images smaller than the target go through the full-frame nearest
+    # resize, which must also match the python path exactly
+    with tempfile.TemporaryDirectory() as tmp:
+        rec = _make_rec(tmp, hw=(20, 24))
+        kw = dict(data_shape=(3, 32, 32), batch_size=4)
+        np.random.seed(7)
+        it = mio.ImageRecordIter(rec, **kw)
+        b_native = it.next()
+        assert it._native is True
+        np.random.seed(7)
+        it2 = mio.ImageRecordIter(rec, **kw)
+        it2._native = False
+        b_py = it2.next()
+        assert np.array_equal(b_native.data[0].asnumpy(),
+                              b_py.data[0].asnumpy())
+
+
+def test_npy_payload_falls_back_to_python():
+    with tempfile.TemporaryDirectory() as tmp:
+        rec_path = os.path.join(tmp, "npy.rec")
+        w = rio.MXRecordIO(rec_path, "w")
+        rs = np.random.RandomState(0)
+        for i in range(4):
+            img = (rs.rand(32, 32, 3) * 255).astype(np.uint8)
+            w.write(rio.pack_img(rio.IRHeader(0, float(i), i, 0), img,
+                                 img_fmt=".npy"))
+        w.close()
+        it = mio.ImageRecordIter(rec_path, (3, 32, 32), batch_size=4)
+        b = it.next()
+        assert it._native is False
+        assert b.data[0].shape == (4, 3, 32, 32)
+
+
+def test_bilinear_vertical_resize():
+    """interp=1 with only one axis resized must interpolate both axes
+    (regression: the fy fast path returned row 0 for every output row)."""
+    rs = np.random.RandomState(3)
+    img = np.zeros((32, 16, 3), np.uint8)
+    img[16:] = 200  # bottom half bright
+    jpg = _jpeg_bytes(img, quality=100)
+    out, ok = native.decode_aug_batch([jpg], 16, 16, interp=1)
+    assert ok.all()
+    got = out[0][0]  # (16, 16) single channel
+    # top rows dark, bottom rows bright — not a repeated first scanline
+    assert got[0].mean() < 50
+    assert got[-1].mean() > 150
+
+
+def test_channel_mismatch_fails_loudly():
+    """A non-RGB data_shape must not be silently served as 3 channels by
+    the native path: it bails to the python path, which raises the same
+    shape error it always did."""
+    with tempfile.TemporaryDirectory() as tmp:
+        rec = _make_rec(tmp)
+        it = mio.ImageRecordIter(rec, (1, 28, 28), batch_size=4)
+        with pytest.raises(ValueError):
+            it.next()
+        assert it._native is False
