@@ -267,7 +267,7 @@ def _run(platform):
 
     on_accel = platform not in ("cpu",)
     argv_batch = [a for a in sys.argv[1:] if a.isdigit()]
-    batch = int(argv_batch[0]) if argv_batch else (128 if on_accel else 8)
+    batch = int(argv_batch[0]) if argv_batch else (256 if on_accel else 8)
     image = 224 if on_accel else 64
     n_steps = 10 if on_accel else 2
 

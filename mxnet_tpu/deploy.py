@@ -60,9 +60,11 @@ def export_model(net, example_inputs, path, embed_params=True,
         example_inputs = (example_inputs,)
     xs = tuple(np.asarray(x.asnumpy() if isinstance(x, NDArray) else x)
                for x in example_inputs)
-    # resolve deferred shapes with one forward
-    net(*[NDArray(np.asarray(x)) for x in xs])
+    # resolve deferred shapes with one forward — only when needed
     params = list(net.collect_params().values())
+    if any(p._data is None for p in params):
+        net(*[NDArray(np.asarray(x)) for x in xs])
+        params = list(net.collect_params().values())
     weights = tuple(p.data().data() for p in params)
 
     def fwd(inputs, ws):

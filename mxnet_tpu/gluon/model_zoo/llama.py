@@ -29,6 +29,15 @@ from .. import nn
 from ..block import HybridBlock
 
 
+def _clear_caches(block):
+    """Recursively drop hybridize caches (the kernel choice is baked into
+    compiled executables, so toggles must invalidate the whole tree)."""
+    if hasattr(block, "clear_cache"):
+        block.clear_cache()
+    for child in getattr(block, "_children", {}).values():
+        _clear_caches(child)
+
+
 class RMSNorm(HybridBlock):
     """Root-mean-square norm (no mean subtraction), Llama convention."""
 
@@ -59,7 +68,15 @@ def _rope(F, x, base=10000.0):
 
 
 class LlamaAttention(HybridBlock):
-    """Causal self-attention with RoPE and grouped-query KV heads."""
+    """Causal self-attention with RoPE and grouped-query KV heads.
+
+    ``sequence_parallel(mesh, axis_name)`` switches the attention kernel
+    from the single-chip Pallas flash attention to
+    ``parallel.ring_attention_sharded``: Q stays resident per chip while
+    K/V blocks travel the ICI ring (ppermute) with an online softmax —
+    the long-context design of SURVEY §5.7.  The mesh axis size must
+    divide the sequence length T.
+    """
 
     def __init__(self, units, num_heads, num_kv_heads=None,
                  rope_base=10000.0, prefix=None, params=None):
@@ -71,6 +88,7 @@ class LlamaAttention(HybridBlock):
         self._heads = num_heads
         self._kv_heads = num_kv_heads
         self._base = rope_base
+        self._sp = None  # (mesh, axis_name) when sequence-parallel
         d = units // num_heads
         with self.name_scope():
             self.q_proj = nn.Dense(units, flatten=False, use_bias=False,
@@ -98,11 +116,56 @@ class LlamaAttention(HybridBlock):
             # broadcast into the attention matmuls)
             k = F.repeat(k, repeats=h // kv, axis=1)
             v = F.repeat(v, repeats=h // kv, axis=1)
-        out = F.contrib.flash_attention(
-            q, k, v, scale=1.0 / math.sqrt(d), causal=True)
+        if self._sp is not None:
+            out = self._ring_attention(q, k, v, 1.0 / math.sqrt(d))
+        else:
+            out = F.contrib.flash_attention(
+                q, k, v, scale=1.0 / math.sqrt(d), causal=True)
         out = F.reshape(F.transpose(out, axes=(0, 2, 1, 3)),
                         shape=(b, t, self._units))
         return self.o_proj(out)
+
+    def sequence_parallel(self, mesh, axis_name="sp"):
+        """Enable ring attention over ``axis_name`` of ``mesh`` (pass
+        ``None`` to return to flash attention).  Any hybridize cache of
+        this block is dropped — _sp is consulted at trace time, so a
+        stale compiled kernel would silently keep the old attention."""
+        self._sp = None if mesh is None else (mesh, axis_name)
+        if hasattr(self, "clear_cache"):
+            self.clear_cache()
+        return self
+
+    def _ring_attention(self, q, k, v, scale):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec, \
+            SingleDeviceSharding
+
+        from ...ops.registry import invoke_fn
+        from ...parallel import ring_attention_sharded
+
+        mesh, axis = self._sp
+        # three tape nodes: scatter -> ring -> gather.  The scatter/
+        # gather are plain device_put (differentiable, trace-safe); by
+        # the time the ring's shard_map records its tape node, the
+        # stored primals are ALREADY mesh-sharded, so the backward
+        # re-trace (jax.vjp over the stored primals) sees correctly
+        # placed arrays.  Under a fully jitted multi-chip train step the
+        # device_puts become GSPMD sharding constraints.
+        sh_in = NamedSharding(mesh, PartitionSpec(None, None, axis, None))
+        sh_out = SingleDeviceSharding(list(mesh.devices.flat)[0])
+        q, k, v = invoke_fn(
+            lambda qq, kk, vv: tuple(jax.device_put(x, sh_in)
+                                     for x in (qq, kk, vv)),
+            [q, k, v], op_name="ring_scatter")
+        (out,) = invoke_fn(
+            lambda qq, kk, vv: (ring_attention_sharded(
+                qq, kk, vv, mesh, axis_name=axis, scale=scale,
+                causal=True),),
+            [q, k, v], op_name="ring_attention")
+        (out,) = invoke_fn(
+            lambda o: (jax.device_put(o, sh_out),), [out],
+            op_name="ring_gather")
+        return out
 
 
 class LlamaFFN(HybridBlock):
@@ -142,7 +205,10 @@ class LlamaBlock(HybridBlock):
 
 
 class LlamaModel(HybridBlock):
-    """Decoder-only LM.  forward(tokens (B,T)) → logits (B,T,V)."""
+    """Decoder-only LM.  forward(tokens (B,T)) → logits (B,T,V).
+
+    ``sequence_parallel(mesh, axis)`` flips every attention layer to the
+    ring-attention kernel for long-context training across chips."""
 
     def __init__(self, vocab_size, units=4096, hidden_size=11008,
                  num_layers=32, num_heads=32, num_kv_heads=None,
@@ -162,6 +228,12 @@ class LlamaModel(HybridBlock):
             if not tie_embeddings:
                 self.lm_head = nn.Dense(vocab_size, flatten=False,
                                         use_bias=False, prefix="head_")
+
+    def sequence_parallel(self, mesh, axis_name="sp"):
+        for blk in self.blocks._children.values():
+            blk.attn.sequence_parallel(mesh, axis_name)
+        _clear_caches(self)  # the model-level compiled graph is stale too
+        return self
 
     def hybrid_forward(self, F, tokens):
         x = self.embed(tokens)

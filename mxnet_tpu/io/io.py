@@ -629,16 +629,9 @@ class ImageRecordIter(DataIter):
         crops = np.empty((self.batch_size, 4), np.int64)
         flips = np.zeros(self.batch_size, np.uint8)
         for i, (ih, iw) in enumerate(dims_list):
-            if self.rand_crop and ih >= h and iw >= w:
-                y0 = np.random.randint(0, ih - h + 1)
-                x0 = np.random.randint(0, iw - w + 1)
-                crops[i] = (x0, y0, w, h)
-            elif ih >= h and iw >= w:
-                crops[i] = ((iw - w) // 2, (ih - h) // 2, w, h)
-            else:
-                crops[i] = (-1, -1, -1, -1)  # full frame + resize
-            if self.rand_mirror and np.random.rand() < 0.5:
-                flips[i] = 1
+            crop, flip = self._draw_aug(ih, iw, h, w)
+            crops[i] = crop
+            flips[i] = 1 if flip else 0
         self._native = True
         out, ok = native.decode_aug_batch(
             bufs, h, w, crops=crops, flips=flips, interp=0,
@@ -678,26 +671,25 @@ class ImageRecordIter(DataIter):
         return (arr - self.mean) * self.scale
 
     def _prep(self, img, h, w):
-        arr = np.asarray(img, dtype=np.float32)
-        if arr.ndim == 2:
-            arr = arr[:, :, None].repeat(3, axis=2)
+        """Draw this record's (crop, flip) decision, then apply it via
+        _apply_aug — the SAME function the native path's fallback uses,
+        so the transform logic exists exactly once and the two paths
+        cannot drift."""
+        arr = np.asarray(img)
         ih, iw = arr.shape[:2]
+        crop, flip = self._draw_aug(ih, iw, h, w)
+        return self._apply_aug(img, crop, flip, h, w)
+
+    def _draw_aug(self, ih, iw, h, w):
+        """(crop_xywh, flip) for one record, consuming np.random in the
+        canonical order (randint y, randint x, rand for mirror)."""
         if self.rand_crop and ih >= h and iw >= w:
             y0 = np.random.randint(0, ih - h + 1)
             x0 = np.random.randint(0, iw - w + 1)
+            crop = (x0, y0, w, h)
+        elif ih >= h and iw >= w:
+            crop = ((iw - w) // 2, (ih - h) // 2, w, h)
         else:
-            y0, x0 = max(0, (ih - h) // 2), max(0, (iw - w) // 2)
-        arr = arr[y0:y0 + h, x0:x0 + w]
-        if arr.shape[0] != h or arr.shape[1] != w:
-            yy = np.clip(
-                (np.arange(h) * ih / float(h)).astype(int), 0, ih - 1)
-            xx = np.clip(
-                (np.arange(w) * iw / float(w)).astype(int), 0, iw - 1)
-            arr = np.asarray(img, dtype=np.float32)
-            if arr.ndim == 2:
-                arr = arr[:, :, None].repeat(3, axis=2)
-            arr = arr[yy][:, xx]
-        if self.rand_mirror and np.random.rand() < 0.5:
-            arr = arr[:, ::-1]
-        arr = arr.transpose(2, 0, 1)
-        return (arr - self.mean) * self.scale
+            crop = (-1, -1, -1, -1)  # full frame + nearest resize
+        flip = bool(self.rand_mirror and np.random.rand() < 0.5)
+        return crop, flip
