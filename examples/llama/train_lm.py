@@ -20,6 +20,23 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "..", ".."))
 
+if "--ring" in sys.argv:
+    # the ring demo needs an 8-way mesh; on a single-chip/CPU host build
+    # it from 8 virtual CPU devices (the same trick the test suite and
+    # the multichip dryrun use) BEFORE any jax backend initializes
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except Exception:
+        pass
+
 import mxnet_tpu as mx
 from mxnet_tpu import gluon, parallel
 from mxnet_tpu.gluon.model_zoo import llama
@@ -37,8 +54,17 @@ def main():
     args = ap.parse_args()
 
     mx.random.seed(0)
-    net = llama.LlamaModel(args.vocab, units=128, hidden_size=256,
-                           num_layers=4, num_heads=8, num_kv_heads=4)
+    if args.ring:
+        # virtual-CPU ring steps re-trace shard_map per layer per
+        # backward (minutes each at full size — a CPU-emulation cost,
+        # not a TPU one), so the demo config stays small
+        args.steps = min(args.steps, 3)
+        args.seqlen = min(args.seqlen, 64)
+        net = llama.LlamaModel(args.vocab, units=64, hidden_size=128,
+                               num_layers=1, num_heads=4, num_kv_heads=2)
+    else:
+        net = llama.LlamaModel(args.vocab, units=128, hidden_size=256,
+                               num_layers=4, num_heads=8, num_kv_heads=4)
     net.initialize(mx.init.Xavier())
     if args.ring:
         mesh = parallel.make_mesh({"sp": 8})
@@ -55,9 +81,17 @@ def main():
         def hybrid_forward(self, F, toks):
             return F.reshape(self.inner(toks), shape=(-1, vocab))
 
-    step = parallel.JitTrainStep(
-        LM(net), gluon.loss.SoftmaxCrossEntropyLoss(),
-        "adamw", {"learning_rate": 3e-4})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    if args.ring:
+        # ring mode drives the mesh collectives itself (scatter -> ring
+        # -> gather per layer), so train eagerly; the flash path compiles
+        # the whole step into one executable instead
+        trainer = gluon.Trainer(net.collect_params(), "adamw",
+                                {"learning_rate": 3e-4})
+        step = None
+    else:
+        step = parallel.JitTrainStep(
+            LM(net), loss_fn, "adamw", {"learning_rate": 3e-4})
 
     rng = np.random.RandomState(0)
     # synthetic "language": next token = (token * 31 + 7) % vocab, so the
@@ -71,9 +105,21 @@ def main():
 
     t0 = time.perf_counter()
     for i in range(args.steps):
-        loss = step.step(toks, labels)
+        if step is not None:
+            loss = step.step(toks, labels)
+            val = float(loss)
+        else:
+            from mxnet_tpu import autograd, nd
+
+            with autograd.record():
+                logits = net(nd.array(toks.astype(np.float32)))
+                l = loss_fn(logits.reshape(-3, 0),
+                            nd.array(labels)).mean()
+            l.backward()
+            trainer.step(1)
+            val = float(l.asscalar())
         if i % 10 == 0 or i == args.steps - 1:
-            print("step %3d  loss %.4f" % (i, float(loss)))
+            print("step %3d  loss %.4f" % (i, val))
     dt = time.perf_counter() - t0
     tok_s = args.batch * args.seqlen * args.steps / dt
     print("done: %.0f tokens/s (incl. compile)" % tok_s)

@@ -30,12 +30,10 @@ from ..block import HybridBlock
 
 
 def _clear_caches(block):
-    """Recursively drop hybridize caches (the kernel choice is baked into
-    compiled executables, so toggles must invalidate the whole tree)."""
-    if hasattr(block, "clear_cache"):
-        block.clear_cache()
-    for child in getattr(block, "_children", {}).values():
-        _clear_caches(child)
+    """Drop hybridize caches across the whole tree (the kernel choice is
+    baked into compiled executables, so toggles must invalidate them)."""
+    block.apply(lambda b: b.clear_cache()
+                if hasattr(b, "clear_cache") else None)
 
 
 class RMSNorm(HybridBlock):
@@ -127,9 +125,13 @@ class LlamaAttention(HybridBlock):
 
     def sequence_parallel(self, mesh, axis_name="sp"):
         """Enable ring attention over ``axis_name`` of ``mesh`` (pass
-        ``None`` to return to flash attention).  Any hybridize cache of
-        this block is dropped — _sp is consulted at trace time, so a
-        stale compiled kernel would silently keep the old attention."""
+        ``None`` to return to flash attention).
+
+        Clears THIS block's hybridize cache only.  When the attention
+        sits inside a hybridized parent (the usual case), the compiled
+        graph lives on that parent — toggle through
+        ``LlamaModel.sequence_parallel``, which invalidates the whole
+        tree, or call ``parent.clear_cache()`` yourself."""
         self._sp = None if mesh is None else (mesh, axis_name)
         if hasattr(self, "clear_cache"):
             self.clear_cache()

@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Cross-device op consistency sweep: TPU vs CPU oracle.
+
+Parity: the reference's GPU test strategy (SURVEY §4.2) —
+``tests/python/gpu/test_operator_gpu.py`` re-runs the CPU op suite on
+GPU and ``check_consistency`` (test_utils.py:1422) compares outputs
+across devices.  Here the same idea runs against the numerics sweep's
+spec table: every op with a sweep spec executes on the TPU and on the
+CPU backend, and outputs must agree within dtype-appropriate tolerance.
+
+Run on a machine with a TPU attached:
+
+    python tools/check_tpu_consistency.py [--ops a,b,c] [--tol 2e-2]
+
+The unit suite pins JAX_PLATFORMS=cpu (tests/conftest.py), so this
+sweep is the designated way to exercise the op library on real
+hardware; the driver's bench covers the model-level path.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "tests"))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", default="",
+                    help="comma-separated subset (default: every spec)")
+    ap.add_argument("--tol", type=float, default=2e-2,
+                    help="max |tpu - cpu| / max(1, |cpu|) allowed")
+    ap.add_argument("--output", default="")
+    args = ap.parse_args()
+
+    import numpy as np
+    import jax
+
+    devices = jax.devices()
+    accel = [d for d in devices if d.platform != "cpu"]
+    if not accel:
+        print("no accelerator available; nothing to check", file=sys.stderr)
+        return 1
+    cpu = jax.devices("cpu")[0]
+    dev = accel[0]
+    print("comparing %s vs %s" % (dev, cpu), file=sys.stderr)
+
+    import test_op_numerics as sweep  # the sweep's spec table is the input
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    names = sorted(sweep.SPECS)
+    if args.ops:
+        names = [n for n in args.ops.split(",") if n in sweep.SPECS]
+    results = {"pass": [], "fail": [], "skip": []}
+    for name in names:
+        if _is_random(name):
+            results["skip"].append(name)
+            continue
+        spec = sweep.SPECS[name]
+        specs = spec if isinstance(spec, list) else [spec]
+        ok = True
+        err = 0.0
+        try:
+            for s in specs:
+                outs_t = _run(name, s, mx, nd, dev)
+                outs_c = _run(name, s, mx, nd, cpu)
+                if name in _DECOMP:
+                    # factorizations are unique only up to sign/rotation:
+                    # compare the reconstruction, not the factors
+                    outs_t = [_reconstruct(name, outs_t)]
+                    outs_c = [_reconstruct(name, outs_c)]
+                for a, b in zip(outs_t, outs_c):
+                    aa = np.asarray(a, np.float64)
+                    bb = np.asarray(b, np.float64)
+                    if aa.shape != bb.shape:
+                        ok = False
+                        break
+                    if aa.dtype.kind in "fc":
+                        denom = max(1.0, float(np.abs(bb).max()))
+                        err = max(err,
+                                  float(np.abs(aa - bb).max()) / denom)
+                    else:
+                        err = max(err, float((aa != bb).any()))
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            print("ERROR %-40s %s" % (name, e), file=sys.stderr)
+            ok = False
+        if ok and err <= args.tol:
+            results["pass"].append(name)
+        else:
+            results["fail"].append({"op": name, "err": err})
+            print("FAIL %-40s rel err %.3g" % (name, err), file=sys.stderr)
+    print("passed %d / failed %d / skipped %d (random)"
+          % (len(results["pass"]), len(results["fail"]),
+             len(results["skip"])), file=sys.stderr)
+    line = json.dumps({
+        "metric": "tpu_cpu_op_consistency",
+        "passed": len(results["pass"]),
+        "failed": len(results["fail"]),
+        "skipped_random": len(results["skip"]),
+        "failures": results["fail"][:20],
+    })
+    print(line)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(line)
+    return 0 if not results["fail"] else 2
+
+
+_DECOMP = {"_npi_svd", "_linalg_svd"}
+
+
+def _reconstruct(name, outs):
+    import numpy as np
+
+    u, sv, vt = (np.asarray(o, np.float64) for o in outs[:3])
+    return u @ np.diag(sv) @ vt
+
+
+def _is_random(name):
+    r = ("_random_", "sample_", "_npi_uniform", "_npi_normal",
+         "_npi_bernoulli", "_npi_exponential", "_npi_gamma", "_npi_choice",
+         "_npi_multinomial", "_shuffle", "Dropout", "uniform", "normal",
+         "gamma", "exponential", "negative_binomial", "poisson",
+         "randint", "randn", "LeakyReLU")
+    return any(k in name for k in r)
+
+
+def _run(name, spec, mx, nd, device):
+    """Execute one spec's forward with inputs placed on ``device``."""
+    import jax
+
+    mx.random.seed(7)
+    inputs = []
+    for x in spec.inputs:
+        arr = nd.array(x)
+        arr._set_data(jax.device_put(arr.data(), device))
+        inputs.append(arr)
+    fn = getattr(mx.nd, name, None)
+    if fn is None:
+        from mxnet_tpu.ndarray.register import make_op_func
+
+        fn = make_op_func(name)
+    out = fn(*inputs, **spec.attrs)
+    outs = out if isinstance(out, list) else [out]
+    return [o.asnumpy() for o in outs]
+
+
+if __name__ == "__main__":
+    sys.exit(main())
