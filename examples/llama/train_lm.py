@@ -21,21 +21,35 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "..", ".."))
 
 if "--ring" in sys.argv:
-    # the ring demo needs an 8-way mesh; on a single-chip/CPU host build
-    # it from 8 virtual CPU devices (the same trick the test suite and
-    # the multichip dryrun use) BEFORE any jax backend initializes
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8").strip()
+    # the ring demo needs an 8-way mesh.  A real multi-chip backend (a
+    # pod host) is used as-is — the ring rides the ICI; otherwise build
+    # the mesh from 8 virtual CPU devices (the same fallback the test
+    # suite and the multichip dryrun use)
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
     try:
-        jax.config.update("jax_num_cpu_devices", 8)
+        n_real = len(jax.devices())
     except Exception:
-        pass
+        n_real = 0
+    if n_real < 8:
+        try:
+            from jax._src import xla_bridge as _xb
+
+            _xb._clear_backends()
+            _xb.get_backend.cache_clear()
+        except Exception:
+            pass
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.config.update("jax_num_cpu_devices", 8)
+        except Exception:
+            pass
 
 import mxnet_tpu as mx
 from mxnet_tpu import gluon, parallel

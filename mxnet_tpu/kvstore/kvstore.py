@@ -71,10 +71,24 @@ class KVStore(KVStoreBase):
                 NDArray(v)
 
     def _reduce(self, values):
+        """Sum pushed buffers, wherever they live.
+
+        Device mode's pushes can arrive committed to DIFFERENT devices
+        (one per data-parallel worker); XLA refuses cross-device adds, so
+        every operand is first brought to the first buffer's device —
+        the reference's CommDevice gathers to a reduction root the same
+        way (comm.h:451) before summing.  PJRT overlaps the transfers.
+        """
+        import jax
+
         vals = _as_list(values)
         acc = vals[0].data()
+        home = getattr(acc, "device", None)
         for v in vals[1:]:
-            acc = acc + v.data()
+            d = v.data()
+            if home is not None and getattr(d, "device", None) != home:
+                d = jax.device_put(d, home)
+            acc = acc + d
         return acc
 
     @staticmethod
@@ -83,11 +97,28 @@ class KVStore(KVStoreBase):
 
         Parity: CommCPU's row_sparse reduce (src/kvstore/comm.h) — the
         aggregated gradient stays sparse all the way to the updater.
+        Cross-device pushes are gathered to the first buffer's device
+        first (same root-gather as the dense _reduce).
         """
+        import jax
+
         vals = _as_list(values)
+        home = getattr(vals[0].values.data(), "device", None)
+
+        def rehome(rs):
+            """A copy on the reduction root; the caller's buffers stay
+            on their own device (matching the dense _reduce)."""
+            if home is None or getattr(rs.values.data(), "device",
+                                       None) == home:
+                return rs
+            return type(rs)(
+                NDArray(jax.device_put(rs.values.data(), home)),
+                NDArray(jax.device_put(rs.indices.data(), home)),
+                rs.shape, canonical=rs._canonical)
+
         acc = vals[0]
         for v in vals[1:]:
-            acc = acc + v
+            acc = acc + rehome(v)
         return acc.compact()
 
     def push(self, key, value, priority=0):

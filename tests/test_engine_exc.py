@@ -1,0 +1,115 @@
+"""Engine exception propagation + device-mode KVStore aggregation across
+mesh devices.
+
+Models: the reference's ``test_exc_handling.py`` (async errors stored on
+vars, rethrown at wait — ``threaded_engine.cc:383-436``) and the nightly
+``dist_sync_kvstore.py:16-60`` pattern of asserting EXACT aggregated
+values when the pushed buffers live on different devices.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.engine import Engine, Var
+
+
+def test_var_exception_rethrown_at_wait():
+    v = Var()
+    eng = Engine.get()
+    with pytest.raises(ValueError):
+        eng.push(lambda: (_ for _ in ()).throw(ValueError("boom")),
+                 write_vars=(v,))
+    # the failure is stored on the var: waiting on it rethrows, once
+    with pytest.raises(ValueError):
+        eng.wait_for_var(v)
+    eng.wait_for_var(v)  # cleared after the rethrow
+
+
+def test_failed_write_poisons_readers():
+    v = Var()
+    eng = Engine.get()
+    with pytest.raises(RuntimeError):
+        eng.push(lambda: (_ for _ in ()).throw(RuntimeError("bad write")),
+                 write_vars=(v,))
+    # a later op READING the poisoned var sees the stored exception at
+    # its own push (parity: dependent ops observe upstream failure)
+    with pytest.raises(RuntimeError):
+        eng.push(lambda: 1, read_vars=(v,))
+
+
+def test_write_bumps_version():
+    v = Var()
+    eng = Engine.get()
+    v0 = v.version
+    eng.push(lambda: 42, write_vars=(v,))
+    assert v.version == v0 + 1
+
+
+def test_device_kvstore_aggregates_across_mesh_devices():
+    """Push buffers living on DIFFERENT devices of the 8-device mesh and
+    assert the exact aggregate, with the reduce placed on-device."""
+    devices = jax.devices()
+    assert len(devices) >= 8, "conftest provides an 8-device CPU backend"
+    kv = mx.kvstore.create("device")
+    shape = (4, 3)
+    kv.init(9, mx.nd.zeros(shape))
+    vals = []
+    expect = np.zeros(shape, np.float32)
+    for rank, dev in enumerate(devices[:8]):
+        arr = np.full(shape, float(rank + 1), np.float32)
+        expect += arr
+        a = nd.array(arr)
+        a._set_data(jax.device_put(a.data(), dev))  # distinct device
+        vals.append(a)
+    kv.push(9, vals)
+    out = mx.nd.zeros(shape)
+    kv.pull(9, out=out)
+    np.testing.assert_allclose(out.asnumpy(), expect)  # exact, not approx
+
+
+def test_device_kvstore_row_sparse_aggregate():
+    devices = jax.devices()
+    kv = mx.kvstore.create("device")
+    dense = np.zeros((6, 2), np.float32)
+    kv.init("emb", mx.nd.zeros((6, 2)))
+    vals = []
+    expect = np.zeros((6, 2), np.float32)
+    for rank, dev in enumerate(devices[:4]):
+        arr = np.zeros((6, 2), np.float32)
+        arr[rank] = rank + 1  # each pusher touches its own row
+        expect += arr
+        a = nd.array(arr)
+        a._set_data(jax.device_put(a.data(), dev))
+        vals.append(a)
+    kv.push("emb", vals)
+    out = mx.nd.zeros((6, 2))
+    kv.pull("emb", out=out)
+    np.testing.assert_allclose(out.asnumpy(), expect)
+
+
+def test_device_kvstore_true_row_sparse_cross_device():
+    """row_sparse pushes whose buffers live on different devices must
+    aggregate exactly (reference: CommDevice gathers to a reduction root
+    before summing)."""
+    from mxnet_tpu.ndarray import sparse as sp
+
+    devices = jax.devices()
+    kv = mx.kvstore.create("device")
+    kv.init("e", mx.nd.zeros((6, 2)))
+    vals = []
+    expect = np.zeros((6, 2), np.float32)
+    for rank, dev in enumerate(devices[:3]):
+        rs_arr = sp.row_sparse_array(
+            (np.full((1, 2), rank + 1.0, np.float32), np.array([rank])),
+            shape=(6, 2))
+        rs_arr.values._set_data(jax.device_put(rs_arr.values.data(), dev))
+        rs_arr.indices._set_data(jax.device_put(rs_arr.indices.data(), dev))
+        vals.append(rs_arr)
+        expect[rank] = rank + 1.0
+    kv.push("e", vals)
+    out = mx.nd.zeros((6, 2))
+    kv.pull("e", out=out)
+    np.testing.assert_allclose(out.asnumpy(), expect)

@@ -55,7 +55,13 @@ def main():
 
     names = sorted(sweep.SPECS)
     if args.ops:
-        names = [n for n in args.ops.split(",") if n in sweep.SPECS]
+        wanted = [n for n in args.ops.split(",") if n]
+        unknown = [n for n in wanted if n not in sweep.SPECS]
+        if unknown:
+            print("unknown ops (no sweep spec): %s" % ", ".join(unknown),
+                  file=sys.stderr)
+            return 1
+        names = wanted
     results = {"pass": [], "fail": [], "skip": []}
     for name in names:
         if _is_random(name):
@@ -122,12 +128,15 @@ def _reconstruct(name, outs):
 
 
 def _is_random(name):
-    r = ("_random_", "sample_", "_npi_uniform", "_npi_normal",
-         "_npi_bernoulli", "_npi_exponential", "_npi_gamma", "_npi_choice",
-         "_npi_multinomial", "_shuffle", "Dropout", "uniform", "normal",
-         "gamma", "exponential", "negative_binomial", "poisson",
-         "randint", "randn", "LeakyReLU")
-    return any(k in name for k in r)
+    """RNG-consuming ops (device-dependent draws): exact registry flag,
+    not a substring heuristic — gamma/gammaln/_image_normalize are
+    deterministic and MUST be swept."""
+    from mxnet_tpu.ops import registry
+
+    try:
+        return bool(registry.get(name).needs_rng)
+    except Exception:
+        return False
 
 
 def _run(name, spec, mx, nd, device):
