@@ -1,0 +1,84 @@
+// Example operator plugin (parity: example/extensions/lib_custom_op).
+// Implements two ops with zero framework linkage:
+//   my_gelu  — tanh-approx GELU, with an analytic backward
+//   my_relu6 — clip(x, 0, 6), forward-only
+//
+// Build:  g++ -O2 -shared -fPIC -std=c++17 my_ops.cc -o libmyops.so
+// Load:   mx.library.load("libmyops.so")
+
+#include <cmath>
+#include <cstring>
+
+namespace {
+
+long numel(const long* shape, int ndim) {
+  long n = 1;
+  for (int i = 0; i < ndim; ++i) n *= shape[i];
+  return n;
+}
+
+constexpr float kC = 0.7978845608028654f;  // sqrt(2/pi)
+
+}  // namespace
+
+extern "C" {
+
+int mx_plugin_abi_version() { return 1; }
+long mx_plugin_num_ops() { return 2; }
+
+const char* mx_plugin_op_name(long i) {
+  return i == 0 ? "my_gelu" : "my_relu6";
+}
+
+long mx_plugin_op_num_inputs(long i) { return 1; }
+
+int mx_plugin_op_has_backward(long i) { return i == 0 ? 1 : 0; }
+
+int mx_plugin_op_infer_shape(long, const long* const* in_shapes,
+                             const int* in_ndims, long,
+                             long* out_shape, int* out_ndim) {
+  *out_ndim = in_ndims[0];
+  std::memcpy(out_shape, in_shapes[0], sizeof(long) * in_ndims[0]);
+  return 0;
+}
+
+int mx_plugin_op_forward(long i, const float* const* inputs,
+                         const long* const* in_shapes,
+                         const int* in_ndims, long,
+                         float* output, const long* out_shape,
+                         int out_ndim) {
+  const float* x = inputs[0];
+  const long n = numel(out_shape, out_ndim);
+  if (i == 0) {
+    for (long j = 0; j < n; ++j) {
+      const float v = x[j];
+      output[j] = 0.5f * v * (1.0f + std::tanh(kC * (v + 0.044715f * v * v * v)));
+    }
+  } else {
+    for (long j = 0; j < n; ++j) {
+      float v = x[j];
+      output[j] = v < 0.f ? 0.f : (v > 6.f ? 6.f : v);
+    }
+  }
+  return 0;
+}
+
+int mx_plugin_op_backward(long i, const float* const* inputs,
+                          const long* const* in_shapes,
+                          const int* in_ndims, long,
+                          const float* out_grad, float* const* in_grads) {
+  if (i != 0) return -1;
+  const float* x = inputs[0];
+  const long n = numel(in_shapes[0], in_ndims[0]);
+  for (long j = 0; j < n; ++j) {
+    const float v = x[j];
+    const float u = kC * (v + 0.044715f * v * v * v);
+    const float t = std::tanh(u);
+    const float du = kC * (1.0f + 3.0f * 0.044715f * v * v);
+    in_grads[0][j] = out_grad[j] *
+        (0.5f * (1.0f + t) + 0.5f * v * (1.0f - t * t) * du);
+  }
+  return 0;
+}
+
+}  // extern "C"

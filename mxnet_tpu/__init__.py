@@ -59,6 +59,7 @@ from . import runtime  # noqa: F401
 from . import rtc  # noqa: F401
 from . import operator  # noqa: F401
 from . import deploy  # noqa: F401
+from . import library  # noqa: F401
 from . import numpy as np  # noqa: F401
 from . import numpy_extension as npx  # noqa: F401
 from .numpy_extension import set_np, reset_np, is_np_shape, is_np_array  # noqa: F401,E501

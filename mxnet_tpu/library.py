@@ -1,0 +1,200 @@
+"""Binary operator extensions: load ops from standalone ``.so`` files.
+
+Parity: the reference's ``lib_api.h`` + ``MXLoadLib``
+(``include/mxnet/lib_api.h:527``, ``src/c_api/c_api.cc:105``) — custom
+operators compiled with NO framework linkage, loaded at runtime and
+registered into the operator registry under their own names.
+
+TPU-native mechanism: the plugin's compute stays a host C function (the
+ABI is dense f32 buffers, see ``src/plugin_api.h``); each loaded op is
+registered as a JAX ``pure_callback`` so it composes with jit/vmap and
+the tape.  Shape inference calls the plugin's ``infer_shape`` export at
+trace time (shapes are static under XLA).  If the plugin exports a
+backward, the op is wrapped in ``jax.custom_vjp`` and becomes
+differentiable; otherwise gradients stop at it (documented, like
+reference custom ops without a declared FGradient).
+"""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from .base import MXNetError
+
+_LOADED = {}
+
+
+class _PluginOp:
+    __slots__ = ("lib", "index", "name", "n_inputs", "has_backward")
+
+    def __init__(self, lib, index):
+        self.lib = lib
+        self.index = index
+        self.name = lib.mx_plugin_op_name(index).decode()
+        self.n_inputs = int(lib.mx_plugin_op_num_inputs(index))
+        self.has_backward = bool(lib.mx_plugin_op_has_backward(index))
+
+    # -- ABI crossings ----------------------------------------------------
+    def _shape_args(self, arrays):
+        shapes = [np.asarray(a.shape, np.int64) for a in arrays]
+        shape_ptrs = (ctypes.POINTER(ctypes.c_long) * len(arrays))(
+            *[s.ctypes.data_as(ctypes.POINTER(ctypes.c_long))
+              for s in shapes])
+        ndims = np.asarray([a.ndim for a in arrays], np.int32)
+        return shapes, shape_ptrs, ndims
+
+    def infer_shape(self, in_shapes):
+        fake = [np.empty(s, np.float32) for s in in_shapes]
+        _, shape_ptrs, ndims = self._shape_args(fake)
+        out_shape = np.zeros(16, np.int64)
+        out_ndim = ctypes.c_int(0)
+        rc = self.lib.mx_plugin_op_infer_shape(
+            self.index, shape_ptrs,
+            ndims.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            len(fake),
+            out_shape.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+            ctypes.byref(out_ndim))
+        if rc != 0:
+            raise MXNetError("%s: infer_shape failed (%d)"
+                             % (self.name, rc))
+        return tuple(int(d) for d in out_shape[:out_ndim.value])
+
+    def forward_host(self, *arrays):
+        arrays = [np.ascontiguousarray(a, np.float32) for a in arrays]
+        shapes, shape_ptrs, ndims = self._shape_args(arrays)
+        in_ptrs = (ctypes.POINTER(ctypes.c_float) * len(arrays))(
+            *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+              for a in arrays])
+        out_shape = np.asarray(
+            self.infer_shape([a.shape for a in arrays]), np.int64)
+        out = np.empty(tuple(out_shape), np.float32)
+        rc = self.lib.mx_plugin_op_forward(
+            self.index, in_ptrs, shape_ptrs,
+            ndims.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            len(arrays),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            out_shape.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+            len(out_shape))
+        if rc != 0:
+            raise MXNetError("%s: forward failed (%d)" % (self.name, rc))
+        return out
+
+    def backward_host(self, out_grad, *arrays):
+        arrays = [np.ascontiguousarray(a, np.float32) for a in arrays]
+        og = np.ascontiguousarray(out_grad, np.float32)
+        shapes, shape_ptrs, ndims = self._shape_args(arrays)
+        in_ptrs = (ctypes.POINTER(ctypes.c_float) * len(arrays))(
+            *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+              for a in arrays])
+        grads = [np.zeros(a.shape, np.float32) for a in arrays]
+        grad_ptrs = (ctypes.POINTER(ctypes.c_float) * len(arrays))(
+            *[g.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+              for g in grads])
+        rc = self.lib.mx_plugin_op_backward(
+            self.index, in_ptrs, shape_ptrs,
+            ndims.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            len(arrays),
+            og.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            grad_ptrs)
+        if rc != 0:
+            raise MXNetError("%s: backward failed (%d)" % (self.name, rc))
+        return tuple(grads)
+
+
+def _register(op):
+    """Register one plugin op into the live registry as a pure_callback."""
+    import jax
+    import jax.numpy as jnp
+
+    from .ops.registry import register
+
+    def call_forward(*datas):
+        out_shape = op.infer_shape([d.shape for d in datas])
+        return jax.pure_callback(
+            op.forward_host,
+            jax.ShapeDtypeStruct(out_shape, jnp.float32),
+            *datas, vmap_method="sequential")
+
+    if op.has_backward:
+        @jax.custom_vjp
+        def fwd(*datas):
+            return call_forward(*datas)
+
+        def fwd_fwd(*datas):
+            return call_forward(*datas), datas
+
+        def fwd_bwd(datas, g):
+            shapes = tuple(
+                jax.ShapeDtypeStruct(d.shape, jnp.float32) for d in datas)
+            return jax.pure_callback(
+                op.backward_host, shapes, g, *datas,
+                vmap_method="sequential")
+
+        fwd.defvjp(fwd_fwd, fwd_bwd)
+        body = fwd
+    else:
+        body = call_forward
+
+    def forward(*datas):
+        return body(*datas)
+
+    forward.__name__ = op.name
+    forward.__doc__ = ("Plugin op %r (binary extension, host compute via "
+                       "the XLA callback bridge)." % op.name)
+    register(op.name)(forward)
+
+
+def load(path, verbose=False):
+    """Load an operator plugin ``.so`` and register its ops.
+
+    Parity: ``mx.library.load`` → ``MXLoadLib`` (c_api.cc:105).  Returns
+    the list of op names registered.  Ops become visible as
+    ``mx.nd.<name>`` / ``mx.sym.<name>`` immediately.
+    """
+    if path in _LOADED:
+        return _LOADED[path]
+    lib = ctypes.CDLL(path)
+    lib.mx_plugin_abi_version.restype = ctypes.c_int
+    if lib.mx_plugin_abi_version() != 1:
+        raise MXNetError("%s: unsupported plugin ABI version" % path)
+    lib.mx_plugin_num_ops.restype = ctypes.c_long
+    lib.mx_plugin_op_name.restype = ctypes.c_char_p
+    lib.mx_plugin_op_name.argtypes = [ctypes.c_long]
+    lib.mx_plugin_op_num_inputs.restype = ctypes.c_long
+    lib.mx_plugin_op_num_inputs.argtypes = [ctypes.c_long]
+    lib.mx_plugin_op_has_backward.restype = ctypes.c_int
+    lib.mx_plugin_op_has_backward.argtypes = [ctypes.c_long]
+    PL = ctypes.POINTER(ctypes.c_long)
+    PI = ctypes.POINTER(ctypes.c_int)
+    PF = ctypes.POINTER(ctypes.c_float)
+    PPL = ctypes.POINTER(PL)
+    PPF = ctypes.POINTER(PF)
+    lib.mx_plugin_op_infer_shape.restype = ctypes.c_int
+    lib.mx_plugin_op_infer_shape.argtypes = [
+        ctypes.c_long, PPL, PI, ctypes.c_long, PL, PI]
+    lib.mx_plugin_op_forward.restype = ctypes.c_int
+    lib.mx_plugin_op_forward.argtypes = [
+        ctypes.c_long, PPF, PPL, PI, ctypes.c_long, PF, PL, ctypes.c_int]
+    try:
+        lib.mx_plugin_op_backward.restype = ctypes.c_int
+        lib.mx_plugin_op_backward.argtypes = [
+            ctypes.c_long, PPF, PPL, PI, ctypes.c_long, PF, PPF]
+    except AttributeError:
+        pass
+
+    names = []
+    for i in range(int(lib.mx_plugin_num_ops())):
+        op = _PluginOp(lib, i)
+        _register(op)
+        names.append(op.name)
+        if verbose:
+            print("loaded plugin op %r (backward=%s)"
+                  % (op.name, op.has_backward))
+    # refresh the generated nd namespace so the new names resolve
+    from . import ndarray as _nd_pkg
+    from .ndarray.register import populate as _populate
+
+    _populate(_nd_pkg.__dict__)
+    _LOADED[path] = names
+    return names

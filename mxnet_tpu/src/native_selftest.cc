@@ -1,0 +1,120 @@
+// Native-tier self-tests (parity: tests/cpp/ gtest suites — engine,
+// storage, operator runners).  A standalone binary with zero framework
+// linkage: each check prints PASS/FAIL and the process exit code is the
+// failure count.  Built and executed by tests/test_native.py's C++ layer
+// so the C++ code is tested as C++, not only through ctypes.
+//
+// Build: g++ -O2 -std=c++17 native_selftest.cc recordio_native.cc
+//            image_decode_native.cc -ljpeg -o selftest
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+long rio_index(const uint8_t*, long, long*, long*, long*, long);
+long rio_gather(const uint8_t*, const long*, const long*, long, uint8_t*,
+                long*);
+long rio_pack(const uint8_t*, const long*, const long*, long, uint8_t*);
+int rio_abi_version();
+long img_decode_aug_batch(const uint8_t* const*, const long*, long, int,
+                          int, const long*, const uint8_t*, int,
+                          const float*, const float*, float*, uint8_t*,
+                          int);
+}
+
+static int failures = 0;
+
+#define CHECK_TRUE(cond, msg)                          \
+  do {                                                 \
+    if (cond) {                                        \
+      std::printf("PASS %s\n", msg);                   \
+    } else {                                           \
+      std::printf("FAIL %s\n", msg);                   \
+      ++failures;                                      \
+    }                                                  \
+  } while (0)
+
+namespace {
+
+void test_abi_version() {
+  CHECK_TRUE(rio_abi_version() == 1, "rio_abi_version == 1");
+}
+
+void test_pack_index_gather_roundtrip() {
+  // three records of different sizes
+  const char* payloads[] = {"alpha", "bet", "gamma-gamma"};
+  std::vector<uint8_t> flat;
+  std::vector<long> offs, lens;
+  for (const char* p : payloads) {
+    offs.push_back(static_cast<long>(flat.size()));
+    lens.push_back(static_cast<long>(std::strlen(p)));
+    flat.insert(flat.end(), p, p + std::strlen(p));
+  }
+  std::vector<uint8_t> packed(flat.size() + 16 * 3);
+  long wrote = rio_pack(flat.data(), offs.data(), lens.data(), 3,
+                        packed.data());
+  CHECK_TRUE(wrote > 0, "rio_pack writes");
+
+  long o[8], l[8], f[8];
+  long n = rio_index(packed.data(), wrote, o, l, f, 8);
+  CHECK_TRUE(n == 3, "rio_index finds 3 records");
+  bool lens_ok = n == 3;
+  for (long i = 0; i < n && lens_ok; ++i) lens_ok = l[i] == lens[i];
+  CHECK_TRUE(lens_ok, "rio_index lengths match");
+
+  std::vector<uint8_t> out(flat.size());
+  long out_offs[8];
+  long total = rio_gather(packed.data(), o, l, n, out.data(), out_offs);
+  CHECK_TRUE(total == static_cast<long>(flat.size()),
+             "rio_gather total bytes");
+  CHECK_TRUE(std::memcmp(out.data(), flat.data(), flat.size()) == 0,
+             "rio_gather payload bytes");
+}
+
+void test_index_rejects_corrupt() {
+  uint8_t junk[32];
+  std::memset(junk, 0xAB, sizeof(junk));
+  long o[4], l[4], f[4];
+  CHECK_TRUE(rio_index(junk, sizeof(junk), o, l, f, 4) == -1,
+             "rio_index flags bad magic");
+}
+
+void test_index_capacity_retry() {
+  const char* payload = "x";
+  long off = 0, len = 1;
+  std::vector<uint8_t> packed(64);
+  long wrote = rio_pack(reinterpret_cast<const uint8_t*>(payload), &off,
+                        &len, 1, packed.data());
+  long o[1], l[1], f[1];
+  CHECK_TRUE(rio_index(packed.data(), wrote, o, l, f, 0) < 0,
+             "rio_index reports capacity overflow");
+}
+
+void test_decode_rejects_garbage() {
+  const uint8_t junk[] = {0xFF, 0xD8, 1, 2, 3};
+  const uint8_t* bufs[] = {junk};
+  long lens[] = {static_cast<long>(sizeof(junk))};
+  long crops[] = {-1, -1, -1, -1};
+  uint8_t flips[] = {0};
+  float mean[] = {0, 0, 0}, scale[] = {1, 1, 1};
+  std::vector<float> out(3 * 4 * 4);
+  uint8_t ok[1] = {9};
+  long n = img_decode_aug_batch(bufs, lens, 1, 4, 4, crops, flips, 0,
+                                mean, scale, out.data(), ok, 2);
+  CHECK_TRUE(n == 0 && ok[0] == 0, "decode flags corrupt jpeg");
+}
+
+}  // namespace
+
+int main() {
+  test_abi_version();
+  test_pack_index_gather_roundtrip();
+  test_index_rejects_corrupt();
+  test_index_capacity_retry();
+  test_decode_rejects_garbage();
+  std::printf("%s (%d failures)\n", failures ? "SELFTEST FAILED"
+                                             : "SELFTEST OK", failures);
+  return failures;
+}

@@ -1,0 +1,43 @@
+// Binary extension ABI (parity: include/mxnet/lib_api.h — ship operators
+// as standalone .so files with zero framework linkage).
+//
+// A plugin exports plain C symbols; mxnet_tpu.library.load() dlopens the
+// file, introspects the op table, and registers each op into the live
+// registry.  Compute runs on the host through the XLA callback bridge
+// (the same boundary the reference's CustomOp used for Python/C++
+// callbacks); an optional backward entry point makes the op
+// differentiable.
+//
+// Version 1 ABI (float32 tensors):
+//
+//   int   mx_plugin_abi_version(void);                 // must return 1
+//   long  mx_plugin_num_ops(void);
+//   const char* mx_plugin_op_name(long i);
+//   long  mx_plugin_op_num_inputs(long i);
+//   int   mx_plugin_op_has_backward(long i);
+//
+//   // write output shape for the given input shapes; return 0 on ok
+//   int mx_plugin_op_infer_shape(long i,
+//                                const long* const* in_shapes,
+//                                const int* in_ndims, long n_inputs,
+//                                long* out_shape, int* out_ndim);
+//
+//   // forward: dense f32 buffers, row-major; return 0 on ok
+//   int mx_plugin_op_forward(long i,
+//                            const float* const* inputs,
+//                            const long* const* in_shapes,
+//                            const int* in_ndims, long n_inputs,
+//                            float* output,
+//                            const long* out_shape, int out_ndim);
+//
+//   // backward (optional): given inputs + out-grad, write in-grads
+//   int mx_plugin_op_backward(long i,
+//                             const float* const* inputs,
+//                             const long* const* in_shapes,
+//                             const int* in_ndims, long n_inputs,
+//                             const float* out_grad,
+//                             float* const* in_grads);
+#ifndef MXNET_TPU_PLUGIN_API_H_
+#define MXNET_TPU_PLUGIN_API_H_
+#define MX_PLUGIN_ABI_VERSION 1
+#endif  // MXNET_TPU_PLUGIN_API_H_

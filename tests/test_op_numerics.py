@@ -1257,6 +1257,15 @@ def test_registry_fully_covered():
     """Every registered op has a sweep spec or a justified exclusion."""
     all_ops = set(registry._REGISTRY)
     covered = set(SPECS) | set(EXCLUDED) | COVERED_ELSEWHERE
+    # ops loaded from binary plugins during THIS test session are not
+    # part of the built-in surface (tests/test_library_plugin.py covers
+    # their numerics)
+    from mxnet_tpu import library
+
+    plugin_ops = set()
+    for names in library._LOADED.values():
+        plugin_ops |= set(names)
+    covered |= plugin_ops
     missing = sorted(all_ops - covered)
     assert not missing, "ops missing sweep specs: %s" % missing
     # COVERED_ELSEWHERE must not drift from reality: every claimed name
