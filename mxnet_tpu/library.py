@@ -24,6 +24,13 @@ from .base import MXNetError
 
 _LOADED = {}
 
+# ABI contract (MX_PLUGIN_MAX_RANK in src/plugin_api.h): plugins may not
+# report more than this many output dims.  infer_shape validates the
+# reported rank, which catches plugins that honor the buffer size but
+# misreport out_ndim; a plugin that ignores the documented cap and writes
+# past the buffer is undefined behavior like any other ABI violation.
+_PLUGIN_MAX_RANK = 16
+
 
 class _PluginOp:
     __slots__ = ("lib", "index", "name", "n_inputs", "has_backward")
@@ -47,7 +54,7 @@ class _PluginOp:
     def infer_shape(self, in_shapes):
         fake = [np.empty(s, np.float32) for s in in_shapes]
         _, shape_ptrs, ndims = self._shape_args(fake)
-        out_shape = np.zeros(16, np.int64)
+        out_shape = np.zeros(_PLUGIN_MAX_RANK, np.int64)
         out_ndim = ctypes.c_int(0)
         rc = self.lib.mx_plugin_op_infer_shape(
             self.index, shape_ptrs,
@@ -58,6 +65,11 @@ class _PluginOp:
         if rc != 0:
             raise MXNetError("%s: infer_shape failed (%d)"
                              % (self.name, rc))
+        if not 0 <= out_ndim.value <= _PLUGIN_MAX_RANK:
+            raise MXNetError(
+                "%s: plugin reported out_ndim=%d (max supported rank is %d; "
+                "see plugin_api.h)" % (self.name, out_ndim.value,
+                                       _PLUGIN_MAX_RANK))
         return tuple(int(d) for d in out_shape[:out_ndim.value])
 
     def forward_host(self, *arrays):

@@ -348,17 +348,21 @@ register("_npi_gamma", needs_rng=True)(
                              jnp.dtype(dtype)))
 @register("_npi_choice", needs_rng=True, inputs=("input1", "input2"))
 def _npi_choice(key, input1=None, input2=None, a=None, size=(),
-                replace=True, weights=None, ctx=None):
-    """np.random.choice: the pool is either the int attr ``a`` or a 1-D
-    array input; optional probability weights are the next array input
-    (reference: numpy/random/np_choice_op.cc input layout)."""
+                replace=True, ctx=None):
+    """np.random.choice backend op: the pool is either the int attr ``a``
+    or a 1-D array input; optional probability weights are the next array
+    input.  Like the reference (numpy/random/np_choice_op.h
+    NumpyChoiceOpType) the op always returns int64 INDICES into the pool;
+    callers wanting values gather ``pool[indices]`` themselves (the
+    ``mx.np.random.choice`` frontend samples values directly and does not
+    route through this op)."""
     if a is not None:
-        pool, p = int(a), input1
+        n_pool, p = int(a), input1
     else:
-        pool, p = input1, input2
+        n_pool, p = int(input1.shape[0]), input2
     if p is not None:
         p = p / jnp.sum(p)
-    return jax.random.choice(key, pool, tuple(size) if size else (),
+    return jax.random.choice(key, n_pool, tuple(size) if size else (),
                              replace=bool(replace), p=p).astype(jnp.int64)
 @register("_npi_multinomial", needs_rng=True, inputs=("data",))
 def _npi_multinomial(key, data, n=1, pvals=None, size=(), ctx=None):

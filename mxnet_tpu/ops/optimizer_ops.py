@@ -532,11 +532,17 @@ def _multi_mp_adamw_update(*arrays, lrs=(), wds=(), etas=(), beta1=0.9,
 @register("_sparse_adagrad_update", num_outputs=2)
 def _sparse_adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7,
                            wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """Reference parity: AdagradDnsRspDnsKernel (optimizer_op.cc) divides by
+    sqrt(hist + eps), and the sparse path rejects weight decay
+    (CheckAdagradParam requires wd == 0)."""
+    if float(wd) != 0.0:
+        raise ValueError("_sparse_adagrad_update: wd must be 0 "
+                         "(reference sparse AdaGrad rejects weight decay)")
     g = grad * rescale_grad
     if clip_gradient >= 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
     new_hist = history + jnp.square(g)
-    w = weight - lr * (g / (jnp.sqrt(new_hist) + epsilon) + wd * weight)
+    w = weight - lr * g / jnp.sqrt(new_hist + epsilon)
     return w, new_hist
 
 

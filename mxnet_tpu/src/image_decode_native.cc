@@ -76,8 +76,9 @@ inline void resample_to(const uint8_t* src, int sh, int sw, int x0, int y0,
   const long plane = static_cast<long>(out_h) * out_w;
   for (int oy = 0; oy < out_h; ++oy) {
     // only the bilinear branch reads fy; when ch == out_h the formula
-    // reduces to oy exactly, so no special case
-    const float fy = interp ? (oy + 0.5f) * ch / out_h - 0.5f : 0.f;
+    // reduces to oy exactly, so no special case.  Coordinate math is
+    // double throughout to match numpy's float64 in the python path.
+    const double fy = interp ? (oy + 0.5) * ch / out_h - 0.5 : 0.0;
     for (int ox = 0; ox < out_w; ++ox) {
       const int oxx = flip ? (out_w - 1 - ox) : ox;
       float r, g, b;
@@ -86,22 +87,25 @@ inline void resample_to(const uint8_t* src, int sh, int sw, int x0, int y0,
             (static_cast<long>(y0 + oy) * sw + (x0 + ox)) * 3;
         r = p[0]; g = p[1]; b = p[2];
       } else if (!interp) {
-        int sy = y0 + static_cast<int>(oy * static_cast<float>(ch) / out_h);
-        int sx = x0 + static_cast<int>(ox * static_cast<float>(cw) / out_w);
+        // index math in double to match numpy's float64 source-index
+        // selection in the python path exactly
+        int sy = y0 + static_cast<int>(oy * static_cast<double>(ch) / out_h);
+        int sx = x0 + static_cast<int>(ox * static_cast<double>(cw) / out_w);
         if (sy > y0 + ch - 1) sy = y0 + ch - 1;
         if (sx > x0 + cw - 1) sx = x0 + cw - 1;
         const uint8_t* p = src + (static_cast<long>(sy) * sw + sx) * 3;
         r = p[0]; g = p[1]; b = p[2];
       } else {
-        float fx = (ox + 0.5f) * cw / out_w - 0.5f;
-        float yy = fy < 0 ? 0 : fy;
-        float xx = fx < 0 ? 0 : fx;
-        if (yy > ch - 1) yy = static_cast<float>(ch - 1);
-        if (xx > cw - 1) xx = static_cast<float>(cw - 1);
+        double fx = (ox + 0.5) * cw / out_w - 0.5;
+        double yy = fy < 0 ? 0 : fy;
+        double xx = fx < 0 ? 0 : fx;
+        if (yy > ch - 1) yy = static_cast<double>(ch - 1);
+        if (xx > cw - 1) xx = static_cast<double>(cw - 1);
         const int iy = static_cast<int>(yy), ix = static_cast<int>(xx);
         const int iy1 = iy + 1 > ch - 1 ? iy : iy + 1;
         const int ix1 = ix + 1 > cw - 1 ? ix : ix + 1;
-        const float wy = yy - iy, wx = xx - ix;
+        const float wy = static_cast<float>(yy - iy),
+                    wx = static_cast<float>(xx - ix);
         const uint8_t* p00 = src +
             (static_cast<long>(y0 + iy) * sw + (x0 + ix)) * 3;
         const uint8_t* p01 = src +

@@ -16,7 +16,10 @@
 //   long  mx_plugin_op_num_inputs(long i);
 //   int   mx_plugin_op_has_backward(long i);
 //
-//   // write output shape for the given input shapes; return 0 on ok
+//   // write output shape for the given input shapes; return 0 on ok.
+//   // out_shape is a caller-owned buffer of MX_PLUGIN_MAX_RANK longs;
+//   // *out_ndim must be <= MX_PLUGIN_MAX_RANK (the loader rejects the
+//   // op otherwise).
 //   int mx_plugin_op_infer_shape(long i,
 //                                const long* const* in_shapes,
 //                                const int* in_ndims, long n_inputs,
@@ -40,4 +43,5 @@
 #ifndef MXNET_TPU_PLUGIN_API_H_
 #define MXNET_TPU_PLUGIN_API_H_
 #define MX_PLUGIN_ABI_VERSION 1
+#define MX_PLUGIN_MAX_RANK 16
 #endif  // MXNET_TPU_PLUGIN_API_H_
