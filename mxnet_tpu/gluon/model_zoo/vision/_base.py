@@ -1,0 +1,37 @@
+"""Shared base for model-zoo vision networks: layout parametrisation.
+
+Reference model-zoo nets (``python/mxnet/gluon/model_zoo/vision/``) are
+NCHW-only.  Here every family is layout-parametric so the whole graph can
+run channels-last on the MXU (see ``mxnet_tpu/layout.py``), while the
+user-facing contract stays reference-compatible: nets accept NCHW image
+batches and transpose once at the stem.
+"""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from .... import layout as layout_mod
+
+
+class _LayoutNet(HybridBlock):
+    """Base for model-zoo vision nets: layout-parametric, NCHW boundary.
+
+    ``layout=None`` resolves through the global policy (``layout.py``) —
+    channels-last on TPU.  The net always ACCEPTS NCHW image batches (API
+    parity with the reference model zoo); when the internal layout is
+    channels-last the input is transposed once at the stem, which XLA folds
+    into the first convolution's relayout.
+    """
+
+    def __init__(self, layout=None, **kwargs):
+        super().__init__(**kwargs)
+        self._layout = layout if layout is not None \
+            else layout_mod.preferred_layout(2)
+
+    def _build_scope(self):
+        """Context manager: build child layers under this net's layout."""
+        return layout_mod.layout_scope(self._layout)
+
+    def _stem_input(self, F, x):
+        if not self._layout.startswith("NC"):
+            return F.transpose(x, axes=(0, 2, 3, 1))
+        return x

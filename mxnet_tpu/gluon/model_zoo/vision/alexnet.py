@@ -3,15 +3,15 @@ from __future__ import annotations
 
 import os
 
-from ...block import HybridBlock
 from ... import nn
 from ....context import cpu
+from ._base import _LayoutNet
 
 
-class AlexNet(HybridBlock):
-    def __init__(self, classes=1000, **kwargs):
-        super().__init__(**kwargs)
-        with self.name_scope():
+class AlexNet(_LayoutNet):
+    def __init__(self, classes=1000, layout=None, **kwargs):
+        super().__init__(layout=layout, **kwargs)
+        with self._build_scope(), self.name_scope():
             self.features = nn.HybridSequential(prefix='')
             with self.features.name_scope():
                 self.features.add(nn.Conv2D(
@@ -36,12 +36,16 @@ class AlexNet(HybridBlock):
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
+        x = self._stem_input(F, x)
         x = self.features(x)
         return self.output(x)
 
 
 def alexnet(pretrained=False, ctx=cpu(),
             root=os.path.join('~', '.mxnet', 'models'), **kwargs):
+    if pretrained:
+        # shipped checkpoints are reference-layout (NCHW/OIHW)
+        kwargs.setdefault('layout', 'NCHW')
     net = AlexNet(**kwargs)
     if pretrained:
         net.load_parameters(

@@ -5,7 +5,9 @@ import os
 
 from ...block import HybridBlock
 from ... import nn
+from .... import layout as layout_mod
 from ....context import cpu
+from ._base import _LayoutNet
 
 
 def _make_dense_block(num_layers, bn_size, growth_rate, dropout,
@@ -22,6 +24,7 @@ class _DenseLayer(HybridBlock):
 
     def __init__(self, growth_rate, bn_size, dropout, **kwargs):
         super().__init__(**kwargs)
+        self._caxis = layout_mod.current_channel_axis()
         self.body = nn.HybridSequential(prefix='')
         self.body.add(nn.BatchNorm())
         self.body.add(nn.Activation('relu'))
@@ -36,7 +39,7 @@ class _DenseLayer(HybridBlock):
 
     def hybrid_forward(self, F, x):
         out = self.body(x)
-        return F.concat(x, out, dim=1)
+        return F.concat(x, out, dim=self._caxis)
 
 
 def _make_transition(num_output_features):
@@ -48,11 +51,11 @@ def _make_transition(num_output_features):
     return out
 
 
-class DenseNet(HybridBlock):
+class DenseNet(_LayoutNet):
     def __init__(self, num_init_features, growth_rate, block_config,
-                 bn_size=4, dropout=0, classes=1000, **kwargs):
-        super().__init__(**kwargs)
-        with self.name_scope():
+                 bn_size=4, dropout=0, classes=1000, layout=None, **kwargs):
+        super().__init__(layout=layout, **kwargs)
+        with self._build_scope(), self.name_scope():
             self.features = nn.HybridSequential(prefix='')
             self.features.add(nn.Conv2D(
                 num_init_features, kernel_size=7, strides=2, padding=3,
@@ -76,6 +79,7 @@ class DenseNet(HybridBlock):
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
+        x = self._stem_input(F, x)
         x = self.features(x)
         return self.output(x)
 
@@ -92,6 +96,9 @@ densenet_spec = {
 def get_densenet(num_layers, pretrained=False, ctx=cpu(),
                  root=os.path.join('~', '.mxnet', 'models'), **kwargs):
     num_init_features, growth_rate, block_config = densenet_spec[num_layers]
+    if pretrained:
+        # shipped checkpoints are reference-layout (NCHW/OIHW)
+        kwargs.setdefault('layout', 'NCHW')
     net = DenseNet(num_init_features, growth_rate, block_config, **kwargs)
     if pretrained:
         net.load_parameters(os.path.join(

@@ -5,7 +5,9 @@ import os
 
 from ...block import HybridBlock
 from ... import nn
+from .... import layout as layout_mod
 from ....context import cpu
+from ._base import _LayoutNet
 
 
 def _make_basic_conv(**kwargs):
@@ -33,7 +35,8 @@ def _make_branch(use_pool, *conv_settings):
 
 
 def _make_A(pool_features, prefix):
-    out = nn.HybridConcurrent(axis=1, prefix=prefix)
+    out = nn.HybridConcurrent(axis=layout_mod.current_channel_axis(),
+                              prefix=prefix)
     with out.name_scope():
         out.add(_make_branch(None, (64, 1, None, None)))
         out.add(_make_branch(None, (48, 1, None, None),
@@ -45,7 +48,8 @@ def _make_A(pool_features, prefix):
 
 
 def _make_B(prefix):
-    out = nn.HybridConcurrent(axis=1, prefix=prefix)
+    out = nn.HybridConcurrent(axis=layout_mod.current_channel_axis(),
+                              prefix=prefix)
     with out.name_scope():
         out.add(_make_branch(None, (384, 3, 2, None)))
         out.add(_make_branch(None, (64, 1, None, None),
@@ -55,7 +59,8 @@ def _make_B(prefix):
 
 
 def _make_C(channels_7x7, prefix):
-    out = nn.HybridConcurrent(axis=1, prefix=prefix)
+    out = nn.HybridConcurrent(axis=layout_mod.current_channel_axis(),
+                              prefix=prefix)
     with out.name_scope():
         out.add(_make_branch(None, (192, 1, None, None)))
         out.add(_make_branch(
@@ -73,7 +78,8 @@ def _make_C(channels_7x7, prefix):
 
 
 def _make_D(prefix):
-    out = nn.HybridConcurrent(axis=1, prefix=prefix)
+    out = nn.HybridConcurrent(axis=layout_mod.current_channel_axis(),
+                              prefix=prefix)
     with out.name_scope():
         out.add(_make_branch(None, (192, 1, None, None),
                              (320, 3, 2, None)))
@@ -90,13 +96,16 @@ class _SplitConcat(HybridBlock):
     def __init__(self, **kwargs):
         super().__init__(**kwargs)
         self.branches = None
+        self._caxis = layout_mod.current_channel_axis()
 
     def hybrid_forward(self, F, x):
-        return F.concat(*[b(x) for b in self._children.values()], dim=1)
+        return F.concat(*[b(x) for b in self._children.values()],
+                        dim=self._caxis)
 
 
 def _make_E(prefix):
-    out = nn.HybridConcurrent(axis=1, prefix=prefix)
+    out = nn.HybridConcurrent(axis=layout_mod.current_channel_axis(),
+                              prefix=prefix)
     with out.name_scope():
         out.add(_make_branch(None, (320, 1, None, None)))
 
@@ -125,12 +134,12 @@ def _make_E(prefix):
     return out
 
 
-class Inception3(HybridBlock):
+class Inception3(_LayoutNet):
     """Inception v3 (parity: inception.py Inception3:119)."""
 
-    def __init__(self, classes=1000, **kwargs):
-        super().__init__(**kwargs)
-        with self.name_scope():
+    def __init__(self, classes=1000, layout=None, **kwargs):
+        super().__init__(layout=layout, **kwargs)
+        with self._build_scope(), self.name_scope():
             self.features = nn.HybridSequential(prefix='')
             self.features.add(_make_basic_conv(
                 channels=32, kernel_size=3, strides=2))
@@ -158,12 +167,16 @@ class Inception3(HybridBlock):
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
+        x = self._stem_input(F, x)
         x = self.features(x)
         return self.output(x)
 
 
 def inception_v3(pretrained=False, ctx=cpu(),
                  root=os.path.join('~', '.mxnet', 'models'), **kwargs):
+    if pretrained:
+        # shipped checkpoints are reference-layout (NCHW/OIHW)
+        kwargs.setdefault('layout', 'NCHW')
     net = Inception3(**kwargs)
     if pretrained:
         net.load_parameters(os.path.join(

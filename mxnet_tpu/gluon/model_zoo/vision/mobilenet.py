@@ -10,6 +10,7 @@ import os
 from ...block import HybridBlock
 from ... import nn
 from ....context import cpu
+from ._base import _LayoutNet
 
 
 class RELU6(HybridBlock):
@@ -52,12 +53,12 @@ class LinearBottleneck(HybridBlock):
         return out
 
 
-class MobileNet(HybridBlock):
+class MobileNet(_LayoutNet):
     """MobileNet v1 (parity: mobilenet.py MobileNet:107)."""
 
-    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
-        super().__init__(**kwargs)
-        with self.name_scope():
+    def __init__(self, multiplier=1.0, classes=1000, layout=None, **kwargs):
+        super().__init__(layout=layout, **kwargs)
+        with self._build_scope(), self.name_scope():
             self.features = nn.HybridSequential(prefix='')
             with self.features.name_scope():
                 _add_conv(self.features, channels=int(32 * multiplier),
@@ -77,16 +78,17 @@ class MobileNet(HybridBlock):
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
+        x = self._stem_input(F, x)
         x = self.features(x)
         return self.output(x)
 
 
-class MobileNetV2(HybridBlock):
+class MobileNetV2(_LayoutNet):
     """MobileNet v2 (parity: mobilenet.py MobileNetV2:160)."""
 
-    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
-        super().__init__(**kwargs)
-        with self.name_scope():
+    def __init__(self, multiplier=1.0, classes=1000, layout=None, **kwargs):
+        super().__init__(layout=layout, **kwargs)
+        with self._build_scope(), self.name_scope():
             self.features = nn.HybridSequential(prefix='features_')
             with self.features.name_scope():
                 _add_conv(self.features, int(32 * multiplier), kernel=3,
@@ -114,12 +116,16 @@ class MobileNetV2(HybridBlock):
                     nn.Flatten())
 
     def hybrid_forward(self, F, x):
+        x = self._stem_input(F, x)
         x = self.features(x)
         return self.output(x)
 
 
 def get_mobilenet(multiplier, pretrained=False, ctx=cpu(),
                   root=os.path.join('~', '.mxnet', 'models'), **kwargs):
+    if pretrained:
+        # shipped checkpoints are reference-layout (NCHW/OIHW)
+        kwargs.setdefault('layout', 'NCHW')
     net = MobileNet(multiplier, **kwargs)
     if pretrained:
         version_suffix = '{0:.2f}'.format(multiplier)
@@ -133,6 +139,9 @@ def get_mobilenet(multiplier, pretrained=False, ctx=cpu(),
 
 def get_mobilenet_v2(multiplier, pretrained=False, ctx=cpu(),
                      root=os.path.join('~', '.mxnet', 'models'), **kwargs):
+    if pretrained:
+        # shipped checkpoints are reference-layout (NCHW/OIHW)
+        kwargs.setdefault('layout', 'NCHW')
     net = MobileNetV2(multiplier, **kwargs)
     if pretrained:
         version_suffix = '{0:.2f}'.format(multiplier)

@@ -11,6 +11,7 @@ import os
 from ...block import HybridBlock
 from ... import nn
 from ....context import cpu
+from ._base import _LayoutNet
 
 
 def _conv3x3(channels, stride, in_channels):
@@ -147,14 +148,14 @@ class BottleneckV2(HybridBlock):
         return x + residual
 
 
-class ResNetV1(HybridBlock):
+class ResNetV1(_LayoutNet):
     """ResNet v1 (parity: resnet.py ResNetV1)."""
 
     def __init__(self, block, layers, channels, classes=1000,
-                 thumbnail=False, **kwargs):
-        super().__init__(**kwargs)
+                 thumbnail=False, layout=None, **kwargs):
+        super().__init__(layout=layout, **kwargs)
         assert len(layers) == len(channels) - 1
-        with self.name_scope():
+        with self._build_scope(), self.name_scope():
             self.features = nn.HybridSequential(prefix='')
             if thumbnail:
                 self.features.add(_conv3x3(channels[0], 1, 0))
@@ -184,18 +185,19 @@ class ResNetV1(HybridBlock):
         return layer
 
     def hybrid_forward(self, F, x):
+        x = self._stem_input(F, x)
         x = self.features(x)
         return self.output(x)
 
 
-class ResNetV2(HybridBlock):
+class ResNetV2(_LayoutNet):
     """ResNet v2 (parity: resnet.py ResNetV2)."""
 
     def __init__(self, block, layers, channels, classes=1000,
-                 thumbnail=False, **kwargs):
-        super().__init__(**kwargs)
+                 thumbnail=False, layout=None, **kwargs):
+        super().__init__(layout=layout, **kwargs)
         assert len(layers) == len(channels) - 1
-        with self.name_scope():
+        with self._build_scope(), self.name_scope():
             self.features = nn.HybridSequential(prefix='')
             self.features.add(nn.BatchNorm(scale=False, center=False))
             if thumbnail:
@@ -231,6 +233,7 @@ class ResNetV2(HybridBlock):
         return layer
 
     def hybrid_forward(self, F, x):
+        x = self._stem_input(F, x)
         x = self.features(x)
         return self.output(x)
 
@@ -259,6 +262,9 @@ def get_resnet(version, num_layers, pretrained=False, ctx=cpu(),
         "Invalid resnet version: %d. Options are 1 and 2." % version
     resnet_class = resnet_net_versions[version - 1]
     block_class = resnet_block_versions[version - 1][block_type]
+    if pretrained:
+        # shipped checkpoints are reference-layout (NCHW/OIHW)
+        kwargs.setdefault('layout', 'NCHW')
     net = resnet_class(block_class, layers, channels, **kwargs)
     if pretrained:
         path = os.path.join(

@@ -3,15 +3,17 @@ from __future__ import annotations
 
 import os
 
-from ...block import HybridBlock
 from ... import nn
+from .... import layout as layout_mod
 from ....context import cpu
+from ._base import _LayoutNet
 
 
 def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels):
     out = nn.HybridSequential(prefix='')
     out.add(_make_fire_conv(squeeze_channels, 1))
-    paths = nn.HybridConcurrent(axis=1, prefix='')
+    paths = nn.HybridConcurrent(
+        axis=layout_mod.current_channel_axis(), prefix='')
     paths.add(_make_fire_conv(expand1x1_channels, 1))
     paths.add(_make_fire_conv(expand3x3_channels, 3, 1))
     out.add(paths)
@@ -25,13 +27,13 @@ def _make_fire_conv(channels, kernel_size, padding=0):
     return out
 
 
-class SqueezeNet(HybridBlock):
-    def __init__(self, version, classes=1000, **kwargs):
-        super().__init__(**kwargs)
+class SqueezeNet(_LayoutNet):
+    def __init__(self, version, classes=1000, layout=None, **kwargs):
+        super().__init__(layout=layout, **kwargs)
         assert version in ['1.0', '1.1'], \
             "Unsupported SqueezeNet version {}: 1.0 or 1.1 expected".format(
                 version)
-        with self.name_scope():
+        with self._build_scope(), self.name_scope():
             self.features = nn.HybridSequential(prefix='')
             if version == '1.0':
                 self.features.add(nn.Conv2D(96, kernel_size=7, strides=2))
@@ -75,12 +77,16 @@ class SqueezeNet(HybridBlock):
             self.output.add(nn.Flatten())
 
     def hybrid_forward(self, F, x):
+        x = self._stem_input(F, x)
         x = self.features(x)
         return self.output(x)
 
 
 def get_squeezenet(version, pretrained=False, ctx=cpu(),
                    root=os.path.join('~', '.mxnet', 'models'), **kwargs):
+    if pretrained:
+        # shipped checkpoints are reference-layout (NCHW/OIHW)
+        kwargs.setdefault('layout', 'NCHW')
     net = SqueezeNet(version, **kwargs)
     if pretrained:
         net.load_parameters(os.path.join(

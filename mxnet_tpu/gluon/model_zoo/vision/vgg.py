@@ -3,18 +3,18 @@ from __future__ import annotations
 
 import os
 
-from ...block import HybridBlock
 from ... import nn
 from ....context import cpu
 from .... import initializer as init
+from ._base import _LayoutNet
 
 
-class VGG(HybridBlock):
+class VGG(_LayoutNet):
     def __init__(self, layers, filters, classes=1000, batch_norm=False,
-                 **kwargs):
-        super().__init__(**kwargs)
+                 layout=None, **kwargs):
+        super().__init__(layout=layout, **kwargs)
         assert len(layers) == len(filters)
-        with self.name_scope():
+        with self._build_scope(), self.name_scope():
             self.features = self._make_features(layers, filters,
                                                 batch_norm)
             self.features.add(nn.Dense(
@@ -43,6 +43,7 @@ class VGG(HybridBlock):
         return featurizer
 
     def hybrid_forward(self, F, x):
+        x = self._stem_input(F, x)
         x = self.features(x)
         return self.output(x)
 
@@ -58,6 +59,9 @@ vgg_spec = {
 def get_vgg(num_layers, pretrained=False, ctx=cpu(),
             root=os.path.join('~', '.mxnet', 'models'), **kwargs):
     layers, filters = vgg_spec[num_layers]
+    if pretrained:
+        # shipped checkpoints are reference-layout (NCHW/OIHW)
+        kwargs.setdefault('layout', 'NCHW')
     net = VGG(layers, filters, **kwargs)
     if pretrained:
         batch_norm_suffix = '_bn' if kwargs.get('batch_norm') else ''

@@ -148,13 +148,19 @@ class BatchNorm(HybridBlock):
     compiled (hybridized) executable.
     """
 
-    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+    def __init__(self, axis=None, momentum=0.9, epsilon=1e-5, center=True,
                  scale=True, use_global_stats=False, beta_initializer="zeros",
                  gamma_initializer="ones",
                  running_mean_initializer="zeros",
                  running_variance_initializer="ones", in_channels=0,
                  prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
+        if axis is None:
+            # reference default is axis=1 (NCHW); under the channels-last
+            # layout policy (layout.py) the channel axis is the last one
+            from ... import layout as layout_mod
+
+            axis = -1 if layout_mod.is_channel_last() else 1
         self._kwargs = {"axis": axis, "eps": epsilon, "momentum": momentum,
                         "fix_gamma": not scale,
                         "use_global_stats": use_global_stats}

@@ -8,6 +8,7 @@ TPU-preferred and supported via ``layout=``.
 from __future__ import annotations
 
 from ...base import MXNetError
+from ... import layout as layout_mod
 from ..block import HybridBlock
 from .activations import Activation
 
@@ -29,6 +30,11 @@ class _Conv(HybridBlock):
         self._channels = channels
         self._in_channels = in_channels
         nd = len(kernel_size)
+        if layout is None:
+            # layout policy (layout.py): channel-first unless an explicit
+            # channels-last policy/scope is active.  Deconvolution lowers
+            # channel-first only, so transposed convs pin their default.
+            layout = layout_mod.default_layout(nd)
         strides = _pair(strides, nd)
         padding = _pair(padding, nd)
         dilation = _pair(dilation, nd)
@@ -92,7 +98,7 @@ class _Conv(HybridBlock):
 
 class Conv1D(_Conv):
     def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
-                 groups=1, layout="NCW", activation=None, use_bias=True,
+                 groups=1, layout=None, activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros",
                  in_channels=0, **kwargs):
         if isinstance(kernel_size, int):
@@ -104,7 +110,7 @@ class Conv1D(_Conv):
 
 class Conv2D(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
-                 dilation=(1, 1), groups=1, layout="NCHW", activation=None,
+                 dilation=(1, 1), groups=1, layout=None, activation=None,
                  use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", in_channels=0, **kwargs):
         if isinstance(kernel_size, int):
@@ -117,7 +123,7 @@ class Conv2D(_Conv):
 class Conv3D(_Conv):
     def __init__(self, channels, kernel_size, strides=(1, 1, 1),
                  padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
-                 layout="NCDHW", activation=None, use_bias=True,
+                 layout=None, activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros",
                  in_channels=0, **kwargs):
         if isinstance(kernel_size, int):
@@ -182,6 +188,8 @@ class _Pooling(HybridBlock):
         if strides is None:
             strides = pool_size
         nd = len(pool_size)
+        if layout is None:
+            layout = layout_mod.default_layout(nd)
         self._kwargs = {
             "kernel": pool_size, "stride": _pair(strides, nd),
             "pad": _pair(padding, nd), "global_pool": global_pool,
@@ -203,7 +211,7 @@ class _Pooling(HybridBlock):
 
 
 class MaxPool1D(_Pooling):
-    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+    def __init__(self, pool_size=2, strides=None, padding=0, layout=None,
                  ceil_mode=False, **kwargs):
         if isinstance(pool_size, int):
             pool_size = (pool_size,)
@@ -213,7 +221,7 @@ class MaxPool1D(_Pooling):
 
 class MaxPool2D(_Pooling):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
-                 layout="NCHW", ceil_mode=False, **kwargs):
+                 layout=None, ceil_mode=False, **kwargs):
         if isinstance(pool_size, int):
             pool_size = (pool_size,) * 2
         super().__init__(pool_size, strides, padding, False, "max", layout,
@@ -222,7 +230,7 @@ class MaxPool2D(_Pooling):
 
 class MaxPool3D(_Pooling):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
-                 layout="NCDHW", ceil_mode=False, **kwargs):
+                 layout=None, ceil_mode=False, **kwargs):
         if isinstance(pool_size, int):
             pool_size = (pool_size,) * 3
         super().__init__(pool_size, strides, padding, False, "max", layout,
@@ -230,7 +238,7 @@ class MaxPool3D(_Pooling):
 
 
 class AvgPool1D(_Pooling):
-    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+    def __init__(self, pool_size=2, strides=None, padding=0, layout=None,
                  ceil_mode=False, count_include_pad=True, **kwargs):
         if isinstance(pool_size, int):
             pool_size = (pool_size,)
@@ -240,7 +248,7 @@ class AvgPool1D(_Pooling):
 
 class AvgPool2D(_Pooling):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
-                 layout="NCHW", ceil_mode=False, count_include_pad=True,
+                 layout=None, ceil_mode=False, count_include_pad=True,
                  **kwargs):
         if isinstance(pool_size, int):
             pool_size = (pool_size,) * 2
@@ -250,7 +258,7 @@ class AvgPool2D(_Pooling):
 
 class AvgPool3D(_Pooling):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
-                 layout="NCDHW", ceil_mode=False, count_include_pad=True,
+                 layout=None, ceil_mode=False, count_include_pad=True,
                  **kwargs):
         if isinstance(pool_size, int):
             pool_size = (pool_size,) * 3
@@ -259,32 +267,32 @@ class AvgPool3D(_Pooling):
 
 
 class GlobalMaxPool1D(_Pooling):
-    def __init__(self, layout="NCW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1,), None, 0, True, "max", layout, **kwargs)
 
 
 class GlobalMaxPool2D(_Pooling):
-    def __init__(self, layout="NCHW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1, 1), None, 0, True, "max", layout, **kwargs)
 
 
 class GlobalMaxPool3D(_Pooling):
-    def __init__(self, layout="NCDHW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1, 1, 1), None, 0, True, "max", layout, **kwargs)
 
 
 class GlobalAvgPool1D(_Pooling):
-    def __init__(self, layout="NCW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1,), None, 0, True, "avg", layout, **kwargs)
 
 
 class GlobalAvgPool2D(_Pooling):
-    def __init__(self, layout="NCHW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1, 1), None, 0, True, "avg", layout, **kwargs)
 
 
 class GlobalAvgPool3D(_Pooling):
-    def __init__(self, layout="NCDHW", **kwargs):
+    def __init__(self, layout=None, **kwargs):
         super().__init__((1, 1, 1), None, 0, True, "avg", layout, **kwargs)
 
 

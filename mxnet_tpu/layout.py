@@ -1,0 +1,149 @@
+"""Global spatial-layout policy: channels-last on TPU, NCHW for parity.
+
+The reference is NCHW-native end to end (``src/operator/nn/convolution.cc``
+defaults, cuDNN's preferred layout).  TPUs are the opposite: XLA:TPU tiles
+convolutions onto the MXU in channels-last (NHWC) form, and an NCHW graph
+pays relayout copies around convs.  This module is the single switch that
+decides which layout spatial layers and the model zoo pick when the user
+does not say.
+
+Two tiers, deliberately different:
+
+- **Bare gluon layers** (``nn.Conv2D``/pooling/``nn.BatchNorm`` built with
+  no ``layout=``/``axis=``) resolve through :func:`default_layout`.  Under
+  the default ``"auto"`` policy this is ALWAYS channel-first — reference
+  semantics — because a bare layer has no input-boundary adapter: user code
+  feeding NCHW batches must keep working on every backend.  Channels-last
+  for bare layers is opt-in via :class:`layout_scope` or an explicit
+  ``layout=`` argument.
+- **Model-zoo networks** (built on ``_LayoutNet``) resolve through
+  :func:`preferred_layout`.  Under ``"auto"`` this picks channels-last iff
+  the default backend is an accelerator; the nets keep NCHW input
+  semantics by transposing once at the stem, so the switch is invisible
+  to callers.  ``pretrained=True`` loaders pin ``"NCHW"`` — shipped
+  checkpoints are reference-layout.
+
+Policy values: ``"auto"`` (the default, see above), the
+``"NCHW"``/``"channel_first"`` family, or the ``"NHWC"``/``"channel_last"``
+family.  :func:`set_default_layout` sets the PROCESS-wide base policy;
+:class:`layout_scope` applies a thread-local override inside a ``with``
+block (like other scope state, it does not leak across threads).
+
+Layout is resolved at **layer construction** time (it is a static property
+of the compiled program; changing the policy later never re-lays-out live
+parameters).  Conv weights are stored in the layout the layer was built
+with (OIHW for NCHW graphs, HWIO for NHWC graphs): to move checkpoints
+across machine kinds, pin an explicit layout.
+"""
+from __future__ import annotations
+
+import threading
+
+_CHANNEL_FIRST = {1: "NCW", 2: "NCHW", 3: "NCDHW"}
+_CHANNEL_LAST = {1: "NWC", 2: "NHWC", 3: "NDHWC"}
+_VALID = ({"auto", "channel_first", "channel_last"}
+          | set(_CHANNEL_FIRST.values()) | set(_CHANNEL_LAST.values()))
+
+_process_policy = ["auto"]
+_state = threading.local()
+_auto_cache = [None]
+
+
+def _auto_channel_last():
+    """True iff compute lands on an accelerator (used by
+    :func:`preferred_layout` only)."""
+    if _auto_cache[0] is None:
+        try:
+            import jax
+
+            _auto_cache[0] = jax.default_backend() not in ("cpu",)
+        except Exception:
+            _auto_cache[0] = False
+    return _auto_cache[0]
+
+
+def _canonical(policy):
+    if policy in _CHANNEL_LAST.values() or policy == "channel_last":
+        return "channel_last"
+    if policy in _CHANNEL_FIRST.values() or policy == "channel_first":
+        return "channel_first"
+    return "auto"
+
+
+def get_policy():
+    """Active policy: thread-local scope override, else the process base."""
+    return getattr(_state, "policy", None) or _process_policy[0]
+
+
+def set_default_layout(policy):
+    """Set the process-wide base layout policy; returns the previous one.
+
+    Accepts ``"auto"``, ``"channel_first"``/``"NCHW"``-family names, or
+    ``"channel_last"``/``"NHWC"``-family names.  Threads currently inside
+    a :class:`layout_scope` keep their scoped override.
+    """
+    if policy not in _VALID:
+        raise ValueError("unknown layout policy %r (want one of %s)"
+                         % (policy, sorted(_VALID)))
+    prev = _process_policy[0]
+    _process_policy[0] = _canonical(policy)
+    return prev
+
+
+class layout_scope:
+    """``with layout_scope("NHWC"): net = resnet50_v1()`` — thread-local
+    scoped policy override."""
+
+    def __init__(self, policy):
+        if policy not in _VALID:
+            raise ValueError("unknown layout policy %r (want one of %s)"
+                             % (policy, sorted(_VALID)))
+        self._policy = _canonical(policy)
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_state, "policy", None)
+        _state.policy = self._policy
+        return self
+
+    def __exit__(self, *exc):
+        _state.policy = self._prev
+        return False
+
+
+def is_channel_last():
+    """True iff BARE layers should build channels-last right now (explicit
+    channel_last policy only — ``auto`` is channel-first for bare layers)."""
+    return _canonical(get_policy()) == "channel_last"
+
+
+def default_layout(ndim=2):
+    """Layout a bare spatial layer picks when the caller does not say.
+
+    ``auto`` → channel-first (reference semantics; safe for NCHW-feeding
+    user code on every backend).  Explicit policies are honored.
+    """
+    table = _CHANNEL_LAST if is_channel_last() else _CHANNEL_FIRST
+    return table[ndim]
+
+
+def preferred_layout(ndim=2):
+    """Layout a model-zoo net (with an NCHW-boundary stem adapter) picks.
+
+    ``auto`` → channels-last iff the default backend is an accelerator;
+    explicit policies are honored.
+    """
+    c = _canonical(get_policy())
+    last = _auto_channel_last() if c == "auto" else (c == "channel_last")
+    return (_CHANNEL_LAST if last else _CHANNEL_FIRST)[ndim]
+
+
+def channel_axis(layout):
+    """Channel axis index for a layout string (1 or -1)."""
+    return 1 if layout.startswith("NC") else -1
+
+
+def current_channel_axis():
+    """Channel axis implied for bare layers by the active policy (for
+    concat/split sites that are built once and baked into the graph)."""
+    return -1 if is_channel_last() else 1

@@ -8,8 +8,12 @@ neighbouring matmuls, RNN is a ``lax.scan`` so the whole unrolled sequence
 compiles to a single executable with static shapes.
 
 Layout: MXNet's native layout is NCHW.  Every spatial op takes a ``layout``
-attr and also accepts NHWC — the layout XLA/TPU prefers — and the gluon layers
-default to NHWC-on-TPU while presenting NCHW-compatible semantics.
+attr and also accepts NHWC — the layout XLA/TPU prefers.  Which one gluon
+layers pick when the caller does not say is decided by the policy in
+``mxnet_tpu/layout.py``: bare layers stay channel-first (reference
+semantics) unless an explicit policy/scope says otherwise, while model-zoo
+networks auto-select channels-last on accelerators and keep accepting NCHW
+input via one stem transpose.
 """
 from __future__ import annotations
 

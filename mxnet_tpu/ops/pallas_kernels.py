@@ -1,21 +1,22 @@
-"""Pallas TPU kernels: fused flash attention.
+"""Pallas TPU kernels: fused flash attention (forward AND backward).
 
 The reference's fused-attention story is two CUDA kernels
 (``_contrib_interleaved_matmul_selfatt_qk``/``_valatt``,
 ``src/operator/contrib/transformer.cc:650-780``) that still materialize
-the (T, T) score matrix.  TPU-native replacement: one Pallas kernel doing
+the (T, T) score matrix.  TPU-native replacement: Pallas kernels doing
 blocked online-softmax attention (flash attention) — scores never leave
 VMEM, HBM traffic is O(T·D) instead of O(T²), and the MXU sees back-to-
 back (block_q × D)·(D × block_k) matmuls.
 
-On non-TPU backends the kernel runs through the Pallas interpreter
-(tests), or falls back to a plain jnp attention when shapes don't tile.
-Backward: the forward saves only (q, k, v) — O(T·D) residuals — and the
-backward RECOMPUTES attention in plain XLA, which materializes the (T, T)
-score matrix transiently.  The forward memory win (inference, frozen
-backbones, activation checkpointing boundaries) is real; a fully blocked
-backward kernel is future work, so very long TRAINING sequences should
-use ring attention (parallel/ring_attention.py) to shard T first.
+Backward is the standard two-pass flash backward (Dao et al.):
+the forward saves (q, k, v, o, lse) — O(T·D) residuals — then one kernel
+recomputes p blockwise to accumulate dq over k-blocks, and a second
+accumulates dk/dv over q-blocks.  No (T, T) buffer exists in either
+direction, so long-context TRAINING runs at O(T·D) memory; ring attention
+(parallel/ring_attention.py) composes on top to shard T across chips.
+
+On non-TPU backends the kernels run through the Pallas interpreter
+(tests), or fall back to plain jnp attention when shapes don't tile.
 """
 from __future__ import annotations
 
@@ -28,8 +29,9 @@ from jax import lax
 from .registry import register
 
 
-def _flash_dispatch(q, k, v, scale, causal, block_q, block_k):
-    """Pick compiled vs interpreted pallas at LOWERING time.
+def _platform_pick(run, *args):
+    """Compiled kernel ONLY on tpu; every other platform (cpu, and
+    untested cuda/rocm) goes through the interpreter.
 
     ``jax.lax.platform_dependent`` resolves per lowering platform, so the
     same traced computation runs the real kernel on TPU and the
@@ -37,19 +39,18 @@ def _flash_dispatch(q, k, v, scale, causal, block_q, block_k):
     eager dispatch ends up placed (a cpu-committed input must never see
     the compiled TPU kernel).
     """
-    import functools as _ft
-
-    run = _ft.partial(_flash_pallas, scale=scale, causal=causal,
-                      block_q=block_q, block_k=block_k)
-    # compiled kernel ONLY on tpu; every other platform (cpu, and
-    # untested cuda/rocm) goes through the interpreter
     return jax.lax.platform_dependent(
-        q, k, v,
-        tpu=_ft.partial(run, interpret=False),
-        default=_ft.partial(run, interpret=True))
+        *args,
+        tpu=functools.partial(run, interpret=False),
+        default=functools.partial(run, interpret=True))
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q,
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q,
                       block_k, scale, causal):
     from jax.experimental import pallas as pl
 
@@ -88,6 +89,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q,
     m, l, acc = lax.fori_loop(0, n_k, body, (m0, l0, acc0))
     safe_l = jnp.where(l == 0, 1.0, l)
     o_ref[0] = (acc / safe_l).astype(o_ref.dtype)
+    # logsumexp per row; -inf rows (fully masked) stored as -inf
+    lse = jnp.where(l[:, 0] == 0, -jnp.inf, m[:, 0] + jnp.log(safe_l[:, 0]))
+    lse_ref[0] = lse
 
 
 def _flash_pallas(q, k, v, scale, causal, block_q, block_k,
@@ -99,7 +103,7 @@ def _flash_pallas(q, k, v, scale, causal, block_q, block_k,
     kernel = functools.partial(
         _flash_fwd_kernel, block_q=block_q, block_k=block_k,
         scale=scale, causal=causal)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(bh, t_q // block_q),
         in_specs=[
@@ -107,15 +111,162 @@ def _flash_pallas(q, k, v, scale, causal, block_q, block_k,
             pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t_q), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward: dq kernel (parallel over q blocks) + dkv kernel (over k blocks)
+# ---------------------------------------------------------------------------
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_q, block_k, scale, causal):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32)                    # (bq, D)
+    do = do_ref[0].astype(jnp.float32)                  # (bq, D)
+    lse = lse_ref[0][:, None]                           # (bq, 1)
+    delta = delta_ref[0][:, None]                       # (bq, 1)
+    t_kv = k_ref.shape[1]
+    n_k = t_kv // block_k
+    qi = pl.program_id(1)
+    row = qi * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(i, dq):
+        k = k_ref[0, pl.dslice(i * block_k, block_k), :] \
+            .astype(jnp.float32)
+        v = v_ref[0, pl.dslice(i * block_k, block_k), :] \
+            .astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            col = i * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(col <= row, s, -jnp.inf)
+        # p is the NORMALIZED probability (lse folds in the row sum);
+        # fully-masked rows have lse=-inf -> exp(-inf - -inf) guarded to 0
+        p = jnp.where(jnp.isfinite(lse), jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (bq, bk)
+        ds = p * (dp - delta)
+        return dq + scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    dq = lax.fori_loop(0, n_k, body, dq0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q, block_k, scale,
+                          causal):
+    from jax.experimental import pallas as pl
+
+    k = k_ref[0].astype(jnp.float32)                    # (bk, D)
+    v = v_ref[0].astype(jnp.float32)                    # (bk, D)
+    t_q = q_ref.shape[1]
+    n_q = t_q // block_q
+    ki = pl.program_id(1)
+    col = ki * block_k + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.dslice(i * block_q, block_q), :] \
+            .astype(jnp.float32)
+        do = do_ref[0, pl.dslice(i * block_q, block_q), :] \
+            .astype(jnp.float32)
+        lse = lse_ref[0, pl.dslice(i * block_q, block_q)][:, None]
+        delta = delta_ref[0, pl.dslice(i * block_q, block_q)][:, None]
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (bq, bk)
+        if causal:
+            row = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            s = jnp.where(col <= row, s, -jnp.inf)
+        p = jnp.where(jnp.isfinite(lse), jnp.exp(s - lse), 0.0)
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (bk, D)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (bq, bk)
+        ds = p * (dp - delta)
+        dk_new = dk + scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (bk, D)
+        return dk_new, dv_new
+
+    z = jnp.zeros((k.shape[0], k.shape[1]), jnp.float32)
+    dk, dv = lax.fori_loop(0, n_q, body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, do, lse, delta, scale, causal, block_q,
+                      block_k, interpret=False):
+    from jax.experimental import pallas as pl
+
+    bh, t_q, d = q.shape
+    t_kv = k.shape[1]
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
+                          block_k=block_k, scale=scale, causal=causal),
+        grid=(bh, t_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
         interpret=interpret,
-    )(q, k, v)
-    return out
+    )(q, k, v, do, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+                          block_k=block_k, scale=scale, causal=causal),
+        grid=(bh, t_kv // block_k),
+        in_specs=[
+            pl.BlockSpec((1, t_q, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, t_q, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, t_q), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, t_q), lambda b, j: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_kv, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, t_kv, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
 
 
 def _attention_ref(q, k, v, scale, causal):
-    """Plain jnp attention (fallback + backward recompute)."""
+    """Plain jnp attention (fallback for non-tiling shapes)."""
     s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if causal:
@@ -130,19 +281,29 @@ def _attention_ref(q, k, v, scale, causal):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_attention(q, k, v, scale, causal, block_q, block_k):
-    return _flash_dispatch(q, k, v, scale, causal, block_q, block_k)
+    run = functools.partial(_flash_pallas, scale=scale, causal=causal,
+                            block_q=block_q, block_k=block_k)
+    out, _ = _platform_pick(run, q, k, v)
+    return out
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
-    out = _flash_dispatch(q, k, v, scale, causal, block_q, block_k)
-    return out, (q, k, v)
+    run = functools.partial(_flash_pallas, scale=scale, causal=causal,
+                            block_q=block_q, block_k=block_k)
+    out, lse = _platform_pick(run, q, k, v)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(scale, causal, block_q, block_k, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q_, k_, v_:
-                     _attention_ref(q_, k_, v_, scale, causal), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    # delta_i = sum_d dO_id * O_id  (rowwise), O(T*D) — the only
+    # off-kernel piece of the two-pass flash backward
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+    run = functools.partial(_flash_bwd_pallas, scale=scale, causal=causal,
+                            block_q=block_q, block_k=block_k)
+    dq, dk, dv = _platform_pick(run, q, k, v, g, lse, delta)
+    return dq, dk, dv
 
 
 _flash_attention.defvjp(_flash_fwd, _flash_bwd)
@@ -163,6 +324,8 @@ def flash_attention(query, key, value, scale=None, causal=False,
     Inputs (B, H, T, D) [or (BH, T, D)]; returns same shape.  Scores are
     computed blockwise with an online softmax; ``scale`` defaults to
     1/sqrt(D).  Falls back to plain XLA attention when T doesn't tile.
+    Differentiable end-to-end via the blocked flash backward (no (T, T)
+    buffer in forward or backward).
     """
     squeeze = query.ndim == 3
     if squeeze:

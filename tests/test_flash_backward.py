@@ -1,0 +1,127 @@
+"""Blocked flash-attention backward: gradient correctness + memory shape.
+
+VERDICT r2 item 4: the backward must be the two-pass blocked kernel (dq
+pass + dk/dv pass), differentiated against the plain-XLA reference at
+several (T, D, causal) points, with no (T, T) buffer in the compiled HLO
+at long T.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops import pallas_kernels as pk
+
+
+def _ref_grads(q, k, v, do, scale, causal):
+    _, vjp = jax.vjp(
+        lambda a, b, c: pk._attention_ref(a, b, c, scale, causal), q, k, v)
+    return vjp(do)
+
+
+def _flash_grads(q, k, v, do, scale, causal, bq, bk):
+    _, vjp = jax.vjp(
+        lambda a, b, c: pk._flash_attention(a, b, c, scale, causal, bq, bk),
+        q, k, v)
+    return vjp(do)
+
+
+@pytest.mark.parametrize("t,d,causal,bq,bk", [
+    (32, 16, False, 8, 8),
+    (64, 32, True, 16, 16),
+    (64, 8, True, 8, 32),
+    (128, 64, False, 32, 16),
+])
+def test_flash_backward_matches_reference(t, d, causal, bq, bk):
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(2, t, d), jnp.float32) * 0.5
+    k = jnp.asarray(rs.randn(2, t, d), jnp.float32) * 0.5
+    v = jnp.asarray(rs.randn(2, t, d), jnp.float32)
+    do = jnp.asarray(rs.randn(2, t, d), jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+    ref = _ref_grads(q, k, v, do, scale, causal)
+    got = _flash_grads(q, k, v, do, scale, causal, bq, bk)
+    for name, r, g in zip("qkv", ref, got):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(r), rtol=2e-4, atol=2e-4,
+            err_msg="d%s mismatch (t=%d d=%d causal=%s)" % (
+                name, t, d, causal))
+
+
+def test_flash_backward_finite_difference():
+    """Independent FD check of the full custom_vjp chain on a tiny case."""
+    rs = np.random.RandomState(1)
+    t, d = 16, 8
+    q0 = rs.randn(1, t, d).astype(np.float32) * 0.3
+    k0 = rs.randn(1, t, d).astype(np.float32) * 0.3
+    v0 = rs.randn(1, t, d).astype(np.float32)
+    scale = 1.0 / np.sqrt(d)
+
+    def f(q):
+        out = pk._flash_attention(q, jnp.asarray(k0), jnp.asarray(v0),
+                                  scale, True, 8, 8)
+        return jnp.sum(out * out)
+
+    g = np.asarray(jax.grad(f)(jnp.asarray(q0)))
+    eps = 1e-3
+    for idx in [(0, 0, 0), (0, 5, 3), (0, 15, 7), (0, 9, 1)]:
+        qp, qm = q0.copy(), q0.copy()
+        qp[idx] += eps
+        qm[idx] -= eps
+        fd = (float(f(jnp.asarray(qp))) - float(f(jnp.asarray(qm)))) \
+            / (2 * eps)
+        assert abs(fd - g[idx]) < 5e-2 * max(1.0, abs(fd)), (idx, fd, g[idx])
+
+
+def test_flash_backward_no_quadratic_buffer():
+    """The compiled train-direction HLO at T=4096 must not contain any
+    (T, T) f32/bf16 buffer — the flash property, forward AND backward."""
+    t, d = 4096, 64
+
+    def loss(q, k, v):
+        out = pk._flash_attention(q, k, v, 0.125, True, 128, 128)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    shapes = [jax.ShapeDtypeStruct((1, t, d), jnp.float32)] * 3
+    txt = g.lower(*shapes).compile().as_text()
+    assert "%dx%d" % (t, t) not in txt.replace(",", "x"), \
+        "quadratic buffer found in compiled HLO"
+    assert "4096,4096" not in txt, "quadratic buffer found in compiled HLO"
+
+
+def test_flash_backward_bf16_inputs():
+    rs = np.random.RandomState(2)
+    t, d = 64, 32
+    q = jnp.asarray(rs.randn(2, t, d), jnp.bfloat16)
+    k = jnp.asarray(rs.randn(2, t, d), jnp.bfloat16)
+    v = jnp.asarray(rs.randn(2, t, d), jnp.bfloat16)
+    do = jnp.asarray(rs.randn(2, t, d), jnp.bfloat16)
+    scale = 1.0 / np.sqrt(d)
+    got = _flash_grads(q, k, v, do, scale, True, 16, 16)
+    ref = _ref_grads(q.astype(jnp.float32), k.astype(jnp.float32),
+                     v.astype(jnp.float32), do.astype(jnp.float32),
+                     scale, True)
+    for name, r, g in zip("qkv", ref, got):
+        assert g.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(r), rtol=0.1, atol=0.15,
+            err_msg="d%s bf16 mismatch" % name)
+
+
+def test_flash_gluon_training_path():
+    """nd.contrib.flash_attention backward flows through the tape."""
+    from mxnet_tpu import autograd
+
+    rs = np.random.RandomState(3)
+    q = mx.nd.array(rs.randn(2, 2, 32, 16).astype(np.float32))
+    q.attach_grad()
+    with autograd.record():
+        out = mx.nd.contrib.flash_attention(q, q, q, causal=True,
+                                            block_q=8, block_k=8)
+        loss = (out * out).sum()
+    loss.backward()
+    g = q.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).max() > 0

@@ -1,0 +1,197 @@
+"""Layout-policy tests: channels-last layers and model-zoo parity.
+
+The TPU path runs convs channels-last (mxnet_tpu/layout.py); these tests
+pin (a) the policy plumbing, (b) exact forward parity between an NCHW net
+and an NHWC net sharing (transposed) weights, and (c) the NCHW boundary
+contract of model-zoo nets.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import layout as layout_mod
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def test_policy_default_is_nchw_on_cpu():
+    # tests run under JAX_PLATFORMS=cpu (conftest), so auto = channel-first
+    assert layout_mod.default_layout(2) == "NCHW"
+    assert layout_mod.default_layout(1) == "NCW"
+    assert not layout_mod.is_channel_last()
+
+
+def test_two_tier_policy():
+    # bare layers: auto -> channel-first even where model zoo would pick
+    # channels-last; explicit process policy flips both tiers
+    assert layout_mod.default_layout(2) == "NCHW"
+    prev = layout_mod.set_default_layout("channel_last")
+    try:
+        assert layout_mod.default_layout(2) == "NHWC"
+        assert layout_mod.preferred_layout(2) == "NHWC"
+    finally:
+        layout_mod.set_default_layout(prev)
+    # thread-local scope overrides the process base
+    layout_mod.set_default_layout("channel_last")
+    try:
+        with layout_mod.layout_scope("NCHW"):
+            assert layout_mod.default_layout(2) == "NCHW"
+            assert layout_mod.preferred_layout(2) == "NCHW"
+    finally:
+        layout_mod.set_default_layout("auto")
+
+
+def test_pretrained_factories_pin_nchw(monkeypatch):
+    # pretrained=True must build reference-layout nets even under a
+    # channels-last policy (checkpoints are NCHW/OIHW); stub the load to
+    # observe the constructed net
+    from mxnet_tpu.gluon.block import Block
+
+    seen = {}
+
+    def fake_load(self, *a, **k):
+        seen["layout"] = self._layout
+
+    monkeypatch.setattr(Block, "load_parameters", fake_load)
+    with layout_mod.layout_scope("NHWC"):
+        vision.resnet18_v1(pretrained=True)
+    assert seen["layout"] == "NCHW"
+
+
+def test_layout_scope_nesting():
+    with layout_mod.layout_scope("NHWC"):
+        assert layout_mod.default_layout(2) == "NHWC"
+        assert layout_mod.default_layout(3) == "NDHWC"
+        with layout_mod.layout_scope("NCHW"):
+            assert layout_mod.default_layout(2) == "NCHW"
+        assert layout_mod.is_channel_last()
+    assert layout_mod.default_layout(2) == "NCHW"
+    with pytest.raises(ValueError):
+        layout_mod.set_default_layout("NWHC")
+
+
+def test_layers_resolve_policy_at_construction():
+    with layout_mod.layout_scope("NHWC"):
+        conv = nn.Conv2D(8, 3)
+        pool = nn.MaxPool2D(2)
+        bn = nn.BatchNorm()
+    assert conv._layout == "NHWC"
+    assert pool._kwargs["layout"] == "NHWC"
+    assert bn._axis == -1
+    conv_cf = nn.Conv2D(8, 3)
+    assert conv_cf._layout == "NCHW"
+    # explicit argument always wins over policy
+    with layout_mod.layout_scope("NHWC"):
+        assert nn.Conv2D(8, 3, layout="NCHW")._layout == "NCHW"
+
+
+def test_conv2d_nhwc_matches_nchw():
+    rs = np.random.RandomState(0)
+    x = rs.rand(2, 4, 8, 8).astype(np.float32)
+    w = rs.rand(5, 4, 3, 3).astype(np.float32)  # OIHW
+    a = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), None,
+                          kernel=(3, 3), num_filter=5, pad=(1, 1),
+                          no_bias=True, layout="NCHW").asnumpy()
+    b = mx.nd.Convolution(
+        mx.nd.array(x.transpose(0, 2, 3, 1)),
+        mx.nd.array(w.transpose(2, 3, 1, 0)), None,
+        kernel=(3, 3), num_filter=5, pad=(1, 1), no_bias=True,
+        layout="NHWC").asnumpy().transpose(0, 3, 1, 2)
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+def _copy_transposed(src_net, dst_net):
+    strip = lambda k: k.split("_", 1)[1]
+    src = {strip(k): p for k, p in src_net.collect_params().items()}
+    for k, p in dst_net.collect_params().items():
+        a = src[strip(k)].data().asnumpy()
+        if a.ndim == 4:
+            a = a.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+        p.set_data(mx.nd.array(a))
+
+
+def test_resnet_nhwc_parity_and_nchw_boundary():
+    x = mx.nd.array(np.random.RandomState(0).rand(2, 3, 32, 32)
+                    .astype(np.float32))
+    mx.random.seed(0)
+    with layout_mod.layout_scope("NHWC"):
+        net = vision.get_resnet(1, 18, thumbnail=True)
+    assert net._layout == "NHWC"
+    net.initialize(mx.init.Xavier())
+    out = net(x)  # NCHW input accepted at the boundary
+    assert out.shape == (2, 1000)
+
+    mx.random.seed(0)
+    nchw = vision.get_resnet(1, 18, thumbnail=True, layout="NCHW")
+    nchw.initialize(mx.init.Xavier())
+    nchw(x)
+    _copy_transposed(nchw, net)
+    np.testing.assert_allclose(nchw(x).asnumpy(), net(x).asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_densenet_concat_axis_follows_layout():
+    x = mx.nd.array(np.random.RandomState(1).rand(1, 3, 64, 64)
+                    .astype(np.float32))
+    mx.random.seed(0)
+    with layout_mod.layout_scope("NHWC"):
+        net = vision.DenseNet(8, 4, [2, 2], classes=10)
+    net.initialize(mx.init.Xavier())
+    mx.random.seed(0)
+    nchw = vision.DenseNet(8, 4, [2, 2], classes=10, layout="NCHW")
+    nchw.initialize(mx.init.Xavier())
+    net(x), nchw(x)
+    _copy_transposed(nchw, net)
+    np.testing.assert_allclose(nchw(x).asnumpy(), net(x).asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_squeezenet_fire_concat_follows_layout():
+    x = mx.nd.array(np.random.RandomState(2).rand(1, 3, 64, 64)
+                    .astype(np.float32))
+    mx.random.seed(0)
+    with layout_mod.layout_scope("NHWC"):
+        net = vision.squeezenet1_1(classes=10)
+    net.initialize(mx.init.Xavier())
+    mx.random.seed(0)
+    nchw = vision.squeezenet1_1(classes=10, layout="NCHW")
+    nchw.initialize(mx.init.Xavier())
+    net(x), nchw(x)
+    _copy_transposed(nchw, net)
+    np.testing.assert_allclose(nchw(x).asnumpy(), net(x).asnumpy(),
+                               rtol=1e-4, atol=2e-4)
+
+
+def test_mobilenet_depthwise_nhwc():
+    x = mx.nd.array(np.random.RandomState(3).rand(1, 3, 64, 64)
+                    .astype(np.float32))
+    mx.random.seed(0)
+    with layout_mod.layout_scope("NHWC"):
+        net = vision.mobilenet0_25(classes=10)
+    net.initialize(mx.init.Xavier())
+    mx.random.seed(0)
+    nchw = vision.mobilenet0_25(classes=10, layout="NCHW")
+    nchw.initialize(mx.init.Xavier())
+    net(x), nchw(x)
+    _copy_transposed(nchw, net)
+    np.testing.assert_allclose(nchw(x).asnumpy(), net(x).asnumpy(),
+                               rtol=1e-4, atol=2e-4)
+
+
+def test_hybridized_nhwc_resnet_trains():
+    from mxnet_tpu import gluon, parallel
+
+    mx.random.seed(0)
+    with layout_mod.layout_scope("NHWC"):
+        net = vision.get_resnet(1, 18, thumbnail=True, classes=10)
+    net.initialize(mx.init.Xavier())
+    step = parallel.JitTrainStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        "sgd", {"learning_rate": 0.1, "momentum": 0.9})
+    rs = np.random.RandomState(0)
+    x = rs.rand(8, 3, 32, 32).astype(np.float32)
+    y = rs.randint(0, 10, 8).astype(np.float32)
+    l0 = float(step.step(x, y))
+    for _ in range(8):
+        loss = step.step(x, y)
+    assert float(loss) < l0

@@ -1,0 +1,165 @@
+"""Pure-JAX NHWC ResNet-50 train-step ceiling probe.
+
+Hand-written minimal ResNet-50 v1 (bf16 activations, f32 BN stats, SGD
+momentum) with no framework plumbing — measures what XLA:TPU delivers on
+this chip for the same math, to separate framework overhead from compiler
+ceiling.  Usage: python tools/rn50_ceiling.py [batch] [variant]
+variant: bf16stats — BN batch stats computed in bf16 instead of f32.
+"""
+import functools
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+cache_dir = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+BF16_STATS = "bf16stats" in sys.argv
+
+
+def conv(x, w, stride=1):
+    return lax.conv_general_dilated(
+        x, w, (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def bn_train(x, gamma, beta):
+    if BF16_STATS:
+        mean = jnp.mean(x, axis=(0, 1, 2))
+        var = jnp.var(x, axis=(0, 1, 2))
+        inv = lax.rsqrt(var + jnp.bfloat16(1e-5)) * gamma
+        return x * inv + (beta - mean * inv)
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=(0, 1, 2))
+    var = jnp.var(x32, axis=(0, 1, 2))
+    inv = (lax.rsqrt(var + 1e-5) * gamma.astype(jnp.float32))
+    scale = inv.astype(x.dtype)
+    shift = (beta.astype(jnp.float32) - mean * inv).astype(x.dtype)
+    return x * scale + shift
+
+
+def bottleneck(x, p, stride, project):
+    out = bn_train(conv(x, p["w1"], stride), p["g1"], p["b1"])
+    out = jax.nn.relu(out)
+    out = bn_train(conv(out, p["w2"]), p["g2"], p["b2"])
+    out = jax.nn.relu(out)
+    out = bn_train(conv(out, p["w3"]), p["g3"], p["b3"])
+    if project:
+        sc = bn_train(conv(x, p["ws"], stride), p["gs"], p["bs"])
+    else:
+        sc = x
+    return jax.nn.relu(out + sc)
+
+
+LAYERS = [(3, 256, 1), (4, 512, 2), (6, 1024, 2), (3, 2048, 2)]
+
+
+def init_params(key):
+    rs = np.random.RandomState(0)
+    P = {}
+
+    def W(*shape):
+        fan_in = int(np.prod(shape[:-1]))
+        return jnp.asarray(
+            rs.randn(*shape) * np.sqrt(2.0 / fan_in), jnp.bfloat16)
+
+    P["stem_w"] = W(7, 7, 3, 64)
+    P["stem_g"] = jnp.ones((64,), jnp.bfloat16)
+    P["stem_b"] = jnp.zeros((64,), jnp.bfloat16)
+    in_ch = 64
+    for si, (n, ch, stride) in enumerate(LAYERS):
+        mid = ch // 4
+        for bi in range(n):
+            p = {}
+            cin = in_ch if bi == 0 else ch
+            s = stride if bi == 0 else 1
+            p["w1"] = W(1, 1, cin, mid)
+            p["w2"] = W(3, 3, mid, mid)
+            p["w3"] = W(1, 1, mid, ch)
+            for t in ("1", "2", "3"):
+                p["g" + t] = jnp.ones(
+                    (mid if t != "3" else ch,), jnp.bfloat16)
+                p["b" + t] = jnp.zeros(
+                    (mid if t != "3" else ch,), jnp.bfloat16)
+            if bi == 0:
+                p["ws"] = W(1, 1, cin, ch)
+                p["gs"] = jnp.ones((ch,), jnp.bfloat16)
+                p["bs"] = jnp.zeros((ch,), jnp.bfloat16)
+            P["s%d_%d" % (si, bi)] = p
+        in_ch = ch
+    P["fc_w"] = W(2048, 1000)
+    P["fc_b"] = jnp.zeros((1000,), jnp.bfloat16)
+    return P
+
+
+def forward(P, x):
+    x = conv(x, P["stem_w"], 2)
+    x = jax.nn.relu(bn_train(x, P["stem_g"], P["stem_b"]))
+    x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1),
+                          (1, 2, 2, 1), "SAME")
+    for si, (n, ch, stride) in enumerate(LAYERS):
+        for bi in range(n):
+            x = bottleneck(x, P["s%d_%d" % (si, bi)],
+                           stride if bi == 0 else 1, bi == 0)
+    x = jnp.mean(x, axis=(1, 2))
+    return x.astype(jnp.float32) @ P["fc_w"].astype(jnp.float32) \
+        + P["fc_b"].astype(jnp.float32)
+
+
+def loss_fn(P, x, y):
+    logits = forward(P, x)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1))
+
+
+@jax.jit
+def train_n(P, M, x, y, n):
+    def step(i, carry):
+        P, M, _ = carry
+        loss, g = jax.value_and_grad(loss_fn)(P, x, y)
+        newM = jax.tree_util.tree_map(
+            lambda m, gg: 0.9 * m + gg.astype(m.dtype), M, g)
+        newP = jax.tree_util.tree_map(
+            lambda p, m: (p.astype(jnp.float32)
+                          - 0.1 * m.astype(jnp.float32)).astype(p.dtype),
+            P, newM)
+        return newP, newM, loss
+
+    return lax.fori_loop(0, n, step, (P, M, jnp.float32(0)))
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 and sys.argv[1].isdigit() \
+        else 256
+    P = init_params(0)
+    M = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.float32), P)
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.rand(batch, 224, 224, 3), jnp.bfloat16)
+    y = jnp.asarray(rs.randint(0, 1000, batch), jnp.int32)
+    n = 10
+    t0 = time.perf_counter()
+    out = train_n(P, M, x, y, n)
+    jax.block_until_ready(out)
+    print("compile+first: %.1fs loss=%.3f"
+          % (time.perf_counter() - t0, float(out[2])), file=sys.stderr)
+    t0 = time.perf_counter()
+    out = train_n(P, M, x, y, n)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    print("pure-jax rn50 b%d%s: %.3fs -> %.1f img/s"
+          % (batch, " bf16stats" if BF16_STATS else "", dt, batch * n / dt))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Print top self-time ops from a jax profiler xplane capture.
+
+Parses the XSpace proto directly (no tensorboard needed):
+aggregates XEvent durations per HLO op name on the device plane.
+
+Usage: python tools/xplane_top_ops.py /tmp/rn50_trace [N]
+"""
+import glob
+import sys
+from collections import defaultdict
+
+
+def main():
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    logdir = sys.argv[1]
+    topn = int(sys.argv[2]) if len(sys.argv) > 2 else 40
+    paths = glob.glob(logdir + "/**/*.xplane.pb", recursive=True)
+    assert paths, "no xplane under %s" % logdir
+    space = xplane_pb2.XSpace()
+    with open(sorted(paths)[-1], "rb") as f:
+        space.ParseFromString(f.read())
+
+    for plane in space.planes:
+        if "TPU" not in plane.name and "device" not in plane.name.lower():
+            continue
+        ev_meta = {m.id: m.name for m in plane.event_metadata.values()}
+        totals = defaultdict(float)
+        counts = defaultdict(int)
+        grand = 0.0
+        for line in plane.lines:
+            # XLA op lines carry per-op events; step lines we skip
+            for ev in line.events:
+                name = ev_meta.get(ev.metadata_id, "?")
+                dur = ev.duration_ps / 1e12
+                totals[(line.name, name)] += dur
+                counts[(line.name, name)] += 1
+        by_line = defaultdict(float)
+        for (ln, name), d in totals.items():
+            by_line[ln] += d
+        print("== plane:", plane.name)
+        for ln, d in sorted(by_line.items(), key=lambda kv: -kv[1]):
+            print("  line %-28s total %.4fs" % (ln, d))
+        # pick the busiest line (usually XLA Ops) and print top ops
+        if not by_line:
+            continue
+        busiest = max(by_line, key=by_line.get)
+        print("-- top ops on line %r --" % busiest)
+        items = [(n, d, counts[(busiest, n)])
+                 for (ln, n), d in totals.items() if ln == busiest]
+        tot = sum(d for _, d, _ in items)
+        for n, d, c in sorted(items, key=lambda kv: -kv[1])[:topn]:
+            print("  %6.2f%% %9.4fs x%-5d %s" % (100 * d / tot, d, c, n[:110]))
+
+
+if __name__ == "__main__":
+    main()
