@@ -1,0 +1,106 @@
+"""Megatron-style tensor-parallel sharding rules for JitTrainStep.
+
+The reference's model parallelism was manual per-layer device placement
+(``ctx_group``; docs/static_site …/model_parallel_lstm.md).  TPU-native
+replacement: declarative PartitionSpec rules consumed by
+``JitTrainStep(param_rule=...)`` — GSPMD inserts the Megatron
+communication pattern (all-gather after column layers, reduce-scatter /
+all-reduce after row layers) automatically from the weight shardings
+alone (Shoeybi et al. 2019's column/row pairing, expressed as shardings).
+
+Two layers of API:
+
+- :func:`pattern_rule` — generic glob-pattern → PartitionSpec mapping.
+- :func:`megatron_rule` — the canonical transformer pairing: QKV and MLP
+  up/gate projections column-parallel (output dim sharded), attention
+  output and MLP down projections row-parallel (input dim sharded),
+  embeddings vocab-sharded, everything else replicated.  Works out of the
+  box for the model-zoo ``llama``/``bert`` naming; pass extra patterns
+  for custom nets.
+
+Every rule degrades safely: a dim that does not divide the mesh axis is
+replicated instead (GSPMD requires divisibility for even sharding).
+"""
+from __future__ import annotations
+
+import fnmatch
+
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["pattern_rule", "megatron_rule",
+           "COLUMN_PATTERNS", "ROW_PATTERNS", "EMBED_PATTERNS"]
+
+# Dense weights are stored (out_features, in_features) — reference layout
+# (src/operator/nn/fully_connected.cc) — so "column parallel" = shard dim
+# 0 and "row parallel" = shard dim 1.
+COLUMN_PATTERNS = (
+    "*attn_q_weight", "*attn_k_weight", "*attn_v_weight",
+    "*query_weight", "*key_weight", "*value_weight",
+    "*ffn_gate_weight", "*ffn_up_weight", "*fc1_weight",
+    "*inter_weight", "*head_weight",
+)
+ROW_PATTERNS = (
+    "*attn_o_weight", "*out_proj_weight", "*proj_weight",
+    "*ffn_down_weight", "*fc2_weight", "*outmap_weight",
+)
+EMBED_PATTERNS = ("*embed_weight", "*embedding0_weight", "*word_embed*")
+
+
+def _axis_size(mesh, axis):
+    """Total mesh extent for a spec entry: a name or a tuple of names
+    (tuple axes multiply, e.g. fsdp+tp sharding one dim over both)."""
+    try:
+        if isinstance(axis, (tuple, list)):
+            size = 1
+            for a in axis:
+                size *= mesh.shape[a]
+            return size
+        return mesh.shape[axis]
+    except Exception:
+        return None
+
+
+def pattern_rule(patterns, mesh=None, default=None):
+    """Build a ``param_rule`` from ``[(glob, PartitionSpec), ...]``.
+
+    First matching glob wins.  When ``mesh`` is given, a spec whose named
+    axes do not evenly divide the corresponding dim is replaced by
+    ``default`` (replication) instead of failing inside GSPMD.
+    """
+    pats = list(patterns)
+
+    def rule(name, shape):
+        for pat, spec in pats:
+            if fnmatch.fnmatch(name, pat):
+                if mesh is not None and spec is not None:
+                    for d, ax in enumerate(spec):
+                        if ax is None:
+                            continue
+                        size = _axis_size(mesh, ax)
+                        if size and (d >= len(shape)
+                                     or shape[d] % size != 0):
+                            return default
+                return spec
+        return default
+
+    return rule
+
+
+def megatron_rule(axis="model", mesh=None, extra=(),
+                  shard_embeddings=True):
+    """The canonical transformer column/row pairing as a param_rule.
+
+    Parameters
+    ----------
+    axis : mesh axis name carrying tensor parallelism
+    mesh : optional Mesh for divisibility degradation (strongly
+        recommended — GQA KV heads often don't divide large tp degrees)
+    extra : additional ``(glob, PartitionSpec)`` pairs, tried first
+    shard_embeddings : vocab-shard embedding/head tables (dim 0)
+    """
+    pairs = list(extra)
+    pairs += [(p, P(axis, None)) for p in COLUMN_PATTERNS]
+    pairs += [(p, P(None, axis)) for p in ROW_PATTERNS]
+    if shard_embeddings:
+        pairs += [(p, P(axis, None)) for p in EMBED_PATTERNS]
+    return pattern_rule(pairs, mesh=mesh)

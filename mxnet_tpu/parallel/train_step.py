@@ -126,6 +126,23 @@ class JitTrainStep:
         return NamedSharding(
             self._mesh, P(self._data_axis, *([None] * (arr.ndim - 1))))
 
+    def _place_batch(self, batch_nd):
+        """device_put batch arrays: data-axis sharded on a mesh, else the
+        single training device."""
+        if self._mesh is not None:
+            return [jax.device_put(b.data(), self._batch_sharding(b.data()))
+                    for b in batch_nd]
+        return [jax.device_put(b.data(), self._device) for b in batch_nd]
+
+    def _out_shardings(self):
+        """(weights, opt_state, loss) shardings for any step executable."""
+        return (
+            self._param_shardings,
+            [None if st is None else jax.tree_util.tree_map(
+                lambda _, s=sh: s, st)
+             for st, sh in zip(self._opt_state, self._param_shardings)],
+            NamedSharding(self._mesh, P()))
+
     # -- the pure step ----------------------------------------------------
     def _build(self, batch_arrays):
         net, loss_block = self._net, self._loss
@@ -194,14 +211,7 @@ class JitTrainStep:
 
         jit_kwargs = {}
         if self._mesh is not None:
-            out_sh = (
-                self._param_shardings,
-                [None if st is None else jax.tree_util.tree_map(
-                    lambda _, s=sh: s, st)
-                 for st, sh in zip(self._opt_state,
-                                   self._param_shardings)],
-                NamedSharding(self._mesh, P()))
-            jit_kwargs['out_shardings'] = out_sh
+            jit_kwargs['out_shardings'] = self._out_shardings()
         self._raw_step = step
         return jax.jit(step,
                        donate_argnums=(2, 3),
@@ -213,12 +223,7 @@ class JitTrainStep:
         batch_nd = [b if isinstance(b, NDArray) else nd.array(b)
                     for b in batch]
         self._ensure_init(batch_nd)
-        arrays = [b.data() for b in batch_nd]
-        if self._mesh is not None:
-            arrays = [jax.device_put(a, self._batch_sharding(a))
-                      for a in arrays]
-        else:
-            arrays = [jax.device_put(a, self._device) for a in arrays]
+        arrays = self._place_batch(batch_nd)
         if self._step_fn is None:
             self._step_fn = self._build(arrays)
         self._t += 1
@@ -239,16 +244,25 @@ class JitTrainStep:
         optimizer state as the carry, so host↔device latency is paid
         once per n steps instead of per step.  Per-iteration RNG keys
         are folded from one base key.  Returns the last step's loss.
-        Single-device path only (mesh carries need explicit shardings).
+
+        Mesh mode: the loop jit pins ``out_shardings`` to the parameter/
+        state shardings (same as ``step()``), so the carried weights keep
+        their tp/dp placement across iterations and the n-step
+        single-dispatch methodology works on a pod the same as on one
+        chip.
         """
         from jax import lax
 
-        if self._mesh is not None:
-            raise MXNetError("step_n: use step() with a mesh")
         if getattr(self._opt, "lr_scheduler", None) is not None:
             # the scheduler is arbitrary Python of the update count and
             # cannot be traced per loop iteration; fall back to per-step
             # dispatch so every update sees its scheduled lr
+            import warnings
+
+            warnings.warn(
+                "step_n: lr_scheduler set -> falling back to per-step "
+                "dispatch (device-side loop cannot trace the scheduler); "
+                "expect per-step host latency", stacklevel=2)
             loss = None
             for _ in range(int(n)):
                 loss = self.step(*batch)
@@ -256,8 +270,7 @@ class JitTrainStep:
         batch_nd = [b if isinstance(b, NDArray) else nd.array(b)
                     for b in batch]
         self._ensure_init(batch_nd)
-        arrays = [jax.device_put(b.data(), self._device)
-                  for b in batch_nd]
+        arrays = self._place_batch(batch_nd)
         if self._step_fn is None:
             self._step_fn = self._build(arrays)
         if not hasattr(self, "_step_n_cache"):
@@ -281,7 +294,10 @@ class JitTrainStep:
                     0, n, body,
                     (weights, state, jnp.float32(0.0)))
 
-            fn = jax.jit(loop, donate_argnums=(2, 3))
+            jit_kwargs = {}
+            if self._mesh is not None:
+                jit_kwargs["out_shardings"] = self._out_shardings()
+            fn = jax.jit(loop, donate_argnums=(2, 3), **jit_kwargs)
             self._step_n_cache[n] = fn
         self._opt.num_update = self._t + n
         self._weights, self._opt_state, loss = fn(

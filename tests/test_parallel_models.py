@@ -1,0 +1,171 @@
+"""Multi-chip correctness at MODEL scale (VERDICT r2 item 2).
+
+Runs on the 8-virtual-device CPU mesh (conftest sets
+xla_force_host_platform_device_count=8).  Each test trains a real
+model-zoo network through JitTrainStep on a dp×tp mesh and asserts loss
+parity with the single-device run — the GSPMD equivalent of the
+reference's nightly dist-sync tests (tests/nightly/multi_lenet.py,
+dist_sync_kvstore.py:16-60).
+"""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, parallel
+from mxnet_tpu.gluon.model_zoo import vision, llama
+
+
+def _mesh(shape, names):
+    devs = np.asarray(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, names)
+
+
+def _train(net_fn, data, labels, loss_fn, mesh=None, param_rule=None,
+           steps=3, opt="sgd", opt_args=None, use_step_n=False):
+    mx.random.seed(7)
+    net = net_fn()
+    net.initialize(mx.init.Xavier())
+    step = parallel.JitTrainStep(
+        net, loss_fn, opt, opt_args or {"learning_rate": 0.05},
+        mesh=mesh, param_rule=param_rule)
+    losses = []
+    if use_step_n:
+        # one device-side loop dispatch covering all steps
+        losses.append(float(step.step_n(steps, data, labels)))
+    else:
+        for _ in range(steps):
+            losses.append(float(step.step(data, labels)))
+    return losses
+
+
+@pytest.fixture(scope="module")
+def eight_devices():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+
+
+def test_resnet_dp_tp_loss_parity(eight_devices):
+    """CIFAR-scale ResNet-18 (4 stages) on a 4x2 dp×tp mesh matches the
+    single-device run step for step."""
+    rs = np.random.RandomState(0)
+    x = rs.rand(16, 3, 32, 32).astype(np.float32)
+    y = rs.randint(0, 10, 16).astype(np.float32)
+    net_fn = lambda: vision.get_resnet(1, 18, thumbnail=True, classes=10)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    ref = _train(net_fn, x, y, loss_fn, mesh=None)
+    mesh = _mesh((4, 2), ("data", "model"))
+
+    def rule(name, shape):
+        # shard dim 0 across 'model' when divisible (Dense + conv weights)
+        if len(shape) >= 2 and shape[0] % 2 == 0:
+            return P("model", *([None] * (len(shape) - 1)))
+        return None
+
+    got = _train(net_fn, x, y, loss_fn, mesh=mesh, param_rule=rule)
+    # step 1 is a pure forward/backward comparison — tight; later steps
+    # compound f32 reduction-order differences through BN + sgd, so the
+    # bound widens with depth (a sharding bug shows up as >10% or NaN)
+    np.testing.assert_allclose(got[0], ref[0], rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(got, ref, rtol=5e-2, atol=5e-3)
+
+
+def test_llama_block_tp_parity_megatron(eight_devices):
+    """llama_small under the shipped Megatron column/row rules on tp=2
+    matches the replicated run (same global batch)."""
+    vocab = 512
+    rs = np.random.RandomState(1)
+    toks = rs.randint(0, vocab, (8, 16)).astype(np.int32)
+    labels = rs.randint(0, vocab, 8 * 16).astype(np.float32)
+
+    class LM(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            mx.random.seed(3)
+            self.inner = llama.llama_small()
+
+        def hybrid_forward(self, F, t):
+            return F.reshape(self.inner(t), shape=(-1, vocab))
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = _mesh((4, 2), ("data", "model"))
+    ref = _train(LM, toks, labels, loss_fn, mesh=mesh, param_rule=None,
+                 opt="adam", opt_args={"learning_rate": 1e-3})
+    rule = parallel.megatron_rule(axis="model", mesh=mesh)
+    got = _train(LM, toks, labels, loss_fn, mesh=mesh, param_rule=rule,
+                 opt="adam", opt_args={"learning_rate": 1e-3})
+    np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_pattern_rule_tuple_axes_degrade():
+    mesh = _mesh((4, 2), ("data", "model"))
+    rule = parallel.pattern_rule(
+        [("*weight", P(("data", "model"), None))], mesh=mesh)
+    # 16 % (4*2) == 0 -> sharded over both axes
+    assert rule("x_weight", (16, 10)) == P(("data", "model"), None)
+    # 6 % 8 != 0 -> replicated, not a GSPMD placement error
+    assert rule("x_weight", (6, 10)) is None
+
+
+def test_megatron_rule_degrades_indivisible():
+    mesh = _mesh((1, 8), ("data", "model"))
+    rule = parallel.megatron_rule(axis="model", mesh=mesh)
+    # kv proj with 4 heads * 8 dim = 32 rows: 32 % 8 == 0 -> sharded
+    assert rule("blk_attn_k_weight", (32, 64)) == P("model", None)
+    # 36 rows don't divide 8 -> replicated, not an error
+    assert rule("blk_attn_k_weight", (36, 64)) is None
+    assert rule("blk_ffn_down_weight", (64, 128)) == P(None, "model")
+    assert rule("blk_attnorm_weight", (64,)) is None
+
+
+def test_step_n_on_mesh(eight_devices):
+    """VERDICT r2 item 3: the n-step device-side loop runs on a mesh and
+    matches per-step dispatch."""
+    rs = np.random.RandomState(2)
+    x = rs.rand(16, 8).astype(np.float32)
+    y = rs.randint(0, 4, 16).astype(np.float32)
+
+    def net_fn():
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(32, activation="relu"))
+        net.add(gluon.nn.Dense(4))
+        return net
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = _mesh((4, 2), ("data", "model"))
+
+    def rule(name, shape):
+        if len(shape) == 2 and shape[0] % 2 == 0:
+            return P("model", None)
+        return None
+
+    ref = _train(net_fn, x, y, loss_fn, mesh=mesh, param_rule=rule,
+                 steps=4)
+    got = _train(net_fn, x, y, loss_fn, mesh=mesh, param_rule=rule,
+                 steps=4, use_step_n=True)
+    # step_n returns only the LAST loss; compare against ref's last
+    np.testing.assert_allclose(got[-1], ref[-1], rtol=2e-5, atol=2e-5)
+
+
+def test_step_n_single_device_matches_mesh(eight_devices):
+    """Same model, same data: the mesh run equals the single-device run
+    through the device-side loop too."""
+    rs = np.random.RandomState(4)
+    x = rs.rand(16, 8).astype(np.float32)
+    y = rs.randint(0, 4, 16).astype(np.float32)
+
+    def net_fn():
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(16, activation="tanh"))
+        net.add(gluon.nn.Dense(4))
+        return net
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    single = _train(net_fn, x, y, loss_fn, steps=4, use_step_n=True)
+    mesh = _mesh((8,), ("data",))
+    dp = _train(net_fn, x, y, loss_fn, mesh=mesh, steps=4,
+                use_step_n=True)
+    np.testing.assert_allclose(dp[-1], single[-1], rtol=2e-5, atol=2e-5)
