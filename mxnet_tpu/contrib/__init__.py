@@ -1,3 +1,4 @@
 """``mx.contrib`` — contrib namespaces (parity: python/mxnet/contrib/)."""
 from .. import amp  # noqa: F401
 from . import quantization  # noqa: F401
+from . import onnx  # noqa: F401

@@ -1,0 +1,10 @@
+"""ONNX interchange (reference parity: ``python/mxnet/contrib/onnx/``).
+
+``mx.contrib.onnx.export_model`` / ``import_model`` /
+``get_model_metadata`` — self-contained (in-repo protobuf codec, no
+``onnx`` package dependency).
+"""
+from . import mx2onnx  # noqa: F401
+from . import onnx2mx  # noqa: F401
+from .mx2onnx import export_model  # noqa: F401
+from .onnx2mx import import_model, get_model_metadata  # noqa: F401

@@ -1,0 +1,234 @@
+"""Minimal self-contained ONNX protobuf codec.
+
+The image ships no ``onnx`` package, so this module implements just
+enough of the protobuf wire format (varint / 64-bit / length-delimited /
+32-bit fields) plus the ONNX message schemas the converter needs:
+ModelProto, GraphProto, NodeProto, AttributeProto, TensorProto,
+ValueInfoProto / TypeProto.  Field numbers follow the public
+``onnx/onnx.proto`` spec (IR version 8 era); files produced here load in
+onnxruntime/netron, and models exported by standard tools decode here.
+
+Messages are plain dicts: ``{"name": ..., "graph": {...}}`` with repeated
+fields as lists.  Unknown fields are skipped on decode (forward compat).
+"""
+from __future__ import annotations
+
+import struct
+
+# ---------------------------------------------------------------------------
+# wire primitives
+# ---------------------------------------------------------------------------
+
+
+def _enc_varint(v):
+    out = bytearray()
+    if v < 0:
+        v += 1 << 64
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _dec_varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _tag(field, wire):
+    return _enc_varint((field << 3) | wire)
+
+
+def _enc_field(field, wire, payload):
+    if wire == 0:
+        return _tag(field, 0) + _enc_varint(payload)
+    if wire == 1:
+        return _tag(field, 1) + struct.pack("<d", payload)
+    if wire == 2:
+        if isinstance(payload, str):
+            payload = payload.encode()
+        return _tag(field, 2) + _enc_varint(len(payload)) + payload
+    if wire == 5:
+        return _tag(field, 5) + struct.pack("<f", payload)
+    raise ValueError(wire)
+
+
+# ---------------------------------------------------------------------------
+# schemas: field number -> (name, kind, [submessage schema])
+# kind: int / sint / float32 / double / string / bytes / msg
+# repeated fields are marked with a trailing '*'
+# ---------------------------------------------------------------------------
+
+DIM = {
+    1: ("dim_value", "int"),
+    3: ("dim_param", "string"),
+}
+TENSOR_SHAPE = {1: ("dim*", "msg", DIM)}
+TENSOR_TYPE = {
+    1: ("elem_type", "int"),
+    2: ("shape", "msg", TENSOR_SHAPE),
+}
+TYPE = {1: ("tensor_type", "msg", TENSOR_TYPE)}
+VALUE_INFO = {
+    1: ("name", "string"),
+    2: ("type", "msg", TYPE),
+    3: ("doc_string", "string"),
+}
+TENSOR = {
+    1: ("dims*", "int"),
+    2: ("data_type", "int"),
+    4: ("float_data*", "float32"),
+    5: ("int32_data*", "int"),
+    6: ("string_data*", "bytes"),
+    7: ("int64_data*", "int"),
+    8: ("name", "string"),
+    9: ("raw_data", "bytes"),
+    10: ("double_data*", "double"),
+    11: ("uint64_data*", "int"),
+}
+ATTRIBUTE = {
+    1: ("name", "string"),
+    2: ("f", "float32"),
+    3: ("i", "int"),
+    4: ("s", "bytes"),
+    5: ("t", "msg", TENSOR),
+    7: ("floats*", "float32"),
+    8: ("ints*", "int"),
+    9: ("strings*", "bytes"),
+    20: ("type", "int"),
+}
+NODE = {
+    1: ("input*", "string"),
+    2: ("output*", "string"),
+    3: ("name", "string"),
+    4: ("op_type", "string"),
+    5: ("attribute*", "msg", ATTRIBUTE),
+    6: ("doc_string", "string"),
+    7: ("domain", "string"),
+}
+GRAPH = {
+    1: ("node*", "msg", NODE),
+    2: ("name", "string"),
+    5: ("initializer*", "msg", TENSOR),
+    10: ("doc_string", "string"),
+    11: ("input*", "msg", VALUE_INFO),
+    12: ("output*", "msg", VALUE_INFO),
+    13: ("value_info*", "msg", VALUE_INFO),
+}
+OPSET = {
+    1: ("domain", "string"),
+    2: ("version", "int"),
+}
+MODEL = {
+    1: ("ir_version", "int"),
+    2: ("producer_name", "string"),
+    3: ("producer_version", "string"),
+    4: ("domain", "string"),
+    5: ("model_version", "int"),
+    6: ("doc_string", "string"),
+    7: ("graph", "msg", GRAPH),
+    8: ("opset_import*", "msg", OPSET),
+}
+
+# attribute type enum (AttributeProto.AttributeType)
+ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_TENSOR = 1, 2, 3, 4
+ATTR_FLOATS, ATTR_INTS, ATTR_STRINGS = 6, 7, 8
+
+# TensorProto.DataType
+DT_FLOAT, DT_UINT8, DT_INT8, DT_INT32, DT_INT64 = 1, 2, 3, 6, 7
+DT_STRING, DT_BOOL, DT_FLOAT16, DT_DOUBLE = 8, 9, 10, 11
+DT_BFLOAT16 = 16
+
+_WIRE_OF = {"int": 0, "sint": 0, "float32": 5, "double": 1,
+            "string": 2, "bytes": 2, "msg": 2}
+
+
+def encode(msg, schema):
+    """Encode dict ``msg`` with ``schema`` into protobuf bytes."""
+    out = bytearray()
+    for field, spec in schema.items():
+        name, kind = spec[0], spec[1]
+        repeated = name.endswith("*")
+        key = name.rstrip("*")
+        if key not in msg or msg[key] is None:
+            continue
+        vals = msg[key] if repeated else [msg[key]]
+        wire = _WIRE_OF[kind]
+        for v in vals:
+            if kind == "msg":
+                v = encode(v, spec[2])
+            out += _enc_field(field, wire, v)
+    return bytes(out)
+
+
+def decode(buf, schema, pos=0, end=None):
+    """Decode protobuf bytes into a dict per ``schema``."""
+    if end is None:
+        end = len(buf)
+    msg = {}
+    while pos < end:
+        tag, pos = _dec_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        spec = schema.get(field)
+        if wire == 0:
+            v, pos = _dec_varint(buf, pos)
+            if v >= 1 << 63:
+                v -= 1 << 64
+        elif wire == 1:
+            v = struct.unpack_from("<d", buf, pos)[0]
+            pos += 8
+        elif wire == 5:
+            v = struct.unpack_from("<f", buf, pos)[0]
+            pos += 4
+        elif wire == 2:
+            ln, pos = _dec_varint(buf, pos)
+            v = bytes(buf[pos:pos + ln])
+            pos += ln
+        else:
+            raise ValueError("unsupported wire type %d" % wire)
+        if spec is None:
+            continue  # unknown field: skip
+        name, kind = spec[0], spec[1]
+        repeated = name.endswith("*")
+        key = name.rstrip("*")
+        if kind == "msg":
+            v = decode(v, spec[2])
+        elif kind == "string" and isinstance(v, bytes):
+            v = v.decode("utf-8", "replace")
+        elif kind in ("float32", "double") and wire == 2:
+            # packed repeated floats/doubles
+            fmt, size = ("<f", 4) if kind == "float32" else ("<d", 8)
+            vals = [struct.unpack_from(fmt, v, i)[0]
+                    for i in range(0, len(v), size)]
+            if repeated:
+                msg.setdefault(key, []).extend(vals)
+                continue
+            v = vals[0]
+        elif kind in ("int", "sint") and wire == 2:
+            # packed repeated varints
+            vals, p2 = [], 0
+            while p2 < len(v):
+                x, p2 = _dec_varint(v, p2)
+                if x >= 1 << 63:
+                    x -= 1 << 64
+                vals.append(x)
+            if repeated:
+                msg.setdefault(key, []).extend(vals)
+                continue
+            v = vals[0] if vals else 0
+        if repeated:
+            msg.setdefault(key, []).append(v)
+        else:
+            msg[key] = v
+    return msg

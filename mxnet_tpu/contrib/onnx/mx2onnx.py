@@ -1,0 +1,638 @@
+"""MXNet Symbol → ONNX export.
+
+Reference parity: ``python/mxnet/contrib/onnx/mx2onnx/export_model.py`` +
+``_op_translations.py`` (4.2k LoC of per-op converters).  Same public
+API — ``export_model(sym, params, input_shape, ...)`` — rebuilt on the
+in-repo protobuf codec (``_proto.py``; the image ships no onnx package).
+
+Graphs export in inference form (Dropout → Identity, BatchNorm uses
+moving stats downstream).  Channel-first (NCHW) graphs only — export a
+model-zoo net built with ``layout='NCHW'`` (the checkpoint layout); the
+NHWC TPU layout is a compile-time optimization, not an interchange
+format.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from . import _proto as P
+
+OPSET = 17  # LayerNormalization needs 17
+
+_MX2ONNX = {}
+
+
+def mx_op(*names):
+    def deco(fn):
+        for n in names:
+            _MX2ONNX[n] = fn
+        return fn
+    return deco
+
+
+class _Ctx:
+    """Per-export state handed to op translators."""
+
+    def __init__(self, params, shapes):
+        self.params = params          # var name -> np.ndarray
+        self.shapes = shapes          # value name -> tuple shape
+        self.nodes = []               # onnx NodeProto dicts
+        self.initializers = {}        # name -> np.ndarray
+        self._uid = 0
+
+    def name(self, hint):
+        self._uid += 1
+        return "%s__%d" % (hint, self._uid)
+
+    def add(self, op_type, inputs, outputs, **attrs):
+        node = {"op_type": op_type, "input": list(inputs),
+                "output": list(outputs),
+                "name": self.name(op_type.lower())}
+        if attrs:
+            node["attribute"] = [_attr(k, v) for k, v in attrs.items()]
+        self.nodes.append(node)
+        return outputs[0]
+
+    def tensor(self, hint, arr):
+        """Register a constant initializer; returns its value name."""
+        name = self.name(hint)
+        self.initializers[name] = np.asarray(arr)
+        return name
+
+
+def _attr(name, v):
+    if isinstance(v, bool):
+        return {"name": name, "i": int(v), "type": P.ATTR_INT}
+    if isinstance(v, int):
+        return {"name": name, "i": v, "type": P.ATTR_INT}
+    if isinstance(v, float):
+        return {"name": name, "f": v, "type": P.ATTR_FLOAT}
+    if isinstance(v, str):
+        return {"name": name, "s": v.encode(), "type": P.ATTR_STRING}
+    if isinstance(v, (list, tuple)):
+        if v and isinstance(v[0], float):
+            return {"name": name, "floats": [float(x) for x in v],
+                    "type": P.ATTR_FLOATS}
+        return {"name": name, "ints": [int(x) for x in v],
+                "type": P.ATTR_INTS}
+    raise MXNetError("unsupported attribute %s=%r" % (name, v))
+
+
+_NP2DT = {"float32": P.DT_FLOAT, "float64": P.DT_DOUBLE,
+          "float16": P.DT_FLOAT16, "int32": P.DT_INT32,
+          "int64": P.DT_INT64, "int8": P.DT_INT8, "uint8": P.DT_UINT8,
+          "bool": P.DT_BOOL, "bfloat16": P.DT_BFLOAT16}
+
+
+def _tensor_proto(name, arr):
+    arr = np.asarray(arr)
+    dt = _NP2DT.get(str(arr.dtype))
+    if dt is None:
+        raise MXNetError("cannot export dtype %s" % arr.dtype)
+    if str(arr.dtype) == "bfloat16":
+        raw = arr.view(np.uint16).tobytes()
+    else:
+        raw = arr.tobytes()
+    return {"name": name, "dims": list(arr.shape), "data_type": dt,
+            "raw_data": raw}
+
+
+def _value_info(name, shape, elem_type=P.DT_FLOAT):
+    return {"name": name,
+            "type": {"tensor_type": {
+                "elem_type": elem_type,
+                "shape": {"dim": [{"dim_value": int(d)} for d in shape]}}}}
+
+
+# ---------------------------------------------------------------------------
+# helpers shared by translators
+# ---------------------------------------------------------------------------
+
+
+def _pads2(pad):
+    pad = tuple(int(p) for p in (pad or ()))
+    if not pad:
+        pad = (0, 0)
+    return list(pad) + list(pad)  # [x1_begin, x2_begin, x1_end, x2_end]
+
+
+def _get_weightT(ctx, wname, out):
+    """Return name of W^T: pre-transposed initializer when W is constant,
+    else a Transpose node."""
+    if wname in ctx.initializers:
+        arr = ctx.initializers[wname]
+        return ctx.tensor(wname + "_T", np.ascontiguousarray(arr.T))
+    return ctx.add("Transpose", [wname], [ctx.name(wname + "_T")],
+                   perm=[1, 0])
+
+
+# ---------------------------------------------------------------------------
+# translators
+# ---------------------------------------------------------------------------
+
+
+@mx_op("Convolution")
+def _conv(ctx, ins, outs, a):
+    layout = a.get("layout", "NCHW")
+    if not str(layout).startswith("NC"):
+        raise MXNetError(
+            "ONNX export supports channel-first graphs only; rebuild the "
+            "net with layout='NCHW' (got %s)" % layout)
+    kernel = [int(k) for k in a["kernel"]]
+    attrs = dict(kernel_shape=kernel,
+                 strides=[int(s) for s in a.get("stride") or [1] * len(kernel)],
+                 dilations=[int(d) for d in a.get("dilate") or [1] * len(kernel)],
+                 group=int(a.get("num_group", 1)))
+    pad = [int(p) for p in a.get("pad") or [0] * len(kernel)]
+    attrs["pads"] = pad + pad
+    inputs = ins[:2] if _true(a.get("no_bias")) else ins[:3]
+    ctx.add("Conv", inputs, outs, **attrs)
+
+
+def _true(v):
+    return v in (True, 1, "True", "true", "1")
+
+
+@mx_op("BatchNorm")
+def _bn(ctx, ins, outs, a):
+    # ins: data gamma beta moving_mean moving_var; out 0 only (inference)
+    gamma = ins[1]
+    if _true(a.get("fix_gamma", True)) and gamma in ctx.initializers:
+        ctx.initializers[gamma] = np.ones_like(ctx.initializers[gamma])
+    ctx.add("BatchNormalization", ins[:5], [outs[0]],
+            epsilon=float(a.get("eps", 1e-3)),
+            momentum=float(a.get("momentum", 0.9)))
+
+
+_ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+        "softrelu": "Softplus", "softsign": "Softsign"}
+
+
+@mx_op("Activation")
+def _act(ctx, ins, outs, a):
+    ctx.add(_ACT[a.get("act_type", "relu")], ins[:1], outs)
+
+
+@mx_op("relu")
+def _relu(ctx, ins, outs, a):
+    ctx.add("Relu", ins[:1], outs)
+
+
+@mx_op("sigmoid")
+def _sigmoid(ctx, ins, outs, a):
+    ctx.add("Sigmoid", ins[:1], outs)
+
+
+@mx_op("tanh")
+def _tanh(ctx, ins, outs, a):
+    ctx.add("Tanh", ins[:1], outs)
+
+
+for _mxn, _onn in [("erf", "Erf"), ("sqrt", "Sqrt"), ("exp", "Exp"),
+                   ("log", "Log"), ("negative", "Neg"), ("abs", "Abs"),
+                   ("floor", "Floor"), ("ceil", "Ceil"),
+                   ("sin", "Sin"), ("cos", "Cos")]:
+    def _mk(onn):
+        def f(ctx, ins, outs, a):
+            ctx.add(onn, ins[:1], outs)
+        return f
+    mx_op(_mxn)(_mk(_onn))
+
+
+@mx_op("Pooling")
+def _pool(ctx, ins, outs, a):
+    ptype = a.get("pool_type", "max")
+    if _true(a.get("global_pool")):
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}[ptype]
+        ctx.add(op, ins[:1], outs)
+        return
+    kernel = [int(k) for k in a["kernel"]]
+    attrs = dict(
+        kernel_shape=kernel,
+        strides=[int(s) for s in a.get("stride") or [1] * len(kernel)])
+    pad = [int(p) for p in a.get("pad") or [0] * len(kernel)]
+    attrs["pads"] = pad + pad
+    if a.get("pooling_convention") == "full":
+        attrs["ceil_mode"] = 1
+    if ptype == "avg":
+        attrs["count_include_pad"] = int(
+            _true(a.get("count_include_pad", True)))
+        ctx.add("AveragePool", ins[:1], outs, **attrs)
+    elif ptype == "max":
+        ctx.add("MaxPool", ins[:1], outs, **attrs)
+    else:
+        raise MXNetError("Pooling %s not exportable" % ptype)
+
+
+@mx_op("FullyConnected")
+def _fc(ctx, ins, outs, a):
+    no_bias = _true(a.get("no_bias"))
+    data, w = ins[0], ins[1]
+    bias = None if no_bias else ins[2]
+    flatten = _true(a.get("flatten", True))
+    dshape = ctx.shapes.get(data)
+    if flatten and dshape is not None and len(dshape) != 2:
+        data = ctx.add("Flatten", [data], [ctx.name("flatten")], axis=1)
+        dshape = (dshape[0], int(np.prod(dshape[1:])))
+    if dshape is not None and len(dshape) == 2:
+        inputs = [data, w] + ([bias] if bias else [])
+        ctx.add("Gemm", inputs, outs, alpha=1.0, beta=1.0,
+                transA=0, transB=1)
+        return
+    # N-D, flatten=False: MatMul with W^T (+ Add bias)
+    wT = _get_weightT(ctx, w, outs[0])
+    mm = ctx.add("MatMul", [data, wT],
+                 [outs[0] if bias is None else ctx.name("matmul")])
+    if bias is not None:
+        ctx.add("Add", [mm, bias], outs)
+
+
+@mx_op("elemwise_add", "broadcast_add", "_plus", "_add")
+def _add(ctx, ins, outs, a):
+    ctx.add("Add", ins[:2], outs)
+
+
+@mx_op("elemwise_sub", "broadcast_sub", "_sub", "_minus")
+def _sub(ctx, ins, outs, a):
+    ctx.add("Sub", ins[:2], outs)
+
+
+@mx_op("elemwise_mul", "broadcast_mul", "_mul")
+def _mul(ctx, ins, outs, a):
+    ctx.add("Mul", ins[:2], outs)
+
+
+@mx_op("elemwise_div", "broadcast_div", "_div")
+def _div(ctx, ins, outs, a):
+    ctx.add("Div", ins[:2], outs)
+
+
+@mx_op("broadcast_maximum", "maximum")
+def _max2(ctx, ins, outs, a):
+    ctx.add("Max", ins[:2], outs)
+
+
+@mx_op("broadcast_minimum", "minimum")
+def _min2(ctx, ins, outs, a):
+    ctx.add("Min", ins[:2], outs)
+
+
+def _scalar_of(ctx, ins, a):
+    dt = np.float32
+    return ctx.tensor("scalar", np.array(float(a.get("scalar", 0.0)), dt))
+
+
+@mx_op("_plus_scalar")
+def _plus_scalar(ctx, ins, outs, a):
+    ctx.add("Add", [ins[0], _scalar_of(ctx, ins, a)], outs)
+
+
+@mx_op("_minus_scalar")
+def _minus_scalar(ctx, ins, outs, a):
+    ctx.add("Sub", [ins[0], _scalar_of(ctx, ins, a)], outs)
+
+
+@mx_op("_rminus_scalar")
+def _rminus_scalar(ctx, ins, outs, a):
+    ctx.add("Sub", [_scalar_of(ctx, ins, a), ins[0]], outs)
+
+
+@mx_op("_mul_scalar")
+def _mul_scalar(ctx, ins, outs, a):
+    ctx.add("Mul", [ins[0], _scalar_of(ctx, ins, a)], outs)
+
+
+@mx_op("_div_scalar")
+def _div_scalar(ctx, ins, outs, a):
+    ctx.add("Div", [ins[0], _scalar_of(ctx, ins, a)], outs)
+
+
+@mx_op("_rdiv_scalar")
+def _rdiv_scalar(ctx, ins, outs, a):
+    ctx.add("Div", [_scalar_of(ctx, ins, a), ins[0]], outs)
+
+
+@mx_op("_power_scalar")
+def _power_scalar(ctx, ins, outs, a):
+    ctx.add("Pow", [ins[0], _scalar_of(ctx, ins, a)], outs)
+
+
+@mx_op("square")
+def _square(ctx, ins, outs, a):
+    ctx.add("Mul", [ins[0], ins[0]], outs)
+
+
+@mx_op("reshape", "Reshape")
+def _reshape(ctx, ins, outs, a):
+    shape = [int(s) for s in a.get("shape", ())]
+    if any(d < -1 for d in shape):
+        # MXNet's -2/-3/-4 split/merge codes have no ONNX equivalent
+        raise MXNetError(
+            "ONNX export: reshape special codes %s unsupported "
+            "(only 0 and -1 translate)" % (shape,))
+    sname = ctx.tensor("shape", np.asarray(shape, np.int64))
+    ctx.add("Reshape", [ins[0], sname], outs)
+
+
+@mx_op("Flatten")
+def _flatten(ctx, ins, outs, a):
+    ctx.add("Flatten", ins[:1], outs, axis=1)
+
+
+@mx_op("transpose")
+def _transpose(ctx, ins, outs, a):
+    axes = a.get("axes")
+    if axes:
+        ctx.add("Transpose", ins[:1], outs, perm=[int(x) for x in axes])
+    else:
+        ctx.add("Transpose", ins[:1], outs)
+
+
+@mx_op("concat", "Concat")
+def _concat(ctx, ins, outs, a):
+    ctx.add("Concat", ins, outs, axis=int(a.get("dim", 1)))
+
+
+@mx_op("softmax")
+def _softmax(ctx, ins, outs, a):
+    ctx.add("Softmax", ins[:1], outs, axis=int(a.get("axis", -1)))
+
+
+@mx_op("log_softmax")
+def _log_softmax(ctx, ins, outs, a):
+    sm = ctx.add("Softmax", ins[:1], [ctx.name("softmax")],
+                 axis=int(a.get("axis", -1)))
+    ctx.add("Log", [sm], outs)
+
+
+@mx_op("Dropout")
+def _dropout(ctx, ins, outs, a):
+    ctx.add("Identity", ins[:1], [outs[0]])
+
+
+@mx_op("_copy", "identity", "BlockGrad", "stop_gradient")
+def _identity(ctx, ins, outs, a):
+    ctx.add("Identity", ins[:1], [outs[0]])
+
+
+@mx_op("LayerNorm")
+def _layernorm(ctx, ins, outs, a):
+    ctx.add("LayerNormalization", ins[:3], [outs[0]],
+            axis=int(a.get("axis", -1)),
+            epsilon=float(a.get("eps", 1e-5)))
+
+
+@mx_op("Embedding")
+def _embedding(ctx, ins, outs, a):
+    idx = ctx.add("Cast", [ins[0]], [ctx.name("cast")], to=P.DT_INT64)
+    ctx.add("Gather", [ins[1], idx], outs, axis=0)
+
+
+@mx_op("dot")
+def _dot(ctx, ins, outs, a):
+    x, y = ins[0], ins[1]
+    if _true(a.get("transpose_a")):
+        x = ctx.add("Transpose", [x], [ctx.name("ta")], perm=[1, 0])
+    if _true(a.get("transpose_b")):
+        y = ctx.add("Transpose", [y], [ctx.name("tb")], perm=[1, 0])
+    ctx.add("MatMul", [x, y], outs)
+
+
+@mx_op("batch_dot")
+def _batch_dot(ctx, ins, outs, a):
+    x, y = ins[0], ins[1]
+    if _true(a.get("transpose_a")):
+        x = ctx.add("Transpose", [x], [ctx.name("ta")], perm=[0, 2, 1])
+    if _true(a.get("transpose_b")):
+        y = ctx.add("Transpose", [y], [ctx.name("tb")], perm=[0, 2, 1])
+    ctx.add("MatMul", [x, y], outs)
+
+
+@mx_op("mean")
+def _mean(ctx, ins, outs, a):
+    axis = a.get("axis")
+    attrs = {"keepdims": int(_true(a.get("keepdims")))}
+    if axis is not None and axis != ():
+        axes = [int(axis)] if isinstance(axis, int) else \
+            [int(x) for x in axis]
+        attrs["axes"] = axes
+    ctx.add("ReduceMean", ins[:1], outs, **attrs)
+
+
+@mx_op("slice_axis")
+def _slice_axis(ctx, ins, outs, a):
+    axis = int(a.get("axis", 0))
+    begin = int(a.get("begin", 0))
+    end = a.get("end")
+    end = int(end) if end is not None else (1 << 62)
+    ctx.add("Slice", [
+        ins[0],
+        ctx.tensor("starts", np.asarray([begin], np.int64)),
+        ctx.tensor("ends", np.asarray([end], np.int64)),
+        ctx.tensor("axes", np.asarray([axis], np.int64)),
+    ], outs)
+
+
+@mx_op("squeeze")
+def _squeeze(ctx, ins, outs, a):
+    axis = a.get("axis")
+    if axis is None:
+        ctx.add("Squeeze", ins[:1], outs)
+        return
+    axes = [int(axis)] if isinstance(axis, int) else [int(x) for x in axis]
+    ctx.add("Squeeze",
+            [ins[0], ctx.tensor("axes", np.asarray(axes, np.int64))], outs)
+
+
+@mx_op("expand_dims")
+def _expand_dims(ctx, ins, outs, a):
+    ctx.add("Unsqueeze", [
+        ins[0],
+        ctx.tensor("axes", np.asarray([int(a.get("axis", 0))], np.int64)),
+    ], outs)
+
+
+@mx_op("clip")
+def _clip(ctx, ins, outs, a):
+    ctx.add("Clip", [
+        ins[0],
+        ctx.tensor("min", np.array(float(a.get("a_min")), np.float32)),
+        ctx.tensor("max", np.array(float(a.get("a_max")), np.float32)),
+    ], outs)
+
+
+@mx_op("Cast", "cast")
+def _cast(ctx, ins, outs, a):
+    dt = _NP2DT[str(np.dtype(a.get("dtype", "float32")))]
+    ctx.add("Cast", ins[:1], outs, to=dt)
+
+
+@mx_op("_contrib_flash_attention")
+def _flash(ctx, ins, outs, a):
+    """Decompose fused attention into MatMul/Softmax/MatMul (the ONNX
+    graph materializes scores — interchange form, not the TPU kernel)."""
+    q, k, v = ins[0], ins[1], ins[2]
+    qshape = ctx.shapes.get(q)
+    if qshape is None:
+        raise MXNetError("flash_attention export needs static shapes")
+    d = int(qshape[-1])
+    t_q = int(qshape[-2])
+    scale = a.get("scale")
+    scale = float(scale) if scale else 1.0 / float(np.sqrt(d))
+    rank = len(qshape)
+    perm = list(range(rank - 2)) + [rank - 1, rank - 2]
+    kT = ctx.add("Transpose", [k], [ctx.name("kT")], perm=perm)
+    s = ctx.add("MatMul", [q, kT], [ctx.name("scores")])
+    s = ctx.add("Mul", [s, ctx.tensor("scale",
+                                      np.array(scale, np.float32))],
+                [ctx.name("scaled")])
+    if _true(a.get("causal")):
+        mask = np.triu(np.full((t_q, t_q), -1e9, np.float32), k=1)
+        s = ctx.add("Add", [s, ctx.tensor("causal_mask", mask)],
+                    [ctx.name("masked")])
+    p = ctx.add("Softmax", [s], [ctx.name("probs")], axis=-1)
+    ctx.add("MatMul", [p, v], outs)
+
+
+# ---------------------------------------------------------------------------
+# graph walk
+# ---------------------------------------------------------------------------
+
+
+def _node_shapes(sym, input_shapes):
+    """Static shape for every value in the graph via one abstract eval."""
+    import jax
+
+    from ...ops import registry as _reg
+
+    nodes = sym._topo_nodes()
+    shapes = {}
+
+    def walk(bindings):
+        vals = {}
+        for node in nodes:
+            if node.is_variable:
+                vals[id(node)] = (bindings[node.name],)
+                continue
+            reg = _reg.get(node.op)
+            ins = [vals[id(inp)][idx] for inp, idx in node.inputs]
+            attrs = dict(node.attrs)
+            attrs.pop("__name__", None)
+            if reg.needs_mode:
+                attrs["_mode"] = "predict"
+            if reg.needs_rng:
+                ins = [jax.random.PRNGKey(0)] + ins
+            out = reg.forward(*ins, **attrs)
+            vals[id(node)] = out if isinstance(out, tuple) else (out,)
+        return vals
+
+    bindings = {n: jax.ShapeDtypeStruct(tuple(s), np.float32)
+                for n, s in input_shapes.items()}
+
+    def capture(bindings):
+        vals = walk(bindings)
+        return tuple(v for node in nodes for v in vals[id(node)])
+
+    outs = jax.eval_shape(capture, bindings)
+    i = 0
+    for node in nodes:
+        n_out = 1 if node.is_variable else node.num_outputs
+        for k in range(n_out):
+            shapes[_value_name(node, k)] = tuple(outs[i].shape)
+            i += 1
+    return shapes
+
+
+def _value_name(node, idx=0):
+    if node.is_variable:
+        return node.name
+    if node.num_outputs == 1:
+        return node.name
+    return "%s_out%d" % (node.name, idx)
+
+
+def export_model(sym, params, input_shape=None, input_type=None,
+                 onnx_file_path="model.onnx", verbose=False,
+                 input_names=None, model_name="mxnet_tpu_model"):
+    """Export a Symbol + params to an ONNX file (reference:
+    contrib/onnx/mx2onnx/export_model.py:export_model).
+
+    Parameters
+    ----------
+    sym : Symbol (single- or multi-output)
+    params : dict name -> NDArray/np.ndarray (arg + aux merged)
+    input_shape : list of tuples, one per graph input (non-param vars,
+        in list_inputs order)
+    onnx_file_path : destination; also returns the path
+    """
+    from ...ndarray.ndarray import NDArray
+
+    np_params = {}
+    for k, v in (params or {}).items():
+        k = k.split(":", 1)[1] if k.startswith(("arg:", "aux:")) else k
+        np_params[k] = v.asnumpy() if isinstance(v, NDArray) \
+            else np.asarray(v)
+
+    nodes = sym._topo_nodes()
+    in_vars = [n for n in nodes if n.is_variable
+               and n.name not in np_params]
+    if input_shape is not None:
+        if len(input_shape) != len(in_vars):
+            raise MXNetError(
+                "input_shape: expected %d shapes for inputs %s"
+                % (len(in_vars), [n.name for n in in_vars]))
+        input_shapes = {n.name: tuple(s)
+                        for n, s in zip(in_vars, input_shape)}
+    else:
+        input_shapes = {}
+        for n in in_vars:
+            if "__shape__" not in n.attrs:
+                raise MXNetError(
+                    "input %r has no shape; pass input_shape=" % n.name)
+            input_shapes[n.name] = tuple(n.attrs["__shape__"])
+    for n in nodes:
+        if n.is_variable and n.name in np_params:
+            input_shapes[n.name] = tuple(np_params[n.name].shape)
+
+    shapes = _node_shapes(sym, input_shapes)
+    ctx = _Ctx(np_params, shapes)
+    for name, arr in np_params.items():
+        ctx.initializers[name] = arr
+
+    for node in nodes:
+        if node.is_variable:
+            continue
+        fn = _MX2ONNX.get(node.op)
+        if fn is None:
+            raise MXNetError(
+                "ONNX export: no translator for op %r" % node.op)
+        ins = [_value_name(inp, idx) for inp, idx in node.inputs]
+        outs = [_value_name(node, k) for k in range(node.num_outputs)]
+        fn(ctx, ins, outs, dict(node.attrs))
+
+    out_names = [_value_name(n, i) for n, i in sym._outputs]
+    graph = {
+        "name": model_name,
+        "node": ctx.nodes,
+        "initializer": [_tensor_proto(k, v)
+                        for k, v in ctx.initializers.items()],
+        "input": [_value_info(n.name, input_shapes[n.name])
+                  for n in in_vars],
+        "output": [_value_info(n, shapes.get(n, ()))
+                   for n in out_names],
+    }
+    model = {
+        "ir_version": 8,
+        "producer_name": "mxnet_tpu",
+        "producer_version": "0.1",
+        "opset_import": [{"domain": "", "version": OPSET}],
+        "graph": graph,
+    }
+    data = P.encode(model, P.MODEL)
+    with open(onnx_file_path, "wb") as f:
+        f.write(data)
+    if verbose:
+        print("exported %d nodes, %d initializers -> %s"
+              % (len(ctx.nodes), len(ctx.initializers), onnx_file_path))
+    return onnx_file_path

@@ -1,0 +1,455 @@
+"""ONNX → MXNet Symbol import.
+
+Reference parity: ``python/mxnet/contrib/onnx/onnx2mx/import_model.py`` +
+``_import_helper.py`` op map.  Same public API —
+``import_model(model_file) -> (sym, arg_params, aux_params)`` — decoding
+with the in-repo protobuf codec.
+
+BatchNormalization moving statistics import as auxiliary states (same
+split the reference importer produces), so ``SymbolBlock``/``Module``
+bind them the reference way.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from . import _proto as P
+
+_ONNX2MX = {}
+
+
+def onnx_op(*names):
+    def deco(fn):
+        for n in names:
+            _ONNX2MX[n] = fn
+        return fn
+    return deco
+
+
+_DT2NP = {P.DT_FLOAT: np.float32, P.DT_DOUBLE: np.float64,
+          P.DT_FLOAT16: np.float16, P.DT_INT32: np.int32,
+          P.DT_INT64: np.int64, P.DT_INT8: np.int8,
+          P.DT_UINT8: np.uint8, P.DT_BOOL: np.bool_}
+try:
+    import ml_dtypes as _mld
+
+    _DT2NP[P.DT_BFLOAT16] = _mld.bfloat16
+except ImportError:  # bf16 models just fail with a clear dtype error
+    pass
+
+
+def _tensor_to_np(t):
+    dt = _DT2NP.get(t.get("data_type", P.DT_FLOAT))
+    if dt is None:
+        raise MXNetError("unsupported tensor dtype %s" % t.get("data_type"))
+    dims = tuple(t.get("dims", ()))
+    if t.get("raw_data") is not None:
+        if t.get("data_type") == P.DT_BFLOAT16:
+            arr = np.frombuffer(t["raw_data"], np.uint16).view(dt)
+        else:
+            arr = np.frombuffer(t["raw_data"], dtype=dt)
+    elif t.get("float_data"):
+        arr = np.asarray(t["float_data"], np.float32).astype(dt)
+    elif t.get("int64_data"):
+        arr = np.asarray(t["int64_data"], np.int64).astype(dt)
+    elif t.get("int32_data"):
+        arr = np.asarray(t["int32_data"], np.int32).astype(dt)
+    elif t.get("double_data"):
+        arr = np.asarray(t["double_data"], np.float64).astype(dt)
+    else:
+        arr = np.zeros(dims, dt)
+    return arr.reshape(dims)
+
+
+def _attrs(node):
+    out = {}
+    for a in node.get("attribute", []):
+        t = a.get("type")
+        if t == P.ATTR_FLOAT:
+            out[a["name"]] = a.get("f", 0.0)
+        elif t == P.ATTR_INT:
+            out[a["name"]] = a.get("i", 0)
+        elif t == P.ATTR_STRING:
+            v = a.get("s", b"")
+            out[a["name"]] = v.decode() if isinstance(v, bytes) else v
+        elif t == P.ATTR_INTS:
+            out[a["name"]] = list(a.get("ints", []))
+        elif t == P.ATTR_FLOATS:
+            out[a["name"]] = list(a.get("floats", []))
+        elif t == P.ATTR_TENSOR:
+            out[a["name"]] = _tensor_to_np(a["t"])
+    return out
+
+
+class _Importer:
+    def __init__(self):
+        from ... import symbol as S
+
+        self.S = S
+        self.values = {}      # onnx value name -> Symbol
+        self.consts = {}      # value name -> np.ndarray (initializers)
+        self.params = {}      # var name -> np.ndarray actually referenced
+        self.aux = set()
+
+    def sym_of(self, name, as_param=True):
+        if name in self.values:
+            return self.values[name]
+        if name in self.consts:
+            arr = self.consts[name]
+            v = self.S.var(name, shape=arr.shape, dtype=str(arr.dtype))
+            self.values[name] = v
+            self.params[name] = arr
+            return v
+        raise MXNetError("ONNX import: undefined value %r" % name)
+
+    def const_of(self, name):
+        """Numpy value of a constant input (shape tensors etc.)."""
+        if name in self.consts:
+            return self.consts[name]
+        raise MXNetError(
+            "ONNX import: %r must be a constant initializer" % name)
+
+
+def _halve_pads(pads):
+    n = len(pads) // 2
+    begin, end = pads[:n], pads[n:]
+    if list(begin) != list(end):
+        raise MXNetError("asymmetric pads %s not supported" % (pads,))
+    return [int(p) for p in begin]
+
+
+@onnx_op("Conv")
+def _conv(imp, node, a):
+    ins = node["input"]
+    data, w = imp.sym_of(ins[0]), imp.sym_of(ins[1])
+    bias = imp.sym_of(ins[2]) if len(ins) > 2 else None
+    wshape = imp.consts.get(ins[1])
+    kernel = a.get("kernel_shape") or list(wshape.shape[2:])
+    num_filter = int(wshape.shape[0]) if wshape is not None else 0
+    kw = dict(kernel=tuple(int(k) for k in kernel),
+              num_filter=num_filter,
+              stride=tuple(int(s) for s in a.get("strides",
+                                                 [1] * len(kernel))),
+              dilate=tuple(int(d) for d in a.get("dilations",
+                                                 [1] * len(kernel))),
+              pad=tuple(_halve_pads(a.get("pads", [0] * 2 * len(kernel)))),
+              num_group=int(a.get("group", 1)))
+    if bias is None:
+        return imp.S.Convolution(data, w, no_bias=True, **kw)
+    return imp.S.Convolution(data, w, bias, no_bias=False, **kw)
+
+
+@onnx_op("BatchNormalization")
+def _bn(imp, node, a):
+    ins = node["input"]
+    data = imp.sym_of(ins[0])
+    gamma, beta = imp.sym_of(ins[1]), imp.sym_of(ins[2])
+    mean, var = imp.sym_of(ins[3]), imp.sym_of(ins[4])
+    imp.aux.update([ins[3], ins[4]])
+    out = imp.S.BatchNorm(data, gamma, beta, mean, var,
+                          eps=float(a.get("epsilon", 1e-5)),
+                          momentum=float(a.get("momentum", 0.9)),
+                          fix_gamma=False)
+    return out[0]
+
+
+@onnx_op("Gemm")
+def _gemm(imp, node, a):
+    ins = node["input"]
+    x, w = imp.sym_of(ins[0]), imp.sym_of(ins[1])
+    alpha, beta = a.get("alpha", 1.0), a.get("beta", 1.0)
+    if a.get("transA"):
+        x = imp.S.transpose(x, axes=(1, 0))
+    transB = bool(a.get("transB"))
+    if abs(alpha - 1.0) > 1e-12:
+        x = imp.S._mul_scalar(x, scalar=float(alpha))
+    if not transB:
+        w = imp.S.transpose(w, axes=(1, 0))
+    bias = None
+    if len(ins) > 2:
+        bias = imp.sym_of(ins[2])
+        if abs(beta - 1.0) > 1e-12:
+            bias = imp.S._mul_scalar(bias, scalar=float(beta))
+    wshape = imp.consts.get(ins[1])
+    nh = 0
+    if wshape is not None:
+        nh = int(wshape.shape[0] if transB else wshape.shape[1])
+    if bias is None:
+        return imp.S.FullyConnected(x, w, no_bias=True, num_hidden=nh,
+                                    flatten=False)
+    return imp.S.FullyConnected(x, w, bias, no_bias=False, num_hidden=nh,
+                                flatten=False)
+
+
+@onnx_op("MatMul")
+def _matmul(imp, node, a):
+    x, y = imp.sym_of(node["input"][0]), imp.sym_of(node["input"][1])
+    return imp.S.linalg_gemm2(x, y)
+
+
+for _onn, _mxn in [("Relu", "relu"), ("Sigmoid", "sigmoid"),
+                   ("Tanh", "tanh"), ("Erf", "erf"), ("Sqrt", "sqrt"),
+                   ("Exp", "exp"), ("Log", "log"), ("Neg", "negative"),
+                   ("Abs", "abs"), ("Floor", "floor"), ("Ceil", "ceil"),
+                   ("Sin", "sin"), ("Cos", "cos"),
+                   ("Identity", "_copy")]:
+    def _mk(mxn):
+        def f(imp, node, a):
+            return getattr(imp.S, mxn)(imp.sym_of(node["input"][0]))
+        return f
+    onnx_op(_onn)(_mk(_mxn))
+
+
+@onnx_op("Softplus")
+def _softplus(imp, node, a):
+    return imp.S.Activation(imp.sym_of(node["input"][0]),
+                            act_type="softrelu")
+
+
+for _onn, _mxn in [("Add", "broadcast_add"), ("Sub", "broadcast_sub"),
+                   ("Mul", "broadcast_mul"), ("Div", "broadcast_div"),
+                   ("Max", "broadcast_maximum"),
+                   ("Min", "broadcast_minimum"),
+                   ("Pow", "power")]:
+    def _mk2(mxn):
+        def f(imp, node, a):
+            return getattr(imp.S, mxn)(imp.sym_of(node["input"][0]),
+                                       imp.sym_of(node["input"][1]))
+        return f
+    onnx_op(_onn)(_mk2(_mxn))
+
+
+@onnx_op("MaxPool", "AveragePool")
+def _pool(imp, node, a):
+    data = imp.sym_of(node["input"][0])
+    kernel = a["kernel_shape"]
+    kw = dict(kernel=tuple(int(k) for k in kernel),
+              stride=tuple(int(s) for s in a.get("strides",
+                                                 [1] * len(kernel))),
+              pad=tuple(_halve_pads(a.get("pads", [0] * 2 * len(kernel)))),
+              pool_type="max" if node["op_type"] == "MaxPool" else "avg")
+    if a.get("ceil_mode"):
+        kw["pooling_convention"] = "full"
+    if node["op_type"] == "AveragePool":
+        kw["count_include_pad"] = bool(a.get("count_include_pad", 0))
+    return imp.S.Pooling(data, **kw)
+
+
+@onnx_op("GlobalAveragePool", "GlobalMaxPool")
+def _gpool(imp, node, a):
+    ptype = "avg" if "Average" in node["op_type"] else "max"
+    return imp.S.Pooling(imp.sym_of(node["input"][0]), global_pool=True,
+                         pool_type=ptype, kernel=(1, 1))
+
+
+@onnx_op("Flatten")
+def _flatten(imp, node, a):
+    if int(a.get("axis", 1)) != 1:
+        raise MXNetError("Flatten axis != 1 unsupported")
+    return imp.S.Flatten(imp.sym_of(node["input"][0]))
+
+
+@onnx_op("Reshape")
+def _reshape(imp, node, a):
+    shape = a.get("shape")
+    if shape is None:
+        shape = [int(s) for s in imp.const_of(node["input"][1])]
+    return imp.S.reshape(imp.sym_of(node["input"][0]),
+                         shape=tuple(shape))
+
+
+@onnx_op("Transpose")
+def _transpose(imp, node, a):
+    perm = a.get("perm")
+    data = imp.sym_of(node["input"][0])
+    if perm is None:
+        return imp.S.transpose(data)
+    return imp.S.transpose(data, axes=tuple(int(p) for p in perm))
+
+
+@onnx_op("Concat")
+def _concat(imp, node, a):
+    ins = [imp.sym_of(n) for n in node["input"]]
+    return imp.S.concat(*ins, dim=int(a.get("axis", 0)))
+
+
+@onnx_op("Softmax")
+def _softmax(imp, node, a):
+    return imp.S.softmax(imp.sym_of(node["input"][0]),
+                         axis=int(a.get("axis", -1)))
+
+
+@onnx_op("LogSoftmax")
+def _log_softmax(imp, node, a):
+    return imp.S.log_softmax(imp.sym_of(node["input"][0]),
+                             axis=int(a.get("axis", -1)))
+
+
+@onnx_op("Dropout")
+def _dropout(imp, node, a):
+    return imp.S._copy(imp.sym_of(node["input"][0]))
+
+
+@onnx_op("LayerNormalization")
+def _layernorm(imp, node, a):
+    ins = node["input"]
+    return imp.S.LayerNorm(imp.sym_of(ins[0]), imp.sym_of(ins[1]),
+                           imp.sym_of(ins[2]),
+                           axis=int(a.get("axis", -1)),
+                           eps=float(a.get("epsilon", 1e-5)))
+
+
+@onnx_op("Gather")
+def _gather(imp, node, a):
+    data = imp.sym_of(node["input"][0])
+    idx = imp.sym_of(node["input"][1])
+    return imp.S.take(data, idx, axis=int(a.get("axis", 0)))
+
+
+@onnx_op("Cast")
+def _cast(imp, node, a):
+    np_dt = _DT2NP.get(int(a.get("to", P.DT_FLOAT)), np.float32)
+    return imp.S.cast(imp.sym_of(node["input"][0]),
+                      dtype=str(np.dtype(np_dt)))
+
+
+@onnx_op("ReduceMean")
+def _reduce_mean(imp, node, a):
+    axes = a.get("axes")
+    kw = {"keepdims": bool(a.get("keepdims", 1))}
+    if axes:
+        kw["axis"] = tuple(int(x) for x in axes)
+    return imp.S.mean(imp.sym_of(node["input"][0]), **kw)
+
+
+@onnx_op("Slice")
+def _slice(imp, node, a):
+    ins = node["input"]
+    data = imp.sym_of(ins[0])
+    if "starts" in a:  # opset-9 attribute form
+        starts, ends = a["starts"], a["ends"]
+        axes = a.get("axes", list(range(len(starts))))
+    else:
+        starts = [int(x) for x in imp.const_of(ins[1])]
+        ends = [int(x) for x in imp.const_of(ins[2])]
+        axes = [int(x) for x in imp.const_of(ins[3])] if len(ins) > 3 \
+            else list(range(len(starts)))
+    out = data
+    for ax, b, e in zip(axes, starts, ends):
+        e = None if e >= (1 << 60) else int(e)
+        out = imp.S.slice_axis(out, axis=int(ax), begin=int(b), end=e)
+    return out
+
+
+@onnx_op("Squeeze")
+def _squeeze(imp, node, a):
+    ins = node["input"]
+    axes = a.get("axes")
+    if axes is None and len(ins) > 1:
+        axes = [int(x) for x in imp.const_of(ins[1])]
+    data = imp.sym_of(ins[0])
+    if axes is None:
+        return imp.S.squeeze(data)
+    return imp.S.squeeze(data, axis=tuple(axes))
+
+
+@onnx_op("Unsqueeze")
+def _unsqueeze(imp, node, a):
+    ins = node["input"]
+    axes = a.get("axes")
+    if axes is None:
+        axes = [int(x) for x in imp.const_of(ins[1])]
+    out = imp.sym_of(ins[0])
+    for ax in sorted(axes):
+        out = imp.S.expand_dims(out, axis=int(ax))
+    return out
+
+
+@onnx_op("Clip")
+def _clip(imp, node, a):
+    ins = node["input"]
+    lo = a.get("min")
+    hi = a.get("max")
+    if lo is None and len(ins) > 1 and ins[1]:
+        lo = float(imp.const_of(ins[1]))
+    if hi is None and len(ins) > 2 and ins[2]:
+        hi = float(imp.const_of(ins[2]))
+    return imp.S.clip(imp.sym_of(ins[0]),
+                      a_min=float(lo if lo is not None else -3.4e38),
+                      a_max=float(hi if hi is not None else 3.4e38))
+
+
+@onnx_op("Constant")
+def _constant(imp, node, a):
+    arr = a.get("value")
+    if arr is None:
+        raise MXNetError("Constant without tensor value")
+    name = node["output"][0]
+    imp.consts[name] = np.asarray(arr)
+    return None  # materialized lazily via sym_of/const_of
+
+
+def import_model(model_file):
+    """Import an ONNX file: returns ``(sym, arg_params, aux_params)``
+    (reference: onnx2mx/import_model.py:import_model)."""
+    with open(model_file, "rb") as f:
+        model = P.decode(f.read(), P.MODEL)
+    return import_graph(model["graph"])
+
+
+def get_model_metadata(model_file):
+    """Input/output names+shapes of an ONNX file (reference:
+    import_model.py:get_model_metadata)."""
+    with open(model_file, "rb") as f:
+        model = P.decode(f.read(), P.MODEL)
+    g = model["graph"]
+
+    def unpack(vi):
+        dims = vi.get("type", {}).get("tensor_type", {}) \
+            .get("shape", {}).get("dim", [])
+        return (vi["name"], tuple(d.get("dim_value", 0) for d in dims))
+
+    return {
+        "input_tensor_data": [unpack(v) for v in g.get("input", [])
+                              if v["name"] not in
+                              {t["name"] for t in g.get("initializer", [])}],
+        "output_tensor_data": [unpack(v) for v in g.get("output", [])],
+    }
+
+
+def import_graph(graph):
+    from ...ndarray import array as nd_array
+
+    imp = _Importer()
+    for t in graph.get("initializer", []):
+        imp.consts[t["name"]] = _tensor_to_np(t)
+    init_names = set(imp.consts)
+    for vi in graph.get("input", []):
+        if vi["name"] in init_names:
+            continue
+        dims = vi.get("type", {}).get("tensor_type", {}) \
+            .get("shape", {}).get("dim", [])
+        shape = tuple(int(d.get("dim_value", 0)) for d in dims) or None
+        imp.values[vi["name"]] = imp.S.var(vi["name"], shape=shape)
+
+    for node in graph.get("node", []):
+        fn = _ONNX2MX.get(node["op_type"])
+        if fn is None:
+            raise MXNetError(
+                "ONNX import: unsupported op %r" % node["op_type"])
+        out = fn(imp, node, _attrs(node))
+        if out is None:
+            continue
+        outs = [out] if not isinstance(out, (list, tuple)) else list(out)
+        for name, s in zip(node["output"], outs):
+            imp.values[name] = s
+
+    out_syms = [imp.values[v["name"]] for v in graph.get("output", [])]
+    sym = out_syms[0] if len(out_syms) == 1 \
+        else imp.S.Group(out_syms)
+    arg_params, aux_params = {}, {}
+    for name, arr in imp.params.items():
+        (aux_params if name in imp.aux else arg_params)[name] = \
+            nd_array(arr)
+    return sym, arg_params, aux_params

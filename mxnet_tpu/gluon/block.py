@@ -387,6 +387,10 @@ class HybridBlock(Block):
 
     # -- forward dispatch -------------------------------------------------
     def forward(self, x, *args):
+        from ..symbol.symbol import Symbol
+
+        if isinstance(x, Symbol):
+            return self._forward_symbolic(x, *args)
         if not isinstance(x, NDArray):
             raise MXNetError(
                 "HybridBlock.forward expects NDArray inputs, got %s"
@@ -394,6 +398,28 @@ class HybridBlock(Block):
         if self._active and not is_tracing():
             return self._call_cached(x, *args)
         return self._forward_imperative(x, *args)
+
+    def _forward_symbolic(self, x, *args):
+        """Trace hybrid_forward into a Symbol graph (reference parity:
+        HybridBlock's symbolic path, block.py:1090 __call__ with Symbol).
+
+        Parameters surface as symbol variables named by their full
+        ``collect_params`` key, carrying ``shape=``/``dtype=`` so
+        downstream ``.shape`` reads and shape inference work.  Used by
+        ONNX export and ``HybridBlock.export``.
+        """
+        from .. import symbol as _sym_module
+        from ..symbol.symbol import var as _sym_var
+
+        params = {}
+        for name, p in self._reg_params.items():
+            shape = tuple(p.shape) if p.shape else None
+            if shape is not None and any(d == 0 for d in shape):
+                shape = None  # deferred — the op shape-hints resolve it
+            params[name] = _sym_var(
+                p.name, shape=shape,
+                dtype=str(p.dtype) if getattr(p, "dtype", None) else None)
+        return self.hybrid_forward(_sym_module, x, *args, **params)
 
     def _forward_imperative(self, x, *args):
         self._shape_hint(x, *args)

@@ -28,17 +28,44 @@ from jax import lax
 
 from .registry import register
 
+_EAGER_JIT_CACHE = {}
+
 
 def _platform_pick(run, *args):
     """Compiled kernel ONLY on tpu; every other platform (cpu, and
     untested cuda/rocm) goes through the interpreter.
 
-    ``jax.lax.platform_dependent`` resolves per lowering platform, so the
-    same traced computation runs the real kernel on TPU and the
-    interpreter on the host — regardless of where the surrounding jit or
-    eager dispatch ends up placed (a cpu-committed input must never see
-    the compiled TPU kernel).
+    Under a trace, ``jax.lax.platform_dependent`` resolves per lowering
+    platform, so the same traced computation runs the real kernel on TPU
+    and the interpreter on the host — regardless of where the surrounding
+    jit ends up placed (a cpu-committed input must never see the compiled
+    TPU kernel).  With CONCRETE (eager) arguments the platform is decided
+    up front instead: eager cond lowering builds every branch, which
+    would lower the TPU pallas branch on a CPU backend and fail.
     """
+    from jax import core as _core
+
+    if not any(isinstance(a, _core.Tracer) for a in args):
+        plat = None
+        for a in args:
+            devs = getattr(a, "devices", None)
+            if callable(devs):
+                ds = list(devs())
+                if ds:
+                    plat = ds[0].platform
+                    break
+        if plat is None:
+            plat = jax.default_backend()
+        # jit the eager call (cached per kernel+attrs): un-jitted
+        # interpret-mode pallas dispatches one tiny executable per inner
+        # op per grid point — minutes instead of milliseconds
+        key = (run.func, tuple(sorted(run.keywords.items())),
+               plat != "tpu")
+        fn = _EAGER_JIT_CACHE.get(key)
+        if fn is None:
+            fn = jax.jit(functools.partial(run, interpret=plat != "tpu"))
+            _EAGER_JIT_CACHE[key] = fn
+        return fn(*args)
     return jax.lax.platform_dependent(
         *args,
         tpu=functools.partial(run, interpret=False),

@@ -11,3 +11,16 @@ from ..ops import registry as _reg
 for _name in _reg.list_ops():
     globals().setdefault(_name, _symbol_mod.make_symbol_op(_name))
 del _name
+
+
+# contrib sub-namespace: ops named _contrib_* surface as sym.contrib.<name>
+# (mirror of nd.contrib so hybrid_forward F.contrib.* traces symbolically)
+class _ContribNS:
+    def __getattr__(self, item):
+        fn = globals().get("_contrib_" + item)
+        if fn is None:
+            raise AttributeError("sym.contrib.%s" % item)
+        return fn
+
+
+contrib = _ContribNS()

@@ -274,6 +274,28 @@ class Symbol:
         return self.eval_imperative(kwargs)
 
     # -- inference ---------------------------------------------------------
+    @property
+    def shape(self):
+        """Static output shape (single-output symbols), inferred from the
+        ``shape=`` attributes attached to the graph's variables.
+
+        Makes ``hybrid_forward`` code that reads ``x.shape`` traceable
+        with Symbol inputs (gluon symbolic trace, ONNX export) — the
+        TPU-native stance that shapes are static makes this well-defined.
+        """
+        if len(self._outputs) != 1:
+            raise MXNetError("shape: symbol has %d outputs"
+                             % len(self._outputs))
+        cached = getattr(self, "_cached_shape", None)
+        if cached is not None:
+            return cached
+        _, out_shapes, _ = self._infer_shape_impl(True)
+        if not out_shapes or out_shapes[0] is None:
+            raise MXNetError(
+                "shape: underdetermined — attach shape= to input vars")
+        self._cached_shape = tuple(out_shapes[0])
+        return self._cached_shape
+
     def infer_shape(self, *args, **kwargs):
         try:
             return self._infer_shape_impl(False, *args, **kwargs)
@@ -287,6 +309,13 @@ class Symbol:
         arg_names = self.list_arguments()
         aux_names = self.list_auxiliary_states()
         known = {}
+        # shapes attached at var() creation seed the inference (explicit
+        # args/kwargs override them)
+        for node in self._topo_nodes():
+            if node.is_variable and "__shape__" in node.attrs:
+                shp = tuple(node.attrs["__shape__"])
+                if all(d != 0 for d in shp):  # 0 dims = deferred/unknown
+                    known[node.name] = shp
         if args:
             for name, shape in zip(arg_names, args):
                 if shape is not None:
@@ -407,7 +436,12 @@ def _solve_shapes(sym, known, partial):
                 "infer_shape: unresolved inputs %s" % missing)
         if missing:
             return {**known, "__outputs__": [None] * len(sym._outputs)}
-    sd = {n: jax.ShapeDtypeStruct(tuple(known[n]), _np.float32)
+    dtypes = {}
+    for node in sym._topo_nodes():
+        if node.is_variable and "__dtype__" in node.attrs:
+            dtypes[node.name] = _np.dtype(node.attrs["__dtype__"])
+    sd = {n: jax.ShapeDtypeStruct(tuple(known[n]),
+                                  dtypes.get(n, _np.float32))
           for n in input_names}
     fn = sym._make_fn(input_names)
     outs = jax.eval_shape(fn, sd)
@@ -479,7 +513,7 @@ def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
     if shape is not None:
         attrs["__shape__"] = tuple(shape)
     if dtype is not None:
-        attrs["__dtype__"] = str(dtype)
+        attrs["__dtype__"] = str(_np.dtype(dtype))
     if lr_mult is not None:
         attrs["__lr_mult__"] = lr_mult
     if wd_mult is not None:

@@ -1,0 +1,140 @@
+"""ONNX interchange tests (VERDICT r2 item 5).
+
+No ``onnx``/``onnxruntime`` in the image, so verification is: (a) the
+protobuf codec round-trips structurally, (b) exported models re-import
+through the independent decoder path with numerical output parity —
+resnet18 end to end and the BERT encoder cell (flash attention
+decomposed to MatMul/Softmax/MatMul), matching the reference converter's
+coverage (python/mxnet/contrib/onnx/).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym as S
+from mxnet_tpu.contrib import onnx as mxonnx
+from mxnet_tpu.contrib.onnx import _proto as P
+from mxnet_tpu.gluon.model_zoo import vision, bert
+
+
+def test_proto_codec_round_trip():
+    model = {
+        "ir_version": 8, "producer_name": "mxnet_tpu",
+        "opset_import": [{"domain": "", "version": 17}],
+        "graph": {
+            "name": "g",
+            "node": [{"input": ["x", "w"], "output": ["y"],
+                      "op_type": "Conv",
+                      "attribute": [
+                          {"name": "kernel_shape", "ints": [3, 3],
+                           "type": P.ATTR_INTS},
+                          {"name": "alpha", "f": 0.25,
+                           "type": P.ATTR_FLOAT},
+                          {"name": "mode", "s": b"same",
+                           "type": P.ATTR_STRING}]}],
+            "initializer": [{"dims": [2, 3], "data_type": P.DT_FLOAT,
+                             "name": "w",
+                             "raw_data": np.arange(6, dtype=np.float32)
+                             .tobytes()}],
+            "input": [{"name": "x", "type": {"tensor_type": {
+                "elem_type": 1,
+                "shape": {"dim": [{"dim_value": 1},
+                                  {"dim_value": 3}]}}}}],
+            "output": [{"name": "y",
+                        "type": {"tensor_type": {"elem_type": 1}}}],
+        },
+    }
+    back = P.decode(P.encode(model, P.MODEL), P.MODEL)
+    node = back["graph"]["node"][0]
+    assert node["op_type"] == "Conv"
+    assert node["attribute"][0]["ints"] == [3, 3]
+    assert abs(node["attribute"][1]["f"] - 0.25) < 1e-7
+    assert node["attribute"][2]["s"] == b"same"
+    w = back["graph"]["initializer"][0]
+    np.testing.assert_array_equal(
+        np.frombuffer(w["raw_data"], np.float32),
+        np.arange(6, dtype=np.float32))
+    assert back["opset_import"][0]["version"] == 17
+
+
+def _round_trip(net, x, tmp_path, fname):
+    ref = net(x).asnumpy()
+    sym = net(S.var("data", shape=x.shape))
+    params = {k: p.data() for k, p in net.collect_params().items()}
+    path = mxonnx.export_model(sym, params,
+                               onnx_file_path=str(tmp_path / fname))
+    sym2, arg, aux = mxonnx.import_model(path)
+    bindings = {"data": x}
+    bindings.update(arg)
+    bindings.update(aux)
+    got = sym2.eval_imperative(bindings)[0].asnumpy()
+    return ref, got, path
+
+
+def test_resnet18_round_trip(tmp_path):
+    mx.random.seed(0)
+    net = vision.get_resnet(1, 18, thumbnail=True, classes=10,
+                            layout="NCHW")
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0)
+                    .rand(2, 3, 32, 32).astype(np.float32))
+    ref, got, path = _round_trip(net, x, tmp_path, "rn18.onnx")
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+    meta = mxonnx.get_model_metadata(path)
+    assert meta["input_tensor_data"] == [("data", (2, 3, 32, 32))]
+    assert meta["output_tensor_data"][0][1] == (2, 10)
+
+
+def test_bert_cell_round_trip(tmp_path):
+    mx.random.seed(0)
+    cell = bert.TransformerEncoderCell(units=64, hidden_size=128,
+                                       num_heads=4)
+    cell.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0)
+                    .rand(2, 8, 64).astype(np.float32))
+    ref, got, _ = _round_trip(cell, x, tmp_path, "bertcell.onnx")
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_bn_stats_import_as_aux(tmp_path):
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1, layout="NCHW"),
+            nn.BatchNorm(axis=1), nn.Activation("relu"))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(1)
+                    .rand(1, 3, 8, 8).astype(np.float32))
+    net(x)
+    sym = net(S.var("data", shape=(1, 3, 8, 8)))
+    params = {k: p.data() for k, p in net.collect_params().items()}
+    path = mxonnx.export_model(sym, params,
+                               onnx_file_path=str(tmp_path / "bn.onnx"))
+    sym2, arg, aux = mxonnx.import_model(path)
+    assert len(aux) == 2  # moving mean + var
+    assert set(sym2.list_auxiliary_states()) == set(aux)
+
+
+def test_nhwc_graph_export_rejected(tmp_path):
+    mx.random.seed(0)
+    net = vision.get_resnet(1, 18, thumbnail=True, classes=10,
+                            layout="NHWC")
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0)
+                    .rand(1, 3, 32, 32).astype(np.float32))
+    net(x)
+    sym = net(S.var("data", shape=(1, 3, 32, 32)))
+    params = {k: p.data() for k, p in net.collect_params().items()}
+    with pytest.raises(mx.MXNetError, match="NCHW"):
+        mxonnx.export_model(sym, params,
+                            onnx_file_path=str(tmp_path / "x.onnx"))
+
+
+def test_unsupported_op_reports_name(tmp_path):
+    sym = S.arcsinh(S.var("data", shape=(2, 2))) \
+        if hasattr(S, "arcsinh") else None
+    if sym is None:
+        pytest.skip("no arcsinh op")
+    with pytest.raises(mx.MXNetError, match="arcsinh"):
+        mxonnx.export_model(sym, {}, onnx_file_path=str(tmp_path / "y.onnx"))
