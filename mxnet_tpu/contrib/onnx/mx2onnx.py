@@ -110,14 +110,7 @@ def _value_info(name, shape, elem_type=P.DT_FLOAT):
 # ---------------------------------------------------------------------------
 
 
-def _pads2(pad):
-    pad = tuple(int(p) for p in (pad or ()))
-    if not pad:
-        pad = (0, 0)
-    return list(pad) + list(pad)  # [x1_begin, x2_begin, x1_end, x2_end]
-
-
-def _get_weightT(ctx, wname, out):
+def _get_weightT(ctx, wname):
     """Return name of W^T: pre-transposed initializer when W is constant,
     else a Transpose node."""
     if wname in ctx.initializers:
@@ -241,7 +234,7 @@ def _fc(ctx, ins, outs, a):
                 transA=0, transB=1)
         return
     # N-D, flatten=False: MatMul with W^T (+ Add bias)
-    wT = _get_weightT(ctx, w, outs[0])
+    wT = _get_weightT(ctx, w)
     mm = ctx.add("MatMul", [data, wT],
                  [outs[0] if bias is None else ctx.name("matmul")])
     if bias is not None:

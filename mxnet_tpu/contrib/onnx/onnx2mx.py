@@ -335,6 +335,11 @@ def _slice(imp, node, a):
         ends = [int(x) for x in imp.const_of(ins[2])]
         axes = [int(x) for x in imp.const_of(ins[3])] if len(ins) > 3 \
             else list(range(len(starts)))
+        if len(ins) > 4 and ins[4]:
+            steps = [int(x) for x in imp.const_of(ins[4])]
+            if any(st != 1 for st in steps):
+                raise MXNetError(
+                    "ONNX import: Slice steps %s unsupported" % steps)
     out = data
     for ax, b, e in zip(axes, starts, ends):
         e = None if e >= (1 << 60) else int(e)

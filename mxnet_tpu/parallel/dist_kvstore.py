@@ -12,22 +12,37 @@ error-feedback residual (``gradient_compression.h:43-130``).
 TPU-native stance: *intra-host* reduction rides ICI inside compiled
 executables (``parallel.JitTrainStep`` psum) — this module is the
 *inter-host* (DCN) tier, where the reference used ZMQ.  The wire is a
-small length-prefixed-pickle protocol over TCP sockets; the scheduler
-rendezvous of ps-lite collapses into the servers themselves (workers
-connect straight to the server addresses derived from the root URI) —
-one fewer process with identical observable semantics.
+TYPED binary protocol over TCP (the shape of ps-lite's message format,
+``kvstore_dist.h:267-327``): every frame is a magic+version+command
+header followed by tagged fields (string / raw-tensor / float64 / json
+/ bytes) — never pickled objects, so a hostile peer can inject data at
+worst, not code.  Connections open with a shared-secret HMAC handshake
+(``MXNET_KVSTORE_SECRET`` env, set by ``tools/launch.py``); the
+scheduler rendezvous of ps-lite collapses into the servers themselves
+(workers connect straight to the server addresses derived from the root
+URI) — one fewer process with identical observable semantics.
+
+Server-side optimizers travel as a JSON config (registry name +
+scalar hyperparameters), not a code object; optimizers carrying an
+``lr_scheduler`` must schedule worker-side (documented limitation —
+the reference shipped the whole pickled object, an RCE by design).
 
 Environment (reference names, ``tools/launch.py`` sets them):
 ``DMLC_ROLE`` (worker|server|scheduler), ``DMLC_PS_ROOT_URI``,
-``DMLC_PS_ROOT_PORT``, ``DMLC_NUM_WORKER``, ``DMLC_NUM_SERVER``.
+``DMLC_PS_ROOT_PORT``, ``DMLC_NUM_WORKER``, ``DMLC_NUM_SERVER``,
+plus ``MXNET_KVSTORE_SECRET`` (optional shared secret).
 """
 from __future__ import annotations
 
+import hashlib
+import hmac as _hmac
+import json
 import os
-import pickle
+import secrets as _secrets
 import socket
 import struct
 import threading
+import warnings
 
 import numpy as np
 
@@ -38,33 +53,234 @@ from ..ndarray import sparse as _sp
 
 
 # ---------------------------------------------------------------------------
-# wire protocol
+# wire protocol: MAGIC | ver u8 | cmd u8 | nfields u8 | fields
+# field := tag u8 | payload
+#   'S' string:  u32 len | utf8
+#   'B' bytes:   u32 len | raw
+#   'J' json:    u32 len | utf8(json)
+#   'F' float64: f64
+#   'T' tensor:  u8 dlen | dtype-ascii | u8 ndim | i64*ndim dims | u64 | raw
 # ---------------------------------------------------------------------------
 
-def _send(sock, obj):
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+_MAGIC = b"MXKV"
+_VERSION = 1
+
+CMD_OK = 0
+CMD_INIT = 1
+CMD_PUSH = 2
+CMD_PULL = 3
+CMD_ROW_SPARSE_PULL = 4
+CMD_BARRIER = 5
+CMD_SET_OPTIMIZER = 6
+CMD_STOP = 7
+CMD_HELLO = 8
+CMD_ERR = 255
+
+_MAX_FRAME = 1 << 34  # 16 GiB sanity ceiling per tensor/string
 
 
-def _recv(sock):
-    hdr = b""
-    while len(hdr) < 8:
-        chunk = sock.recv(8 - len(hdr))
-        if not chunk:
+def _recv_exact(sock, n):
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if not r:
             raise ConnectionError("peer closed")
-        hdr += chunk
-    (n,) = struct.unpack("<Q", hdr)
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(min(1 << 20, n - len(buf)))
-        if not chunk:
-            raise ConnectionError("peer closed")
-        buf += chunk
-    return pickle.loads(bytes(buf))
+        got += r
+    return bytes(buf)
+
+
+def _send(sock, cmd, *fields):
+    """Encode small parts into one header buffer; large tensor payloads
+    are sent as zero-copy memoryviews (no 64MB tobytes round trips)."""
+    out = bytearray()
+    out += _MAGIC
+    out += struct.pack("<BBB", _VERSION, cmd, len(fields))
+
+    def flush():
+        if out:
+            sock.sendall(out)
+            out.clear()
+
+    for v in fields:
+        if isinstance(v, str):
+            b = v.encode()
+            out += b"S" + struct.pack("<I", len(b)) + b
+        elif isinstance(v, (bytes, bytearray)):
+            out += b"B" + struct.pack("<I", len(v)) + bytes(v)
+        elif isinstance(v, float):
+            out += b"F" + struct.pack("<d", v)
+        elif isinstance(v, dict):
+            b = json.dumps(v).encode()
+            out += b"J" + struct.pack("<I", len(b)) + b
+        elif isinstance(v, np.ndarray):
+            v = np.ascontiguousarray(v)
+            out += b"T" + struct.pack("<B", len(str(v.dtype))) \
+                + str(v.dtype).encode() \
+                + struct.pack("<B", v.ndim) \
+                + struct.pack("<%dq" % v.ndim, *v.shape) \
+                + struct.pack("<Q", v.nbytes)
+            flush()
+            sock.sendall(memoryview(v).cast("B"))
+        else:
+            raise MXNetError("wire: cannot encode %r" % type(v).__name__)
+    flush()
+
+
+def _recv(sock, max_bytes=_MAX_FRAME):
+    """Decode one frame.  ``max_bytes`` caps any single field allocation —
+    servers keep it tiny until the peer has authenticated, so an
+    unauthenticated connection cannot force multi-GiB allocations."""
+    magic = _recv_exact(sock, 4)
+    if magic != _MAGIC:
+        raise MXNetError("wire: bad magic %r" % magic)
+    ver, cmd, nfields = struct.unpack("<BBB", _recv_exact(sock, 3))
+    if ver != _VERSION:
+        raise MXNetError("wire: version %d (want %d)" % (ver, _VERSION))
+    fields = []
+    for _ in range(nfields):
+        tag = _recv_exact(sock, 1)
+        if tag in (b"S", b"B", b"J"):
+            (ln,) = struct.unpack("<I", _recv_exact(sock, 4))
+            if ln > max_bytes:
+                raise MXNetError("wire: oversized field")
+            raw = _recv_exact(sock, ln)
+            if tag == b"S":
+                fields.append(raw.decode())
+            elif tag == b"J":
+                fields.append(json.loads(raw.decode()))
+            else:
+                fields.append(raw)
+        elif tag == b"F":
+            fields.append(struct.unpack("<d", _recv_exact(sock, 8))[0])
+        elif tag == b"T":
+            (dlen,) = struct.unpack("<B", _recv_exact(sock, 1))
+            dtype = np.dtype(_recv_exact(sock, dlen).decode())
+            (ndim,) = struct.unpack("<B", _recv_exact(sock, 1))
+            dims = struct.unpack("<%dq" % ndim, _recv_exact(sock, 8 * ndim)) \
+                if ndim else ()
+            (nbytes,) = struct.unpack("<Q", _recv_exact(sock, 8))
+            expect = int(np.prod(dims, dtype=np.int64)) * dtype.itemsize \
+                if ndim else dtype.itemsize
+            if nbytes != expect or nbytes > max_bytes:
+                raise MXNetError("wire: tensor size mismatch")
+            arr = np.empty(dims, dtype)
+            view = memoryview(arr).cast("B")
+            got = 0
+            while got < nbytes:
+                r = sock.recv_into(view[got:], nbytes - got)
+                if not r:
+                    raise ConnectionError("peer closed")
+                got += r
+            fields.append(arr)
+        else:
+            raise MXNetError("wire: unknown field tag %r" % tag)
+    return cmd, fields
+
+
+# -- shared-secret handshake -------------------------------------------------
+
+def _secret():
+    return os.environ.get("MXNET_KVSTORE_SECRET", "")
+
+
+_warned_no_secret = []
+
+
+def _auth_digest(secret, nonce, role):
+    return _hmac.new(secret.encode(), nonce + role, hashlib.sha256) \
+        .digest()
+
+
+def _client_handshake(sock):
+    """Mutual challenge-response (replay-proof: each side proves the
+    secret over the OTHER side's fresh nonce).
+
+    client -> HELLO [client_nonce]
+    server -> OK    [server_nonce, HMAC(secret, client_nonce|"server")]
+    client -> HELLO [HMAC(secret, server_nonce|"client")]
+    server -> OK    []
+    """
+    secret = _secret()
+    if not secret:
+        if not _warned_no_secret:
+            _warned_no_secret.append(True)
+            warnings.warn(
+                "MXNET_KVSTORE_SECRET unset: dist-kvstore connections are "
+                "unauthenticated (tools/launch.py generates one per job)")
+        return
+    nonce = _secrets.token_bytes(16)
+    _send(sock, CMD_HELLO, nonce)
+    cmd, fields = _recv(sock, max_bytes=4096)
+    if cmd != CMD_OK or len(fields) != 2 or not _hmac.compare_digest(
+            fields[1], _auth_digest(secret, nonce, b"server")):
+        raise MXNetError("kvstore handshake failed (bad server secret)")
+    server_nonce = bytes(fields[0])
+    _send(sock, CMD_HELLO, _auth_digest(secret, server_nonce, b"client"))
+    cmd, _f = _recv(sock, max_bytes=4096)
+    if cmd != CMD_OK:
+        raise MXNetError("kvstore handshake rejected")
+
+
+def _server_hello(sock, fields):
+    """Serve the two-round handshake; returns True iff authenticated."""
+    secret = _secret()
+    if not secret or len(fields) != 1:
+        # no secret configured server-side: reply with an empty proof —
+        # a secret-bearing client will reject it (configs disagree)
+        _send(sock, CMD_OK, b"", b"")
+        return not secret
+    client_nonce = bytes(fields[0])
+    server_nonce = _secrets.token_bytes(16)
+    _send(sock, CMD_OK, server_nonce,
+          _auth_digest(secret, client_nonce, b"server"))
+    cmd, f2 = _recv(sock, max_bytes=4096)
+    if cmd != CMD_HELLO or len(f2) != 1 or not _hmac.compare_digest(
+            bytes(f2[0]), _auth_digest(secret, server_nonce, b"client")):
+        _send(sock, CMD_ERR, "authentication failed")
+        return False
+    _send(sock, CMD_OK)
+    return True
 
 
 def _server_port(root_port, server_id):
     return int(root_port) + 1 + server_id
+
+
+# -- optimizer config (replaces the reference's pickled-object command) ------
+
+_JSONABLE = (int, float, str, bool, type(None))
+
+
+def _optimizer_to_config(optimizer):
+    if getattr(optimizer, "lr_scheduler", None) is not None:
+        raise MXNetError(
+            "server-side optimizer with an lr_scheduler is not "
+            "serializable over the wire; schedule worker-side instead")
+    state = {}
+    for k, v in vars(optimizer).items():
+        if isinstance(v, _JSONABLE):
+            state[k] = v
+        elif isinstance(v, dict) and all(
+                isinstance(x, _JSONABLE) for x in v.values()) and all(
+                isinstance(x, (int, str)) for x in v.keys()):
+            # item-list form: JSON object keys are always strings, which
+            # would corrupt int-keyed idx2name/lr_mult/wd_mult tables
+            state[k] = {"__items__": [[kk, vv] for kk, vv in v.items()]}
+    return {"class": type(optimizer).__name__.lower(), "state": state}
+
+
+def _optimizer_from_config(cfg):
+    from .. import optimizer as opt_mod
+
+    opt = opt_mod.create(cfg["class"])
+    for k, v in cfg.get("state", {}).items():
+        if isinstance(v, dict) and "__items__" in v:
+            v = {kk if not isinstance(kk, list) else tuple(kk): vv
+                 for kk, vv in v["__items__"]}
+        setattr(opt, k, v)
+    return opt
 
 
 # ---------------------------------------------------------------------------
@@ -166,62 +382,72 @@ class DistServer:
         return NDArray(acc)
 
     def _handle(self, sock):
+        authed = not _secret()
         try:
             while not self._stop.is_set():
-                msg = _recv(sock)
-                cmd = msg[0]
-                if cmd == "INIT":
-                    _, key, value = msg
+                # unauthenticated peers may only send tiny (HELLO) frames
+                cmd, f = _recv(
+                    sock, max_bytes=_MAX_FRAME if authed else 4096)
+                if cmd == CMD_HELLO:
+                    authed = _server_hello(sock, f)
+                    if not authed:
+                        return
+                    continue
+                if not authed:
+                    _send(sock, CMD_ERR, "unauthenticated")
+                    return
+                if cmd == CMD_INIT:
+                    key, value = f
                     st = self._key(key)
                     with st.lock:
                         if st.value is None:
                             st.value = NDArray(np.asarray(value))
-                    _send(sock, ("OK",))
-                elif cmd == "PUSH":
-                    _, key, payload = msg
-                    self._do_push(key, self._decode(payload))
-                    _send(sock, ("OK",))
-                elif cmd == "PULL":
-                    _, key = msg
+                    _send(sock, CMD_OK)
+                elif cmd == CMD_PUSH:
+                    key = f[0]
+                    self._do_push(key, self._decode(f[1], f[2:]))
+                    _send(sock, CMD_OK)
+                elif cmd == CMD_PULL:
+                    (key,) = f
                     st = self._key(key)
                     with st.lock:
                         val = st.value.asnumpy()
-                    _send(sock, ("OK", val))
-                elif cmd == "ROW_SPARSE_PULL":
-                    _, key, row_ids = msg
+                    _send(sock, CMD_OK, val)
+                elif cmd == CMD_ROW_SPARSE_PULL:
+                    key, row_ids = f
                     st = self._key(key)
                     with st.lock:
                         rows = st.value.asnumpy()[np.asarray(row_ids)]
-                    _send(sock, ("OK", rows))
-                elif cmd == "BARRIER":
+                    _send(sock, CMD_OK, rows)
+                elif cmd == CMD_BARRIER:
                     self._do_barrier()
-                    _send(sock, ("OK",))
-                elif cmd == "SET_OPTIMIZER":
-                    _, blob = msg
+                    _send(sock, CMD_OK)
+                elif cmd == CMD_SET_OPTIMIZER:
                     from .. import optimizer as opt_mod
 
-                    self._optimizer = pickle.loads(blob)
+                    self._optimizer = _optimizer_from_config(f[0])
                     self._updater = opt_mod.get_updater(self._optimizer)
-                    _send(sock, ("OK",))
-                elif cmd == "STOP":
-                    _send(sock, ("OK",))
+                    _send(sock, CMD_OK)
+                elif cmd == CMD_STOP:
+                    _send(sock, CMD_OK)
                     self._stop.set()
                 else:
-                    _send(sock, ("ERR", "unknown command %r" % (cmd,)))
-        except (ConnectionError, OSError):
+                    _send(sock, CMD_ERR, "unknown command %r" % (cmd,))
+        except Exception:
+            # malformed frame / handler error: the stream may be out of
+            # sync — drop the connection (client surfaces a socket error)
             pass
 
     @staticmethod
-    def _decode(payload):
-        kind = payload[0]
+    def _decode(kind, fields):
         if kind == "dense":
-            return NDArray(payload[1])
+            return NDArray(fields[0])
         if kind == "rsp":
-            _, vals, idx, shape = payload
-            return _sp.RowSparseNDArray(np.asarray(vals),
-                                        np.asarray(idx), shape)
+            vals, idx, shape = fields
+            return _sp.RowSparseNDArray(np.asarray(vals), np.asarray(idx),
+                                        tuple(int(d) for d in shape))
         if kind == "2bit":
-            _, codes, threshold = payload
+            codes, threshold = fields
             return NDArray(codes.astype(np.float32) * threshold)
         raise MXNetError("bad payload kind %r" % (kind,))
 
@@ -327,17 +553,18 @@ class DistKVStore(KVStoreBase):
                     (self._root, _server_port(self._root_port, server_id)),
                     timeout=60)
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                _client_handshake(s)
                 self._socks[server_id] = s
             return s
 
-    def _rpc(self, key, *msg):
+    def _rpc(self, key, cmd, *fields):
         s = self._sock(self._shard(key))
         with self._lock:
-            _send(s, msg)
-            reply = _recv(s)
-        if reply[0] != "OK":
-            raise MXNetError("kvstore rpc failed: %r" % (reply,))
-        return reply[1] if len(reply) > 1 else None
+            _send(s, cmd, *fields)
+            rcmd, rfields = _recv(s)
+        if rcmd != CMD_OK:
+            raise MXNetError("kvstore rpc failed: %r" % (rfields,))
+        return rfields[0] if rfields else None
 
     # -- KVStore API -------------------------------------------------------
     @staticmethod
@@ -370,17 +597,18 @@ class DistKVStore(KVStoreBase):
         for k, v in zip(keys, values):
             if self._rank == 0:
                 arr = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
-                self._rpc(k, "INIT", str(k), arr)
+                self._rpc(k, CMD_INIT, str(k), arr)
         self.barrier()
 
     def _encode(self, key, v):
+        """(kind, *wire_fields) for a pushed value."""
         if isinstance(v, _sp.RowSparseNDArray):
             return ("rsp", v.values.asnumpy(), v.indices.asnumpy(),
-                    tuple(v.shape))
+                    np.asarray(v.shape, np.int64))
         arr = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
         if self._gc is not None:
             codes = self._gc.compress(str(key), arr)
-            return ("2bit", codes, self._gc.threshold)
+            return ("2bit", codes, float(self._gc.threshold))
         return ("dense", arr)
 
     def _local_merge(self, value):
@@ -402,13 +630,14 @@ class DistKVStore(KVStoreBase):
         values = [value] if not isinstance(key, (list, tuple)) else value
         for k, v in zip(keys, values):
             merged = self._local_merge(v)
-            self._rpc(k, "PUSH", str(k), self._encode(k, merged))
+            kind, *fields = self._encode(k, merged)
+            self._rpc(k, CMD_PUSH, str(k), kind, *fields)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys = [key] if not isinstance(key, (list, tuple)) else key
         outs = [out] if not isinstance(key, (list, tuple)) else out
         for k, o in zip(keys, outs):
-            val = self._rpc(k, "PULL", str(k))
+            val = self._rpc(k, CMD_PULL, str(k))
             dsts = o if isinstance(o, (list, tuple)) else [o]
             for dst in dsts:
                 dst._set_data(np.asarray(val).astype(dst.dtype))
@@ -428,7 +657,8 @@ class DistKVStore(KVStoreBase):
         rows_np = row_ids.asnumpy().astype(np.int64) \
             if hasattr(row_ids, "asnumpy") else np.asarray(row_ids,
                                                            np.int64)
-        rows = self._rpc(key, "ROW_SPARSE_PULL", str(key), rows_np)
+        rows = self._rpc(key, CMD_ROW_SPARSE_PULL, str(key),
+                         rows_np)
         dsts = out if isinstance(out, (list, tuple)) else [out]
         for dst in dsts:
             import jax.numpy as jnp
@@ -442,22 +672,22 @@ class DistKVStore(KVStoreBase):
         for sid in range(self._num_servers):
             s = self._sock(sid)
             with self._lock:
-                _send(s, ("BARRIER",))
-                reply = _recv(s)
-            if reply[0] != "OK":
+                _send(s, CMD_BARRIER)
+                rcmd, _f = _recv(s)
+            if rcmd != CMD_OK:
                 raise MXNetError("barrier failed")
 
     def set_optimizer(self, optimizer):
         """Run the optimizer server-side (parity: SendCommandToServers)."""
         self._optimizer = optimizer
         if self._rank == 0:
-            blob = pickle.dumps(optimizer)
+            cfg = _optimizer_to_config(optimizer)
             for sid in range(self._num_servers):
                 s = self._sock(sid)
                 with self._lock:
-                    _send(s, ("SET_OPTIMIZER", blob))
-                    reply = _recv(s)
-                if reply[0] != "OK":
+                    _send(s, CMD_SET_OPTIMIZER, cfg)
+                    rcmd, _f = _recv(s)
+                if rcmd != CMD_OK:
                     raise MXNetError("set_optimizer failed")
         self.barrier()
 
@@ -472,7 +702,7 @@ class DistKVStore(KVStoreBase):
             try:
                 s = self._socks[sid]
                 with self._lock:
-                    _send(s, ("STOP",))
+                    _send(s, CMD_STOP)
                     _recv(s)
                 s.close()
             except OSError:

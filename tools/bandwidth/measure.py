@@ -7,10 +7,16 @@ Here the collective is an XLA ``psum`` over the device mesh — ICI on a
 real pod, shared-memory on the virtual CPU mesh — which is the rebuild's
 actual gradient-aggregation path (compiled into the train step).
 
+``--dist`` instead measures the DCN tier: push+pull round-trip
+throughput of the typed dist-kvstore wire against an in-process
+DistServer over loopback TCP (upper bound of the protocol + framing
+stack; real DCN adds the network itself).
+
 Usage:
     python tools/bandwidth/measure.py [--size-mb 64] [--runs 10]
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python tools/bandwidth/measure.py   # 8 virtual devices
+    python tools/bandwidth/measure.py --dist  # dist-kvstore TCP wire
 """
 from __future__ import annotations
 
@@ -23,11 +29,53 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))))
 
 
+def measure_dist(size_mb, runs):
+    """Loopback push+pull throughput of the typed dist-kvstore wire."""
+    import threading
+    import time as _t
+
+    import numpy as np
+
+    from mxnet_tpu import nd
+    from mxnet_tpu.parallel.dist_kvstore import (
+        DistKVStore, DistServer, _server_port)
+
+    root_port = 23450
+    srv = DistServer(_server_port(root_port, 0), num_workers=1, sync=True)
+    threading.Thread(target=srv.run, daemon=True).start()
+    _t.sleep(0.3)
+    os.environ["DMLC_PS_ROOT_PORT"] = str(root_port)
+    os.environ["DMLC_NUM_WORKER"] = "1"
+    os.environ["DMLC_NUM_SERVER"] = "1"
+    kv = DistKVStore("dist_sync")
+    elems = int(size_mb * 1e6 / 4)
+    val = nd.array(np.ones((elems,), np.float32))
+    kv.init("bw", val)
+    out = nd.zeros((elems,))
+    kv.push("bw", val)
+    kv.pull("bw", out=out)
+    t0 = _t.perf_counter()
+    for _ in range(runs):
+        kv.push("bw", val)
+        kv.pull("bw", out=out)
+    dt = (_t.perf_counter() - t0) / runs
+    moved = elems * 4 * 2  # push + pull payloads
+    print("dist wire: payload=%.1fMB round-trip=%.1fms throughput=%.2f GB/s"
+          % (elems * 4 / 1e6, dt * 1e3, moved / dt / 1e9))
+    kv.stop()
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--size-mb", type=float, default=64.0)
     ap.add_argument("--runs", type=int, default=10)
+    ap.add_argument("--dist", action="store_true",
+                    help="measure the dist-kvstore TCP wire instead")
     args = ap.parse_args()
+
+    if args.dist:
+        measure_dist(args.size_mb, args.runs)
+        return
 
     import jax
 

@@ -29,6 +29,8 @@ def _free_port():
 
 def launch(num_workers, num_servers, command, kv_store="dist_sync",
            env_extra=None):
+    import secrets
+
     root_port = _free_port()
     base_env = dict(os.environ)
     base_env.update({
@@ -37,6 +39,9 @@ def launch(num_workers, num_servers, command, kv_store="dist_sync",
         "DMLC_NUM_WORKER": str(num_workers),
         "DMLC_NUM_SERVER": str(num_servers),
         "MXNET_KVSTORE_MODE": kv_store,
+        # per-job shared secret for the typed-wire HMAC handshake
+        "MXNET_KVSTORE_SECRET": base_env.get("MXNET_KVSTORE_SECRET")
+        or secrets.token_hex(16),
     })
     base_env.update(env_extra or {})
 
