@@ -115,7 +115,11 @@ def _send(sock, cmd, *fields):
             b = json.dumps(v).encode()
             out += b"J" + struct.pack("<I", len(b)) + b
         elif isinstance(v, np.ndarray):
-            v = np.ascontiguousarray(v)
+            # asarray(order="C") keeps 0-d shapes; ascontiguousarray
+            # would promote () to (1,)
+            v = np.asarray(v, order="C")
+            if not v.flags.c_contiguous:
+                v = np.ascontiguousarray(v)
             out += b"T" + struct.pack("<B", len(str(v.dtype))) \
                 + str(v.dtype).encode() \
                 + struct.pack("<B", v.ndim) \
@@ -253,21 +257,47 @@ def _server_port(root_port, server_id):
 _JSONABLE = (int, float, str, bool, type(None))
 
 
+_DROP = object()
+
+
 def _optimizer_to_config(optimizer):
     if getattr(optimizer, "lr_scheduler", None) is not None:
         raise MXNetError(
             "server-side optimizer with an lr_scheduler is not "
             "serializable over the wire; schedule worker-side instead")
-    state = {}
+    def scalar(x):
+        if isinstance(x, (bool,) + _JSONABLE[:1]) or x is None \
+                or isinstance(x, (float, str)):
+            return x
+        if isinstance(x, np.integer):
+            return int(x)
+        if isinstance(x, np.floating):
+            return float(x)
+        if isinstance(x, np.bool_):
+            return bool(x)
+        return _DROP
+
+    state, dropped = {}, []
     for k, v in vars(optimizer).items():
-        if isinstance(v, _JSONABLE):
-            state[k] = v
-        elif isinstance(v, dict) and all(
-                isinstance(x, _JSONABLE) for x in v.values()) and all(
-                isinstance(x, (int, str)) for x in v.keys()):
-            # item-list form: JSON object keys are always strings, which
-            # would corrupt int-keyed idx2name/lr_mult/wd_mult tables
-            state[k] = {"__items__": [[kk, vv] for kk, vv in v.items()]}
+        sv = scalar(v)
+        if sv is not _DROP:
+            state[k] = sv
+            continue
+        if isinstance(v, dict):
+            items = [[kk, scalar(vv)] for kk, vv in v.items()
+                     if isinstance(kk, (int, str))]
+            if len(items) == len(v) and all(
+                    vv is not _DROP for _, vv in items):
+                # item-list form: JSON object keys are always strings,
+                # which would corrupt int-keyed idx2name/lr_mult tables
+                state[k] = {"__items__": items}
+                continue
+        dropped.append(k)
+    if dropped:
+        warnings.warn(
+            "set_optimizer: attributes %s are not wire-serializable and "
+            "were dropped; the server-side optimizer uses its defaults "
+            "for them" % dropped)
     return {"class": type(optimizer).__name__.lower(), "state": state}
 
 
@@ -433,10 +463,18 @@ class DistServer:
                     self._stop.set()
                 else:
                     _send(sock, CMD_ERR, "unknown command %r" % (cmd,))
-        except Exception:
-            # malformed frame / handler error: the stream may be out of
-            # sync — drop the connection (client surfaces a socket error)
+        except (ConnectionError, OSError):
             pass
+        except Exception:
+            # malformed frame / handler bug: the stream may be out of
+            # sync — log and drop the connection (client surfaces a
+            # socket error rather than a blind timeout)
+            import logging
+            import traceback
+
+            logging.getLogger(__name__).warning(
+                "kvstore server connection dropped:\n%s",
+                traceback.format_exc())
 
     @staticmethod
     def _decode(kind, fields):

@@ -358,6 +358,7 @@ def test_auth_handshake_and_rejection(monkeypatch):
         assert cmd == dk.CMD_ERR
     finally:
         raw.close()
+        kvs[0].stop()
 
 
 def test_optimizer_config_round_trip():
