@@ -771,5 +771,227 @@ def take(a, indices, axis=None, mode="clip"):
                                       mode=mode), [a], "_np_take")
 
 
+
+
+# ---------------------------------------------------------------------------
+# statistics / set / window wave (reference: numpy/multiarray.py +
+# src/operator/numpy/np_percentile_op.cc, np_window_op.cc, set ops)
+# ---------------------------------------------------------------------------
+
+
+def percentile(a, q, axis=None, interpolation="linear", keepdims=False):
+    if not isinstance(a, NDArray):
+        a = array(a)
+    return _invoke(lambda x: jnp.percentile(
+        x.astype(jnp.float32), jnp.asarray(q, jnp.float32),
+        axis=_norm_axis(axis), method=interpolation,
+        keepdims=keepdims), [a], "_npi_percentile")
+
+
+def quantile(a, q, axis=None, interpolation="linear", keepdims=False):
+    if not isinstance(a, NDArray):
+        a = array(a)
+    return _invoke(lambda x: jnp.quantile(
+        x.astype(jnp.float32), jnp.asarray(q, jnp.float32),
+        axis=_norm_axis(axis), method=interpolation,
+        keepdims=keepdims), [a], "_npi_quantile")
+
+
+def histogram(a, bins=10, range=None):  # noqa: A002
+    if isinstance(bins, NDArray) or isinstance(bins, (list, tuple)):
+        edges = jnp.asarray(bins.data() if isinstance(bins, NDArray)
+                            else bins, jnp.float32)
+        counts, e = jnp.histogram(jnp.asarray(_flat(a), jnp.float32),
+                                  bins=edges)
+        return array(counts), array(e)
+    counts, e = jnp.histogram(jnp.asarray(_flat(a), jnp.float32),
+                              bins=int(bins), range=range)
+    return array(counts), array(e)
+
+
+def _flat(a):
+    return a.data().reshape(-1) if isinstance(a, NDArray) \
+        else jnp.asarray(a).reshape(-1)
+
+
+def cov(m, y=None, rowvar=True, bias=False, ddof=None):
+    args = [m] if y is None else [m, y]
+    args = [x if isinstance(x, NDArray) else array(x) for x in args]
+    if y is None:
+        return _invoke(lambda x: jnp.cov(
+            x.astype(jnp.float32), rowvar=rowvar, bias=bias, ddof=ddof),
+            args, "_npi_cov")
+    return _invoke(lambda x, yy: jnp.cov(
+        x.astype(jnp.float32), yy.astype(jnp.float32), rowvar=rowvar,
+        bias=bias, ddof=ddof), args, "_npi_cov")
+
+
+def corrcoef(x, rowvar=True):
+    if not isinstance(x, NDArray):
+        x = array(x)
+    return _invoke(lambda a: jnp.corrcoef(a.astype(jnp.float32),
+                                          rowvar=rowvar),
+                   [x], "_npi_corrcoef")
+
+
+def ptp(a, axis=None, keepdims=False):
+    if not isinstance(a, NDArray):
+        a = array(a)
+    return _invoke(lambda x: jnp.ptp(x, axis=_norm_axis(axis),
+                                     keepdims=keepdims), [a], "_npi_ptp")
+
+
+def _nan_reduce(name, jfn, with_ddof=False):
+    def f(a, axis=None, ddof=0, keepdims=False):
+        if not isinstance(a, NDArray):
+            a = array(a)
+        kw = {"axis": _norm_axis(axis), "keepdims": keepdims}
+        if with_ddof:
+            kw["ddof"] = ddof
+        return _invoke(lambda x: jfn(x.astype(jnp.float32), **kw),
+                       [a], "_npi_" + name)
+    f.__name__ = name
+    return f
+
+
+nanmean = _nan_reduce("nanmean", jnp.nanmean)
+nanstd = _nan_reduce("nanstd", jnp.nanstd, with_ddof=True)
+nanvar = _nan_reduce("nanvar", jnp.nanvar, with_ddof=True)
+
+
+def nanmax(a, axis=None, keepdims=False):
+    if not isinstance(a, NDArray):
+        a = array(a)
+    return _invoke(lambda x: jnp.nanmax(x, axis=_norm_axis(axis),
+                                        keepdims=keepdims),
+                   [a], "_npi_nanmax")
+
+
+def nanmin(a, axis=None, keepdims=False):
+    if not isinstance(a, NDArray):
+        a = array(a)
+    return _invoke(lambda x: jnp.nanmin(x, axis=_norm_axis(axis),
+                                        keepdims=keepdims),
+                   [a], "_npi_nanmin")
+
+
+def nanargmax(a, axis=None):
+    if not isinstance(a, NDArray):
+        a = array(a)
+    return _invoke(lambda x: jnp.nanargmax(x, axis=axis), [a],
+                   "_npi_nanargmax")
+
+
+def nanargmin(a, axis=None):
+    if not isinstance(a, NDArray):
+        a = array(a)
+    return _invoke(lambda x: jnp.nanargmin(x, axis=axis), [a],
+                   "_npi_nanargmin")
+
+
+def hanning(M, dtype="float32", ctx=None):
+    return array(jnp.hanning(int(M)).astype(_to_jax_dtype(dtype)), ctx=ctx)
+
+
+def hamming(M, dtype="float32", ctx=None):
+    return array(jnp.hamming(int(M)).astype(_to_jax_dtype(dtype)), ctx=ctx)
+
+
+def blackman(M, dtype="float32", ctx=None):
+    return array(jnp.blackman(int(M)).astype(_to_jax_dtype(dtype)),
+                 ctx=ctx)
+
+
+def bartlett(M, dtype="float32", ctx=None):
+    return array(jnp.bartlett(int(M)).astype(_to_jax_dtype(dtype)),
+                 ctx=ctx)
+
+
+def polyval(p, x):
+    p = p if isinstance(p, NDArray) else array(p)
+    x = x if isinstance(x, NDArray) else array(x)
+    return _invoke(lambda pp, xx: jnp.polyval(pp.astype(jnp.float32),
+                                              xx.astype(jnp.float32)),
+                   [p, x], "_npi_polyval")
+
+
+def ediff1d(ary, to_end=None, to_begin=None):
+    ary = ary if isinstance(ary, NDArray) else array(ary)
+    return _invoke(lambda x: jnp.ediff1d(
+        x, to_end=None if to_end is None else jnp.asarray(to_end),
+        to_begin=None if to_begin is None else jnp.asarray(to_begin)),
+        [ary], "_npi_ediff1d")
+
+
+def nan_to_num(x, copy=True, nan=0.0, posinf=None, neginf=None):
+    x = x if isinstance(x, NDArray) else array(x)
+    return _invoke(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf,
+                                            neginf=neginf),
+                   [x], "_npi_nan_to_num")
+
+
+def digitize(x, bins, right=False):
+    x = x if isinstance(x, NDArray) else array(x)
+    bins = bins if isinstance(bins, NDArray) else array(bins)
+    return _invoke(lambda a, b: jnp.digitize(a, b, right=right),
+                   [x, bins], "_npi_digitize")
+
+
+def trapz(y, x=None, dx=1.0, axis=-1):
+    y = y if isinstance(y, NDArray) else array(y)
+    if x is None:
+        return _invoke(lambda a: jnp.trapezoid(
+            a.astype(jnp.float32), dx=dx, axis=axis), [y], "_npi_trapz")
+    x = x if isinstance(x, NDArray) else array(x)
+    return _invoke(lambda a, b: jnp.trapezoid(
+        a.astype(jnp.float32), b.astype(jnp.float32), axis=axis),
+        [y, x], "_npi_trapz")
+
+
+def isin(element, test_elements, assume_unique=False, invert=False):
+    element = element if isinstance(element, NDArray) else array(element)
+    test_elements = test_elements if isinstance(test_elements, NDArray) \
+        else array(test_elements)
+    return _invoke(lambda e, t: jnp.isin(e, t, invert=invert),
+                   [element, test_elements], "_npi_isin")
+
+
+def in1d(ar1, ar2, assume_unique=False, invert=False):
+    return isin(ar1, ar2, assume_unique=assume_unique,
+                invert=invert).reshape(-1)
+
+
+def _set_op(onp_name):
+    onp_fn = getattr(_onp, onp_name)
+
+    def f(ar1, ar2, assume_unique=False):
+        a = ar1.asnumpy() if isinstance(ar1, NDArray) else _onp.asarray(ar1)
+        b = ar2.asnumpy() if isinstance(ar2, NDArray) else _onp.asarray(ar2)
+        # data-dependent output size: host computation, like the
+        # reference's CPU-only set ops
+        return array(onp_fn(a, b, assume_unique=assume_unique)
+                     if onp_name != "union1d" else onp_fn(a, b))
+
+    f.__name__ = onp_name
+    return f
+
+
+intersect1d = _set_op("intersect1d")
+union1d = _set_op("union1d")
+setdiff1d = _set_op("setdiff1d")
+setxor1d = _set_op("setxor1d")
+
+
+for _extra in ("copysign", "fmod", "heaviside", "gcd", "lcm",
+               "logaddexp", "hypot", "nextafter"):
+    if _extra not in globals():
+        globals()[_extra] = _make_binary(_extra)
+for _extra in ("deg2rad", "rad2deg", "signbit", "cbrt", "positive",
+               "fabs", "spacing"):
+    if _extra not in globals() and hasattr(jnp, _extra):
+        globals()[_extra] = _make_unary(_extra)
+del _extra
+
+
 from . import linalg  # noqa: E402,F401
 from . import random  # noqa: E402,F401

@@ -459,3 +459,197 @@ register("_npi_delete")(lambda data, obj=None, start=None, stop=None,
 register_invoke_override("_npi_unique", _unique_override)
 register_invoke_override("_npx_nonzero", _nonzero_override)
 register_invoke_override("_npi_delete", _delete_override)
+
+
+# ---------------------------------------------------------------------------
+# statistics wave (reference: python/mxnet/numpy/multiarray.py percentile/
+# quantile/histogram + src/operator/numpy/np_percentile_op.cc etc.)
+# ---------------------------------------------------------------------------
+
+def _as_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@register("_npi_percentile")
+def _npi_percentile(a, q=50.0, axis=None, interpolation="linear",
+                    keepdims=False):
+    qv = jnp.asarray(q, jnp.float32)
+    return jnp.percentile(a.astype(jnp.float32), qv, axis=_as_axis(axis),
+                          method=str(interpolation),
+                          keepdims=bool(keepdims))
+
+
+@register("_npi_quantile")
+def _npi_quantile(a, q=0.5, axis=None, interpolation="linear",
+                  keepdims=False):
+    qv = jnp.asarray(q, jnp.float32)
+    return jnp.quantile(a.astype(jnp.float32), qv, axis=_as_axis(axis),
+                        method=str(interpolation), keepdims=bool(keepdims))
+
+
+@register("_npi_median")
+def _npi_median(a, axis=None, keepdims=False):
+    return jnp.median(a.astype(jnp.float32), axis=_as_axis(axis),
+                      keepdims=bool(keepdims))
+
+
+@register("_npi_histogram", num_outputs=2)
+def _npi_histogram(data, bin_cnt=10, range=None):
+    lo, hi = (float(range[0]), float(range[1])) if range is not None \
+        else (None, None)
+    if lo is None:
+        # dynamic range still jit-safe: min/max are reductions
+        lo_v = jnp.min(data).astype(jnp.float32)
+        hi_v = jnp.max(data).astype(jnp.float32)
+    else:
+        lo_v, hi_v = jnp.float32(lo), jnp.float32(hi)
+    counts, edges = jnp.histogram(
+        data.astype(jnp.float32), bins=int(bin_cnt), range=(lo_v, hi_v))
+    return counts.astype(jnp.int64), edges
+
+
+@register("_npi_cov")
+def _npi_cov(m, rowvar=True, bias=False, ddof=None):
+    return jnp.cov(m.astype(jnp.float32), rowvar=bool(rowvar),
+                   bias=bool(bias),
+                   ddof=None if ddof is None else int(ddof))
+
+
+@register("_npi_corrcoef")
+def _npi_corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x.astype(jnp.float32), rowvar=bool(rowvar))
+
+
+@register("_npi_ptp")
+def _npi_ptp(a, axis=None, keepdims=False):
+    return jnp.ptp(a, axis=_as_axis(axis), keepdims=bool(keepdims))
+
+
+for _name, _jfn in [("nanmean", jnp.nanmean), ("nanstd", jnp.nanstd),
+                    ("nanvar", jnp.nanvar)]:
+    def _mk_nan(jfn):
+        def f(a, axis=None, ddof=0, keepdims=False):
+            kw = {"axis": _as_axis(axis), "keepdims": bool(keepdims)}
+            if jfn is not jnp.nanmean:
+                kw["ddof"] = int(ddof)
+            return jfn(a.astype(jnp.float32), **kw)
+        return f
+    register("_npi_" + _name)(_mk_nan(_jfn))
+
+for _name, _jfn in [("nanmax", jnp.nanmax), ("nanmin", jnp.nanmin),
+                    ("nansum", jnp.nansum), ("nanprod", jnp.nanprod)]:
+    def _mk_nan2(jfn):
+        def f(a, axis=None, keepdims=False):
+            return jfn(a, axis=_as_axis(axis), keepdims=bool(keepdims))
+        return f
+    register("_npi_" + _name)(_mk_nan2(_jfn))
+
+register("_npi_nanargmax")(lambda a, axis=None: jnp.nanargmax(
+    a, axis=None if axis is None else int(axis)))
+register("_npi_nanargmin")(lambda a, axis=None: jnp.nanargmin(
+    a, axis=None if axis is None else int(axis)))
+
+
+# ---------------------------------------------------------------------------
+# window functions (reference: src/operator/numpy/np_window_op.cc)
+# ---------------------------------------------------------------------------
+
+register("_npi_bartlett")(lambda M=10, ctx=None, dtype="float32":
+                          jnp.bartlett(int(M)).astype(jnp.dtype(dtype)))
+
+
+# ---------------------------------------------------------------------------
+# misc numpy wave
+# ---------------------------------------------------------------------------
+
+@register("_npi_polyval", inputs=("p", "x"))
+def _npi_polyval(p, x):
+    return jnp.polyval(p.astype(jnp.float32), x.astype(jnp.float32))
+
+
+@register("_npi_ediff1d", inputs=("data", "to_end", "to_begin"))
+def _npi_ediff1d(data, to_end=None, to_begin=None):
+    return jnp.ediff1d(data, to_end=to_end, to_begin=to_begin)
+
+
+@register("_npi_digitize", inputs=("x", "bins"))
+def _npi_digitize(x, bins, right=False):
+    return jnp.digitize(x, bins, right=bool(right)).astype(jnp.int64)
+
+
+@register("_npi_trapz", inputs=("y", "x"))
+def _npi_trapz(y, x=None, dx=1.0, axis=-1):
+    if x is None:
+        return jnp.trapezoid(y.astype(jnp.float32), dx=float(dx),
+                             axis=int(axis))
+    return jnp.trapezoid(y.astype(jnp.float32),
+                         x.astype(jnp.float32), axis=int(axis))
+
+
+@register("_npi_cross", inputs=("a", "b"))
+def _npi_cross(a, b, axisa=-1, axisb=-1, axisc=-1, axis=None):
+    if axis is not None:
+        axisa = axisb = axisc = int(axis)
+    return jnp.cross(a, b, axisa=int(axisa), axisb=int(axisb),
+                     axisc=int(axisc))
+
+
+for _name in ("fmod", "heaviside", "logaddexp", "nextafter"):
+    def _mk_bin(jfn):
+        def f(a, b):
+            return jfn(a, b)
+        return f
+    register("_npi_" + _name, inputs=("a", "b"))(
+        _mk_bin(getattr(jnp, _name)))
+
+register("_npi_gcd", inputs=("a", "b"))(
+    lambda a, b: jnp.gcd(a.astype(jnp.int32),
+                         jnp.asarray(b).astype(jnp.int32)))
+
+for _name in ("signbit", "spacing", "cbrt", "positive", "fabs"):
+    if not hasattr(jnp, _name):
+        continue
+    def _mk_un(jfn):
+        def f(a):
+            return jfn(a)
+        return f
+    register("_npi_" + _name)(_mk_un(getattr(jnp, _name)))
+
+
+# ---------------------------------------------------------------------------
+# set ops: output shapes are data-dependent -> host path (same stance as
+# _npi_unique above; reference computes these on CPU too)
+# ---------------------------------------------------------------------------
+
+def _set_op_override(onp_fn, n_in=2):
+    def handler(inputs, attrs, out):
+        import numpy as onp
+
+        args = [x.asnumpy() for x in inputs[:n_in] if x is not None]
+        kwargs = {}
+        if attrs.get("assume_unique"):
+            kwargs["assume_unique"] = True
+        res = onp_fn(*args, **kwargs)
+        return inputs[0]._op_result_cls(jnp.asarray(res))
+    return handler
+
+
+import numpy as _host_np  # noqa: E402
+
+for _name, _fn in [("intersect1d", _host_np.intersect1d),
+                   ("union1d", _host_np.union1d),
+                   ("setdiff1d", _host_np.setdiff1d),
+                   ("setxor1d", _host_np.setxor1d)]:
+    register("_npi_" + _name, inputs=("a", "b"))(
+        lambda a, b, assume_unique=False: a)
+    register_invoke_override("_npi_" + _name, _set_op_override(_fn))
+
+
+@register("_npi_isin", inputs=("element", "test_elements"))
+def _npi_isin(element, test_elements, assume_unique=False, invert=False):
+    # static output shape (same as element) -> jit-safe
+    return jnp.isin(element, test_elements, invert=bool(invert))

@@ -118,8 +118,6 @@ def _send(sock, cmd, *fields):
             # asarray(order="C") keeps 0-d shapes; ascontiguousarray
             # would promote () to (1,)
             v = np.asarray(v, order="C")
-            if not v.flags.c_contiguous:
-                v = np.ascontiguousarray(v)
             out += b"T" + struct.pack("<B", len(str(v.dtype))) \
                 + str(v.dtype).encode() \
                 + struct.pack("<B", v.ndim) \
@@ -266,8 +264,7 @@ def _optimizer_to_config(optimizer):
             "server-side optimizer with an lr_scheduler is not "
             "serializable over the wire; schedule worker-side instead")
     def scalar(x):
-        if isinstance(x, (bool,) + _JSONABLE[:1]) or x is None \
-                or isinstance(x, (float, str)):
+        if isinstance(x, _JSONABLE):
             return x
         if isinstance(x, np.integer):
             return int(x)

@@ -281,3 +281,119 @@ def test_np_extras(name, args):
     mx_out = getattr(np, name)(*mx_args)
     np_out = getattr(onp, name)(*args)
     _check(mx_out, np_out, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# round-3 wave: statistics / set ops / windows / misc (reference:
+# test_numpy_interoperability.py slices for these families)
+# ---------------------------------------------------------------------------
+
+_NAN = onp.array([[1.0, onp.nan, 3.0], [4.0, 5.0, onp.nan]], onp.float32)
+
+_STATS_WAVE = [
+    ("percentile", (_A, 30.0)),
+    ("quantile", (_A, 0.3)),
+    ("ptp", (_A,)),
+    ("nanmean", (_NAN,)),
+    ("nanstd", (_NAN,)),
+    ("nanvar", (_NAN,)),
+    ("nanmax", (_NAN,)),
+    ("nanmin", (_NAN,)),
+    ("nanargmax", (_NAN,)),
+    ("nanargmin", (_NAN,)),
+    ("corrcoef", (_A,)),
+    ("cov", (_A,)),
+    ("polyval", (onp.array([1.0, -2.0, 1.0], onp.float32), _V)),
+    ("ediff1d", (_V,)),
+    ("nan_to_num", (_NAN,)),
+    ("trapz", (_V,)),
+    ("isin", (_A, onp.array([0.3, 1.0], onp.float32))),
+    ("intersect1d", (onp.array([1.0, 2.0, 5.0], onp.float32),
+                     onp.array([2.0, 5.0, 7.0], onp.float32))),
+    ("union1d", (onp.array([1.0, 2.0], onp.float32),
+                 onp.array([2.0, 3.0], onp.float32))),
+    ("setdiff1d", (onp.array([1.0, 2.0, 5.0], onp.float32),
+                   onp.array([2.0], onp.float32))),
+    ("setxor1d", (onp.array([1.0, 2.0, 5.0], onp.float32),
+                  onp.array([2.0, 7.0], onp.float32))),
+    ("fmod", (_A, _B)),
+    ("gcd", (onp.array([12, 18]), onp.array([8, 12]))),
+    ("heaviside", (_A - 1.0, onp.float32(0.5))),
+    ("nextafter", (_A, _B)),
+    ("deg2rad", (_A,)),
+    ("rad2deg", (_A,)),
+    ("signbit", (_A - 1.0,)),
+]
+
+
+@pytest.mark.parametrize("name,args", _STATS_WAVE,
+                         ids=[n for n, _ in _STATS_WAVE])
+def test_stats_wave_interop(name, args):
+    mx_args = [np.array(a) if isinstance(a, onp.ndarray) else a
+               for a in args]
+    mx_out = getattr(np, name)(*mx_args)
+    np_out = getattr(onp, name)(*args)
+    _check(mx_out, np_out, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["hanning", "hamming", "blackman",
+                                  "bartlett"])
+def test_window_functions(name):
+    got = getattr(np, name)(8).asnumpy()
+    want = getattr(onp, name)(8)
+    onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_histogram_matches_numpy():
+    data = onp.array([1.0, 2.0, 2.0, 3.0, 9.0], onp.float32)
+    c, e = np.histogram(np.array(data), bins=4, range=(0.0, 8.0))
+    wc, we = onp.histogram(data, bins=4, range=(0.0, 8.0))
+    onp.testing.assert_allclose(c.asnumpy(), wc)
+    onp.testing.assert_allclose(e.asnumpy(), we, rtol=1e-6)
+
+
+def test_digitize_matches_numpy():
+    x = onp.array([0.2, 6.4, 3.0, 1.6], onp.float32)
+    bins = onp.array([0.0, 1.0, 2.5, 4.0, 10.0], onp.float32)
+    got = np.digitize(np.array(x), np.array(bins)).asnumpy()
+    onp.testing.assert_array_equal(got, onp.digitize(x, bins))
+
+
+def test_npi_registry_ops_callable_from_nd():
+    """The _npi_* backing ops are part of the operator surface (usable
+    via mx.nd and symbols), not just mx.np sugar."""
+    from mxnet_tpu import nd
+
+    out = nd._npi_percentile(nd.array(_A), q=40.0)
+    onp.testing.assert_allclose(out.asnumpy(), onp.percentile(_A, 40.0),
+                                rtol=1e-5)
+    c, e = nd._npi_histogram(nd.array(_V), bin_cnt=3, range=(0.0, 1.0))
+    wc, we = onp.histogram(_V, bins=3, range=(0.0, 1.0))
+    onp.testing.assert_allclose(c.asnumpy(), wc)
+    h = nd._npi_hanning(M=6)
+    onp.testing.assert_allclose(h.asnumpy(), onp.hanning(6), rtol=1e-5,
+                                atol=1e-6)
+
+
+def test_array_function_protocol_dispatch_new_wave():
+    """onp.percentile(mx_array) routes through __array_function__
+    (reference test_numpy_interoperability.py protocol slice)."""
+    a = np.array(_A)
+    out = onp.percentile(a, 60.0)
+    assert abs(float(out) - float(onp.percentile(_A, 60.0))) < 1e-4
+    out = onp.nanmean(np.array(_NAN))
+    assert abs(float(out) - float(onp.nanmean(_NAN))) < 1e-5
+    out = onp.ptp(a)
+    assert abs(float(out) - float(onp.ptp(_A))) < 1e-6
+
+
+def test_array_ufunc_protocol_dispatch_new_wave():
+    a = np.array(_A)
+    b = np.array(_B)
+    out = onp.fmod(a, b)
+    assert isinstance(out, np.ndarray)
+    onp.testing.assert_allclose(out.asnumpy(), onp.fmod(_A, _B),
+                                rtol=1e-5)
+    out = onp.hypot(a, b)
+    onp.testing.assert_allclose(out.asnumpy(), onp.hypot(_A, _B),
+                                rtol=1e-5)

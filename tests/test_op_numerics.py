@@ -1194,6 +1194,83 @@ EXCLUDED = {
 COVERED_ELSEWHERE = set(_WAVE_TESTED) | set(_WAVE_EXCLUDED)
 
 
+
+# round-3 numpy wave: statistics / set / window / misc
+_NANA = np.array([[1.0, np.nan, 3.0], [4.0, 5.0, np.nan]], np.float32)
+SPECS["_npi_percentile"] = S([_A], {"q": 30.0},
+                             ref=lambda x: np.percentile(x, 30.0))
+SPECS["_npi_quantile"] = S([_A], {"q": 0.3},
+                           ref=lambda x: np.quantile(x, 0.3))
+SPECS["_npi_median"] = S([_A], ref=lambda x: np.median(x))
+SPECS["_npi_histogram"] = S(
+    [np.array([1.0, 2.0, 2.0, 3.0], np.float32)],
+    {"bin_cnt": 3, "range": (0.0, 4.0)},
+    check=lambda outs, ins: np.allclose(
+        np.asarray(outs[0]),
+        np.histogram(np.asarray(ins[0]), bins=3, range=(0.0, 4.0))[0]))
+SPECS["_npi_cov"] = S([_A], ref=lambda m: np.cov(m))
+SPECS["_npi_corrcoef"] = S([_A], ref=lambda m: np.corrcoef(m))
+SPECS["_npi_ptp"] = S([_A], ref=lambda x: np.ptp(x), grad=True)
+SPECS["_npi_nanmean"] = S([_NANA], ref=lambda x: np.nanmean(x))
+SPECS["_npi_nanstd"] = S([_NANA], ref=lambda x: np.nanstd(x))
+SPECS["_npi_nanvar"] = S([_NANA], ref=lambda x: np.nanvar(x))
+SPECS["_npi_nanmax"] = S([_NANA], ref=lambda x: np.nanmax(x))
+SPECS["_npi_nanmin"] = S([_NANA], ref=lambda x: np.nanmin(x))
+SPECS["_npi_nansum"] = S([_NANA], ref=lambda x: np.nansum(x))
+SPECS["_npi_nanprod"] = S([_NANA], ref=lambda x: np.nanprod(x))
+SPECS["_npi_nanargmax"] = S([_NANA], ref=lambda x: np.nanargmax(x))
+SPECS["_npi_nanargmin"] = S([_NANA], ref=lambda x: np.nanargmin(x))
+SPECS["_npi_bartlett"] = S([], {"M": 7}, ref=lambda: np.bartlett(7))
+SPECS["_npi_polyval"] = S(
+    [np.array([1.0, -2.0, 1.0], np.float32),
+     np.array([0.5, 1.5], np.float32)],
+    ref=lambda p, x: np.polyval(p, x), grad=True)
+SPECS["_npi_ediff1d"] = S([np.array([1.0, 4.0, 9.0], np.float32)],
+                          ref=lambda x: np.ediff1d(x))
+SPECS["_npi_digitize"] = S(
+    [np.array([0.5, 2.5, 9.0], np.float32),
+     np.array([1.0, 2.0, 3.0], np.float32)],
+    ref=lambda x, b: np.digitize(x, b))
+SPECS["_npi_trapz"] = S([np.array([1.0, 2.0, 4.0], np.float32)],
+                        ref=lambda y: np.trapz(y))
+SPECS["_npi_cross"] = S(
+    [np.array([1.0, 0.0, 0.0], np.float32),
+     np.array([0.0, 1.0, 0.0], np.float32)],
+    ref=lambda a, b: np.cross(a, b), grad=True)
+SPECS["_npi_fmod"] = S([_A, _B + 0.7], ref=lambda a, b: np.fmod(a, b))
+SPECS["_npi_gcd"] = S([np.array([12.0, 18.0], np.float32),
+                       np.array([8.0, 12.0], np.float32)],
+                      check=lambda outs, ins: np.allclose(
+                          np.asarray(outs[0]), [4, 6]))
+SPECS["_npi_heaviside"] = S([_A - 1.0, np.array(0.5, np.float32)],
+                            ref=lambda a, b: np.heaviside(a, b))
+SPECS["_npi_logaddexp"] = S([_A, _B], ref=lambda a, b: np.logaddexp(a, b),
+                            grad=True)
+SPECS["_npi_nextafter"] = S([_A, _B], ref=lambda a, b: np.nextafter(a, b))
+SPECS["_npi_signbit"] = S([_A - 1.0], ref=lambda x: np.signbit(x))
+SPECS["_npi_cbrt"] = S([_A], ref=lambda x: np.cbrt(x), grad=True)
+SPECS["_npi_fabs"] = S([_A - 1.0], ref=lambda x: np.fabs(x))
+SPECS["_npi_positive"] = S([_A], ref=lambda x: +x, grad=True)
+SPECS["_npi_spacing"] = S([_A], ref=lambda x: np.spacing(x))
+SPECS["_npi_isin"] = S(
+    [np.array([1.0, 2.0, 5.0], np.float32),
+     np.array([2.0, 5.0], np.float32)],
+    ref=lambda e, t: np.isin(e, t))
+SPECS["_npi_intersect1d"] = S(
+    [np.array([1.0, 2.0, 5.0], np.float32),
+     np.array([2.0, 5.0, 9.0], np.float32)],
+    ref=lambda a, b: np.intersect1d(a, b))
+SPECS["_npi_union1d"] = S(
+    [np.array([1.0, 2.0], np.float32), np.array([2.0, 3.0], np.float32)],
+    ref=lambda a, b: np.union1d(a, b))
+SPECS["_npi_setdiff1d"] = S(
+    [np.array([1.0, 2.0, 5.0], np.float32), np.array([2.0], np.float32)],
+    ref=lambda a, b: np.setdiff1d(a, b))
+SPECS["_npi_setxor1d"] = S(
+    [np.array([1.0, 2.0, 5.0], np.float32),
+     np.array([2.0, 7.0], np.float32)],
+    ref=lambda a, b: np.setxor1d(a, b))
+
 def _all_specs():
     for name, spec in sorted(SPECS.items()):
         specs = spec if isinstance(spec, list) else [spec]
