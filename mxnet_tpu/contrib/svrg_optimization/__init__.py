@@ -1,0 +1,2 @@
+"""SVRG optimization (parity: ``python/mxnet/contrib/svrg_optimization``)."""
+from .svrg_module import SVRGModule  # noqa: F401

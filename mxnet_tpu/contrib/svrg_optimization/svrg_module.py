@@ -1,0 +1,171 @@
+"""SVRG training module (parity: contrib/svrg_optimization/svrg_module.py).
+
+SVRG (Johnson & Zhang 2013) reduces gradient variance: every
+``update_freq`` epochs the module snapshots the weights w̃ and computes
+the FULL-dataset gradient ḡ at w̃; each minibatch step then uses the
+corrected gradient  g_i(w) − g_i(w̃) + ḡ.
+
+The reference wires this through a wrapper optimizer and special KVStore
+keys (``svrg_optimizer.py`` ``_SVRGOptimizer``/``_AssignmentOptimizer``).
+TPU-native mechanism: a second internal Module holds the snapshot
+weights, both modules' forward/backward are fused jitted executables,
+and the correction is applied directly to the gradient buffers before
+the optimizer step — no KVStore round-trip, identical math.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ...base import MXNetError
+from ...module.module import Module
+from ... import ndarray as nd
+
+
+class SVRGModule(Module):
+    """Module with Stochastic Variance Reduced Gradient updates
+    (parity: svrg_module.py:30 SVRGModule).
+
+    Parameters beyond ``Module``: ``update_freq`` — number of epochs
+    between full-gradient snapshots.
+    """
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=None,
+                 context=None, update_freq=1, **kwargs):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, **kwargs)
+        if not isinstance(update_freq, int) or update_freq < 1:
+            raise MXNetError("update_freq must be a positive integer")
+        self.update_freq = update_freq
+        self._mod_aux = Module(symbol, data_names=data_names,
+                               label_names=label_names, **kwargs)
+        self._param_dict = None
+        self._ctx_len = 1
+
+    # -- lifecycle --------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        super().bind(data_shapes, label_shapes, for_training,
+                     inputs_need_grad, force_rebind, shared_module,
+                     grad_req)
+        if for_training:
+            self._mod_aux.bind(data_shapes, label_shapes, for_training,
+                               inputs_need_grad, force_rebind, None,
+                               grad_req)
+
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False,
+                    force_init=False, allow_extra=False):
+        super().init_params(initializer, arg_params, aux_params,
+                            allow_missing, force_init, allow_extra)
+        self._sync_aux_params()
+        # full-gradient accumulators, one per parameter
+        self._param_dict = {
+            name: nd.zeros(self._exec_group._exec.arg_dict[name].shape)
+            for name in self._exec_group.param_names}
+
+    def _sync_aux_params(self):
+        """Copy current weights into the snapshot module (w̃ ← w)."""
+        arg, aux = self.get_params()
+        self._mod_aux.init_params(arg_params=arg, aux_params=aux,
+                                  allow_missing=False, force_init=True)
+
+    # -- SVRG mechanics ---------------------------------------------------
+    def update_full_grads(self, train_data):
+        """Compute the full-dataset gradient at the snapshot weights
+        (parity: svrg_module.py:292)."""
+        self._sync_aux_params()
+        train_data.reset()
+        nbatch = 0
+        totals = {n: None for n in self._exec_group.param_names}
+        for batch in train_data:
+            self._mod_aux.forward(batch, is_train=True)
+            self._mod_aux.backward()
+            gdict = self._mod_aux._exec_group._exec.grad_dict
+            for name in totals:
+                g = gdict.get(name)
+                if g is None:
+                    continue
+                acc = totals[name]
+                totals[name] = g.copy() if acc is None else acc + g
+            nbatch += 1
+        if nbatch == 0:
+            raise MXNetError("update_full_grads: empty data iterator")
+        for name, acc in totals.items():
+            if acc is not None:
+                self._param_dict[name] = acc / nbatch
+        train_data.reset()
+
+    def forward_backward(self, data_batch):
+        """Forward+backward with the SVRG gradient correction applied in
+        place (parity: svrg_module.py fit_ inner loop)."""
+        self.forward(data_batch, is_train=True)
+        self.backward()
+        self._mod_aux.forward(data_batch, is_train=True)
+        self._mod_aux.backward()
+        exec_ = self._exec_group._exec
+        aux_exec = self._mod_aux._exec_group._exec
+        for name in self._exec_group.param_names:
+            g = exec_.grad_dict.get(name)
+            if g is None:
+                continue
+            g_tilde = aux_exec.grad_dict.get(name)
+            corrected = g - g_tilde + self._param_dict[name]
+            g._set_data(corrected.data())
+
+    # -- reference-style fit ---------------------------------------------
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.01),),
+            initializer=None, num_epoch=None,
+            validation_metric=None, force_init=False):
+        """Train with periodic full-gradient snapshots (parity:
+        svrg_module.py:400 fit)."""
+        from ... import metric as metric_mod
+        from ... import initializer as init_mod
+
+        assert num_epoch is not None, "please specify number of epochs"
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True)
+        self.init_params(initializer=initializer
+                         or init_mod.Uniform(0.01),
+                         force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        for epoch in range(num_epoch):
+            if epoch % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, batch in enumerate(train_data):
+                self.forward_backward(batch)
+                self.update()
+                self.update_metric(eval_metric, batch.label)
+                if batch_end_callback is not None:
+                    from ...model import BatchEndParam
+
+                    cbs = batch_end_callback \
+                        if isinstance(batch_end_callback, (list, tuple)) \
+                        else [batch_end_callback]
+                    param = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                          eval_metric=eval_metric,
+                                          locals=locals())
+                    for cb in cbs:
+                        cb(param)
+            if epoch_end_callback is not None:
+                arg, aux = self.get_params()
+                cbs = epoch_end_callback \
+                    if isinstance(epoch_end_callback, (list, tuple)) \
+                    else [epoch_end_callback]
+                for cb in cbs:
+                    cb(epoch, self._symbol, arg, aux)
+            logging.getLogger(__name__).info(
+                "Epoch[%d] SVRG train %s", epoch,
+                dict(eval_metric.get_name_value()))

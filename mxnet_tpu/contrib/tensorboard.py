@@ -1,0 +1,46 @@
+"""TensorBoard metric logging (parity: contrib/tensorboard.py).
+
+The reference bridges to ``mxboard``; this image ships ``tensorboardX``,
+which exposes the same ``SummaryWriter.add_scalar`` API — the callback
+degrades to a logged error when neither is importable, exactly like the
+reference's mxboard-missing path.
+"""
+from __future__ import annotations
+
+import logging
+
+
+class LogMetricsCallback:
+    """Log eval-metric values per epoch to a TensorBoard event file
+    (parity: contrib/tensorboard.py:25 LogMetricsCallback).
+
+    Use as ``batch_end_callback``/``eval_end_callback`` with
+    ``Module.fit`` or as an Estimator event handler — any callable fed
+    a param object carrying ``eval_metric`` works.
+    """
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.summary_writer = None
+        try:
+            try:
+                from mxboard import SummaryWriter
+            except ImportError:
+                from tensorboardX import SummaryWriter
+            self.summary_writer = SummaryWriter(logging_dir)
+        except ImportError:
+            logging.error(
+                "You can install mxboard via `pip install mxboard` or "
+                "tensorboardX via `pip install tensorboardX`.")
+
+    def __call__(self, param):
+        """Write each (name, value) of ``param.eval_metric``."""
+        if self.summary_writer is None:
+            return
+        if getattr(param, "eval_metric", None) is None:
+            return
+        step = getattr(param, "epoch", 0)
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value, global_step=step)

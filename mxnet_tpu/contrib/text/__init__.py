@@ -1,0 +1,6 @@
+"""Text token indexing + embeddings (parity:
+``python/mxnet/contrib/text/``)."""
+from . import utils  # noqa: F401
+from . import vocab  # noqa: F401
+from . import embedding  # noqa: F401
+from .vocab import Vocabulary  # noqa: F401

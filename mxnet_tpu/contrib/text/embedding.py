@@ -1,0 +1,298 @@
+"""Pretrained token embeddings (parity: contrib/text/embedding.py).
+
+Same composable API as the reference: a registry of embedding classes
+(``register``/``create``/``get_pretrained_file_names``), a
+``_TokenEmbedding`` base that extends ``Vocabulary`` with an
+``idx_to_vec`` matrix, file-format loaders (one token + vector per line),
+``CustomEmbedding`` for arbitrary local files, and ``CompositeEmbedding``
+to stack several embeddings over one vocabulary.
+
+This image has zero network egress, so ``GloVe``/``FastText`` resolve
+their pretrained files from ``embedding_root`` ONLY (the reference's
+download step becomes "file must already be on disk" — same cache
+layout, no silent network I/O).
+"""
+from __future__ import annotations
+
+import io
+import logging
+import os
+
+import numpy as np
+
+from ...base import MXNetError
+from ... import ndarray as nd
+from .vocab import Vocabulary
+
+_REGISTRY = {}
+
+
+def register(embedding_cls):
+    """Register an embedding class under its lowercase name
+    (parity: embedding.py:43)."""
+    _REGISTRY[embedding_cls.__name__.lower()] = embedding_cls
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    """Instantiate a registered embedding (parity: embedding.py:66)."""
+    name = embedding_name.lower()
+    if name not in _REGISTRY:
+        raise MXNetError(
+            "Cannot find `embedding_name` %s. Use get_pretrained_file_names"
+            "() to get all the valid embedding names." % embedding_name)
+    return _REGISTRY[name](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Known pretrained file names per embedding (parity:
+    embedding.py:93)."""
+    if embedding_name is not None:
+        name = embedding_name.lower()
+        if name not in _REGISTRY:
+            raise MXNetError(
+                "Cannot find `embedding_name` %s." % embedding_name)
+        return list(_REGISTRY[name].pretrained_file_name_sha1)
+    return {n: list(c.pretrained_file_name_sha1)
+            for n, c in _REGISTRY.items()}
+
+
+class _TokenEmbedding(Vocabulary):
+    """Vocabulary + vector table (parity: embedding.py:136)."""
+
+    pretrained_file_name_sha1 = {}
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    @classmethod
+    def _get_pretrained_file(cls, embedding_root, pretrained_file_name):
+        embedding_root = os.path.expanduser(embedding_root)
+        embedding_dir = os.path.join(embedding_root,
+                                     cls.__name__.lower())
+        path = os.path.join(embedding_dir, pretrained_file_name)
+        if not os.path.isfile(path):
+            raise MXNetError(
+                "pretrained file %s not found under %s; this environment "
+                "has no network access — place the file there first"
+                % (pretrained_file_name, embedding_dir))
+        return path
+
+    def _load_embedding(self, pretrained_file_path, elem_delim,
+                        init_unknown_vec, encoding="utf8"):
+        """Parse one-token-per-line vectors (parity: embedding.py:235)."""
+        pretrained_file_path = os.path.expanduser(pretrained_file_path)
+        if not os.path.isfile(pretrained_file_path):
+            raise MXNetError(
+                "`pretrained_file_path` must be a valid path to the "
+                "pre-trained token embedding file.")
+        vec_len = None
+        all_elems = []
+        tokens = set()
+        loaded_unknown_vec = None
+        with io.open(pretrained_file_path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                elems = line.rstrip().split(elem_delim)
+                assert len(elems) > 1, (
+                    "line %d in %s: unexpected data format."
+                    % (line_num, pretrained_file_path))
+                token, elems = elems[0], [float(e) for e in elems[1:]]
+                if token == self.unknown_token and \
+                        loaded_unknown_vec is None:
+                    loaded_unknown_vec = elems
+                elif token in tokens:
+                    logging.warning(
+                        "duplicate embedding found for token %r; only the "
+                        "first occurrence is kept", token)
+                elif len(elems) == 1:
+                    # likely a header line (e.g. fastText "count dim");
+                    # reference skips any 1-dim vector with a warning
+                    logging.warning(
+                        "line %d: token %r with 1-dimensional vector is "
+                        "likely a header and is skipped", line_num, token)
+                else:
+                    if vec_len is None:
+                        vec_len = len(elems)
+                        # index 0 reserved for unknown_token
+                        all_elems.extend([0.0] * vec_len)
+                    else:
+                        assert len(elems) == vec_len, (
+                            "line %d in %s: inconsistent vector length"
+                            % (line_num, pretrained_file_path))
+                    all_elems.extend(elems)
+                    self._idx_to_token.append(token)
+                    self._token_to_idx[token] = \
+                        len(self._idx_to_token) - 1
+                    tokens.add(token)
+        self._vec_len = vec_len or 0
+        mat = np.asarray(all_elems, np.float32).reshape(
+            (-1, self._vec_len)) if self._vec_len else \
+            np.zeros((1, 0), np.float32)
+        if loaded_unknown_vec is None:
+            mat[0] = init_unknown_vec(shape=self._vec_len).asnumpy() \
+                if hasattr(init_unknown_vec(shape=self._vec_len),
+                           "asnumpy") \
+                else np.asarray(init_unknown_vec(shape=self._vec_len))
+        else:
+            mat[0] = np.asarray(loaded_unknown_vec, np.float32)
+        self._idx_to_vec = nd.array(mat)
+
+    def _index_tokens_from_vocabulary(self, vocabulary):
+        self._idx_to_token = vocabulary.idx_to_token[:]
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = None if vocabulary.reserved_tokens is None \
+            else vocabulary.reserved_tokens[:]
+
+    def _set_idx_to_vec_by_embeddings(self, token_embeddings, vocab_len,
+                                      vocab_idx_to_token):
+        """Compose vectors for a vocabulary from source embeddings
+        (parity: embedding.py:320)."""
+        new_vec_len = sum(e.vec_len for e in token_embeddings)
+        rows = np.zeros((vocab_len, new_vec_len), np.float32)
+        col_start = 0
+        for emb in token_embeddings:
+            col_end = col_start + emb.vec_len
+            rows[:, col_start:col_end] = emb.get_vecs_by_tokens(
+                list(vocab_idx_to_token)).asnumpy()
+            col_start = col_end
+        self._vec_len = new_vec_len
+        self._idx_to_vec = nd.array(rows)
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Vectors for token(s); unknowns get row 0
+        (parity: embedding.py:373)."""
+        to_reduce = False
+        if not isinstance(tokens, list):
+            tokens = [tokens]
+            to_reduce = True
+        if not lower_case_backup:
+            indices = [self.token_to_idx.get(t, 0) for t in tokens]
+        else:
+            indices = [self.token_to_idx[t] if t in self.token_to_idx
+                       else self.token_to_idx.get(t.lower(), 0)
+                       for t in tokens]
+        mat = self._idx_to_vec.asnumpy()[np.asarray(indices, np.int64)]
+        vecs = nd.array(mat)
+        return vecs[0] if to_reduce else vecs
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite vectors of existing tokens (parity:
+        embedding.py:418)."""
+        assert self._idx_to_vec is not None, \
+            "The property `idx_to_vec` has not been properly set."
+        if not isinstance(tokens, list) or len(tokens) == 1:
+            assert hasattr(new_vectors, "shape") and \
+                len(new_vectors.shape) in (1, 2), \
+                "`new_vectors` must be a 1-D or 2-D NDArray"
+            if not isinstance(tokens, list):
+                tokens = [tokens]
+        vecs = new_vectors.asnumpy().reshape(len(tokens), -1)
+        mat = self._idx_to_vec.asnumpy().copy()
+        for t, v in zip(tokens, vecs):
+            if t not in self.token_to_idx:
+                raise MXNetError(
+                    "token %r is unknown; only vectors of indexed tokens "
+                    "can be updated" % t)
+            mat[self.token_to_idx[t]] = v
+        self._idx_to_vec = nd.array(mat)
+
+    def _build_embedding_for_vocabulary(self, vocabulary):
+        """Re-index this embedding onto ``vocabulary`` (shared by every
+        concrete class; reference keeps it on _TokenEmbedding too)."""
+        emb = CompositeEmbedding(vocabulary, [self])
+        self._index_tokens_from_vocabulary(vocabulary)
+        self._vec_len = emb.vec_len
+        self._idx_to_vec = emb.idx_to_vec
+
+    @classmethod
+    def _check_pretrained_file_names(cls, pretrained_file_name):
+        if pretrained_file_name not in cls.pretrained_file_name_sha1:
+            raise MXNetError(
+                "Cannot find pretrained file %s for %s. Valid names: %s"
+                % (pretrained_file_name, cls.__name__,
+                   ", ".join(cls.pretrained_file_name_sha1)))
+
+
+@register
+class GloVe(_TokenEmbedding):
+    """GloVe embeddings from a local cache (parity: embedding.py:484)."""
+
+    pretrained_file_name_sha1 = {
+        n: "" for n in (
+            "glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+            "glove.6B.200d.txt", "glove.6B.300d.txt",
+            "glove.840B.300d.txt", "glove.twitter.27B.25d.txt",
+            "glove.twitter.27B.50d.txt", "glove.twitter.27B.100d.txt",
+            "glove.twitter.27B.200d.txt")}
+
+    def __init__(self, pretrained_file_name="glove.840B.300d.txt",
+                 embedding_root=os.path.join("~", ".mxnet", "embeddings"),
+                 init_unknown_vec=nd.zeros, vocabulary=None, **kwargs):
+        self._check_pretrained_file_names(pretrained_file_name)
+        super().__init__(**kwargs)
+        path = self._get_pretrained_file(embedding_root,
+                                         pretrained_file_name)
+        self._load_embedding(path, " ", init_unknown_vec)
+        if vocabulary is not None:
+            self._build_embedding_for_vocabulary(vocabulary)
+
+
+@register
+class FastText(_TokenEmbedding):
+    """fastText embeddings from a local cache (parity:
+    embedding.py:556)."""
+
+    pretrained_file_name_sha1 = {
+        n: "" for n in ("wiki.en.vec", "wiki.simple.vec", "wiki.zh.vec",
+                        "wiki.fr.vec", "wiki.de.vec", "wiki.es.vec")}
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec",
+                 embedding_root=os.path.join("~", ".mxnet", "embeddings"),
+                 init_unknown_vec=nd.zeros, vocabulary=None, **kwargs):
+        self._check_pretrained_file_names(pretrained_file_name)
+        super().__init__(**kwargs)
+        path = self._get_pretrained_file(embedding_root,
+                                         pretrained_file_name)
+        self._load_embedding(path, " ", init_unknown_vec)
+        if vocabulary is not None:
+            self._build_embedding_for_vocabulary(vocabulary)
+
+
+class CustomEmbedding(_TokenEmbedding):
+    """Embedding from any local token-vector file (parity:
+    embedding.py:638)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", init_unknown_vec=nd.zeros,
+                 vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim,
+                             init_unknown_vec, encoding)
+        if vocabulary is not None:
+            self._build_embedding_for_vocabulary(vocabulary)
+
+
+class CompositeEmbedding(_TokenEmbedding):
+    """Stack several embeddings over one vocabulary (parity:
+    embedding.py:680)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(token_embeddings, list):
+            token_embeddings = [token_embeddings]
+        for emb in token_embeddings:
+            assert isinstance(emb, _TokenEmbedding), \
+                "`token_embeddings` must be instances of _TokenEmbedding"
+        self._index_tokens_from_vocabulary(vocabulary)
+        self._set_idx_to_vec_by_embeddings(
+            token_embeddings, len(self), self.idx_to_token)

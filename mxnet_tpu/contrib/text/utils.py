@@ -1,0 +1,22 @@
+"""Text utilities (parity: python/mxnet/contrib/text/utils.py)."""
+from __future__ import annotations
+
+import collections
+import re
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Count tokens in a delimited string (parity: utils.py:28).
+
+    Returns a ``collections.Counter`` mapping token -> frequency; pass
+    ``counter_to_update`` to accumulate across documents.
+    """
+    source_str = re.split(token_delim + "|" + seq_delim, source_str)
+    tokens = [t for t in source_str if t]
+    if to_lower:
+        tokens = [t.lower() for t in tokens]
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    counter.update(tokens)
+    return counter

@@ -1,0 +1,107 @@
+"""Token indexing (parity: python/mxnet/contrib/text/vocab.py Vocabulary).
+
+Builds index maps from a frequency counter with the reference's exact
+ordering contract: unknown token at index 0, reserved tokens next, then
+counter keys by descending frequency (ties broken by insertion/__cmp__
+order) subject to ``most_freq_count`` / ``min_freq``.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+
+
+class Vocabulary:
+    """Indexing for text tokens (parity: vocab.py:30)."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise MXNetError("`min_freq` must be set to a positive value")
+        if reserved_tokens is not None:
+            reserved_set = set(reserved_tokens)
+            if unknown_token in reserved_set:
+                raise MXNetError(
+                    "`reserved_tokens` must not contain unknown_token")
+            if len(reserved_set) != len(reserved_tokens):
+                raise MXNetError(
+                    "`reserved_tokens` must not contain duplicates")
+        self._index_unknown_and_reserved_tokens(unknown_token,
+                                                reserved_tokens)
+        if counter is not None:
+            self._index_counter_keys(counter, unknown_token,
+                                     reserved_tokens, most_freq_count,
+                                     min_freq)
+
+    def _index_unknown_and_reserved_tokens(self, unknown_token,
+                                           reserved_tokens):
+        self._unknown_token = unknown_token
+        self._idx_to_token = [unknown_token]
+        if reserved_tokens is None:
+            self._reserved_tokens = None
+        else:
+            self._reserved_tokens = list(reserved_tokens)
+            self._idx_to_token.extend(reserved_tokens)
+        self._token_to_idx = {t: i for i, t in
+                              enumerate(self._idx_to_token)}
+
+    def _index_counter_keys(self, counter, unknown_token, reserved_tokens,
+                            most_freq_count, min_freq):
+        unknown_and_reserved = {unknown_token}
+        if reserved_tokens is not None:
+            unknown_and_reserved.update(reserved_tokens)
+        token_freqs = sorted(counter.items(), key=lambda x: x[0])
+        token_freqs.sort(key=lambda x: x[1], reverse=True)
+        token_cap = len(unknown_and_reserved) + (
+            len(counter) if most_freq_count is None else most_freq_count)
+        for token, freq in token_freqs:
+            if freq < min_freq or len(self._idx_to_token) == token_cap:
+                break
+            if token in unknown_and_reserved:
+                continue
+            self._idx_to_token.append(token)
+            self._token_to_idx[token] = len(self._idx_to_token) - 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token(s) → index/indices; unknown tokens map to index 0
+        (parity: vocab.py:162)."""
+        to_reduce = False
+        if not isinstance(tokens, list):
+            tokens = [tokens]
+            to_reduce = True
+        indices = [self._token_to_idx.get(t, 0) for t in tokens]
+        return indices[0] if to_reduce else indices
+
+    def to_tokens(self, indices):
+        """Index/indices → token(s) (parity: vocab.py:188)."""
+        to_reduce = False
+        if not isinstance(indices, list):
+            indices = [indices]
+            to_reduce = True
+        max_idx = len(self._idx_to_token) - 1
+        tokens = []
+        for idx in indices:
+            if not isinstance(idx, int) or idx > max_idx:
+                raise MXNetError(
+                    "Token index %r in the provided `indices` is invalid"
+                    % idx)
+            tokens.append(self._idx_to_token[idx])
+        return tokens[0] if to_reduce else tokens

@@ -16,6 +16,7 @@ from ..ops import misc as _m  # noqa: F401
 from ..ops import vision as _v  # noqa: F401
 from ..ops import quantized_ops as _q  # noqa: F401
 from ..ops import npi as _npi  # noqa: F401
+from ..ops import control_flow as _cf  # noqa: F401
 
 from .ndarray import (  # noqa: F401
     NDArray, array, empty, zeros, ones, full, arange, zeros_like, ones_like,

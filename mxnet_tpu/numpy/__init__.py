@@ -924,10 +924,15 @@ def ediff1d(ary, to_end=None, to_begin=None):
 
 
 def nan_to_num(x, copy=True, nan=0.0, posinf=None, neginf=None):
-    x = x if isinstance(x, NDArray) else array(x)
-    return _invoke(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf,
-                                            neginf=neginf),
-                   [x], "_npi_nan_to_num")
+    was_nd = isinstance(x, NDArray)
+    x = x if was_nd else array(x)
+    res = _invoke(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf,
+                                           neginf=neginf),
+                  [x], "_npi_nan_to_num")
+    if not copy and was_nd:
+        x._adopt(res)  # documented in-place contract
+        return x
+    return res
 
 
 def digitize(x, bins, right=False):
@@ -962,15 +967,16 @@ def in1d(ar1, ar2, assume_unique=False, invert=False):
 
 
 def _set_op(onp_name):
-    onp_fn = getattr(_onp, onp_name)
-
     def f(ar1, ar2, assume_unique=False):
-        a = ar1.asnumpy() if isinstance(ar1, NDArray) else _onp.asarray(ar1)
-        b = ar2.asnumpy() if isinstance(ar2, NDArray) else _onp.asarray(ar2)
-        # data-dependent output size: host computation, like the
-        # reference's CPU-only set ops
-        return array(onp_fn(a, b, assume_unique=assume_unique)
-                     if onp_name != "union1d" else onp_fn(a, b))
+        # single implementation lives on the registry op (host path for
+        # data-dependent output sizes, ops/npi.py _set_op_override)
+        a = ar1 if isinstance(ar1, NDArray) else array(ar1)
+        b = ar2 if isinstance(ar2, NDArray) else array(ar2)
+        out = _reg.invoke("_npi_" + onp_name, [a, b],
+                          {"assume_unique": assume_unique})
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        return _as_np(out)
 
     f.__name__ = onp_name
     return f

@@ -237,3 +237,60 @@ def cond(pred, then_func, else_func):
                     _branch(then_func), _branch(else_func))
     wrapped = [NDArray(o) for o in outs]
     return wrapped[0] if meta["single"] else wrapped
+
+
+# ---------------------------------------------------------------------------
+# op-name registration: the reference registers control flow as invokable
+# OPERATORS (`_foreach`/`_while_loop`/`_cond`, src/operator/control_flow.cc
+# :475-531) whose subgraphs arrive as attributes.  Here the subgraphs are
+# Python callables passed as attrs; dispatch runs through the imperative
+# override hook because the bodies drive tracing themselves (a jitted
+# wrapper cannot close over arbitrary Python control flow).
+# ---------------------------------------------------------------------------
+
+
+def _foreach_op_override(inputs, attrs, out):
+    body = attrs.get("body")
+    if not callable(body):
+        raise MXNetError(
+            "_foreach: pass body= (callable) — op-name form of "
+            "nd.contrib.foreach")
+    n_data = int(attrs.get("num_data", 1))
+    data = list(inputs[:n_data])
+    states = list(inputs[n_data:])
+    if not data:
+        raise MXNetError("_foreach: needs at least one data input")
+    outs, final = foreach(body, data if len(data) != 1 else data[0],
+                          states if len(states) != 1 else states[0])
+    return tuple(_as_list(outs) + _as_list(final))
+
+
+def _while_loop_op_override(inputs, attrs, out):
+    cond_fn, func = attrs.get("cond"), attrs.get("func")
+    if not (callable(cond_fn) and callable(func)):
+        raise MXNetError(
+            "_while_loop: pass cond= and func= callables — op-name form "
+            "of nd.contrib.while_loop")
+    outs, final = while_loop(
+        cond_fn, func, list(inputs),
+        max_iterations=int(attrs.get("max_iterations", 0)) or None)
+    return tuple(_as_list(outs) + _as_list(final))
+
+
+def _cond_op_override(inputs, attrs, out):
+    pred, then_fn, else_fn = (attrs.get("cond"), attrs.get("then_func"),
+                              attrs.get("else_func"))
+    if not (callable(pred) and callable(then_fn) and callable(else_fn)):
+        raise MXNetError(
+            "_cond: pass cond=, then_func=, else_func= callables — "
+            "op-name form of nd.contrib.cond")
+    return tuple(_as_list(cond(pred(*inputs), lambda: then_fn(*inputs),
+                               lambda: else_fn(*inputs))))
+
+
+_reg.register("_foreach", num_outputs=-1)(lambda *a, **k: a)
+_reg.register("_while_loop", num_outputs=-1)(lambda *a, **k: a)
+_reg.register("_cond", num_outputs=-1)(lambda *a, **k: a)
+_reg.register_invoke_override("_foreach", _foreach_op_override)
+_reg.register_invoke_override("_while_loop", _while_loop_op_override)
+_reg.register_invoke_override("_cond", _cond_op_override)

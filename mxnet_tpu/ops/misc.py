@@ -426,6 +426,36 @@ def _image_crop(data, x=0, y=0, width=1, height=1):
     return data[:, y:y + height, x:x + width, :]
 
 
+@register("_image_adjust_lighting")
+def _image_adjust_lighting(data, alpha=(0.0, 0.0, 0.0)):
+    """PCA-based AlexNet lighting jitter (parity: image_random-inl.h
+    AdjustLightingImpl — same hard-coded eigval*eigvec table).  HWC (or
+    NHWC) layout, channel-last like the reference's image namespace."""
+    eig = jnp.asarray(
+        [[55.46 * -0.5675, 4.794 * 0.7192, 1.148 * 0.4009],
+         [55.46 * -0.5808, 4.794 * -0.0045, 1.148 * -0.8140],
+         [55.46 * -0.5836, 4.794 * -0.6948, 1.148 * 0.4203]],
+        jnp.float32)
+    a = jnp.asarray(alpha, jnp.float32)
+    if data.shape[-1] == 1:
+        return data
+    pca = eig @ a  # (3,) per-channel shift
+    out = data.astype(jnp.float32) + pca
+    if jnp.issubdtype(data.dtype, jnp.integer):
+        # reference saturate_cast: clamp to the dtype's range, no wrap
+        info = jnp.iinfo(data.dtype)
+        out = jnp.clip(out, info.min, info.max)
+    return out.astype(data.dtype)
+
+
+@register("_image_random_lighting", needs_rng=True)
+def _image_random_lighting(key, data, alpha_std=0.05):
+    """Random lighting: alpha ~ N(0, alpha_std) per channel (parity:
+    image_random.cc _image_random_lighting)."""
+    a = jax.random.normal(key, (3,), jnp.float32) * alpha_std
+    return _image_adjust_lighting(data, alpha=a)
+
+
 @register("_image_resize")
 def _image_resize(data, size=(), keep_ratio=False, interp=1):
     if isinstance(size, int):

@@ -625,13 +625,11 @@ for _name in ("signbit", "spacing", "cbrt", "positive", "fabs"):
 # _npi_unique above; reference computes these on CPU too)
 # ---------------------------------------------------------------------------
 
-def _set_op_override(onp_fn, n_in=2):
+def _set_op_override(onp_fn, n_in=2, takes_assume_unique=True):
     def handler(inputs, attrs, out):
-        import numpy as onp
-
         args = [x.asnumpy() for x in inputs[:n_in] if x is not None]
         kwargs = {}
-        if attrs.get("assume_unique"):
+        if takes_assume_unique and attrs.get("assume_unique"):
             kwargs["assume_unique"] = True
         res = onp_fn(*args, **kwargs)
         return inputs[0]._op_result_cls(jnp.asarray(res))
@@ -640,13 +638,15 @@ def _set_op_override(onp_fn, n_in=2):
 
 import numpy as _host_np  # noqa: E402
 
-for _name, _fn in [("intersect1d", _host_np.intersect1d),
-                   ("union1d", _host_np.union1d),
-                   ("setdiff1d", _host_np.setdiff1d),
-                   ("setxor1d", _host_np.setxor1d)]:
+for _name, _fn, _au in [("intersect1d", _host_np.intersect1d, True),
+                        ("union1d", _host_np.union1d, False),
+                        ("setdiff1d", _host_np.setdiff1d, True),
+                        ("setxor1d", _host_np.setxor1d, True)]:
     register("_npi_" + _name, inputs=("a", "b"))(
         lambda a, b, assume_unique=False: a)
-    register_invoke_override("_npi_" + _name, _set_op_override(_fn))
+    register_invoke_override(
+        "_npi_" + _name,
+        _set_op_override(_fn, takes_assume_unique=_au))
 
 
 @register("_npi_isin", inputs=("element", "test_elements"))

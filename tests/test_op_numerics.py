@@ -1188,11 +1188,34 @@ EXCLUDED = {
            "tests/test_gluon_rnn.py",
     "CTCLoss": "alignment-marginalising loss; golden + grad tests in "
                "tests/test_gluon.py (gluon.loss.CTCLoss)",
+    "_foreach": "op-name form of nd.contrib.foreach (callable attrs); "
+                "tests/test_contrib_extras.py",
+    "_while_loop": "op-name form of nd.contrib.while_loop; "
+                   "tests/test_contrib_extras.py",
+    "_cond": "op-name form of nd.contrib.cond; "
+             "tests/test_contrib_extras.py",
 }
 # ops whose numerics live in a dedicated test file (not exclusions: each
 # has golden/parity assertions in tests/test_op_waves.py)
 COVERED_ELSEWHERE = set(_WAVE_TESTED) | set(_WAVE_EXCLUDED)
 
+
+
+SPECS["_image_adjust_lighting"] = S(
+    [np.random.RandomState(0).rand(4, 4, 3).astype(np.float32) * 255],
+    {"alpha": (0.01, -0.02, 0.005)},
+    check=lambda outs, ins: np.allclose(
+        np.asarray(outs[0]) - np.asarray(ins[0]),
+        np.broadcast_to(
+            np.array([[55.46 * -0.5675, 4.794 * 0.7192, 1.148 * 0.4009],
+                      [55.46 * -0.5808, 4.794 * -0.0045, 1.148 * -0.8140],
+                      [55.46 * -0.5836, 4.794 * -0.6948, 1.148 * 0.4203]],
+                     np.float32) @ np.array([0.01, -0.02, 0.005],
+                                            np.float32),
+            (4, 4, 3)), atol=1e-3))
+SPECS["_image_random_lighting"] = S(
+    [np.zeros((4, 4, 3), np.float32)], {"alpha_std": 0.05},
+    check=lambda outs, ins: np.isfinite(np.asarray(outs[0])).all())
 
 
 # round-3 numpy wave: statistics / set / window / misc

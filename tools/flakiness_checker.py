@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Run a test many times to surface flakiness
+(parity: reference tools/flakiness_checker.py).
+
+Usage:
+    python tools/flakiness_checker.py test_module.test_name [-n 500]
+    python tools/flakiness_checker.py tests/test_gluon.py::test_dense
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+
+DEFAULT_NUM_TRIALS = 500
+
+
+def find_test_path(test_file):
+    """Locate a test file by name under tests/ (reference:
+    flakiness_checker.py:55)."""
+    test_file += ".py"
+    test_path = os.path.split(test_file)
+    top = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests")
+    for root, _dirs, files in os.walk(top):
+        if test_path[1] in files:
+            return os.path.join(root, test_path[1])
+    raise FileNotFoundError(
+        "could not find %s under %s" % (test_path[1], top))
+
+
+def run_test_trials(args):
+    if "/" in args.test or args.test.endswith(".py") \
+            or "::" in args.test:
+        test_spec = args.test
+    else:
+        # reference syntax: test_module.test_name
+        mod, _, name = args.test.rpartition(".")
+        test_spec = "%s::%s" % (find_test_path(mod), name)
+    env = dict(os.environ)
+    if args.seed is not None:
+        env["MXNET_TEST_SEED"] = str(args.seed)
+    print("running %s for %d trials" % (test_spec, args.trials))
+    cmd = [sys.executable, "-m", "pytest", "-q", "-x",
+           "--count=%d" % args.trials, test_spec] \
+        if args.use_count_plugin else None
+    failures = 0
+    if cmd is not None:
+        return subprocess.call(cmd, env=env)
+    for i in range(args.trials):
+        rc = subprocess.call(
+            [sys.executable, "-m", "pytest", "-q", test_spec],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        if rc != 0:
+            failures += 1
+            print("trial %d FAILED" % i)
+    print("%d/%d trials failed" % (failures, args.trials))
+    return 1 if failures else 0
+
+
+def parse_args():
+    ap = argparse.ArgumentParser(
+        description="Check test flakiness by repetition")
+    ap.add_argument("test",
+                    help="file.py::test, tests path, or module.test_name")
+    ap.add_argument("-n", "--trials", type=int,
+                    default=DEFAULT_NUM_TRIALS,
+                    help="number of runs (default %d)"
+                    % DEFAULT_NUM_TRIALS)
+    ap.add_argument("-s", "--seed", type=int, default=None,
+                    help="fixed MXNET_TEST_SEED for every run")
+    ap.add_argument("--use-count-plugin", action="store_true",
+                    help="use pytest-repeat's --count instead of "
+                         "spawning per-trial processes")
+    return ap.parse_args()
+
+
+if __name__ == "__main__":
+    sys.exit(run_test_trials(parse_args()))

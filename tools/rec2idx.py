@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Create an index file for an existing RecordIO file
+(parity: reference tools/rec2idx.py IndexCreator).
+
+The index maps record key -> byte offset so ``MXIndexedRecordIO`` can
+random-access records (shuffled epochs, distributed sharding).
+
+Usage:
+    python tools/rec2idx.py data.rec data.idx
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+
+class IndexCreator(recordio.MXRecordIO):
+    """Sequentially read a .rec file, emitting key<TAB>offset per record
+    (reference: rec2idx.py IndexCreator — the C-ABI tell() becomes the
+    reader's tracked offset)."""
+
+    def __init__(self, idx_path, uri, key_type=int):
+        self.fidx = open(idx_path, "w")
+        self.key_type = key_type
+        super().__init__(uri, "r")
+
+    def close(self):
+        if getattr(self, "fidx", None) is not None:
+            self.fidx.close()
+            self.fidx = None
+        super().close()
+
+    def create_index(self):
+        """Walk every record; index entry i is the record's byte offset."""
+        counter = 0
+        while True:
+            pos = self.record.tell()  # reader offset (MXRecordIO.tell is writer-only, reference parity)
+            cont = self.read()
+            if cont is None:
+                break
+            key = self.key_type(counter)
+            self.fidx.write("%s\t%d\n" % (str(key), pos))
+            counter += 1
+        return counter
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Create an index file from a RecordIO file")
+    ap.add_argument("record", help="path of the input RecordIO file")
+    ap.add_argument("index", help="path of the index file to create")
+    args = ap.parse_args()
+    creator = IndexCreator(args.index, args.record)
+    n = creator.create_index()
+    creator.close()
+    print("wrote %d index entries -> %s" % (n, args.index))
+
+
+if __name__ == "__main__":
+    main()
