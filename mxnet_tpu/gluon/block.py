@@ -40,6 +40,36 @@ from .parameter import (
 _trace_state = threading.local()
 
 
+class _functional_params:
+    """Context manager: run imperative forwards with parameters
+    substituted by the given arrays (the functional-trace choke point
+    used by hybridize, JitTrainStep, deploy, and the pipeline stages).
+
+    ``with _functional_params(params, arrays): net._forward_imperative(x)``
+    maps ``id(param) -> NDArray(array)`` for the duration and restores
+    the previous trace state on exit.
+    """
+
+    def __init__(self, params, arrays):
+        from ..ndarray.ndarray import NDArray
+
+        self._map = {id(p): NDArray(a) for p, a in zip(params, arrays)}
+        self._prev = None
+
+    def __enter__(self):
+        st = _trace_st()
+        self._prev = (st.param_map, st.aux_updates, st.active)
+        st.param_map = self._map
+        st.aux_updates = []
+        st.active = True
+        return st
+
+    def __exit__(self, *exc):
+        st = _trace_st()
+        st.param_map, st.aux_updates, st.active = self._prev
+        return False
+
+
 def _trace_st():
     if not hasattr(_trace_state, "param_map"):
         _trace_state.param_map = None   # id(Parameter) -> NDArray(tracer)

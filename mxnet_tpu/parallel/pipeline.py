@@ -83,3 +83,205 @@ def gpipe_loss_fn(stage_fn, loss_fn, mesh, axis_name="pp"):
         return loss_fn(outs, y_mb)
 
     return f
+
+
+# ---------------------------------------------------------------------------
+# Non-identical stages: host-scheduled GPipe with per-stage placement
+# ---------------------------------------------------------------------------
+
+
+class HostPipeline:
+    """GPipe over NON-identical stages (embedding/blocks/head included).
+
+    The SPMD ``gpipe`` above needs one shape-preserving stage replicated
+    on every device; real models are not shaped like that.  This runtime
+    instead keeps each stage a separately jitted callable whose
+    parameters LIVE on that stage's device, and drives the microbatch
+    schedule from the host: JAX's async dispatch overlaps stage s's
+    microbatch j with stage s+1's microbatch j-1 automatically, and the
+    backward recomputes each stage's forward inside its vjp (classic
+    GPipe activation rematerialisation — per-device memory holds one
+    stage's weights + boundary activations only).
+
+    Parameters
+    ----------
+    stage_fns : list of pure callables ``(params, activation) -> activation``
+        (the LAST stage returns the model output fed to ``loss_fn``)
+    stage_params : list of param pytrees, one per stage (``shared_params``
+        index groups additionally require each stage's params to be a
+        FLAT list of arrays, which is what ``partition_llama`` produces)
+    loss_fn : ``(output, labels) -> scalar`` (mean over the microbatch)
+    devices : optional list of jax devices, one per stage (defaults to
+        ``jax.devices()[:n_stages]``)
+    """
+
+    def __init__(self, stage_fns, stage_params, loss_fn, devices=None,
+                 shared_params=()):
+        if len(stage_fns) != len(stage_params):
+            raise MXNetError("one params pytree per stage required")
+        self.n_stages = len(stage_fns)
+        self.loss_fn = loss_fn
+        # groups of (stage, leaf_index) aliases of ONE logical parameter
+        # (tied embeddings): grads are summed across the group and every
+        # member receives the identical update
+        self.shared_params = [list(g) for g in shared_params]
+        if devices is None:
+            devices = jax.devices()[: self.n_stages]
+        if len(devices) < self.n_stages:
+            raise MXNetError("need >= n_stages devices")
+        self.devices = list(devices[: self.n_stages])
+        self.params = [
+            jax.tree_util.tree_map(
+                lambda a, d=dev: jax.device_put(jnp.asarray(a), d), p)
+            for p, dev in zip(stage_params, self.devices)]
+        self._fwd = [jax.jit(f) for f in stage_fns]
+
+        def _mid_bwd(f):
+            def run(p, a, g):
+                _, vjp = jax.vjp(f, p, a)
+                return vjp(g)
+            return jax.jit(run)
+
+        self._bwd = [_mid_bwd(f) for f in stage_fns[:-1]]
+        f_last = stage_fns[-1]
+
+        def _last_grad(p, a, y):
+            loss, grads = jax.value_and_grad(
+                lambda p_, a_: loss_fn(f_last(p_, a_), y),
+                argnums=(0, 1))(p, a)
+            return loss, grads[0], grads[1]
+
+        self._last_grad = jax.jit(_last_grad)
+
+    def forward_backward(self, x_microbatches, y_microbatches):
+        """Returns (mean loss over microbatches, per-stage grads)."""
+        n, devs = self.n_stages, self.devices
+        m = len(x_microbatches)
+        acts = [[None] * m for _ in range(n)]  # stage input per mb
+        for j, x in enumerate(x_microbatches):
+            acts[0][j] = jax.device_put(jnp.asarray(x), devs[0])
+            for s in range(n - 1):
+                out = self._fwd[s](self.params[s], acts[s][j])
+                acts[s + 1][j] = jax.device_put(out, devs[s + 1])
+        grads = [None] * n
+        losses = []
+        for j in range(m):
+            y = jax.device_put(jnp.asarray(y_microbatches[j]), devs[-1])
+            loss, gp, ga = self._last_grad(self.params[-1],
+                                           acts[-1][j], y)
+            losses.append(loss)
+            grads[-1] = gp if grads[-1] is None else jax.tree_util.tree_map(
+                jnp.add, grads[-1], gp)
+            g = ga
+            for s in range(n - 2, -1, -1):
+                g = jax.device_put(g, devs[s])
+                gp, ga = self._bwd[s](self.params[s], acts[s][j], g)
+                grads[s] = gp if grads[s] is None else \
+                    jax.tree_util.tree_map(jnp.add, grads[s], gp)
+                g = ga
+        inv = 1.0 / m
+        grads = [jax.tree_util.tree_map(lambda a: a * inv, g)
+                 for g in grads]
+        loss = sum(float(l) for l in losses) / m
+        return loss, grads
+
+    def _merge_shared_grads(self, grads):
+        """Sum gradients of aliased (tied) parameters across stages."""
+        for group in self.shared_params:
+            total = None
+            for (s, i) in group:
+                g = jax.device_put(grads[s][i], self.devices[group[0][0]])
+                total = g if total is None else total + g
+            for (s, i) in group:
+                grads[s][i] = jax.device_put(total, self.devices[s])
+        return grads
+
+    def sgd_step(self, x_microbatches, y_microbatches, lr=0.1):
+        """One pipelined train step with in-place SGD; returns the loss.
+        Tied parameters (``shared_params`` groups) receive one summed
+        update so the aliases never diverge."""
+        loss, grads = self.forward_backward(x_microbatches,
+                                            y_microbatches)
+        if self.shared_params:
+            grads = self._merge_shared_grads([list(g) for g in grads])
+        self.params = [
+            jax.tree_util.tree_map(lambda p, g: p - lr * g, ps, gs)
+            for ps, gs in zip(self.params, grads)]
+        return loss
+
+
+def partition_llama(model, n_stages):
+    """Split a gluon ``LlamaModel`` into ``n_stages`` NON-identical
+    pipeline stages (embedding fused into stage 0, final norm + LM head
+    into the last).  Returns ``(stage_fns, stage_params, param_refs,
+    shared_groups)``.
+
+    ``param_refs[s]`` lists the gluon Parameters backing stage ``s`` (in
+    the order the stage fn expects), so updated weights can be synced
+    back with ``Parameter.set_data``.  The fourth return value lists
+    shared-parameter alias groups (tied embeddings appear in stage 0 AND
+    the last stage) — pass it to ``HostPipeline(shared_params=...)`` so
+    tied weights receive one summed update.
+    """
+    from ..gluon import block as _block_mod
+    from ..ndarray.ndarray import NDArray
+
+    for p in model.collect_params().values():
+        if p._data is None:
+            raise MXNetError(
+                "partition_llama: run one forward first to resolve "
+                "deferred parameter shapes (param %s unresolved)" % p.name)
+    blocks = list(model.blocks._children.values())
+    if n_stages < 2 or n_stages > len(blocks):
+        raise MXNetError("need 2 <= n_stages <= n_blocks")
+    per = [len(blocks) // n_stages] * n_stages
+    for i in range(len(blocks) % n_stages):
+        per[i] += 1
+    segments, start = [], 0
+    for s, k in enumerate(per):
+        segs = blocks[start:start + k]
+        start += k
+        segments.append(segs)
+
+    def params_of(gluon_blocks):
+        out = []
+        for b in gluon_blocks:
+            out.extend(b.collect_params().values())
+        return out
+
+    head_blocks = [model.norm] + (
+        [] if model._tie else [model.lm_head])
+    stage_blocks = []
+    for s, segs in enumerate(segments):
+        pre = [model.embed] if s == 0 else []
+        post = head_blocks if s == n_stages - 1 else []
+        stage_blocks.append(pre + segs + post)
+
+    def make_fn(gluon_blocks, prefs, is_last):
+        tie = model._tie and is_last
+
+        def fn(param_arrays, act):
+            with _block_mod._functional_params(prefs, param_arrays) as st:
+                x = NDArray(act)
+                for b in gluon_blocks:
+                    x = b._forward_imperative(x)
+                if tie:
+                    w = st.param_map[id(model.embed.weight)]
+                    x = NDArray(x.data() @ w.data().T)
+                return x.data()
+        return fn
+
+    stage_fns, stage_params, param_refs = [], [], []
+    for s, gblocks in enumerate(stage_blocks):
+        prefs = params_of(gblocks)
+        if model._tie and s == n_stages - 1:
+            prefs = prefs + [model.embed.weight]
+        param_refs.append(prefs)
+        stage_params.append([p.data().data() for p in prefs])
+        stage_fns.append(make_fn(gblocks, prefs, s == n_stages - 1))
+    by_param = {}
+    for s, prefs in enumerate(param_refs):
+        for i, p in enumerate(prefs):
+            by_param.setdefault(id(p), []).append((s, i))
+    shared = [g for g in by_param.values() if len(g) > 1]
+    return stage_fns, stage_params, param_refs, shared
