@@ -1,10 +1,10 @@
 """Checkpoint helpers + training-callback params.
 
 Capability parity: ``python/mxnet/model.py`` (``save_checkpoint:407``,
-``load_checkpoint:456``, ``BatchEndParam:80``).  TPU-native storage: the
-params file is the framework's ``.npz``-container NDArray format
-(``mxnet_tpu/ndarray/ndarray.py:629``) instead of the reference's magic-
-number binary; the symbol file is the same JSON idea.
+``load_checkpoint:456``, ``BatchEndParam:80``).  Storage: ``nd.save``
+writes the reference's byte-level ``.params`` binary (magic-number
+format, ``ndarray/legacy_io.py``) so checkpoints interchange with the
+reference; the symbol file is the same JSON idea.
 """
 from __future__ import annotations
 
