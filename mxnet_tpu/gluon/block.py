@@ -275,9 +275,39 @@ class Block:
         from ..ndarray import ndarray as _ndm
 
         loaded = _ndm.load(filename, ctx=ctx)
-        params = self._collect_params_with_prefix()
         if not isinstance(loaded, dict):
             raise MXNetError("%s is not a parameter dict file" % filename)
+        if any(k.startswith(("arg:", "aux:")) for k in loaded):
+            # exported-model format (HybridBlock.export / save_checkpoint)
+            loaded = {k.split(":", 1)[1] if k.startswith(("arg:", "aux:"))
+                      else k: v for k, v in loaded.items()}
+        params = self._collect_params_with_prefix()
+        if loaded and not set(loaded) & set(params):
+            # exported files use FLAT ParameterDict names (p.name), not
+            # the structural dotted names save_parameters writes; fall
+            # back to name-based matching (reference load_parameters does
+            # the same when keys don't look structural)
+            by_flat = {p.name: p for p in self.collect_params().values()}
+            if set(loaded) & set(by_flat):
+                params = by_flat
+            else:
+                # a FRESH net instance carries a different auto-prefix
+                # (resnetv10_ vs resnetv11_); retry with the instance
+                # prefix (first '_' token) stripped from both sides, but
+                # only when the mapping stays unambiguous
+                def strip(k):
+                    return k.split("_", 1)[1] if "_" in k else k
+
+                flat2 = {}
+                for p in self.collect_params().values():
+                    flat2.setdefault(strip(p.name), p)
+                loaded2 = {}
+                for k, v in loaded.items():
+                    loaded2.setdefault(strip(k), v)
+                if len(flat2) == len(by_flat) and \
+                        len(loaded2) == len(loaded) and \
+                        set(loaded2) & set(flat2):
+                    params, loaded = flat2, loaded2
         for name, p in params.items():
             if name not in loaded:
                 if not allow_missing:
@@ -425,6 +455,9 @@ class HybridBlock(Block):
             raise MXNetError(
                 "HybridBlock.forward expects NDArray inputs, got %s"
                 % type(x).__name__)
+        self._export_input_sig = [
+            (tuple(a.shape), str(a.dtype))
+            for a in (x,) + args if isinstance(a, NDArray)]
         if self._active and not is_tracing():
             return self._call_cached(x, *args)
         return self._forward_imperative(x, *args)
@@ -559,22 +592,58 @@ class HybridBlock(Block):
 
     # -- export -----------------------------------------------------------
     def export(self, path, epoch=0):
-        """Serialize compiled-form params (parity: HybridBlock.export:1081).
+        """Serialize to ``path-symbol.json`` + ``path-%04d.params``
+        (parity: HybridBlock.export:1081).
 
-        Emits ``path-symbol.json`` (a structural description: op-level jaxpr
-        text of the cached executable if built, else the block tree) and
-        ``path-%04d.params``.
+        Like the reference, the block must have run at least one forward
+        (that recorded the input signature).  The symbol file is a REAL
+        Symbol graph traced via the symbolic path — loadable with
+        ``SymbolBlock.imports`` / ``mx.mod.Module`` — with a structural
+        JSON fallback when the graph cannot be expressed symbolically
+        (e.g. data-dependent ops).
         """
         import json as _json
 
-        params = self.collect_params()
+        from .. import symbol as _sym_mod
         from ..ndarray import ndarray as _ndm
+        from ..symbol.symbol import Symbol
 
+        params = self.collect_params()
+        sym = None
+        sig = getattr(self, "_export_input_sig", None)
+        if sig:
+            try:
+                data_vars = [
+                    _sym_mod.var("data" if i == 0 else "data%d" % i,
+                                 shape=shp, dtype=dt)
+                    for i, (shp, dt) in enumerate(sig)]
+                with autograd.predict_mode():
+                    out = self.forward(*data_vars)
+                if isinstance(out, (list, tuple)) and all(
+                        isinstance(o, Symbol) for o in out):
+                    out = Symbol.Group(out)
+                sym = out if isinstance(out, Symbol) else None
+            except Exception:
+                import logging
+
+                # genuinely untraceable graphs (data-dependent ops) fall
+                # back to the structural stub; log so tracer REGRESSIONS
+                # stay visible rather than silently degrading exports
+                logging.getLogger(__name__).warning(
+                    "HybridBlock.export: symbolic trace failed, writing "
+                    "structural stub", exc_info=True)
+                sym = None
+        aux_names = set(sym.list_auxiliary_states()) if sym else set()
         arg = {}
         for name, p in params.items():
             if p._data is not None:
-                arg["arg:" + name] = p.data()
+                tag = "aux:" if name in aux_names else "arg:"
+                arg[tag + name] = p.data()
         _ndm.save("%s-%04d.params" % (path, epoch), arg)
+        if sym is not None:
+            with open(path + "-symbol.json", "w") as f:
+                f.write(sym.tojson())
+            return
         desc = {"framework": "mxnet_tpu", "block": self.__class__.__name__,
                 "name": self.name,
                 "params": {k: list(p.shape or ()) for k, p in params.items()}}
