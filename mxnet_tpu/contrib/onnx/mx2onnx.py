@@ -328,7 +328,7 @@ def _reshape(ctx, ins, outs, a):
     ctx.add("Reshape", [ins[0], sname], outs)
 
 
-@mx_op("Flatten")
+@mx_op("Flatten", "flatten")
 def _flatten(ctx, ins, outs, a):
     ctx.add("Flatten", ins[:1], outs, axis=1)
 

@@ -160,3 +160,24 @@ def test_hybrid_export_symbol_round_trip(tmp_path):
                               prefix + "-0003.params")
     np.testing.assert_allclose(blk(x).asnumpy(), ref, rtol=1e-5,
                                atol=1e-5)
+
+
+@pytest.mark.parametrize("family", ["alexnet", "mobilenet", "vgg"])
+def test_more_families_round_trip(family, tmp_path):
+    mx.random.seed(0)
+    if family == "alexnet":
+        net = vision.AlexNet(classes=10, layout="NCHW")
+        size = 224  # fixed dense geometry
+    elif family == "mobilenet":
+        # depthwise/grouped convs exercise the Conv group attribute
+        net = vision.MobileNet(multiplier=0.25, classes=10,
+                               layout="NCHW")
+        size = 64
+    else:
+        net = vision.VGG([1, 1], [8, 16], classes=10, layout="NCHW")
+        size = 32
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0)
+                    .rand(1, 3, size, size).astype(np.float32))
+    ref, got, _ = _round_trip(net, x, tmp_path, family + ".onnx")
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
