@@ -1,0 +1,70 @@
+"""Pipeline-parallel Llama training example (non-identical stages).
+
+Partitions a Llama stack into pipeline stages — embedding fused into
+stage 0, final norm + LM head into the last — places each stage's
+weights on its own device, and trains with the host-scheduled GPipe
+schedule (microbatches overlap via async dispatch; backward recomputes
+each stage's forward).
+
+Run on the 8-virtual-device CPU mesh:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/llama/train_pipeline.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import parallel  # noqa: E402
+from mxnet_tpu.gluon.model_zoo import llama  # noqa: E402
+
+VOCAB = 1024
+PP = 4
+
+
+def cross_entropy(logits, labels):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[..., None], -1))
+
+
+def main():
+    if len(jax.devices()) < PP:
+        print("need %d devices (see module docstring)" % PP)
+        return
+    mx.random.seed(0)
+    net = llama.LlamaModel(VOCAB, units=128, hidden_size=256,
+                           num_layers=PP, num_heads=4, num_kv_heads=2)
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.array(np.zeros((1, 32), np.int32)))  # resolve shapes
+
+    fns, params, refs, shared = parallel.partition_llama(net, PP)
+    pipe = parallel.HostPipeline(fns, params, cross_entropy,
+                                 shared_params=shared)
+    print("stages:", [len(p) for p in params], "params each; devices:",
+          [str(d) for d in pipe.devices])
+
+    rs = np.random.RandomState(0)
+    for step in range(5):
+        toks = rs.randint(0, VOCAB, (8, 32)).astype(np.int32)
+        labels = np.roll(toks, -1, axis=1).astype(np.int32)
+        x_mbs = [toks[i::4] for i in range(4)]    # 4 microbatches
+        y_mbs = [labels[i::4] for i in range(4)]
+        loss = pipe.sgd_step(x_mbs, y_mbs, lr=0.1)
+        print("step %d: loss %.4f" % (step, loss))
+
+    # sync updated weights back into the gluon net
+    for prefs, ps in zip(refs, pipe.params):
+        for p, a in zip(prefs, ps):
+            p.set_data(mx.nd.NDArray(a))
+    print("weights synced back to the gluon model")
+
+
+if __name__ == "__main__":
+    main()

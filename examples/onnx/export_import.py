@@ -1,0 +1,54 @@
+"""ONNX interchange example: export a model-zoo net, inspect it,
+re-import it, and verify output parity.
+
+Run: python examples/onnx/export_import.py
+(reference workflow: python/mxnet/contrib/onnx — mx2onnx + onnx2mx)
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym as S  # noqa: E402
+from mxnet_tpu.contrib import onnx as mxonnx  # noqa: E402
+from mxnet_tpu.gluon.model_zoo import vision  # noqa: E402
+
+
+def main():
+    mx.random.seed(0)
+    # ONNX is channel-first interchange: build the net NCHW
+    net = vision.get_resnet(1, 18, thumbnail=True, classes=10,
+                            layout="NCHW")
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0)
+                    .rand(2, 3, 32, 32).astype(np.float32))
+    ref = net(x)
+
+    # gluon -> Symbol (symbolic trace) -> ONNX
+    graph = net(S.var("data", shape=(2, 3, 32, 32)))
+    params = {k: p.data() for k, p in net.collect_params().items()}
+    path = mxonnx.export_model(graph, params,
+                               onnx_file_path="/tmp/resnet18.onnx",
+                               verbose=True)
+
+    meta = mxonnx.get_model_metadata(path)
+    print("inputs :", meta["input_tensor_data"])
+    print("outputs:", meta["output_tensor_data"])
+
+    # ONNX -> Symbol + params, evaluated through the executor
+    sym2, arg_params, aux_params = mxonnx.import_model(path)
+    bindings = {"data": x}
+    bindings.update(arg_params)
+    bindings.update(aux_params)
+    out = sym2.eval_imperative(bindings)[0]
+    err = float(np.abs(out.asnumpy() - ref.asnumpy()).max())
+    print("round-trip max |Δ| = %.2e" % err)
+    assert err < 1e-4
+
+
+if __name__ == "__main__":
+    main()
