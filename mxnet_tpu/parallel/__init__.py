@@ -20,6 +20,7 @@ from .mesh import (  # noqa: F401
 from .train_step import JitTrainStep  # noqa: F401
 from .tp_rules import megatron_rule, pattern_rule  # noqa: F401
 from .ring_attention import ring_attention, ring_attention_sharded  # noqa: F401,E501
+from .moe import moe_ffn, moe_ffn_sharded  # noqa: F401
 from .pipeline import (  # noqa: F401
     gpipe, gpipe_loss_fn, HostPipeline, partition_llama,
 )

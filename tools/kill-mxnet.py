@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Kill stray training processes on this machine
+(parity: reference tools/kill-mxnet.py, which pkilled the python
+processes of a dmlc job).
+
+Matches python processes whose command line mentions the given program
+name (default: any mxnet_tpu entrypoint) and SIGTERMs them, escalating
+to SIGKILL after a grace period.
+
+Usage: python tools/kill-mxnet.py [prog_name]
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+
+
+def _ancestors():
+    """PIDs of this process's ancestor chain (never kill those — their
+    command lines quote OUR argv, including the search needle)."""
+    chain, pid = set(), os.getpid()
+    while pid > 1:
+        chain.add(pid)
+        try:
+            with open("/proc/%d/stat" % pid) as f:
+                pid = int(f.read().rsplit(")", 1)[1].split()[1])
+        except (OSError, IndexError, ValueError):
+            break
+    chain.add(1)
+    return chain
+
+
+def find_procs(needle):
+    skip = _ancestors()
+    out = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit() or int(pid) in skip:
+            continue
+        try:
+            with open("/proc/%s/cmdline" % pid, "rb") as f:
+                cmd = f.read().replace(b"\x00", b" ").decode(
+                    "utf-8", "replace")
+        except OSError:
+            continue
+        if "python" in cmd and needle in cmd:
+            out.append((int(pid), cmd.strip()))
+    return out
+
+
+def main():
+    needle = sys.argv[1] if len(sys.argv) > 1 else "mxnet_tpu"
+    procs = find_procs(needle)
+    if not procs:
+        print("no matching processes for %r" % needle)
+        return
+    for pid, cmd in procs:
+        print("SIGTERM %d: %s" % (pid, cmd[:100]))
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except OSError:
+            pass
+    time.sleep(2)
+    # re-match before escalating: the PID may have been recycled for an
+    # unrelated process during the grace period
+    still = {pid for pid, _ in find_procs(needle)}
+    for pid, _cmd in procs:
+        if pid in still:
+            print("SIGKILL %d (did not exit)" % pid)
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+
+
+if __name__ == "__main__":
+    main()
