@@ -1,0 +1,37 @@
+"""Shared example helper: pin a deterministic CPU backend.
+
+This image's sitecustomize imports jax (registering the axon/TPU
+backend) before shell env vars can influence it, and probing the
+ambient backend can HANG when the chip tunnel is unhealthy — so
+examples pin CPU via the config API unless the user opts into the
+ambient backend with MXNET_EXAMPLE_PLATFORM=ambient.
+"""
+from __future__ import annotations
+
+import os
+import re
+
+
+def ensure_devices(n_needed=1):
+    import jax
+
+    if os.environ.get("MXNET_EXAMPLE_PLATFORM") == "ambient":
+        return
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._clear_backends()
+        _xb.get_backend.cache_clear()
+    except Exception:
+        pass
+    n = max(8, n_needed)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except Exception:
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       os.environ.get("XLA_FLAGS", ""))
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=%d" % n
+        ).strip()

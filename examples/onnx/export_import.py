@@ -11,39 +11,14 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
 
 
-def _ensure_devices(n_needed):
-    """Pin the CPU backend with n_needed virtual devices.
 
-    Examples default to CPU so they run anywhere, deterministically —
-    probing the ambient TPU backend first could HANG when the chip
-    tunnel is unhealthy (jax.devices() blocks, it does not raise).  Set
-    MXNET_EXAMPLE_PLATFORM=ambient to use whatever backend the
-    environment provides instead."""
-    import jax
+from _device_setup import ensure_devices  # noqa: E402
 
-    if os.environ.get("MXNET_EXAMPLE_PLATFORM") == "ambient":
-        return
-    try:
-        from jax._src import xla_bridge as _xb
-
-        _xb._clear_backends()
-        _xb.get_backend.cache_clear()
-    except Exception:
-        pass
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    jax.config.update("jax_platforms", "cpu")
-    try:
-        jax.config.update("jax_num_cpu_devices", max(8, n_needed))
-    except Exception:
-        flags = os.environ.get("XLA_FLAGS", "")
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=%d"
-            % max(8, n_needed)).strip()
-
-
-_ensure_devices(1)
+ensure_devices(1)
 
 import mxnet_tpu as mx  # noqa: E402
 from mxnet_tpu import sym as S  # noqa: E402

@@ -47,6 +47,7 @@ from . import parallel  # noqa: F401
 from . import gluon  # noqa: F401
 from . import symbol  # noqa: F401
 from . import symbol as sym  # noqa: F401
+from .symbol import AttrScope  # noqa: F401
 from . import model  # noqa: F401
 from . import callback  # noqa: F401
 from . import module  # noqa: F401

@@ -508,12 +508,11 @@ def _node_shapes(sym, input_shapes):
             if node.is_variable:
                 vals[id(node)] = (bindings[node.name],)
                 continue
+            from ...symbol.symbol import _op_attrs
+
             reg = _reg.get(node.op)
             ins = [vals[id(inp)][idx] for inp, idx in node.inputs]
-            attrs = dict(node.attrs)
-            attrs.pop("__name__", None)
-            if reg.needs_mode:
-                attrs["_mode"] = "predict"
+            attrs = _op_attrs(node, "predict" if reg.needs_mode else None)
             if reg.needs_rng:
                 ins = [jax.random.PRNGKey(0)] + ins
             out = reg.forward(*ins, **attrs)

@@ -1,6 +1,7 @@
 """Symbolic graph API (parity: ``python/mxnet/symbol/``)."""
 from .symbol import (  # noqa: F401
     Symbol, var, Variable, Group, load, load_json, zeros, ones, arange,
+    AttrScope,
 )
 from .executor import Executor  # noqa: F401
 from . import symbol as _symbol_mod

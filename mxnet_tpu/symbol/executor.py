@@ -29,9 +29,13 @@ class Executor:
     """Parity: mxnet.executor.Executor (python/mxnet/executor.py)."""
 
     def __init__(self, symbol, ctx, args, auxs, grad_req="write",
-                 args_grad=None):
+                 args_grad=None, group2ctx=None):
         self._symbol = symbol
         self._ctx = ctx or current_context()
+        # manual model-parallel placement (AttrScope ctx_group): devices
+        # per group imply EAGER per-node execution with cross-device
+        # copies — one jit targets one logical device
+        self._group2ctx = dict(group2ctx) if group2ctx else None
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
         self.output_names = symbol.list_outputs()
@@ -82,7 +86,8 @@ class Executor:
         if fn is None:
             ext = self._extended_symbol()
             input_names = ext.list_inputs()
-            raw = ext._make_fn(input_names, mode=mode)
+            raw = ext._make_fn(input_names, mode=mode,
+                               group2ctx=self._group2ctx)
 
             def run(key, args, auxs):
                 with _random.trace_key_scope(key):
@@ -91,7 +96,7 @@ class Executor:
                     bindings.update(auxs)
                     return raw(bindings)
 
-            fn = jax.jit(run)
+            fn = run if self._group2ctx else jax.jit(run)
             self._fns[mode] = fn
         return fn
 
@@ -100,7 +105,8 @@ class Executor:
         fn = self._fns.get("train_grad")
         if fn is None:
             ext = self._extended_symbol()
-            raw = ext._make_fn(ext.list_inputs(), mode="train")
+            raw = ext._make_fn(ext.list_inputs(), mode="train",
+                               group2ctx=self._group2ctx)
 
             def run(key, grad_args, other_args, auxs):
                 with _random.trace_key_scope(key):
@@ -109,7 +115,7 @@ class Executor:
                     bindings.update(grad_args)
                     return raw(bindings)
 
-            fn = jax.jit(run)
+            fn = run if self._group2ctx else jax.jit(run)
             self._fns["train_grad"] = fn
         return fn
 
@@ -124,7 +130,8 @@ class Executor:
         fn = self._fns.get("train_bwd")
         if fn is None:
             ext = self._extended_symbol()
-            raw = ext._make_fn(ext.list_inputs(), mode="train")
+            raw = ext._make_fn(ext.list_inputs(), mode="train",
+                               group2ctx=self._group2ctx)
 
             def run_bwd(key, grad_args, other_args, auxs, cts):
                 def wrt(ga):
@@ -138,7 +145,7 @@ class Executor:
                 (grads,) = vjp(tuple(cts))
                 return grads
 
-            fn = jax.jit(run_bwd)
+            fn = run_bwd if self._group2ctx else jax.jit(run_bwd)
             self._fns["train_bwd"] = fn
         return fn
 

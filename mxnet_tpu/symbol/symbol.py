@@ -43,6 +43,41 @@ _AUX_OUTPUTS = {
 _name_lock = threading.Lock()
 _name_counters = {}
 
+_attr_scope = threading.local()
+
+
+class AttrScope:
+    """Attach attributes to every symbol created inside the scope
+    (parity: mx.AttrScope, python/mxnet/attribute.py) — the reference's
+    manual model-parallel idiom:
+
+        with mx.AttrScope(ctx_group="dev1"):
+            h = mx.sym.FullyConnected(x, num_hidden=128)
+
+    Scope attrs are stored dunder-wrapped (``__ctx_group__``) on the
+    node so they never collide with operator kwargs; ``bind`` maps
+    groups to devices via ``group2ctx``.
+    """
+
+    def __init__(self, **attrs):
+        self._attrs = {"__%s__" % k: v for k, v in attrs.items()}
+        self._prev = None
+
+    @staticmethod
+    def current():
+        return getattr(_attr_scope, "value", {})
+
+    def __enter__(self):
+        self._prev = AttrScope.current()
+        merged = dict(self._prev)
+        merged.update(self._attrs)
+        _attr_scope.value = merged
+        return self
+
+    def __exit__(self, *exc):
+        _attr_scope.value = self._prev
+        return False
+
 
 def _auto_name(hint):
     hint = hint.lstrip("_").lower()
@@ -50,6 +85,16 @@ def _auto_name(hint):
         c = _name_counters.get(hint, 0)
         _name_counters[hint] = c + 1
     return "%s%d" % (hint, c)
+
+
+def _op_attrs(node, mode=None):
+    """Operator kwargs for a node: node attrs minus reserved dunder meta
+    attrs (AttrScope ctx_group, var shape/dtype, names)."""
+    attrs = {k: v for k, v in node.attrs.items()
+             if not (k.startswith("__") and k.endswith("__"))}
+    if mode is not None:
+        attrs["_mode"] = mode
+    return attrs
 
 
 class _Node:
@@ -230,9 +275,35 @@ class Symbol:
         return Symbol(entries)
 
     # -- evaluation --------------------------------------------------------
-    def _make_fn(self, arg_names, mode="predict"):
-        """Pure function mapping {name: array} -> tuple of outputs."""
+    def _make_fn(self, arg_names, mode="predict", group2ctx=None):
+        """Pure function mapping {name: array} -> tuple of outputs.
+
+        ``group2ctx`` (group name -> Context) activates the reference's
+        manual model-parallel placement: a node carrying an AttrScope
+        ``ctx_group`` runs on that group's device, with cross-device
+        copies inserted at the boundaries (``device_put`` — exactly the
+        reference's cross-dev copy nodes, ``AssignContext``
+        graph_executor.cc:1043).  Placement implies eager execution (the
+        caller must not jit: one jit = one logical device).
+        """
         nodes = self._topo_nodes()
+        dev_of = {}
+        if group2ctx:
+            # EVERY op node gets a device in placement mode: its group's,
+            # or the bind context's — so merges of different groups are
+            # re-colocated instead of crashing on mixed commitments (the
+            # reference's AssignContext copy-node insertion)
+            from ..context import current_context
+
+            default_dev = (group2ctx.get(None)
+                           or current_context()).jax_device
+            for node in nodes:
+                if node.is_variable:
+                    continue
+                grp = node.attrs.get("__ctx_group__")
+                ctx = group2ctx.get(grp)
+                dev_of[id(node)] = (ctx.jax_device if ctx is not None
+                                    else default_dev)
 
         def fn(bindings):
             vals = {}
@@ -245,12 +316,12 @@ class Symbol:
                     continue
                 reg = _reg.get(node.op)
                 ins = [vals[id(inp)][idx] for inp, idx in node.inputs]
-                attrs = dict(node.attrs)
-                attrs.pop("__name__", None)
-                if reg.needs_mode:
-                    attrs["_mode"] = mode
+                attrs = _op_attrs(node, mode if reg.needs_mode else None)
                 if reg.needs_rng:
                     ins = [_random.next_key()] + ins
+                dev = dev_of.get(id(node))
+                if dev is not None:
+                    ins = [jax.device_put(v, dev) for v in ins]
                 out = reg.forward(*ins, **attrs)
                 vals[id(node)] = out if isinstance(out, tuple) else (out,)
             return tuple(vals[id(node)][idx]
@@ -395,7 +466,8 @@ class Symbol:
                                                arg_shapes)}
         auxs = {n: nd.zeros(s) for n, s in
                 zip(self.list_auxiliary_states(), aux_shapes)}
-        return Executor(self, ctx, args, auxs, grad_req)
+        return Executor(self, ctx, args, auxs, grad_req,
+                        group2ctx=group2ctx)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
@@ -408,7 +480,7 @@ class Symbol:
         if isinstance(aux_states, (list, tuple)):
             aux_states = dict(zip(aux_names, aux_states))
         return Executor(self, ctx, args or {}, aux_states or {}, grad_req,
-                        args_grad=args_grad)
+                        args_grad=args_grad, group2ctx=group2ctx)
 
 
 def _solve_shapes(sym, known, partial):
@@ -480,10 +552,7 @@ def _hint_missing(sym, known, missing):
             return None
         # run eval_shape on this single node
         reg = _reg.get(node.op)
-        attrs = dict(node.attrs)
-        attrs.pop("__name__", None)
-        if reg.needs_mode:
-            attrs["_mode"] = "predict"
+        attrs = _op_attrs(node, "predict" if reg.needs_mode else None)
         def one(*arrs):
             ins = list(arrs)
             if reg.needs_rng:
@@ -611,6 +680,8 @@ def make_symbol_op(op_name):
                         entry_inputs.extend(a._outputs)
                     else:
                         entry_inputs.append(a._outputs[0])
+            for k, v in AttrScope.current().items():
+                attrs.setdefault(k, v)
             node = _Node(op_name, name, attrs, entry_inputs,
                          reg.num_outputs)
             return Symbol([(node, i) for i in range(reg.num_outputs)]) \
@@ -637,6 +708,8 @@ def make_symbol_op(op_name):
             else:
                 vnode = _Node(None, "%s_%s" % (name, nm), {})
                 entries.append((vnode, 0))
+        for k, v in AttrScope.current().items():
+            attrs.setdefault(k, v)
         node = _Node(op_name, name, attrs, entries, reg.num_outputs)
         if reg.num_outputs > 1:
             return Symbol([(node, i) for i in range(reg.num_outputs)])

@@ -243,3 +243,89 @@ def test_random_lighting_stochastic():
     b = nd._image_random_lighting(img, alpha_std=0.1).asnumpy()
     assert np.abs(a).max() > 0
     assert not np.allclose(a, b)
+
+
+# ---------------------------------------------------------------------------
+# AttrScope ctx_group manual model parallelism (SURVEY §2.4 row 3:
+# reference ctx_group attr + group2ctx bind, graph_executor AssignContext)
+# ---------------------------------------------------------------------------
+
+def test_attr_scope_ctx_group_placement_and_parity():
+    import jax
+
+    from mxnet_tpu import sym as S
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    x = S.var("data", shape=(4, 8))
+    with mx.AttrScope(ctx_group="dev1"):
+        h = S.Activation(S.FullyConnected(x, num_hidden=16, name="fc1"),
+                         act_type="relu")
+    with mx.AttrScope(ctx_group="dev2"):
+        out_sym = S.FullyConnected(h, num_hidden=3, name="fc2")
+    # attrs recorded dunder-wrapped so op kwargs are unpolluted
+    node = out_sym._outputs[0][0]
+    assert node.attrs["__ctx_group__"] == "dev2"
+
+    g2c = {"dev1": mx.Context("cpu", 0), "dev2": mx.Context("cpu", 1)}
+    exe = out_sym.simple_bind(ctx=mx.cpu(), group2ctx=g2c, data=(4, 8))
+    rs = np.random.RandomState(0)
+    for n, arr in exe.arg_dict.items():
+        arr._set_data(np.asarray(rs.randn(*arr.shape), np.float32))
+    exe.forward(is_train=True)
+    exe.backward(out_grads=nd.ones((4, 3)))
+    assert np.isfinite(exe.grad_dict["fc1_weight"].asnumpy()).all()
+
+    # placed execution matches the single-device jitted executor exactly
+    exe2 = out_sym.simple_bind(ctx=mx.cpu(), data=(4, 8))
+    for n in exe2.arg_dict:
+        exe2.arg_dict[n]._set_data(exe.arg_dict[n].data())
+    r1 = exe.forward(is_train=False)[0].asnumpy()
+    r2 = exe2.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(r1, r2, rtol=1e-6, atol=1e-7)
+
+
+def test_ctx_group_cross_group_merge():
+    """An ungrouped node merging outputs from two different groups must
+    re-colocate them (reference AssignContext copy-node insertion), not
+    crash on mixed device commitments."""
+    import jax
+
+    from mxnet_tpu import sym as S
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    x = S.var("data", shape=(4, 8))
+    with mx.AttrScope(ctx_group="dev1"):
+        a = S.FullyConnected(x, num_hidden=6, name="fca")
+    with mx.AttrScope(ctx_group="dev2"):
+        b = S.FullyConnected(x, num_hidden=6, name="fcb")
+    out_sym = a + b  # ungrouped merge node
+    g2c = {"dev1": mx.Context("cpu", 0), "dev2": mx.Context("cpu", 1)}
+    exe = out_sym.simple_bind(ctx=mx.cpu(), group2ctx=g2c, data=(4, 8))
+    rs = np.random.RandomState(1)
+    for n, arr in exe.arg_dict.items():
+        arr._set_data(np.asarray(rs.randn(*arr.shape), np.float32))
+    res = exe.forward(is_train=False)[0].asnumpy()
+    exe2 = out_sym.simple_bind(ctx=mx.cpu(), data=(4, 8))
+    for n in exe2.arg_dict:
+        exe2.arg_dict[n]._set_data(exe.arg_dict[n].data())
+    res2 = exe2.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(res, res2, rtol=1e-6, atol=1e-7)
+
+
+def test_attr_scope_nesting_and_restore():
+    from mxnet_tpu import sym as S
+    from mxnet_tpu.symbol.symbol import AttrScope
+
+    with mx.AttrScope(ctx_group="a"):
+        s1 = S.relu(S.var("x1", shape=(2,)))
+        with mx.AttrScope(ctx_group="b"):
+            s2 = S.relu(S.var("x2", shape=(2,)))
+        s3 = S.relu(S.var("x3", shape=(2,)))
+    s4 = S.relu(S.var("x4", shape=(2,)))
+    assert s1._outputs[0][0].attrs["__ctx_group__"] == "a"
+    assert s2._outputs[0][0].attrs["__ctx_group__"] == "b"
+    assert s3._outputs[0][0].attrs["__ctx_group__"] == "a"
+    assert "__ctx_group__" not in s4._outputs[0][0].attrs
+    assert AttrScope.current() == {}

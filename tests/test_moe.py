@@ -67,6 +67,21 @@ def test_sharded_parity_and_errors():
     np.testing.assert_allclose(np.asarray(y_sh), np.asarray(y_ref),
                                rtol=1e-5, atol=1e-6)
     assert abs(float(aux_sh) - float(aux_ref)) < 1e-6
+    # GRADIENT parity dense vs sharded (shard_map+psum transpose path)
+    def loss_dense(wi_, wo_, rw_):
+        y, aux = parallel.moe_ffn(x, rw_, wi_, wo_)
+        return jnp.sum(y * y) + 0.01 * aux
+
+    def loss_sharded(wi_, wo_, rw_):
+        y, aux = parallel.moe_ffn_sharded(x, rw_, wi_, wo_, mesh)
+        return jnp.sum(y * y) + 0.01 * aux
+
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(wi, wo, rw)
+    gs = jax.grad(loss_sharded, argnums=(0, 1, 2))(wi, wo, rw)
+    for a, b in zip(gd, gs):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-5, atol=1e-6)
+
     mesh3 = Mesh(np.array(jax.devices()[:3]), ("expert",))
     with pytest.raises(mx.MXNetError, match="divide"):
         parallel.moe_ffn_sharded(x, rw, wi, wo, mesh3)

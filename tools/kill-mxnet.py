@@ -50,7 +50,10 @@ def find_procs(needle):
 
 
 def main():
-    needle = sys.argv[1] if len(sys.argv) > 1 else "mxnet_tpu"
+    # default: anything running code from THIS repo (the package name
+    # rarely appears on the command line; the repo path does)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    needle = sys.argv[1] if len(sys.argv) > 1 else repo
     procs = find_procs(needle)
     if not procs:
         print("no matching processes for %r" % needle)
