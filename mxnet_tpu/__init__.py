@@ -49,6 +49,7 @@ from . import symbol  # noqa: F401
 from . import symbol as sym  # noqa: F401
 from .symbol import AttrScope  # noqa: F401
 from . import model  # noqa: F401
+from . import rnn  # noqa: F401
 from . import callback  # noqa: F401
 from . import module  # noqa: F401
 from . import monitor  # noqa: F401

@@ -1,0 +1,14 @@
+"""Legacy recurrent API (parity: ``python/mxnet/rnn/``)."""
+from . import rnn_cell  # noqa: F401
+from . import io  # noqa: F401
+from . import rnn  # noqa: F401
+from .rnn_cell import (  # noqa: F401
+    RNNParams, BaseRNNCell, RNNCell, LSTMCell, GRUCell, FusedRNNCell,
+    SequentialRNNCell, DropoutCell, ModifierCell, ZoneoutCell,
+    ResidualCell, BidirectionalCell, ConvRNNCell, ConvLSTMCell,
+    ConvGRUCell,
+)
+from .io import BucketSentenceIter, encode_sentences  # noqa: F401
+from .rnn import (  # noqa: F401
+    save_rnn_checkpoint, load_rnn_checkpoint, do_rnn_checkpoint,
+)

@@ -1,0 +1,45 @@
+"""RNN checkpoint helpers (parity: python/mxnet/rnn/rnn.py).
+
+Cells' fused/unfused weight layouts differ; these helpers pack weights
+through the cells before saving and unpack after loading so checkpoints
+interchange between ``FusedRNNCell`` graphs and unfused stacks.
+"""
+from __future__ import annotations
+
+from .. import model
+from .rnn_cell import BaseRNNCell
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+def save_rnn_checkpoint(cells, prefix, epoch, symbol, arg_params,
+                        aux_params):
+    """Save symbol + packed params (parity: rnn.py:32)."""
+    cells = _as_list(cells)
+    for cell in cells:
+        arg_params = cell.pack_weights(arg_params)
+    model.save_checkpoint(prefix, epoch, symbol, arg_params, aux_params)
+
+
+def load_rnn_checkpoint(cells, prefix, epoch):
+    """Load symbol + params, unpacking through the cells
+    (parity: rnn.py:62)."""
+    sym, arg, aux = model.load_checkpoint(prefix, epoch)
+    cells = _as_list(cells)
+    for cell in cells:
+        arg = cell.unpack_weights(arg)
+    return sym, arg, aux
+
+
+def do_rnn_checkpoint(cells, prefix, period=1):
+    """Epoch-end callback doing save_rnn_checkpoint
+    (parity: rnn.py:97)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
+
+    return _callback

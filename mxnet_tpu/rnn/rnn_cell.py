@@ -1,0 +1,907 @@
+"""Legacy symbol-level RNN cell API (parity: python/mxnet/rnn/rnn_cell.py).
+
+The pre-gluon recurrent surface: cells compose ``mx.sym`` graphs one
+time step at a time (``cell(inputs, states)``), ``unroll`` builds the
+whole sequence graph, ``FusedRNNCell`` maps onto the monolithic ``RNN``
+operator (here a fused ``lax.scan`` chain — ops/nn.py:649 — instead of
+cuDNN), and ``unpack_weights``/``pack_weights`` convert between the
+fused op's packed parameter vector and per-gate matrices so fused and
+unfused graphs interchange checkpoints, exactly like the reference.
+
+The gluon cells (``gluon/rnn/rnn_cell.py``) are the modern path; this
+package exists so reference code using ``mx.rnn.*`` runs unchanged.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from .. import symbol
+from .. import initializer as init
+
+__all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "FusedRNNCell", "SequentialRNNCell", "DropoutCell",
+           "ModifierCell", "ZoneoutCell", "ResidualCell",
+           "BidirectionalCell", "ConvRNNCell", "ConvLSTMCell",
+           "ConvGRUCell"]
+
+
+class RNNParams:
+    """Container for holding variables (parity: rnn_cell.py RNNParams).
+    Cells sharing one RNNParams share weights."""
+
+    def __init__(self, prefix=""):
+        self._prefix = prefix
+        self._params = {}
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        if name not in self._params:
+            self._params[name] = symbol.var(name, **kwargs)
+        return self._params[name]
+
+
+def _cells_state_info(cells):
+    return sum([c.state_info for c in cells], [])
+
+
+def _cells_begin_state(cells, **kwargs):
+    return sum([c.begin_state(**kwargs) for c in cells], [])
+
+
+def _cells_unpack_weights(cells, args):
+    for cell in cells:
+        args = cell.unpack_weights(args)
+    return args
+
+
+def _cells_pack_weights(cells, args):
+    for cell in cells:
+        args = cell.pack_weights(args)
+    return args
+
+
+def _normalize_sequence(length, inputs, layout, merge, in_layout=None):
+    """Split/merge between a single (T-major or N-major) symbol and a
+    per-step list (parity: rnn_cell.py _normalize_sequence)."""
+    assert inputs is not None
+    axis = layout.find("T")
+    in_axis = in_layout.find("T") if in_layout is not None else axis
+    if isinstance(inputs, symbol.Symbol):
+        if merge is False:
+            outs = symbol.SliceChannel(inputs, axis=in_axis,
+                                        num_outputs=length,
+                                        squeeze_axis=1)
+            inputs = list(outs)
+    else:
+        assert length is None or len(inputs) == length
+        if merge is True:
+            inputs = [symbol.expand_dims(i, axis=axis) for i in inputs]
+            inputs = symbol.concat(*inputs, dim=axis)
+            in_axis = axis
+    if isinstance(inputs, symbol.Symbol) and axis != in_axis:
+        inputs = symbol.swapaxes(inputs, dim1=axis, dim2=in_axis)
+    return inputs, axis
+
+
+
+
+def _infer_batch(inputs, layout):
+    """Batch size from an input symbol/list when statically known."""
+    try:
+        if isinstance(inputs, symbol.Symbol):
+            return inputs.shape[layout.find("N")]
+        return inputs[0].shape[0]
+    except Exception:
+        return 0
+
+
+class BaseRNNCell:
+    """Abstract base (parity: rnn_cell.py BaseRNNCell)."""
+
+    def __init__(self, prefix="", params=None):
+        if params is None:
+            params = RNNParams(prefix)
+            self._own_params = True
+        else:
+            self._own_params = False
+        self._prefix = prefix
+        self._params = params
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        if hasattr(self, "_cells"):
+            for cell in self._cells:
+                cell.reset()
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError()
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self._params
+
+    @property
+    def state_info(self):
+        raise NotImplementedError()
+
+    @property
+    def state_shape(self):
+        return [ele["shape"] for ele in self.state_info]
+
+    @property
+    def _gate_names(self):
+        return ()
+
+    def begin_state(self, func=symbol.zeros, batch_size=0, **kwargs):
+        """Initial states.  ``batch_size`` (extension over the reference)
+        substitutes unknown (0) dims so constants stay static-shaped on
+        XLA; ``unroll`` fills it from the input symbol automatically."""
+        assert not self._modified, \
+            "After applying modifier cells the base cell cannot be called"
+        states = []
+        for info in self.state_info:
+            self._init_counter += 1
+            name = "%sbegin_state_%d" % (self._prefix,
+                                         self._init_counter)
+            kw = dict(kwargs)
+            if info is not None:
+                kw.update(info)
+                kw.pop("__layout__", None)
+            shape = kw.get("shape")
+            if shape is not None and batch_size:
+                shape = tuple(batch_size if d == 0 else d for d in shape)
+                kw["shape"] = shape
+            if func in (symbol.zeros, symbol.ones) and shape is not None \
+                    and any(d == 0 for d in shape):
+                # unknown dims (batch) cannot materialize a constant on
+                # XLA's static shapes; the state becomes a bindable
+                # variable instead — simple_bind/Module feed zeros, which
+                # reproduces the reference's deferred-shape zeros
+                kw.pop("dtype", None)
+                state = symbol.var(name, **kw)
+            else:
+                state = func(name=name, **kw)
+            states.append(state)
+        return states
+
+    def unpack_weights(self, args):
+        """Split fused per-cell i2h/h2h matrices into per-gate entries
+        (parity: rnn_cell.py:225)."""
+        args = args.copy()
+        if not self._gate_names:
+            return args
+        h = self._num_hidden
+        for group_name in ("i2h", "h2h"):
+            for t in ("weight", "bias"):
+                name = "%s%s_%s" % (self._prefix, group_name, t)
+                if name not in args:
+                    continue
+                arr = args.pop(name)
+                for j, gate in enumerate(self._gate_names):
+                    wname = "%s%s%s_%s" % (self._prefix, group_name,
+                                           gate, t)
+                    args[wname] = arr[j * h:(j + 1) * h].copy()
+        return args
+
+    def pack_weights(self, args):
+        """Inverse of ``unpack_weights`` (parity: rnn_cell.py:265)."""
+        args = args.copy()
+        if not self._gate_names:
+            return args
+        for group_name in ("i2h", "h2h"):
+            for t in ("weight", "bias"):
+                pieces = []
+                for gate in self._gate_names:
+                    wname = "%s%s%s_%s" % (self._prefix, group_name,
+                                           gate, t)
+                    if wname not in args:
+                        pieces = None
+                        break
+                    pieces.append(args.pop(wname))
+                if pieces:
+                    name = "%s%s_%s" % (self._prefix, group_name, t)
+                    args[name] = nd.concatenate(pieces)
+        return args
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        """Unroll the cell across ``length`` steps
+        (parity: rnn_cell.py:295)."""
+        self.reset()
+        batch = _infer_batch(inputs, layout)
+        inputs, _ = _normalize_sequence(length, inputs, layout, False)
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size=batch)
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+        outputs, _ = _normalize_sequence(length, outputs, layout,
+                                         merge_outputs)
+        return outputs, states
+
+    def _get_activation(self, inputs, activation, **kwargs):
+        if isinstance(activation, str):
+            return symbol.Activation(inputs, act_type=activation, **kwargs)
+        return activation(inputs, **kwargs)
+
+
+class RNNCell(BaseRNNCell):
+    """Elman RNN cell (parity: rnn_cell.py RNNCell)."""
+
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_",
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._activation = activation
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("",)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = symbol.FullyConnected(inputs, self._iW, self._iB,
+                                    num_hidden=self._num_hidden,
+                                    name="%si2h" % name)
+        h2h = symbol.FullyConnected(states[0], self._hW, self._hB,
+                                    num_hidden=self._num_hidden,
+                                    name="%sh2h" % name)
+        output = self._get_activation(i2h + h2h, self._activation,
+                                      name="%sout" % name)
+        return output, [output]
+
+
+class LSTMCell(BaseRNNCell):
+    """LSTM cell (parity: rnn_cell.py LSTMCell); gate order i, f, c, o
+    matches the fused RNN op."""
+
+    def __init__(self, num_hidden, prefix="lstm_", params=None,
+                 forget_bias=1.0):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        self._hW = self.params.get("h2h_weight")
+        self._iB = self.params.get(
+            "i2h_bias",
+            init=init.LSTMBias(forget_bias=forget_bias))
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"},
+                {"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_i", "_f", "_c", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = symbol.FullyConnected(inputs, self._iW, self._iB,
+                                    num_hidden=self._num_hidden * 4,
+                                    name="%si2h" % name)
+        h2h = symbol.FullyConnected(states[0], self._hW, self._hB,
+                                    num_hidden=self._num_hidden * 4,
+                                    name="%sh2h" % name)
+        gates = i2h + h2h
+        sliced = list(symbol.SliceChannel(gates, num_outputs=4,
+                                           name="%sslice" % name))
+        in_gate = symbol.Activation(sliced[0], act_type="sigmoid")
+        forget_gate = symbol.Activation(sliced[1], act_type="sigmoid")
+        in_trans = symbol.Activation(sliced[2], act_type="tanh")
+        out_gate = symbol.Activation(sliced[3], act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_trans
+        next_h = out_gate * symbol.Activation(next_c, act_type="tanh")
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(BaseRNNCell):
+    """GRU cell (parity: rnn_cell.py GRUCell); gate order r, z, n."""
+
+    def __init__(self, num_hidden, prefix="gru_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_r", "_z", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        prev_h = states[0]
+        i2h = symbol.FullyConnected(inputs, self._iW, self._iB,
+                                    num_hidden=self._num_hidden * 3,
+                                    name="%si2h" % name)
+        h2h = symbol.FullyConnected(prev_h, self._hW, self._hB,
+                                    num_hidden=self._num_hidden * 3,
+                                    name="%sh2h" % name)
+        i2h_r, i2h_z, i2h_n = list(symbol.SliceChannel(i2h, num_outputs=3))
+        h2h_r, h2h_z, h2h_n = list(symbol.SliceChannel(h2h, num_outputs=3))
+        reset = symbol.Activation(i2h_r + h2h_r, act_type="sigmoid")
+        update = symbol.Activation(i2h_z + h2h_z, act_type="sigmoid")
+        next_h_tmp = symbol.Activation(i2h_n + reset * h2h_n,
+                                       act_type="tanh")
+        next_h = (1.0 - update) * next_h_tmp + update * prev_h
+        return next_h, [next_h]
+
+
+class FusedRNNCell(BaseRNNCell):
+    """Whole-sequence fused RNN via the monolithic ``RNN`` op (parity:
+    rnn_cell.py FusedRNNCell; cuDNN becomes a lax.scan chain)."""
+
+    def __init__(self, num_hidden, num_layers=1, mode="lstm",
+                 bidirectional=False, dropout=0., get_next_state=False,
+                 forget_bias=1.0, prefix=None, params=None):
+        if prefix is None:
+            prefix = "%s_" % mode
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._dropout = dropout
+        self._get_next_state = get_next_state
+        self._directions = ["l", "r"] if bidirectional else ["l"]
+        initializer = init.FusedRNN(None, num_hidden, num_layers, mode,
+                                    bidirectional, forget_bias)
+        self._parameter = self.params.get("parameters", init=initializer)
+
+    @property
+    def state_info(self):
+        b = self._bidirectional + 1
+        n = (self._mode == "lstm") + 1
+        return [{"shape": (b * self._num_layers, 0, self._num_hidden),
+                 "__layout__": "LNC"} for _ in range(n)]
+
+    @property
+    def _gate_names(self):
+        return {"rnn_relu": [""], "rnn_tanh": [""],
+                "lstm": ["_i", "_f", "_c", "_o"],
+                "gru": ["_r", "_z", "_o"]}[self._mode]
+
+    @property
+    def _num_gates(self):
+        return len(self._gate_names)
+
+    def __call__(self, inputs, states):
+        raise MXNetError(
+            "FusedRNNCell cannot be stepped — use unroll (the whole "
+            "sequence is one fused op)")
+
+    def _layer_param_shapes(self, num_input):
+        """[(name, shape)] in the PACKED vector's order (weights of every
+        layer/direction first, then biases — rnn-inl.h layout)."""
+        h = self._num_hidden
+        m = self._num_gates
+        dirs = self._directions
+        shapes = []
+        for group in ("weight", "bias"):
+            for layer in range(self._num_layers):
+                in_size = num_input if layer == 0 \
+                    else h * len(dirs)
+                for d in dirs:
+                    for conn in ("i2h", "h2h"):
+                        for gate in self._gate_names:
+                            name = "%s%s%d_%s%s_%s" % (
+                                self._prefix, d, layer, conn, gate, group)
+                            if group == "weight":
+                                size = in_size if conn == "i2h" else h
+                                shapes.append((name, (h, size)))
+                            else:
+                                shapes.append((name, (h,)))
+        return shapes
+
+    def unpack_weights(self, args):
+        args = args.copy()
+        if self._parameter.name not in args:
+            return args  # already unpacked
+        arr = args.pop(self._parameter.name)
+        arr_np = arr.asnumpy().reshape(-1)
+        b = len(self._directions)
+        m = self._num_gates
+        h = self._num_hidden
+        num_input = arr_np.size // b // h // m \
+            - (self._num_layers - 1) * (h + b * h + 2) - h - 2
+        offset = 0
+        for name, shape in self._layer_param_shapes(num_input):
+            size = int(_np.prod(shape))
+            args[name] = nd.array(
+                arr_np[offset:offset + size].reshape(shape))
+            offset += size
+        assert offset == arr_np.size, "packed parameter size mismatch"
+        return args
+
+    def pack_weights(self, args):
+        args = args.copy()
+        w0_name = "%sl0_i2h%s_weight" % (self._prefix,
+                                         self._gate_names[0])
+        if w0_name not in args:
+            return args  # already packed
+        w0 = args[w0_name]
+        num_input = w0.shape[1]
+        pieces = []
+        for name, shape in self._layer_param_shapes(num_input):
+            pieces.append(args.pop(name).asnumpy().reshape(-1))
+        args[self._parameter.name] = nd.array(_np.concatenate(pieces))
+        return args
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        batch = _infer_batch(inputs, layout)
+        inputs, axis = _normalize_sequence(length, inputs, layout, True)
+        if axis == 1:  # RNN op wants TNC
+            inputs = symbol.swapaxes(inputs, dim1=0, dim2=1)
+        if begin_state is None:
+            begin_state = self.begin_state(
+                func=symbol.zeros, batch_size=batch, dtype="float32")
+        states = begin_state
+        if self._mode == "lstm":
+            rnn = symbol.RNN(inputs, self._parameter, states[0],
+                             states[1], state_size=self._num_hidden,
+                             num_layers=self._num_layers,
+                             bidirectional=self._bidirectional,
+                             p=self._dropout, state_outputs=True,
+                             mode=self._mode,
+                             name="%srnn" % self._prefix)
+        else:
+            rnn = symbol.RNN(inputs, self._parameter, states[0],
+                             state_size=self._num_hidden,
+                             num_layers=self._num_layers,
+                             bidirectional=self._bidirectional,
+                             p=self._dropout, state_outputs=True,
+                             mode=self._mode,
+                             name="%srnn" % self._prefix)
+        outputs = rnn[0]
+        states = [rnn[1], rnn[2]] if self._mode == "lstm" else [rnn[1]]
+        if axis == 1:
+            outputs = symbol.swapaxes(outputs, dim1=0, dim2=1)
+        if merge_outputs is False:
+            outputs, _ = _normalize_sequence(length, outputs, layout,
+                                             False, in_layout=layout)
+        if not self._get_next_state:
+            states = []
+        return outputs, states
+
+    def unfuse(self):
+        """Equivalent SequentialRNNCell of unfused cells (parity:
+        rnn_cell.py:733)."""
+        stack = SequentialRNNCell()
+        get_cell = {
+            "rnn_relu": lambda p: RNNCell(self._num_hidden,
+                                          activation="relu", prefix=p),
+            "rnn_tanh": lambda p: RNNCell(self._num_hidden,
+                                          activation="tanh", prefix=p),
+            "lstm": lambda p: LSTMCell(self._num_hidden, prefix=p),
+            "gru": lambda p: GRUCell(self._num_hidden, prefix=p),
+        }[self._mode]
+        for i in range(self._num_layers):
+            if self._bidirectional:
+                stack.add(BidirectionalCell(
+                    get_cell("%sl%d_" % (self._prefix, i)),
+                    get_cell("%sr%d_" % (self._prefix, i)),
+                    output_prefix="%sbi_l%d_" % (self._prefix, i)))
+            else:
+                stack.add(get_cell("%sl%d_" % (self._prefix, i)))
+            if self._dropout > 0 and i != self._num_layers - 1:
+                stack.add(DropoutCell(
+                    self._dropout, prefix="%s_dropout%d_"
+                    % (self._prefix, i)))
+        return stack
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """Stack cells sequentially (parity: rnn_cell.py:748)."""
+
+    def __init__(self, params=None):
+        super().__init__(prefix="", params=params)
+        self._override_cell_params = params is not None
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+        if self._override_cell_params:
+            assert cell._own_params, \
+                "Either specify params for SequentialRNNCell or child cells, not both."
+            cell.params._params.update(self.params._params)
+        self.params._params.update(cell.params._params)
+
+    @property
+    def state_info(self):
+        return _cells_state_info(self._cells)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._cells, **kwargs)
+
+    def unpack_weights(self, args):
+        return _cells_unpack_weights(self._cells, args)
+
+    def pack_weights(self, args):
+        return _cells_pack_weights(self._cells, args)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._cells:
+            n = len(cell.state_info)
+            state = states[p:p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.append(state)
+        return inputs, sum(next_states, [])
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        num_cells = len(self._cells)
+        if begin_state is None:
+            begin_state = self.begin_state(
+                batch_size=_infer_batch(inputs, layout))
+        p = 0
+        next_states = []
+        for i, cell in enumerate(self._cells):
+            n = len(cell.state_info)
+            states = begin_state[p:p + n]
+            p += n
+            inputs, states = cell.unroll(
+                length, inputs=inputs, begin_state=states, layout=layout,
+                merge_outputs=None if i < num_cells - 1
+                else merge_outputs)
+            next_states.extend(states)
+        return inputs, next_states
+
+
+class DropoutCell(BaseRNNCell):
+    """Dropout on cell input (parity: rnn_cell.py:827)."""
+
+    def __init__(self, dropout, prefix="dropout_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.dropout = dropout
+
+    @property
+    def state_info(self):
+        return []
+
+    def __call__(self, inputs, states):
+        if self.dropout > 0:
+            inputs = symbol.Dropout(inputs, p=self.dropout)
+        return inputs, states
+
+
+class ModifierCell(BaseRNNCell):
+    """Base for cells that wrap another cell (parity: rnn_cell.py:867)."""
+
+    def __init__(self, base_cell):
+        super().__init__()
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self.base_cell.params
+
+    @property
+    def state_info(self):
+        return self.base_cell.state_info
+
+    def begin_state(self, func=symbol.zeros, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(func=func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+    def unpack_weights(self, args):
+        return self.base_cell.unpack_weights(args)
+
+    def pack_weights(self, args):
+        return self.base_cell.pack_weights(args)
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization (parity: rnn_cell.py:909)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0., zoneout_states=0.):
+        assert not isinstance(base_cell, FusedRNNCell), \
+            "FusedRNNCell doesn't support zoneout; unfuse() first"
+        assert not isinstance(base_cell, BidirectionalCell), \
+            "BidirectionalCell doesn't support zoneout; wrap the cells"
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self.prev_output = None
+
+    def reset(self):
+        super().reset()
+        self.prev_output = None
+
+    def __call__(self, inputs, states):
+        cell, p_outputs, p_states = (self.base_cell,
+                                     self.zoneout_outputs,
+                                     self.zoneout_states)
+        next_output, next_states = cell(inputs, states)
+
+        def mask(p, like):
+            return symbol.Dropout(symbol.ones_like(like), p=p)
+
+        prev_output = self.prev_output if self.prev_output is not None \
+            else symbol.zeros_like(next_output)
+        output = symbol.where(mask(p_outputs, next_output), next_output,
+                              prev_output) if p_outputs != 0. \
+            else next_output
+        states = [symbol.where(mask(p_states, new_s), new_s, old_s)
+                  for new_s, old_s in zip(next_states, states)] \
+            if p_states != 0. else next_states
+        self.prev_output = output
+        return output, states
+
+
+class ResidualCell(ModifierCell):
+    """Adds the input to the output (parity: rnn_cell.py:957)."""
+
+    def __call__(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        output = output + inputs
+        return output, states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        self.base_cell._modified = False
+        outputs, states = self.base_cell.unroll(
+            length, inputs=inputs, begin_state=begin_state, layout=layout,
+            merge_outputs=merge_outputs)
+        self.base_cell._modified = True
+        merge_outputs = isinstance(outputs, symbol.Symbol) \
+            if merge_outputs is None else merge_outputs
+        inputs, _ = _normalize_sequence(length, inputs, layout,
+                                        merge_outputs)
+        if merge_outputs:
+            outputs = outputs + inputs
+        else:
+            outputs = [out + inp for out, inp in zip(outputs, inputs)]
+        return outputs, states
+
+
+class BidirectionalCell(BaseRNNCell):
+    """Forward + backward cells over the sequence (parity:
+    rnn_cell.py:998).  Step-by-step calling is impossible (the backward
+    direction needs the whole sequence); use ``unroll``."""
+
+    def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
+        super().__init__("", params=params)
+        self._output_prefix = output_prefix
+        self._cells = [l_cell, r_cell]
+
+    def unpack_weights(self, args):
+        return _cells_unpack_weights(self._cells, args)
+
+    def pack_weights(self, args):
+        return _cells_pack_weights(self._cells, args)
+
+    def __call__(self, inputs, states):
+        raise MXNetError(
+            "Bidirectional cannot be stepped. Please use unroll")
+
+    @property
+    def state_info(self):
+        return _cells_state_info(self._cells)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._cells, **kwargs)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        batch = _infer_batch(inputs, layout)
+        inputs, axis = _normalize_sequence(length, inputs, layout, False)
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size=batch)
+        states = begin_state
+        l_cell, r_cell = self._cells
+        l_outputs, l_states = l_cell.unroll(
+            length, inputs=inputs,
+            begin_state=states[:len(l_cell.state_info)],
+            layout=layout, merge_outputs=merge_outputs)
+        r_outputs, r_states = r_cell.unroll(
+            length, inputs=list(reversed(inputs)),
+            begin_state=states[len(l_cell.state_info):],
+            layout=layout, merge_outputs=merge_outputs)
+        if merge_outputs is None:
+            merge_outputs = isinstance(l_outputs, symbol.Symbol) \
+                and isinstance(r_outputs, symbol.Symbol)
+            l_outputs, _ = _normalize_sequence(length, l_outputs, layout,
+                                               merge_outputs)
+            r_outputs, _ = _normalize_sequence(length, r_outputs, layout,
+                                               merge_outputs)
+        if merge_outputs:
+            r_outputs = symbol.reverse(r_outputs, axis=axis)
+            outputs = symbol.concat(l_outputs, r_outputs, dim=2,
+                                    name="%sout" % self._output_prefix)
+        else:
+            outputs = [
+                symbol.concat(l_o, r_o, dim=1,
+                              name="%st%d" % (self._output_prefix, i))
+                for i, (l_o, r_o) in enumerate(
+                    zip(l_outputs, reversed(r_outputs)))]
+        states = l_states + r_states
+        return outputs, states
+
+
+class BaseConvRNNCell(BaseRNNCell):
+    """Base for convolutional RNN cells (parity: rnn_cell.py:1094)."""
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel, h2h_dilate,
+                 i2h_kernel, i2h_stride, i2h_pad, i2h_dilate, activation,
+                 prefix="", params=None, conv_layout="NCHW"):
+        super().__init__(prefix=prefix, params=params)
+        self._h2h_kernel = h2h_kernel
+        self._h2h_pad = (h2h_dilate[0] * (h2h_kernel[0] - 1) // 2,
+                         h2h_dilate[1] * (h2h_kernel[1] - 1) // 2)
+        self._h2h_dilate = h2h_dilate
+        self._i2h_kernel = i2h_kernel
+        self._i2h_stride = i2h_stride
+        self._i2h_pad = i2h_pad
+        self._i2h_dilate = i2h_dilate
+        self._num_hidden = num_hidden
+        self._input_shape = input_shape
+        self._conv_layout = conv_layout
+        self._activation = activation
+        # infer state shape from a conv of the input shape
+        data = symbol.var("__tmp__", shape=(1,) + tuple(input_shape))
+        state = symbol.Convolution(
+            data, symbol.var("__tmp_w__"), symbol.var("__tmp_b__"),
+            kernel=self._i2h_kernel, stride=self._i2h_stride,
+            pad=self._i2h_pad, dilate=self._i2h_dilate,
+            num_filter=self._num_hidden, layout=conv_layout)
+        self._state_shape = state.infer_shape(
+            __tmp__=(1,) + tuple(input_shape))[1][0]
+        self._state_shape = (0,) + self._state_shape[1:]
+        self._iW = self.params.get("i2h_weight")
+        self._hW = self.params.get("h2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def _num_gates(self):
+        return len(self._gate_names)
+
+    @property
+    def state_info(self):
+        return [{"shape": self._state_shape, "__layout__":
+                 self._conv_layout}
+                for _ in range(2 if isinstance(self, ConvLSTMCell) else 1)]
+
+    def _conv_forward(self, inputs, states, name):
+        i2h = symbol.Convolution(
+            inputs, self._iW, self._iB, kernel=self._i2h_kernel,
+            stride=self._i2h_stride, pad=self._i2h_pad,
+            dilate=self._i2h_dilate,
+            num_filter=self._num_hidden * self._num_gates,
+            layout=self._conv_layout, name="%si2h" % name)
+        h2h = symbol.Convolution(
+            states[0], self._hW, self._hB, kernel=self._h2h_kernel,
+            dilate=self._h2h_dilate, pad=self._h2h_pad,
+            num_filter=self._num_hidden * self._num_gates,
+            layout=self._conv_layout, name="%sh2h" % name)
+        return i2h, h2h
+
+
+class ConvRNNCell(BaseConvRNNCell):
+    """Convolutional Elman cell (parity: rnn_cell.py:1176)."""
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel=(3, 3),
+                 h2h_dilate=(1, 1), i2h_kernel=(3, 3), i2h_stride=(1, 1),
+                 i2h_pad=(1, 1), i2h_dilate=(1, 1), activation="tanh",
+                 prefix="ConvRNN_", params=None, conv_layout="NCHW"):
+        super().__init__(input_shape, num_hidden, h2h_kernel, h2h_dilate,
+                         i2h_kernel, i2h_stride, i2h_pad, i2h_dilate,
+                         activation, prefix, params, conv_layout)
+
+    @property
+    def _gate_names(self):
+        return ("",)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h, h2h = self._conv_forward(inputs, states, name)
+        output = self._get_activation(i2h + h2h, self._activation,
+                                      name="%sout" % name)
+        return output, [output]
+
+
+class ConvLSTMCell(BaseConvRNNCell):
+    """Convolutional LSTM (parity: rnn_cell.py:1253; Shi et al. 2015)."""
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel=(3, 3),
+                 h2h_dilate=(1, 1), i2h_kernel=(3, 3), i2h_stride=(1, 1),
+                 i2h_pad=(1, 1), i2h_dilate=(1, 1), activation="tanh",
+                 prefix="ConvLSTM_", params=None, forget_bias=1.0,
+                 conv_layout="NCHW"):
+        super().__init__(input_shape, num_hidden, h2h_kernel, h2h_dilate,
+                         i2h_kernel, i2h_stride, i2h_pad, i2h_dilate,
+                         activation, prefix, params, conv_layout)
+
+    @property
+    def _gate_names(self):
+        return ("_i", "_f", "_c", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h, h2h = self._conv_forward(inputs, states, name)
+        gates = i2h + h2h
+        axis = 1 if self._conv_layout.startswith("NC") else 3
+        sliced = list(symbol.SliceChannel(gates, num_outputs=4,
+                                           axis=axis))
+        in_gate = symbol.Activation(sliced[0], act_type="sigmoid")
+        forget_gate = symbol.Activation(sliced[1], act_type="sigmoid")
+        in_trans = self._get_activation(sliced[2], self._activation)
+        out_gate = symbol.Activation(sliced[3], act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_trans
+        next_h = out_gate * self._get_activation(next_c, self._activation)
+        return next_h, [next_h, next_c]
+
+
+class ConvGRUCell(BaseConvRNNCell):
+    """Convolutional GRU (parity: rnn_cell.py:1349)."""
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel=(3, 3),
+                 h2h_dilate=(1, 1), i2h_kernel=(3, 3), i2h_stride=(1, 1),
+                 i2h_pad=(1, 1), i2h_dilate=(1, 1), activation="tanh",
+                 prefix="ConvGRU_", params=None, conv_layout="NCHW"):
+        super().__init__(input_shape, num_hidden, h2h_kernel, h2h_dilate,
+                         i2h_kernel, i2h_stride, i2h_pad, i2h_dilate,
+                         activation, prefix, params, conv_layout)
+
+    @property
+    def _gate_names(self):
+        return ("_r", "_z", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h, h2h = self._conv_forward(inputs, states, name)
+        axis = 1 if self._conv_layout.startswith("NC") else 3
+        i2h_r, i2h_z, i2h_n = list(symbol.SliceChannel(
+            i2h, num_outputs=3, axis=axis))
+        h2h_r, h2h_z, h2h_n = list(symbol.SliceChannel(
+            h2h, num_outputs=3, axis=axis))
+        reset = symbol.Activation(i2h_r + h2h_r, act_type="sigmoid")
+        update = symbol.Activation(i2h_z + h2h_z, act_type="sigmoid")
+        next_h_tmp = self._get_activation(i2h_n + reset * h2h_n,
+                                          self._activation)
+        next_h = (1.0 - update) * next_h_tmp + update * states[0]
+        return next_h, [next_h]

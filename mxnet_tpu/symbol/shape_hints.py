@@ -107,7 +107,31 @@ def _embedding(known, attrs):
     return {"weight": (input_dim, output_dim)}
 
 
+def _rnn(known, attrs):
+    data = known.get("data")
+    if data is None:
+        return None
+    from ..ops.nn import rnn_param_size
+
+    from .symbol import _truthy
+
+    state_size = int(attrs.get("state_size", 0))
+    num_layers = int(attrs.get("num_layers", 1))
+    mode = attrs.get("mode", "lstm")
+    bidir = _truthy(attrs.get("bidirectional", False))
+    if not state_size:
+        return None
+    n = rnn_param_size(mode, num_layers, data[-1], state_size, bidir)
+    dirs = 2 if bidir else 1
+    out = {"parameters": (n,),
+           "state": (num_layers * dirs, data[1], state_size)}
+    if mode == "lstm":
+        out["state_cell"] = (num_layers * dirs, data[1], state_size)
+    return out
+
+
 _HINTS = {
+    "RNN": _rnn,
     "FullyConnected": _fully_connected,
     "Convolution": _convolution,
     "Deconvolution": _deconvolution,

@@ -275,9 +275,13 @@ class Symbol:
         return Symbol(entries)
 
     # -- evaluation --------------------------------------------------------
-    def _make_fn(self, arg_names, mode="predict", group2ctx=None):
+    def _make_fn(self, arg_names, mode="predict", group2ctx=None,
+                 static_rng=False):
         """Pure function mapping {name: array} -> tuple of outputs.
 
+        ``static_rng=True`` feeds RNG ops a constant key — REQUIRED for
+        abstract evaluation (``jax.eval_shape``): drawing from the live
+        RNG stream under a trace would leak tracers into global state.
         ``group2ctx`` (group name -> Context) activates the reference's
         manual model-parallel placement: a node carrying an AttrScope
         ``ctx_group`` runs on that group's device, with cross-device
@@ -318,7 +322,9 @@ class Symbol:
                 ins = [vals[id(inp)][idx] for inp, idx in node.inputs]
                 attrs = _op_attrs(node, mode if reg.needs_mode else None)
                 if reg.needs_rng:
-                    ins = [_random.next_key()] + ins
+                    key = jax.random.PRNGKey(0) if static_rng \
+                        else _random.next_key()
+                    ins = [key] + ins
                 dev = dev_of.get(id(node))
                 if dev is not None:
                     ins = [jax.device_put(v, dev) for v in ins]
@@ -411,7 +417,7 @@ class Symbol:
             dt = known.get(n, _np.float32)
             sd[n] = jax.ShapeDtypeStruct((1,) * 4, _np.dtype(dt))
         try:
-            fn = self._make_fn(list(sd))
+            fn = self._make_fn(list(sd), static_rng=True)
             outs = jax.eval_shape(fn, sd)
             out_types = [o.dtype for o in outs]
         except Exception:
@@ -515,7 +521,7 @@ def _solve_shapes(sym, known, partial):
     sd = {n: jax.ShapeDtypeStruct(tuple(known[n]),
                                   dtypes.get(n, _np.float32))
           for n in input_names}
-    fn = sym._make_fn(input_names)
+    fn = sym._make_fn(input_names, static_rng=True)
     outs = jax.eval_shape(fn, sd)
     solved = dict(known)
     solved["__outputs__"] = [tuple(o.shape) for o in outs]
@@ -623,7 +629,8 @@ def load_json(json_str):
         node = _Node(None if op == "null" else op, jn["name"], attrs)
         node.inputs = [(nodes[i], oi) for i, oi, _ in jn["inputs"]]
         if node.op is not None:
-            node.num_outputs = _reg.get(node.op).num_outputs
+            node.num_outputs = _resolved_num_outputs(
+                _reg.get(node.op), attrs)
         nodes.append(node)
     heads = [(nodes[i], oi) for i, oi, _ in data["heads"]]
     return Symbol(heads)
@@ -631,6 +638,13 @@ def load_json(json_str):
 
 def _truthy(v):
     return v in (True, 1, "1", "true", "True")
+
+
+def _resolved_num_outputs(reg, attrs):
+    """Concrete output count: dynamic-output ops (num_outputs<=0, e.g.
+    split) take it from their num_outputs attr."""
+    return reg.num_outputs if reg.num_outputs > 0 \
+        else int(attrs.get("num_outputs", 1))
 
 
 def _unused_inputs(op_name, attrs):
@@ -644,6 +658,8 @@ def _unused_inputs(op_name, attrs):
         return ("bias",)
     if op_name == "softmax" and not _truthy(attrs.get("use_length", False)):
         return ("length",)
+    if op_name == "RNN" and attrs.get("mode", "lstm") != "lstm":
+        return ("state_cell",)
     return ()
 
 
@@ -682,10 +698,10 @@ def make_symbol_op(op_name):
                         entry_inputs.append(a._outputs[0])
             for k, v in AttrScope.current().items():
                 attrs.setdefault(k, v)
-            node = _Node(op_name, name, attrs, entry_inputs,
-                         reg.num_outputs)
-            return Symbol([(node, i) for i in range(reg.num_outputs)]) \
-                if reg.num_outputs > 1 else Symbol([(node, 0)])
+            n_out = _resolved_num_outputs(reg, attrs)
+            node = _Node(op_name, name, attrs, entry_inputs, n_out)
+            return Symbol([(node, i) for i in range(n_out)]) \
+                if n_out > 1 else Symbol([(node, 0)])
         # auto-create missing trailing variable inputs (weights etc.),
         # except inputs the op ignores under the given attrs (e.g. bias
         # under no_bias=1 — the reference's FListInputNames is attr-aware)
@@ -710,9 +726,10 @@ def make_symbol_op(op_name):
                 entries.append((vnode, 0))
         for k, v in AttrScope.current().items():
             attrs.setdefault(k, v)
-        node = _Node(op_name, name, attrs, entries, reg.num_outputs)
-        if reg.num_outputs > 1:
-            return Symbol([(node, i) for i in range(reg.num_outputs)])
+        n_out = _resolved_num_outputs(reg, attrs)
+        node = _Node(op_name, name, attrs, entries, n_out)
+        if n_out > 1:
+            return Symbol([(node, i) for i in range(n_out)])
         return Symbol([(node, 0)])
 
     sym_op.__name__ = op_name
@@ -727,13 +744,13 @@ def _binary(broadcast_op, scalar_op, lhs, rhs):
 
 
 def zeros(shape, dtype=None, name=None):
-    return make_symbol_op("zeros")(shape=shape, dtype=dtype or "float32",
-                                   name=name)
+    return make_symbol_op("_zeros")(shape=shape, dtype=dtype or "float32",
+                                    name=name)
 
 
 def ones(shape, dtype=None, name=None):
-    return make_symbol_op("ones")(shape=shape, dtype=dtype or "float32",
-                                  name=name)
+    return make_symbol_op("_ones")(shape=shape, dtype=dtype or "float32",
+                                   name=name)
 
 
 def arange(start, stop=None, step=1.0, dtype=None, name=None):

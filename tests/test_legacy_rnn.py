@@ -1,0 +1,196 @@
+"""Legacy mx.rnn package (parity: python/mxnet/rnn/): symbol cells,
+fused<->unfused weight interchange, bucketing iterator, checkpoints."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, rnn
+from mxnet_tpu import sym as S
+
+
+def _bind_and_fill(out_sym, data_shape, seed=0, x=None):
+    exe = out_sym.simple_bind(ctx=mx.cpu(), data=data_shape)
+    rs = np.random.RandomState(seed)
+    for n, arr in exe.arg_dict.items():
+        if n != "data":
+            arr._set_data(np.asarray(rs.rand(*arr.shape) * 0.4 - 0.2,
+                                     np.float32))
+    if x is None:
+        x = np.asarray(rs.rand(*data_shape), np.float32)
+    exe.arg_dict["data"]._set_data(x)
+    return exe, x
+
+
+@pytest.mark.parametrize("cell_fn,n_states", [
+    (lambda: rnn.RNNCell(8, prefix="r_"), 1),
+    (lambda: rnn.LSTMCell(8, prefix="l_"), 2),
+    (lambda: rnn.GRUCell(8, prefix="g_"), 1),
+])
+def test_cell_unroll_shapes_and_numerics(cell_fn, n_states):
+    cell = cell_fn()
+    data = S.var("data", shape=(2, 5, 4))
+    outs, states = cell.unroll(5, data, layout="NTC", merge_outputs=True)
+    assert outs.shape == (2, 5, 8)
+    assert len(states) == n_states
+    exe, _ = _bind_and_fill(outs, (2, 5, 4))
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert out.shape == (2, 5, 8) and np.isfinite(out).all()
+    # merge_outputs=False returns a per-step list
+    cell.reset()
+    outs_list, _ = cell.unroll(5, data, layout="NTC",
+                               merge_outputs=False)
+    assert isinstance(outs_list, list) and len(outs_list) == 5
+
+
+def test_lstm_cell_matches_numpy_recurrence():
+    cell = rnn.LSTMCell(3, prefix="l_")
+    data = S.var("data", shape=(1, 4, 2))
+    outs, _ = cell.unroll(4, data, layout="NTC", merge_outputs=True)
+    exe, x = _bind_and_fill(outs, (1, 4, 2), seed=3)
+    got = exe.forward(is_train=False)[0].asnumpy()[0]
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    iW = exe.arg_dict["l_i2h_weight"].asnumpy()
+    iB = exe.arg_dict["l_i2h_bias"].asnumpy()
+    hW = exe.arg_dict["l_h2h_weight"].asnumpy()
+    hB = exe.arg_dict["l_h2h_bias"].asnumpy()
+    h = np.zeros(3)
+    c = np.zeros(3)
+    for t in range(4):
+        gates = x[0, t] @ iW.T + iB + h @ hW.T + hB
+        i, f, g, o = np.split(gates, 4)
+        c = sig(f) * c + sig(i) * np.tanh(g)
+        h = sig(o) * np.tanh(c)
+        np.testing.assert_allclose(got[t], h, rtol=1e-5, atol=1e-5)
+
+
+def test_stacked_bidirectional_residual_zoneout():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.BidirectionalCell(rnn.GRUCell(4, prefix="f_"),
+                                    rnn.GRUCell(4, prefix="b_")))
+    stack.add(rnn.ResidualCell(rnn.RNNCell(8, prefix="top_")))
+    stack.add(rnn.DropoutCell(0.0))
+    data = S.var("data", shape=(2, 5, 4))
+    outs, _ = stack.unroll(5, data, layout="NTC", merge_outputs=True)
+    assert outs.shape == (2, 5, 8)
+    exe, _ = _bind_and_fill(outs, (2, 5, 4))
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert np.isfinite(out).all()
+
+    z = rnn.ZoneoutCell(rnn.RNNCell(4, prefix="z_"), zoneout_states=0.2)
+    outs_z, _ = z.unroll(3, S.var("data", shape=(2, 3, 4)), layout="NTC",
+                         merge_outputs=True)
+    assert outs_z.shape == (2, 3, 4)
+
+
+@pytest.mark.parametrize("mode,bidir,layers", [
+    ("lstm", False, 1),
+    ("lstm", True, 2),
+    ("gru", False, 2),
+    ("rnn_tanh", True, 1),
+])
+def test_fused_unfused_interchange(mode, bidir, layers):
+    """FusedRNNCell (monolithic RNN op) and its unfuse() stack produce
+    identical outputs through unpack_weights/pack_weights — the
+    reference's checkpoint-interchange contract."""
+    fused = rnn.FusedRNNCell(6, num_layers=layers, mode=mode,
+                             bidirectional=bidir,
+                             prefix="%s_" % mode, get_next_state=True)
+    fouts, _ = fused.unroll(4, S.var("data", shape=(2, 4, 3)),
+                            layout="NTC", merge_outputs=True)
+    exe, x = _bind_and_fill(fouts, (2, 4, 3), seed=1)
+    ref = exe.forward(is_train=False)[0].asnumpy()
+
+    args = {n: a for n, a in exe.arg_dict.items() if n != "data"}
+    unpacked = fused.unpack_weights(args)
+    stack = fused.unfuse()
+    consolidated = stack.pack_weights(unpacked)
+    uouts, _ = stack.unroll(4, S.var("data", shape=(2, 4, 3)),
+                            layout="NTC", merge_outputs=True)
+    exe2 = uouts.simple_bind(ctx=mx.cpu(), data=(2, 4, 3))
+    for n, arr in exe2.arg_dict.items():
+        if n == "data":
+            arr._set_data(x)
+        else:
+            assert n in consolidated, "missing unfused param %s" % n
+            arr._set_data(consolidated[n].data())
+    got = exe2.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    # pack(unpack(x)) == x
+    repacked = fused.pack_weights(fused.unpack_weights(args))
+    pname = "%s_parameters" % mode
+    np.testing.assert_allclose(repacked[pname].asnumpy(),
+                               args[pname].asnumpy(), rtol=1e-6)
+
+
+def test_conv_cells():
+    for cls, n_states in ((rnn.ConvRNNCell, 1), (rnn.ConvLSTMCell, 2),
+                          (rnn.ConvGRUCell, 1)):
+        cell = cls(input_shape=(2, 8, 8), num_hidden=4,
+                   prefix="%s_" % cls.__name__)
+        data = S.var("data", shape=(1, 3, 2, 8, 8))  # NTC... (N,T,C,H,W)
+        outs, states = cell.unroll(3, data, layout="NTC",
+                                   merge_outputs=False)
+        assert len(outs) == 3 and len(states) == n_states
+        exe = outs[-1].simple_bind(ctx=mx.cpu(), data=(1, 3, 2, 8, 8))
+        rs = np.random.RandomState(0)
+        for n, arr in exe.arg_dict.items():
+            exe.arg_dict[n]._set_data(
+                np.asarray(rs.rand(*arr.shape) * 0.3, np.float32))
+        out = exe.forward(is_train=False)[0].asnumpy()
+        assert out.shape == (1, 4, 8, 8) and np.isfinite(out).all()
+
+
+def test_encode_sentences_and_bucket_iter():
+    sents = [["the", "cat", "sat"], ["a", "dog"], ["the", "dog", "ran"],
+             ["a", "cat", "sat", "up"], ["dogs", "run"], ["cats", "sit"]]
+    coded, vocab = rnn.encode_sentences(sents, invalid_label=0,
+                                        start_label=1)
+    assert len(coded) == len(sents)
+    assert all(isinstance(i, int) for s in coded for i in s)
+    it = rnn.BucketSentenceIter(coded, batch_size=2, buckets=[3, 5],
+                                invalid_label=0)
+    it.reset()
+    seen = 0
+    for batch in it:
+        assert batch.data[0].shape[0] == 2
+        assert batch.bucket_key in (3, 5)
+        assert batch.data[0].shape[1] == batch.bucket_key
+        # label is data shifted by one step
+        d = batch.data[0].asnumpy()
+        l = batch.label[0].asnumpy()
+        np.testing.assert_allclose(l[:, :-1], d[:, 1:])
+        seen += 1
+    assert seen >= 1
+    # TN layout transposes
+    it_tn = rnn.BucketSentenceIter(coded, batch_size=2, buckets=[3, 5],
+                                   invalid_label=0, layout="TN")
+    batch = next(iter(it_tn))
+    assert batch.data[0].shape[1] == 2
+
+
+def test_rnn_checkpoint_round_trip(tmp_path):
+    fused = rnn.FusedRNNCell(4, num_layers=1, mode="lstm",
+                             prefix="lstm_")
+    fouts, _ = fused.unroll(3, S.var("data", shape=(2, 3, 2)),
+                            layout="NTC", merge_outputs=True)
+    exe, _ = _bind_and_fill(fouts, (2, 3, 2), seed=2)
+    args = {n: a for n, a in exe.arg_dict.items() if n != "data"}
+    prefix = str(tmp_path / "model")
+    rnn.save_rnn_checkpoint(fused, prefix, 3, fouts, dict(args), {})
+    sym2, arg2, aux2 = rnn.load_rnn_checkpoint(fused, prefix, 3)
+    # loaded+unpacked params contain per-gate entries
+    assert any("_i_" in k or k.endswith("_i_weight")
+               or "i2h_i_weight" in k for k in arg2), sorted(arg2)[:5]
+    packed = fused.pack_weights(arg2)
+    np.testing.assert_allclose(
+        packed["lstm_parameters"].asnumpy(),
+        args["lstm_parameters"].asnumpy(), rtol=1e-6)
+    cb = rnn.do_rnn_checkpoint(fused, str(tmp_path / "cb"), period=1)
+    cb(0, fouts, dict(args), {})
+    import os
+
+    assert os.path.exists(str(tmp_path / "cb") + "-0001.params")
