@@ -50,6 +50,8 @@ from . import symbol as sym  # noqa: F401
 from .symbol import AttrScope  # noqa: F401
 from . import model  # noqa: F401
 from . import rnn  # noqa: F401
+from . import log  # noqa: F401
+from . import util  # noqa: F401
 from . import callback  # noqa: F401
 from . import module  # noqa: F401
 from . import monitor  # noqa: F401

@@ -1,0 +1,79 @@
+"""Logging utilities (parity: python/mxnet/log.py).
+
+``get_logger`` returns a configured logger with the reference's level
+coloring when writing to a TTY.
+"""
+from __future__ import annotations
+
+import logging
+import sys
+
+CRITICAL = logging.CRITICAL
+ERROR = logging.ERROR
+WARNING = logging.WARNING
+INFO = logging.INFO
+DEBUG = logging.DEBUG
+NOTSET = logging.NOTSET
+
+PY3 = True
+
+
+class _Formatter(logging.Formatter):
+    """Colored level labels on TTYs (parity: log.py _Formatter)."""
+
+    def __init__(self, colored=True):
+        self._colored = colored
+        super().__init__(datefmt="%m%d %H:%M:%S")
+
+    def _get_color(self, level):
+        if level >= ERROR:
+            return "\x1b[31m"
+        if level >= WARNING:
+            return "\x1b[33m"
+        return "\x1b[32m"
+
+    def _get_label(self, level):
+        if level == INFO:
+            return "I"
+        if level == WARNING:
+            return "W"
+        if level == ERROR:
+            return "E"
+        if level == CRITICAL:
+            return "C"
+        return "U"
+
+    def format(self, record):
+        if self._colored:
+            fmt = (self._get_color(record.levelno)
+                   + self._get_label(record.levelno)
+                   + "%(asctime)s %(process)d %(pathname)s:%(funcName)s"
+                   ":%(lineno)d\x1b[0m %(message)s")
+        else:
+            fmt = (self._get_label(record.levelno)
+                   + "%(asctime)s %(process)d %(pathname)s:%(funcName)s"
+                   ":%(lineno)d %(message)s")
+        self._style._fmt = fmt
+        return super().format(record)
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """Configured logger (parity: log.py:90 get_logger)."""
+    logger = logging.getLogger(name)
+    if name is not None and not getattr(logger, "_init_done", None):
+        logger._init_done = True
+        if filename:
+            mode = filemode if filemode else "a"
+            hdlr = logging.FileHandler(filename, mode)
+            colored = False
+        else:
+            hdlr = logging.StreamHandler(sys.stderr)
+            colored = hasattr(sys.stderr, "isatty") and sys.stderr.isatty()
+        hdlr.setFormatter(_Formatter(colored))
+        logger.addHandler(hdlr)
+        logger.setLevel(level)
+    return logger
+
+
+# reference exports the camelCase alias too
+getLogger = get_logger

@@ -329,3 +329,55 @@ def test_attr_scope_nesting_and_restore():
     assert s3._outputs[0][0].attrs["__ctx_group__"] == "a"
     assert "__ctx_group__" not in s4._outputs[0][0].attrs
     assert AttrScope.current() == {}
+
+
+# ---------------------------------------------------------------------------
+# mx.log + mx.util (reference log.py / util.py surfaces)
+# ---------------------------------------------------------------------------
+
+def test_log_get_logger(tmp_path, capsys):
+    logf = str(tmp_path / "x.log")
+    lg = mx.log.get_logger("t_file", filename=logf, level=mx.log.INFO)
+    lg.info("file message")
+    for h in lg.handlers:
+        h.flush()
+    assert "file message" in open(logf).read()
+    lg2 = mx.log.get_logger("t_file")  # idempotent: no duplicate handler
+    assert lg2 is lg and len(lg.handlers) == 1
+    assert mx.log.getLogger is mx.log.get_logger
+
+
+def test_util_helpers(tmp_path):
+    d = str(tmp_path / "a" / "b")
+    mx.util.makedirs(d)
+    import os
+
+    assert os.path.isdir(d)
+    mx.util.makedirs(d)  # idempotent
+    assert isinstance(mx.util.get_gpu_count(), int)
+
+    # np flags: util delegates to numpy_extension (one source of truth)
+    mx.util.reset_np()
+    assert mx.util.is_np_shape() is False
+
+    @mx.util.use_np_shape
+    def f():
+        return mx.util.is_np_shape()
+
+    assert f() is True
+    assert mx.util.is_np_shape() is False  # restored after the call
+
+    with mx.util.np_array(True):
+        with mx.util.np_shape(True):
+            assert mx.util.is_np_array() is True
+    assert mx.util.is_np_array() is False
+    assert mx.util.is_np_shape() is False
+    # same probe as mx.num_gpus — never contradicts it
+    assert mx.util.get_gpu_count() == mx.num_gpus()
+    assert isinstance(mx.util.get_accelerator_count(), int)
+
+    @mx.util.set_module("mxnet_tpu.somewhere")
+    def g():
+        return 1
+
+    assert g.__module__ == "mxnet_tpu.somewhere"
