@@ -102,6 +102,10 @@ def create(init, **kwargs):
         return init
     if init is None:
         return Uniform()
+    if isinstance(init, (list, tuple)) and len(init) == 2:
+        # decoded dumps() form (symbol JSON attrs arrive pre-parsed)
+        name, kw = init
+        return _INIT_REGISTRY[str(name).lower()](**kw)
     if isinstance(init, str):
         s = init.strip()
         if s.startswith("["):  # dumps() round-trip

@@ -160,11 +160,20 @@ class Module(BaseModule):
                             dtype=self._exec_group._exec.aux_dict[n].dtype)
                 for n in self._aux_names}
 
+        var_attrs = {node.name: node.attrs
+                     for node in self._symbol._topo_nodes()
+                     if node.is_variable and node.attrs}
+
         def _fill(name, arr):
             # the framework's initializer protocol is functional:
-            # init(desc, shape, dtype) -> array
+            # init(desc, shape, dtype) -> array.  Passing the variable's
+            # attrs lets Initializer.__call__ honor a per-variable
+            # __init__ (sym.var(init=...)) via create()._init_impl —
+            # the reference's per-variable init contract, bypassing the
+            # bias/gamma suffix dispatch exactly like the reference.
+            desc = InitDesc(name, attrs=var_attrs.get(name))
             arr._set_data(jnp.asarray(initializer(
-                InitDesc(name), tuple(arr.shape), arr.data().dtype)))
+                desc, tuple(arr.shape), arr.data().dtype)))
 
         def _impl(name, arr, cache):
             if cache is not None and name in cache:

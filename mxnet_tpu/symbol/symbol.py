@@ -431,13 +431,25 @@ class Symbol:
     def tojson(self):
         nodes = self._topo_nodes()
         index = {id(n): i for i, n in enumerate(nodes)}
+        def enc_attr(k, v):
+            if isinstance(v, str):
+                return v
+            try:
+                return json.dumps(v)
+            except TypeError:
+                # non-JSON attr values: Initializer objects round-trip
+                # via dumps() ('["constant", {"value": 3.0}]'), which
+                # load-side create() parses back with its kwargs
+                if hasattr(v, "dumps"):
+                    return v.dumps()
+                return json.dumps(type(v).__name__.lower())
+
         jnodes = []
         for n in nodes:
             jnodes.append({
                 "op": n.op or "null",
                 "name": n.name,
-                "attrs": {k: json.dumps(v) if not isinstance(v, str)
-                          else v for k, v in n.attrs.items()},
+                "attrs": {k: enc_attr(k, v) for k, v in n.attrs.items()},
                 "inputs": [[index[id(inp)], idx, 0]
                            for inp, idx in n.inputs],
             })
@@ -593,6 +605,11 @@ def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
         attrs["__lr_mult__"] = lr_mult
     if wd_mult is not None:
         attrs["__wd_mult__"] = wd_mult
+    if init is not None:
+        # Initializer object (or registry string): honored by
+        # Module.init_params over the global initializer, like the
+        # reference's __init__ variable attr
+        attrs["__init__"] = init
     attrs.update(kwargs)
     return Symbol([(_Node(None, name, attrs), 0)])
 
