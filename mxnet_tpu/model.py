@@ -49,3 +49,183 @@ def load_checkpoint(prefix, epoch):
         else:
             raise MXNetError("invalid param file entry %r" % k)
     return symbol, arg_params, aux_params
+
+
+class FeedForward:
+    """Legacy estimator-style Model API (parity: model.py:486
+    FeedForward — deprecated in the reference in favor of Module, kept
+    for API completeness).  Internally a thin driver over
+    ``mx.module.Module``: one compiled train step per shape, sklearn-ish
+    ``fit``/``predict``/``score``/``save``/``load``.
+    """
+
+    def __init__(self, symbol, ctx=None, num_epoch=None,
+                 epoch_size=None, optimizer="sgd",
+                 initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, begin_epoch=0,
+                 **kwargs):
+        from . import initializer as init_mod
+
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        if epoch_size is not None:
+            import warnings
+
+            warnings.warn(
+                "FeedForward: epoch_size is accepted for API parity but "
+                "not used — epochs are bounded by the iterator; wrap an "
+                "infinite iterator (e.g. mx.io.ResizeIter) instead")
+        self.optimizer = optimizer
+        self.initializer = initializer or init_mod.Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.begin_epoch = begin_epoch
+        self._kwargs = kwargs
+        self._module = None
+
+    # -- data plumbing -----------------------------------------------------
+    def _init_iter(self, X, y, is_train):
+        import numpy as _np
+
+        from .io.io import DataIter, NDArrayIter
+
+        if isinstance(X, DataIter):
+            return X
+        X = _np.asarray(X)
+        if y is None and is_train:
+            raise MXNetError("y is required for training")
+        batch = min(self.numpy_batch_size, X.shape[0])
+        return NDArrayIter(
+            X, None if y is None else _np.asarray(y),
+            batch_size=batch, shuffle=is_train,
+            last_batch_handle="roll_over" if is_train else "pad")
+
+    def _build_module(self, data_iter):
+        from .module.module import Module
+
+        label_names = tuple(n for n, _ in
+                            (data_iter.provide_label or ()))
+        self._module = Module(
+            self.symbol, data_names=tuple(
+                n for n, _ in data_iter.provide_data),
+            label_names=label_names, context=self.ctx)
+        self._module_has_labels = bool(label_names)
+        return self._module
+
+    def _ensure_bound(self, data_iter, need_labels):
+        """(Re)bind the inner Module for inference; a module built
+        without labels cannot score, so label requirements force a
+        rebuild (otherwise the metric would silently never update)."""
+        if self._module is None or not self._module.binded or \
+                (need_labels and not getattr(self, "_module_has_labels",
+                                             False)):
+            mod = self._build_module(data_iter)
+            mod.bind(data_shapes=data_iter.provide_data,
+                     label_shapes=data_iter.provide_label
+                     if need_labels else None,
+                     for_training=False)
+            mod.set_params(self.arg_params or {}, self.aux_params or {},
+                           allow_missing=False)
+        return self._module
+
+    # -- estimator API -----------------------------------------------------
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", logger=None,
+            work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        """Train (parity: model.py:827 FeedForward.fit)."""
+        train_data = self._init_iter(X, y, is_train=True)
+        mod = self._build_module(train_data)
+        mod.fit(train_data,
+                eval_data=None if eval_data is None
+                else self._init_iter(
+                    eval_data[0] if isinstance(eval_data, tuple)
+                    else eval_data,
+                    eval_data[1] if isinstance(eval_data, tuple)
+                    else None, is_train=False),
+                eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback,
+                kvstore=kvstore,
+                optimizer=self.optimizer,
+                optimizer_params=self._kwargs
+                or (("learning_rate", 0.01),),
+                initializer=self.initializer,
+                arg_params=self.arg_params,
+                aux_params=self.aux_params,
+                begin_epoch=self.begin_epoch,
+                num_epoch=self.num_epoch,
+                eval_end_callback=eval_end_callback,
+                eval_batch_end_callback=eval_batch_end_callback,
+                monitor=monitor)
+        self.arg_params, self.aux_params = mod.get_params()
+        return self
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        """Forward over a dataset (parity: model.py:707): single-output
+        symbols return one array, multi-output a list — delegates to
+        ``BaseModule.predict`` (pad slicing, batch merging)."""
+        import numpy as _np
+
+        data_iter = self._init_iter(X, None, is_train=False)
+        mod = self._ensure_bound(data_iter, need_labels=False)
+        outs = mod.predict(data_iter, num_batch=num_batch, reset=reset)
+        if isinstance(outs, (list, tuple)):
+            return [_np.asarray(o.asnumpy()) for o in outs]
+        return _np.asarray(outs.asnumpy())
+
+    def score(self, X, eval_metric="acc", num_batch=None,
+              batch_end_callback=None, reset=True):
+        """Evaluate a metric over a dataset (parity: model.py:776) —
+        delegates to ``BaseModule.score`` (per-batch callbacks
+        included)."""
+        data_iter = self._init_iter(
+            X[0] if isinstance(X, tuple) else X,
+            X[1] if isinstance(X, tuple) else None, is_train=False)
+        mod = self._ensure_bound(data_iter, need_labels=True)
+        res = mod.score(data_iter, eval_metric, num_batch=num_batch,
+                        batch_end_callback=batch_end_callback,
+                        reset=reset)
+        return res[0][1]
+
+    # -- persistence -------------------------------------------------------
+    def save(self, prefix, epoch=None, remove_amp_cast=True):
+        """Checkpoint (parity: model.py:931)."""
+        if epoch is None:
+            epoch = self.num_epoch or 0
+        save_checkpoint(prefix, epoch, self.symbol,
+                        self.arg_params or {}, self.aux_params or {},
+                        remove_amp_cast=remove_amp_cast)
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        """Restore a saved FeedForward (parity: model.py:956)."""
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None,
+               epoch_size=None, optimizer="sgd", initializer=None,
+               eval_data=None, eval_metric="acc",
+               epoch_end_callback=None, batch_end_callback=None,
+               kvstore="local", logger=None, work_load_list=None,
+               eval_end_callback=None, eval_batch_end_callback=None,
+               **kwargs):
+        """Build + fit in one call (parity: model.py:987)."""
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer, **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback,
+                  kvstore=kvstore, logger=logger,
+                  work_load_list=work_load_list,
+                  eval_end_callback=eval_end_callback,
+                  eval_batch_end_callback=eval_batch_end_callback)
+        return model

@@ -381,3 +381,47 @@ def test_util_helpers(tmp_path):
         return 1
 
     assert g.__module__ == "mxnet_tpu.somewhere"
+
+
+# ---------------------------------------------------------------------------
+# legacy FeedForward Model API (reference model.py:486)
+# ---------------------------------------------------------------------------
+
+def test_feedforward_fit_score_predict_save_load(tmp_path):
+    from mxnet_tpu import sym as S
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(64, 5).astype(np.float32)
+    w_true = rs.randn(5, 3)
+    y = np.argmax(X @ w_true, axis=1).astype(np.float32)
+
+    data = S.var("data")
+    fc = S.Activation(S.FullyConnected(data, num_hidden=16, name="fc1"),
+                      act_type="relu")
+    out = S.SoftmaxOutput(
+        S.FullyConnected(fc, num_hidden=3, name="fc2"),
+        S.var("softmax_label"), name="softmax")
+    model = mx.model.FeedForward(out, num_epoch=12, optimizer="adam",
+                                 learning_rate=0.05,
+                                 numpy_batch_size=16)
+    model.fit(X, y)
+    acc = model.score((X, y))
+    assert acc > 0.8
+    pred = model.predict(X)
+    assert pred.shape == (64, 3)
+    np.testing.assert_allclose(pred.sum(axis=1), 1.0, rtol=1e-4)
+
+    prefix = str(tmp_path / "ff")
+    model.save(prefix)
+    m2 = mx.model.FeedForward.load(prefix, 12)
+    # predict() first builds a label-less module; score() must rebuild
+    # with labels instead of silently returning NaN
+    p2 = m2.predict(X)
+    assert p2.shape == (64, 3)
+    s2 = m2.score((X, y))
+    assert np.isfinite(s2) and abs(s2 - acc) < 1e-6
+    # create() = construct + fit
+    m3 = mx.model.FeedForward.create(out, X, y, num_epoch=3,
+                                     optimizer="adam",
+                                     learning_rate=0.05)
+    assert m3.arg_params is not None
