@@ -108,20 +108,29 @@ class FeedForward:
 
         label_names = tuple(n for n, _ in
                             (data_iter.provide_label or ()))
+        if not label_names:
+            # predict-mode iterators carry no labels, but the symbol's
+            # label variables must still be classed as labels (NOT
+            # parameters), or set_params would demand values for them
+            label_names = tuple(n for n in self.symbol.list_arguments()
+                                if n.endswith("_label"))
         self._module = Module(
             self.symbol, data_names=tuple(
                 n for n, _ in data_iter.provide_data),
             label_names=label_names, context=self.ctx)
-        self._module_has_labels = bool(label_names)
         return self._module
 
     def _ensure_bound(self, data_iter, need_labels):
         """(Re)bind the inner Module for inference; a module built
         without labels cannot score, so label requirements force a
         rebuild (otherwise the metric would silently never update)."""
+        # _module_has_labels tracks the BIND-time label topology: a
+        # module bound without label shapes cannot score (the metric
+        # would silently never update), and vice versa for label-less
+        # forwards — mismatches force a rebuild
         if self._module is None or not self._module.binded or \
-                (need_labels and not getattr(self, "_module_has_labels",
-                                             False)):
+                need_labels != getattr(self, "_module_bound_with_labels",
+                                       None):
             mod = self._build_module(data_iter)
             mod.bind(data_shapes=data_iter.provide_data,
                      label_shapes=data_iter.provide_label
@@ -129,6 +138,7 @@ class FeedForward:
                      for_training=False)
             mod.set_params(self.arg_params or {}, self.aux_params or {},
                            allow_missing=False)
+            self._module_bound_with_labels = need_labels
         return self._module
 
     # -- estimator API -----------------------------------------------------
@@ -163,6 +173,7 @@ class FeedForward:
                 eval_batch_end_callback=eval_batch_end_callback,
                 monitor=monitor)
         self.arg_params, self.aux_params = mod.get_params()
+        self._module_bound_with_labels = True
         return self
 
     def predict(self, X, num_batch=None, return_data=False, reset=True):

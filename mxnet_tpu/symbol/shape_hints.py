@@ -130,8 +130,29 @@ def _rnn(known, attrs):
     return out
 
 
+def _loss_label_like_batch(known, attrs):
+    data = known.get("data")
+    if data is None:
+        return None
+    # softmax-style losses accept (B,) labels; predict-mode binds without
+    # a label feed still need a shape for the unused input
+    return {"label": (data[0],)}
+
+
+def _loss_label_like_data(known, attrs):
+    data = known.get("data")
+    if data is None:
+        return None
+    return {"label": tuple(data)}
+
+
 _HINTS = {
     "RNN": _rnn,
+    "SoftmaxOutput": _loss_label_like_batch,
+    "SVMOutput": _loss_label_like_batch,
+    "LinearRegressionOutput": _loss_label_like_data,
+    "MAERegressionOutput": _loss_label_like_data,
+    "LogisticRegressionOutput": _loss_label_like_data,
     "FullyConnected": _fully_connected,
     "Convolution": _convolution,
     "Deconvolution": _deconvolution,
