@@ -1,3 +1,5 @@
 """gluon.contrib (parity: python/mxnet/gluon/contrib/)."""
 from . import estimator  # noqa: F401
 from . import nn  # noqa: F401
+from . import rnn  # noqa: F401
+from . import cnn  # noqa: F401

@@ -1,0 +1,78 @@
+"""Contrib convolution layers (parity: gluon/contrib/cnn/conv_layers.py).
+
+``DeformableConvolution`` wraps the ``_contrib_DeformableConvolution``
+operator (ops/vision.py — bilinear sampling at learned offsets) with a
+built-in offset-predicting convolution, like the reference layer.
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from .. import nn as _nn
+
+
+class DeformableConvolution(HybridBlock):
+    """Deformable conv v1 (parity: contrib/cnn DeformableConvolution;
+    Dai et al. 2017): a standard conv predicts per-position sampling
+    offsets for the deformable kernel."""
+
+    def __init__(self, channels, kernel_size=(1, 1), strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), groups=1,
+                 num_deformable_group=1, layout="NCHW", use_bias=True,
+                 in_channels=0, activation=None,
+                 weight_initializer=None, bias_initializer="zeros",
+                 offset_weight_initializer="zeros",
+                 offset_bias_initializer="zeros", offset_use_bias=True,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * 2
+        if isinstance(strides, int):
+            strides = (strides,) * 2
+        if isinstance(padding, int):
+            padding = (padding,) * 2
+        if isinstance(dilation, int):
+            dilation = (dilation,) * 2
+        assert layout == "NCHW", \
+            "DeformableConvolution supports NCHW layout only"
+        self._channels = channels
+        self._kwargs = dict(kernel=kernel_size, stride=strides,
+                            pad=padding, dilate=dilation,
+                            num_filter=channels, num_group=groups,
+                            num_deformable_group=num_deformable_group,
+                            no_bias=not use_bias, layout=layout)
+        offset_channels = 2 * kernel_size[0] * kernel_size[1] \
+            * num_deformable_group
+        with self.name_scope():
+            self.offset = _nn.Conv2D(
+                offset_channels, kernel_size=kernel_size,
+                strides=strides, padding=padding, dilation=dilation,
+                layout=layout, use_bias=offset_use_bias,
+                weight_initializer=offset_weight_initializer,
+                bias_initializer=offset_bias_initializer,
+                in_channels=in_channels, prefix="offset_")
+            self.weight = self.params.get(
+                "weight",
+                shape=(channels,
+                       in_channels // groups if in_channels else 0)
+                + tuple(kernel_size),
+                init=weight_initializer, allow_deferred_init=True)
+            self.bias = self.params.get(
+                "bias", shape=(channels,), init=bias_initializer,
+                allow_deferred_init=True) if use_bias else None
+            self.act = _nn.Activation(activation) \
+                if activation is not None else None
+
+    def _shape_hint(self, x, *args):
+        if self.weight.shape and 0 in self.weight.shape:
+            cin = x.shape[1]
+            k = self._kwargs["kernel"]
+            g = self._kwargs["num_group"]
+            self.weight.shape = (self._channels, cin // g) + tuple(k)
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        offset = self.offset(x)
+        out = F._contrib_DeformableConvolution(x, offset, weight, bias,
+                                               **self._kwargs)
+        if self.act is not None:
+            out = self.act(out)
+        return out

@@ -65,3 +65,71 @@ class SyncBatchNorm(_nn.BatchNorm):
                  epsilon=1e-5, **kwargs):
         super().__init__(momentum=momentum, epsilon=epsilon,
                          in_channels=in_channels, **kwargs)
+
+
+class PixelShuffle1D(HybridBlock):
+    """Pixel-shuffle upsampling in 1D (parity: contrib/nn
+    PixelShuffle1D): (N, C*f, W) -> (N, C, W*f)."""
+
+    def __init__(self, factor):
+        super().__init__()
+        self._factor = int(factor)
+
+    def hybrid_forward(self, F, x):
+        f = self._factor
+        n, cf, w = x.shape
+        x = F.reshape(x, shape=(n, cf // f, f, w))
+        x = F.transpose(x, axes=(0, 1, 3, 2))       # (N, C, W, f)
+        return F.reshape(x, shape=(n, cf // f, w * f))
+
+    def __repr__(self):
+        return "PixelShuffle1D(%d)" % self._factor
+
+
+class PixelShuffle2D(HybridBlock):
+    """Pixel-shuffle upsampling in 2D (parity: contrib/nn
+    PixelShuffle2D): (N, C*f1*f2, H, W) -> (N, C, H*f1, W*f2)."""
+
+    def __init__(self, factor):
+        super().__init__()
+        try:
+            self._factors = (int(factor),) * 2
+        except TypeError:
+            self._factors = tuple(int(fac) for fac in factor)
+            assert len(self._factors) == 2, "wrong length %s" % (factor,)
+
+    def hybrid_forward(self, F, x):
+        f1, f2 = self._factors
+        n, c, h, w = x.shape
+        co = c // (f1 * f2)
+        x = F.reshape(x, shape=(n, co, f1, f2, h, w))
+        x = F.transpose(x, axes=(0, 1, 4, 2, 5, 3))  # (N,C,H,f1,W,f2)
+        return F.reshape(x, shape=(n, co, h * f1, w * f2))
+
+    def __repr__(self):
+        return "PixelShuffle2D(%s)" % (self._factors,)
+
+
+class PixelShuffle3D(HybridBlock):
+    """Pixel-shuffle upsampling in 3D (parity: contrib/nn
+    PixelShuffle3D): (N, C*f1*f2*f3, D, H, W) ->
+    (N, C, D*f1, H*f2, W*f3)."""
+
+    def __init__(self, factor):
+        super().__init__()
+        try:
+            self._factors = (int(factor),) * 3
+        except TypeError:
+            self._factors = tuple(int(fac) for fac in factor)
+            assert len(self._factors) == 3, "wrong length %s" % (factor,)
+
+    def hybrid_forward(self, F, x):
+        f1, f2, f3 = self._factors
+        n, c, d, h, w = x.shape
+        co = c // (f1 * f2 * f3)
+        x = F.reshape(x, shape=(n, co, f1, f2, f3, d, h, w))
+        x = F.transpose(x, axes=(0, 1, 5, 2, 6, 3, 7, 4))
+        return F.reshape(x, shape=(n, co, d * f1, h * f2, w * f3))
+
+    def __repr__(self):
+        return "PixelShuffle3D(%s)" % (self._factors,)

@@ -1,0 +1,155 @@
+"""Contrib recurrent cells (parity: gluon/contrib/rnn/rnn_cell.py).
+
+``VariationalDropoutCell`` — one dropout mask shared across time steps
+(Gal & Ghahramani 2016) for inputs/states/outputs; ``LSTMPCell`` — LSTM
+with a recurrent projection (Sak et al. 2014).
+"""
+from __future__ import annotations
+
+from ..rnn.rnn_cell import ModifierCell, HybridRecurrentCell, \
+    BidirectionalCell, SequentialRNNCell
+from ..block import HybridBlock  # noqa: F401  (re-export convenience)
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Variational dropout over a base cell (parity:
+    contrib/rnn/rnn_cell.py:27).  Masks are drawn once per sequence
+    (first step after ``reset``) and reused every step; input, state and
+    output masks are independent."""
+
+    def __init__(self, base_cell, drop_inputs=0., drop_states=0.,
+                 drop_outputs=0.):
+        assert not drop_states or not isinstance(base_cell,
+                                                 BidirectionalCell), \
+            "BidirectionalCell doesn't support variational state " \
+            "dropout; wrap the cells underneath instead."
+        assert not drop_states or not isinstance(base_cell,
+                                                 SequentialRNNCell), \
+            "Apply VariationalDropoutCell to the cells underneath the " \
+            "SequentialRNNCell instead."
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self.drop_inputs_mask = None
+        self.drop_states_mask = None
+        self.drop_outputs_mask = None
+
+    def _alias(self):
+        return "vardrop"
+
+    def reset(self):
+        super().reset()
+        self.drop_inputs_mask = None
+        self.drop_states_mask = None
+        self.drop_outputs_mask = None
+
+    def _initialize_input_masks(self, F, inputs, states):
+        if self.drop_states and self.drop_states_mask is None:
+            self.drop_states_mask = F.Dropout(
+                F.ones_like(states[0]), p=self.drop_states)
+        if self.drop_inputs and self.drop_inputs_mask is None:
+            self.drop_inputs_mask = F.Dropout(
+                F.ones_like(inputs), p=self.drop_inputs)
+
+    def _initialize_output_mask(self, F, output):
+        if self.drop_outputs and self.drop_outputs_mask is None:
+            self.drop_outputs_mask = F.Dropout(
+                F.ones_like(output), p=self.drop_outputs)
+
+    def hybrid_forward(self, F, inputs, states):
+        cell = self.base_cell
+        self._initialize_input_masks(F, inputs, states)
+        if self.drop_states:
+            states = list(states)
+            # state dropout applies to the first state channel only
+            # (reference semantics)
+            states[0] = states[0] * self.drop_states_mask
+        if self.drop_inputs:
+            inputs = inputs * self.drop_inputs_mask
+        next_output, next_states = cell(inputs, states)
+        self._initialize_output_mask(F, next_output)
+        if self.drop_outputs:
+            next_output = next_output * self.drop_outputs_mask
+        return next_output, next_states
+
+    def __repr__(self):
+        return "VariationalDropoutCell(p_out=%s, p_state=%s)" % (
+            self.drop_outputs, self.drop_states)
+
+
+class LSTMPCell(HybridRecurrentCell):
+    """LSTM with recurrent projection (parity:
+    contrib/rnn/rnn_cell.py:197; arXiv:1402.1128).
+
+    States are [projected (B, P), cell (B, H)]; the hidden state is
+    projected to P units before recurrence and output.
+    """
+
+    def __init__(self, hidden_size, projection_size,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None,
+                 i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self._projection_size = projection_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(4 * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(4 * hidden_size, projection_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.h2r_weight = self.params.get(
+            "h2r_weight", shape=(projection_size, hidden_size),
+            init=h2r_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(4 * hidden_size,),
+            init=i2h_bias_initializer, allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(4 * hidden_size,),
+            init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def _alias(self):
+        return "lstmp"
+
+    def state_info(self, batch_size=0):
+        return [
+            {"shape": (batch_size, self._projection_size),
+             "__layout__": "NC"},
+            {"shape": (batch_size, self._hidden_size),
+             "__layout__": "NC"},
+        ]
+
+    def _shape_hint(self, x, *args):
+        if self.i2h_weight.shape and self.i2h_weight.shape[1] == 0:
+            self._input_size = x.shape[-1]
+            self.i2h_weight.shape = (4 * self._hidden_size,
+                                     self._input_size)
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       h2r_weight, i2h_bias, h2h_bias):
+        prefix = "t%d_" % getattr(self, "_counter", 0)
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size,
+                               name=prefix + "i2h")
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size,
+                               name=prefix + "h2h")
+        gates = i2h + h2h
+        sliced = F.SliceChannel(gates, num_outputs=4,
+                                name=prefix + "slice")
+        sliced = list(sliced) if not isinstance(sliced, (list, tuple)) \
+            else sliced
+        in_gate = F.Activation(sliced[0], act_type="sigmoid")
+        forget_gate = F.Activation(sliced[1], act_type="sigmoid")
+        in_transform = F.Activation(sliced[2], act_type="tanh")
+        out_gate = F.Activation(sliced[3], act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        hidden = out_gate * F.Activation(next_c, act_type="tanh")
+        next_r = F.FullyConnected(hidden, h2r_weight, None, no_bias=True,
+                                  num_hidden=self._projection_size,
+                                  name=prefix + "out")
+        return next_r, [next_r, next_c]

@@ -124,10 +124,14 @@ class FeedForward:
         """(Re)bind the inner Module for inference; a module built
         without labels cannot score, so label requirements force a
         rebuild (otherwise the metric would silently never update)."""
-        # _module_has_labels tracks the BIND-time label topology: a
-        # module bound without label shapes cannot score (the metric
-        # would silently never update), and vice versa for label-less
-        # forwards — mismatches force a rebuild
+        # _module_bound_with_labels tracks the BIND-time label
+        # topology: a module bound without label shapes cannot score
+        # (the metric would silently never update), and vice versa for
+        # label-less forwards — mismatches force a rebuild.  NOTE:
+        # alternating predict()/score() therefore re-binds each flip
+        # (XLA's persistent compilation cache absorbs the recompile);
+        # batch eval loops should score() with a metric instead of
+        # interleaving
         if self._module is None or not self._module.binded or \
                 need_labels != getattr(self, "_module_bound_with_labels",
                                        None):

@@ -358,3 +358,91 @@ def test_optimizer_rescale_grad_not_baked():
     opt.rescale_grad = 0.0
     opt.update(0, w, g, None)
     np.testing.assert_allclose(w.asnumpy(), -1.0)  # zero-scaled grad
+
+
+# ---------------------------------------------------------------------------
+# round-3 gluon.contrib additions (reference gluon/contrib/{nn,rnn,cnn})
+# ---------------------------------------------------------------------------
+
+def test_pixel_shuffle_layers():
+    import numpy as onp
+
+    from mxnet_tpu.gluon import contrib as gc
+
+    x1 = mx.nd.array(onp.arange(24).reshape(1, 8, 3).astype(onp.float32))
+    out = gc.nn.PixelShuffle1D(2)(x1)
+    assert out.shape == (1, 4, 6)
+    # value semantics: channel groups interleave into W
+    got = out.asnumpy()[0, 0]
+    onp.testing.assert_allclose(got, [0, 3, 1, 4, 2, 5])
+
+    x2 = mx.nd.array(onp.arange(36).reshape(1, 4, 3, 3)
+                     .astype(onp.float32))
+    out2 = gc.nn.PixelShuffle2D(2)(x2)
+    assert out2.shape == (1, 1, 6, 6)
+    x3 = mx.nd.array(onp.zeros((1, 8, 2, 2, 2), onp.float32))
+    assert gc.nn.PixelShuffle3D(2)(x3).shape == (1, 1, 4, 4, 4)
+
+
+def test_lstmp_cell_projection_and_unroll():
+    import numpy as onp
+
+    from mxnet_tpu.gluon import contrib as gc
+
+    cell = gc.rnn.LSTMPCell(8, 4)
+    cell.initialize(mx.init.Xavier())
+    x = mx.nd.array(onp.random.RandomState(0).rand(2, 5, 3)
+                    .astype(onp.float32))
+    outs, states = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert outs.shape == (2, 5, 4)          # projected size
+    assert states[0].shape == (2, 4) and states[1].shape == (2, 8)
+    # r_t = W_hr h_t: projection weight participates in the graph
+    assert cell.h2r_weight.shape == (4, 8)
+
+
+def test_variational_dropout_mask_shared_across_steps():
+    import numpy as onp
+
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import contrib as gc
+    from mxnet_tpu.gluon import rnn as grnn
+
+    mx.random.seed(7)
+    vd = gc.rnn.VariationalDropoutCell(grnn.RNNCell(16),
+                                       drop_outputs=0.5)
+    vd.initialize(mx.init.Xavier())
+    x = mx.nd.array(onp.random.RandomState(0).rand(2, 16)
+                    .astype(onp.float32))
+    with autograd.record():
+        s = vd.begin_state(batch_size=2)
+        o1, s = vd(x, s)
+        o2, s = vd(x, s)
+    m1, m2 = (o1.asnumpy() == 0), (o2.asnumpy() == 0)
+    assert m1.any()                          # dropout active
+    assert (m1 == m2).all()                  # SAME mask across steps
+    vd.reset()
+    with autograd.record():
+        s = vd.begin_state(batch_size=2)
+        o3, _ = vd(x, s)
+    # a new sequence draws a new mask (almost surely different)
+    assert not ((o3.asnumpy() == 0) == m1).all()
+
+
+def test_deformable_convolution_layer():
+    import numpy as onp
+
+    from mxnet_tpu.gluon import contrib as gc
+
+    dc = gc.cnn.DeformableConvolution(4, kernel_size=3, padding=1,
+                                      num_deformable_group=1)
+    dc.initialize(mx.init.Xavier())
+    img = mx.nd.array(onp.random.RandomState(1).rand(1, 2, 8, 8)
+                      .astype(onp.float32))
+    out = dc(img)
+    assert out.shape == (1, 4, 8, 8)
+    # zero-initialized offsets -> equals a plain convolution
+    plain = mx.nd.Convolution(
+        img, dc.weight.data(), dc.bias.data(), kernel=(3, 3),
+        pad=(1, 1), num_filter=4)
+    onp.testing.assert_allclose(out.asnumpy(), plain.asnumpy(),
+                                rtol=1e-4, atol=1e-5)
