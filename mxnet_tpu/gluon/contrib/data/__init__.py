@@ -1,0 +1,4 @@
+"""Contrib data utilities (parity: gluon/contrib/data/)."""
+from . import text  # noqa: F401
+from .sampler import IntervalSampler  # noqa: F401
+from .text import WikiText2, WikiText103  # noqa: F401

@@ -1,0 +1,28 @@
+"""Contrib samplers (parity: gluon/contrib/data/sampler.py)."""
+from __future__ import annotations
+
+from ...data.sampler import Sampler
+
+
+class IntervalSampler(Sampler):
+    """Samples [0, length) at fixed intervals (parity: sampler.py:25).
+
+    With ``rollover`` (default) the sweep restarts at each skipped
+    offset until every index is visited exactly once.
+    """
+
+    def __init__(self, length, interval, rollover=True):
+        assert interval <= length, \
+            "Interval {} must be smaller than or equal to length {}" \
+            .format(interval, length)
+        self._length = length
+        self._interval = interval
+        self._rollover = rollover
+
+    def __iter__(self):
+        for i in range(self._interval if self._rollover else 1):
+            yield from range(i, self._length, self._interval)
+
+    def __len__(self):
+        return self._length if self._rollover else \
+            len(range(0, self._length, self._interval))

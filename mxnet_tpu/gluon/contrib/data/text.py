@@ -1,0 +1,74 @@
+"""Language-model text datasets (parity: gluon/contrib/data/text.py).
+
+The reference downloads WikiText archives; this environment has no
+network egress, so the datasets read the SAME files from ``root`` (the
+reference's extracted cache layout: ``wiki.train.tokens`` etc.) and
+raise a clear error when absent.  Tokenization, vocabulary mapping and
+sequence batching match the reference: the corpus becomes one long id
+stream split into ``seq_len``-sized (data, label-shifted-by-one)
+samples.
+"""
+from __future__ import annotations
+
+import io
+import os
+
+import numpy as np
+
+from ....base import MXNetError
+from ...data.dataset import SimpleDataset
+from ....contrib.text.utils import count_tokens_from_str
+from ....contrib.text.vocab import Vocabulary
+
+
+class _LanguageModelDataset(SimpleDataset):
+    """Token-stream dataset over a local corpus file."""
+
+    def __init__(self, path, seq_len=35, vocab=None, eos="<eos>"):
+        path = os.path.expanduser(path)
+        if not os.path.isfile(path):
+            raise MXNetError(
+                "corpus file %s not found; this environment has no "
+                "network access — place the extracted tokens file there "
+                "first" % path)
+        with io.open(path, "r", encoding="utf-8") as f:
+            raw = f.read()
+        lines = [line.split() + [eos] for line in raw.splitlines()
+                 if line.strip()]
+        if vocab is None:
+            counter = count_tokens_from_str(
+                " ".join(" ".join(l) for l in lines))
+            vocab = Vocabulary(counter)
+        self.vocabulary = vocab
+        stream = []
+        for line in lines:
+            stream.extend(vocab.to_indices(line))
+        n = (len(stream) - 1) // seq_len
+        data = np.asarray(stream[:n * seq_len + 1], np.int32)
+        xs = data[:n * seq_len].reshape(n, seq_len)
+        ys = data[1:n * seq_len + 1].reshape(n, seq_len)
+        super().__init__([(x, y) for x, y in zip(xs, ys)])
+        self.seq_len = seq_len
+
+
+class WikiText2(_LanguageModelDataset):
+    """WikiText-2 (parity: text.py:105).  ``root`` must contain the
+    extracted ``wiki.<segment>.tokens`` file."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "wikitext-2"),
+                 segment="train", seq_len=35, vocab=None):
+        path = os.path.join(os.path.expanduser(root),
+                            "wiki.%s.tokens" % segment)
+        super().__init__(path, seq_len=seq_len, vocab=vocab)
+
+
+class WikiText103(_LanguageModelDataset):
+    """WikiText-103 (parity: text.py:143); same local-cache contract."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "wikitext-103"),
+                 segment="train", seq_len=35, vocab=None):
+        path = os.path.join(os.path.expanduser(root),
+                            "wiki.%s.tokens" % segment)
+        super().__init__(path, seq_len=seq_len, vocab=vocab)

@@ -446,3 +446,28 @@ def test_deformable_convolution_layer():
         pad=(1, 1), num_filter=4)
     onp.testing.assert_allclose(out.asnumpy(), plain.asnumpy(),
                                 rtol=1e-4, atol=1e-5)
+
+
+def test_contrib_data_interval_sampler_and_wikitext(tmp_path):
+    from mxnet_tpu.gluon import contrib as gc
+
+    s = gc.data.IntervalSampler(13, interval=3)
+    assert list(s) == [0, 3, 6, 9, 12, 1, 4, 7, 10, 2, 5, 8, 11]
+    assert len(s) == 13
+    s2 = gc.data.IntervalSampler(13, interval=3, rollover=False)
+    assert list(s2) == [0, 3, 6, 9, 12] and len(s2) == 5
+
+    (tmp_path / "wiki.train.tokens").write_text(
+        "the cat sat on the mat\nthe dog ran\n" * 30)
+    ds = gc.data.WikiText2(root=str(tmp_path), segment="train",
+                           seq_len=5)
+    x, y = ds[0]
+    assert x.shape == (5,) and (y[:-1] == x[1:]).all()
+    assert "cat" in ds.vocabulary.token_to_idx
+    # label stream is the data stream shifted by exactly one token
+    x1, y1 = ds[1]
+    assert y[-1] == x1[0]
+    import pytest as _pytest
+
+    with _pytest.raises(mx.MXNetError, match="no network access"):
+        gc.data.WikiText103(root=str(tmp_path / "none"))
