@@ -17,7 +17,6 @@ import numpy as np
 
 from ....base import MXNetError
 from ...data.dataset import SimpleDataset
-from ....contrib.text.utils import count_tokens_from_str
 from ....contrib.text.vocab import Vocabulary
 
 
@@ -36,8 +35,10 @@ class _LanguageModelDataset(SimpleDataset):
         lines = [line.split() + [eos] for line in raw.splitlines()
                  if line.strip()]
         if vocab is None:
-            counter = count_tokens_from_str(
-                " ".join(" ".join(l) for l in lines))
+            import collections
+
+            counter = collections.Counter(
+                t for line in lines for t in line)
             vocab = Vocabulary(counter)
         self.vocabulary = vocab
         stream = []

@@ -467,7 +467,5 @@ def test_contrib_data_interval_sampler_and_wikitext(tmp_path):
     # label stream is the data stream shifted by exactly one token
     x1, y1 = ds[1]
     assert y[-1] == x1[0]
-    import pytest as _pytest
-
-    with _pytest.raises(mx.MXNetError, match="no network access"):
+    with pytest.raises(mx.MXNetError, match="no network access"):
         gc.data.WikiText103(root=str(tmp_path / "none"))
