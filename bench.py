@@ -47,15 +47,51 @@ def _init_backend():
     except Exception as e:
         _log("compilation cache unavailable: %s" % e)
     last = None
-    for attempt in range(4):
+    # the tunnel to the chip can be down for extended periods; probe in a
+    # SUBPROCESS with a hard timeout (jax.devices() can hang rather than
+    # raise), retrying across a worst-case ~10-minute window (6 probes
+    # of <=60s + backoff sleeps) before CPU fallback
+    import subprocess
+
+    n_attempts = 6
+    for attempt in range(n_attempts):
         try:
-            devs = jax.devices()
-            _log("devices: %s" % (devs,))
-            return devs[0].platform
-        except Exception as e:  # backend setup can be transiently UNAVAILABLE
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                capture_output=True, text=True, timeout=60)
+            if probe.returncode == 0 and probe.stdout.strip():
+                # the probe just initialized the backend successfully in
+                # a fresh process; the parent's own init could still
+                # stall if the tunnel drops in between, so keep a
+                # watchdog that aborts to CPU rather than hanging the
+                # "a number is always printed" guarantee
+                import threading
+
+                done = threading.Event()
+                result = {}
+
+                def _init():
+                    try:
+                        result["devs"] = jax.devices()
+                    except Exception as e:  # noqa: BLE001
+                        result["err"] = e
+                    done.set()
+
+                threading.Thread(target=_init, daemon=True).start()
+                if done.wait(timeout=120) and "devs" in result:
+                    devs = result["devs"]
+                    _log("devices: %s" % (devs,))
+                    return devs[0].platform
+                last = result.get("err", "parent backend init stalled")
+            else:
+                last = (probe.stderr.strip() or probe.stdout.strip()
+                        or "probe exited %d" % probe.returncode)[-200:]
+        except Exception as e:  # includes probe TimeoutExpired
             last = e
-            _log("backend init attempt %d failed: %s" % (attempt + 1, e))
-            time.sleep(5 * (attempt + 1))
+        _log("backend init attempt %d failed: %s" % (attempt + 1, last))
+        if attempt < n_attempts - 1:
+            time.sleep(10 * (attempt + 1))
     _log("all backend attempts failed (%s); falling back to CPU" % (last,))
     os.environ["JAX_PLATFORMS"] = "cpu"
     try:
