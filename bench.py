@@ -1,6 +1,13 @@
 """Headline benchmark: ResNet-50 training throughput, one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
+"platform", "fallback", "metrics"} — the headline ResNet-50 train
+number at top level, plus a "metrics" array carrying the secondary
+benchmarks (inference, BERT, Llama) so one driver artifact records the
+whole headline set.  "platform" is the PJRT platform the numbers were
+measured on and "fallback" is True iff the accelerator was unreachable
+and the run degraded to CPU — a fallback number can never masquerade as
+a chip number again.
 Baseline: the reference's best published single-GPU ResNet-50 training
 number — 363.69 img/s (batch 128, 1x V100, fp32; BASELINE.md, perf.md:254).
 
@@ -31,7 +38,15 @@ def _log(msg):
 
 
 def _init_backend():
-    """Initialize jax's backend with retries; returns the platform name."""
+    """Initialize jax's backend with retries.
+
+    Returns ``(platform, fallback)`` — ``fallback`` is True iff the
+    ambient/requested backend could not be brought up and the benchmark
+    dropped to CPU.  The flag travels into the emitted JSON so a driver
+    or dashboard can never mistake an outage-degraded number for a real
+    chip regression (round-3 lesson: BENCH_r03 recorded a CPU 1.07
+    img/s with nothing machine-readable marking it as a fallback).
+    """
     import jax
 
     # persistent executable cache: the ResNet-50 train step takes XLA
@@ -46,6 +61,15 @@ def _init_backend():
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     except Exception as e:
         _log("compilation cache unavailable: %s" % e)
+    # honor an explicit JAX_PLATFORMS override in this process too
+    try:
+        from jax._src import xla_bridge as _xb
+
+        if os.environ.get("JAX_PLATFORMS") and \
+                not _xb.backends_are_initialized():
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    except Exception:
+        pass
     last = None
     # the tunnel to the chip can be down for extended periods; probe in a
     # SUBPROCESS with a hard timeout (jax.devices() can hang rather than
@@ -56,9 +80,15 @@ def _init_backend():
     n_attempts = 6
     for attempt in range(n_attempts):
         try:
+            # the probe honors a JAX_PLATFORMS env override through the
+            # config API (the image may have pinned another platform via
+            # config at interpreter startup, and config beats env)
             probe = subprocess.run(
                 [sys.executable, "-c",
-                 "import jax; print(jax.devices()[0].platform)"],
+                 "import os, jax\n"
+                 "p = os.environ.get('JAX_PLATFORMS')\n"
+                 "if p: jax.config.update('jax_platforms', p)\n"
+                 "print(jax.devices()[0].platform)"],
                 capture_output=True, text=True, timeout=60)
             if probe.returncode == 0 and probe.stdout.strip():
                 # the probe just initialized the backend successfully in
@@ -82,7 +112,7 @@ def _init_backend():
                 if done.wait(timeout=120) and "devs" in result:
                     devs = result["devs"]
                     _log("devices: %s" % (devs,))
-                    return devs[0].platform
+                    return devs[0].platform, False
                 last = result.get("err", "parent backend init stalled")
             else:
                 last = (probe.stderr.strip() or probe.stdout.strip()
@@ -98,7 +128,7 @@ def _init_backend():
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
-    return jax.devices()[0].platform
+    return jax.devices()[0].platform, True
 
 
 def _run_bert(platform):
@@ -363,54 +393,75 @@ def _run(platform):
     return img_s
 
 
-def main():
-    bert_mode = "bert" in sys.argv[1:]
-    infer_mode = "infer" in sys.argv[1:]
-    llama_mode = "llama" in sys.argv[1:]
+_SPECS = {
+    # name -> (runner, metric, unit, baseline or None)
+    "train": (_run, "resnet50_train_throughput", "images/sec",
+              BASELINE_IMG_S),
+    "infer": (_run_infer, "resnet50_infer_throughput", "images/sec",
+              BASELINE_INFER_FP16),
+    "bert": (_run_bert, "bert_base_train_throughput", "samples/sec", None),
+    "llama": (_run_llama, "llama_decoder_train_throughput", "tokens/sec",
+              None),
+}
+
+
+def _measure(name, platform, fallback):
+    """Run one benchmark; always returns a JSON-able record."""
+    runner, metric, unit, baseline = _SPECS[name]
     try:
-        platform = _init_backend()
-        if bert_mode:
-            value = _run_bert(platform)
-        elif infer_mode:
-            value = _run_infer(platform)
-        elif llama_mode:
-            value = _run_llama(platform)
-        else:
-            value = _run(platform)
+        value = runner(platform)
     except Exception:
         traceback.print_exc(file=sys.stderr)
-        _log("benchmark failed; emitting value 0")
+        _log("%s benchmark failed; emitting value 0" % name)
         value = 0.0
-    if bert_mode:
-        print(json.dumps({
-            "metric": "bert_base_train_throughput",
-            "value": round(value, 2),
-            "unit": "samples/sec",
-            "vs_baseline": 0.0,
-        }))
-        return
-    if llama_mode:
-        print(json.dumps({
-            "metric": "llama_decoder_train_throughput",
-            "value": round(value, 2),
-            "unit": "tokens/sec",
-            "vs_baseline": 0.0,
-        }))
-        return
-    if infer_mode:
-        print(json.dumps({
-            "metric": "resnet50_infer_throughput",
-            "value": round(value, 2),
-            "unit": "images/sec",
-            "vs_baseline": round(value / BASELINE_INFER_FP16, 3),
-        }))
-        return
-    print(json.dumps({
-        "metric": "resnet50_train_throughput",
+    return {
+        "metric": metric,
         "value": round(value, 2),
-        "unit": "images/sec",
-        "vs_baseline": round(value / BASELINE_IMG_S, 3),
-    }))
+        "unit": unit,
+        "vs_baseline": round(value / baseline, 3) if baseline else 0.0,
+        "platform": platform,
+        "fallback": fallback,
+    }
+
+
+def main():
+    t_start = time.perf_counter()
+    requested = [a for a in sys.argv[1:] if a in _SPECS and a != "train"]
+    try:
+        platform, fallback = _init_backend()
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        platform, fallback = "unknown", True
+
+    if requested:  # single-metric mode: `bench.py bert|infer|llama`
+        print(json.dumps(_measure(requested[0], platform, fallback)))
+        return
+
+    # Default mode: the headline ResNet-50 train number PLUS every
+    # secondary metric, all in ONE JSON line (the driver records the
+    # line verbatim; secondaries ride in "metrics" so one artifact
+    # carries chip evidence for the full headline set).  A time budget
+    # keeps a cold-cache run bounded: secondaries are skipped — and
+    # recorded as skipped — once the budget is spent.
+    budget = float(os.environ.get("MXNET_BENCH_BUDGET", "2700"))
+    head = _measure("train", platform, fallback)
+    metrics = [head]
+    for name in ("infer", "bert", "llama"):
+        elapsed = time.perf_counter() - t_start
+        if elapsed > budget:
+            _log("budget %.0fs spent (%.0fs elapsed); skipping %s"
+                 % (budget, elapsed, name))
+            metrics.append({
+                "metric": _SPECS[name][1], "value": 0.0,
+                "unit": _SPECS[name][2], "vs_baseline": 0.0,
+                "platform": platform, "fallback": fallback,
+                "skipped": "time budget",
+            })
+            continue
+        metrics.append(_measure(name, platform, fallback))
+    out = dict(head)
+    out["metrics"] = metrics
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -18,6 +18,38 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+
+def _honor_platform_env():
+    """Make a ``JAX_PLATFORMS`` environment override actually win.
+
+    The deployment image may register an accelerator PJRT plugin at
+    interpreter startup and set the platform through jax's *config* API;
+    config beats the env var, so a subprocess launched with
+    ``JAX_PLATFORMS=cpu`` would still try to initialize the accelerator
+    backend — and hang, not raise, if the device link is down.  Pushing
+    the env value back through the config API (before any backend is
+    instantiated) restores the documented env-var contract for every
+    process that imports this package (tools/launch.py servers and
+    workers, tools/diagnose.py, test subprocesses).
+    """
+    import os
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    try:
+        import jax
+        from jax._src import xla_bridge as _xb
+
+        if _xb.backends_are_initialized():
+            return  # too late to redirect a live backend; leave it be
+        jax.config.update("jax_platforms", plat)
+    except Exception:
+        pass  # never let platform plumbing break the import
+
+
+_honor_platform_env()
+
 from .base import MXNetError  # noqa: F401
 from .context import (  # noqa: F401
     Context, cpu, cpu_pinned, gpu, tpu, num_gpus, num_tpus, current_context,

@@ -79,12 +79,34 @@ CMD_ERR = 255
 _MAX_FRAME = 1 << 34  # 16 GiB sanity ceiling per tensor/string
 
 
+def _wire_timeout():
+    """Deadline (seconds) for any single blocking wire read/connect.
+
+    A wedged peer (e.g. a server process that died mid-round, or one
+    stuck in accelerator backend init) must surface as a clear error,
+    never an indefinite ``recv`` hang.  0 disables (not recommended).
+
+    The default is generous (30 min) because sync-mode replies
+    legitimately block on the SLOWEST worker in the round — which may be
+    spending many minutes in its first-step XLA compile — and a deadline
+    that fires on a healthy straggler would kill the whole job.
+    """
+    t = float(os.environ.get("MXNET_KVSTORE_TIMEOUT", "1800"))
+    return t if t > 0 else None
+
+
 def _recv_exact(sock, n):
     buf = bytearray(n)
     view = memoryview(buf)
     got = 0
     while got < n:
-        r = sock.recv_into(view[got:], n - got)
+        try:
+            r = sock.recv_into(view[got:], n - got)
+        except socket.timeout:
+            raise MXNetError(
+                "kvstore: peer unresponsive for %ss (MXNET_KVSTORE_TIMEOUT;"
+                " a server or worker process is wedged or dead)"
+                % sock.gettimeout())
         if not r:
             raise ConnectionError("peer closed")
         got += r
@@ -171,7 +193,12 @@ def _recv(sock, max_bytes=_MAX_FRAME):
             view = memoryview(arr).cast("B")
             got = 0
             while got < nbytes:
-                r = sock.recv_into(view[got:], nbytes - got)
+                try:
+                    r = sock.recv_into(view[got:], nbytes - got)
+                except socket.timeout:
+                    raise MXNetError(
+                        "kvstore: peer unresponsive mid-tensor for %ss "
+                        "(MXNET_KVSTORE_TIMEOUT)" % sock.gettimeout())
                 if not r:
                     raise ConnectionError("peer closed")
                 got += r
@@ -410,6 +437,10 @@ class DistServer:
 
     def _handle(self, sock):
         authed = not _secret()
+        # unauthenticated peers get a short deadline (can't park a server
+        # thread); once authenticated the connection may legitimately sit
+        # idle between training rounds, so the deadline comes off
+        sock.settimeout(30.0 if _secret() else None)
         try:
             while not self._stop.is_set():
                 # unauthenticated peers may only send tiny (HELLO) frames
@@ -419,6 +450,7 @@ class DistServer:
                     authed = _server_hello(sock, f)
                     if not authed:
                         return
+                    sock.settimeout(None)
                     continue
                 if not authed:
                     _send(sock, CMD_ERR, "unauthenticated")
@@ -588,6 +620,10 @@ class DistKVStore(KVStoreBase):
                     (self._root, _server_port(self._root_port, server_id)),
                     timeout=60)
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # every later read inherits the wire deadline: a wedged
+                # server raises a diagnosable MXNetError instead of
+                # blocking this worker forever
+                s.settimeout(_wire_timeout())
                 _client_handshake(s)
                 self._socks[server_id] = s
             return s

@@ -1,0 +1,105 @@
+"""The driver records bench.py's stdout verbatim; this pins the JSON
+contract (platform/fallback provenance fields + the multi-metric array)
+without running the heavy benchmarks.
+
+Round-3 lesson: a CPU-fallback number with no machine-readable platform
+field was indistinguishable from a 300x chip regression in the recorded
+artifact.  These tests make that shape impossible to lose silently.
+"""
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _stub(mod, monkeypatch, values):
+    monkeypatch.setattr(mod, "_init_backend", lambda: ("cpu", False))
+    specs = {}
+    for name, (_, metric, unit, baseline) in mod._SPECS.items():
+        specs[name] = (lambda platform, v=values[name]: v,
+                       metric, unit, baseline)
+    monkeypatch.setattr(mod, "_SPECS", specs)
+
+
+def test_single_metric_line(monkeypatch, capsys):
+    mod = _load_bench()
+    _stub(mod, monkeypatch,
+          {"train": 100.0, "infer": 200.0, "bert": 300.0, "llama": 400.0})
+    monkeypatch.setattr(sys, "argv", ["bench.py", "bert"])
+    mod.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["metric"] == "bert_base_train_throughput"
+    assert rec["value"] == 300.0
+    assert rec["platform"] == "cpu"
+    assert rec["fallback"] is False
+
+
+def test_default_mode_emits_all_metrics_in_one_line(monkeypatch, capsys):
+    mod = _load_bench()
+    _stub(mod, monkeypatch,
+          {"train": 100.0, "infer": 200.0, "bert": 300.0, "llama": 400.0})
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    mod.main()
+    out_lines = [ln for ln in capsys.readouterr().out.strip().splitlines()
+                 if ln.startswith("{")]
+    assert len(out_lines) == 1, "driver contract: exactly ONE JSON line"
+    rec = json.loads(out_lines[0])
+    # headline at top level
+    assert rec["metric"] == "resnet50_train_throughput"
+    assert rec["value"] == 100.0
+    assert rec["vs_baseline"] > 0
+    assert rec["platform"] == "cpu" and rec["fallback"] is False
+    # all four metrics in the array, each with provenance
+    names = [m["metric"] for m in rec["metrics"]]
+    assert names == ["resnet50_train_throughput",
+                     "resnet50_infer_throughput",
+                     "bert_base_train_throughput",
+                     "llama_decoder_train_throughput"]
+    assert all("platform" in m and "fallback" in m for m in rec["metrics"])
+
+
+def test_budget_exhaustion_marks_skipped(monkeypatch, capsys):
+    mod = _load_bench()
+    _stub(mod, monkeypatch,
+          {"train": 100.0, "infer": 200.0, "bert": 300.0, "llama": 400.0})
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.setenv("MXNET_BENCH_BUDGET", "0")
+    mod.main()
+    rec = json.loads([ln for ln in capsys.readouterr().out.splitlines()
+                      if ln.startswith("{")][-1])
+    assert rec["value"] == 100.0  # headline always measured
+    skipped = [m for m in rec["metrics"] if m.get("skipped")]
+    assert len(skipped) == 3
+    assert all(m["value"] == 0.0 for m in skipped)
+
+
+def test_failed_benchmark_emits_zero_not_crash(monkeypatch, capsys):
+    mod = _load_bench()
+
+    def boom(platform):
+        raise RuntimeError("synthetic failure")
+
+    monkeypatch.setattr(mod, "_init_backend", lambda: ("cpu", True))
+    monkeypatch.setattr(mod, "_SPECS", {
+        "train": (boom, "resnet50_train_throughput", "images/sec", 363.69),
+        "infer": (boom, "resnet50_infer_throughput", "images/sec", 2085.51),
+        "bert": (boom, "bert_base_train_throughput", "samples/sec", None),
+        "llama": (boom, "llama_decoder_train_throughput", "tokens/sec",
+                  None),
+    })
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    mod.main()
+    rec = json.loads([ln for ln in capsys.readouterr().out.splitlines()
+                      if ln.startswith("{")][-1])
+    assert rec["value"] == 0.0 and rec["fallback"] is True
+    assert len(rec["metrics"]) == 4

@@ -156,7 +156,7 @@ def test_dist_push_with_compression():
 _WORKER_SCRIPT = r"""
 import os
 import numpy as np
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 jax.config.update("jax_platforms", "cpu")
 import mxnet_tpu as mx

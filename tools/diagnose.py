@@ -54,13 +54,50 @@ def check_mxnet():
 
 
 def check_backend():
+    """Backend init can HANG (not raise) when the accelerator link is
+    down, so the device query runs under a watchdog and reports a
+    timeout instead of wedging the whole diagnostic (which would defeat
+    its purpose exactly when it is most needed)."""
     print("----------Backend Info---------")
     try:
+        import threading
+
         import jax
+
+        # honor a JAX_PLATFORMS env override even if the image pinned a
+        # platform through the config API at interpreter startup
+        try:
+            from jax._src import xla_bridge as _xb
+
+            if os.environ.get("JAX_PLATFORMS") and \
+                    not _xb.backends_are_initialized():
+                jax.config.update("jax_platforms",
+                                  os.environ["JAX_PLATFORMS"])
+        except Exception:
+            pass
 
         print("jax          :", jax.__version__)
         t0 = time.time()
-        devs = jax.devices()
+        res = {}
+        done = threading.Event()
+
+        def _probe():
+            try:
+                res["devs"] = jax.devices()
+            except Exception as e:  # noqa: BLE001
+                res["err"] = e
+            done.set()
+
+        threading.Thread(target=_probe, daemon=True).start()
+        budget = float(os.environ.get("MXNET_DIAGNOSE_TIMEOUT", "60"))
+        if not done.wait(timeout=budget):
+            print("Devices      : TIMED OUT after %.0fs — backend init is "
+                  "wedged (accelerator tunnel down?)" % budget)
+            return
+        if "err" in res:
+            print("Devices      : init FAILED:", res["err"])
+            return
+        devs = res["devs"]
         print("Devices      : %s (init %.2fs)" % (devs, time.time() - t0))
         print("Default      :", jax.default_backend())
     except Exception as e:

@@ -49,6 +49,10 @@ def launch(num_workers, num_servers, command, kv_store="dist_sync",
     for sid in range(num_servers):
         env = dict(base_env)
         env.update({"DMLC_ROLE": "server", "DMLC_SERVER_ID": str(sid)})
+        # servers are CPU processes (parity: ps-lite servers never touch
+        # the accelerator) — and must not wedge on accelerator backend
+        # init when the device link is down
+        env["JAX_PLATFORMS"] = (env_extra or {}).get("JAX_PLATFORMS", "cpu")
         procs.append(subprocess.Popen(
             [sys.executable, "-c",
              "from mxnet_tpu.kvstore.kvstore_server import KVStoreServer;"
