@@ -3,3 +3,4 @@ from .io import (  # noqa: F401
     DataDesc, DataBatch, DataIter, ResizeIter, PrefetchingIter,
     NDArrayIter, CSVIter, MNISTIter, ImageRecordIter,
 )
+from .libsvm import LibSVMIter, read_libsvm  # noqa: F401

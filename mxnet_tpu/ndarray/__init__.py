@@ -28,6 +28,21 @@ from .register import populate as _populate
 
 _populate(globals())
 
+# imperative cast_storage returns REAL sparse views (the registry op is
+# the dense/graph rendering; parity: mx.nd.cast_storage returning
+# CSRNDArray/RowSparseNDArray objects)
+_graph_cast_storage = cast_storage  # noqa: F821  (registry-generated)
+
+
+def cast_storage(data, stype="default"):  # noqa: F811
+    if getattr(data, "stype", "default") != "default":
+        return sparse.cast_storage(data, stype)
+    if stype != "default" and not getattr(data, "_in_graph", False):
+        # eager dense -> sparse view; in-graph (taped/jitted) arrays stay
+        # on the registry op, whose dense rendering is differentiable
+        return sparse.cast_storage(data, stype)
+    return _graph_cast_storage(data, stype=stype)
+
 # control-flow operators (lax.scan/while/cond lowering; ops/control_flow.py)
 from ..ops.control_flow import (  # noqa: E402
     foreach as _contrib_foreach,

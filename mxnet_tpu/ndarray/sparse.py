@@ -221,6 +221,187 @@ def retain(data, indices):
                             cur_idx[mask], data.shape, ctx=data.context)
 
 
+def cast_storage(arr, stype):
+    """Convert between storage types (parity:
+    ``src/operator/tensor/cast_storage.cc``).
+
+    ``default`` ↔ ``row_sparse`` / ``csr`` in any direction (sparse →
+    sparse routes through dense — same as the reference, which supports
+    only default↔sparse pairs per cast).
+    """
+    cur = getattr(arr, "stype", "default")
+    if cur == stype:
+        return arr
+    if cur != "default":
+        arr = arr.tostype("default")
+        if stype == "default":
+            return arr
+    if stype == "row_sparse":
+        return row_sparse_array(arr)
+    if stype == "csr":
+        if len(arr.shape) != 2:
+            raise MXNetError("cast_storage to csr needs a 2-D array")
+        return csr_matrix(arr)
+    raise MXNetError("cast_storage: unknown stype %r" % (stype,))
+
+
+def _csr_to_coo_rows(csr):
+    indptr = csr.indptr.asnumpy().astype(_np.int64)
+    return _np.repeat(_np.arange(csr.shape[0]), _np.diff(indptr))
+
+
+def _coo_to_csr(rows, cols, vals, shape, ctx):
+    """Canonicalize COO (sorted, duplicates summed) into a CSRNDArray."""
+    order = _np.lexsort((cols, rows))
+    rows, cols, vals = rows[order], cols[order], _np.asarray(vals)[order]
+    if len(rows):
+        key = rows * shape[1] + cols
+        uniq, inv = _np.unique(key, return_inverse=True)
+        summed = _np.zeros(len(uniq), vals.dtype)
+        _np.add.at(summed, inv, vals)
+        rows, cols, vals = uniq // shape[1], uniq % shape[1], summed
+        nz = summed != 0
+        rows, cols, vals = rows[nz], cols[nz], vals[nz]
+    indptr = _np.zeros(shape[0] + 1, _np.int64)
+    _np.add.at(indptr, rows + 1, 1)
+    _np.cumsum(indptr, out=indptr)
+    return CSRNDArray(vals, indptr, cols, shape, ctx=ctx)
+
+
+def add(lhs, rhs):
+    """Sparse-aware elementwise add (parity: ``elemwise_add`` sparse
+    dispatch, ``src/operator/tensor/elemwise_binary_op_basic.cc``).
+
+    csr+csr → csr and rsp+rsp → rsp keep the sparse storage; any mixed
+    pairing falls back to dense, like the reference's FComputeEx table.
+    The csr merge happens host-side (IO-scale data; the device path is
+    dense).
+    """
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray):
+        if lhs.shape != rhs.shape:
+            raise MXNetError("sparse add: shape mismatch")
+        rows = _np.concatenate([_csr_to_coo_rows(lhs),
+                                _csr_to_coo_rows(rhs)])
+        cols = _np.concatenate([lhs.indices.asnumpy(),
+                                rhs.indices.asnumpy()]).astype(_np.int64)
+        vals = _np.concatenate([lhs.data_arr.asnumpy(),
+                                rhs.data_arr.asnumpy()])
+        return _coo_to_csr(rows, cols, vals, lhs.shape, lhs.context)
+    if isinstance(lhs, RowSparseNDArray) and \
+            isinstance(rhs, RowSparseNDArray):
+        return lhs + rhs  # already sparse-preserving (compacted)
+    lhs = lhs.tostype("default") if hasattr(lhs, "tostype") else lhs
+    rhs = rhs.tostype("default") if hasattr(rhs, "tostype") else rhs
+    return lhs + rhs
+
+
+def multiply(lhs, rhs):
+    """Sparse-aware elementwise multiply.
+
+    csr*csr / rsp*rsp intersect the nonzero patterns; sparse*scalar
+    scales values in place (sparsity preserved — the reference's
+    ``_mul_scalar`` sparse kernel); sparse*dense keeps the sparse
+    operand's pattern (zeros stay zero).
+    """
+    import numbers
+
+    if isinstance(lhs, numbers.Number):
+        lhs, rhs = rhs, lhs
+    if isinstance(rhs, numbers.Number):
+        if isinstance(lhs, RowSparseNDArray):
+            return RowSparseNDArray(
+                NDArray(lhs.values.data() * float(rhs)), lhs.indices,
+                lhs.shape, ctx=lhs.context, canonical=lhs._canonical)
+        if isinstance(lhs, CSRNDArray):
+            return CSRNDArray(NDArray(lhs.data_arr.data() * float(rhs)),
+                              lhs.indptr, lhs.indices, lhs.shape,
+                              ctx=lhs.context)
+        return lhs * rhs
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray):
+        if lhs.shape != rhs.shape:
+            raise MXNetError("sparse multiply: shape mismatch")
+        key_l = _csr_to_coo_rows(lhs) * lhs.shape[1] \
+            + lhs.indices.asnumpy().astype(_np.int64)
+        key_r = _csr_to_coo_rows(rhs) * rhs.shape[1] \
+            + rhs.indices.asnumpy().astype(_np.int64)
+        common, li, ri = _np.intersect1d(key_l, key_r,
+                                         return_indices=True)
+        vals = lhs.data_arr.asnumpy()[li] * rhs.data_arr.asnumpy()[ri]
+        return _coo_to_csr(common // lhs.shape[1], common % lhs.shape[1],
+                           vals, lhs.shape, lhs.context)
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray):
+        # dense rhs sampled at the csr pattern: out keeps lhs's nonzeros
+        rows = _csr_to_coo_rows(lhs)
+        cols = lhs.indices.asnumpy().astype(_np.int64)
+        picked = rhs.asnumpy()[rows, cols]
+        return CSRNDArray(lhs.data_arr.asnumpy() * picked,
+                          lhs.indptr.asnumpy(), cols, lhs.shape,
+                          ctx=lhs.context)
+    if isinstance(lhs, RowSparseNDArray) and \
+            isinstance(rhs, RowSparseNDArray):
+        a, b = lhs.compact(), rhs.compact()
+        common, ai, bi = _np.intersect1d(
+            a.indices.asnumpy().astype(_np.int64),
+            b.indices.asnumpy().astype(_np.int64), return_indices=True)
+        vals = a.values.data()[jnp.asarray(ai)] \
+            * b.values.data()[jnp.asarray(bi)]
+        return RowSparseNDArray(NDArray(vals), common, lhs.shape,
+                                ctx=lhs.context, canonical=True)
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, NDArray):
+        picked = rhs.data()[lhs.indices.data().astype(jnp.int32)]
+        return RowSparseNDArray(NDArray(lhs.values.data() * picked),
+                                lhs.indices, lhs.shape, ctx=lhs.context,
+                                canonical=lhs._canonical)
+    return lhs.tostype("default") * (rhs.tostype("default")
+                                     if hasattr(rhs, "tostype") else rhs)
+
+
+def square_sum(data, axis=None, keepdims=False):
+    """``sum(data ** 2)`` without densifying (parity:
+    ``src/operator/tensor/square_sum.cc`` — the reference adds this op
+    precisely because dense ``square`` + ``sum`` would materialize the
+    full array; here it reduces over stored values only, since zero
+    entries contribute nothing to a square-sum).
+
+    Row_sparse with ``axis=1`` returns row_sparse (the reference's
+    documented sparse-out case); everything else returns dense.
+    """
+    ax = tuple(axis) if isinstance(axis, (tuple, list)) else \
+        (axis,) if axis is not None else None
+    if isinstance(data, RowSparseNDArray):
+        d = data.compact()
+        vals = d.values.data()
+        if ax == (1,) and len(data.shape) == 2:
+            red = jnp.sum(jnp.square(vals), axis=1)
+            if keepdims:
+                red = red[:, None]
+            out_shape = (data.shape[0], 1) if keepdims else (data.shape[0],)
+            return RowSparseNDArray(NDArray(red), d.indices, out_shape,
+                                    ctx=data.context, canonical=True)
+        if ax is None:
+            out = jnp.sum(jnp.square(vals))
+            if keepdims:
+                out = out.reshape((1,) * len(data.shape))
+            return NDArray(out, ctx=data.context)
+        if ax == (0,):
+            # absent rows are zero, so summing stored rows is exact
+            out = jnp.sum(jnp.square(vals), axis=0)
+            if keepdims:
+                out = out[None]
+            return NDArray(out, ctx=data.context)
+        raise MXNetError("square_sum: unsupported axis %r" % (axis,))
+    if isinstance(data, CSRNDArray):
+        vals = data.data_arr.data()
+        if ax is None:
+            out = jnp.sum(jnp.square(vals))
+            if keepdims:
+                out = out.reshape((1, 1))
+            return NDArray(out, ctx=data.context)
+        data = data.tostype("default")
+    out = jnp.sum(jnp.square(data.data()), axis=ax, keepdims=keepdims)
+    return NDArray(out, ctx=data.context)
+
+
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     """csr · dense without densifying the csr operand.
 

@@ -164,3 +164,259 @@ def test_rowsparse_add_and_compact():
     expect[0] = 5
     assert_almost_equal(dense, expect)
     assert c.indices.asnumpy().tolist() == [0, 1, 3]
+
+
+# ---------------------------------------------------------------------------
+# sparse op sweep: every structured-sparse op checked against the dense
+# oracle over a grid of shapes/densities (mirrors the dense registry
+# sweep in test_op_numerics; ref: tests/python/unittest/
+# test_sparse_operator.py's check_sparse_* harness)
+# ---------------------------------------------------------------------------
+
+def _rand_dense(shape, density, seed):
+    rs = np.random.RandomState(seed)
+    arr = rs.randn(*shape).astype(np.float32)
+    mask = rs.rand(*shape) < density
+    return arr * mask
+
+
+def _rand_csr(shape, density, seed):
+    return sparse.csr_matrix(_rand_dense(shape, density, seed))
+
+
+def _rand_rsp(shape, density, seed):
+    rs = np.random.RandomState(seed)
+    arr = rs.randn(*shape).astype(np.float32)
+    row_mask = rs.rand(shape[0]) < density
+    return sparse.row_sparse_array(arr * row_mask[:, None])
+
+
+_GRID = [((5, 7), 0.3, 0), ((1, 4), 0.9, 1), ((16, 3), 0.05, 2),
+         ((8, 8), 0.0, 3)]
+
+
+@pytest.mark.parametrize("shape,density,seed", _GRID)
+def test_sweep_cast_storage_round_trips(shape, density, seed):
+    dense = nd.array(_rand_dense(shape, density, seed))
+    for stype, cls in (("csr", sparse.CSRNDArray),
+                       ("row_sparse", sparse.RowSparseNDArray)):
+        sp = nd.cast_storage(dense, stype)
+        assert isinstance(sp, cls) and sp.stype == stype
+        back = nd.cast_storage(sp, "default")
+        assert_almost_equal(back.asnumpy(), dense.asnumpy())
+        # sparse->sparse cross-cast routes through dense
+        other = "row_sparse" if stype == "csr" else "csr"
+        cross = sparse.cast_storage(sp, other)
+        assert cross.stype == other
+        assert_almost_equal(cross.tostype("default").asnumpy(),
+                            dense.asnumpy())
+
+
+@pytest.mark.parametrize("shape,density,seed", _GRID)
+def test_sweep_csr_add(shape, density, seed):
+    a, b = _rand_csr(shape, density, seed), _rand_csr(shape, density,
+                                                      seed + 10)
+    out = sparse.add(a, b)
+    assert out.stype == "csr"
+    assert_almost_equal(out.tostype("default").asnumpy(),
+                        a.tostype("default").asnumpy()
+                        + b.tostype("default").asnumpy())
+
+
+@pytest.mark.parametrize("shape,density,seed", _GRID)
+def test_sweep_rsp_add(shape, density, seed):
+    a, b = _rand_rsp(shape, density, seed), _rand_rsp(shape, density,
+                                                      seed + 10)
+    out = sparse.add(a, b)
+    assert out.stype == "row_sparse"
+    assert_almost_equal(out.tostype("default").asnumpy(),
+                        a.tostype("default").asnumpy()
+                        + b.tostype("default").asnumpy())
+
+
+@pytest.mark.parametrize("shape,density,seed", _GRID)
+def test_sweep_multiply_pattern_intersection(shape, density, seed):
+    a, b = _rand_csr(shape, density, seed), _rand_csr(shape, density,
+                                                      seed + 10)
+    out = sparse.multiply(a, b)
+    assert out.stype == "csr"
+    assert_almost_equal(out.tostype("default").asnumpy(),
+                        a.tostype("default").asnumpy()
+                        * b.tostype("default").asnumpy())
+    ra, rb = _rand_rsp(shape, density, seed), _rand_rsp(shape, density,
+                                                        seed + 5)
+    rout = sparse.multiply(ra, rb)
+    assert rout.stype == "row_sparse"
+    assert_almost_equal(rout.tostype("default").asnumpy(),
+                        ra.tostype("default").asnumpy()
+                        * rb.tostype("default").asnumpy())
+
+
+@pytest.mark.parametrize("shape,density,seed", _GRID)
+def test_sweep_multiply_scalar_and_dense(shape, density, seed):
+    a = _rand_csr(shape, density, seed)
+    out = sparse.multiply(a, 2.5)
+    assert out.stype == "csr"
+    assert_almost_equal(out.tostype("default").asnumpy(),
+                        a.tostype("default").asnumpy() * 2.5)
+    d = nd.array(_rand_dense(shape, 1.0, seed + 3) + 1.0)
+    out2 = sparse.multiply(a, d)
+    assert out2.stype == "csr"
+    assert_almost_equal(out2.tostype("default").asnumpy(),
+                        a.tostype("default").asnumpy() * d.asnumpy())
+    r = _rand_rsp(shape, density, seed)
+    out3 = sparse.multiply(r, d)
+    assert out3.stype == "row_sparse"
+    assert_almost_equal(out3.tostype("default").asnumpy(),
+                        r.tostype("default").asnumpy() * d.asnumpy())
+
+
+@pytest.mark.parametrize("shape,density,seed", _GRID)
+def test_sweep_square_sum_vs_dense_oracle(shape, density, seed):
+    r = _rand_rsp(shape, density, seed)
+    dense = r.tostype("default").asnumpy()
+    # full reduction
+    assert_almost_equal(sparse.square_sum(r).asnumpy(),
+                        np.sum(dense ** 2), rtol=1e-5, atol=1e-6)
+    # axis=1 keeps row_sparse (the reference's sparse-out case)
+    out = sparse.square_sum(r, axis=1)
+    assert out.stype == "row_sparse"
+    assert_almost_equal(out.tostype("default").asnumpy(),
+                        np.sum(dense ** 2, axis=1), rtol=1e-5, atol=1e-6)
+    out_k = sparse.square_sum(r, axis=1, keepdims=True)
+    assert_almost_equal(out_k.tostype("default").asnumpy(),
+                        np.sum(dense ** 2, axis=1, keepdims=True),
+                        rtol=1e-5, atol=1e-6)
+    # axis=0 densifies
+    assert_almost_equal(sparse.square_sum(r, axis=0).asnumpy(),
+                        np.sum(dense ** 2, axis=0), rtol=1e-5, atol=1e-6)
+    c = _rand_csr(shape, density, seed)
+    assert_almost_equal(sparse.square_sum(c).asnumpy(),
+                        np.sum(c.tostype("default").asnumpy() ** 2),
+                        rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("shape,density,seed", _GRID)
+def test_sweep_retain_and_dot(shape, density, seed):
+    r = _rand_rsp(shape, density, seed)
+    keep = np.arange(0, shape[0], 2, dtype=np.int64)
+    out = sparse.retain(r, keep)
+    dense = r.tostype("default").asnumpy().copy()
+    mask = np.zeros(shape[0], bool)
+    mask[keep] = True
+    dense[~mask] = 0
+    assert_almost_equal(out.tostype("default").asnumpy(), dense)
+    c = _rand_csr(shape, density, seed)
+    rhs = np.random.RandomState(seed + 7).randn(
+        shape[1], 3).astype(np.float32)
+    got = sparse.dot(c, nd.array(rhs))
+    assert_almost_equal(got.asnumpy(),
+                        c.tostype("default").asnumpy() @ rhs,
+                        rtol=1e-4, atol=1e-5)
+    gotT = sparse.dot(c, nd.array(np.random.RandomState(seed + 8).randn(
+        shape[0], 3).astype(np.float32)), transpose_a=True)
+    assert gotT.shape == (shape[1], 3)
+
+
+# ---------------------------------------------------------------------------
+# LibSVMIter (ref: src/io/iter_libsvm.cc)
+# ---------------------------------------------------------------------------
+
+def _write_libsvm(path, dense, labels):
+    with open(path, "w") as f:
+        for row, lab in zip(dense, labels):
+            toks = ["%g" % lab]
+            for j in np.nonzero(row)[0]:
+                toks.append("%d:%g" % (j, row[j]))
+            f.write(" ".join(toks) + "\n")
+
+
+def test_libsvm_iter_round_trip(tmp_path):
+    from mxnet_tpu.io import LibSVMIter
+
+    rs = np.random.RandomState(0)
+    dense = (rs.randn(11, 6) * (rs.rand(11, 6) < 0.4)).astype(np.float32)
+    labels = rs.randint(0, 2, 11).astype(np.float32)
+    p = str(tmp_path / "train.libsvm")
+    _write_libsvm(p, dense, labels)
+
+    it = LibSVMIter(data_libsvm=p, data_shape=(6,), batch_size=4)
+    assert it.num_examples == 11
+    got_rows, got_labels = [], []
+    n_batches = 0
+    for batch in it:
+        n_batches += 1
+        data = batch.data[0]
+        assert data.stype == "csr" and data.shape == (4, 6)
+        got_rows.append(data.tostype("default").asnumpy())
+        got_labels.append(batch.label[0].asnumpy())
+    assert n_batches == 3  # 11 examples, batch 4, round_batch wraps
+    got = np.concatenate(got_rows)[:11]
+    assert_almost_equal(got, dense)
+    assert_almost_equal(np.concatenate(got_labels)[:11], labels)
+    # last batch wrapped to the front (round_batch) and reported pad
+    assert_almost_equal(got_rows[-1][3], dense[0])
+    # reset replays the epoch identically
+    it.reset()
+    again = next(it).data[0].tostype("default").asnumpy()
+    assert_almost_equal(again, dense[:4])
+
+
+def test_libsvm_iter_sharding(tmp_path):
+    from mxnet_tpu.io import LibSVMIter
+
+    dense = np.diag(np.arange(1.0, 9.0)).astype(np.float32)
+    labels = np.arange(8).astype(np.float32)
+    p = str(tmp_path / "train.libsvm")
+    _write_libsvm(p, dense, labels)
+    seen = []
+    for part in range(2):
+        it = LibSVMIter(data_libsvm=p, data_shape=(8,), batch_size=2,
+                        num_parts=2, part_index=part, round_batch=False)
+        for batch in it:
+            seen.extend(batch.label[0].asnumpy().tolist())
+    assert sorted(seen) == labels.tolist()  # disjoint cover, no overlap
+
+
+def test_libsvm_parse_errors(tmp_path):
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.io import LibSVMIter
+
+    p = str(tmp_path / "bad.libsvm")
+    with open(p, "w") as f:
+        f.write("1 9:1.0\n")
+    with pytest.raises(MXNetError, match="ZERO-based"):
+        LibSVMIter(data_libsvm=p, data_shape=(6,), batch_size=1)
+    with open(p, "w") as f:
+        f.write("1 abc\n")
+    with pytest.raises(MXNetError, match="bad token"):
+        LibSVMIter(data_libsvm=p, data_shape=(6,), batch_size=1)
+
+
+def test_cast_storage_in_graph_stays_differentiable():
+    """In-graph (taped) cast_storage must stay on the dense registry op
+    so autograd through it works; eager calls return real sparse views."""
+    x = nd.array(np.ones((2, 2), np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.cast_storage(x, "row_sparse")
+        z = y * 3.0
+    z.backward()
+    g = x.grad() if callable(x.grad) else x.grad
+    assert_almost_equal(g.asnumpy(), np.full((2, 2), 3.0, np.float32))
+    # eager: a real sparse object comes back
+    assert nd.cast_storage(nd.array(np.eye(3)), "csr").stype == "csr"
+
+
+def test_libsvm_round_batch_exceeding_shard(tmp_path):
+    from mxnet_tpu.io import LibSVMIter
+
+    dense = np.diag([1.0, 2.0, 3.0]).astype(np.float32)
+    p = str(tmp_path / "tiny.libsvm")
+    _write_libsvm(p, dense, np.arange(3.0))
+    it = LibSVMIter(data_libsvm=p, data_shape=(3,), batch_size=8)
+    batch = next(it)  # batch larger than the whole shard: wrap repeats
+    got = batch.data[0].tostype("default").asnumpy()
+    expect = dense[np.arange(8) % 3]
+    assert_almost_equal(got, expect)
+    assert batch.pad == 5
