@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Generate ``reference_lenet.onnx`` + ``reference_lenet_expected.npz``.
+
+A *foreign* ONNX fixture for the cross-implementation import test
+(VERDICT r3 item 5): the bytes are assembled by THIS standalone
+encoder — deliberately independent of ``mxnet_tpu.contrib.onnx._proto``
+— following the official ``onnx.proto3`` schema, with the graph/node
+naming conventions the reference's exporter
+(``python/mxnet/contrib/onnx/mx2onnx/export_onnx.py``) produces
+("convolution0", "pooling0", "fullyconnected0", params named
+``<node>_weight``/``<node>_bias``).  The expected output is computed
+with plain numpy (no mxnet_tpu imports), so the import test checks the
+whole decode→graph→execute chain against an implementation that shares
+no code with it.
+
+Run from the repo root to regenerate:
+    python tests/fixtures/gen_reference_onnx.py
+"""
+import os
+import struct
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+# -- minimal protobuf writer (wire format only; onnx.proto3 field ids) ------
+
+def varint(v):
+    out = b""
+    v &= (1 << 64) - 1
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        out += bytes([b | (0x80 if v else 0)])
+        if not v:
+            return out
+
+
+def key(field, wire):
+    return varint((field << 3) | wire)
+
+
+def ld(field, payload):  # length-delimited
+    return key(field, 2) + varint(len(payload)) + payload
+
+
+def vint(field, v):
+    return key(field, 0) + varint(v)
+
+
+def packed_ints(field, vals):
+    return ld(field, b"".join(varint(v) for v in vals))
+
+
+ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_INTS = 1, 2, 3, 7
+
+
+def attr_ints(name, vals):
+    return ld(1, name.encode()) + packed_ints(8, vals) + vint(20, ATTR_INTS)
+
+
+def attr_int(name, v):
+    return ld(1, name.encode()) + vint(3, v) + vint(20, ATTR_INT)
+
+
+def attr_float(name, v):
+    return ld(1, name.encode()) + key(2, 5) \
+        + struct.pack("<f", v) + vint(20, ATTR_FLOAT)
+
+
+def node(op_type, inputs, outputs, name, attrs=b""):
+    body = b"".join(ld(1, i.encode()) for i in inputs)
+    body += b"".join(ld(2, o.encode()) for o in outputs)
+    body += ld(3, name.encode()) + ld(4, op_type.encode())
+    if attrs:
+        body += b"".join(ld(5, a) for a in
+                         (attrs if isinstance(attrs, list) else [attrs]))
+    return ld(1, body)  # GraphProto.node = 1
+
+
+def tensor(name, arr):
+    arr = np.ascontiguousarray(arr, np.float32)
+    body = packed_ints(1, list(arr.shape))        # dims
+    body += vint(2, 1)                            # data_type = FLOAT
+    body += ld(8, name.encode())                  # name
+    body += ld(9, arr.tobytes())                  # raw_data
+    return ld(5, body)  # GraphProto.initializer = 5
+
+
+def value_info(field, name, shape):
+    dims = b"".join(ld(1, vint(1, d)) for d in shape)  # dim{dim_value}
+    tshape = ld(2, dims)                               # shape
+    ttype = vint(1, 1) + tshape                        # elem_type FLOAT
+    typ = ld(1, ttype)                                 # type.tensor_type
+    return ld(field, ld(1, name.encode()) + ld(2, typ))
+
+
+def main():
+    rs = np.random.RandomState(7)
+    x = rs.randn(1, 1, 8, 8).astype(np.float32)
+    wc = (rs.randn(4, 1, 3, 3) * 0.4).astype(np.float32)
+    bc = (rs.randn(4) * 0.1).astype(np.float32)
+    wf = (rs.randn(10, 4 * 4 * 4) * 0.2).astype(np.float32)
+    bf = (rs.randn(10) * 0.1).astype(np.float32)
+
+    # numpy oracle: conv(pad1) -> relu -> maxpool2s2 -> flatten -> gemm
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    conv = np.zeros((1, 4, 8, 8), np.float32)
+    for co in range(4):
+        for i in range(8):
+            for j in range(8):
+                conv[0, co, i, j] = np.sum(
+                    xp[0, :, i:i + 3, j:j + 3] * wc[co]) + bc[co]
+    relu = np.maximum(conv, 0)
+    pool = relu.reshape(1, 4, 4, 2, 4, 2).max(axis=(3, 5))
+    flat = pool.reshape(1, -1)
+    out = flat @ wf.T + bf
+
+    nodes = [
+        node("Conv", ["data", "convolution0_weight", "convolution0_bias"],
+             ["convolution0"], "convolution0",
+             [attr_ints("kernel_shape", [3, 3]),
+              attr_ints("pads", [1, 1, 1, 1]),
+              attr_ints("strides", [1, 1]),
+              attr_int("group", 1)]),
+        node("Relu", ["convolution0"], ["activation0"], "activation0"),
+        node("MaxPool", ["activation0"], ["pooling0"], "pooling0",
+             [attr_ints("kernel_shape", [2, 2]),
+              attr_ints("strides", [2, 2]),
+              attr_ints("pads", [0, 0, 0, 0])]),
+        node("Flatten", ["pooling0"], ["flatten0"], "flatten0",
+             [attr_int("axis", 1)]),
+        node("Gemm", ["flatten0", "fullyconnected0_weight",
+                      "fullyconnected0_bias"], ["fullyconnected0"],
+             "fullyconnected0",
+             [attr_float("alpha", 1.0), attr_float("beta", 1.0),
+              attr_int("transA", 0), attr_int("transB", 1)]),
+    ]
+    graph = b"".join(nodes)
+    graph += tensor("convolution0_weight", wc)
+    graph += tensor("convolution0_bias", bc)
+    graph += tensor("fullyconnected0_weight", wf)
+    graph += tensor("fullyconnected0_bias", bf)
+    graph += ld(2, b"mxnet_converted_model")  # GraphProto.name = 2
+    graph += value_info(11, "data", [1, 1, 8, 8])        # input
+    graph += value_info(12, "fullyconnected0", [1, 10])  # output
+
+    model = vint(1, 8)                                   # ir_version
+    model += ld(2, b"mxnet")                             # producer_name
+    model += ld(3, b"1.9.1")                             # producer_version
+    model += ld(7, graph)                                # graph
+    model += ld(8, vint(2, 13))                          # opset v13
+    with open(os.path.join(HERE, "reference_lenet.onnx"), "wb") as f:
+        f.write(model)
+    np.savez(os.path.join(HERE, "reference_lenet_expected.npz"),
+             x=x, expected=out)
+    print("wrote reference_lenet.onnx (%d bytes), expected %s"
+          % (len(model), out.shape))
+
+
+if __name__ == "__main__":
+    main()
