@@ -7,7 +7,7 @@ rows.  With ``sparse_grad=True`` the gradient is a RowSparseNDArray of
 just the touched rows and the optimizer applies a lazy gather→update→
 scatter, so step cost scales with the batch, not the table.
 
-    python examples/sparse/linear_classification.py [--vocab 2000]
+    python examples/sparse/sparse_embedding.py [--vocab 2000]
 """
 from __future__ import annotations
 

@@ -55,11 +55,16 @@ def read_libsvm(path, num_features=None, label_width=1):
                 try:
                     idx_s, val_s = tok.split(":", 1)
                     idx = int(idx_s)
+                    val = float(val_s)
                 except ValueError:
                     raise MXNetError("libsvm %s:%d: bad token %r"
                                      % (path, lineno, tok))
+                if idx < 0:
+                    raise MXNetError(
+                        "libsvm %s:%d: negative feature index %d "
+                        "(indices are ZERO-based)" % (path, lineno, idx))
                 cols.append(idx)
-                vals.append(float(val_s))
+                vals.append(val)
                 max_col = max(max_col, idx)
             indptr.append(len(cols))
     if num_features is not None and max_col >= num_features:
@@ -160,15 +165,20 @@ class LibSVMIter(DataIter):
         pad = 0
         if end > self._hi:
             pad = end - self._hi
-            if self._round:
-                # wrap WITHIN this shard (reference round_batch); modulo
-                # keeps the wrap in-shard even when batch_size exceeds
-                # the shard and never leaks another part's examples
-                ids = np.concatenate(
-                    [ids,
-                     self._lo + (np.arange(pad) % self.num_examples)])
-            elif len(ids) == 0:
+            if len(ids) == 0:
                 raise StopIteration
+            # ALWAYS emit a full batch_size batch (the DataBatch pad
+            # contract: consumers slice off the last `pad` rows, and
+            # Module binds to the advertised (batch_size, D) shape).
+            # round_batch wraps the filler to the shard's front (the
+            # reference's epoch-wrapping semantics); otherwise the
+            # filler repeats in-shard rows — either way the filler is
+            # modulo-clamped so it can never leave this shard.
+            fill_base = self._lo if self._round else ids[0]
+            ids = np.concatenate(
+                [ids,
+                 self._lo + ((fill_base - self._lo + np.arange(pad))
+                             % self.num_examples)])
         self._cursor = end
         data, label = self._rows(ids)
         return DataBatch(data=[data], label=[label], pad=pad,

@@ -307,6 +307,9 @@ def multiply(lhs, rhs):
 
     if isinstance(lhs, numbers.Number):
         lhs, rhs = rhs, lhs
+    elif not isinstance(lhs, BaseSparseNDArray) and \
+            isinstance(rhs, BaseSparseNDArray):
+        lhs, rhs = rhs, lhs  # commutative: sparse operand drives
     if isinstance(rhs, numbers.Number):
         if isinstance(lhs, RowSparseNDArray):
             return RowSparseNDArray(

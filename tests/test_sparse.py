@@ -420,3 +420,46 @@ def test_libsvm_round_batch_exceeding_shard(tmp_path):
     expect = dense[np.arange(8) % 3]
     assert_almost_equal(got, expect)
     assert batch.pad == 5
+
+
+def test_libsvm_no_round_batch_pads_to_full(tmp_path):
+    """round_batch=False still emits FULL batch_size batches (the
+    DataBatch pad contract: consumers slice off the last `pad` rows)."""
+    from mxnet_tpu.io import LibSVMIter
+
+    dense = np.diag([1.0, 2.0, 3.0, 4.0, 5.0]).astype(np.float32)
+    p = str(tmp_path / "five.libsvm")
+    _write_libsvm(p, dense, np.arange(5.0))
+    it = LibSVMIter(data_libsvm=p, data_shape=(5,), batch_size=4,
+                    round_batch=False)
+    batches = list(it)
+    assert len(batches) == 2
+    last = batches[-1]
+    assert last.data[0].shape == (4, 5)  # full advertised shape
+    assert last.pad == 3
+    got = last.data[0].tostype("default").asnumpy()
+    assert_almost_equal(got[0], dense[4])  # the one real example
+
+
+def test_libsvm_rejects_negative_and_bad_value(tmp_path):
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.io import LibSVMIter
+
+    p = str(tmp_path / "neg.libsvm")
+    with open(p, "w") as f:
+        f.write("1 -1:7.0\n")
+    with pytest.raises(MXNetError, match="ZERO-based"):
+        LibSVMIter(data_libsvm=p, data_shape=(4,), batch_size=1)
+    with open(p, "w") as f:
+        f.write("1 2:abc\n")
+    with pytest.raises(MXNetError, match="bad token"):
+        LibSVMIter(data_libsvm=p, data_shape=(4,), batch_size=1)
+
+
+def test_multiply_commutes_dense_sparse():
+    c = _rand_csr((5, 7), 0.3, 0)
+    d = nd.array(_rand_dense((5, 7), 1.0, 1) + 1.0)
+    out = sparse.multiply(d, c)  # dense on the LEFT
+    assert out.stype == "csr"
+    assert_almost_equal(out.tostype("default").asnumpy(),
+                        c.tostype("default").asnumpy() * d.asnumpy())
