@@ -116,7 +116,7 @@ class HostPipeline:
     """
 
     def __init__(self, stage_fns, stage_params, loss_fn, devices=None,
-                 shared_params=()):
+                 shared_params=(), param_rule=None, data_axis="data"):
         if len(stage_fns) != len(stage_params):
             raise MXNetError("one params pytree per stage required")
         self.n_stages = len(stage_fns)
@@ -130,9 +130,17 @@ class HostPipeline:
         if len(devices) < self.n_stages:
             raise MXNetError("need >= n_stages devices")
         self.devices = list(devices[: self.n_stages])
+        # 3D parallelism: an entry in ``devices`` may be a
+        # ``jax.sharding.Mesh`` instead of a single device — that stage
+        # then runs dp×tp-sharded via GSPMD (params placed by
+        # ``param_rule(name=None, shape)``→PartitionSpec, activations
+        # batch-sharded over ``data_axis``), while the host schedule
+        # still pipelines stages: pp across meshes, dp×tp within each.
+        self._param_rule = param_rule
+        self._data_axis = data_axis
         self.params = [
             jax.tree_util.tree_map(
-                lambda a, d=dev: jax.device_put(jnp.asarray(a), d), p)
+                lambda a, d=dev: self._put_param(jnp.asarray(a), d), p)
             for p, dev in zip(stage_params, self.devices)]
         self._fwd = [jax.jit(f) for f in stage_fns]
 
@@ -153,20 +161,45 @@ class HostPipeline:
 
         self._last_grad = jax.jit(_last_grad)
 
+    # -- placement helpers (single device OR dp×tp mesh per stage) --------
+    def _put_param(self, arr, dev):
+        from jax.sharding import Mesh, NamedSharding
+
+        if isinstance(dev, Mesh):
+            spec = self._param_rule(None, arr.shape) \
+                if self._param_rule else None
+            return jax.device_put(
+                arr, NamedSharding(dev, spec if spec is not None else P()))
+        return jax.device_put(arr, dev)
+
+    def _put_act(self, arr, stage):
+        from jax.sharding import Mesh, NamedSharding
+
+        dev = self.devices[stage]
+        if isinstance(dev, Mesh):
+            # batch-shard activations over the stage's data axis when it
+            # exists and divides the batch; replicate otherwise
+            spec = P()
+            if self._data_axis in dev.shape and arr.ndim >= 1 and \
+                    arr.shape[0] % dev.shape[self._data_axis] == 0:
+                spec = P(self._data_axis)
+            return jax.device_put(arr, NamedSharding(dev, spec))
+        return jax.device_put(arr, dev)
+
     def forward_backward(self, x_microbatches, y_microbatches):
         """Returns (mean loss over microbatches, per-stage grads)."""
-        n, devs = self.n_stages, self.devices
+        n = self.n_stages
         m = len(x_microbatches)
         acts = [[None] * m for _ in range(n)]  # stage input per mb
         for j, x in enumerate(x_microbatches):
-            acts[0][j] = jax.device_put(jnp.asarray(x), devs[0])
+            acts[0][j] = self._put_act(jnp.asarray(x), 0)
             for s in range(n - 1):
                 out = self._fwd[s](self.params[s], acts[s][j])
-                acts[s + 1][j] = jax.device_put(out, devs[s + 1])
+                acts[s + 1][j] = self._put_act(out, s + 1)
         grads = [None] * n
         losses = []
         for j in range(m):
-            y = jax.device_put(jnp.asarray(y_microbatches[j]), devs[-1])
+            y = self._put_act(jnp.asarray(y_microbatches[j]), n - 1)
             loss, gp, ga = self._last_grad(self.params[-1],
                                            acts[-1][j], y)
             losses.append(loss)
@@ -174,7 +207,7 @@ class HostPipeline:
                 jnp.add, grads[-1], gp)
             g = ga
             for s in range(n - 2, -1, -1):
-                g = jax.device_put(g, devs[s])
+                g = self._put_act(g, s)
                 gp, ga = self._bwd[s](self.params[s], acts[s][j], g)
                 grads[s] = gp if grads[s] is None else \
                     jax.tree_util.tree_map(jnp.add, grads[s], gp)
@@ -190,10 +223,11 @@ class HostPipeline:
         for group in self.shared_params:
             total = None
             for (s, i) in group:
-                g = jax.device_put(grads[s][i], self.devices[group[0][0]])
+                g = self._put_param(grads[s][i],
+                                    self.devices[group[0][0]])
                 total = g if total is None else total + g
             for (s, i) in group:
-                grads[s][i] = jax.device_put(total, self.devices[s])
+                grads[s][i] = self._put_param(total, self.devices[s])
         return grads
 
     def sgd_step(self, x_microbatches, y_microbatches, lr=0.1):
