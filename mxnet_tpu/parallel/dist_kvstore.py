@@ -74,6 +74,7 @@ CMD_BARRIER = 5
 CMD_SET_OPTIMIZER = 6
 CMD_STOP = 7
 CMD_HELLO = 8
+CMD_PROFILER = 9
 CMD_ERR = 255
 
 _MAX_FRAME = 1 << 34  # 16 GiB sanity ceiling per tensor/string
@@ -435,6 +436,18 @@ class DistServer:
             acc = acc + p.data()
         return NDArray(acc)
 
+    @staticmethod
+    def _prof_now():
+        from .. import profiler as _prof
+
+        return _prof._now_us()
+
+    @staticmethod
+    def _prof_span(name, t0):
+        from .. import profiler as _prof
+
+        _prof.add_span(name, t0, _prof._now_us(), cat="kvstore")
+
     def _handle(self, sock):
         authed = not _secret()
         # unauthenticated peers get a short deadline (can't park a server
@@ -463,15 +476,19 @@ class DistServer:
                             st.value = NDArray(np.asarray(value))
                     _send(sock, CMD_OK)
                 elif cmd == CMD_PUSH:
+                    t0 = self._prof_now()
                     key = f[0]
                     self._do_push(key, self._decode(f[1], f[2:]))
                     _send(sock, CMD_OK)
+                    self._prof_span("KVStoreServer::push", t0)
                 elif cmd == CMD_PULL:
+                    t0 = self._prof_now()
                     (key,) = f
                     st = self._key(key)
                     with st.lock:
                         val = st.value.asnumpy()
                     _send(sock, CMD_OK, val)
+                    self._prof_span("KVStoreServer::pull", t0)
                 elif cmd == CMD_ROW_SPARSE_PULL:
                     key, row_ids = f
                     st = self._key(key)
@@ -487,6 +504,39 @@ class DistServer:
                     self._optimizer = _optimizer_from_config(f[0])
                     self._updater = opt_mod.get_updater(self._optimizer)
                     _send(sock, CMD_OK)
+                elif cmd == CMD_PROFILER:
+                    # remote profiling (parity: the reference's
+                    # kSetProfilerParams server command,
+                    # include/mxnet/kvstore.h:49 +
+                    # tests/nightly/test_server_profiling.py)
+                    from .. import profiler as _prof
+
+                    cfg = f[0]
+                    action = cfg.get("action")
+                    try:
+                        if action == "set_state":
+                            _prof.set_state(cfg.get("state", "stop"))
+                            _send(sock, CMD_OK, "")
+                        elif action == "set_config":
+                            _prof.set_config(**cfg.get("config", {}))
+                            _send(sock, CMD_OK, "")
+                        elif action == "dump":
+                            _prof.dump(finished=bool(cfg.get("finished",
+                                                             True)))
+                            _send(sock, CMD_OK, "")
+                        elif action == "dumps":
+                            _send(sock, CMD_OK,
+                                  _prof.dumps(
+                                      reset=bool(cfg.get("reset"))))
+                        else:
+                            _send(sock, CMD_ERR,
+                                  "unknown profiler action %r" % (action,))
+                    except Exception as pe:  # noqa: BLE001
+                        # a bad config key / unwritable dump path must
+                        # NOT kill the connection training runs on —
+                        # report it and keep serving
+                        _send(sock, CMD_ERR,
+                              "profiler %s failed: %s" % (action, pe))
                 elif cmd == CMD_STOP:
                     _send(sock, CMD_OK)
                     self._stop.set()
@@ -636,6 +686,41 @@ class DistKVStore(KVStoreBase):
         if rcmd != CMD_OK:
             raise MXNetError("kvstore rpc failed: %r" % (rfields,))
         return rfields[0] if rfields else None
+
+    # -- remote (server-side) profiling ------------------------------------
+    def _profiler_broadcast(self, cfg):
+        """Send one profiler command to EVERY server; returns replies in
+        server-id order (parity: kSetProfilerParams,
+        include/mxnet/kvstore.h:49)."""
+        outs = []
+        for sid in range(self._num_servers):
+            s = self._sock(sid)
+            with self._lock:
+                _send(s, CMD_PROFILER, cfg)
+                rcmd, rfields = _recv(s)
+            if rcmd != CMD_OK:
+                raise MXNetError("server profiler command failed: %r"
+                                 % (rfields,))
+            outs.append(rfields[0] if rfields else "")
+        return outs
+
+    def set_server_profiler_state(self, state):
+        """Start/stop the profiler inside every server process."""
+        self._profiler_broadcast({"action": "set_state", "state": state})
+
+    def set_server_profiler_config(self, **config):
+        self._profiler_broadcast({"action": "set_config",
+                                  "config": config})
+
+    def server_profiler_dump(self, finished=True):
+        """Every server writes its own chrome-trace file server-side."""
+        self._profiler_broadcast({"action": "dump", "finished": finished})
+
+    def server_profiler_dumps(self, reset=False):
+        """Fetch each server's aggregate per-op stats table (one string
+        per server)."""
+        return self._profiler_broadcast({"action": "dumps",
+                                         "reset": reset})
 
     # -- KVStore API -------------------------------------------------------
     @staticmethod

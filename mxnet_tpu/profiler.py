@@ -62,23 +62,44 @@ def profiler_set_config(mode="symbolic", filename="profile.json"):
 
 
 def _engine_hook(op_name, t_start, t_end):
+    add_span(op_name, (t_start - _t0) * 1e6, (t_end - _t0) * 1e6)
+
+
+def add_span(name, t_start_us, t_end_us, cat="operator", tid=None):
+    """Record one complete duration event; timestamps are ``_now_us()``
+    values (server request handlers and other non-engine
+    instrumentation report through this).  ``tid`` defaults to the
+    calling thread so concurrent handlers land on distinct trace
+    tracks instead of overlapping on one."""
     if not _state["running"] or _state["paused"]:
         return
+    if tid is None:
+        import threading
+
+        tid = threading.get_ident() & 0xFFFF
     with _lock:
         _events.append({
-            "name": op_name, "ph": "X", "cat": "operator",
-            "ts": (t_start - _t0) * 1e6,
-            "dur": (t_end - t_start) * 1e6,
-            "pid": 0, "tid": 0,
+            "name": name, "ph": "X", "cat": cat,
+            "ts": t_start_us, "dur": t_end_us - t_start_us,
+            "pid": 0, "tid": tid,
         })
 
 
 def set_state(state="stop", profile_process="worker"):
-    """Start ('run') or stop ('stop') profiling (parity: profiler.py:89)."""
+    """Start ('run') or stop ('stop') profiling (parity: profiler.py:89).
+
+    ``profile_process='server'`` routes the command over the dist
+    KVStore wire to every server (parity: the reference's
+    kSetProfilerParams server command, include/mxnet/kvstore.h:49) —
+    call ``set_kvstore_handle(kv)`` first.
+    """
     from .engine import Engine
 
     if state not in ("run", "stop"):
         raise ValueError("state must be 'run' or 'stop'")
+    if profile_process == "server":
+        _require_kv_handle().set_server_profiler_state(state)
+        return
     eng = Engine.get()
     if state == "run" and not _state["running"]:
         _state["running"] = True
@@ -124,7 +145,12 @@ def resume(profile_process="worker"):
 
 
 def dump(finished=True, profile_process="worker"):
-    """Write collected events as chrome://tracing JSON (parity: :122)."""
+    """Write collected events as chrome://tracing JSON (parity: :122).
+    ``profile_process='server'`` makes every dist server write ITS OWN
+    trace file server-side (reference server profiling contract)."""
+    if profile_process == "server":
+        _require_kv_handle().server_profiler_dump(finished=finished)
+        return
     if finished and _state["running"]:
         set_state("stop")
     with _lock:
@@ -141,8 +167,17 @@ def dump_profile():
     dump(finished=False)
 
 
-def dumps(reset=False, format="table", sort_by="total", ascending=False):
-    """Aggregate per-op summary (parity: :151, aggregate_stats.cc)."""
+def dumps(reset=False, format="table", sort_by="total", ascending=False,
+          aggregate=True):
+    """Aggregate per-op summary (parity: :151, aggregate_stats.cc —
+    count/total/avg/min/max per op name).  ``aggregate=False`` returns
+    the raw event list as JSON instead of the table."""
+    if not aggregate:
+        with _lock:
+            out = json.dumps(list(_events))
+            if reset:
+                _events.clear()
+        return out
     with _lock:
         stats = {}
         for e in _events:
@@ -288,8 +323,22 @@ class Marker:
         return self.name
 
 
-def set_kvstore_handle(handle):  # parity stub (server-side profiling)
-    pass
+_kv_handle = [None]
+
+
+def set_kvstore_handle(handle):
+    """Attach a dist KVStore so ``profile_process='server'`` commands
+    reach the servers (parity: profiler.py set_kvstore_handle)."""
+    _kv_handle[0] = handle
+
+
+def _require_kv_handle():
+    h = _kv_handle[0]
+    if h is None or not hasattr(h, "set_server_profiler_state"):
+        raise RuntimeError(
+            "profile_process='server' needs a dist kvstore: call "
+            "mx.profiler.set_kvstore_handle(kv) with a dist_* store first")
+    return h
 
 
 # parity: MXNET_PROFILER_AUTOSTART (env_var.md) — begin collecting as

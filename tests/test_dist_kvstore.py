@@ -459,3 +459,82 @@ def test_dist_sync_training_convergence(tmp_path):
     # sync replicas end identical (same updates applied everywhere)
     assert abs(outs[0]["wsum"] - outs[1]["wsum"]) < 1e-5
     assert abs(outs[0]["whash"] - outs[1]["whash"]) < 1e-5
+
+
+def test_server_side_profiling_in_thread():
+    """Remote-profiling command path (parity: kSetProfilerParams +
+    tests/nightly/test_server_profiling.py): start/stop the server
+    profiler over the typed wire, then fetch the server's aggregate
+    stats table and find the server-side request spans in it."""
+    from mxnet_tpu import profiler
+
+    servers, make_worker = _start_cluster(1, sync=True)
+    kv = make_worker(0)
+    profiler.set_kvstore_handle(kv)
+    try:
+        profiler.set_state("run", profile_process="server")
+        kv.init("pw", nd.zeros((4, 2)))
+        kv.push("pw", nd.array(np.ones((4, 2), np.float32)))
+        out = nd.zeros((4, 2))
+        kv.pull("pw", out=out)
+        profiler.set_state("stop", profile_process="server")
+        tables = kv.server_profiler_dumps()
+        assert len(tables) == 1
+        assert "KVStoreServer::push" in tables[0]
+        assert "KVStoreServer::pull" in tables[0]
+    finally:
+        # in-thread servers share this process's profiler globals:
+        # always stop it and drop collected events so later tests in
+        # the same pytest process see a clean profiler
+        profiler.set_kvstore_handle(None)
+        profiler.set_state("stop")
+        profiler.dumps(reset=True)
+        kv.stop()
+
+
+_PROFILING_WORKER = r"""
+import os
+import numpy as np
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import mxnet_tpu as mx
+from mxnet_tpu import nd, profiler
+
+kv = mx.kvstore.create("dist_sync")
+profiler.set_kvstore_handle(kv)
+profiler.set_state("run", profile_process="server")
+kv.init("w", nd.zeros((8, 4)))
+for _ in range(3):
+    kv.push("w", nd.array(np.ones((8, 4), np.float32)))
+    out = nd.zeros((8, 4))
+    kv.pull("w", out=out)
+profiler.set_state("stop", profile_process="server")
+tables = kv.server_profiler_dumps()
+assert "KVStoreServer::push" in tables[0], tables[0][:400]
+# server writes its own trace file (dump routed over the wire)
+kv.set_server_profiler_config(filename=os.environ["SERVER_TRACE"])
+profiler.dump(profile_process="server")
+kv.stop()
+"""
+
+
+def test_server_side_profiling_cross_process(tmp_path):
+    """True remote profiling: the server lives in ANOTHER process; the
+    worker drives its profiler over the wire and the server writes its
+    own chrome-trace file."""
+    import json
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools.launch import launch
+
+    trace = str(tmp_path / "server_profile.json")
+    rc = launch(1, 1, [sys.executable, "-c", _PROFILING_WORKER],
+                kv_store="dist_sync",
+                env_extra={"JAX_PLATFORMS": "cpu",
+                           "SERVER_TRACE": trace})
+    assert rc == 0
+    events = json.load(open(trace))["traceEvents"]
+    names = {e["name"] for e in events}
+    assert "KVStoreServer::push" in names
+    assert "KVStoreServer::pull" in names
