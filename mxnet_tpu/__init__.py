@@ -50,6 +50,38 @@ def _honor_platform_env():
 
 _honor_platform_env()
 
+
+def _honor_int64_tensor_size():
+    """``MXNET_INT64_TENSOR_SIZE=1`` enables 64-bit index VALUES
+    (parity: the reference's ``USE_INT64_TENSOR_SIZE`` compile flag,
+    tested by tests/nightly/test_large_array.py — here a runtime flag).
+
+    Array *shapes* are 64-bit regardless (XLA native); this flag lifts
+    jax's default int32 truncation so index arithmetic and integer
+    reductions past 2^31 are exact too.  Opt-in because it also widens
+    numpy-style default promotions, exactly like the reference flag
+    changes framework-wide index types.  See docs/large_tensor.md.
+    """
+    import os
+
+    if os.environ.get("MXNET_INT64_TENSOR_SIZE", "0") not in ("1", "true"):
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+    except Exception as e:
+        # an explicit opt-in to exact >2^31 index math must never fail
+        # silently — truncation would corrupt numerics downstream
+        import warnings
+
+        warnings.warn(
+            "MXNET_INT64_TENSOR_SIZE=1 requested but enabling jax x64 "
+            "failed (%s): index values past 2^31 will truncate" % (e,))
+
+
+_honor_int64_tensor_size()
+
 from .base import MXNetError  # noqa: F401
 from .context import (  # noqa: F401
     Context, cpu, cpu_pinned, gpu, tpu, num_gpus, num_tpus, current_context,

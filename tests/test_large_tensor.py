@@ -1,0 +1,71 @@
+"""Large-tensor (>2^31 elements / int64-index) smoke tests.
+
+Parity: ``tests/nightly/test_large_array.py`` behind the reference's
+``USE_INT64_TENSOR_SIZE`` compile flag — here the runtime flag
+``MXNET_INT64_TENSOR_SIZE=1`` (docs/large_tensor.md).  The big cases
+run in a SUBPROCESS so the flag applies from interpreter start and the
+~2 GiB allocation never lives in the test runner.  Gated: run only
+with ``MXNET_TEST_LARGE_TENSOR=1`` (the reference's nightly opt-in
+model).
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+BIG = 2**31 + 8  # just past the int32 element-count boundary
+
+_BIG_CASE = r"""
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+BIG = 2**31 + 8
+x = nd.ones((BIG,), dtype="int8")
+assert x.shape == (BIG,)
+total = int(x.data().astype("int64").sum())
+assert total == BIG, total  # int64 reduction: no int32 wrap
+x[BIG - 1] = 7              # index VALUE past 2^31
+assert int(x[BIG - 1].asnumpy()) == 7
+assert int(x[2**31 + 1].asnumpy()) == 1  # untouched element
+tail = x[2**31 - 2:2**31 + 2]           # slice spanning the boundary
+np.testing.assert_array_equal(tail.asnumpy(),
+                              np.array([1, 1, 1, 1], np.int8))
+print("LARGE_OK")
+"""
+
+
+@pytest.mark.skipif(
+    os.environ.get("MXNET_TEST_LARGE_TENSOR") != "1",
+    reason="opt-in: allocates >2GiB (set MXNET_TEST_LARGE_TENSOR=1)")
+def test_past_int32_boundary_with_int64_flag():
+    env = {**os.environ, "MXNET_INT64_TENSOR_SIZE": "1",
+           "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run([sys.executable, "-c", _BIG_CASE],
+                         capture_output=True, text=True, timeout=600,
+                         env=env, cwd=os.path.join(
+                             os.path.dirname(__file__), ".."))
+    assert out.returncode == 0, out.stderr[-800:]
+    assert "LARGE_OK" in out.stdout
+
+
+def test_int64_gather_indices():
+    """int64 index ARRAYS work without the flag (values < 2^31)."""
+    x = nd.arange(0, 16).reshape((16, 1))
+    idx = nd.array(np.array([0, 15], np.int64), dtype="int64")
+    out = nd.take(x, idx)
+    np.testing.assert_allclose(out.asnumpy().ravel(), [0.0, 15.0])
+
+
+def test_shape_past_int32_allocates_without_flag():
+    """Array SHAPES are 64-bit regardless of the flag (XLA native) —
+    cheap proof via eval_shape (no 2 GiB allocation here)."""
+    import jax
+
+    big = jax.eval_shape(lambda: jax.numpy.zeros((BIG,), "int8"))
+    assert big.shape == (BIG,)
