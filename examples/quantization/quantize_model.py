@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""INT8 quantization: calibrate a model-zoo net, compare fp32 vs int8.
+
+Parity with the reference's ``example/quantization`` (imagenet_gen_qsym
++ imagenet_inference: quantize a model-zoo CNN with naive/entropy
+calibration, then measure accuracy drop and speed).  Offline-friendly:
+a ResNet-18 (CIFAR geometry) on a synthetic 10-class dataset the model
+first fits briefly, so the accuracy comparison is meaningful.
+
+    python examples/quantization/quantize_model.py [--calib entropy]
+
+On TPU the quantized layers run int8×int8→int32 on the MXU
+(``ops/quantized_ops.py``); on CPU they exercise the identical graph.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+from examples import _device_setup  # noqa: E402
+
+_device_setup.ensure_devices(1)
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon, nd  # noqa: E402
+from mxnet_tpu.contrib import quantization as quant  # noqa: E402
+from mxnet_tpu.gluon.model_zoo import vision  # noqa: E402
+
+
+def make_data(n, rs):
+    """Linearly-separable-ish blobs rendered as 3x32x32 images."""
+    y = rs.randint(0, 10, n)
+    x = rs.randn(n, 3, 32, 32).astype(np.float32) * 0.5
+    for i in range(n):
+        c = y[i]
+        x[i, c % 3, (c * 3) % 28:(c * 3) % 28 + 4, :] += 2.0
+    return x, y.astype(np.float32)
+
+
+def accuracy(net, x, y, batch=64):
+    correct = 0
+    for i in range(0, len(x), batch):
+        out = net(nd.array(x[i:i + batch])).asnumpy()
+        correct += int((out.argmax(1) == y[i:i + batch]).sum())
+    return correct / len(x)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--calib", choices=["naive", "entropy"],
+                    default="naive")
+    ap.add_argument("--train-steps", type=int, default=60)
+    ap.add_argument("--n", type=int, default=512)
+    args = ap.parse_args()
+
+    rs = np.random.RandomState(0)
+    x, y = make_data(args.n, rs)
+    x_test, y_test = make_data(256, np.random.RandomState(1))
+
+    mx.random.seed(0)
+    net = vision.get_resnet(1, 18, thumbnail=True, classes=10)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()  # whole-graph executable: the fast path on any backend
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    bs = 64
+    print("fitting fp32 model (%d steps)..." % args.train_steps,
+          flush=True)
+    for step in range(args.train_steps):
+        i = (step * bs) % (args.n - bs)
+        xb, yb = nd.array(x[i:i + bs]), nd.array(y[i:i + bs])
+        with autograd.record():
+            loss = loss_fn(net(xb), yb).mean()
+        loss.backward()
+        trainer.step(bs)
+        if step % 20 == 0:
+            print("  step %d loss %.3f" % (step, float(loss.asnumpy())),
+                  flush=True)
+    fp32_acc = accuracy(net, x_test, y_test)
+
+    t0 = time.time()
+    out_fp32 = net(nd.array(x_test[:64])).asnumpy()
+    fp32_ms = (time.time() - t0) * 1000
+
+    print("calibrating (%s) + quantizing to int8..." % args.calib)
+    calib = [nd.array(x[i:i + bs]) for i in range(0, 256, bs)]
+    quant.quantize_net_v2(net, quantized_dtype="int8",
+                          calib_mode=args.calib, calib_data=calib)
+    n_q = sum(isinstance(b, (quant.QuantizedDense, quant.QuantizedConv2D))
+              for b in _walk(net))
+    int8_acc = accuracy(net, x_test, y_test)
+    t0 = time.time()
+    out_int8 = net(nd.array(x_test[:64])).asnumpy()
+    int8_ms = (time.time() - t0) * 1000
+
+    agree = float((out_fp32.argmax(1) == out_int8.argmax(1)).mean())
+    print("quantized layers : %d" % n_q)
+    print("fp32 accuracy    : %.3f  (%.0f ms/64-batch)"
+          % (fp32_acc, fp32_ms))
+    print("int8 accuracy    : %.3f  (%.0f ms/64-batch)"
+          % (int8_acc, int8_ms))
+    print("top-1 agreement  : %.3f" % agree)
+    assert n_q > 0, "nothing was quantized"
+    assert int8_acc >= fp32_acc - 0.05, \
+        "int8 accuracy dropped more than 5 points"
+
+
+def _walk(block):
+    out = []
+    stack = [block]
+    while stack:
+        b = stack.pop()
+        out.append(b)
+        stack.extend(b._children.values())
+    return out
+
+
+if __name__ == "__main__":
+    main()
