@@ -61,13 +61,14 @@ def _init_backend():
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     except Exception as e:
         _log("compilation cache unavailable: %s" % e)
-    # honor an explicit JAX_PLATFORMS override in this process too
+    # honor an explicit JAX_PLATFORMS override in this process too: the
+    # package's import-time guard applies the canonical rule (redirect
+    # unless the env list is a prefix of the config list — see
+    # mxnet_tpu.__init__._platform_override_needed; the round-4 OOM came
+    # from stripping a plugin's "<accel>,cpu" staging platform to bare
+    # "<accel>").  Importing the package does not initialize a backend.
     try:
-        from jax._src import xla_bridge as _xb
-
-        if os.environ.get("JAX_PLATFORMS") and \
-                not _xb.backends_are_initialized():
-            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        import mxnet_tpu  # noqa: F401 — import runs _honor_platform_env
     except Exception:
         pass
     last = None
@@ -85,9 +86,15 @@ def _init_backend():
             # config at interpreter startup, and config beats env)
             probe = subprocess.run(
                 [sys.executable, "-c",
+                 # mirrors _platform_override_needed (kept jax-only so
+                 # the probe stays fast under a dead tunnel)
                  "import os, jax\n"
-                 "p = os.environ.get('JAX_PLATFORMS')\n"
-                 "if p: jax.config.update('jax_platforms', p)\n"
+                 "p = os.environ.get('JAX_PLATFORMS') or ''\n"
+                 "c = str(getattr(jax.config, 'jax_platforms', '') or '')\n"
+                 "pl = [s.strip() for s in p.split(',') if s.strip()]\n"
+                 "cl = [s.strip() for s in c.split(',') if s.strip()]\n"
+                 "if pl and pl != cl[:len(pl)]:\n"
+                 "    jax.config.update('jax_platforms', p)\n"
                  "print(jax.devices()[0].platform)"],
                 capture_output=True, text=True, timeout=60)
             if probe.returncode == 0 and probe.stdout.strip():

@@ -19,6 +19,27 @@ from __future__ import annotations
 __version__ = "0.1.0"
 
 
+def _platform_override_needed(env_val, cfg_val):
+    """Should a ``JAX_PLATFORMS`` env value replace the config value?
+
+    Refuse ONLY the strip direction: when the env list is a (strict or
+    equal) prefix of the config list, the config is the same intent plus
+    extra fallback platforms a deployment plugin added — e.g. config
+    ``"axon,cpu"`` (accelerator + host-CPU staging platform) under env
+    ``"axon"``.  Clobbering that to the bare env value silently pushes
+    host-side buffers onto the chip (observed: ResNet-50 batch-256 OOM
+    on a 16G v5e with ``"axon"`` forced over ``"axon,cpu"``).  Every
+    other disagreement — different primary (the tunnel-outage case this
+    guard exists for: ``JAX_PLATFORMS=cpu`` subprocesses), or an env
+    that ADDS platforms over a bare config — is an explicit request and
+    must win.  Pure function; the probe snippets in bench.py and
+    __graft_entry__.py inline the same rule (keep them in sync).
+    """
+    env_list = [p.strip() for p in env_val.split(",") if p.strip()]
+    cfg_list = [p.strip() for p in cfg_val.split(",") if p.strip()]
+    return env_list != cfg_list[:len(env_list)]
+
+
 def _honor_platform_env():
     """Make a ``JAX_PLATFORMS`` environment override actually win.
 
@@ -43,6 +64,9 @@ def _honor_platform_env():
 
         if _xb.backends_are_initialized():
             return  # too late to redirect a live backend; leave it be
+        current = str(getattr(jax.config, "jax_platforms", "") or "")
+        if not _platform_override_needed(plat, current):
+            return
         jax.config.update("jax_platforms", plat)
     except Exception:
         pass  # never let platform plumbing break the import

@@ -1,0 +1,89 @@
+"""Regression tests for the JAX_PLATFORMS config-vs-env plumbing.
+
+Round-4 incident: ``_honor_platform_env`` (mxnet_tpu/__init__.py) pushed
+the ambient ``JAX_PLATFORMS=axon`` through the config API, clobbering
+the deployment plugin's ``jax_platforms="axon,cpu"`` down to bare
+``"axon"``.  That stripped the plugin's host-CPU staging platform and
+silently moved host-side buffers onto the chip — a batch-256 ResNet-50
+train step that fits in 16G HBM under ``"axon,cpu"`` OOMs under
+``"axon"``.  The guard must therefore redirect ONLY when the env names a
+different primary platform (the tunnel-outage case it exists for:
+``JAX_PLATFORMS=cpu`` subprocesses on an image whose config pins the
+accelerator).
+
+Each case runs in a subprocess because the config/backend state under
+test is process-global and the suite's conftest already pinned this
+process to CPU.
+"""
+import json
+import os
+import subprocess
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_child(pre_config, env_platforms):
+    """Set config to ``pre_config`` (as a deployment plugin would),
+    import mxnet_tpu with ``JAX_PLATFORMS=env_platforms``, and report
+    the resulting config value."""
+    code = (
+        "import jax, json\n"
+        "jax.config.update('jax_platforms', %r)\n"
+        "import mxnet_tpu\n"
+        "print(json.dumps({'cfg': str(jax.config.jax_platforms)}))\n"
+        % pre_config)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "PYTHONPATH")}
+    env["JAX_PLATFORMS"] = env_platforms
+    env["PYTHONPATH"] = _ROOT
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=120,
+                         env=env)
+    assert out.returncode == 0, out.stderr[-800:]
+    return json.loads(out.stdout.strip().splitlines()[-1])["cfg"]
+
+
+def test_same_primary_platform_preserves_plugin_config():
+    # env "cpu" vs plugin "cpu,foo": same primary — the plugin's extra
+    # platform survives (the round-4 OOM was this case with axon)
+    assert _run_child("cpu,foo", "cpu") == "cpu,foo"
+
+
+def test_different_primary_platform_redirects():
+    # env "cpu" vs config pinning some accelerator: the env must win —
+    # this is the hang fix (JAX_PLATFORMS=cpu probe/test subprocesses)
+    assert _run_child("notreal,cpu", "cpu") == "cpu"
+
+
+def test_env_superset_extends_bare_config():
+    # env ADDS platforms over a bare config: an operator exporting
+    # "cpu,foo" to restore a staging platform must not be ignored
+    assert _run_child("cpu", "cpu,foo") == "cpu,foo"
+
+
+def test_pure_rule():
+    from mxnet_tpu import _platform_override_needed as need
+
+    assert not need("axon", "axon,cpu")       # strip refused
+    assert not need("axon,cpu", "axon,cpu")   # equal: no-op
+    assert need("cpu", "axon,cpu")            # different primary
+    assert need("axon,cpu", "axon")           # env extends bare config
+    assert need("cpu", "")                    # unset config
+
+
+def test_no_env_leaves_config_alone():
+    code = (
+        "import jax, json\n"
+        "jax.config.update('jax_platforms', 'cpu,foo')\n"
+        "import mxnet_tpu\n"
+        "print(json.dumps({'cfg': str(jax.config.jax_platforms)}))\n")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "PYTHONPATH")}
+    env["PYTHONPATH"] = _ROOT
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=120,
+                         env=env)
+    assert out.returncode == 0, out.stderr[-800:]
+    assert json.loads(
+        out.stdout.strip().splitlines()[-1])["cfg"] == "cpu,foo"

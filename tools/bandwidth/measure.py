@@ -79,10 +79,14 @@ def main():
 
     import jax
 
-    if os.environ.get("JAX_PLATFORMS"):
-        # the image's sitecustomize imports jax before this env var is
-        # read; push the platform override through the config API too
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    try:
+        # the image's sitecustomize imports jax before JAX_PLATFORMS is
+        # read; the package's import-time guard pushes the override
+        # through the config API under the canonical rule
+        # (mxnet_tpu.__init__._platform_override_needed)
+        import mxnet_tpu  # noqa: F401
+    except Exception:
+        pass
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import Mesh, PartitionSpec as P

@@ -67,12 +67,10 @@ def check_backend():
         # honor a JAX_PLATFORMS env override even if the image pinned a
         # platform through the config API at interpreter startup
         try:
-            from jax._src import xla_bridge as _xb
-
-            if os.environ.get("JAX_PLATFORMS") and \
-                    not _xb.backends_are_initialized():
-                jax.config.update("jax_platforms",
-                                  os.environ["JAX_PLATFORMS"])
+            # the package's import-time guard applies the canonical
+            # rule (mxnet_tpu.__init__._platform_override_needed);
+            # importing does not initialize a backend
+            import mxnet_tpu  # noqa: F401
         except Exception:
             pass
 
