@@ -30,6 +30,12 @@ from .registry import register
 
 _EAGER_JIT_CACHE = {}
 
+# minor-dim width for per-row scalars (lse, delta): TPU Mosaic tiles
+# require the minor block dim to be a multiple of 128, so row scalars
+# ride lane-broadcast as (..., t, 128) exactly like jax's own TPU flash
+# kernels' l/m buffers
+_LANES = 128
+
 
 def _platform_pick(run, *args):
     """Compiled kernel ONLY on tpu; every other platform (cpu, and
@@ -116,9 +122,13 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q,
     m, l, acc = lax.fori_loop(0, n_k, body, (m0, l0, acc0))
     safe_l = jnp.where(l == 0, 1.0, l)
     o_ref[0] = (acc / safe_l).astype(o_ref.dtype)
-    # logsumexp per row; -inf rows (fully masked) stored as -inf
+    # logsumexp per row; -inf rows (fully masked) stored as -inf.  The
+    # row scalar is broadcast across a 128-lane minor dimension — TPU
+    # Mosaic requires block minor dims divisible by 128 (or full), so a
+    # bare (block_q,) output cannot tile; jax's own TPU flash kernels
+    # store l/m the same way (flash_attention.py MIN_BLOCK_SIZE).
     lse = jnp.where(l[:, 0] == 0, -jnp.inf, m[:, 0] + jnp.log(safe_l[:, 0]))
-    lse_ref[0] = lse
+    lse_ref[0] = lax.broadcast_in_dim(lse, (block_q, _LANES), (0,))
 
 
 def _flash_pallas(q, k, v, scale, causal, block_q, block_k,
@@ -140,11 +150,11 @@ def _flash_pallas(q, k, v, scale, causal, block_q, block_k,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, t_q), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t_q, _LANES), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -162,8 +172,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     q = q_ref[0].astype(jnp.float32)                    # (bq, D)
     do = do_ref[0].astype(jnp.float32)                  # (bq, D)
-    lse = lse_ref[0][:, None]                           # (bq, 1)
-    delta = delta_ref[0][:, None]                       # (bq, 1)
+    lse = lse_ref[0][:, :1]                             # (bq, 1) lane 0
+    delta = delta_ref[0][:, :1]                         # (bq, 1) lane 0
     t_kv = k_ref.shape[1]
     n_k = t_kv // block_k
     qi = pl.program_id(1)
@@ -217,8 +227,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             .astype(jnp.float32)
         do = do_ref[0, pl.dslice(i * block_q, block_q), :] \
             .astype(jnp.float32)
-        lse = lse_ref[0, pl.dslice(i * block_q, block_q)][:, None]
-        delta = delta_ref[0, pl.dslice(i * block_q, block_q)][:, None]
+        lse = lse_ref[0, pl.dslice(i * block_q, block_q), :1]
+        delta = delta_ref[0, pl.dslice(i * block_q, block_q), :1]
         s = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)         # (bq, bk)
@@ -260,8 +270,8 @@ def _flash_bwd_pallas(q, k, v, do, lse, delta, scale, causal, block_q,
             pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
@@ -276,8 +286,8 @@ def _flash_bwd_pallas(q, k, v, do, lse, delta, scale, causal, block_q,
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, t_q, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, t_q), lambda b, j: (b, 0)),
-            pl.BlockSpec((1, t_q), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, t_q, _LANES), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, t_q, _LANES), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
@@ -324,9 +334,11 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
 def _flash_bwd(scale, causal, block_q, block_k, res, g):
     q, k, v, out, lse = res
     # delta_i = sum_d dO_id * O_id  (rowwise), O(T*D) — the only
-    # off-kernel piece of the two-pass flash backward
+    # off-kernel piece of the two-pass flash backward.  Broadcast across
+    # the 128-lane minor dim to match the lse residual's tiled layout.
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANES))
     run = functools.partial(_flash_bwd_pallas, scale=scale, causal=causal,
                             block_q=block_q, block_k=block_k)
     dq, dk, dv = _platform_pick(run, q, k, v, g, lse, delta)
@@ -337,8 +349,13 @@ _flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
 def _tiles(t, preferred):
+    """Largest workable block: divides ``t`` AND satisfies the Mosaic
+    sublane rule (multiple of 8, or the full axis).  A user-preferred
+    block that divides t but breaks the sublane rule is skipped in
+    favor of the next conforming candidate rather than forcing the
+    O(T^2) reference fallback."""
     for b in (preferred, 128, 64, 32, 16, 8):
-        if b <= t and t % b == 0:
+        if b <= t and t % b == 0 and (b == t or b % 8 == 0):
             return b
     return None
 
