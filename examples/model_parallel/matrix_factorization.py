@@ -27,7 +27,6 @@ from examples import _device_setup  # noqa: E402
 _device_setup.ensure_devices(2)
 
 import mxnet_tpu as mx  # noqa: E402
-from mxnet_tpu import nd  # noqa: E402
 from mxnet_tpu import sym as S  # noqa: E402
 
 

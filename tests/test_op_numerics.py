@@ -1124,6 +1124,365 @@ SPECS["_image_resize"] = S(
     [pos((4, 4, 3), 930)], {"size": (2, 2)},
     check=lambda outs, ins: np.asarray(outs[0]).shape == (2, 2, 3))
 
+# ---------------------------------------------------------------------------
+# chip-sweep specs for the wave ops.  tests/test_op_waves.py holds the full
+# numerics oracles (single-vs-multi-tensor parity, STE gradients, int8
+# accuracy); these entries exist so tools/check_tpu_consistency.py runs every
+# wave op on real hardware and cross-checks TPU against the CPU backend.
+# Exact one-line oracles are inlined where they exist; otherwise ref=None
+# (finite-output check on CPU; full TPU-vs-CPU output parity either way).
+# ---------------------------------------------------------------------------
+
+# loss / legacy layers -------------------------------------------------------
+_WD = randn((2, 3), 40)
+_WL = randn((2, 3), 41)
+SPECS["LinearRegressionOutput"] = S([_WD, _WL], ref=lambda d, l: d)
+SPECS["MAERegressionOutput"] = S([_WD, _WL], ref=lambda d, l: d)
+SPECS["LogisticRegressionOutput"] = S(
+    [_WD, _WL], ref=lambda d, l: 1 / (1 + np.exp(-d)))
+SPECS["SVMOutput"] = S([_WD, np.array([0.0, 1.0], np.float32)],
+                       ref=lambda d, l: d)
+SPECS["MakeLoss"] = S([_WD], {"grad_scale": 3.0}, ref=lambda d: d)
+SPECS["IdentityAttachKLSparseReg"] = S(
+    [pos((4, 2), 42, 0.1, 0.9)],
+    {"sparseness_target": 0.1, "penalty": 0.001}, ref=lambda d: d)
+
+
+def _lrn_ref(x, alpha=1e-3, beta=0.75, knorm=2.0, nsize=5):
+    sq = x ** 2
+    c = x.shape[1]
+    padded = np.zeros((x.shape[0], c + nsize - 1) + x.shape[2:], np.float32)
+    padded[:, nsize // 2:nsize // 2 + c] = sq
+    win = sum(padded[:, i:i + c] for i in range(nsize))
+    return x * (knorm + (alpha / nsize) * win) ** -beta
+
+
+SPECS["LRN"] = S([pos((2, 7, 3, 3), 43)],
+                 {"alpha": 1e-3, "beta": 0.75, "knorm": 2.0, "nsize": 5},
+                 ref=_lrn_ref)
+SPECS["Crop"] = S([randn((1, 2, 4, 4), 44)],
+                  {"offset": (1, 1), "h_w": (2, 2)},
+                  ref=lambda x: x[:, :, 1:3, 1:3])
+SPECS["Correlation"] = S(
+    [np.full((1, 2, 5, 5), 2.0, np.float32),
+     np.full((1, 2, 5, 5), 2.0, np.float32)],
+    {"kernel_size": 1, "max_displacement": 1, "stride1": 1, "stride2": 1,
+     "pad_size": 1, "is_multiply": True},
+    check=lambda outs, ins: abs(np.asarray(outs[0])[0, 4, 2, 2] - 4.0) < 1e-5)
+_THETA_ID = np.array([[1, 0, 0, 0, 1, 0]], np.float32)
+SPECS["GridGenerator"] = S(
+    [_THETA_ID], {"transform_type": "affine", "target_shape": (2, 2)},
+    ref=lambda t: np.array([[[[-1., 1.], [-1., 1.]],
+                             [[-1., -1.], [1., 1.]]]], np.float32))
+SPECS["SpatialTransformer"] = S(
+    [np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4), _THETA_ID],
+    {"target_shape": (4, 4)},
+    ref=lambda img, t: img, rtol=1e-3, atol=1e-4)
+SPECS["_contrib_AdaptiveAvgPooling2D"] = S(
+    [np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)],
+    {"output_size": (2, 2)},
+    ref=lambda x: np.array([[[[2.5, 4.5], [10.5, 12.5]]]], np.float32))
+SPECS["_contrib_BilinearResize2D"] = S(
+    [np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)],
+    {"height": 2, "width": 2},
+    check=lambda outs, ins: float(np.asarray(outs[0])[0, 0, 0, 0]) == 0.0
+    and float(np.asarray(outs[0])[0, 0, 1, 1]) == 15.0)
+SPECS["_contrib_round_ste"] = S([randn((2, 3), 45)], ref=np.round)
+SPECS["_contrib_sign_ste"] = S([randn((2, 3), 46)], ref=np.sign)
+
+# ROI / detection ------------------------------------------------------------
+SPECS["ROIPooling"] = S(
+    [np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8),
+     np.array([[0, 0, 0, 3, 3]], np.float32)],
+    {"pooled_size": (2, 2), "spatial_scale": 1.0},
+    ref=lambda d, r: np.array([[[[9., 11.], [25., 27.]]]], np.float32))
+SPECS["_contrib_ROIAlign"] = S(
+    [np.full((1, 2, 6, 6), 7.0, np.float32),
+     np.array([[0, 1, 1, 4, 4]], np.float32)],
+    {"pooled_size": (2, 2), "spatial_scale": 1.0, "sample_ratio": 2},
+    ref=lambda d, r: np.full((1, 2, 2, 2), 7.0, np.float32),
+    rtol=1e-3, atol=1e-4)
+SPECS["_contrib_RROIAlign"] = S(
+    [np.full((1, 2, 8, 8), 3.0, np.float32),
+     np.array([[0, 4, 4, 4, 4, 0]], np.float32)],
+    {"pooled_size": (2, 2)},
+    ref=lambda d, r: np.full((1, 2, 2, 2), 3.0, np.float32),
+    rtol=1e-3, atol=1e-4)
+SPECS["_contrib_PSROIPooling"] = S(
+    [np.full((1, 8, 6, 6), 2.0, np.float32),
+     np.array([[0, 0, 0, 5, 5]], np.float32)],
+    {"spatial_scale": 1.0, "output_dim": 2, "pooled_size": 2,
+     "group_size": 2},
+    ref=lambda d, r: np.full((1, 2, 2, 2), 2.0, np.float32),
+    rtol=1e-3, atol=1e-4)
+SPECS["_contrib_DeformablePSROIPooling"] = S(
+    [np.full((1, 8, 6, 6), 2.0, np.float32),
+     np.array([[0, 0, 0, 5, 5]], np.float32)],
+    {"spatial_scale": 1.0, "output_dim": 2, "group_size": 2,
+     "pooled_size": 2, "no_trans": True},
+    ref=lambda d, r: (np.full((1, 2, 2, 2), 2.0, np.float32),),
+    rtol=1e-3, atol=1e-4)
+# constant data + constant weights + zero offsets: every interior output
+# element is C*kh*kw*1 = 18 (no padding, so no edge effects)
+SPECS["_contrib_DeformableConvolution"] = S(
+    [np.ones((1, 2, 5, 5), np.float32),
+     np.zeros((1, 18, 3, 3), np.float32),
+     np.ones((2, 2, 3, 3), np.float32)],
+    {"kernel": (3, 3), "num_filter": 2, "no_bias": True},
+    ref=lambda d, o, w: np.full((1, 2, 3, 3), 18.0, np.float32),
+    rtol=1e-3, atol=1e-3)
+SPECS["_contrib_MultiBoxPrior"] = S(
+    [np.zeros((1, 3, 2, 2), np.float32)], {"sizes": [0.5], "ratios": [1.0]},
+    check=lambda outs, ins: np.allclose(
+        np.asarray(outs[0])[0, 0], [0.0, 0.0, 0.5, 0.5], atol=1e-6))
+_MB_ANCH = np.array([[[0.0, 0.0, 0.5, 0.5], [0.5, 0.5, 1.0, 1.0]]],
+                    np.float32)
+SPECS["_contrib_MultiBoxTarget"] = S(
+    [_MB_ANCH, np.array([[[0, 0.05, 0.05, 0.45, 0.45]]], np.float32),
+     np.zeros((1, 2, 2), np.float32)],
+    check=lambda outs, ins: np.array_equal(np.asarray(outs[2]), [[1.0, 0.0]]))
+SPECS["_contrib_MultiBoxDetection"] = S(
+    [np.array([[[0.1, 0.9], [0.9, 0.1]]], np.float32).transpose(0, 2, 1),
+     np.zeros((1, 8), np.float32), _MB_ANCH],
+    check=lambda outs, ins: np.allclose(
+        np.asarray(outs[0])[0, 0], [0., 0.9, 0., 0., 0.5, 0.5], atol=1e-5))
+_PROP_KW = {"rpn_pre_nms_top_n": 12, "rpn_post_nms_top_n": 5,
+            "scales": (8,), "ratios": (0.5, 1, 2)}
+_PROP_IN = [randn((2, 6, 4, 4), 47) * 0.1 + 0.5,
+            np.zeros((2, 12, 4, 4), np.float32),
+            np.array([[64, 64, 1.0], [64, 64, 1.0]], np.float32)]
+SPECS["_contrib_Proposal"] = S(
+    _PROP_IN, _PROP_KW,
+    check=lambda outs, ins: np.asarray(outs[0]).shape == (10, 5)
+    and np.asarray(outs[1]).shape == (10, 1))
+SPECS["_contrib_MultiProposal"] = S(
+    _PROP_IN, _PROP_KW,
+    check=lambda outs, ins: np.asarray(outs[0]).shape == (10, 5))
+SPECS["_contrib_bipartite_matching"] = S(
+    [np.array([[[0.9, 0.1], [0.8, 0.7]]], np.float32)],
+    check=lambda outs, ins: np.array_equal(np.asarray(outs[0]), [[0.0, 1.0]])
+    and np.array_equal(np.asarray(outs[1]), [[0.0, 1.0]]))
+SPECS["_contrib_box_decode"] = S(
+    [np.zeros((1, 1, 4), np.float32),
+     np.array([[[0.0, 0.0, 0.5, 0.5]]], np.float32)],
+    ref=lambda d, a: a)
+SPECS["_contrib_box_encode"] = S(
+    [np.array([[1.0]], np.float32), np.array([[0.0]], np.float32),
+     np.array([[[0.0, 0.0, 1.0, 1.0]]], np.float32),
+     np.array([[[0.0, 0.0, 1.0, 1.0]]], np.float32)],
+    ref=lambda s, m, a, r: (np.zeros((1, 1, 4), np.float32),
+                            np.ones((1, 1, 4), np.float32)))
+SPECS["_contrib_mrcnn_mask_target"] = S(
+    [_r(48).rand(2, 3, 4).astype(np.float32) * 10,
+     (_r(49).rand(2, 2, 16, 16) > 0.5).astype(np.float32),
+     np.zeros((2, 3), np.float32), np.ones((2, 3), np.float32)],
+    {"num_rois": 3, "num_classes": 4, "mask_size": (7, 7)},
+    check=lambda outs, ins: np.asarray(outs[0]).shape == (2, 3, 4, 7, 7)
+    and np.asarray(outs[1]).shape == (2, 3, 4, 7, 7))
+SPECS["_contrib_SyncBatchNorm"] = S(
+    [pos((4, 3, 2, 2), 50), np.ones(3, np.float32), np.zeros(3, np.float32),
+     np.zeros(3, np.float32), np.ones(3, np.float32)], {})
+
+# extended linalg ------------------------------------------------------------
+_SPD_G = _r(51).rand(3, 3).astype(np.float32)
+_SPD = _SPD_G @ _SPD_G.T + 3 * np.eye(3, dtype=np.float32)
+_SPD_L = np.linalg.cholesky(_SPD).astype(np.float32)
+SPECS["_linalg_potri"] = S(
+    [_SPD_L], ref=lambda L: np.linalg.inv(L @ L.T),
+    rtol=1e-3, atol=1e-3)
+SPECS["_linalg_slogdet"] = S(
+    [_SPD], ref=lambda A: np.linalg.slogdet(A), rtol=1e-3, atol=1e-4)
+SPECS["_linalg_extracttrian"] = S(
+    [_SPD], ref=lambda A: A[np.tril_indices(3)])
+SPECS["_linalg_maketrian"] = S(
+    [np.arange(1, 7, dtype=np.float32)],
+    ref=lambda v: np.array([[1., 0., 0.], [2., 3., 0.], [4., 5., 6.]],
+                           np.float32))
+SPECS["_linalg_trmm"] = S(
+    [_SPD_L, _SPD], ref=lambda L, B: np.tril(L) @ B, rtol=1e-3, atol=1e-4)
+# factorizations are unique only up to sign — verify by reconstruction
+SPECS["_linalg_syevd"] = S(
+    [_SPD],
+    check=lambda outs, ins: np.allclose(
+        np.asarray(outs[0]).T @ np.diag(np.asarray(outs[1]))
+        @ np.asarray(outs[0]), ins[0], atol=1e-3))
+SPECS["_linalg_gelqf"] = S(
+    [_r(52).rand(2, 4).astype(np.float32)],
+    check=lambda outs, ins: np.allclose(
+        np.asarray(outs[0]) @ np.asarray(outs[1]), ins[0], atol=1e-4))
+
+# mixed-precision / multi-tensor optimizer ops ------------------------------
+_OW = _r(53).rand(3, 2).astype(np.float32)
+_OG = _r(54).rand(3, 2).astype(np.float32)
+_OZ = np.zeros((3, 2), np.float32)
+SPECS["mp_sgd_update"] = S(
+    [_OW.astype(np.float16), _OG.astype(np.float16), _OW], {"lr": 0.1},
+    ref=lambda w, g, w32: (
+        (w32 - 0.1 * g.astype(np.float32)).astype(np.float16),
+        w32 - 0.1 * g.astype(np.float32)))
+SPECS["mp_sgd_mom_update"] = S(
+    [_OW.astype(np.float16), _OG.astype(np.float16), _OZ, _OW],
+    {"lr": 0.1, "momentum": 0.9})
+SPECS["mp_nag_mom_update"] = S(
+    [_OW.astype(np.float16), _OG.astype(np.float16), _OZ, _OW],
+    {"lr": 0.1, "momentum": 0.9})
+_ONE_S = np.array([1.0], np.float32)
+SPECS["_adamw_update"] = S(
+    [_OW, _OG, _OZ, _OZ, _ONE_S], {"lr": 0.01, "wd": 0.1})
+SPECS["_mp_adamw_update"] = S(
+    [_OW, _OG, _OZ, _OZ, _OW, _ONE_S], {"lr": 0.01, "wd": 0.1})
+SPECS["ftml_update"] = S(
+    [_OW, _OG, _OZ, _OZ, _OZ], {"lr": 0.1, "t": 1})
+SPECS["_sparse_adagrad_update"] = S(
+    [np.ones((3, 2), np.float32), np.full((3, 2), 2.0, np.float32),
+     np.zeros((3, 2), np.float32)], {"lr": 0.1, "epsilon": 0.0},
+    ref=lambda w, g, h: (np.full((3, 2), 0.9, np.float32),
+                         np.full((3, 2), 4.0, np.float32)))
+SPECS["_contrib_group_adagrad_update"] = S(
+    [np.ones((3, 2), np.float32), np.full((3, 2), 2.0, np.float32),
+     np.zeros((3,), np.float32)], {"lr": 0.1, "epsilon": 0.0},
+    check=lambda outs, ins: np.allclose(np.asarray(outs[1]),
+                                        np.full((3,), 4.0), atol=1e-6))
+_MULTI2 = [_OW, _OG, _OW + 1, _OG + 1]
+SPECS["multi_sgd_update"] = S(
+    _MULTI2, {"lrs": [0.1, 0.2], "wds": [0.0, 0.01], "num_weights": 2},
+    ref=lambda w0, g0, w1, g1: (w0 - 0.1 * g0,
+                                w1 - 0.2 * (g1 + 0.01 * w1)))
+SPECS["multi_sgd_mom_update"] = S(
+    [_OW, _OG, _OZ, _OW + 1, _OG + 1, _OZ],
+    {"lrs": [0.1, 0.2], "wds": [0.0, 0.0], "momentum": 0.9,
+     "num_weights": 2})
+SPECS["multi_mp_sgd_update"] = S(
+    [_OW, _OG, _OW, _OW + 1, _OG + 1, _OW + 1],
+    {"lrs": [0.1, 0.2], "wds": [0.0, 0.0], "num_weights": 2})
+SPECS["multi_mp_sgd_mom_update"] = S(
+    [_OW, _OG, _OZ, _OW, _OW + 1, _OG + 1, _OZ, _OW + 1],
+    {"lrs": [0.1, 0.2], "wds": [0.0, 0.0], "momentum": 0.9,
+     "num_weights": 2})
+_LRS_T = np.array([0.1, 0.2], np.float32)
+_WDS_T = np.array([0.0, 0.01], np.float32)
+SPECS["preloaded_multi_sgd_update"] = S(
+    _MULTI2 + [_LRS_T, _WDS_T], {"num_weights": 2})
+SPECS["preloaded_multi_sgd_mom_update"] = S(
+    [_OW, _OG, _OZ, _OW + 1, _OG + 1, _OZ, _LRS_T, _WDS_T],
+    {"momentum": 0.9, "num_weights": 2})
+SPECS["preloaded_multi_mp_sgd_update"] = S(
+    [_OW, _OG, _OW, _OW + 1, _OG + 1, _OW + 1, _LRS_T, _WDS_T],
+    {"num_weights": 2})
+SPECS["preloaded_multi_mp_sgd_mom_update"] = S(
+    [_OW, _OG, _OZ, _OW, _OW + 1, _OG + 1, _OZ, _OW + 1, _LRS_T, _WDS_T],
+    {"momentum": 0.9, "num_weights": 2})
+SPECS["mp_lamb_update_phase1"] = S(
+    [_OW, _OG, _OZ, _OZ, _OW], {"t": 1, "wd": 0.01})
+SPECS["mp_lamb_update_phase2"] = S(
+    [_OW, _OG, np.array([1.0], np.float32), np.array([1.0], np.float32),
+     _OW], {"lr": 0.1})
+SPECS["_multi_lamb_update"] = S(
+    [_OW, _OG, _OZ, _OZ],
+    {"learning_rates": [0.1], "wds": [0.01], "step_count": [1],
+     "num_tensors": 1})
+SPECS["_multi_mp_lamb_update"] = S(
+    [_OW, _OG, _OZ, _OZ, _OW],
+    {"learning_rates": [0.1], "wds": [0.01], "step_count": [1],
+     "num_tensors": 1})
+SPECS["_multi_adamw_update"] = S(
+    [_OW, _OG, _OZ, _OZ, _ONE_S],
+    {"lrs": [0.01], "wds": [0.1], "etas": [1.0], "num_weights": 1})
+SPECS["_multi_mp_adamw_update"] = S(
+    [_OW, _OG, _OZ, _OZ, _OW, _ONE_S],
+    {"lrs": [0.01], "wds": [0.1], "etas": [1.0], "num_weights": 1})
+SPECS["multi_lars"] = S(
+    [np.array([0.1, 0.2], np.float32), np.array([4.0, 0.0], np.float32),
+     np.array([1.0, 1.0], np.float32), np.array([0.0, 0.0], np.float32)],
+    {"eta": 0.01, "eps": 0.0},
+    ref=lambda lrs, wn, gn, wds: np.array([0.1 * 0.01 * 2.0, 0.2],
+                                          np.float32))
+SPECS["all_finite"] = S(
+    [np.ones(4, np.float32)],
+    check=lambda outs, ins: float(np.asarray(outs[0])) == 1.0)
+SPECS["multi_all_finite"] = S(
+    [np.ones(3, np.float32), np.ones(2, np.float32)], {"num_arrays": 2},
+    check=lambda outs, ins: float(np.asarray(outs[0])) == 1.0)
+SPECS["reset_arrays"] = S(
+    [np.ones((2, 2), np.float32), np.ones(3, np.float32)],
+    {"num_arrays": 2},
+    check=lambda outs, ins: all(
+        float(np.abs(np.asarray(o)).max()) == 0.0 for o in outs))
+
+# quantized int8 family ------------------------------------------------------
+def _q8(x):
+    """Symmetric int8 quantization matching _contrib_quantize_v2."""
+    m = float(np.abs(x).max())
+    q = np.clip(np.round(x * (127.0 / m)), -127, 127).astype(np.int8)
+    return q, np.array(-m, np.float32), np.array(m, np.float32)
+
+
+_QX_F = _r(60).randn(4, 8).astype(np.float32)
+_QW_F = _r(61).randn(3, 8).astype(np.float32)
+_QB_F = _r(62).randn(3).astype(np.float32)
+_QX, _QXMIN, _QXMAX = _q8(_QX_F)
+_QW, _QWMIN, _QWMAX = _q8(_QW_F)
+_QB, _QBMIN, _QBMAX = _q8(_QB_F)
+SPECS["_contrib_quantize_v2"] = S(
+    [_QX_F],
+    check=lambda outs, ins: np.asarray(outs[0]).dtype == np.int8
+    and np.abs(np.asarray(outs[0]).astype(np.float32)
+               * float(np.asarray(outs[2])) / 127 - ins[0]).max() < 0.05)
+SPECS["_contrib_quantized_fully_connected"] = S(
+    [_QX, _QW, _QB, _QXMIN, _QXMAX, _QWMIN, _QWMAX, _QBMIN, _QBMAX],
+    {"num_hidden": 3},
+    check=lambda outs, ins: np.asarray(outs[0]).dtype == np.int32
+    and np.asarray(outs[0]).shape == (4, 3))
+_QIMG_F = _r(63).randn(1, 2, 6, 6).astype(np.float32)
+_QKRN_F = _r(64).randn(3, 2, 3, 3).astype(np.float32)
+_QIMG, _QIMIN, _QIMAX = _q8(_QIMG_F)
+_QKRN, _QKMIN, _QKMAX = _q8(_QKRN_F)
+SPECS["_contrib_quantized_conv"] = S(
+    [_QIMG, _QKRN, _QB, _QIMIN, _QIMAX, _QKMIN, _QKMAX, _QBMIN, _QBMAX],
+    {"kernel": (3, 3), "pad": (1, 1), "num_filter": 3},
+    check=lambda outs, ins: np.asarray(outs[0]).dtype == np.int32
+    and np.asarray(outs[0]).shape == (1, 3, 6, 6))
+SPECS["_contrib_quantized_pooling"] = S(
+    [_QIMG, _QIMIN, _QIMAX],
+    {"kernel": (2, 2), "stride": (2, 2), "pool_type": "max"},
+    check=lambda outs, ins: np.asarray(outs[0]).dtype == np.int8)
+SPECS["_contrib_quantized_act"] = S(
+    [_QX, _QXMIN, _QXMAX], {"act_type": "relu"},
+    check=lambda outs, ins: (np.asarray(outs[0]) >= 0).all())
+SPECS["_contrib_quantized_flatten"] = S(
+    [_QIMG, _QIMIN, _QIMAX],
+    check=lambda outs, ins: np.asarray(outs[0]).shape == (1, 72))
+SPECS["_contrib_quantized_elemwise_add"] = S(
+    [_QX[:3], _QW, _QXMIN, _QXMAX, _QWMIN, _QWMAX],
+    check=lambda outs, ins: np.asarray(outs[0]).shape == (3, 8))
+SPECS["_contrib_quantized_elemwise_mul"] = S(
+    [_QX[:3], _QW, _QXMIN, _QXMAX, _QWMIN, _QWMAX],
+    check=lambda outs, ins: np.asarray(outs[0]).dtype == np.int32)
+SPECS["_contrib_quantized_concat"] = S(
+    [_QX[:3], _QW, _QXMIN, _QWMIN, _QXMAX, _QWMAX],
+    {"num_args": 2, "dim": 1},
+    check=lambda outs, ins: np.asarray(outs[0]).shape == (3, 16))
+SPECS["_contrib_quantized_embedding"] = S(
+    [np.array([1, 3], np.float32), _r(65).randn(10, 4).astype(np.float32),
+     np.array(-1.0, np.float32), np.array(1.0, np.float32)],
+    {"input_dim": 10, "output_dim": 4},
+    check=lambda outs, ins: np.asarray(outs[0]).shape == (2, 4))
+_QBN_F = _r(66).randn(2, 3, 4, 4).astype(np.float32)
+_QBN, _QBNMIN, _QBNMAX = _q8(_QBN_F)
+SPECS["_contrib_quantized_batch_norm"] = S(
+    [_QBN, np.ones(3, np.float32), np.zeros(3, np.float32),
+     _QBN_F.mean((0, 2, 3)), _QBN_F.var((0, 2, 3)), _QBNMIN, _QBNMAX],
+    {"eps": 1e-5},
+    check=lambda outs, ins: np.asarray(outs[0]).dtype == np.int8)
+_QHIST, _QEDGES = np.histogram(_r(67).randn(20000), bins=255)
+SPECS["_contrib_requantize"] = S(
+    [(_QX.astype(np.int32) * 1000), np.array(-1000.0 * 127, np.float32),
+     np.array(1000.0 * 127, np.float32)],
+    check=lambda outs, ins: np.asarray(outs[0]).dtype == np.int8)
+SPECS["_contrib_calibrate_entropy"] = S(
+    [_QHIST.astype(np.float32), _QEDGES.astype(np.float32)],
+    check=lambda outs, ins: 0.5 < float(np.asarray(outs[1])) < 4.5)
+
 _WAVE_TESTED = {
     # loss layers / legacy vision (custom-vjp or sampling semantics)
     "LinearRegressionOutput", "MAERegressionOutput",

@@ -52,6 +52,19 @@ def main():
     import test_op_numerics as sweep  # the sweep's spec table is the input
     import mxnet_tpu as mx
     from mxnet_tpu import nd
+    from mxnet_tpu.ops import registry
+
+    # coverage gate: every canonical registry op must be swept here or
+    # carry a justified exclusion in the sweep module — new ops cannot
+    # silently dodge the hardware check (round-3 lesson: the op registry
+    # outgrew the sweep without anything noticing)
+    canonical = set(registry._REGISTRY)
+    justified = set(sweep.EXCLUDED) | set(sweep._WAVE_EXCLUDED)
+    uncovered = sorted(canonical - set(sweep.SPECS) - justified)
+    if uncovered:
+        print("registry ops with neither a sweep spec nor a justified "
+              "exclusion: %s" % ", ".join(uncovered), file=sys.stderr)
+        return 3
 
     names = sorted(sweep.SPECS)
     if args.ops:
@@ -78,8 +91,8 @@ def main():
                 if name in _DECOMP:
                     # factorizations are unique only up to sign/rotation:
                     # compare the reconstruction, not the factors
-                    outs_t = [_reconstruct(name, outs_t)]
-                    outs_c = [_reconstruct(name, outs_c)]
+                    outs_t = [_DECOMP[name](outs_t)]
+                    outs_c = [_DECOMP[name](outs_c)]
                 for a, b in zip(outs_t, outs_c):
                     aa = np.asarray(a, np.float64)
                     bb = np.asarray(b, np.float64)
@@ -105,9 +118,12 @@ def main():
              len(results["skip"])), file=sys.stderr)
     line = json.dumps({
         "metric": "tpu_cpu_op_consistency",
+        "platform": dev.platform,
         "passed": len(results["pass"]),
         "failed": len(results["fail"]),
         "skipped_random": len(results["skip"]),
+        "registry_canonical": len(canonical),
+        "excluded_justified": len(justified),
         "failures": results["fail"][:20],
     })
     print(line)
@@ -117,14 +133,29 @@ def main():
     return 0 if not results["fail"] else 2
 
 
-_DECOMP = {"_npi_svd", "_linalg_svd"}
-
-
-def _reconstruct(name, outs):
+def _svd_rec(outs):
     import numpy as np
 
     u, sv, vt = (np.asarray(o, np.float64) for o in outs[:3])
     return u @ np.diag(sv) @ vt
+
+
+def _syevd_rec(outs):
+    import numpy as np
+
+    u, lam = (np.asarray(o, np.float64) for o in outs[:2])
+    return u.T @ np.diag(lam) @ u
+
+
+def _gelqf_rec(outs):
+    import numpy as np
+
+    l, q = (np.asarray(o, np.float64) for o in outs[:2])
+    return l @ q
+
+
+_DECOMP = {"_npi_svd": _svd_rec, "_linalg_svd": _svd_rec,
+           "_linalg_syevd": _syevd_rec, "_linalg_gelqf": _gelqf_rec}
 
 
 def _is_random(name):

@@ -3,8 +3,16 @@
 Hand-written minimal ResNet-50 v1 (bf16 activations, f32 BN stats, SGD
 momentum) with no framework plumbing — measures what XLA:TPU delivers on
 this chip for the same math, to separate framework overhead from compiler
-ceiling.  Usage: python tools/rn50_ceiling.py [batch] [variant]
-variant: bf16stats — BN batch stats computed in bf16 instead of f32.
+ceiling.  Usage: python tools/rn50_ceiling.py [batch] [variant...]
+variants:
+  bf16stats — BN batch stats computed in bf16 instead of f32.
+  s2d       — space-to-depth stem (the MLPerf TPU ResNet transform): the
+              7x7/s2 conv over 3 input channels packs terribly onto the
+              128x128 MXU (contraction dim 7*7*3=147 but channel dim 3);
+              pad the kernel to 8x8 and fold a 2x2 space-to-depth block
+              into channels, giving an equivalent 4x4/s1 conv over 12
+              channels on a 112x112 grid.  Same math (zero-padded taps),
+              MXU-friendly shape.
 """
 import functools
 import os
@@ -26,11 +34,33 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
 BF16_STATS = "bf16stats" in sys.argv
+S2D = "s2d" in sys.argv
 
 
 def conv(x, w, stride=1):
     return lax.conv_general_dilated(
         x, w, (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def stem_s2d(x, w7):
+    """7x7/s2 SAME stem conv, rewritten space-to-depth.
+
+    Equivalence: SAME for k=7,s=2,in=224 pads (2,3); padding the kernel
+    with one zero row/col (8x8) and the input to (2,4) keeps every tap
+    aligned.  An 8x8/s2 conv is then exactly a 4x4/s1 conv on the 2x2
+    space-to-depth transform of the input (block offset (di,dj) becomes
+    a channel), with the kernel regrouped the same way.
+    """
+    w8 = jnp.pad(w7, ((0, 1), (0, 1), (0, 0), (0, 0)))
+    xp = jnp.pad(x, ((0, 0), (2, 4), (2, 4), (0, 0)))
+    n, h, w_, c = xp.shape
+    xs = xp.reshape(n, h // 2, 2, w_ // 2, 2, c).transpose(
+        0, 1, 3, 2, 4, 5).reshape(n, h // 2, w_ // 2, 4 * c)
+    w4 = w8.reshape(4, 2, 4, 2, c, w7.shape[-1]).transpose(
+        0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * c, w7.shape[-1])
+    return lax.conv_general_dilated(
+        xs, w4, (1, 1), "VALID",
         dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
@@ -104,7 +134,7 @@ def init_params(key):
 
 
 def forward(P, x):
-    x = conv(x, P["stem_w"], 2)
+    x = stem_s2d(x, P["stem_w"]) if S2D else conv(x, P["stem_w"], 2)
     x = jax.nn.relu(bn_train(x, P["stem_g"], P["stem_b"]))
     x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1),
                           (1, 2, 2, 1), "SAME")
