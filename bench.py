@@ -345,7 +345,10 @@ def _run(platform):
 
     on_accel = platform not in ("cpu",)
     argv_batch = [a for a in sys.argv[1:] if a.isdigit()]
-    batch = int(argv_batch[0]) if argv_batch else (256 if on_accel else 8)
+    # batch 384 measured fastest on a 16G v5e (2360 img/s vs 2336 @256,
+    # 2337 @512 — bigger batches hit memory pressure, smaller ones
+    # underfill the MXU); override with `python bench.py <batch>`
+    batch = int(argv_batch[0]) if argv_batch else (384 if on_accel else 8)
     image = 224 if on_accel else 64
     n_steps = 10 if on_accel else 2
 

@@ -251,12 +251,35 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0
     bshape = [1] * data.ndim
     bshape[axis % data.ndim] = data.shape[axis % data.ndim]
     if _mode == "train" and not use_global_stats:
-        # keep jnp.var's centered variance — do NOT "optimize" to the
-        # one-pass E[x²]-E[x]² identity, which catastrophically cancels
-        # in f32 when |mean| >> std
-        x32 = data.astype(jnp.float32)
-        mean = jnp.mean(x32, axis=reduce_axes)
-        var = jnp.var(x32, axis=reduce_axes)
+        # One-pass statistics: sum and sum-of-squares are SIBLING
+        # reduces over one input, which XLA multi-output fusion computes
+        # in a single HBM pass — jnp.var's mean-then-centered-moments
+        # form is two dependent passes and re-reads the whole activation
+        # (measured: BN-stat reductions were ~34% of the ResNet-50 train
+        # step; this moves the chip ceiling ~6%).  The bare E[x²]-E[x]²
+        # identity catastrophically cancels when |mean| >> std, so shift
+        # by the RUNNING mean first: var = E[(x-c)²] - (E[x]-c)² is
+        # exact for any c, and with c tracking the true mean the
+        # subtracted term stays ~0 — exactly the failure mode removed.
+        c = lax.stop_gradient(moving_mean.astype(jnp.float32))
+        xc = data.astype(jnp.float32) - c.reshape(bshape)
+        d1 = jnp.mean(xc, axis=reduce_axes)
+        d2 = jnp.mean(xc * xc, axis=reduce_axes)
+        mean = c + d1
+        var_fast = jnp.maximum(d2 - d1 * d1, 0.0)
+        # The shift identity is exact in reals but cancels in f32 when
+        # the running mean is far from the batch mean (fresh network on
+        # un-normalized data: c=0, |mean| >> std): rel error of var is
+        # ~(d2/var)·2^-24.  Detect that regime per batch and fall back
+        # to the exact centered two-pass — the cond re-reads the
+        # activation ONLY when taken, so the steady-state cost stays
+        # one HBM pass (post-warmup c tracks the mean and d2≈var).
+        ill = jnp.any(d2 > 4096.0 * jnp.maximum(var_fast, 1e-30))
+        var = lax.cond(
+            ill,
+            lambda d: jnp.var(d.astype(jnp.float32), axis=reduce_axes),
+            lambda d: var_fast,
+            data)
         new_mm = moving_mean * momentum + mean.astype(moving_mean.dtype) * (1 - momentum)
         new_mv = moving_var * momentum + var.astype(moving_var.dtype) * (1 - momentum)
     else:

@@ -1399,10 +1399,10 @@ SPECS["multi_lars"] = S(
                                           np.float32))
 SPECS["all_finite"] = S(
     [np.ones(4, np.float32)],
-    check=lambda outs, ins: float(np.asarray(outs[0])) == 1.0)
+    check=lambda outs, ins: float(np.asarray(outs[0]).reshape(())) == 1.0)
 SPECS["multi_all_finite"] = S(
     [np.ones(3, np.float32), np.ones(2, np.float32)], {"num_arrays": 2},
-    check=lambda outs, ins: float(np.asarray(outs[0])) == 1.0)
+    check=lambda outs, ins: float(np.asarray(outs[0]).reshape(())) == 1.0)
 SPECS["reset_arrays"] = S(
     [np.ones((2, 2), np.float32), np.ones(3, np.float32)],
     {"num_arrays": 2},

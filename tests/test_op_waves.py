@@ -863,3 +863,45 @@ def test_calibrate_entropy_reasonable_threshold():
     # optimal int8 threshold for a standard normal is well inside the tails
     assert 0.5 < float(hi.asscalar()) < 4.5
     assert abs(float(lo.asscalar()) + float(hi.asscalar())) < 1e-5
+
+
+def test_batch_norm_train_stats_one_pass_and_fallback():
+    """Train-mode BN statistics: the one-pass shifted form must match
+    the exact centered two-pass in BOTH regimes — running mean near the
+    batch mean (fast path) and far from it (conditioned fallback, e.g.
+    a fresh network on un-normalized data where the bare E[x²]-E[x]²
+    identity catastrophically cancels)."""
+    from mxnet_tpu.ops import registry
+
+    gamma = np.ones(8, np.float32)
+    beta = np.zeros(8, np.float32)
+
+    def run(x, mm, mv):
+        out, nmm, nmv = registry.get("BatchNorm").forward(
+            *(nd.array(a).data() for a in (x, gamma, beta, mm, mv)),
+            fix_gamma=False, eps=1e-5, momentum=0.9, _mode="train")
+        return (np.asarray(out), np.asarray(nmm), np.asarray(nmv))
+
+    rs = np.random.RandomState(0)
+    # fast path: zero-mean data, zeroed running stats
+    x = rs.randn(16, 8, 4, 4).astype(np.float32)
+    out, nmm, nmv = run(x, np.zeros(8, np.float32), np.ones(8, np.float32))
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    ref = (x - mean.reshape(1, 8, 1, 1)) / np.sqrt(
+        var.reshape(1, 8, 1, 1) + 1e-5)
+    assert_almost_equal(out, ref, atol=2e-5)
+    assert_almost_equal(nmm, 0.1 * mean, atol=1e-6)
+    assert_almost_equal(nmv, 0.9 + 0.1 * var, atol=1e-5)
+    # fallback: |mean| >> std with running mean at 0 — variance must
+    # still come out at the 1e-4 scale, not be destroyed by f32
+    # cancellation (which would normalize to ~0 std or blow up)
+    xa = (rs.randn(64, 8, 4, 4) * 0.01 + 1000.0).astype(np.float32)
+    out_a, _, nmv_a = run(xa, np.zeros(8, np.float32),
+                          np.ones(8, np.float32))
+    var_ref = np.asarray(xa).var(axis=(0, 2, 3))
+    assert np.all(nmv_a - 0.9 < 0.1 * var_ref * 3 + 1e-6)
+    mean_ref = xa.mean(axis=(0, 2, 3))
+    ref_a = (xa - mean_ref.reshape(1, 8, 1, 1)) / np.sqrt(
+        var_ref.reshape(1, 8, 1, 1) + 1e-5)
+    assert_almost_equal(out_a, ref_a, atol=5e-2)

@@ -57,11 +57,13 @@ def main():
     # coverage gate: every canonical registry op must be swept here or
     # carry a justified exclusion in the sweep module — new ops cannot
     # silently dodge the hardware check (round-3 lesson: the op registry
-    # outgrew the sweep without anything noticing)
+    # outgrew the sweep without anything noticing).  Enforced only on
+    # FULL sweeps: a targeted `--ops foo` debugging run must keep
+    # working even while an unrelated coverage gap exists.
     canonical = set(registry._REGISTRY)
     justified = set(sweep.EXCLUDED) | set(sweep._WAVE_EXCLUDED)
     uncovered = sorted(canonical - set(sweep.SPECS) - justified)
-    if uncovered:
+    if uncovered and not args.ops:
         print("registry ops with neither a sweep spec nor a justified "
               "exclusion: %s" % ", ".join(uncovered), file=sys.stderr)
         return 3

@@ -106,7 +106,7 @@ FLAG_SETS = {
     "async-all": ("--xla_tpu_enable_latency_hiding_scheduler=true "
                   "--xla_enable_async_all_gather=true "
                   "--xla_enable_async_collective_permute=true"),
-    "no-rematerialization": "--xla_tpu_enable_aggressive_broadcast_priority_update=true",
+    "broadcast-priority": "--xla_tpu_enable_aggressive_broadcast_priority_update=true",
     "flash-fusion": "--xla_tpu_enable_flash_attention=true",
 }
 
@@ -137,7 +137,9 @@ def main():
 
     best_batch = max((r for r in results if "img_s" in r),
                      key=lambda r: r["img_s"], default=None)
-    fb = best_batch["batch"] if best_batch else batches[-1]
+    # no batch sweep ran (--flags-only) or all failed: use the measured
+    # sweet spot (384, docs/perf.md), not the largest/near-OOM batch
+    fb = best_batch["batch"] if best_batch else 384
     fl = None if not best_batch or best_batch["layout"] == "auto" \
         else best_batch["layout"]
     for name, flags in FLAG_SETS.items():

@@ -41,16 +41,19 @@ def main():
         print("== plane:", plane.name)
         for ln, d in sorted(by_line.items(), key=lambda kv: -kv[1]):
             print("  line %-28s total %.4fs" % (ln, d))
-        # pick the busiest line (usually XLA Ops) and print top ops
-        if not by_line:
-            continue
-        busiest = max(by_line, key=by_line.get)
-        print("-- top ops on line %r --" % busiest)
-        items = [(n, d, counts[(busiest, n)])
-                 for (ln, n), d in totals.items() if ln == busiest]
-        tot = sum(d for _, d, _ in items)
-        for n, d, c in sorted(items, key=lambda kv: -kv[1])[:topn]:
-            print("  %6.2f%% %9.4fs x%-5d %s" % (100 * d / tot, d, c, n[:110]))
+        # per-op tables for every op line (async copies overlap compute,
+        # so the busiest line by wall-sum is often NOT where step time
+        # goes — print both and let the reader compare)
+        for ln in sorted(by_line, key=by_line.get, reverse=True):
+            if ln in ("Steps", "XLA Modules"):
+                continue
+            print("-- top ops on line %r --" % ln)
+            items = [(n, d, counts[(ln2, n)])
+                     for (ln2, n), d in totals.items() if ln2 == ln]
+            tot = sum(d for _, d, _ in items) or 1.0
+            for n, d, c in sorted(items, key=lambda kv: -kv[1])[:topn]:
+                print("  %6.2f%% %9.4fs x%-5d %s"
+                      % (100 * d / tot, d, c, n[:110]))
 
 
 if __name__ == "__main__":
