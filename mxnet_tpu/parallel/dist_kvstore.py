@@ -96,6 +96,21 @@ def _wire_timeout():
     return t if t > 0 else None
 
 
+def _tune_socket(sock):
+    """Per-connection transport tuning: no Nagle (tiny control frames
+    must not wait behind tensor payloads) and multi-MB kernel buffers —
+    gradient pushes move tens of MB per frame, and the ~200 KiB Linux
+    defaults cap loopback/DCN throughput well below link speed
+    (measured: 8 MiB buffers took the loopback push+pull round trip
+    from ~0.6 to well over 1 GB/s)."""
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 8 << 20)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 8 << 20)
+    except OSError:
+        pass  # transport tuning is best-effort, never fatal
+
+
 def _recv_exact(sock, n):
     buf = bytearray(n)
     view = memoryview(buf)
@@ -612,6 +627,7 @@ class DistServer:
         while not self._stop.is_set():
             try:
                 conn, _ = srv.accept()
+                _tune_socket(conn)
             except socket.timeout:
                 continue
             t = threading.Thread(target=self._handle, args=(conn,),
@@ -669,7 +685,7 @@ class DistKVStore(KVStoreBase):
                 s = socket.create_connection(
                     (self._root, _server_port(self._root_port, server_id)),
                     timeout=60)
-                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                _tune_socket(s)
                 # every later read inherits the wire deadline: a wedged
                 # server raises a diagnosable MXNetError instead of
                 # blocking this worker forever
@@ -796,7 +812,10 @@ class DistKVStore(KVStoreBase):
             val = self._rpc(k, CMD_PULL, str(k))
             dsts = o if isinstance(o, (list, tuple)) else [o]
             for dst in dsts:
-                dst._set_data(np.asarray(val).astype(dst.dtype))
+                # copy=False: a dtype-matching pull (the common case)
+                # must not clone 10s-of-MB gradients a second time
+                dst._set_data(np.asarray(val).astype(dst.dtype,
+                                                     copy=False))
 
     def pushpull(self, key, value, out=None, priority=0):
         self.push(key, value, priority)
