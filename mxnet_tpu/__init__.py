@@ -140,6 +140,9 @@ from . import model  # noqa: F401
 from . import rnn  # noqa: F401
 from . import log  # noqa: F401
 from . import util  # noqa: F401
+from . import name  # noqa: F401
+from . import error  # noqa: F401
+from . import executor  # noqa: F401
 from . import callback  # noqa: F401
 from . import module  # noqa: F401
 from . import monitor  # noqa: F401

@@ -1,0 +1,12 @@
+"""``mx.executor`` parity module.
+
+The reference exposes ``Executor`` at ``python/mxnet/executor.py``; the
+TPU-native implementation lives with the symbol layer
+(``symbol/executor.py`` — bind/simple_bind produce executors whose
+forward/backward run as jitted XLA callables).  This module re-exports
+it so ``mx.executor.Executor`` and ``from mxnet.executor import
+Executor`` migrations keep working.
+"""
+from .symbol.executor import Executor  # noqa: F401
+
+__all__ = ["Executor"]

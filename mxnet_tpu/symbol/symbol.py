@@ -41,7 +41,6 @@ _AUX_OUTPUTS = {
 }
 
 _name_lock = threading.Lock()
-_name_counters = {}
 
 _attr_scope = threading.local()
 
@@ -79,12 +78,19 @@ class AttrScope:
         return False
 
 
-def _auto_name(hint):
+def _auto_name(hint, name=None):
+    """Resolve a symbol name through the active NameManager
+    (``mxnet_tpu.name`` — users install ``Prefix``/custom managers with
+    a ``with`` block, reference ``python/mxnet/name.py``).  When no
+    manager is installed, the fallback default manager is PROCESS-wide
+    (counters shared across threads under ``_name_lock``), so
+    auto-names stay unique when graphs built on different threads are
+    merged — scoped managers remain thread-local like the reference's."""
+    from mxnet_tpu.name import NameManager
+
     hint = hint.lstrip("_").lower()
     with _name_lock:
-        c = _name_counters.get(hint, 0)
-        _name_counters[hint] = c + 1
-    return "%s%d" % (hint, c)
+        return NameManager.current().get(name, hint)
 
 
 def _op_attrs(node, mode=None):
@@ -687,8 +693,9 @@ def make_symbol_op(op_name):
     def sym_op(*args, **kwargs):
         name = kwargs.pop("name", None)
         kwargs.pop("attr", None)
-        if name is None:
-            name = _auto_name(op_name)
+        # EVERY name routes through the manager (reference semantics:
+        # a Prefix scope prefixes user-supplied names too)
+        name = _auto_name(op_name, name)
         # split tensor inputs from attrs
         inputs = {}
         pos = list(args)

@@ -397,3 +397,77 @@ def test_array_ufunc_protocol_dispatch_new_wave():
     out = onp.hypot(a, b)
     onp.testing.assert_allclose(out.asnumpy(), onp.hypot(_A, _B),
                                 rtol=1e-5)
+
+
+def test_round4_surface_stragglers():
+    """Reference-surface stragglers from the multiarray.py grep-diff:
+    append/around/ravel/flips/stacks/splits/broadcast_arrays/vdot/ldexp/
+    delete/indices/resize/unravel_index/bitwise trio/shares_memory/
+    empty_like/genfromtxt/set_printoptions."""
+    import os
+    import tempfile
+
+    a = np.array([[1., 2.], [3., 4.]])
+    assert onp.allclose(np.append(a, [[5., 6.]], axis=0).asnumpy(),
+                        onp.append(a.asnumpy(), [[5, 6]], 0))
+    assert onp.allclose(np.around(np.array([1.26]), 1).asnumpy(), [1.3])
+    assert onp.allclose(np.ravel(a).asnumpy(), [1, 2, 3, 4])
+    assert onp.allclose(np.fliplr(a).asnumpy(), onp.fliplr(a.asnumpy()))
+    assert onp.allclose(np.flipud(a).asnumpy(), onp.flipud(a.asnumpy()))
+    assert onp.allclose(
+        np.column_stack((np.array([1., 2.]), np.array([3., 4.]))).asnumpy(),
+        [[1, 3], [2, 4]])
+    assert onp.allclose(
+        np.row_stack((np.array([1., 2.]), np.array([3., 4.]))).asnumpy(),
+        [[1, 2], [3, 4]])
+    h = np.hsplit(a, 2)
+    assert len(h) == 2 and onp.allclose(h[0].asnumpy(), [[1], [3]])
+    v = np.vsplit(a, 2)
+    assert onp.allclose(v[0].asnumpy(), [[1, 2]])
+    bs = np.broadcast_arrays(np.array([[1.], [2.]]), np.array([3., 4.]))
+    assert bs[0].shape == (2, 2) and bs[1].shape == (2, 2)
+    assert abs(float(np.vdot(np.array([1., 2.]),
+                             np.array([3., 4.])).asnumpy()) - 11) < 1e-6
+    assert onp.allclose(
+        np.ldexp(np.array([1.5]), np.array([2], dtype=np.int32)).asnumpy(),
+        [6.0])
+    assert onp.allclose(np.delete(np.array([1., 2., 3., 4.]), [1]).asnumpy(),
+                        [1, 3, 4])
+    assert np.indices((2, 2)).shape == (2, 2, 2)
+    assert onp.allclose(np.resize(np.array([1., 2.]), (5,)).asnumpy(),
+                        [1, 2, 1, 2, 1])
+    ui = np.unravel_index(np.array([5]), (2, 3))
+    assert int(ui[0].asnumpy()[0]) == 1 and int(ui[1].asnumpy()[0]) == 2
+    assert int(np.bitwise_or(np.array([4], dtype=np.int32),
+                             np.array([1], dtype=np.int32)).asnumpy()[0]) == 5
+    assert int(np.bitwise_xor(np.array([5], dtype=np.int32),
+                              np.array([1], dtype=np.int32)).asnumpy()[0]) == 4
+    assert int(np.invert(np.array([0], dtype=np.int32)).asnumpy()[0]) == -1
+    assert np.shares_memory(a, a)
+    assert not np.shares_memory(a, np.array([1.]))
+    assert np.may_share_memory(a, a)
+    assert np.empty_like(a).shape == (2, 2)
+    with tempfile.NamedTemporaryFile("w", suffix=".csv",
+                                     delete=False) as f:
+        f.write("1,2\n3,4\n")
+        path = f.name
+    try:
+        assert np.genfromtxt(path, delimiter=",").shape == (2, 2)
+    finally:
+        os.unlink(path)
+    saved = onp.get_printoptions()
+    try:
+        np.set_printoptions(precision=3)
+        assert onp.get_printoptions()["precision"] == 3
+    finally:
+        onp.set_printoptions(**saved)
+    # bitwise ops reject float operands (numpy semantics)
+    with pytest.raises(TypeError):
+        np.bitwise_or(np.array([1.5]), np.array([2.5]))
+    # delete with a boolean mask keeps mask semantics
+    dm = np.delete(np.array([1., 2., 3.]), onp.array([True, False, True]))
+    assert onp.allclose(dm.asnumpy(), [2.0])
+    # around honors out=
+    buf = np.zeros((1,))
+    ret = np.around(np.array([1.26]), 1, out=buf)
+    assert ret is buf and abs(float(buf.asnumpy()[0]) - 1.3) < 1e-6

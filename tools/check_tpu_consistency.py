@@ -34,6 +34,10 @@ def main():
                     help="comma-separated subset (default: every spec)")
     ap.add_argument("--tol", type=float, default=2e-2,
                     help="max |tpu - cpu| / max(1, |cpu|) allowed")
+    ap.add_argument("--grad", action="store_true",
+                    help="sweep BACKWARD instead: per-input vjp (ones "
+                         "cotangent) TPU vs CPU for every spec the "
+                         "numerics suite marks differentiable")
     ap.add_argument("--output", default="")
     args = ap.parse_args()
 
@@ -77,20 +81,29 @@ def main():
                   file=sys.stderr)
             return 1
         names = wanted
+    if args.grad:
+        # backward sweep: only specs the numerics suite marks
+        # differentiable (those carry FD-checked gradients on CPU; here
+        # the same vjp runs on both devices and must agree)
+        names = [n for n in names
+                 if any(s.grad for s in _as_list(sweep.SPECS[n]))]
     results = {"pass": [], "fail": [], "skip": []}
+    run_fn = _run_grad if args.grad else _run
     for name in names:
         if _is_random(name):
             results["skip"].append(name)
             continue
         spec = sweep.SPECS[name]
         specs = spec if isinstance(spec, list) else [spec]
+        if args.grad:
+            specs = [s for s in specs if s.grad]
         ok = True
         err = 0.0
         try:
             for s in specs:
-                outs_t = _run(name, s, mx, nd, dev)
-                outs_c = _run(name, s, mx, nd, cpu)
-                if name in _DECOMP:
+                outs_t = run_fn(name, s, mx, nd, dev)
+                outs_c = run_fn(name, s, mx, nd, cpu)
+                if name in _DECOMP and not args.grad:
                     # factorizations are unique only up to sign/rotation:
                     # compare the reconstruction, not the factors
                     outs_t = [_DECOMP[name](outs_t)]
@@ -119,7 +132,8 @@ def main():
           % (len(results["pass"]), len(results["fail"]),
              len(results["skip"])), file=sys.stderr)
     line = json.dumps({
-        "metric": "tpu_cpu_op_consistency",
+        "metric": "tpu_cpu_grad_consistency" if args.grad
+        else "tpu_cpu_op_consistency",
         "platform": dev.platform,
         "passed": len(results["pass"]),
         "failed": len(results["fail"]),
@@ -170,6 +184,43 @@ def _is_random(name):
         return bool(registry.get(name).needs_rng)
     except Exception:
         return False
+
+
+def _as_list(spec):
+    return spec if isinstance(spec, list) else [spec]
+
+
+def _run_grad(name, spec, mx, nd, device):
+    """Per-input gradients (sum-of-outputs loss) with inputs on
+    ``device`` — the hardware leg of the suite's FD gradient checks."""
+    import jax
+    from mxnet_tpu import autograd
+
+    mx.random.seed(7)
+    wanted = spec.grad_nodes
+    inputs = []
+    for i, x in enumerate(spec.inputs):
+        arr = nd.array(x)
+        arr._set_data(jax.device_put(arr.data(), device))
+        # only differentiate the nodes the spec's FD check does —
+        # e.g. Embedding indices are not a grad node
+        if wanted is None or ("v%d" % i) in wanted:
+            arr.attach_grad()
+        inputs.append(arr)
+    fn = getattr(mx.nd, name, None)
+    if fn is None:
+        from mxnet_tpu.ndarray.register import make_op_func
+
+        fn = make_op_func(name)
+    with autograd.record():
+        out = fn(*inputs, **spec.attrs)
+        outs = out if isinstance(out, list) else [out]
+        loss = outs[0].sum()
+        for o in outs[1:]:
+            loss = loss + o.sum()
+    loss.backward()
+    return [arr.grad.asnumpy() for arr in inputs
+            if arr.grad is not None]
 
 
 def _run(name, spec, mx, nd, device):
