@@ -303,3 +303,40 @@ class CosineEmbeddingLoss(Loss):
         eps_arr = 1e-12
         return xy / F.broadcast_maximum(
             x_norm * y_norm, eps_arr * F.ones_like(x_norm))
+
+
+class SDMLLoss(Loss):
+    """Smoothed Deep Metric Learning loss (parity: loss.SDMLLoss,
+    Bonadiman et al. 2019).  Two aligned minibatches of vectors — row i
+    of ``x1`` pairs with row i of ``x2``; every other row acts as an
+    in-batch negative.  The pairwise (squared-euclidean) distance matrix
+    is softmaxed into similarity probabilities and pulled toward a
+    label-smoothed identity matrix with KL divergence.
+    """
+
+    def __init__(self, smoothing_parameter=0.3, weight=1., batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self.kl_loss = KLDivLoss(from_logits=True)
+        self.smoothing_parameter = smoothing_parameter
+
+    @staticmethod
+    def _compute_distances(F, x1, x2):
+        b, d = x1.shape
+        x1_ = F.broadcast_to(F.expand_dims(x1, 1), (b, b, d))
+        x2_ = F.broadcast_to(F.expand_dims(x2, 0), (b, b, d))
+        return F.sum(F.square(x1_ - x2_), axis=2)
+
+    def _compute_labels(self, F, batch_size):
+        gold = F.one_hot(F.arange(batch_size), batch_size)
+        return gold * (1 - self.smoothing_parameter) \
+            + (1 - gold) * self.smoothing_parameter / (batch_size - 1)
+
+    def hybrid_forward(self, F, x1, x2):
+        batch_size = x1.shape[0]
+        labels = self._compute_labels(F, batch_size)
+        distances = self._compute_distances(F, x1, x2)
+        log_probabilities = F.log_softmax(-distances, axis=1)
+        # scale by batch_size: KLDivLoss averages over the label axis,
+        # the paper's formulation sums (reference does the same)
+        return self.kl_loss(log_probabilities, labels) * batch_size

@@ -320,6 +320,99 @@ class SGLD(Optimizer):
 
 
 @register
+class Adamax(Optimizer):
+    """AdaMax — Adam on the infinity norm (parity: optimizer.Adamax,
+    Kingma & Ba section 7)::
+
+        m = beta1*m + (1-beta1)*g
+        u = max(beta2*u, |g|)
+        w -= lr/(1-beta1^t) * m/u
+    """
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        w = weight.data() if isinstance(weight, NDArray) else weight
+        return (jnp.zeros(w.shape, w.dtype), jnp.zeros(w.shape, w.dtype))
+
+    def _step(self, weight, grad, state, lr, wd, t):
+        mean, u = state
+        t = t.astype(jnp.float32)
+        g = self._clip_rescale(grad) + wd * weight
+        mean = self.beta1 * mean + (1 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * u, jnp.abs(g))
+        lr_t = lr / (1.0 - self.beta1 ** t)
+        new_w = weight - lr_t * mean / jnp.maximum(u, 1e-30)
+        return new_w, (mean, u)
+
+
+@register
+class LBSGD(Optimizer):
+    """Large-Batch SGD: momentum SGD with a warmup learning-rate
+    multiplier and LARS layer-wise scaling (parity: optimizer.LBSGD).
+
+    The warmup multiplier ramps 1 → ``batch_scale`` over
+    ``warmup_epochs * updates_per_epoch`` updates with the chosen
+    ``warmup_strategy`` (``linear``/``power2``/``sqrt``); strategy
+    ``lars`` instead scales each layer's rate by
+    ``sqrt(||w||² / (||g||² + wd·||w||² + eps))`` clipped to
+    [0.01, 100] (the reference's ``_get_lars``).  Deviation (documented):
+    the reference can also EMULATE a large batch by cumulating
+    ``batch_scale`` micro-batch gradients host-side; here the TPU-native
+    route to a large batch is the sharded data-parallel train step, so
+    every update is treated as one macro-batch step.
+    """
+
+    def __init__(self, momentum=0.0, multi_precision=False,
+                 warmup_strategy="linear", warmup_epochs=5, batch_scale=1,
+                 updates_per_epoch=32, begin_epoch=0, num_epochs=60,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = float(batch_scale)
+        self.updates_per_epoch = updates_per_epoch
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        w = weight.data() if isinstance(weight, NDArray) else weight
+        return jnp.zeros(w.shape, w.dtype)
+
+    def _warmup_mult(self, t):
+        nwup = float(self.warmup_epochs * self.updates_per_epoch)
+        maxmult = self.batch_scale
+        if nwup <= 1 or maxmult <= 1 \
+                or self.warmup_strategy not in ("linear", "power2", "sqrt"):
+            return jnp.float32(1.0)
+        frac = jnp.minimum(t.astype(jnp.float32) / nwup, 1.0)
+        if self.warmup_strategy == "power2":
+            frac = frac * frac
+        elif self.warmup_strategy == "sqrt":
+            frac = jnp.sqrt(frac)
+        return 1.0 + (maxmult - 1.0) * frac
+
+    def _step(self, weight, grad, state, lr, wd, t):
+        g = self._clip_rescale(grad) + wd * weight
+        if self.warmup_strategy == "lars":
+            w2 = jnp.sum(jnp.square(weight).astype(jnp.float32))
+            g2 = jnp.sum(jnp.square(g).astype(jnp.float32))
+            lars = jnp.sqrt(w2 / (g2 + wd * w2 + 1e-18))
+            lr = lr * jnp.clip(lars, 0.01, 100.0)
+        else:
+            lr = lr * self._warmup_mult(t)
+        if self.momentum == 0.0 or state is None:
+            return weight - lr * g, state
+        mom = self.momentum * state - lr * g
+        return weight + mom, mom
+
+
+@register
 class Adam(Optimizer):
     """Adam with bias correction (parity: optimizer.Adam; op adam_update)."""
 

@@ -469,3 +469,49 @@ def test_contrib_data_interval_sampler_and_wikitext(tmp_path):
     assert y[-1] == x1[0]
     with pytest.raises(mx.MXNetError, match="no network access"):
         gc.data.WikiText103(root=str(tmp_path / "none"))
+
+
+def test_adamax_lbsgd_sdml():
+    """Round-4 stragglers from the reference surface diff: Adamax and
+    LBSGD optimizers (optimizer.py:1905,1058), SDMLLoss (loss.py:935)."""
+    from mxnet_tpu import autograd, nd
+
+    rs = np.random.RandomState(0)
+    # Adamax drives a quadratic to ~0 (infinity-norm Adam)
+    w = nd.array(np.array([5.0], np.float32))
+    w.attach_grad()
+    upd = mx.optimizer.get_updater(
+        mx.optimizer.create("adamax", learning_rate=0.5))
+    for _ in range(200):
+        with autograd.record():
+            loss = (w * w).sum()
+        loss.backward()
+        upd(0, w.grad, w)
+    assert abs(float(w.asscalar())) < 1e-3
+    # LBSGD: every warmup strategy (and lars) converges on the quadratic
+    for strat in ("linear", "power2", "sqrt", "lars"):
+        w2 = nd.array(np.array([2.0], np.float32))
+        w2.attach_grad()
+        u = mx.optimizer.get_updater(mx.optimizer.create(
+            "lbsgd", learning_rate=0.05, momentum=0.9,
+            warmup_strategy=strat, batch_scale=4, warmup_epochs=1,
+            updates_per_epoch=4))
+        for _ in range(60):
+            with autograd.record():
+                loss = (w2 * w2).sum()
+            loss.backward()
+            u(0, w2.grad, w2)
+        assert abs(float(w2.asscalar())) < 0.5, strat
+    # SDML: aligned pairs score lower than misaligned, and the loss is
+    # differentiable
+    x = rs.randn(8, 16).astype(np.float32)
+    sdml = gluon.loss.SDMLLoss()
+    x1 = nd.array(x)
+    x1.attach_grad()
+    with autograd.record():
+        aligned = sdml(x1, nd.array(
+            x + 0.01 * rs.randn(8, 16).astype(np.float32))).mean()
+    aligned.backward()
+    assert np.isfinite(x1.grad.asnumpy()).all()
+    shuffled = sdml(nd.array(x), nd.array(np.roll(x, 3, axis=0))).mean()
+    assert float(aligned.asscalar()) < float(shuffled.asscalar())
