@@ -5,3 +5,7 @@ from . import onnx  # noqa: F401
 from . import text  # noqa: F401
 from . import tensorboard  # noqa: F401
 from . import svrg_optimization  # noqa: F401
+from . import io  # noqa: F401
+from . import autograd  # noqa: F401
+from .io import DataLoaderIter  # noqa: F401
+from .autograd import TrainingStateScope  # noqa: F401

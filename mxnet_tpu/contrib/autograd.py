@@ -1,0 +1,74 @@
+"""Legacy contrib autograd API (parity: contrib/autograd.py).
+
+The reference kept a deprecated pre-1.0 autograd surface under
+``mx.contrib.autograd`` (``set_is_training``, ``TrainingStateScope``,
+``train_section``/``test_section``, ``compute_gradient``,
+``backward``).  They delegate to the modern tape here.
+"""
+from __future__ import annotations
+
+from .. import autograd as _ag
+
+
+def set_is_training(is_train):
+    """Flip recording+training mode; returns the previous record flag
+    (parity: contrib/autograd.py set_is_training — which set BOTH the
+    training and recording flags)."""
+    prev = _ag.is_recording()
+    _ag.set_recording(is_train)
+    _ag.set_training(is_train)
+    return prev
+
+
+def _get_state():
+    return (_ag.is_recording(), _ag.is_training())
+
+
+def _set_state(state):
+    _ag.set_recording(state[0])
+    _ag.set_training(state[1])
+
+
+class TrainingStateScope:
+    """``with TrainingStateScope(True): ...`` (parity:
+    contrib/autograd.py:54).  Saves and restores BOTH the recording and
+    training flags — ``set_is_training`` mutates both, so restoring
+    only on a recording-flag mismatch (as a naive port would) can leave
+    the training flag permanently flipped inside an outer
+    ``record(train_mode=False)`` scope."""
+
+    def __init__(self, enter_state):
+        self._enter_state = bool(enter_state)
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = _get_state()
+        set_is_training(self._enter_state)
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        _set_state(self._prev)
+        return False
+
+
+def train_section():
+    """Training scope for ``with`` (parity: train_section)."""
+    return TrainingStateScope(True)
+
+
+def test_section():
+    """Prediction scope for ``with`` (parity: test_section)."""
+    return TrainingStateScope(False)
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    """Legacy multi-output backward (parity: contrib backward)."""
+    _ag.backward(outputs, head_grads=out_grads,
+                 retain_graph=retain_graph)
+
+
+def compute_gradient(outputs):
+    """Deprecated — use ``backward`` (parity: contrib/autograd.py:158,
+    which is likewise just ``backward(outputs)``; gradients land on the
+    arrays that called ``attach_grad``)."""
+    backward(outputs)

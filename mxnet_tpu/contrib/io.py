@@ -1,0 +1,50 @@
+"""Contrib IO: gluon↔Module bridges (parity: contrib/io.py).
+
+``DataLoaderIter`` wraps a ``gluon.data.DataLoader`` as a classic
+``DataIter`` so gluon data pipelines feed the symbolic Module API.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io.io import DataBatch, DataDesc, DataIter
+
+
+class DataLoaderIter(DataIter):
+    """Adapt a gluon DataLoader to the DataIter interface (parity:
+    contrib/io.py:25).  Each loader item must be a (data, label) pair;
+    shapes are probed from the first batch."""
+
+    def __init__(self, loader, data_name="data",
+                 label_name="softmax_label", dtype="float32"):
+        super().__init__()
+        self._loader = loader
+        self._iter = iter(loader)
+        data, label = next(self._iter)
+        self.batch_size = int(data.shape[0])
+        self.dtype = dtype
+        self.provide_data = [DataDesc(data_name, tuple(data.shape), dtype)]
+        self.provide_label = [
+            DataDesc(label_name, tuple(label.shape), dtype)]
+        self._current_batch = (data, label)
+
+    def reset(self):
+        self._iter = iter(self._loader)
+        self._current_batch = None
+
+    def next(self):
+        if self._current_batch is None:
+            try:
+                self._current_batch = next(self._iter)
+            except StopIteration:
+                raise StopIteration
+        data, label = self._current_batch
+        self._current_batch = None
+        from .. import nd
+
+        def as_nd(x):
+            if hasattr(x, "asnumpy"):
+                return x
+            return nd.array(np.asarray(x))
+
+        return DataBatch(data=[as_nd(data)], label=[as_nd(label)], pad=0)

@@ -153,3 +153,180 @@ class LSTMPCell(HybridRecurrentCell):
                                   num_hidden=self._projection_size,
                                   name=prefix + "out")
         return next_r, [next_r, next_c]
+
+
+# ---------------------------------------------------------------------------
+# Convolutional recurrent cells (parity: gluon/contrib/rnn/conv_rnn_cell.py)
+# ---------------------------------------------------------------------------
+
+
+class _BaseConvCell(HybridRecurrentCell):
+    """Recurrent cell whose i2h/h2h transforms are convolutions over
+    NC*-layout feature maps (parity: conv_rnn_cell.py:37
+    _BaseConvRNNCell).  ``input_shape`` is the per-sample shape
+    ``(channels, *spatial)``; the h2h kernel must be odd so its SAME
+    padding keeps the state shape."""
+
+    _num_gates = 1
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                 h2h_kernel, i2h_pad, i2h_dilate, h2h_dilate, dims,
+                 activation="tanh", i2h_weight_initializer=None,
+                 h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        as_t = lambda v: (v,) * dims if isinstance(v, int) else tuple(v)
+        self._dims = dims
+        self._input_shape = tuple(input_shape)
+        self._hidden_channels = hidden_channels
+        self._activation = activation
+        self._i2h_kernel = as_t(i2h_kernel)
+        self._h2h_kernel = as_t(h2h_kernel)
+        assert all(k % 2 == 1 for k in self._h2h_kernel), \
+            "h2h_kernel must be odd (SAME-padded state conv), got %s" \
+            % (h2h_kernel,)
+        self._i2h_pad = as_t(i2h_pad)
+        self._i2h_dilate = as_t(i2h_dilate)
+        self._h2h_dilate = as_t(h2h_dilate)
+        self._h2h_pad = tuple(d * (k - 1) // 2 for d, k in
+                              zip(self._h2h_dilate, self._h2h_kernel))
+        in_ch = input_shape[0]
+        ng = self._num_gates
+        # state spatial dims = i2h conv output dims
+        self._state_shape = (hidden_channels,) + tuple(
+            (x + 2 * p - d * (k - 1) - 1) + 1
+            for x, p, d, k in zip(input_shape[1:], self._i2h_pad,
+                                  self._i2h_dilate, self._i2h_kernel))
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight",
+                shape=(ng * hidden_channels, in_ch) + self._i2h_kernel,
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight",
+                shape=(ng * hidden_channels,
+                       hidden_channels) + self._h2h_kernel,
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(ng * hidden_channels,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(ng * hidden_channels,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size,) + self._state_shape
+        return [{"shape": shape, "__layout__": "NC" + "DHW"[-self._dims:]}
+                ] * (2 if self._num_gates == 4 else 1)
+
+    def _convs(self, F, inputs, states, i2h_weight, h2h_weight,
+               i2h_bias, h2h_bias):
+        ng = self._num_gates
+        layout = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[self._dims]
+        i2h = F.Convolution(
+            inputs, i2h_weight, i2h_bias,
+            kernel=self._i2h_kernel, pad=self._i2h_pad,
+            dilate=self._i2h_dilate, layout=layout,
+            num_filter=ng * self._hidden_channels)
+        h2h = F.Convolution(
+            states[0], h2h_weight, h2h_bias,
+            kernel=self._h2h_kernel, pad=self._h2h_pad,
+            dilate=self._h2h_dilate, layout=layout,
+            num_filter=ng * self._hidden_channels)
+        return i2h, h2h
+
+    def _act(self, F, x):
+        # same contract as the dense cells: any act_type string the
+        # Activation op supports, or a callable block
+        return self._get_activation(F, x, self._activation)
+
+
+class _ConvRNNCellImpl(_BaseConvCell):
+    _num_gates = 1
+
+    def _alias(self):
+        return "conv_rnn"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._convs(F, inputs, states, i2h_weight,
+                               h2h_weight, i2h_bias, h2h_bias)
+        out = self._act(F, i2h + h2h)
+        return out, [out]
+
+
+class _ConvLSTMCellImpl(_BaseConvCell):
+    _num_gates = 4
+
+    def _alias(self):
+        return "conv_lstm"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._convs(F, inputs, states, i2h_weight,
+                               h2h_weight, i2h_bias, h2h_bias)
+        gates = i2h + h2h
+        slices = F.SliceChannel(gates, num_outputs=4, axis=1)
+        i = F.Activation(slices[0], act_type="sigmoid")
+        f = F.Activation(slices[1], act_type="sigmoid")
+        c_in = self._act(F, slices[2])
+        o = F.Activation(slices[3], act_type="sigmoid")
+        next_c = f * states[1] + i * c_in
+        next_h = o * self._act(F, next_c)
+        return next_h, [next_h, next_c]
+
+
+class _ConvGRUCellImpl(_BaseConvCell):
+    _num_gates = 3
+
+    def _alias(self):
+        return "conv_gru"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._convs(F, inputs, states, i2h_weight,
+                               h2h_weight, i2h_bias, h2h_bias)
+        i2h_s = F.SliceChannel(i2h, num_outputs=3, axis=1)
+        h2h_s = F.SliceChannel(h2h, num_outputs=3, axis=1)
+        reset = F.Activation(i2h_s[0] + h2h_s[0], act_type="sigmoid")
+        update = F.Activation(i2h_s[1] + h2h_s[1], act_type="sigmoid")
+        new_mem = self._act(F, i2h_s[2] + reset * h2h_s[2])
+        out = (1.0 - update) * new_mem + update * states[0]
+        return out, [out]
+
+
+def _make_conv_cell(impl, dims, name, doc_line):
+    class Cell(impl):
+        __doc__ = ("%s over %dD feature maps (parity: "
+                   "conv_rnn_cell.py %s)." % (doc_line, dims, name))
+
+        def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                     h2h_kernel, i2h_pad=0, i2h_dilate=1, h2h_dilate=1,
+                     activation="tanh", **kwargs):
+            super().__init__(input_shape, hidden_channels, i2h_kernel,
+                             h2h_kernel, i2h_pad, i2h_dilate, h2h_dilate,
+                             dims, activation=activation, **kwargs)
+
+    Cell.__name__ = Cell.__qualname__ = name
+    return Cell
+
+
+Conv1DRNNCell = _make_conv_cell(_ConvRNNCellImpl, 1, "Conv1DRNNCell",
+                                "Convolutional vanilla RNN cell")
+Conv2DRNNCell = _make_conv_cell(_ConvRNNCellImpl, 2, "Conv2DRNNCell",
+                                "Convolutional vanilla RNN cell")
+Conv3DRNNCell = _make_conv_cell(_ConvRNNCellImpl, 3, "Conv3DRNNCell",
+                                "Convolutional vanilla RNN cell")
+Conv1DLSTMCell = _make_conv_cell(_ConvLSTMCellImpl, 1, "Conv1DLSTMCell",
+                                 "ConvLSTM cell (Shi et al. 2015)")
+Conv2DLSTMCell = _make_conv_cell(_ConvLSTMCellImpl, 2, "Conv2DLSTMCell",
+                                 "ConvLSTM cell (Shi et al. 2015)")
+Conv3DLSTMCell = _make_conv_cell(_ConvLSTMCellImpl, 3, "Conv3DLSTMCell",
+                                 "ConvLSTM cell (Shi et al. 2015)")
+Conv1DGRUCell = _make_conv_cell(_ConvGRUCellImpl, 1, "Conv1DGRUCell",
+                                "Convolutional GRU cell")
+Conv2DGRUCell = _make_conv_cell(_ConvGRUCellImpl, 2, "Conv2DGRUCell",
+                                "Convolutional GRU cell")
+Conv3DGRUCell = _make_conv_cell(_ConvGRUCellImpl, 3, "Conv3DGRUCell",
+                                "Convolutional GRU cell")

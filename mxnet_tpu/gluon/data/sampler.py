@@ -39,6 +39,23 @@ class RandomSampler(Sampler):
         return self._length
 
 
+class FilterSampler(Sampler):
+    """Samples elements for which ``fn(sample)`` is True (parity:
+    gluon/data/sampler.py:77)."""
+
+    def __init__(self, fn, dataset):
+        self._fn = fn
+        self._dataset = dataset
+        self._indices = [i for i, sample in enumerate(dataset)
+                         if fn(sample)]
+
+    def __iter__(self):
+        return iter(self._indices)
+
+    def __len__(self):
+        return len(self._indices)
+
+
 class BatchSampler(Sampler):
     """Group a sampler into batches; last_batch in keep/discard/rollover."""
 

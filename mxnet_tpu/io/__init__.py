@@ -4,3 +4,18 @@ from .io import (  # noqa: F401
     NDArrayIter, CSVIter, MNISTIter, ImageRecordIter,
 )
 from .libsvm import LibSVMIter, read_libsvm  # noqa: F401
+
+
+def MXDataIter(*args, **kwargs):
+    """The reference's wrapper over C-implemented iterators
+    (``io.py MXDataIter``).  There is no C iterator registry here — the
+    built-in iterators (ImageRecordIter, MNISTIter, CSVIter, LibSVMIter,
+    NDArrayIter) are native Python/C++-data-plane classes — so this
+    name exists only to give migrating code a actionable error."""
+    from ..base import NotSupportedForTPU
+
+    raise NotSupportedForTPU(
+        "MXDataIter wraps the reference's C iterator handles, which do "
+        "not exist in this runtime; construct the concrete iterator "
+        "class instead (mx.io.ImageRecordIter / MNISTIter / CSVIter / "
+        "LibSVMIter / NDArrayIter)")

@@ -1,3 +1,4 @@
 """KVStore package (parity: python/mxnet/kvstore/)."""
 from .base import KVStoreBase  # noqa: F401
 from .kvstore import KVStore, TestStore, create  # noqa: F401
+from .kvstore_server import KVStoreServer  # noqa: F401

@@ -425,3 +425,95 @@ def test_feedforward_fit_score_predict_save_load(tmp_path):
                                      optimizer="adam",
                                      learning_rate=0.05)
     assert m3.arg_params is not None
+
+
+def test_conv_recurrent_cells():
+    """gluon.contrib Conv{1,2,3}D{RNN,LSTM,GRU}Cell (parity:
+    gluon/contrib/rnn/conv_rnn_cell.py): shapes, state counts, unroll,
+    and gradient flow through a ConvLSTM step."""
+    from mxnet_tpu import autograd, nd
+    from mxnet_tpu.gluon.contrib import rnn as crnn
+
+    cases = [
+        (crnn.Conv1DRNNCell, (8, 20), 1),
+        (crnn.Conv2DRNNCell, (8, 10, 10), 1),
+        (crnn.Conv3DRNNCell, (4, 5, 5, 5), 1),
+        (crnn.Conv1DLSTMCell, (8, 20), 2),
+        (crnn.Conv2DLSTMCell, (8, 10, 10), 2),
+        (crnn.Conv3DLSTMCell, (4, 5, 5, 5), 2),
+        (crnn.Conv1DGRUCell, (8, 20), 1),
+        (crnn.Conv2DGRUCell, (8, 10, 10), 1),
+        (crnn.Conv3DGRUCell, (4, 5, 5, 5), 1),
+    ]
+    rs = np.random.RandomState(0)
+    for cls, shape, n_states in cases:
+        cell = cls(input_shape=shape, hidden_channels=6, i2h_kernel=3,
+                   h2h_kernel=3, i2h_pad=1)
+        cell.initialize(mx.init.Xavier())
+        x = nd.array(rs.rand(2, *shape).astype(np.float32))
+        states = cell.begin_state(batch_size=2)
+        out, new_states = cell(x, states)
+        assert out.shape == (2, 6) + shape[1:], cls.__name__
+        assert len(new_states) == n_states, cls.__name__
+    # unroll + gradient through ConvLSTM
+    cell = crnn.Conv2DLSTMCell(input_shape=(3, 8, 8), hidden_channels=4,
+                               i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize(mx.init.Xavier())
+    x = nd.array(rs.rand(2, 3, 8, 8).astype(np.float32))
+    x.attach_grad()
+    states = cell.begin_state(batch_size=2)
+    with autograd.record():
+        out, states = cell(x, states)
+        out2, _ = cell(x, states)
+        loss = (out2 * out2).sum()
+    loss.backward()
+    assert np.isfinite(x.grad.asnumpy()).all()
+    assert float(np.abs(x.grad.asnumpy()).max()) > 0
+
+
+def test_contrib_io_autograd_misc_surfaces():
+    """Round-4 contrib stragglers: DataLoaderIter bridge,
+    TrainingStateScope/train_section, KVStoreServer export, MXDataIter
+    guidance error."""
+    from mxnet_tpu import autograd, gluon
+
+    ds = gluon.data.ArrayDataset(
+        np.random.rand(10, 4).astype(np.float32),
+        np.arange(10, dtype=np.float32))
+    it = mx.contrib.DataLoaderIter(gluon.data.DataLoader(ds, batch_size=5))
+    it.reset()
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (5, 4)
+    assert it.provide_data[0].shape == (5, 4)
+
+    assert not autograd.is_recording()
+    with mx.contrib.autograd.train_section():
+        assert autograd.is_recording() and autograd.is_training()
+    assert not autograd.is_recording()
+    with mx.contrib.autograd.TrainingStateScope(False):
+        assert not autograd.is_recording()
+
+    assert mx.kvstore.KVStoreServer is not None
+    with pytest.raises(mx.MXNetError, match="concrete iterator"):
+        mx.io.MXDataIter()
+
+
+def test_training_state_scope_restores_mixed_flags():
+    """train_section inside record(train_mode=False) must not leave the
+    training flag flipped on exit (set_is_training mutates BOTH the
+    recording and training flags; the scope restores both)."""
+    from mxnet_tpu import autograd, nd
+
+    x = nd.array(np.array([1.0], np.float32))
+    x.attach_grad()
+    with autograd.record(train_mode=False):
+        assert autograd.is_recording() and not autograd.is_training()
+        with mx.contrib.autograd.train_section():
+            assert autograd.is_training()
+        # both flags restored to the outer scope's state
+        assert autograd.is_recording() and not autograd.is_training()
+        y = x * 2
+    # compute_gradient: deprecated spelling of backward
+    mx.contrib.autograd.compute_gradient([y])
+    assert float(x.grad.asnumpy()[0]) == 2.0

@@ -235,3 +235,14 @@ def test_transforms():
     compose = transforms.Compose([transforms.ToTensor(),
                                   transforms.Normalize(0.5, 0.5)])
     assert compose(img).shape == (3, 8, 9)
+
+
+def test_filter_sampler_and_loader_v1_alias():
+    """Parity stragglers: FilterSampler (gluon/data/sampler.py:77) and
+    the DataLoaderV1 compatibility name."""
+    from mxnet_tpu.gluon import data as gdata
+
+    fs = gdata.FilterSampler(lambda s: s % 3 == 0, list(range(12)))
+    assert list(fs) == [0, 3, 6, 9]
+    assert len(fs) == 4
+    assert gdata.DataLoaderV1 is gdata.DataLoader
