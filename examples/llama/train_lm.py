@@ -65,6 +65,9 @@ def main():
     ap.add_argument("--ring", action="store_true",
                     help="sequence-parallel ring attention over an "
                          "8-way sp mesh")
+    ap.add_argument("--clip", type=float, default=1.0,
+                    help="global-norm gradient clip (the standard LM "
+                         "training guard; <=0 disables)")
     args = ap.parse_args()
 
     mx.random.seed(0)
@@ -105,7 +108,8 @@ def main():
         step = None
     else:
         step = parallel.JitTrainStep(
-            LM(net), loss_fn, "adamw", {"learning_rate": 3e-4})
+            LM(net), loss_fn, "adamw", {"learning_rate": 3e-4},
+            clip_global_norm=args.clip if args.clip > 0 else None)
 
     rng = np.random.RandomState(0)
     # synthetic "language": next token = (token * 31 + 7) % vocab, so the
@@ -130,6 +134,10 @@ def main():
                 l = loss_fn(logits.reshape(-3, 0),
                             nd.array(labels)).mean()
             l.backward()
+            if args.clip > 0:
+                grads = [p.grad() for p in net.collect_params().values()
+                         if p.grad_req != "null"]
+                gluon.utils.clip_global_norm(grads, args.clip)
             trainer.step(1)
             val = float(l.asscalar())
         if i % 10 == 0 or i == args.steps - 1:

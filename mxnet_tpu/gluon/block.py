@@ -217,10 +217,18 @@ class Block:
         self._children[name] = block
 
     def register_forward_hook(self, hook):
-        self._forward_hooks[len(self._forward_hooks)] = hook
+        from .utils import HookHandle
+
+        handle = HookHandle()
+        handle.attach(self._forward_hooks, hook)
+        return handle
 
     def register_forward_pre_hook(self, hook):
-        self._forward_pre_hooks[len(self._forward_pre_hooks)] = hook
+        from .utils import HookHandle
+
+        handle = HookHandle()
+        handle.attach(self._forward_pre_hooks, hook)
+        return handle
 
     def collect_params(self, select=None):
         """All params of self + descendants, optionally regex-filtered.

@@ -1,0 +1,322 @@
+"""Parallelization and misc utilities for Gluon.
+
+Parity: ``python/mxnet/gluon/utils.py`` (``split_data:42``,
+``split_and_load:88``, ``clip_global_norm:118``, ``check_sha1:172``,
+``download:254``, ``HookHandle:378``).
+
+TPU-native notes:
+
+* ``split_and_load`` accepts either a list of :class:`~mxnet_tpu.Context`
+  (reference semantics: a python list of per-device slices) **or** a
+  ``jax.sharding.Mesh`` — the GSPMD form — in which case the batch is laid
+  out as ONE globally-sharded array over the mesh's leading (data) axis and
+  XLA handles the per-chip placement.  On TPU pods the mesh form is the one
+  you want: there is no host round-trip per shard and collectives ride ICI.
+* ``clip_global_norm`` runs as ONE fused jitted executable over the whole
+  array list — a single kernel computes every partial norm, the global norm
+  and every rescaled output, instead of the reference's per-array
+  ``ndarray.dot`` dispatches (``gluon/utils.py:133-141``).
+"""
+from __future__ import annotations
+
+import collections
+import hashlib
+import itertools
+import os
+import uuid
+import warnings
+import weakref
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from .. import ndarray
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm",
+           "check_sha1", "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split ``data`` into ``num_slice`` slices along ``batch_axis``.
+
+    Returns a list even when ``num_slice == 1``.  With ``even_split`` the
+    batch must divide exactly; otherwise leading slices get one extra row
+    (reference ``gluon/utils.py:42``).
+    """
+    if not isinstance(data, NDArray):
+        data = ndarray.array(data)
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices along "
+            "axis %d. Use a batch size that's multiple of %d or set "
+            "even_split=False to allow uneven partitioning of data."
+            % (str(data.shape), num_slice, batch_axis, num_slice))
+
+    n_each, extras = divmod(size, num_slice)
+    section_sizes = (extras * [n_each + 1] + (num_slice - extras) * [n_each])
+    div_points = _np.cumsum([0] + section_sizes)
+    raw = data.data()
+    slices = []
+    for i in range(num_slice):
+        idx = [slice(None)] * raw.ndim
+        idx[batch_axis] = slice(int(div_points[i]), int(div_points[i + 1]))
+        slices.append(NDArray(raw[tuple(idx)], ctx=data.context))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split ``data`` along ``batch_axis`` and load slices onto devices.
+
+    Parameters
+    ----------
+    data : NDArray or array-like
+    ctx_list : list of Context, or jax.sharding.Mesh
+        A list of contexts gives the reference behaviour — a python list of
+        per-context slices.  A ``Mesh`` gives the TPU-native behaviour: the
+        return value is a single NDArray sharded over the mesh's first axis
+        (GSPMD data parallelism); XLA moves the shards, not the host.
+    batch_axis : int
+    even_split : bool
+
+    Returns
+    -------
+    list of NDArray (ctx list form) or NDArray (mesh form)
+    """
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    if isinstance(ctx_list, Mesh):
+        mesh = ctx_list
+        if not isinstance(data, NDArray):
+            data = ndarray.array(data)
+        axis = mesh.axis_names[0]
+        spec = [None] * data.ndim
+        spec[batch_axis] = axis
+        if even_split and data.shape[batch_axis] % mesh.shape[axis] != 0:
+            raise ValueError(
+                "batch %d not divisible by mesh axis %r size %d"
+                % (data.shape[batch_axis], axis, mesh.shape[axis]))
+        sharding = NamedSharding(mesh, PartitionSpec(*spec))
+        return NDArray(jax.device_put(data.data(), sharding), ctx=data.context)
+
+    if not isinstance(data, NDArray):
+        data = ndarray.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def global_norm_scale(raws, max_norm):
+    """Pure fn: global-norm clip over a list of raw jax arrays.
+
+    Returns ``(scaled_arrays, total_norm)``.  The single shared definition
+    of the clip math — used here (jitted, below) and fused into
+    ``parallel.JitTrainStep``'s step executable.
+    """
+    total = jnp.zeros((), jnp.float32)
+    for r in raws:
+        total = total + jnp.sum(jnp.square(r.astype(jnp.float32)))
+    total_norm = jnp.sqrt(total)
+    scale = jnp.minimum(max_norm / (total_norm + 1e-8), 1.0)
+    return [(r * scale.astype(r.dtype)) for r in raws], total_norm
+
+
+# One executable per (tree-structure, shapes/dtypes) — all partial norms, the
+# global norm and every rescaled output in a single fused XLA program.
+_clip_global_norm_impl = jax.jit(global_norm_scale)
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale ``arrays`` so the joint L2 norm is at most ``max_norm``.
+
+    In-place on each NDArray (functional swap under the hood).  Returns the
+    pre-clip total norm: a float when ``check_isfinite`` (blocking), else a
+    shape-(1,) NDArray (reference ``gluon/utils.py:118``).
+    """
+    assert len(arrays) > 0
+    if not all(isinstance(a, NDArray) for a in arrays):
+        raise TypeError("clip_global_norm expects a list of NDArray "
+                        "(mutated in place); for raw jax arrays use "
+                        "gluon.utils.global_norm_scale")
+    raws = [a.data() for a in arrays]
+    scaled, total_norm = _clip_global_norm_impl(
+        raws, jnp.float32(max_norm))
+    if check_isfinite:
+        tn = float(total_norm)
+        if not _np.isfinite(tn):
+            warnings.warn(
+                UserWarning("nan or inf is detected. "
+                            "Clipping results will be undefined."),
+                stacklevel=2)
+    for arr, new in zip(arrays, scaled):
+        arr._set_data(new)
+    if check_isfinite:
+        return tn
+    return NDArray(total_norm.reshape((1,)))
+
+
+def check_sha1(filename, sha1_hash):
+    """True iff the sha1 of ``filename``'s content equals ``sha1_hash``."""
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def _replace_atomic(src, dst):
+    try:
+        os.replace(src, dst)
+    except OSError:
+        try:
+            os.remove(src)
+        except OSError:
+            pass
+        raise OSError("Moving downloaded temp file - {}, to {} failed. "
+                      "Please retry the download.".format(src, dst))
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Download ``url`` with retries, sha1 verification and atomic rename.
+
+    Reference ``gluon/utils.py:254``.  Uses ``requests`` when available,
+    falling back to ``urllib`` (this build has no hard dependency on
+    requests).
+    """
+    if path is None:
+        fname = url.split("/")[-1]
+        assert fname, ("Can't construct file-name from this URL. "
+                       "Please set the `path` option manually.")
+    else:
+        path = os.path.expanduser(path)
+        if os.path.isdir(path):
+            fname = os.path.join(path, url.split("/")[-1])
+        else:
+            fname = path
+    assert retries >= 0, \
+        "Number of retries should be at least 0, currently it's {}".format(
+            retries)
+
+    if not verify_ssl:
+        warnings.warn(
+            "Unverified HTTPS request is being made (verify_ssl=False). "
+            "Adding certificate verification is strongly advised.")
+
+    if overwrite or not os.path.exists(fname) or (
+            sha1_hash and not check_sha1(fname, sha1_hash)):
+        dirname = os.path.dirname(os.path.abspath(os.path.expanduser(fname)))
+        if not os.path.exists(dirname):
+            os.makedirs(dirname)
+        while retries + 1 > 0:
+            try:
+                print("Downloading {} from {}...".format(fname, url))
+                tmp = "{}.{}".format(fname, str(uuid.uuid4()))
+                _fetch_url(url, tmp, verify_ssl)
+                if not os.path.exists(fname) or (
+                        sha1_hash and not check_sha1(fname, sha1_hash)):
+                    _replace_atomic(tmp, fname)
+                else:
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
+                    warnings.warn("File {} exists in file system so the "
+                                  "downloaded file is deleted".format(fname))
+                if sha1_hash and not check_sha1(fname, sha1_hash):
+                    raise UserWarning(
+                        "File {} is downloaded but the content hash does not "
+                        "match.".format(fname))
+                break
+            except Exception as e:
+                retries -= 1
+                if retries <= 0:
+                    raise e
+                print("download failed due to {}, retrying, {} attempt{} left"
+                      .format(repr(e), retries, "s" if retries > 1 else ""))
+    return fname
+
+
+def _fetch_url(url, dest, verify_ssl=True):
+    """Stream ``url`` to ``dest``; file:// URLs are served locally (tests)."""
+    if url.startswith("file://"):
+        import shutil
+        shutil.copyfile(url[len("file://"):], dest)
+        return
+    try:
+        import requests
+        r = requests.get(url, stream=True, verify=verify_ssl)
+        if r.status_code != 200:
+            raise RuntimeError("Failed downloading url {}".format(url))
+        with open(dest, "wb") as f:
+            for chunk in r.iter_content(chunk_size=1048576):
+                if chunk:
+                    f.write(chunk)
+    except ImportError:  # pragma: no cover - requests is baked into the image
+        import ssl
+        import urllib.request
+        ctx = None if verify_ssl else ssl._create_unverified_context()
+        with urllib.request.urlopen(url, context=ctx) as r, \
+                open(dest, "wb") as f:
+            while True:
+                chunk = r.read(1048576)
+                if not chunk:
+                    break
+                f.write(chunk)
+
+
+class HookHandle:
+    """A removable handle for a registered hook (reference ``utils.py:378``)."""
+
+    _next_id = itertools.count()
+
+    def __init__(self):
+        self._hooks_dict_ref = None
+        self._id = None
+
+    def attach(self, hooks_dict, hook):
+        assert not self._hooks_dict_ref, \
+            "The same handle cannot be attached twice."
+        # monotonic key: id(self)/id(hook) can be reused after GC and would
+        # silently replace a still-registered hook
+        self._id = next(HookHandle._next_id)
+        hooks_dict[self._id] = hook
+        self._hooks_dict_ref = weakref.ref(hooks_dict)
+
+    def detach(self):
+        hooks_dict = self._hooks_dict_ref()
+        if hooks_dict is not None and self._id in hooks_dict:
+            del hooks_dict[self._id]
+
+    def __getstate__(self):
+        return (self._hooks_dict_ref(), self._id)
+
+    def __setstate__(self, state):
+        if state[0] is None:
+            self._hooks_dict_ref = weakref.ref(collections.OrderedDict())
+        else:
+            self._hooks_dict_ref = weakref.ref(state[0])
+        self._id = state[1]
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        self.detach()
+
+
+def shape_is_known(shape):
+    """Whether ``shape`` is fully known (no 0/-1/None unknown dims)."""
+    if shape is None:
+        return False
+    unknown = (0, -1, None)
+    if len(shape) == 0:
+        return True
+    return all(dim not in unknown for dim in shape)

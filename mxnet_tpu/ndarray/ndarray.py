@@ -50,6 +50,7 @@ class NDArray:
     __slots__ = (
         "_data", "_ctx", "_var",
         "_marked", "_grad", "_grad_req", "_grad_gen", "_fresh_grad",
+        "_grad_owner",
         "_tape_node", "_tape_index",
         "__weakref__",
     )
@@ -71,6 +72,7 @@ class NDArray:
         self._var = Var()
         self._marked = False
         self._grad = None
+        self._grad_owner = None
         self._grad_req = "write"
         self._grad_gen = -1
         self._fresh_grad = False
@@ -86,8 +88,18 @@ class NDArray:
 
     def _set_data(self, new_data):
         """In-place write: swap buffer + bump the engine var version."""
+        old = self._data
         self._data = new_data
         self._var.on_write()
+        # grad-view write-through: reference .grad is the ACTUAL shared
+        # NDArray, so mutating it mutates the stored gradient.  Our wrapper
+        # is fresh per access (immutable buffers), so propagate writes back
+        # to the owning array's gradient slot — but only while the view is
+        # current (a later backward() orphans old views instead of letting
+        # their read-modify-writes clobber the newer gradient).
+        owner = self._grad_owner
+        if owner is not None and owner._grad is old:
+            owner._grad = new_data
 
     @property
     def shape(self):
@@ -182,7 +194,9 @@ class NDArray:
 
         if isinstance(self._grad, BaseSparseNDArray):
             return self._grad
-        return NDArray(self._grad, ctx=self._ctx)
+        out = NDArray(self._grad, ctx=self._ctx)
+        out._grad_owner = self
+        return out
 
     def _accumulate_grad(self, ct):
         # MXNet 'write' semantics: a new backward pass overwrites .grad, but

@@ -47,9 +47,13 @@ class JitTrainStep:
 
     def __init__(self, net, loss=None, optimizer='sgd',
                  optimizer_params=None, mesh=None, data_axis='data',
-                 param_rule=None, donate=True):
+                 param_rule=None, donate=True, clip_global_norm=None):
         self._net = net
         self._loss = loss
+        # global-norm grad clip fused into the step executable (the jitted
+        # analogue of gluon.utils.clip_global_norm, reference
+        # gluon/utils.py:118)
+        self._clip_global_norm = clip_global_norm
         if isinstance(optimizer, str):
             optimizer = _opt_mod.create(optimizer,
                                         **(optimizer_params or {}))
@@ -183,11 +187,17 @@ class JitTrainStep:
             finally:
                 st.param_map, st.aux_updates, st.active = prev
 
+        clip_norm = self._clip_global_norm
+
         def step(key, lr, weights, opt_state, t, *batch):
             with _random.trace_key_scope(key):
                 train_ws = [weights[i] for i in train_idx]
                 (loss_val, aux), grads = jax.value_and_grad(
                     forward_loss, has_aux=True)(train_ws, weights, batch)
+            if clip_norm is not None:
+                from ..gluon.utils import global_norm_scale
+
+                grads, _ = global_norm_scale(grads, clip_norm)
             new_weights = list(weights)
             new_state = list(opt_state)
             for j, i in enumerate(train_idx):

@@ -1,0 +1,236 @@
+"""gluon.utils: split_and_load / clip_global_norm / download / HookHandle.
+
+Parity: reference python/mxnet/gluon/utils.py (tests modeled on
+tests/python/unittest/test_gluon_utils.py).
+"""
+import hashlib
+import os
+
+import numpy as np
+import jax
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn, utils
+
+
+def test_split_data_even():
+    data = mx.nd.array(np.arange(24).reshape(8, 3))
+    slices = utils.split_data(data, 4)
+    assert len(slices) == 4
+    for i, s in enumerate(slices):
+        assert s.shape == (2, 3)
+        np.testing.assert_array_equal(
+            s.asnumpy(), np.arange(24).reshape(8, 3)[2 * i:2 * i + 2])
+
+
+def test_split_data_uneven_and_error():
+    data = mx.nd.array(np.arange(21).reshape(7, 3))
+    with pytest.raises(ValueError):
+        utils.split_data(data, 4)
+    slices = utils.split_data(data, 4, even_split=False)
+    assert [s.shape[0] for s in slices] == [2, 2, 2, 1]
+    recon = np.concatenate([s.asnumpy() for s in slices], axis=0)
+    np.testing.assert_array_equal(recon, data.asnumpy())
+
+
+def test_split_data_batch_axis1():
+    data = mx.nd.array(np.arange(24).reshape(3, 8))
+    slices = utils.split_data(data, 2, batch_axis=1)
+    assert [s.shape for s in slices] == [(3, 4), (3, 4)]
+
+
+def test_split_and_load_ctx_list():
+    ctxs = [mx.cpu(0), mx.cpu(0)]
+    data = np.arange(12).reshape(6, 2).astype(np.float32)
+    parts = utils.split_and_load(data, ctxs)
+    assert len(parts) == 2
+    np.testing.assert_array_equal(parts[0].asnumpy(), data[:3])
+    np.testing.assert_array_equal(parts[1].asnumpy(), data[3:])
+    # single ctx: whole batch on that ctx, still a list
+    whole = utils.split_and_load(data, [mx.cpu(0)])
+    assert len(whole) == 1 and whole[0].shape == (6, 2)
+
+
+def test_split_and_load_mesh():
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:8]).reshape(8)
+    mesh = Mesh(devs, ("data",))
+    data = np.arange(64, dtype=np.float32).reshape(16, 4)
+    out = utils.split_and_load(data, mesh)
+    # GSPMD form: one global array sharded over the data axis
+    assert out.shape == (16, 4)
+    np.testing.assert_array_equal(out.asnumpy(), data)
+    shardings = {tuple(s.index) for s in out.data().addressable_shards}
+    assert len(shardings) == 8
+    with pytest.raises(ValueError):
+        utils.split_and_load(np.zeros((6, 4), np.float32), mesh)
+
+
+def test_clip_global_norm_clips():
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(3, 4).astype(np.float32),
+          rng.randn(7,).astype(np.float32),
+          rng.randn(2, 2, 2).astype(np.float32)]
+    total = np.sqrt(sum((x ** 2).sum() for x in xs))
+    arrays = [mx.nd.array(x) for x in xs]
+    max_norm = float(total) / 2.0
+    ret = utils.clip_global_norm(arrays, max_norm)
+    assert isinstance(ret, float)
+    assert abs(ret - total) < 1e-3
+    new_total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert abs(new_total - max_norm) < 1e-3
+    for x, a in zip(xs, arrays):
+        np.testing.assert_allclose(
+            a.asnumpy(), x * (max_norm / (total + 1e-8)), rtol=1e-5)
+
+
+def test_clip_global_norm_noop_when_small():
+    xs = [np.ones((2, 2), np.float32) * 0.01]
+    arrays = [mx.nd.array(x) for x in xs]
+    utils.clip_global_norm(arrays, 100.0)
+    np.testing.assert_allclose(arrays[0].asnumpy(), xs[0], rtol=1e-6)
+
+
+def test_clip_global_norm_nonfinite_warns():
+    arrays = [mx.nd.array(np.array([np.inf, 1.0], np.float32))]
+    with pytest.warns(UserWarning):
+        utils.clip_global_norm(arrays, 1.0)
+
+
+def test_clip_global_norm_unblocking():
+    arrays = [mx.nd.array(np.ones((3,), np.float32))]
+    ret = utils.clip_global_norm(arrays, 10.0, check_isfinite=False)
+    assert ret.shape == (1,)
+    assert abs(float(ret.asnumpy()[0]) - np.sqrt(3.0)) < 1e-5
+
+
+def test_check_sha1_and_download(tmp_path):
+    src = tmp_path / "payload.bin"
+    content = b"mxnet-tpu gluon utils download test" * 100
+    src.write_bytes(content)
+    sha1 = hashlib.sha1(content).hexdigest()
+    assert utils.check_sha1(str(src), sha1)
+    assert not utils.check_sha1(str(src), "0" * 40)
+
+    dest = tmp_path / "out" / "payload.bin"
+    got = utils.download("file://" + str(src), path=str(dest), sha1_hash=sha1)
+    assert got == str(dest)
+    assert dest.read_bytes() == content
+    # no overwrite: second call is a no-op (mtime preserved)
+    mtime = os.path.getmtime(got)
+    utils.download("file://" + str(src), path=str(dest), sha1_hash=sha1)
+    assert os.path.getmtime(got) == mtime
+    # bad hash on existing file forces re-download
+    utils.download("file://" + str(src), path=str(dest), overwrite=True)
+    assert dest.read_bytes() == content
+
+
+def test_download_retries_exhausted(tmp_path):
+    with pytest.raises(Exception):
+        utils.download("file:///nonexistent/definitely/missing",
+                       path=str(tmp_path / "x"), retries=2)
+
+
+def test_hook_handle_via_block():
+    calls = []
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    handle = net.register_forward_hook(lambda blk, inp, out: calls.append(1))
+    x = mx.nd.array(np.ones((2, 4), np.float32))
+    net(x)
+    assert calls == [1]
+    handle.detach()
+    net(x)
+    assert calls == [1]
+    # context-manager form detaches on exit
+    with net.register_forward_pre_hook(lambda blk, inp: calls.append(2)):
+        net(x)
+    net(x)
+    assert calls == [1, 2]
+
+
+def test_shape_is_known():
+    assert utils.shape_is_known(())
+    assert utils.shape_is_known((2, 3))
+    assert not utils.shape_is_known(None)
+    assert not utils.shape_is_known((2, 0))
+    assert not utils.shape_is_known((2, -1))
+
+
+def test_jit_train_step_clip_global_norm():
+    """JitTrainStep(clip_global_norm=...) fuses the clip into the step."""
+    from mxnet_tpu import parallel
+
+    def make_step(clip):
+        mx.random.seed(0)
+        net = nn.Dense(1, in_units=4)
+        net.initialize(mx.init.Constant(0.5))
+        loss = gluon.loss.L2Loss()
+        return net, parallel.JitTrainStep(net, loss, "sgd",
+                                          {"learning_rate": 1.0},
+                                          clip_global_norm=clip)
+
+    x = np.ones((2, 4), np.float32) * 100.0  # huge grads
+    y = np.zeros((2, 1), np.float32)
+
+    net_a, step_a = make_step(None)
+    step_a.step(x, y)
+    step_a.sync_params() if hasattr(step_a, "sync_params") else None
+    wa = step_a._weights[0]
+
+    net_b, step_b = make_step(1e-6)  # essentially freezes the weights
+    step_b.step(x, y)
+    wb = step_b._weights[0]
+
+    assert float(np.abs(np.asarray(wa) - 0.5).max()) > 1.0
+    assert float(np.abs(np.asarray(wb) - 0.5).max()) < 1e-4
+
+
+def test_clip_global_norm_writes_through_grad_views():
+    """p.grad() wrappers write back: the real grad buffer is clipped."""
+    from mxnet_tpu import autograd, nd
+
+    net = nn.Dense(1, in_units=4)
+    net.initialize(mx.init.Constant(1.0))
+    x = mx.nd.array(np.ones((2, 4), np.float32) * 10.0)
+    with autograd.record():
+        l = (net(x) ** 2).mean()
+    l.backward()
+    params = [p for p in net.collect_params().values()
+              if p.grad_req != "null"]
+    before = np.sqrt(sum((p.grad().asnumpy() ** 2).sum() for p in params))
+    assert before > 1.0
+    utils.clip_global_norm([p.grad() for p in params], 0.5)
+    after = np.sqrt(sum((p.grad().asnumpy() ** 2).sum() for p in params))
+    assert abs(after - 0.5) < 1e-4
+
+
+def test_clip_global_norm_rejects_raw_arrays():
+    import jax.numpy as jnp
+
+    with pytest.raises(TypeError):
+        utils.clip_global_norm([jnp.ones((2,))], 1.0)
+
+
+def test_same_hook_registered_twice_fires_twice():
+    calls = []
+
+    def hook(blk, inp, out):
+        calls.append(1)
+
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    h1 = net.register_forward_hook(hook)
+    h2 = net.register_forward_hook(hook)
+    x = mx.nd.array(np.ones((1, 2), np.float32))
+    net(x)
+    assert len(calls) == 2
+    h1.detach()
+    net(x)
+    assert len(calls) == 3
+    h2.detach()
+    net(x)
+    assert len(calls) == 3
