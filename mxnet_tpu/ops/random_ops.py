@@ -120,3 +120,108 @@ def _shuffle(key, data):
 @register("_random_gumbel", needs_rng=True)
 def _random_gumbel(key, loc=0.0, scale=1.0, shape=(), dtype="float32"):
     return loc + scale * jax.random.gumbel(key, _shape(shape), jnp.dtype(dtype))
+
+
+# ----------------------------------------------------------------------------
+# _random_pdf_* family: density of *sample* under per-element distribution
+# parameters (reference src/operator/random/pdf_op.cc:33-37, functors in
+# pdf_op.h).  Parameters have the leftmost subshape of ``sample`` and
+# broadcast over the trailing sample dims; ``is_log`` selects log-density.
+# TPU-native: the forward is plain differentiable jnp (gradients wrt sample
+# AND parameters come from the tape's vjp — no hand-written _backward_pdf_*
+# kernels), fused by XLA into one elementwise program.
+# ----------------------------------------------------------------------------
+
+
+def _pdf_bcast(param, sample_ndim):
+    """Align a leftmost-subshape parameter to the sample rank."""
+    return param.reshape(param.shape + (1,) * (sample_ndim - param.ndim))
+
+
+def _pdf_out(lpdf, is_log):
+    return lpdf if is_log else jnp.exp(lpdf)
+
+
+@register("_random_pdf_uniform", aliases=("random_pdf_uniform",))
+def _random_pdf_uniform(sample, low, high, is_log=False):
+    l = _pdf_bcast(low, sample.ndim)
+    h = _pdf_bcast(high, sample.ndim)
+    lpdf = jnp.broadcast_to(-jnp.log(h - l), sample.shape)
+    return _pdf_out(lpdf, is_log)
+
+
+@register("_random_pdf_normal", aliases=("random_pdf_normal",))
+def _random_pdf_normal(sample, mu, sigma, is_log=False):
+    u = _pdf_bcast(mu, sample.ndim)
+    s = _pdf_bcast(sigma, sample.ndim)
+    lpdf = (-0.5 * jnp.square(sample - u) / jnp.square(s)
+            - jnp.log(jnp.sqrt(2.0 * jnp.pi) * s))
+    return _pdf_out(lpdf, is_log)
+
+
+@register("_random_pdf_gamma", aliases=("random_pdf_gamma",))
+def _random_pdf_gamma(sample, alpha, beta, is_log=False):
+    from jax.scipy.special import gammaln
+
+    a = _pdf_bcast(alpha, sample.ndim)
+    b = _pdf_bcast(beta, sample.ndim)
+    lpdf = (a * jnp.log(b) + (a - 1.0) * jnp.log(sample) - b * sample
+            - gammaln(a))
+    return _pdf_out(lpdf, is_log)
+
+
+@register("_random_pdf_exponential", aliases=("random_pdf_exponential",))
+def _random_pdf_exponential(sample, lam, is_log=False):
+    l = _pdf_bcast(lam, sample.ndim)
+    lpdf = jnp.log(l) - l * sample
+    return _pdf_out(lpdf, is_log)
+
+
+@register("_random_pdf_poisson", aliases=("random_pdf_poisson",))
+def _random_pdf_poisson(sample, lam, is_log=False):
+    from jax.scipy.special import gammaln
+
+    l = _pdf_bcast(lam, sample.ndim)
+    lpdf = sample * jnp.log(l) - gammaln(sample + 1.0) - l
+    return _pdf_out(lpdf, is_log)
+
+
+def _nb_lpdf(limit, prob, x):
+    """log NB(x; limit, prob) with prob the FAILURE probability
+    (pdf_op.h PDF_NegativeBinomial::LPDF)."""
+    from jax.scipy.special import gammaln
+
+    return (gammaln(x + limit) - gammaln(x + 1.0) - gammaln(limit)
+            + limit * jnp.log(prob) + x * jnp.log(1.0 - prob))
+
+
+@register("_random_pdf_negative_binomial",
+          aliases=("random_pdf_negative_binomial",))
+def _random_pdf_negative_binomial(sample, k, p, is_log=False):
+    limit = _pdf_bcast(k, sample.ndim)
+    prob = _pdf_bcast(p, sample.ndim)
+    return _pdf_out(_nb_lpdf(limit, prob, sample), is_log)
+
+
+@register("_random_pdf_generalized_negative_binomial",
+          aliases=("random_pdf_generalized_negative_binomial",))
+def _random_pdf_generalized_negative_binomial(sample, mu, alpha, is_log=False):
+    m = _pdf_bcast(mu, sample.ndim)
+    a = _pdf_bcast(alpha, sample.ndim)
+    limit = 1.0 / a
+    prob = 1.0 / (m * a + 1.0)
+    return _pdf_out(_nb_lpdf(limit, prob, sample), is_log)
+
+
+@register("_random_pdf_dirichlet", aliases=("random_pdf_dirichlet",))
+def _random_pdf_dirichlet(sample, alpha, is_log=False):
+    """alpha: (s..., k); sample: (s..., m..., k); out: (s..., m...)."""
+    from jax.scipy.special import gammaln
+
+    a = alpha.reshape(alpha.shape[:-1]
+                      + (1,) * (sample.ndim - alpha.ndim)
+                      + alpha.shape[-1:])
+    lpdf = (jnp.sum((a - 1.0) * jnp.log(sample), axis=-1)
+            + gammaln(jnp.sum(a, axis=-1))
+            - jnp.sum(gammaln(a), axis=-1))
+    return _pdf_out(lpdf, is_log)

@@ -786,6 +786,87 @@ SPECS["_random_randint"] = S([], dict(_RSHAPE, low=2, high=9),
 SPECS["_random_bernoulli"] = S([], dict(_RSHAPE, prob=0.3),
                                check=_stat(0.0, 1.0, integral=True))
 SPECS["_random_gumbel"] = S([], dict(_RSHAPE), check=_stat())
+
+# _random_pdf_* family (pdf_op.cc:33-37): scipy forward oracles + FD grads
+# wrt sample AND parameters (grads wrt sample skipped for discrete distrs,
+# mirroring the reference test_random.py grad_nodes choice)
+import scipy.stats as _ss  # noqa: E402
+
+_PDF_X = np.abs(np.random.RandomState(3).randn(2, 5)).astype(np.float64) + 0.5
+_PDF_K = np.round(np.abs(np.random.RandomState(4).randn(2, 5)) * 3) + 1.0
+SPECS["_random_pdf_uniform"] = [
+    S([_PDF_X, np.array([0.1, 0.2]), np.array([9.0, 8.0])], {},
+      ref=lambda x, l, h: _ss.uniform.pdf(x, l[:, None], (h - l)[:, None]),
+      grad=True),
+    S([_PDF_X, np.array([0.1, 0.2]), np.array([9.0, 8.0])], {"is_log": True},
+      ref=lambda x, l, h: _ss.uniform.logpdf(x, l[:, None], (h - l)[:, None])),
+]
+SPECS["_random_pdf_normal"] = [
+    S([_PDF_X, np.array([0.5, 1.0]), np.array([1.0, 2.0])], {},
+      ref=lambda x, u, s: _ss.norm.pdf(x, u[:, None], s[:, None]),
+      grad=True),
+    S([_PDF_X, np.array([0.5, 1.0]), np.array([1.0, 2.0])], {"is_log": True},
+      ref=lambda x, u, s: _ss.norm.logpdf(x, u[:, None], s[:, None])),
+]
+SPECS["_random_pdf_gamma"] = [
+    S([_PDF_X, np.array([2.0, 3.0]), np.array([1.0, 2.0])], {},
+      ref=lambda x, a, b: _ss.gamma.pdf(x, a[:, None], 0, 1.0 / b[:, None]),
+      grad=True),
+    S([_PDF_X, np.array([2.0, 3.0]), np.array([1.0, 2.0])], {"is_log": True},
+      ref=lambda x, a, b: _ss.gamma.logpdf(x, a[:, None], 0,
+                                           1.0 / b[:, None])),
+]
+SPECS["_random_pdf_exponential"] = [
+    S([_PDF_X, np.array([2.0, 0.5])], {},
+      ref=lambda x, lam: _ss.expon.pdf(x, 0, 1.0 / lam[:, None]),
+      grad=True),
+    S([_PDF_X, np.array([2.0, 0.5])], {"is_log": True},
+      ref=lambda x, lam: _ss.expon.logpdf(x, 0, 1.0 / lam[:, None])),
+]
+SPECS["_random_pdf_poisson"] = [
+    S([_PDF_K, np.array([3.0, 1.5])], {},
+      ref=lambda x, lam: _ss.poisson.pmf(x, lam[:, None]),
+      grad=True, grad_nodes=["v1"]),
+    S([_PDF_K, np.array([3.0, 1.5])], {"is_log": True},
+      ref=lambda x, lam: _ss.poisson.logpmf(x, lam[:, None])),
+]
+SPECS["_random_pdf_negative_binomial"] = [
+    S([_PDF_K, np.array([3.0, 2.0]), np.array([0.4, 0.6])], {},
+      ref=lambda x, k, p: _ss.nbinom.pmf(x, k[:, None], p[:, None]),
+      grad=True, grad_nodes=["v1", "v2"]),
+    S([_PDF_K, np.array([3.0, 2.0]), np.array([0.4, 0.6])], {"is_log": True},
+      ref=lambda x, k, p: _ss.nbinom.logpmf(x, k[:, None], p[:, None])),
+]
+SPECS["_random_pdf_generalized_negative_binomial"] = [
+    S([_PDF_K, np.array([2.0, 3.0]), np.array([0.5, 0.25])], {},
+      ref=lambda x, mu, a: _ss.nbinom.pmf(
+          x, 1.0 / a[:, None], 1.0 / (mu * a + 1.0)[:, None]),
+      grad=True, grad_nodes=["v1", "v2"]),
+    S([_PDF_K, np.array([2.0, 3.0]), np.array([0.5, 0.25])],
+      {"is_log": True},
+      ref=lambda x, mu, a: _ss.nbinom.logpmf(
+          x, 1.0 / a[:, None], 1.0 / (mu * a + 1.0)[:, None])),
+]
+
+
+def _dirichlet_ref(x, a, log=False):
+    out = np.empty(x.shape[:-1])
+    for i in range(x.shape[0]):
+        for j in range(x.shape[1]):
+            out[i, j] = _ss.dirichlet.logpdf(
+                x[i, j] / x[i, j].sum(), a[i])
+    return out if log else np.exp(out)
+
+
+_DIR_A = np.array([[1.5, 2.0, 1.0], [2.5, 1.0, 3.0]])
+_DIR_X = np.abs(np.random.RandomState(5).randn(2, 4, 3)) + 0.1
+_DIR_X = _DIR_X / _DIR_X.sum(-1, keepdims=True)
+SPECS["_random_pdf_dirichlet"] = [
+    S([_DIR_X, _DIR_A], {}, ref=lambda x, a: _dirichlet_ref(x, a),
+      grad=True),
+    S([_DIR_X, _DIR_A], {"is_log": True},
+      ref=lambda x, a: _dirichlet_ref(x, a, log=True)),
+]
 SPECS["_sample_uniform"] = S(
     [np.array([0.0, 5.0], np.float32), np.array([1.0, 6.0], np.float32)],
     {"shape": (40,)}, check=_stat(0.0, 6.0))
