@@ -50,7 +50,7 @@ class NDArray:
     __slots__ = (
         "_data", "_ctx", "_var",
         "_marked", "_grad", "_grad_req", "_grad_gen", "_fresh_grad",
-        "_grad_owner",
+        "_grad_owner", "_dlpack_mirror",
         "_tape_node", "_tape_index",
         "__weakref__",
     )
@@ -73,6 +73,7 @@ class NDArray:
         self._marked = False
         self._grad = None
         self._grad_owner = None
+        self._dlpack_mirror = None
         self._grad_req = "write"
         self._grad_gen = -1
         self._fresh_grad = False
@@ -84,6 +85,8 @@ class NDArray:
     # ------------------------------------------------------------------
     def data(self):
         """The raw jax.Array (framework-internal)."""
+        if self._dlpack_mirror is not None:
+            self._sync_dlpack_write()
         return self._data
 
     def _set_data(self, new_data):
@@ -136,11 +139,15 @@ class NDArray:
     # ------------------------------------------------------------------
     def wait_to_read(self):
         self._var.rethrow()
+        if self._dlpack_mirror is not None:
+            self._sync_dlpack_write()
         self._data.block_until_ready()
         return self
 
     def asnumpy(self):
         self._var.rethrow()
+        if self._dlpack_mirror is not None:
+            self._sync_dlpack_write()
         return _np.asarray(self._data)
 
     def __array__(self, dtype=None, copy=None):
@@ -280,8 +287,55 @@ class NDArray:
     def as_nd_ndarray(self):
         return self
 
+    # ------------------------------------------------------------------
+    # DLPack interchange (reference ndarray.py:2825-2893 to_dlpack_for_read/
+    # to_dlpack_for_write/from_dlpack).  Zero-copy when the PJRT backend
+    # exports external references (CPU; real TPU buffers); the axon tunnel
+    # plugin does not, so export falls back to a host copy there.
+    # ------------------------------------------------------------------
+    def _dlpack_source(self):
+        """The object whose ``__dlpack__`` we export: the device buffer when
+        the backend supports external references, else a host copy."""
+        self._var.rethrow()
+        if self._dlpack_mirror is not None:
+            return self._dlpack_mirror
+        try:
+            self._data.__dlpack_device__()
+            return self._data
+        except Exception:  # PJRT_Buffer_*ExternalReference unimplemented
+            # np.array (not asarray): device_get hands back a READONLY host
+            # view, which numpy refuses to export over DLPack
+            return _np.array(self._data)
+
+    def __dlpack__(self, **kwargs):
+        return self._dlpack_source().__dlpack__(**kwargs)
+
+    def __dlpack_device__(self):
+        return self._dlpack_source().__dlpack_device__()
+
     def to_dlpack_for_read(self):
-        return jax.dlpack.to_dlpack(self._data)  # pragma: no cover
+        """Legacy-capsule export; no writes allowed through the capsule."""
+        return self._dlpack_source().__dlpack__()
+
+    def to_dlpack_for_write(self):
+        """Writable export: a host mirror that this array re-adopts at its
+        next read sync point (``data()``/``asnumpy()``/``wait_to_read()``).
+
+        XLA buffers are immutable, so the reference's write-through alias
+        (engine WaitForWrite ordering) cannot exist; the documented
+        TPU-native contract is: external writes through the capsule are
+        visible after the next read-side sync, and the capsule must not be
+        written after that.
+        """
+        self._var.rethrow()
+        if self._dlpack_mirror is None:
+            self._dlpack_mirror = _np.array(self._data)  # writable host copy
+        self._var.on_write()
+        return self._dlpack_mirror.__dlpack__()
+
+    def _sync_dlpack_write(self):
+        m, self._dlpack_mirror = self._dlpack_mirror, None
+        self._set_data(jax.device_put(m, self._ctx.jax_device))
 
     def tostype(self, stype):
         if stype != "default":
@@ -603,6 +657,50 @@ class NDArray:
 
 
 NDArray._op_result_cls = NDArray
+
+
+class _CapsuleHolder:
+    """Adapts a legacy DLPack capsule to the array-protocol consumers
+    (np.from_dlpack / jax.dlpack.from_dlpack want ``__dlpack__`` objects)."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, **kwargs):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (1, 0)  # kDLCPU; legacy capsules carry no device metadata
+
+
+def from_dlpack(obj):
+    """Build an NDArray from a DLPack-capable object or legacy capsule.
+
+    Parity: reference ``ndarray.py:2878-2893`` (``from_dlpack``).  Zero-copy
+    where the producing/consuming backends share memory space (CPU);
+    otherwise the backend copies on import.
+    """
+    if isinstance(obj, NDArray):
+        return obj
+    if not hasattr(obj, "__dlpack__"):
+        obj = _CapsuleHolder(obj)  # legacy PyCapsule form
+    try:
+        data = jax.dlpack.from_dlpack(obj)
+    except Exception:
+        data = jnp.asarray(_np.from_dlpack(obj))
+    return NDArray(data)
+
+
+def to_dlpack_for_read(data):
+    """Module-level form of ``NDArray.to_dlpack_for_read`` (reference
+    ``ndarray.py:2825``)."""
+    return data.to_dlpack_for_read()
+
+
+def to_dlpack_for_write(data):
+    """Module-level form of ``NDArray.to_dlpack_for_write`` (reference
+    ``ndarray.py:2851``)."""
+    return data.to_dlpack_for_write()
 
 
 def _as_nd(x, ctx=None):

@@ -1,0 +1,96 @@
+"""DLPack interchange: mx ⇄ numpy / torch / jax.
+
+Parity: reference ``python/mxnet/ndarray/ndarray.py:2825-2893``
+(``to_dlpack_for_read``/``to_dlpack_for_write``/``from_dlpack``) and
+``tests/python/unittest/test_ndarray.py`` dlpack round-trips.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_np_from_dlpack_mx():
+    a = nd.array(np.arange(6.0, dtype=np.float32).reshape(2, 3))
+    out = np.from_dlpack(a)
+    np.testing.assert_array_equal(out, a.asnumpy())
+
+
+def test_torch_consumes_mx_capsule_and_object():
+    torch = pytest.importorskip("torch")
+    a = nd.array(np.arange(4.0, dtype=np.float32))
+    t1 = torch.utils.dlpack.from_dlpack(nd.to_dlpack_for_read(a))
+    t2 = torch.utils.dlpack.from_dlpack(a)  # protocol-object form
+    np.testing.assert_array_equal(t1.numpy(), a.asnumpy())
+    np.testing.assert_array_equal(t2.numpy(), a.asnumpy())
+
+
+def test_from_dlpack_jax_and_numpy_objects():
+    x = jnp.arange(5.0)
+    a = nd.from_dlpack(x)
+    assert isinstance(a, nd.NDArray)
+    np.testing.assert_array_equal(a.asnumpy(), np.arange(5.0))
+
+    n = np.arange(4.0, dtype=np.float32)
+    b = nd.from_dlpack(n)
+    np.testing.assert_array_equal(b.asnumpy(), n)
+
+
+def test_from_dlpack_torch_object_and_capsule():
+    torch = pytest.importorskip("torch")
+    t = torch.arange(6).float().reshape(2, 3)
+    a = nd.from_dlpack(t)
+    np.testing.assert_array_equal(a.asnumpy(), t.numpy())
+    cap = torch.utils.dlpack.to_dlpack(torch.arange(3).float())
+    b = nd.from_dlpack(cap)
+    np.testing.assert_array_equal(b.asnumpy(), [0.0, 1.0, 2.0])
+
+
+def test_round_trip_mx_jax_mx():
+    a = nd.array(np.arange(8.0, dtype=np.float32))
+    j = jax.dlpack.from_dlpack(a)
+    b = nd.from_dlpack(j)
+    np.testing.assert_array_equal(b.asnumpy(), a.asnumpy())
+
+
+def test_zero_copy_on_cpu():
+    """CPU backend shares the buffer: consumer sees the same memory."""
+    torch = pytest.importorskip("torch")
+    a = nd.array(np.arange(4.0, dtype=np.float32))
+    a.wait_to_read()
+    t = torch.utils.dlpack.from_dlpack(a)
+    assert t.data_ptr() == a.data().unsafe_buffer_pointer()
+
+
+def test_to_dlpack_for_write_visible_after_sync():
+    torch = pytest.importorskip("torch")
+    a = nd.array(np.zeros(4, np.float32))
+    cap = a.to_dlpack_for_write()
+    t = torch.utils.dlpack.from_dlpack(cap)
+    t[:] = torch.tensor([1.0, 2.0, 3.0, 4.0])
+    # next read-side sync adopts the written mirror
+    np.testing.assert_array_equal(a.asnumpy(), [1.0, 2.0, 3.0, 4.0])
+    # and the array keeps working as a normal operand afterwards
+    np.testing.assert_array_equal((a + 1).asnumpy(), [2.0, 3.0, 4.0, 5.0])
+
+
+def test_write_mirror_sync_via_op_read():
+    torch = pytest.importorskip("torch")
+    a = nd.array(np.ones(3, np.float32))
+    t = torch.utils.dlpack.from_dlpack(a.to_dlpack_for_write())
+    t *= 5.0
+    s = nd.sum(a)  # op dispatch goes through data() -> sync
+    assert float(s.asscalar()) == 15.0
+
+
+def test_read_capsule_then_write_capsule_same_array():
+    torch = pytest.importorskip("torch")
+    a = nd.array(np.arange(3.0, dtype=np.float32))
+    r = torch.utils.dlpack.from_dlpack(a.to_dlpack_for_read())
+    w = torch.utils.dlpack.from_dlpack(a.to_dlpack_for_write())
+    w += 10.0
+    np.testing.assert_array_equal(a.asnumpy(), [10.0, 11.0, 12.0])
+    np.testing.assert_array_equal(r.numpy(), [0.0, 1.0, 2.0])  # snapshot
