@@ -123,10 +123,28 @@ def _honor_compile_cache():
     """
     import os
 
-    if os.environ.get("MXNET_COMPILE_CACHE", "1").lower() in ("0", "false"):
+    mode = os.environ.get("MXNET_COMPILE_CACHE", "auto").lower()
+    if mode in ("0", "false"):
         return
     try:
         import jax
+
+        if mode == "auto" and not os.environ.get("MXNET_COMPILE_CACHE_DIR"):
+            # default-on for ACCELERATOR processes only: XLA:CPU cache
+            # entries are AOT objects keyed without host machine features —
+            # an entry compiled elsewhere (e.g. through the device tunnel's
+            # cpu staging platform) can SIGILL a pure-CPU process that
+            # loads it (observed killing dist-kvstore servers).  CPU
+            # compiles are cheap; TPU compiles are the minutes-long ones
+            # worth persisting.  Set MXNET_COMPILE_CACHE=1 or an explicit
+            # _DIR to opt a CPU process in.
+            plats = str(getattr(jax.config, "jax_platforms", "") or "")
+            primary = plats.split(",")[0].strip() if plats else ""
+            # unknown/unset platform counts as CPU: a host with no
+            # accelerator plugin auto-selects cpu with an EMPTY config,
+            # and enabling the cache there reopens the AOT-SIGILL hazard
+            if primary in ("cpu", ""):
+                return
 
         cache_dir = os.environ.get("MXNET_COMPILE_CACHE_DIR")
         if not cache_dir:

@@ -20,6 +20,11 @@ TARGET_DTYPE_OPS = [
     "_npi_einsum",
     "_contrib_interleaved_matmul_selfatt_qk",
     "_contrib_interleaved_matmul_selfatt_valatt",
+    # flash attention: bf16 in/out is safe — the Pallas kernel upcasts
+    # per-block and accumulates softmax/output in f32 internally; f32
+    # inputs would double attention HBM traffic and halve MXU rate
+    # (xplane r5: f32[96,512,64] custom-calls before this entry)
+    "_contrib_flash_attention",
 ]
 
 # numerically-sensitive ops forced to float32
@@ -61,6 +66,10 @@ FP32_OPS = [
     "InstanceNorm",
     "LayerNorm",
     "GroupNorm",
+    # measured r5 (tools A/B, llama bench geometry, best-of-3 windows):
+    # norms IN this list run 7% faster end-to-end than bf16-in/bf16-out
+    # norms (131.7k vs 122.7k tok/s) — XLA fuses the f32 norm chain into
+    # the adjacent matmuls and skips a convert round trip
     "RMSNorm",
 ]
 

@@ -12,8 +12,6 @@ string-dispatch factory mirrors ``KVStore::Create`` (``kvstore.cc:40-77``).
 """
 from __future__ import annotations
 
-import pickle
-
 import jax.numpy as jnp
 
 from ..base import MXNetError

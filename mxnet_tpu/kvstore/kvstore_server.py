@@ -2,8 +2,9 @@
 
 A process launched with ``DMLC_ROLE=server`` calls ``KVStoreServer.run()``
 (or just imports mxnet_tpu and calls ``serve_if_server()``, which
-tools/launch.py arranges) and blocks serving pushes/pulls until a worker
-sends STOP.
+tools/launch.py arranges) and blocks serving pushes/pulls until every
+distinct worker rank has sent STOP (ps-lite Finalize semantics; the
+launcher additionally terminates servers if a worker dies without one).
 """
 from __future__ import annotations
 

@@ -31,6 +31,42 @@ class LRScheduler:
             return self.warmup_begin_lr
         raise MXNetError("invalid warmup_mode %r" % self.warmup_mode)
 
+    def _traced_warmup(self, t):
+        import jax.numpy as jnp
+
+        if self.warmup_mode == "constant" or self.warmup_steps == 0:
+            return jnp.float32(self.warmup_begin_lr)
+        return jnp.float32(self.warmup_begin_lr) + (
+            (self.warmup_final_lr - self.warmup_begin_lr)
+            * t.astype(jnp.float32) / self.warmup_steps)
+
+    def _with_warmup(self, t, lr):
+        import jax.numpy as jnp
+
+        if self.warmup_steps <= 0:
+            return lr
+        return jnp.where(t < self.warmup_steps, self._traced_warmup(t), lr)
+
+    def traced(self, t):
+        """lr as a pure jnp function of a TRACED update count.
+
+        The device-side n-step training loop (``JitTrainStep.step_n``)
+        evaluates the schedule inside ``lax.fori_loop`` — every update sees
+        its scheduled lr without per-step host dispatch.  Subclasses without
+        a pure form return None and step_n falls back to per-step dispatch.
+        """
+        return None
+
+    _anchor = None
+
+    def _ensure_anchor(self):
+        # the pre-decay base lr for stateful schedulers: captured at first
+        # use, AFTER the optimizer has adopted its learning_rate into
+        # base_lr (reference semantics: the eager path decays base_lr in
+        # place)
+        if self._anchor is None:
+            self._anchor = self.base_lr
+
     def __call__(self, num_update):  # pragma: no cover - abstract
         raise NotImplementedError
 
@@ -51,6 +87,7 @@ class FactorScheduler(LRScheduler):
         self.count = 0
 
     def __call__(self, num_update):
+        self._ensure_anchor()
         if num_update < self.warmup_steps:
             return self.get_warmup_lr(num_update)
         while num_update > self.count + self.step:
@@ -59,6 +96,14 @@ class FactorScheduler(LRScheduler):
             if self.base_lr < self.stop_factor_lr:
                 self.base_lr = self.stop_factor_lr
         return self.base_lr
+
+    def traced(self, t):
+        import jax.numpy as jnp
+
+        self._ensure_anchor()
+        k = jnp.maximum(0, (t - 1) // self.step)
+        lr = self._anchor * jnp.float32(self.factor) ** k
+        return self._with_warmup(t, jnp.maximum(lr, self.stop_factor_lr))
 
 
 class MultiFactorScheduler(LRScheduler):
@@ -78,7 +123,16 @@ class MultiFactorScheduler(LRScheduler):
         self.factor = factor
         self.count = 0
 
+    def traced(self, t):
+        import jax.numpy as jnp
+
+        self._ensure_anchor()
+        k = jnp.sum(t > jnp.asarray(self.step, jnp.int32))
+        lr = self._anchor * jnp.float32(self.factor) ** k
+        return self._with_warmup(t, lr)
+
     def __call__(self, num_update):
+        self._ensure_anchor()
         if num_update < self.warmup_steps:
             return self.get_warmup_lr(num_update)
         while self.cur_step_ind <= len(self.step) - 1:
@@ -115,6 +169,15 @@ class PolyScheduler(LRScheduler):
                       float(self.max_steps), self.power)
         return self.base_lr
 
+    def traced(self, t):
+        import jax.numpy as jnp
+
+        tt = jnp.minimum(t, self.max_update).astype(jnp.float32)
+        frac = 1.0 - (tt - self.warmup_steps) / float(self.max_steps)
+        lr = self.final_lr + (self.base_lr_orig - self.final_lr) \
+            * frac ** self.power
+        return self._with_warmup(t, lr)
+
 
 class CosineScheduler(LRScheduler):
     """Cosine decay (parity: CosineScheduler)."""
@@ -139,3 +202,12 @@ class CosineScheduler(LRScheduler):
                     math.pi * (num_update - self.warmup_steps)
                     / self.max_steps)) / 2
         return self.base_lr
+
+    def traced(self, t):
+        import jax.numpy as jnp
+
+        tt = jnp.minimum(t, self.max_update).astype(jnp.float32)
+        lr = self.final_lr + (self.base_lr_orig - self.final_lr) * (
+            1.0 + jnp.cos(jnp.pi * (tt - self.warmup_steps)
+                          / float(self.max_steps))) / 2.0
+        return self._with_warmup(t, lr)

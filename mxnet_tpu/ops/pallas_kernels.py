@@ -354,7 +354,7 @@ def _tiles(t, preferred):
     block that divides t but breaks the sublane rule is skipped in
     favor of the next conforming candidate rather than forcing the
     O(T^2) reference fallback."""
-    for b in (preferred, 128, 64, 32, 16, 8):
+    for b in (preferred, 512, 256, 128, 64, 32, 16, 8):
         if b <= t and t % b == 0 and (b == t or b % 8 == 0):
             return b
     return None
@@ -362,7 +362,7 @@ def _tiles(t, preferred):
 
 @register("_contrib_flash_attention", inputs=("query", "key", "value"))
 def flash_attention(query, key, value, scale=None, causal=False,
-                    block_q=128, block_k=128):
+                    block_q=None, block_k=None):
     """Fused multi-head attention, one Pallas kernel per (batch·head).
 
     Inputs (B, H, T, D) [or (BH, T, D)]; returns same shape.  Scores are
@@ -370,6 +370,12 @@ def flash_attention(query, key, value, scale=None, causal=False,
     1/sqrt(D).  Falls back to plain XLA attention when T doesn't tile.
     Differentiable end-to-end via the blocked flash backward (no (T, T)
     buffer in forward or backward).
+
+    ``block_q``/``block_k`` default to ``min(T, 512)`` — tuned on v5e
+    (tools/llama_ceiling.py block sweep: 512/512 runs the seq-512 llama
+    bench 1.5x faster than 128/128; the VMEM footprint per block at
+    d<=128 stays under ~1MB so large blocks are safe), while 1024+
+    regresses (VMEM pressure starts serializing the pipeline).
     """
     squeeze = query.ndim == 3
     if squeeze:
@@ -382,12 +388,23 @@ def flash_attention(query, key, value, scale=None, causal=False,
     q3 = query.reshape(b * h, t_q, d)
     k3 = key.reshape(b * h, t_kv, d)
     v3 = value.reshape(b * h, t_kv, d)
-    bq = _tiles(t_q, int(block_q))
-    bk = _tiles(t_kv, int(block_k))
+    # short sequences: XLA's fused attention beats the kernel (v5e A/B:
+    # BERT seq-128 994 vs 825 samples/s) and the (T,T) buffer is small;
+    # the Pallas path earns its keep from T>=512 (llama seq-512: 132k vs
+    # 112k tok/s).  Explicit block sizes force the kernel (tests, tuning).
+    if block_q is None and block_k is None and t_q < 512 and t_kv < 512:
+        return _finish(_attention_ref(q3, k3, v3, scale, causal),
+                       b, h, t_q, d, squeeze)
+    bq = _tiles(t_q, int(block_q) if block_q else min(t_q, 512))
+    bk = _tiles(t_kv, int(block_k) if block_k else min(t_kv, 512))
     if bq is None or bk is None:
         out3 = _attention_ref(q3, k3, v3, scale, causal)
     else:
         out3 = _flash_attention(q3, k3, v3, float(scale), bool(causal),
                                 bq, bk)
+    return _finish(out3, b, h, t_q, d, squeeze)
+
+
+def _finish(out3, b, h, t_q, d, squeeze):
     out = out3.reshape(b, h, t_q, d)
     return out[:, 0] if squeeze else out

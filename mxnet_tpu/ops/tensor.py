@@ -149,8 +149,19 @@ register("stop_gradient", aliases=("BlockGrad", "make_loss_grad_stop"))(
     lambda data: lax.stop_gradient(data)
 )
 register("make_loss")(lambda data: data)
-register("shape_array")(lambda data: jnp.asarray(data.shape, dtype=jnp.int64))
-register("size_array")(lambda data: jnp.asarray([data.size], dtype=jnp.int64))
+# int64 per the reference ABI when 64-bit index math is on
+# (MXNET_INT64_TENSOR_SIZE=1 -> x64); int32 otherwise — asking jnp for
+# int64 with x64 off just truncates with a UserWarning on every call
+def _index_dtype():
+    import jax
+
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+
+register("shape_array")(
+    lambda data: jnp.asarray(data.shape, dtype=_index_dtype()))
+register("size_array")(
+    lambda data: jnp.asarray([data.size], dtype=_index_dtype()))
 
 # ----------------------------------------------------------------------------
 # casts

@@ -38,6 +38,8 @@ class Optimizer:
         self.lr = learning_rate
         self.lr_scheduler = lr_scheduler
         if lr_scheduler is not None:
+            # reference parity: only base_lr is adopted — Poly/Cosine
+            # deliberately keep their construction-time anchor
             self.lr_scheduler.base_lr = learning_rate
         self.wd = wd
         self.clip_gradient = clip_gradient

@@ -420,6 +420,9 @@ class DistServer:
         self._barrier_gen = 0
         self._barrier_cv = threading.Condition()
         self._stop = threading.Event()
+        self._stop_count = 0
+        self._stopped_ranks = set()
+        self._stop_lock = threading.Lock()
 
     def _key(self, k):
         with self._keys_lock:
@@ -428,16 +431,33 @@ class DistServer:
                 st = self._keys[k] = _KeyState()
             return st
 
+    # Dense server state is HOST numpy: the server is a host process doing
+    # memcpy/accumulate — wrapping values in NDArray forced a device_put on
+    # every push and an asnumpy on every pull (64MB copies each way; the
+    # round-4 wire profile showed these, not framing, were the gap to the
+    # raw-loopback floor).  The server-side-optimizer path still runs on
+    # NDArray (it computes real updates).
+
+    @staticmethod
+    def _as_server_nd(v):
+        return v if isinstance(v, (NDArray, _sp.RowSparseNDArray)) \
+            else NDArray(v)
+
     def _apply(self, st, key, merged):
         if self._updater is not None:
             idx = int(key) if str(key).isdigit() else key
-            self._updater(idx, merged, st.value)
+            st.value = self._as_server_nd(st.value)
+            self._updater(idx, self._as_server_nd(merged), st.value)
+        elif isinstance(merged, _sp.RowSparseNDArray):
+            base = self._as_server_nd(st.value)
+            base._set_data(merged.scatter_add_into(base.data() * 0))
+            st.value = base
+        elif isinstance(st.value, np.ndarray):
+            st.value = np.asarray(merged, dtype=st.value.dtype)
         else:
-            if isinstance(merged, _sp.RowSparseNDArray):
-                st.value._set_data(merged.scatter_add_into(
-                    st.value.data() * 0))
-            else:
-                st.value._set_data(merged.data().astype(st.value.dtype))
+            import jax.numpy as jnp
+
+            st.value._set_data(jnp.asarray(merged, dtype=st.value.dtype))
 
     def _merge(self, pushes):
         first = pushes[0]
@@ -446,10 +466,14 @@ class DistServer:
             for p in pushes[1:]:
                 acc = acc + p
             return acc.compact()
-        acc = pushes[0].data()
-        for p in pushes[1:]:
-            acc = acc + p.data()
-        return NDArray(acc)
+        if len(pushes) == 1:
+            return first
+        # out-of-place first add (the recv buffer aliases push[0]),
+        # in-place accumulation after
+        acc = pushes[0] + pushes[1]
+        for p in pushes[2:]:
+            np.add(acc, p, out=acc)
+        return acc
 
     @staticmethod
     def _prof_now():
@@ -488,7 +512,7 @@ class DistServer:
                     st = self._key(key)
                     with st.lock:
                         if st.value is None:
-                            st.value = NDArray(np.asarray(value))
+                            st.value = np.asarray(value)
                     _send(sock, CMD_OK)
                 elif cmd == CMD_PUSH:
                     t0 = self._prof_now()
@@ -501,14 +525,17 @@ class DistServer:
                     (key,) = f
                     st = self._key(key)
                     with st.lock:
-                        val = st.value.asnumpy()
+                        val = st.value if isinstance(st.value, np.ndarray) \
+                            else st.value.asnumpy()
                     _send(sock, CMD_OK, val)
                     self._prof_span("KVStoreServer::pull", t0)
                 elif cmd == CMD_ROW_SPARSE_PULL:
                     key, row_ids = f
                     st = self._key(key)
                     with st.lock:
-                        rows = st.value.asnumpy()[np.asarray(row_ids)]
+                        base = st.value if isinstance(st.value, np.ndarray) \
+                            else st.value.asnumpy()
+                        rows = base[np.asarray(row_ids)]
                     _send(sock, CMD_OK, rows)
                 elif cmd == CMD_BARRIER:
                     self._do_barrier()
@@ -554,7 +581,25 @@ class DistServer:
                               "profiler %s failed: %s" % (action, pe))
                 elif cmd == CMD_STOP:
                     _send(sock, CMD_OK)
-                    self._stop.set()
+                    # the server dies only when EVERY distinct worker
+                    # rank said stop (ps-lite Finalize semantics): under
+                    # load, worker finish times skew by many seconds —
+                    # the first finisher must not kill the service under
+                    # the rest.  Duplicate stops from one rank (retry,
+                    # second DistKVStore instance) don't count twice; a
+                    # rankless STOP (legacy frame) falls back to a
+                    # counter.
+                    with self._stop_lock:
+                        if f:
+                            self._stopped_ranks.add(str(f[0]))
+                            done = len(self._stopped_ranks) \
+                                >= self._num_workers
+                        else:
+                            self._stop_count += 1
+                            done = self._stop_count >= self._num_workers
+                        if done:
+                            self._stop.set()
+                    return
                 else:
                     _send(sock, CMD_ERR, "unknown command %r" % (cmd,))
         except (ConnectionError, OSError):
@@ -573,14 +618,14 @@ class DistServer:
     @staticmethod
     def _decode(kind, fields):
         if kind == "dense":
-            return NDArray(fields[0])
+            return fields[0]  # host numpy; stays host-side on the server
         if kind == "rsp":
             vals, idx, shape = fields
             return _sp.RowSparseNDArray(np.asarray(vals), np.asarray(idx),
                                         tuple(int(d) for d in shape))
         if kind == "2bit":
             codes, threshold = fields
-            return NDArray(codes.astype(np.float32) * threshold)
+            return codes.astype(np.float32) * threshold
         raise MXNetError("bad payload kind %r" % (kind,))
 
     def _do_push(self, key, value):
@@ -682,9 +727,27 @@ class DistKVStore(KVStoreBase):
         with self._lock:
             s = self._socks.get(server_id)
             if s is None:
-                s = socket.create_connection(
-                    (self._root, _server_port(self._root_port, server_id)),
-                    timeout=60)
+                addr = (self._root,
+                        _server_port(self._root_port, server_id))
+                # retry refused connects: at job start the server process
+                # may still be importing/binding (ps-lite retries the van
+                # connect the same way).  The connect phase gets its OWN
+                # short deadline — the wire-read timeout is sized for
+                # sync-round reads waiting on slow compiles (30min); a dead
+                # or misaddressed server must fail in seconds, not that
+                import time as _time
+
+                deadline = _time.monotonic() + min(
+                    _wire_timeout() or 60, 60)
+                while True:
+                    try:
+                        s = socket.create_connection(addr, timeout=60)
+                        break
+                    except (ConnectionRefusedError, socket.timeout,
+                            OSError):
+                        if _time.monotonic() >= deadline:
+                            raise
+                        _time.sleep(0.2)
                 _tune_socket(s)
                 # every later read inherits the wire deadline: a wedged
                 # server raises a diagnosable MXNetError instead of
@@ -873,11 +936,14 @@ class DistKVStore(KVStoreBase):
         raise MXNetError("server-side optimizer states live on the server")
 
     def stop(self):
-        for sid in list(self._socks):
+        # EVERY server shard gets this worker's stop (even ones this
+        # worker never pushed to): the server quits once each distinct
+        # rank has said goodbye
+        for sid in range(self._num_servers):
             try:
-                s = self._socks[sid]
+                s = self._sock(sid)
                 with self._lock:
-                    _send(s, CMD_STOP)
+                    _send(s, CMD_STOP, str(self._rank))
                     _recv(s)
                 s.close()
             except OSError:

@@ -263,16 +263,24 @@ class JitTrainStep:
         """
         from jax import lax
 
-        if getattr(self._opt, "lr_scheduler", None) is not None:
-            # the scheduler is arbitrary Python of the update count and
-            # cannot be traced per loop iteration; fall back to per-step
-            # dispatch so every update sees its scheduled lr
+        sched = getattr(self._opt, "lr_scheduler", None)
+        sched_traced = None
+        if sched is not None:
+            try:
+                sched_traced = sched.traced(jnp.asarray(1, jnp.int32))
+            except Exception:
+                sched_traced = None
+            sched_traced = sched.traced if sched_traced is not None else None
+        if sched is not None and sched_traced is None:
+            # custom scheduler without a pure jnp form: fall back to
+            # per-step dispatch so every update sees its scheduled lr
             import warnings
 
             warnings.warn(
-                "step_n: lr_scheduler set -> falling back to per-step "
-                "dispatch (device-side loop cannot trace the scheduler); "
-                "expect per-step host latency", stacklevel=2)
+                "step_n: lr_scheduler has no traced() pure form -> "
+                "falling back to per-step dispatch; subclass "
+                "LRScheduler.traced to keep the device-side loop",
+                stacklevel=2)
             loss = None
             for _ in range(int(n)):
                 loss = self.step(*batch)
@@ -285,7 +293,12 @@ class JitTrainStep:
             self._step_fn = self._build(arrays)
         if not hasattr(self, "_step_n_cache"):
             self._step_n_cache = {}
-        fn = self._step_n_cache.get(n)
+        # keyed on the scheduler OBJECT too: swapping in a different
+        # scheduler must not reuse a loop that closed over the old one
+        # (mutating a scheduler's fields in place after the first step_n
+        # still won't retrace — schedules are constants of the executable)
+        sched_key = (n, id(sched) if sched_traced is not None else None)
+        fn = self._step_n_cache.get(sched_key)
         if fn is None:
             raw = self._raw_step
 
@@ -296,7 +309,10 @@ class JitTrainStep:
                     # update number t+i+1 (step() uses 1-based counts —
                     # Adam's bias correction divides by 1-beta^t, so a
                     # 0-based counter would produce 0/0 on step one)
-                    nw, ns, loss = raw(jax.random.fold_in(key, i), lr,
+                    # scheduled lr is evaluated device-side per iteration
+                    lr_i = (sched_traced(t + i + 1).astype(jnp.float32)
+                            if sched_traced is not None else lr)
+                    nw, ns, loss = raw(jax.random.fold_in(key, i), lr_i,
                                        w, s, t + i + 1, *arrs)
                     return (nw, ns, loss.astype(jnp.float32))
 
@@ -308,7 +324,7 @@ class JitTrainStep:
             if self._mesh is not None:
                 jit_kwargs["out_shardings"] = self._out_shardings()
             fn = jax.jit(loop, donate_argnums=(2, 3), **jit_kwargs)
-            self._step_n_cache[n] = fn
+            self._step_n_cache[sched_key] = fn
         self._opt.num_update = self._t + n
         self._weights, self._opt_state, loss = fn(
             _random.next_key(),

@@ -23,9 +23,36 @@ from mxnet_tpu.test_utils import assert_almost_equal
 _PORT_SEQ = [21310]
 
 
+def _probe_free(root_port, num_servers):
+    import socket as _socket
+
+    for sid in range(num_servers):
+        s = _socket.socket()
+        try:
+            s.bind(("", _server_port(root_port, sid)))
+        except OSError:
+            return False
+        finally:
+            s.close()
+    return True
+
+
 def _start_cluster(num_workers, sync=True, num_servers=1):
-    _PORT_SEQ[0] += 10
-    root_port = _PORT_SEQ[0]
+    # probe the whole port range first: in-thread servers now live until
+    # EVERY rank stops (ps-lite Finalize), so a sequence-allocated port
+    # can collide with a stale listener from a test that stopped fewer
+    # ranks — workers would then talk to a server with the wrong
+    # num_workers and hang a sync round
+    import random
+
+    for _ in range(50):
+        _PORT_SEQ[0] += 10
+        root_port = _PORT_SEQ[0]
+        if _probe_free(root_port, num_servers):
+            break
+        _PORT_SEQ[0] += random.randint(10, 200)
+    else:
+        raise RuntimeError("no free port range found")
     servers = []
     for sid in range(num_servers):
         srv = DistServer(_server_port(root_port, sid), num_workers,
@@ -70,7 +97,8 @@ def test_dist_sync_exact_aggregation():
     for r in range(n):
         assert results[r] is not None, "worker %d hung" % r
         assert_almost_equal(results[r], expect)
-    kvs[0].stop()
+    for _kv in kvs:
+        _kv.stop()
 
 
 def test_dist_async_immediate_apply():
@@ -108,7 +136,8 @@ def test_dist_sparse_push_and_row_sparse_pull():
     expect[0] = 1.0
     expect[2] = 2.0
     assert_almost_equal(out.asnumpy(), expect)
-    kvs[0].stop()
+    for _kv in kvs:
+        _kv.stop()
 
 
 def test_dist_server_side_optimizer():
@@ -246,7 +275,8 @@ def test_dist_two_servers_key_sharding():
         for key in ("alpha", "beta", "7"):
             assert_almost_equal(results[r][key],
                                 np.full((2,), 3.0, np.float32))
-    kvs[0].stop()
+    for _kv in kvs:
+        _kv.stop()
 
 
 # ---------------------------------------------------------------------------
@@ -358,7 +388,8 @@ def test_auth_handshake_and_rejection(monkeypatch):
         assert cmd == dk.CMD_ERR
     finally:
         raw.close()
-        kvs[0].stop()
+        for _kv in kvs:
+            _kv.stop()
 
 
 def test_optimizer_config_round_trip():

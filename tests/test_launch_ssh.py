@@ -1,0 +1,170 @@
+"""tools/launch.py ssh/mpi launchers (parity: reference tools/launch.py:28-50
++ dmlc_tracker ssh.py), faked locally: the ssh binary is a stub that strips
+the job environment and runs the remote command line on this machine — so a
+pass proves the launcher carries the whole DMLC_*/secret contract inside the
+generated remote command, not via process inheritance.
+"""
+import json
+import os
+import shlex
+import stat
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.launch import launch, _remote_command, _read_hostfile  # noqa: E402
+
+_FAKESSH = """#!%(py)s
+import os, subprocess, sys
+host = sys.argv[1]
+# the launcher invokes `ssh host /bin/sh -s` and pipes the command line
+# (with the secret) over STDIN — argv must NOT contain the job contract
+assert sys.argv[2] == "/bin/sh -s", sys.argv
+assert not any("MXNET_KVSTORE_SECRET" in a for a in sys.argv)
+with open(%(log)r, "a") as f:
+    f.write(host + "\\n")
+# strip the job contract from the inherited env: the remote script
+# must re-create it via its own exports (real ssh starts a fresh env)
+env = {k: v for k, v in os.environ.items()
+       if not k.startswith(("DMLC_", "MXNET_"))}
+sys.exit(subprocess.call(["/bin/sh", "-s"], env=env))
+"""
+
+_WORKER = r"""
+import json, os
+import numpy as np
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+kv = mx.kvstore.create(os.environ["MXNET_KVSTORE_MODE"])
+rank, n = kv.rank, kv.num_workers
+rs = np.random.RandomState(100 + rank)
+y = rs.randint(0, 2, 64).astype(np.float32)
+x = (rs.randn(64, 8) * 0.5 + (y[:, None] * 2 - 1)).astype(np.float32)
+mx.random.seed(0)
+net = gluon.nn.Dense(2)
+net.initialize(mx.init.Xavier())
+net(nd.array(x[:2]))
+trainer = gluon.Trainer(net.collect_params(), "sgd",
+                        {"learning_rate": 0.1}, kvstore=kv)
+loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+first = last = None
+for epoch in range(4):
+    with autograd.record():
+        loss = loss_fn(net(nd.array(x)), nd.array(y)).mean()
+    loss.backward()
+    trainer.step(64)
+    if first is None:
+        first = float(loss.asnumpy())
+    last = float(loss.asnumpy())
+ws = np.concatenate([p.data().asnumpy().ravel()
+                     for p in net.collect_params().values()])
+json.dump({"rank": rank, "first": first, "last": last,
+           "wsum": float(np.abs(ws).sum())},
+          open(os.environ["DIST_TEST_OUT"] + ".%d" % rank, "w"))
+kv.stop()
+"""
+
+
+def _make_fakessh(tmp_path):
+    log = str(tmp_path / "ssh_hosts.log")
+    path = tmp_path / "fakessh"
+    path.write_text(_FAKESSH % {"py": sys.executable, "log": log})
+    path.chmod(path.stat().st_mode | stat.S_IEXEC)
+    return str(path), log
+
+
+def test_launch_ssh_two_host_training(tmp_path):
+    """2-'host' dist_sync training started via --launcher ssh."""
+    fakessh, log = _make_fakessh(tmp_path)
+    out_base = str(tmp_path / "out")
+    rc = launch(
+        2, 1, [sys.executable, "-c", _WORKER], kv_store="dist_sync",
+        launcher="ssh", hosts=["host_a", "host_b"], ssh_bin=fakessh,
+        root_uri="127.0.0.1", workdir=REPO,
+        env_names=("DIST_TEST_OUT",),
+        env_extra={"JAX_PLATFORMS": "cpu", "DIST_TEST_OUT": out_base})
+    assert rc == 0
+    hosts = open(log).read().split()
+    assert sorted(hosts) == ["host_a", "host_b"]  # round-robin placement
+    outs = [json.load(open(out_base + ".%d" % r)) for r in (0, 1)]
+    for o in outs:
+        assert o["last"] < o["first"]  # trained through the ssh'd contract
+    assert abs(outs[0]["wsum"] - outs[1]["wsum"]) < 1e-5  # sync replicas
+
+
+def test_launch_ssh_requires_hosts():
+    with pytest.raises(ValueError):
+        launch(1, 1, ["true"], launcher="ssh", hosts=None)
+
+
+def test_remote_command_exports_and_quoting():
+    env = {"DMLC_PS_ROOT_URI": "10.0.0.1",
+           "MXNET_KVSTORE_SECRET": "s3cr3t with space",
+           "UNRELATED": "nope"}
+    line = _remote_command(env, ["python", "train.py", "--lr", "0.1"],
+                           "/work dir",
+                           ("DMLC_PS_ROOT_URI", "MXNET_KVSTORE_SECRET"))
+    assert "export DMLC_PS_ROOT_URI=10.0.0.1" in line
+    assert shlex.quote("s3cr3t with space") in line
+    assert "UNRELATED" not in line
+    assert "cd '/work dir'" in line
+    assert line.endswith("python train.py --lr 0.1")
+
+
+def test_read_hostfile(tmp_path):
+    hf = tmp_path / "hosts"
+    hf.write_text("# comment\nhost_a slots=2\n\nhost_b\n")
+    assert _read_hostfile(str(hf)) == ["host_a", "host_b"]
+    empty = tmp_path / "empty"
+    empty.write_text("\n")
+    with pytest.raises(ValueError):
+        _read_hostfile(str(empty))
+
+
+_FAKEMPIRUN = """#!%(py)s
+import os, subprocess, sys
+args = sys.argv[1:]
+env = dict(os.environ)
+cmd = []
+i = 0
+while i < len(args):
+    if args[i] == "-x":
+        k, _, v = args[i + 1].partition("=")
+        env[k] = v
+        i += 2
+    elif args[i] in ("-n",):
+        i += 2
+    else:
+        cmd.append(args[i]); i += 1
+sys.exit(subprocess.call(cmd, env=env))
+"""
+
+
+def test_launch_mpi_env_forwarding(tmp_path, monkeypatch):
+    """mpirun invocations carry the contract via -x (stubbed mpirun)."""
+    fake = tmp_path / "mpirun"
+    fake.write_text(_FAKEMPIRUN % {"py": sys.executable})
+    fake.chmod(fake.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", str(tmp_path) + os.pathsep + os.environ["PATH"])
+
+    out = str(tmp_path / "envdump")
+    probe = ("import json,os;json.dump({k:v for k,v in os.environ.items() "
+             "if k.startswith(('DMLC_','MXNET_'))}, "
+             "open(%r + '.' + os.environ['DMLC_RANK'],'w'))" % out)
+    rc = launch(2, 0, [sys.executable, "-c", probe], launcher="mpi",
+                env_extra={"JAX_PLATFORMS": "cpu"})
+    assert rc == 0
+    for r in (0, 1):
+        env = json.load(open(out + ".%d" % r))
+        assert env["DMLC_ROLE"] == "worker"
+        assert env["DMLC_RANK"] == str(r)
+        assert env["DMLC_NUM_WORKER"] == "2"
+        assert env["MXNET_KVSTORE_SECRET"]

@@ -1,18 +1,39 @@
 #!/usr/bin/env python
-"""Local cluster launcher (parity: reference tools/launch.py:28 with the
-dmlc "local" tracker).
+"""Cluster launcher (parity: reference tools/launch.py:28-50 + the dmlc
+tracker backends local/ssh/mpi).
 
-Spawns S server processes and N worker processes on this machine with the
-reference's DMLC_* environment contract, runs the given command in each
-worker, and waits.  Exit status is non-zero if any worker fails.
+Spawns S server processes and N worker processes with the reference's
+DMLC_* environment contract and a per-job HMAC secret, runs the given
+command in each worker, and waits.  Exit status is non-zero if any worker
+fails.
+
+Launchers:
+
+``local``
+    everything on this machine (subprocesses).
+``ssh``
+    workers round-robin over the hosts in ``-H hostfile`` via ssh; the
+    parameter servers run on the launcher host (workers connect back to
+    ``--root-uri``, which must be this machine's address as seen from the
+    workers).  Environment (the DMLC_*/MXNET_* job contract plus ``--env``
+    names) is exported explicitly in the remote command — ssh does not
+    forward env.  ``--sync-dst-dir`` rsyncs the current directory to every
+    host first (reference dmlc_tracker/ssh.py behaviour).
+``mpi``
+    one ``mpirun`` invocation per role with ``-x`` env forwarding (OpenMPI
+    convention); host placement is mpirun's, via ``-H``/hostfile args in
+    ``--mpi-args``.
 
 Usage:
     python tools/launch.py -n 2 [-s 1] [--kv-store dist_sync] python train.py
+    python tools/launch.py -n 4 --launcher ssh -H hosts.txt \
+        --root-uri 10.0.0.1 python train.py
 """
 from __future__ import annotations
 
 import argparse
 import os
+import shlex
 import socket
 import subprocess
 import sys
@@ -27,14 +48,91 @@ def _free_port():
     return port
 
 
+def _default_root_uri():
+    """An address of this host that remote workers can reach."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 53))  # no traffic sent; just picks the route
+        addr = s.getsockname()[0]
+        s.close()
+        return addr
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+
+
+# env vars exported through ssh (the job contract + backend selection);
+# --env appends to this
+_JOB_ENV_NAMES = (
+    "DMLC_PS_ROOT_URI", "DMLC_PS_ROOT_PORT", "DMLC_NUM_WORKER",
+    "DMLC_NUM_SERVER", "DMLC_ROLE", "DMLC_RANK", "DMLC_WORKER_ID",
+    "DMLC_SERVER_ID", "MXNET_KVSTORE_MODE", "MXNET_KVSTORE_SECRET",
+    "MXNET_KVSTORE_TIMEOUT", "JAX_PLATFORMS", "PYTHONPATH",
+)
+
+
+def _remote_command(env, command, workdir, env_names):
+    """One shell line: exports + cd + command (dmlc ssh.py's pass_envs).
+
+    Fed to the remote shell over STDIN (``ssh host /bin/sh -s``), never as
+    an argv element: the line carries MXNET_KVSTORE_SECRET, and argv is
+    world-readable in the process list on both ends.
+    """
+    parts = []
+    for name in env_names:
+        if name in env:
+            parts.append("export %s=%s" % (name, shlex.quote(env[name])))
+    parts.append("cd %s" % shlex.quote(workdir))
+    parts.append(" ".join(shlex.quote(c) for c in command))
+    return "; ".join(parts)
+
+
+def _read_hostfile(path):
+    hosts = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                # accept 'host', 'host slots=N' and MPI-style 'host:N'
+                hosts.append(line.split()[0].split(":")[0])
+    if not hosts:
+        raise ValueError("hostfile %s has no hosts" % path)
+    return hosts
+
+
+def _sync_dir(hosts, src, dst, ssh_bin):
+    rsh = ssh_bin if ssh_bin != "ssh" else None
+    for h in hosts:
+        cmd = ["rsync", "-az", "--exclude", ".git",
+               src + "/", "%s:%s/" % (h, dst)]
+        if rsh:
+            cmd[1:1] = ["-e", rsh]
+        subprocess.check_call(cmd)
+
+
 def launch(num_workers, num_servers, command, kv_store="dist_sync",
-           env_extra=None):
+           env_extra=None, launcher="local", hosts=None, ssh_bin="ssh",
+           root_uri=None, env_names=(), workdir=None, sync_dst_dir=None,
+           mpi_args=(), log_dir=None):
     import secrets
 
+    log_handles = []
+
+    def _role_out(role, i):
+        if not log_dir:
+            return None
+        os.makedirs(log_dir, exist_ok=True)
+        fh = open(os.path.join(log_dir, "%s_%d.log" % (role, i)), "wb")
+        log_handles.append(fh)
+        return fh
+
     root_port = _free_port()
+    if launcher == "local":
+        root_uri = "127.0.0.1"
+    elif root_uri is None:
+        root_uri = _default_root_uri()
     base_env = dict(os.environ)
     base_env.update({
-        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_URI": root_uri,
         "DMLC_PS_ROOT_PORT": str(root_port),
         "DMLC_NUM_WORKER": str(num_workers),
         "DMLC_NUM_SERVER": str(num_servers),
@@ -44,28 +142,59 @@ def launch(num_workers, num_servers, command, kv_store="dist_sync",
         or secrets.token_hex(16),
     })
     base_env.update(env_extra or {})
+    all_env_names = tuple(_JOB_ENV_NAMES) + tuple(env_names)
+    workdir = workdir or os.getcwd()
 
+    if launcher == "ssh":
+        if not hosts:
+            raise ValueError("--launcher ssh needs a hostfile (-H)")
+        if sync_dst_dir:
+            _sync_dir(hosts, workdir, sync_dst_dir, ssh_bin)
+            workdir = sync_dst_dir
+    elif launcher not in ("local", "mpi"):
+        raise ValueError("unknown launcher %r" % launcher)
+
+    # parameter servers always run on the launcher host: workers connect
+    # back to (root_uri, root_port+1+sid).  ps-lite servers never touch
+    # the accelerator; neither do these (host CPU processes).
+    server_cmd = [sys.executable, "-c",
+                  "from mxnet_tpu.kvstore.kvstore_server import "
+                  "KVStoreServer; KVStoreServer().run()"]
     procs = []
     for sid in range(num_servers):
         env = dict(base_env)
         env.update({"DMLC_ROLE": "server", "DMLC_SERVER_ID": str(sid)})
-        # servers are CPU processes (parity: ps-lite servers never touch
-        # the accelerator) — and must not wedge on accelerator backend
-        # init when the device link is down
         env["JAX_PLATFORMS"] = (env_extra or {}).get("JAX_PLATFORMS", "cpu")
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c",
-             "from mxnet_tpu.kvstore.kvstore_server import KVStoreServer;"
-             "KVStoreServer().run()"],
-            env=env))
-    time.sleep(0.5)  # let servers bind before workers connect
+        out = _role_out("server", sid)
+        procs.append(subprocess.Popen(server_cmd, env=env,
+                                      stdout=out, stderr=out))
+    time.sleep(0.5)  # workers ALSO retry refused connects (dist_kvstore)
 
     workers = []
     for rank in range(num_workers):
         env = dict(base_env)
         env.update({"DMLC_ROLE": "worker", "DMLC_RANK": str(rank),
                     "DMLC_WORKER_ID": str(rank)})
-        workers.append(subprocess.Popen(command, env=env))
+        wout = _role_out("worker", rank)
+        if launcher == "ssh":
+            host = hosts[rank % len(hosts)]
+            line = _remote_command(env, command, workdir, all_env_names)
+            p = subprocess.Popen(
+                shlex.split(ssh_bin) + [host, "/bin/sh -s"],
+                env=env, stdin=subprocess.PIPE, stdout=wout, stderr=wout)
+            p.stdin.write(line.encode())
+            p.stdin.close()
+            workers.append(p)
+        elif launcher == "mpi":
+            cmd = ["mpirun", "-n", "1"] + list(mpi_args)
+            for name in all_env_names:
+                if name in env:
+                    cmd += ["-x", "%s=%s" % (name, env[name])]
+            workers.append(subprocess.Popen(cmd + list(command), env=env,
+                                            stdout=wout, stderr=wout))
+        else:
+            workers.append(subprocess.Popen(command, env=env,
+                                            stdout=wout, stderr=wout))
 
     rc = 0
     for w in workers:
@@ -77,6 +206,8 @@ def launch(num_workers, num_servers, command, kv_store="dist_sync",
             p.wait(timeout=5)
         except subprocess.TimeoutExpired:
             p.kill()
+    for fh in log_handles:
+        fh.close()
     return rc
 
 
@@ -86,16 +217,40 @@ def main():
     ap.add_argument("-s", "--num-servers", type=int, default=1)
     ap.add_argument("--kv-store", default="dist_sync")
     ap.add_argument("--launcher", default="local",
-                    help="only 'local' is implemented (ssh/mpi/yarn: use "
-                         "your scheduler to run this per host)")
+                    choices=["local", "ssh", "mpi"],
+                    help="local subprocesses, ssh over a hostfile, or one "
+                         "mpirun per worker (sge/yarn: submit this script "
+                         "with --launcher local per allocation)")
+    ap.add_argument("-H", "--hostfile",
+                    help="hosts file for --launcher ssh (one host per line)")
+    ap.add_argument("--root-uri",
+                    help="address of THIS host reachable from the workers "
+                         "(default: auto-detected primary address)")
+    ap.add_argument("--ssh-bin", default="ssh",
+                    help="ssh command (override for tests / alternative "
+                         "transports)")
+    ap.add_argument("--sync-dst-dir",
+                    help="rsync the current directory to this path on every "
+                         "host before launching (reference --sync-dst-dir)")
+    ap.add_argument("--env", action="append", default=[],
+                    help="extra env var NAMES to propagate to remote "
+                         "workers (values taken from this environment)")
+    ap.add_argument("--log-dir",
+                    help="redirect each server/worker's stdout+stderr to "
+                         "<log-dir>/<role>_<i>.log")
+    ap.add_argument("--mpi-args", default="",
+                    help="extra args spliced into each mpirun invocation")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
-    if args.launcher != "local":
-        ap.error("only --launcher local is implemented")
     if not args.command:
         ap.error("no command given")
-    sys.exit(launch(args.num_workers, args.num_servers, args.command,
-                    kv_store=args.kv_store))
+    hosts = _read_hostfile(args.hostfile) if args.hostfile else None
+    sys.exit(launch(
+        args.num_workers, args.num_servers, args.command,
+        kv_store=args.kv_store, launcher=args.launcher, hosts=hosts,
+        ssh_bin=args.ssh_bin, root_uri=args.root_uri,
+        env_names=tuple(args.env), sync_dst_dir=args.sync_dst_dir,
+        mpi_args=tuple(shlex.split(args.mpi_args)), log_dir=args.log_dir))
 
 
 if __name__ == "__main__":
