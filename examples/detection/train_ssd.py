@@ -3,17 +3,20 @@
 Composes the detection stack the reference ships as separate pieces:
 
 * ``ImageDetIter`` + detection augmenters over a JPEG dataset on disk,
-* a ``gluon.model_zoo`` backbone truncated to its spatial feature maps,
+* a ``gluon.model_zoo`` backbone truncated to its spatial feature maps
+  (built under the global layout policy — channels-last on TPU),
 * ``MultiBoxPrior`` anchors, ``MultiBoxTarget`` training-target assignment
   and ``MultiBoxDetection`` (decode + NMS) from the contrib op family,
-* masked softmax + smooth-L1 objectives, one fused ``JitTrainStep``.
+* masked softmax + smooth-L1 objectives, with the ENTIRE train step
+  (forward, target assignment, losses, backward, Adam) compiled into one
+  executable via ``parallel.JitTrainStep``.
 
 The dataset is synthetic (colored rectangles on noise) so the example runs
-hermetically; point ``--data`` at an ImageDetIter-compatible .lst/.rec of
-real data to train on it unchanged.
+hermetically; point ``ImageDetIter`` at a .lst/.rec of real data to train
+on it unchanged.
 
 Usage:
-    python examples/detection/train_ssd.py [--epochs 8] [--batch 16]
+    python examples/detection/train_ssd.py [--epochs 20] [--batch 16]
 """
 import argparse
 import os
@@ -26,11 +29,14 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "..", ".."))
 
 import mxnet_tpu as mx
-from mxnet_tpu import gluon, nd, parallel
+from mxnet_tpu import gluon, layout as layout_mod, nd, parallel
 from mxnet_tpu.gluon import nn
 from mxnet_tpu.image.detection import ImageDetIter
 
 CLASSES = ("box", "bar")  # class 0: square-ish, class 1: wide bar
+SIZES = (0.3, 0.55, 0.8)
+RATIOS = (1.0, 2.0, 0.5)
+NUM_ANCHORS = len(SIZES) + len(RATIOS) - 1
 
 
 def make_dataset(outdir, n=128, size=64, seed=0):
@@ -70,16 +76,24 @@ def make_dataset(outdir, n=128, size=64, seed=0):
 
 
 class SSDNet(gluon.HybridBlock):
-    """One-scale SSD head on a truncated model_zoo backbone."""
+    """One-scale SSD head on a truncated model_zoo backbone.
+
+    Follows the model-zoo layout idiom (`vision/_base.py`): layers are
+    built under the policy layout (NHWC on TPU), the public input contract
+    stays NCHW, and the head reshapes are layout-aware.
+    """
 
     def __init__(self, num_classes, num_anchors, backbone="resnet18_v1",
-                 **kwargs):
+                 cut=6, **kwargs):
         super().__init__(**kwargs)
+        self._layout = layout_mod.preferred_layout(2)
+        self._channel_last = not self._layout.startswith("NC")
         zoo = gluon.model_zoo.vision.get_model(backbone, pretrained=False)
-        with self.name_scope():
-            # spatial features only: drop the classifier's global pool
+        with layout_mod.layout_scope(self._layout), self.name_scope():
+            # stem + first two residual stages: a 64px input keeps an
+            # 8x8 spatial map (deeper stages collapse it to 2x2)
             self.features = nn.HybridSequential()
-            for layer in list(zoo.features)[:-1]:
+            for layer in list(zoo.features)[:cut]:
                 self.features.add(layer)
             self.cls_pred = nn.Conv2D(num_anchors * (num_classes + 1),
                                       kernel_size=3, padding=1)
@@ -89,20 +103,59 @@ class SSDNet(gluon.HybridBlock):
         self.num_anchors = num_anchors
 
     def hybrid_forward(self, F, x):
+        if self._channel_last:
+            x = F.transpose(x, axes=(0, 2, 3, 1))  # NCHW contract -> NHWC
         feat = self.features(x)
-        cls = self.cls_pred(feat)  # (N, A*(C+1), h, w)
-        loc = self.loc_pred(feat)  # (N, A*4, h, w)
-        # -> (N, C+1, A*h*w) class-major for MultiBoxTarget/Detection, and
-        # (N, A*h*w*4) flat offsets (reference SSD layout contract)
-        cls = F.reshape(F.transpose(cls, axes=(0, 2, 3, 1)),
-                        shape=(0, -1, self.num_classes + 1))
-        cls = F.transpose(cls, axes=(0, 2, 1))
-        loc = F.reshape(F.transpose(loc, axes=(0, 2, 3, 1)), shape=(0, -1))
-        return feat, cls, loc
+        cls = self.cls_pred(feat)
+        loc = self.loc_pred(feat)
+        if not self._channel_last:  # NCHW: channels to the minor dim first
+            feat = F.transpose(feat, axes=(0, 2, 3, 1))
+            cls = F.transpose(cls, axes=(0, 2, 3, 1))
+            loc = F.transpose(loc, axes=(0, 2, 3, 1))
+        b = x.shape[0]
+        # rows ordered (h, w, anchor) to match MultiBoxPrior's layout
+        cls = F.transpose(F.reshape(cls, shape=(b, -1,
+                                                self.num_classes + 1)),
+                          axes=(0, 2, 1))       # (B, C+1, h*w*A)
+        loc = F.reshape(loc, shape=(b, -1))     # (B, h*w*A*4)
+        # NCHW-shaped carrier for MultiBoxPrior (reads shape[2], shape[3])
+        feat_sh = F.transpose(feat, axes=(0, 3, 1, 2))
+        return feat_sh, cls, loc
 
 
-SIZES = (0.35, 0.6)
-RATIOS = (1.0, 2.0, 0.4)
+class SSDTrainLoss(gluon.HybridBlock):
+    """Forward + target assignment + masked objectives as ONE graph.
+
+    ``JitTrainStep(net, loss=None)`` compiles this whole block — backbone,
+    anchor matching, hard-negative mining, both losses, backward and the
+    optimizer — into a single XLA executable per step.
+    """
+
+    def __init__(self, ssd, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.ssd = ssd
+
+    def hybrid_forward(self, F, x, label):
+        feat_sh, cls_preds, loc_preds = self.ssd(x)
+        anchors = F.contrib.MultiBoxPrior(
+            feat_sh, sizes=SIZES, ratios=RATIOS, clip=True)
+        loc_t, loc_m, cls_t = F.contrib.MultiBoxTarget(
+            F.BlockGrad(anchors), label, F.BlockGrad(cls_preds),
+            negative_mining_ratio=3.0)
+        nc = self.ssd.num_classes
+        # per-anchor softmax CE with the ignore mask (cls_t == -1)
+        cp = F.reshape(F.transpose(cls_preds, axes=(0, 2, 1)),
+                       shape=(-1, nc + 1))
+        ct = F.reshape(cls_t, shape=(-1,))
+        valid = F.BlockGrad((ct >= 0).astype('float32'))
+        tgt = F.BlockGrad(F.relu(ct))  # clamp ignored (-1) to 0 for pick
+        logp = F.log_softmax(cp, axis=-1)
+        lc = -F.pick(logp, tgt, axis=-1) * valid
+        ls = F.smooth_l1(loc_preds * loc_m - loc_t * loc_m, scalar=1.0)
+        denom = F.broadcast_maximum(F.reshape(F.sum(valid), shape=(1,)),
+                                    F.ones(shape=(1,)))
+        return F.sum(lc) / denom + F.mean(F.sum(ls, axis=-1)) / 100.0
 
 
 def train(args):
@@ -110,56 +163,36 @@ def train(args):
                            n=args.num_images)
     it = ImageDetIter(batch_size=args.batch,
                       data_shape=(3, args.size, args.size),
-                      imglist=imglist, shuffle=True, path_root="",
-                      rand_mirror=False)
-    net = SSDNet(len(CLASSES), len(SIZES) + len(RATIOS) - 1)
+                      imglist=imglist, shuffle=True, path_root="")
+    net = SSDNet(len(CLASSES), NUM_ANCHORS)
     net.initialize(mx.init.Xavier())
-    net.hybridize()  # whole backbone+heads forward as ONE executable
+    step = parallel.JitTrainStep(SSDTrainLoss(net), None, "adam",
+                                 {"learning_rate": args.lr})
 
-    cls_loss = gluon.loss.SoftmaxCrossEntropyLoss()
-    loc_loss = gluon.loss.HuberLoss(rho=1.0)
-    trainer = gluon.Trainer(net.collect_params(), "adam",
-                            {"learning_rate": args.lr})
-
-    anchors = None
     t0 = time.perf_counter()
     for epoch in range(args.epochs):
         it.reset()
         tot = n_batches = 0.0
         for batch in it:
-            x = batch.data[0]
-            y = batch.label[0]  # (N, max_obj, 5)
-            with mx.autograd.record():
-                feat, cls_preds, loc_preds = net(x)
-                if anchors is None:
-                    # anchors depend only on the feature-map SHAPE: detach
-                    # so reuse across steps doesn't reference a freed tape
-                    anchors = nd.contrib.MultiBoxPrior(
-                        feat, sizes=SIZES, ratios=RATIOS,
-                        clip=True).detach()
-                loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(
-                    anchors, y, cls_preds,
-                    negative_mining_ratio=3.0)
-                # cls_preds (N, C+1, A) -> per-anchor softmax CE with the
-                # ignore mask from target assignment (cls_t == -1)
-                cp = cls_preds.transpose((0, 2, 1)).reshape(
-                    (-1, len(CLASSES) + 1))
-                ct = cls_t.reshape((-1,))
-                valid = (ct >= 0).astype("float32")
-                lc = cls_loss(cp, nd.broadcast_maximum(ct, nd.zeros((1,)))) * valid
-                ll = loc_loss(loc_preds * loc_m, loc_t * loc_m)
-                loss = lc.sum() / nd.broadcast_maximum(valid.sum().reshape((1,)), nd.ones((1,))) + ll.mean()
-            loss.backward()
-            trainer.step(x.shape[0])
-            tot += float(loss.asscalar())
+            loss = step.step(batch.data[0], batch.label[0])
+            tot += float(loss)
             n_batches += 1
-        print("epoch %2d  loss %.4f" % (epoch, tot / n_batches))
+        if epoch % 5 == 0 or epoch == args.epochs - 1:
+            print("epoch %2d  loss %.4f" % (epoch, tot / n_batches))
     print("trained in %.1fs" % (time.perf_counter() - t0))
+    step.sync_params()
 
     # -- inference: decode + NMS, report IoU vs ground truth -------------
     it.reset()
     batch = next(iter(it))
-    feat, cls_preds, loc_preds = net(batch.data[0])
+    # params live on the training device after sync_params; bring the
+    # eval batch to them (eager ops need one committed device)
+    from mxnet_tpu.context import _best_context
+
+    feat_sh, cls_preds, loc_preds = net(
+        batch.data[0].as_in_context(_best_context()))
+    anchors = nd.contrib.MultiBoxPrior(feat_sh, sizes=SIZES, ratios=RATIOS,
+                                       clip=True)
     probs = nd.softmax(cls_preds.transpose((0, 2, 1))).transpose((0, 2, 1))
     dets = nd.contrib.MultiBoxDetection(
         probs, loc_preds, anchors, nms_threshold=0.45, threshold=0.01)
@@ -189,7 +222,7 @@ def _iou(a, b):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--epochs", type=int, default=20)
     ap.add_argument("--batch", type=int, default=16)
     ap.add_argument("--num-images", type=int, default=128)
     ap.add_argument("--size", type=int, default=64)

@@ -1,0 +1,39 @@
+"""SSD end-to-end example smoke test (VERDICT r4 item 9; reference
+example/ssd/): the full detection stack — ImageDetIter over JPEGs,
+model_zoo backbone, MultiBox target assignment, one-executable train step,
+decode+NMS inference — trains to localizing detections on synthetic data.
+"""
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "examples", "detection"))
+
+
+def test_ssd_example_trains_and_detects(tmp_path):
+    import train_ssd as S
+
+    args = argparse.Namespace(epochs=12, batch=16, num_images=48, size=64,
+                              lr=4e-3, workdir=str(tmp_path))
+    miou = S.train(args)
+    # random boxes land well under 0.2 IoU; a learned detector on this
+    # synthetic set reaches ~0.7 at 20 epochs, ~0.5 by 12
+    assert miou > 0.35, miou
+
+
+def test_ssd_dataset_labels_are_valid(tmp_path):
+    import numpy as np
+
+    import train_ssd as S
+
+    imglist = S.make_dataset(str(tmp_path / "d"), n=8, size=64)
+    assert len(imglist) == 8
+    for label, path in imglist:
+        assert os.path.exists(path)
+        assert label.ndim == 2 and label.shape[1] == 5
+        assert (label[:, 0] >= 0).all() and (label[:, 0] <= 1).all()
+        boxes = label[:, 1:]
+        assert (boxes >= 0).all() and (boxes <= 1).all()
+        assert (boxes[:, 2] > boxes[:, 0]).all()
+        assert (boxes[:, 3] > boxes[:, 1]).all()
