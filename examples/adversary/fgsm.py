@@ -1,0 +1,88 @@
+"""Fast Gradient Sign Method adversarial examples (reference:
+example/adversary/adversary_generation.ipynb).
+
+Exercises input-gradient autograd: ``x.attach_grad()`` + backward through
+a trained classifier gives d(loss)/d(input); one FGSM step flips most
+predictions while staying imperceptibly close in L-inf.
+
+Usage:
+    python examples/adversary/fgsm.py [--epsilon 0.15]
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def make_data(rs, n):
+    """Two-class 8x8 images: class = which diagonal the bar follows."""
+    x = rs.randn(n, 1, 8, 8).astype(np.float32) * 0.25
+    y = rs.randint(0, 2, n).astype(np.float32)
+    for i in range(n):
+        idx = np.arange(8)
+        if y[i] == 0:
+            x[i, 0, idx, idx] += 0.6
+        else:
+            x[i, 0, idx, 7 - idx] += 0.6
+    return x, y
+
+
+def train_classifier(rs, epochs=12):
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+            nn.Flatten(), nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 5e-3})
+    for _ in range(epochs):
+        x, y = make_data(rs, 64)
+        with autograd.record():
+            loss = loss_fn(net(nd.array(x)), nd.array(y)).mean()
+        loss.backward()
+        tr.step(64)
+    return net, loss_fn
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epsilon", type=float, default=0.3)
+    args = ap.parse_args()
+
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    net, loss_fn = train_classifier(rs)
+
+    xt, yt = make_data(rs, 128)
+    x = nd.array(xt)
+    y = nd.array(yt)
+    clean_acc = float((net(x).argmax(-1) == y).mean().asscalar())
+
+    # FGSM: one signed-gradient step ON THE INPUT
+    x.attach_grad()
+    with autograd.record():
+        loss = loss_fn(net(x), y).mean()
+    loss.backward()
+    x_adv = x + args.epsilon * x.grad.sign()
+    adv_acc = float((net(x_adv).argmax(-1) == y).mean().asscalar())
+
+    linf = float(nd.abs(x_adv - x).max().asscalar())
+    print("clean accuracy:       %.3f" % clean_acc)
+    print("adversarial accuracy: %.3f (eps=%.3f, L-inf=%.3f)"
+          % (adv_acc, args.epsilon, linf))
+    assert clean_acc > 0.9 and adv_acc < clean_acc - 0.2, \
+        "FGSM should measurably degrade a trained classifier"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,134 @@
+"""DCGAN on synthetic 2-D shape images (reference: example/gan/dcgan.py).
+
+Exercises the adversarial-training surface: TWO networks with TWO
+independent Trainers updated alternately under one autograd scope each,
+Conv2DTranspose generator, BatchNorm+LeakyReLU discriminator, and the
+label-flip loss bookkeeping — the training-loop shape every GAN recipe
+written against the reference uses.
+
+Synthetic "real" data: 16x16 images of axis-aligned bright squares.  After
+a few epochs the generator's samples concentrate energy in a contiguous
+blob (scored below); the point is the training mechanics, not FID.
+
+Usage:
+    python examples/gan/train_dcgan.py [--epochs 6]
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+LATENT = 16
+
+
+def real_batch(rs, n, size=16):
+    imgs = np.full((n, 1, size, size), -1.0, np.float32)
+    for i in range(n):
+        w = rs.randint(4, 9)
+        x0 = rs.randint(0, size - w)
+        y0 = rs.randint(0, size - w)
+        imgs[i, 0, y0:y0 + w, x0:x0 + w] = 1.0
+    return imgs
+
+
+def build_generator():
+    net = nn.HybridSequential(prefix="gen_")
+    with net.name_scope():
+        # latent (N, LATENT, 1, 1) -> (N, 1, 16, 16)
+        net.add(nn.Conv2DTranspose(64, 4, 1, 0, use_bias=False),  # 4x4
+                nn.BatchNorm(), nn.Activation("relu"),
+                nn.Conv2DTranspose(32, 4, 2, 1, use_bias=False),  # 8x8
+                nn.BatchNorm(), nn.Activation("relu"),
+                nn.Conv2DTranspose(1, 4, 2, 1, use_bias=False),   # 16x16
+                nn.Activation("tanh"))
+    return net
+
+
+def build_discriminator():
+    net = nn.HybridSequential(prefix="disc_")
+    with net.name_scope():
+        net.add(nn.Conv2D(32, 4, 2, 1, use_bias=False),
+                nn.LeakyReLU(0.2),
+                nn.Conv2D(64, 4, 2, 1, use_bias=False),
+                nn.BatchNorm(), nn.LeakyReLU(0.2),
+                nn.Conv2D(1, 4, 1, 0, use_bias=False),
+                nn.Flatten())
+    return net
+
+
+def train(args):
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    gen, disc = build_generator(), build_discriminator()
+    gen.initialize(mx.init.Normal(0.02))
+    disc.initialize(mx.init.Normal(0.02))
+    gen.hybridize()
+    disc.hybridize()
+
+    loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    g_tr = gluon.Trainer(gen.collect_params(), "adam",
+                         {"learning_rate": 2e-3, "beta1": 0.5})
+    d_tr = gluon.Trainer(disc.collect_params(), "adam",
+                         {"learning_rate": 2e-3, "beta1": 0.5})
+
+    bs = args.batch
+    ones = nd.ones((bs,))
+    zeros = nd.zeros((bs,))
+    t0 = time.perf_counter()
+    for epoch in range(args.epochs):
+        dl = gl = 0.0
+        for _ in range(args.iters):
+            real = nd.array(real_batch(rs, bs))
+            noise = nd.array(rs.randn(bs, LATENT, 1, 1).astype(np.float32))
+            # -- discriminator: real->1, fake->0 (fake detached) --------
+            with autograd.record():
+                out_r = disc(real).reshape((-1,))
+                fake = gen(noise)
+                out_f = disc(fake.detach()).reshape((-1,))
+                errd = (loss_fn(out_r, ones) + loss_fn(out_f, zeros)).mean()
+            errd.backward()
+            d_tr.step(bs)
+            # -- generator: fool the discriminator ----------------------
+            with autograd.record():
+                out = disc(gen(noise)).reshape((-1,))
+                errg = loss_fn(out, ones).mean()
+            errg.backward()
+            g_tr.step(bs)
+            dl += float(errd.asscalar())
+            gl += float(errg.asscalar())
+        print("epoch %d  D %.4f  G %.4f" % (epoch, dl / args.iters,
+                                            gl / args.iters))
+    print("trained in %.1fs" % (time.perf_counter() - t0))
+
+    # structure score: real squares have high spatial autocorrelation —
+    # noise scores ~0, learned blobs clearly above
+    noise = nd.array(rs.randn(64, LATENT, 1, 1).astype(np.float32))
+    samples = gen(noise).asnumpy()[:, 0]
+    acorr = np.mean([
+        np.corrcoef(s[:, :-1].ravel(), s[:, 1:].ravel())[0, 1]
+        for s in samples])
+    print("sample spatial autocorrelation: %.3f" % acorr)
+    return acorr
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--iters", type=int, default=16)
+    ap.add_argument("--batch", type=int, default=32)
+    args = ap.parse_args()
+    train(args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

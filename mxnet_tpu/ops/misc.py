@@ -345,25 +345,35 @@ def _im2col(data, kernel=(), stride=(), dilate=(), pad=()):
 
 @register("col2im")
 def _col2im(data, output_size=(), kernel=(), stride=(), dilate=(), pad=()):
-    nd = len(kernel)
-    if nd != 2:
-        raise NotImplementedError("col2im: only 2D supported")
-    kh, kw = _conv_tuple(kernel, 2)
-    sh, sw = _conv_tuple(stride or (1, 1), 2)
-    dh, dw = _conv_tuple(dilate or (1, 1), 2)
-    ph, pw = _conv_tuple(pad or (0, 0), 2)
-    H, W = int(output_size[0]), int(output_size[1])
+    """N-D col2im (1D/2D/3D like the reference's im2col_nd_core,
+    src/operator/nn/im2col.h:150): scatter-add each kernel tap's column
+    back onto its strided output window."""
+    import itertools
+    import math
+
+    ndim = len(kernel)
+    k = _conv_tuple(kernel, ndim)
+    s = _conv_tuple(stride or (1,) * ndim, ndim)
+    d = _conv_tuple(dilate or (1,) * ndim, ndim)
+    p = _conv_tuple(pad or (0,) * ndim, ndim)
+    out_sp = tuple(int(x) for x in output_size)
     n = data.shape[0]
-    c = data.shape[1] // (kh * kw)
-    oh = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
-    ow = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
-    cols = data.reshape(n, c, kh, kw, oh, ow)
-    out = jnp.zeros((n, c, H + 2 * ph, W + 2 * pw), data.dtype)
-    for i in range(kh):
-        for j in range(kw):
-            out = out.at[:, :, i * dh:i * dh + oh * sh:sh,
-                         j * dw:j * dw + ow * sw:sw].add(cols[:, :, i, j])
-    return out[:, :, ph:ph + H, pw:pw + W]
+    c = data.shape[1] // math.prod(k)
+    o = tuple((out_sp[i] + 2 * p[i] - d[i] * (k[i] - 1) - 1) // s[i] + 1
+              for i in range(ndim))
+    cols = data.reshape((n, c) + tuple(k) + o)
+    out = jnp.zeros(
+        (n, c) + tuple(out_sp[i] + 2 * p[i] for i in range(ndim)),
+        data.dtype)
+    for taps in itertools.product(*(range(ki) for ki in k)):
+        dst = (slice(None), slice(None)) + tuple(
+            slice(taps[i] * d[i], taps[i] * d[i] + o[i] * s[i], s[i])
+            for i in range(ndim))
+        src = (slice(None), slice(None)) + taps
+        out = out.at[dst].add(cols[src])
+    unpad = (slice(None), slice(None)) + tuple(
+        slice(p[i], p[i] + out_sp[i]) for i in range(ndim))
+    return out[unpad]
 
 
 # ----------------------------------------------------------------------------

@@ -147,7 +147,12 @@ def _quantized_pooling(data, min_data, max_data, kernel=(1, 1),
 @register("_contrib_quantized_act", num_outputs=3)
 def _quantized_act(data, min_data, max_data, act_type="relu"):
     if act_type != "relu":
-        raise NotImplementedError("quantized act: only relu")
+        # PARITY, not a ceiling: the reference also supports only relu
+        # ("_contrib_quantized_act only supports act_type=relu for now",
+        # src/operator/quantization/quantized_activation.cc:54,110)
+        raise NotImplementedError(
+            "quantized act: only relu (same as the reference, "
+            "quantized_activation.cc:110)")
     zero = jnp.zeros((), data.dtype)
     out = jnp.maximum(data, zero)
     return out, jnp.maximum(min_data, 0.0), max_data

@@ -1188,10 +1188,32 @@ SPECS["im2col"] = S(
     [randn((1, 2, 4, 4), 925)],
     {"kernel": (2, 2), "stride": (2, 2)},
     check=lambda outs, ins: np.asarray(outs[0]).shape == (1, 8, 4))
-SPECS["col2im"] = S(
-    [randn((1, 8, 4), 926)],
-    {"output_size": (4, 4), "kernel": (2, 2), "stride": (2, 2)},
-    check=lambda outs, ins: np.asarray(outs[0]).shape == (1, 2, 4, 4))
+SPECS["col2im"] = [
+    S([randn((1, 8, 4), 926)],
+      {"output_size": (4, 4), "kernel": (2, 2), "stride": (2, 2)},
+      check=lambda outs, ins: np.asarray(outs[0]).shape == (1, 2, 4, 4)),
+    # 1D and 3D (reference im2col_nd_core supports any spatial rank):
+    # non-overlapping stride=kernel -> col2im exactly inverts im2col
+    S([randn((1, 4, 3), 929)],
+      {"output_size": (6,), "kernel": (2,), "stride": (2,)},
+      check=lambda outs, ins: np.asarray(outs[0]).shape == (1, 2, 6)),
+    S([randn((2, 16, 8), 930)],
+      {"output_size": (4, 4, 4), "kernel": (2, 2, 2), "stride": (2, 2, 2)},
+      check=lambda outs, ins: np.asarray(outs[0]).shape == (2, 2, 4, 4, 4)),
+]
+
+
+def test_col2im_inverts_im2col_nd():
+    import mxnet_tpu as mx
+
+    for shape, kernel in [((2, 3, 8), (2,)),
+                          ((2, 3, 8, 6), (2, 3)),
+                          ((1, 2, 4, 4, 6), (2, 2, 3))]:
+        x = np.random.RandomState(7).randn(*shape).astype(np.float32)
+        cols = mx.nd.im2col(mx.nd.array(x), kernel=kernel, stride=kernel)
+        back = mx.nd.col2im(cols, output_size=shape[2:], kernel=kernel,
+                            stride=kernel)
+        np.testing.assert_allclose(back.asnumpy(), x, rtol=1e-6, atol=1e-6)
 SPECS["_image_to_tensor"] = S(
     [(_r(927).rand(4, 5, 3) * 255).astype(np.uint8)],
     ref=lambda x: (x.transpose(2, 0, 1) / 255.0).astype(np.float32))
