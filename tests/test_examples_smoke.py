@@ -36,3 +36,21 @@ def test_fgsm_flips_predictions(capsys):
     assert fgsm.main() == 0
     out = capsys.readouterr().out
     assert "adversarial accuracy" in out
+
+
+def test_bilstm_sort_learns():
+    sys.path.insert(0, os.path.join(REPO, "examples", "bi-lstm-sort"))
+    import sort_io
+
+    args = argparse.Namespace(epochs=10, iters=18, batch=64)
+    acc = sort_io.train(args)
+    assert acc > 0.7, acc  # random guessing: 0.1
+
+
+def test_multitask_both_heads_learn():
+    sys.path.insert(0, os.path.join(REPO, "examples", "multi-task"))
+    import train_multitask
+
+    args = argparse.Namespace(epochs=8, iters=15, batch=64)
+    acc_s, acc_f = train_multitask.train(args)
+    assert acc_s > 0.8 and acc_f > 0.8, (acc_s, acc_f)
