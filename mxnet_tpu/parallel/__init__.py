@@ -24,3 +24,7 @@ from .moe import moe_ffn, moe_ffn_sharded  # noqa: F401
 from .pipeline import (  # noqa: F401
     gpipe, gpipe_loss_fn, HostPipeline, partition_llama,
 )
+from .multihost import (  # noqa: F401
+    init_multihost, global_mesh, host_local_to_global,
+    global_to_host_local, sync_global_devices,
+)

@@ -1,0 +1,96 @@
+"""Multi-host GSPMD runtime (SURVEY §5.8 distributed backend tier).
+
+The reference scales across machines with ps-lite/NCCL processes; the
+TPU-native equivalent is ONE logical XLA program spanning every host's
+chips: each process calls :func:`init_multihost`, the global mesh sees
+all devices, and ``pjit``-compiled steps insert ICI collectives within a
+host and DCN collectives across hosts automatically (scaling-book recipe).
+
+The process/rendezvous contract is the SAME DMLC_* environment the
+parameter-server tier and ``tools/launch.py`` already use, so
+``tools/launch.py --backend gspmd -n 4 --launcher ssh -H hosts``
+launches either tier:
+
+* ``DMLC_PS_ROOT_URI`` / ``DMLC_PS_ROOT_PORT`` → the jax.distributed
+  coordinator (rank-0 host).
+* ``DMLC_NUM_WORKER`` / ``DMLC_RANK`` → process count / id.
+
+On real pods each process owns its host's chips; in tests the same code
+runs as N processes × K virtual CPU devices (gloo collectives).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax
+
+_initialized = [False]
+
+
+def init_multihost(coordinator=None, num_processes=None, process_id=None):
+    """Join (or create) the multi-process JAX runtime.
+
+    Arguments default from the DMLC env contract.  Safe to call once per
+    process, before any backend use.  Returns (num_processes, process_id).
+    """
+    if _initialized[0]:
+        return (jax.process_count(), jax.process_index())
+    if num_processes is None:
+        num_processes = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    if num_processes <= 1:
+        _initialized[0] = True
+        return (1, 0)
+    if process_id is None:
+        process_id = int(os.environ.get("DMLC_RANK", "0"))
+    if coordinator is None:
+        host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        port = int(os.environ.get("DMLC_PS_ROOT_PORT", "29400"))
+        coordinator = "%s:%d" % (host, port)
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized[0] = True
+    return (num_processes, process_id)
+
+
+def global_mesh(axes=None):
+    """A mesh over EVERY process's devices (call after init_multihost)."""
+    from .mesh import make_mesh
+
+    return make_mesh(axes, devices=jax.devices())
+
+
+def host_local_to_global(array, mesh, spec):
+    """Assemble per-process host-local shards into one global array.
+
+    Each process passes ITS slice of the batch (e.g. the rows its data
+    pipeline loaded); the result is a global array laid out by ``spec``
+    over ``mesh`` that pjit-compiled steps consume directly — the
+    multi-host analogue of the reference feeding each worker its own
+    data shard.
+    """
+    from jax.experimental import multihost_utils
+
+    from ..ndarray.ndarray import NDArray
+
+    if isinstance(array, NDArray):
+        array = array.data()
+    return multihost_utils.host_local_array_to_global_array(
+        np.asarray(array), mesh, spec)
+
+
+def global_to_host_local(array, mesh, spec):
+    """Inverse of :func:`host_local_to_global` (fetch this host's rows)."""
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.global_array_to_host_local_array(
+        array, mesh, spec)
+
+
+def sync_global_devices(tag="barrier"):
+    """Cross-process barrier (reference kvstore barrier analogue)."""
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(tag)

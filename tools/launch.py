@@ -112,7 +112,7 @@ def _sync_dir(hosts, src, dst, ssh_bin):
 def launch(num_workers, num_servers, command, kv_store="dist_sync",
            env_extra=None, launcher="local", hosts=None, ssh_bin="ssh",
            root_uri=None, env_names=(), workdir=None, sync_dst_dir=None,
-           mpi_args=(), log_dir=None):
+           mpi_args=(), log_dir=None, backend="ps"):
     import secrets
 
     log_handles = []
@@ -153,6 +153,12 @@ def launch(num_workers, num_servers, command, kv_store="dist_sync",
             workdir = sync_dst_dir
     elif launcher not in ("local", "mpi"):
         raise ValueError("unknown launcher %r" % launcher)
+
+    if backend == "gspmd":
+        # GSPMD tier: no parameter servers — workers join ONE logical XLA
+        # program via jax.distributed (parallel/multihost.py); the DMLC
+        # root URI/port doubles as the coordinator address
+        num_servers = 0
 
     # parameter servers always run on the launcher host: workers connect
     # back to (root_uri, root_port+1+sid).  ps-lite servers never touch
@@ -235,6 +241,10 @@ def main():
     ap.add_argument("--env", action="append", default=[],
                     help="extra env var NAMES to propagate to remote "
                          "workers (values taken from this environment)")
+    ap.add_argument("--backend", default="ps", choices=["ps", "gspmd"],
+                    help="ps: parameter-server tier (dist kvstore); "
+                         "gspmd: one logical XLA program over all hosts "
+                         "(jax.distributed rendezvous, no servers)")
     ap.add_argument("--log-dir",
                     help="redirect each server/worker's stdout+stderr to "
                          "<log-dir>/<role>_<i>.log")
@@ -250,7 +260,8 @@ def main():
         kv_store=args.kv_store, launcher=args.launcher, hosts=hosts,
         ssh_bin=args.ssh_bin, root_uri=args.root_uri,
         env_names=tuple(args.env), sync_dst_dir=args.sync_dst_dir,
-        mpi_args=tuple(shlex.split(args.mpi_args)), log_dir=args.log_dir))
+        mpi_args=tuple(shlex.split(args.mpi_args)), log_dir=args.log_dir,
+        backend=args.backend))
 
 
 if __name__ == "__main__":
