@@ -83,10 +83,13 @@ class Context:
 
 
 def _resolve_jax_device(device_type, device_id):
-    devices = jax.devices()
+    # local_devices, not devices: in a multi-process (multi-host) runtime
+    # jax.devices() is the GLOBAL list and entry 0 may belong to another
+    # process — a Context always names a device THIS process can address
+    devices = jax.local_devices()
     if device_type in ("cpu", "cpu_pinned", "cpu_shared"):
         try:
-            cpus = jax.devices("cpu")
+            cpus = jax.local_devices(backend="cpu")
         except RuntimeError:
             cpus = [d for d in devices if d.platform == "cpu"]
         if cpus:

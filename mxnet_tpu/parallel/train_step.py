@@ -111,6 +111,28 @@ class JitTrainStep:
             self._place_on_mesh(self._param_rule)
 
     # -- mesh placement ----------------------------------------------------
+    @staticmethod
+    def _np_host(arr):
+        import numpy as _np
+
+        return _np.asarray(arr)
+
+    @property
+    def _multiprocess(self):
+        """Mesh spans devices of MORE than this process (multi-host run)."""
+        return self._mesh is not None and jax.process_count() > 1
+
+    @staticmethod
+    def _put_global(arr, sharding):
+        """Place a host-replicated array onto a (possibly multi-host)
+        sharding.  ``device_put`` cannot target non-addressable devices;
+        ``make_array_from_callback`` lets every process materialize just
+        ITS shards from the identical host copy (works for replicated and
+        sharded specs alike — the tp slice of a weight is host[idx])."""
+        host = JitTrainStep._np_host(arr)
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx: host[idx])
+
     def _place_on_mesh(self, param_rule):
         mesh = self._mesh
         def spec_for(p):
@@ -118,12 +140,13 @@ class JitTrainStep:
             return s if s is not None else P()
         self._param_shardings = [
             NamedSharding(mesh, spec_for(p)) for p in self._params]
+        put = self._put_global if self._multiprocess else jax.device_put
         self._weights = [
-            jax.device_put(w, s)
+            put(w, s)
             for w, s in zip(self._weights, self._param_shardings)]
         self._opt_state = [
             None if st is None else jax.tree_util.tree_map(
-                lambda a: jax.device_put(a, sh), st)
+                lambda a: put(a, sh), st)
             for st, sh in zip(self._opt_state, self._param_shardings)]
 
     def _batch_sharding(self, arr):
@@ -132,7 +155,20 @@ class JitTrainStep:
 
     def _place_batch(self, batch_nd):
         """device_put batch arrays: data-axis sharded on a mesh, else the
-        single training device."""
+        single training device.
+
+        Multi-host: each process passes its HOST-LOCAL rows; the global
+        batch is their concatenation along the data axis (the reference's
+        per-worker data shard semantics), assembled without cross-host
+        transfers."""
+        if self._multiprocess:
+            from jax.experimental import multihost_utils
+
+            return [multihost_utils.host_local_array_to_global_array(
+                        self._np_host(b.data()), self._mesh,
+                        P(self._data_axis,
+                          *([None] * (b.data().ndim - 1))))
+                    for b in batch_nd]
         if self._mesh is not None:
             return [jax.device_put(b.data(), self._batch_sharding(b.data()))
                     for b in batch_nd]
@@ -228,6 +264,24 @@ class JitTrainStep:
                        **jit_kwargs)
 
     # -- public API --------------------------------------------------------
+    def _scalar_args(self, key, lr, t):
+        """key/lr/t for the step executable.
+
+        Multi-host: every argument of a global jit must be a GLOBAL array
+        — and the RNG key must be the SAME on every process (identical
+        dropout masks keep the replicas in lockstep, the property the
+        reference gets from broadcasting seeds through the kvstore).
+        Rank 0's key wins via broadcast.
+        """
+        if not self._multiprocess:
+            return key, lr, t
+        from jax.experimental import multihost_utils
+
+        key = multihost_utils.broadcast_one_to_all(key)
+        rep = NamedSharding(self._mesh, P())
+        return (self._put_global(key, rep), self._put_global(lr, rep),
+                self._put_global(t, rep))
+
     def step(self, *batch):
         """Run one train step; returns the (device, async) scalar loss."""
         batch_nd = [b if isinstance(b, NDArray) else nd.array(b)
@@ -238,11 +292,12 @@ class JitTrainStep:
             self._step_fn = self._build(arrays)
         self._t += 1
         self._opt.num_update = self._t
-        self._weights, self._opt_state, loss = self._step_fn(
+        key, lr, t = self._scalar_args(
             _random.next_key(),
             jnp.asarray(self._opt.learning_rate, jnp.float32),
-            self._weights, self._opt_state,
-            jnp.asarray(self._t, jnp.int32), *arrays)
+            jnp.asarray(self._t, jnp.int32))
+        self._weights, self._opt_state, loss = self._step_fn(
+            key, lr, self._weights, self._opt_state, t, *arrays)
         self._last_loss = loss
         return loss
 
