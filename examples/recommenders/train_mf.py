@@ -1,0 +1,103 @@
+"""Matrix-factorization recommender with sparse embedding gradients
+(reference: example/recommenders/demo1-MF.ipynb).
+
+Exercises the sparse tier end to end: ``nn.Embedding(sparse_grad=True)``
+produces ``row_sparse`` gradients (only the rows a batch touched), the
+optimizer applies lazy row-wise updates, and training cost per step stays
+proportional to the BATCH, not the embedding table — the property large
+recommender tables rely on in the reference.
+
+Synthetic data: a low-rank user x item preference matrix with noise;
+the model recovers it to high rating accuracy.
+
+Usage:
+    python examples/recommenders/train_mf.py [--epochs 15]
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+N_USERS, N_ITEMS, RANK = 200, 300, 6
+
+
+class MFNet(gluon.Block):
+    def __init__(self, dim=16, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.user = nn.Embedding(N_USERS, dim, sparse_grad=True)
+            self.item = nn.Embedding(N_ITEMS, dim, sparse_grad=True)
+
+    def forward(self, users, items):
+        return (self.user(users) * self.item(items)).sum(axis=-1)
+
+
+def make_truth(rs):
+    u = rs.randn(N_USERS, RANK).astype(np.float32)
+    v = rs.randn(N_ITEMS, RANK).astype(np.float32)
+    return (u @ v.T) / np.sqrt(RANK)
+
+
+def train(args):
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    truth = make_truth(rs)
+    net = MFNet()
+    net.initialize(mx.init.Normal(0.1))
+    loss_fn = gluon.loss.L2Loss()
+    trainer = gluon.Trainer(net.collect_params(), "adagrad",
+                            {"learning_rate": 1.0})
+
+    t0 = time.perf_counter()
+    for epoch in range(args.epochs):
+        tot = 0.0
+        for _ in range(args.iters):
+            users = rs.randint(0, N_USERS, args.batch)
+            items = rs.randint(0, N_ITEMS, args.batch)
+            ratings = truth[users, items] + 0.05 * rs.randn(args.batch)
+            with autograd.record():
+                pred = net(nd.array(users.astype(np.float32)),
+                           nd.array(items.astype(np.float32)))
+                loss = loss_fn(pred, nd.array(
+                    ratings.astype(np.float32))).mean()
+            loss.backward()
+            # row_sparse gradients: only touched rows carry values
+            g = net.user.weight.grad()
+            assert getattr(g, "stype", "default") == "row_sparse", g
+            trainer.step(args.batch)
+            tot += float(loss.asscalar())
+        if epoch % 5 == 0 or epoch == args.epochs - 1:
+            print("epoch %2d  mse %.4f" % (epoch, tot / args.iters))
+    print("trained in %.1fs" % (time.perf_counter() - t0))
+
+    users = rs.randint(0, N_USERS, 2048)
+    items = rs.randint(0, N_ITEMS, 2048)
+    pred = net(nd.array(users.astype(np.float32)),
+               nd.array(items.astype(np.float32))).asnumpy()
+    rmse = float(np.sqrt(np.mean((pred - truth[users, items]) ** 2)))
+    print("held-out RMSE vs truth: %.4f (truth std %.3f)"
+          % (rmse, truth.std()))
+    return rmse
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=15)
+    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--batch", type=int, default=256)
+    args = ap.parse_args()
+    train(args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,3 +54,12 @@ def test_multitask_both_heads_learn():
     args = argparse.Namespace(epochs=8, iters=15, batch=64)
     acc_s, acc_f = train_multitask.train(args)
     assert acc_s > 0.8 and acc_f > 0.8, (acc_s, acc_f)
+
+
+def test_recommender_sparse_mf_learns():
+    sys.path.insert(0, os.path.join(REPO, "examples", "recommenders"))
+    import train_mf
+
+    args = argparse.Namespace(epochs=10, iters=25, batch=256)
+    rmse = train_mf.train(args)
+    assert rmse < 0.25, rmse  # truth std ~0.94; no-learning baseline ~0.93
