@@ -271,16 +271,21 @@ class JitTrainStep:
         — and the RNG key must be the SAME on every process (identical
         dropout masks keep the replicas in lockstep, the property the
         reference gets from broadcasting seeds through the kvstore).
-        Rank 0's key wins via broadcast.
+        Rank 0's key is broadcast ONCE; per-step keys derive from it
+        deterministically (``fold_in(t)``) so the steady-state step pays
+        no cross-host collective.
         """
         if not self._multiprocess:
             return key, lr, t
         from jax.experimental import multihost_utils
 
-        key = multihost_utils.broadcast_one_to_all(key)
-        rep = NamedSharding(self._mesh, P())
-        return (self._put_global(key, rep), self._put_global(lr, rep),
-                self._put_global(t, rep))
+        if not hasattr(self, "_mh_rep"):
+            self._mh_rep = NamedSharding(self._mesh, P())
+            self._mh_base_key = multihost_utils.broadcast_one_to_all(key)
+        key = jax.random.fold_in(self._mh_base_key, int(t))
+        return (self._put_global(key, self._mh_rep),
+                self._put_global(lr, self._mh_rep),
+                self._put_global(t, self._mh_rep))
 
     def step(self, *batch):
         """Run one train step; returns the (device, async) scalar loss."""
@@ -381,18 +386,28 @@ class JitTrainStep:
             fn = jax.jit(loop, donate_argnums=(2, 3), **jit_kwargs)
             self._step_n_cache[sched_key] = fn
         self._opt.num_update = self._t + n
-        self._weights, self._opt_state, loss = fn(
+        key, lr, t = self._scalar_args(
             _random.next_key(),
             jnp.asarray(self._opt.learning_rate, jnp.float32),
-            self._weights, self._opt_state,
-            jnp.asarray(self._t, jnp.int32), *arrays)
+            jnp.asarray(self._t, jnp.int32))
+        self._weights, self._opt_state, loss = fn(
+            key, lr, self._weights, self._opt_state, t, *arrays)
         self._t += n
         self._last_loss = loss
         return loss
 
     def sync_params(self):
-        """Write the jitted weights back into the gluon Parameters."""
+        """Write the jitted weights back into the gluon Parameters.
+
+        Multi-host: a parameter sharded ACROSS processes spans
+        non-addressable devices and cannot be fetched directly —
+        all-gather it first (every process ends with the full value,
+        reference broadcast-from-kvstore semantics)."""
         for p, w in zip(self._params, self._weights):
+            if self._multiprocess and not w.is_fully_addressable:
+                from jax.experimental import multihost_utils
+
+                w = multihost_utils.process_allgather(w, tiled=True)
             p.set_data(w)
 
     @property

@@ -106,6 +106,9 @@ w_true = np.random.RandomState(0).randn(4, 2).astype(np.float32)
 x = rs.randn(16, 4).astype(np.float32)
 y = x @ w_true
 losses = [float(step.step(x, y)) for _ in range(40)]
+# the n-step device-side loop must work cross-process too (same
+# _scalar_args path: replicated key/lr/t)
+losses.append(float(step.step_n(5, x, y)))
 step.sync_params()
 w = net.weight.data().asnumpy()
 json.dump({"rank": rank, "first": losses[0], "last": losses[-1],

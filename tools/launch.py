@@ -126,6 +126,7 @@ def launch(num_workers, num_servers, command, kv_store="dist_sync",
         return fh
 
     root_port = _free_port()
+    explicit_uri = root_uri is not None
     if launcher == "local":
         root_uri = "127.0.0.1"
     elif root_uri is None:
@@ -157,8 +158,14 @@ def launch(num_workers, num_servers, command, kv_store="dist_sync",
     if backend == "gspmd":
         # GSPMD tier: no parameter servers — workers join ONE logical XLA
         # program via jax.distributed (parallel/multihost.py); the DMLC
-        # root URI/port doubles as the coordinator address
+        # root URI/port doubles as the coordinator address.  The
+        # coordinator SERVICE runs inside rank 0's process, so over ssh
+        # the address must be rank 0's HOST (hosts[0]), not the launcher
+        # (and the port only needs to be free there — a fixed high port
+        # beats a launcher-local _free_port probe)
         num_servers = 0
+        if launcher == "ssh" and hosts and not explicit_uri:
+            base_env["DMLC_PS_ROOT_URI"] = hosts[0].split(":")[0]
 
     # parameter servers always run on the launcher host: workers connect
     # back to (root_uri, root_port+1+sid).  ps-lite servers never touch
