@@ -1,0 +1,8 @@
+"""Parity import path: the reference ships AMP as ``mx.contrib.amp``
+(``python/mxnet/contrib/amp/amp.py``); this rebuild hosts it at
+``mxnet_tpu.amp`` (bfloat16-first).  Re-export so reference recipes'
+``from mxnet.contrib import amp`` works unchanged."""
+from ..amp import *  # noqa: F401,F403
+from ..amp import (  # noqa: F401
+    init, init_trainer, scale_loss, convert_hybrid_block, LossScaler,
+)

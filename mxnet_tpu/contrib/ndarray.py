@@ -1,0 +1,16 @@
+"""Parity import path: ``mx.contrib.ndarray`` — the contrib op namespace
+(reference ``python/mxnet/contrib/ndarray.py`` codegen).  The live registry
+already exposes every ``_contrib_*`` op as ``mx.nd.contrib.<name>``; this
+module forwards attribute access to that namespace object."""
+
+
+def __getattr__(name):
+    from .. import ndarray as _nd
+
+    return getattr(_nd.contrib, name)
+
+
+def __dir__():
+    from .. import ndarray as _nd
+
+    return dir(_nd.contrib)

@@ -1,0 +1,14 @@
+"""Parity import path: ``mx.contrib.symbol`` (reference
+``python/mxnet/contrib/symbol.py`` codegen) — the symbolic contrib ops."""
+
+
+def __getattr__(name):
+    from .. import symbol as _sym
+
+    return getattr(_sym.contrib, name)
+
+
+def __dir__():
+    from .. import symbol as _sym
+
+    return dir(_sym.contrib)

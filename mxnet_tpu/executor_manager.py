@@ -1,0 +1,26 @@
+"""Parity re-exports for ``mx.executor_manager`` (reference
+``python/mxnet/executor_manager.py``).
+
+The reference's DataParallelExecutorManager machinery lives here; in this
+rebuild the same roles are implemented by the Module API's executor group
+(`module/executor_group.py`) — batch slicing across contexts, forward/
+backward fan-out, gradient accumulation — so this module re-exports them
+under the reference import path.
+"""
+from .module.executor_group import DataParallelExecutorGroup  # noqa: F401
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Slice [0, batch_size) proportionally to work_load_list (reference
+    executor_manager.py:35)."""
+    total = sum(work_load_list)
+    slices = []
+    start = 0
+    for i, w in enumerate(work_load_list):
+        end = batch_size if i == len(work_load_list) - 1 else \
+            start + int(round(batch_size * w / total))
+        if end <= start:
+            raise ValueError("Too many slices. Some splits are empty.")
+        slices.append(slice(start, end))
+        start = end
+    return slices

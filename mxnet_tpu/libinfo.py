@@ -1,0 +1,32 @@
+"""Parity for ``mx.libinfo`` (reference ``python/mxnet/libinfo.py``).
+
+The reference locates ``libmxnet.so`` and reports its version; here the
+"library" is the package itself (XLA is the kernel library) plus the
+optional native data-plane helpers, so ``find_lib_path`` returns the
+built native ``.so`` paths when present.
+"""
+import os
+
+from . import __version__  # noqa: F401
+
+
+def find_lib_path():
+    """Paths of the native helper libraries built for this install
+    (reference returns [libmxnet.so]).  May be empty: the compute path
+    needs no native library — XLA provides the kernels."""
+    import glob
+
+    from . import native
+
+    try:
+        native.get_lib()  # ensure the cached build exists
+    except Exception:
+        pass
+    return sorted(glob.glob(os.path.join(native._cache_dir(),
+                                         "mxnet_native-*.so")))
+
+
+def find_include_path():
+    """Headers for binary extensions (reference: include/mxnet)."""
+    src = os.path.join(os.path.dirname(os.path.abspath(__file__)), "src")
+    return src if os.path.isdir(src) else ""

@@ -1,0 +1,45 @@
+"""Reference import-path parity shims (round-5 surface sweep):
+mx.executor_manager, mx.libinfo, mx.contrib.{amp,ndarray,symbol}.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_executor_manager_reexports():
+    from mxnet_tpu import executor_manager
+
+    assert executor_manager.DataParallelExecutorGroup is not None
+    s = executor_manager._split_input_slice(10, [1, 1, 2])
+    assert [x.start for x in s] == [0, 2, 4]
+    assert s[-1].stop == 10
+
+
+def test_libinfo_paths():
+    from mxnet_tpu import libinfo
+
+    assert libinfo.__version__ == mx.__version__
+    libs = libinfo.find_lib_path()
+    assert isinstance(libs, list)  # may be empty on a fresh cache
+    assert libinfo.find_include_path()
+
+
+def test_contrib_amp_path():
+    from mxnet_tpu.contrib import amp
+
+    assert callable(amp.init)
+    assert callable(amp.convert_hybrid_block)
+    assert amp.LossScaler is not None
+
+
+def test_contrib_ndarray_symbol_namespaces():
+    from mxnet_tpu.contrib import ndarray as cnd
+    from mxnet_tpu.contrib import symbol as csym
+
+    q = mx.nd.ones((1, 1, 8, 8))
+    out = cnd.flash_attention(q, q, q)
+    assert out.shape == (1, 1, 8, 8)
+
+    v = mx.sym.var("v")
+    assert csym.MultiBoxPrior is not None
+    assert "quantize" in dir(cnd) or "flash_attention" in dir(cnd)
