@@ -23,5 +23,9 @@ class _ContribNS:
             raise AttributeError("sym.contrib.%s" % item)
         return fn
 
+    def __dir__(self):
+        return sorted(n[len("_contrib_"):] for n in globals()
+                      if n.startswith("_contrib_"))
+
 
 contrib = _ContribNS()
