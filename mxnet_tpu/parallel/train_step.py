@@ -396,6 +396,68 @@ class JitTrainStep:
         self._last_loss = loss
         return loss
 
+    def save_states(self, fname):
+        """Checkpoint weights + optimizer state + update count
+        (resume-able mid-training; Trainer.save_states analogue for the
+        compiled path).  Multi-host: call on every process (each writes
+        identical replicated state; rank-suffix the fname if the
+        filesystem is shared)."""
+        import pickle
+
+        if self._params is None:
+            raise MXNetError("save_states before the first step")
+
+        def fetch(a):
+            if self._multiprocess and not a.is_fully_addressable:
+                from jax.experimental import multihost_utils
+
+                a = multihost_utils.process_allgather(a, tiled=True)
+            return jax.device_get(a)
+
+        payload = {
+            "weights": [fetch(w) for w in self._weights],
+            "opt_state": [None if s is None
+                          else jax.tree_util.tree_map(fetch, s)
+                          for s in self._opt_state],
+            "t": self._t,
+        }
+        with open(fname, "wb") as f:
+            pickle.dump(payload, f)
+
+    def load_states(self, fname):
+        """Restore a save_states checkpoint (same net/optimizer config).
+        May be called before or after the first step; placement (device,
+        mesh shardings) is re-applied."""
+        import pickle
+
+        with open(fname, "rb") as f:
+            payload = pickle.load(f)
+        if self._params is None:
+            raise MXNetError(
+                "load_states needs initialized placement: run one step, "
+                "or call after net.initialize + a step")
+        if self._mesh is not None:
+            put = (self._put_global if self._multiprocess
+                   else jax.device_put)
+            self._weights = [put(w, s) for w, s in
+                             zip(payload["weights"],
+                                 self._param_shardings)]
+            self._opt_state = [
+                None if st is None else jax.tree_util.tree_map(
+                    lambda a, sh=sh: put(a, sh), st)
+                for st, sh in zip(payload["opt_state"],
+                                  self._param_shardings)]
+        else:
+            dev = self._device
+            self._weights = [jax.device_put(w, dev)
+                             for w in payload["weights"]]
+            self._opt_state = [
+                None if st is None else jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a, dev), st)
+                for st in payload["opt_state"]]
+        self._t = int(payload["t"])
+        self._opt.num_update = self._t
+
     def sync_params(self):
         """Write the jitted weights back into the gluon Parameters.
 
