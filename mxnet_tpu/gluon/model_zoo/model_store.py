@@ -1,0 +1,48 @@
+"""Pretrained-weight store (parity: gluon/model_zoo/model_store.py).
+
+``get_model_file(name)`` returns the local path of a model's ``.params``
+checkpoint, downloading it from the Gluon repository when absent.  The
+repository base is ``MXNET_GLUON_REPO`` (see ``gluon.utils._get_repo_url``)
+— point it at a ``file://`` tree or internal mirror in air-gapped
+deployments; no sha1 table is baked in (the reference pins known-model
+hashes; here any repo-served checkpoint for the NAMED model is accepted,
+with sha1 verification when the repo publishes ``<file>.sha1``).
+"""
+import os
+
+from ..utils import _get_repo_file_url, check_sha1, download
+
+_NAMESPACE = "gluon/models"
+
+
+def get_model_file(name, root=os.path.join("~", ".mxnet", "models")):
+    """Local path of ``<name>.params``, downloaded on first use."""
+    file_name = "%s.params" % name
+    root = os.path.expanduser(root)
+    path = os.path.join(root, file_name)
+    if os.path.exists(path):
+        return path
+    os.makedirs(root, exist_ok=True)
+    url = _get_repo_file_url(_NAMESPACE, file_name)
+    sha1 = None
+    try:  # optional integrity sidecar published next to the checkpoint
+        sha_path = download(url + ".sha1", path=path + ".sha1",
+                            overwrite=True, retries=0)
+        sha1 = open(sha_path).read().split()[0].strip() or None
+    except Exception:
+        sha1 = None
+    download(url, path=path, sha1_hash=sha1)
+    if sha1 and not check_sha1(path, sha1):
+        raise ValueError(
+            "downloaded %s does not match its published sha1" % file_name)
+    return path
+
+
+def purge(root=os.path.join("~", ".mxnet", "models")):
+    """Remove all cached model files (reference model_store.purge)."""
+    root = os.path.expanduser(root)
+    if not os.path.isdir(root):
+        return
+    for f in os.listdir(root):
+        if f.endswith(".params"):
+            os.remove(os.path.join(root, f))

@@ -267,10 +267,13 @@ def get_resnet(version, num_layers, pretrained=False, ctx=cpu(),
         kwargs.setdefault('layout', 'NCHW')
     net = resnet_class(block_class, layers, channels, **kwargs)
     if pretrained:
-        path = os.path.join(
-            os.path.expanduser(root),
-            'resnet%d_v%d.params' % (num_layers, version))
-        net.load_parameters(path, ctx=ctx)
+        # local file wins; otherwise fetched from the model store
+        # (MXNET_GLUON_REPO — file:// trees work for air-gapped use)
+        from ..model_store import get_model_file
+
+        net.load_parameters(
+            get_model_file('resnet%d_v%d' % (num_layers, version),
+                           root=root), ctx=ctx)
     return net
 
 

@@ -272,6 +272,24 @@ def _fetch_url(url, dest, verify_ssl=True):
                 f.write(chunk)
 
 
+def _get_repo_url():
+    """Base URL for the Gluon model/dataset repository (reference
+    ``gluon/utils.py:347``); ``MXNET_GLUON_REPO`` overrides — including
+    ``file://`` trees for air-gapped deployments."""
+    default_repo = "https://apache-mxnet.s3-accelerate.dualstack." \
+                   "amazonaws.com/"
+    repo_url = os.environ.get("MXNET_GLUON_REPO", default_repo)
+    if repo_url[-1] != "/":
+        repo_url = repo_url + "/"
+    return repo_url
+
+
+def _get_repo_file_url(namespace, filename):
+    """URL of a hosted file (reference ``gluon/utils.py:355``)."""
+    return "{base_url}{namespace}/{filename}".format(
+        base_url=_get_repo_url(), namespace=namespace, filename=filename)
+
+
 class HookHandle:
     """A removable handle for a registered hook (reference ``utils.py:378``)."""
 

@@ -234,3 +234,32 @@ def test_same_hook_registered_twice_fires_twice():
     h2.detach()
     net(x)
     assert len(calls) == 3
+
+
+def test_model_store_download_and_pretrained(tmp_path, monkeypatch):
+    """get_model_file downloads from MXNET_GLUON_REPO (file:// tree) and
+    pretrained=True loads through it (reference model_store flow)."""
+    from mxnet_tpu.gluon.model_zoo import model_store
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    # author a repo tree holding a real resnet18_v1 checkpoint
+    repo = tmp_path / "repo" / "gluon" / "models"
+    repo.mkdir(parents=True)
+    src = vision.resnet18_v1(layout="NCHW")
+    src.initialize(mx.init.Xavier())
+    src(mx.nd.array(np.zeros((1, 3, 32, 32), np.float32)))
+    src.save_parameters(str(repo / "resnet18_v1.params"))
+
+    monkeypatch.setenv("MXNET_GLUON_REPO",
+                       "file://" + str(tmp_path / "repo"))
+    root = tmp_path / "cache"
+    path = model_store.get_model_file("resnet18_v1", root=str(root))
+    assert os.path.exists(path)
+
+    net = vision.resnet18_v1(pretrained=True, root=str(root))
+    a = src.features[0].weight.data().asnumpy()
+    b = net.features[0].weight.data().asnumpy()
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    model_store.purge(str(root))
+    assert not [f for f in os.listdir(root) if f.endswith(".params")]
