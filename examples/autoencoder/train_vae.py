@@ -1,0 +1,110 @@
+"""Variational autoencoder on synthetic glyphs (reference:
+example/autoencoder/ + vae-gan/).
+
+Exercises stochastic training graphs: the reparameterization trick
+(``nd.random.normal`` inside an autograd scope — gradients flow through
+the sampling), a KL-divergence regularizer written in ndarray ops, and
+decoder reconstruction.
+
+Usage:
+    python examples/autoencoder/train_vae.py [--epochs 15]
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+S = 12
+LATENT = 8
+
+
+def make_data(rs, n):
+    """Glyphs from a 2-factor generative process: bar position x width."""
+    x = np.zeros((n, S * S), np.float32)
+    for i in range(n):
+        pos = rs.randint(0, S - 3)
+        width = rs.randint(1, 4)
+        img = np.zeros((S, S), np.float32)
+        img[:, pos:pos + width] = 1.0
+        x[i] = img.ravel()
+    return x
+
+
+class VAE(gluon.Block):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.enc = nn.HybridSequential()
+            self.enc.add(nn.Dense(64, activation="relu"))
+            self.mu = nn.Dense(LATENT)
+            self.logvar = nn.Dense(LATENT)
+            self.dec = nn.HybridSequential()
+            self.dec.add(nn.Dense(64, activation="relu"),
+                         nn.Dense(S * S))
+
+    def forward(self, x):
+        h = self.enc(x)
+        mu, logvar = self.mu(h), self.logvar(h)
+        # reparameterization: z = mu + sigma * eps, eps ~ N(0, 1)
+        eps = nd.random.normal(0, 1, shape=mu.shape)
+        z = mu + (0.5 * logvar).exp() * eps
+        return self.dec(z), mu, logvar
+
+
+def train(args):
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    net = VAE()
+    net.initialize(mx.init.Xavier())
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss(from_sigmoid=False)
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 2e-3})
+
+    t0 = time.perf_counter()
+    for epoch in range(args.epochs):
+        tot = 0.0
+        for _ in range(args.iters):
+            x = nd.array(make_data(rs, args.batch))
+            with autograd.record():
+                logits, mu, logvar = net(x)
+                recon = bce(logits, x).sum(axis=-1).mean()
+                kl = (-0.5 * (1 + logvar - mu ** 2
+                              - logvar.exp())).sum(axis=-1).mean()
+                loss = recon + kl
+            loss.backward()
+            tr.step(args.batch)
+            tot += float(loss.asscalar())
+        if epoch % 5 == 0 or epoch == args.epochs - 1:
+            print("epoch %2d  elbo-loss %.3f" % (epoch, tot / args.iters))
+    print("trained in %.1fs" % (time.perf_counter() - t0))
+
+    # reconstruction quality: thresholded decode matches input pixels
+    x = make_data(rs, 256)
+    logits, _, _ = net(nd.array(x))
+    rec = (logits.asnumpy() > 0).astype(np.float32)
+    pix_acc = float((rec == x).mean())
+    print("reconstruction pixel accuracy: %.3f" % pix_acc)
+    return pix_acc
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=15)
+    ap.add_argument("--iters", type=int, default=25)
+    ap.add_argument("--batch", type=int, default=64)
+    args = ap.parse_args()
+    train(args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,3 +63,12 @@ def test_recommender_sparse_mf_learns():
     args = argparse.Namespace(epochs=10, iters=25, batch=256)
     rmse = train_mf.train(args)
     assert rmse < 0.25, rmse  # truth std ~0.94; no-learning baseline ~0.93
+
+
+def test_vae_reconstructs():
+    sys.path.insert(0, os.path.join(REPO, "examples", "autoencoder"))
+    import train_vae
+
+    args = argparse.Namespace(epochs=10, iters=20, batch=64)
+    acc = train_vae.train(args)
+    assert acc > 0.9, acc
