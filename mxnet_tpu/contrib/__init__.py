@@ -1,5 +1,5 @@
 """``mx.contrib`` — contrib namespaces (parity: python/mxnet/contrib/)."""
-from .. import amp  # noqa: F401
+from .. import amp  # noqa: F401  (reference path mx.contrib.amp)
 from . import quantization  # noqa: F401
 from . import onnx  # noqa: F401
 from . import text  # noqa: F401

@@ -22,6 +22,15 @@ def get_model_file(name, root=os.path.join("~", ".mxnet", "models")):
     path = os.path.join(root, file_name)
     if os.path.exists(path):
         return path
+    if "MXNET_GLUON_REPO" not in os.environ:
+        # the default public bucket stores hash-suffixed archives this
+        # rebuild does not mirror; hammering it would 404 through every
+        # retry.  Be direct about what works instead.
+        raise FileNotFoundError(
+            "%s not found locally (%s) and no MXNET_GLUON_REPO is set. "
+            "Place the checkpoint there, or point MXNET_GLUON_REPO at a "
+            "repository (https:// or file://) serving "
+            "gluon/models/%s" % (file_name, path, file_name))
     os.makedirs(root, exist_ok=True)
     url = _get_repo_file_url(_NAMESPACE, file_name)
     sha1 = None
@@ -31,10 +40,17 @@ def get_model_file(name, root=os.path.join("~", ".mxnet", "models")):
         sha1 = open(sha_path).read().split()[0].strip() or None
     except Exception:
         sha1 = None
-    download(url, path=path, sha1_hash=sha1)
-    if sha1 and not check_sha1(path, sha1):
-        raise ValueError(
-            "downloaded %s does not match its published sha1" % file_name)
+    try:
+        download(url, path=path, sha1_hash=sha1)
+        if sha1 and not check_sha1(path, sha1):
+            raise ValueError(
+                "downloaded %s does not match its published sha1"
+                % file_name)
+    finally:
+        try:
+            os.remove(path + ".sha1")
+        except OSError:
+            pass
     return path
 
 
@@ -44,5 +60,5 @@ def purge(root=os.path.join("~", ".mxnet", "models")):
     if not os.path.isdir(root):
         return
     for f in os.listdir(root):
-        if f.endswith(".params"):
+        if f.endswith((".params", ".params.sha1")):
             os.remove(os.path.join(root, f))

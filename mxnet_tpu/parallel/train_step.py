@@ -426,8 +426,10 @@ class JitTrainStep:
 
     def load_states(self, fname):
         """Restore a save_states checkpoint (same net/optimizer config).
-        May be called before or after the first step; placement (device,
-        mesh shardings) is re-applied."""
+
+        Requires placement to exist — run ONE step (any batch) first so
+        shapes/shardings are established, then load; the loaded state
+        fully overwrites that step's effects."""
         import pickle
 
         with open(fname, "rb") as f:
