@@ -53,6 +53,13 @@ def test_pretrained_factories_pin_nchw(monkeypatch):
         seen["layout"] = self._layout
 
     monkeypatch.setattr(Block, "load_parameters", fake_load)
+    # checkpoint resolution now goes through model_store; stub it (no
+    # repo in the test environment — see test_gluon_utils for the real
+    # download round-trip)
+    from mxnet_tpu.gluon.model_zoo import model_store
+
+    monkeypatch.setattr(model_store, "get_model_file",
+                        lambda name, root=None: "/dev/null")
     with layout_mod.layout_scope("NHWC"):
         vision.resnet18_v1(pretrained=True)
     assert seen["layout"] == "NCHW"
