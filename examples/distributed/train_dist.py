@@ -52,6 +52,7 @@ def main():
         loss.backward()
         trainer.step(x.shape[0] * nworker)  # global batch size
         if rank == 0 and i % 5 == 0:
+            # pull only on logged steps  # mxlint: allow-host-sync
             print("step %d loss %.5f" % (i, float(loss.mean().asnumpy())))
 
     final = float(loss.mean().asnumpy())

@@ -139,9 +139,9 @@ def main():
                          if p.grad_req != "null"]
                 gluon.utils.clip_global_norm(grads, args.clip)
             trainer.step(1)
-            val = float(l.asscalar())
         if i % 10 == 0 or i == args.steps - 1:
-            print("step %3d  loss %.4f" % (i, val))
+            # pull only on logged steps  # mxlint: allow-host-sync
+            print("step %3d  loss %.4f" % (i, float(l.asscalar())))
     dt = time.perf_counter() - t0
     tok_s = args.batch * args.seqlen * args.steps / dt
     print("done: %.0f tokens/s (incl. compile)" % tok_s)

@@ -102,8 +102,9 @@ def main():
                 g = exe.grad_dict.get(name)
                 if g is not None and name.endswith("weight"):
                     arr._set_data(arr.data() - args.lr * g.data())
-            total += float(out.asnumpy())
-        mse = total / (n // bs)
+            total = out + total  # device-side accumulate, no per-batch sync
+        # one intentional pull per epoch  # mxlint: allow-host-sync
+        mse = float(total.asscalar()) / (n // bs)
         if first is None:
             first = mse
         last = mse

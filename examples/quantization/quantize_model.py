@@ -46,6 +46,7 @@ def make_data(n, rs):
 def accuracy(net, x, y, batch=64):
     correct = 0
     for i in range(0, len(x), batch):
+        # eval-time pull, intentionally per batch  # mxlint: allow-host-sync
         out = net(nd.array(x[i:i + batch])).asnumpy()
         correct += int((out.argmax(1) == y[i:i + batch]).sum())
     return correct / len(x)
@@ -81,6 +82,7 @@ def main():
         loss.backward()
         trainer.step(bs)
         if step % 20 == 0:
+            # pull only on logged steps  # mxlint: allow-host-sync
             print("  step %d loss %.3f" % (step, float(loss.asnumpy())),
                   flush=True)
     fp32_acc = accuracy(net, x_test, y_test)

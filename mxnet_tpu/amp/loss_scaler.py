@@ -30,7 +30,8 @@ class LossScaler:
                 continue
             if g is None:
                 continue
-            a = g.asnumpy() if hasattr(g, "asnumpy") else np.asarray(g)
+            # dynamic loss scaling must inspect grads on host
+            a = g.asnumpy() if hasattr(g, "asnumpy") else np.asarray(g)  # mxlint: allow-host-sync
             if not np.isfinite(a.astype(np.float64)).all():
                 return True
         return False

@@ -1,0 +1,49 @@
+"""Framework-aware static analysis (``mxlint``) + runtime auditors.
+
+The paper's central bet — MXNet's async dependency engine collapsing onto
+XLA's enqueue-order execution (see ``engine.py``) — holds only while user
+and framework code keeps two contracts:
+
+1. nothing inside a hybridized/traced region forces a host round-trip or a
+   Python-level data-dependent branch (XLA traces would either crash or
+   silently bake in one branch), and
+2. every device→host sync on the eager path is *intentional*, because each
+   one stalls the PJRT stream the engine relies on for overlap.
+
+This package enforces both, statically and at runtime, with four passes:
+
+* **tracing-safety lint** (``TS1xx``, ``tracing_safety``) — AST pass over
+  ``hybrid_forward`` bodies and jit-wrapped functions: data-dependent
+  ``if``/``while`` on array values, host coercions, in-place mutation of
+  traced arrays, calls to ops absent from ``ops.registry``.
+* **host-sync detector** (``HS2xx``, ``host_sync``) — static flagging of
+  implicit device→host syncs inside loops, plus a runtime ``SyncCounter``
+  built on the engine's sync-hook surface (``Engine.add_hook(fn,
+  kind='sync')``) reporting syncs-per-step.
+* **engine dependency auditor** (``EA4xx``, ``engine_audit``) — runtime
+  checker (``MXNET_ENGINE_AUDIT=1``) validating read/write var sets at
+  ``Engine.push``: out-of-band writes that skip ``Var.on_write``,
+  overlapping write sets from concurrent threads, version regressions.
+* **registry consistency checker** (``RC3xx``, ``registry_check``) — every
+  registered op must have a coherent ``num_outputs``/``input_names``/doc
+  and, where a gradient is expected, a differentiable forward under
+  ``jax.eval_shape``.
+
+CLI: ``python tools/mxlint.py mxnet_tpu/ examples/`` (the repo's own source
+is a permanent lint target; intentional syncs carry
+``# mxlint: allow-host-sync`` or an entry in
+``tools/mxlint_suppressions.txt``).  Docs: ``docs/static_analysis.md``.
+"""
+from __future__ import annotations
+
+from .findings import Finding, RULES, rule_doc
+from .driver import lint_paths, lint_source, lint_block, check_registry
+from .host_sync import SyncCounter
+from .engine_audit import EngineAudit, EngineAuditError, install, uninstall
+
+__all__ = [
+    "Finding", "RULES", "rule_doc",
+    "lint_paths", "lint_source", "lint_block", "check_registry",
+    "SyncCounter",
+    "EngineAudit", "EngineAuditError", "install", "uninstall",
+]

@@ -1,0 +1,192 @@
+"""mxlint driver: walk paths, run the static passes, apply suppressions.
+
+Programmatic API (what ``tools/mxlint.py`` and the test suite call):
+
+* ``lint_paths(paths, ...)`` — files/dirs → sorted, suppression-filtered
+  findings.
+* ``lint_source(source, path, ...)`` — one source string (used by
+  ``HybridBlock.lint()``).
+* ``lint_block(block)`` — a live ``HybridBlock``: lints its
+  ``hybrid_forward`` source (and its children's, recursively).
+* ``check_registry(...)`` — RC3xx pass, suppression-filtered.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import textwrap
+
+from . import host_sync, tracing_safety
+from .suppressions import SuppressionFile, inline_suppressed
+
+_SKIP_DIRS = frozenset({"__pycache__", ".git", "node_modules", "build",
+                        "dist", ".ipynb_checkpoints"})
+
+
+def default_suppression_file():
+    """``tools/mxlint_suppressions.txt`` relative to the repo root."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(root, "tools", "mxlint_suppressions.txt")
+
+
+def registry_op_names():
+    """Names valid after ``F.`` in a traced body: registry ops + aliases +
+    the public surface of the ndarray/symbol modules (``F`` is one of the
+    two at trace time).  ``None`` on import failure → TS105 is skipped."""
+    try:
+        from ..ops import registry as _reg
+        from .. import ndarray as _nd
+        from .. import symbol as _sym
+
+        names = set(_reg._REGISTRY) | set(_reg._ALIASES)
+        names.update(n for n in dir(_nd) if not n.startswith("__"))
+        names.update(n for n in dir(_sym) if not n.startswith("__"))
+        return names
+    except Exception:
+        return None
+
+
+def _iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in _SKIP_DIRS
+                                     and not d.startswith("."))
+                for f in sorted(filenames):
+                    if f.endswith(".py"):
+                        yield os.path.join(dirpath, f)
+        else:
+            raise FileNotFoundError(p)
+
+
+def _load_suppressions(suppressions):
+    if isinstance(suppressions, SuppressionFile):
+        return suppressions
+    if suppressions is None:
+        path = default_suppression_file()
+        if os.path.exists(path):
+            return SuppressionFile.load(path)
+        return SuppressionFile()
+    return SuppressionFile.load(suppressions)
+
+
+def _filter(findings, source_lines, supp):
+    kept = []
+    for f in findings:
+        if source_lines is not None and inline_suppressed(source_lines, f):
+            continue
+        if supp is not None and supp.suppressed(f):
+            continue
+        kept.append(f)
+    return kept
+
+
+def lint_source(source, path="<string>", registry_names=None, strict=False,
+                suppressions=None):
+    """Lint one source string; returns findings (suppression-filtered)."""
+    tree = ast.parse(source, filename=path)
+    findings = []
+    tracing_safety.run(path, tree, registry_names, findings)
+    host_sync.run(path, tree, findings, strict=strict)
+    supp = suppressions if isinstance(suppressions, SuppressionFile) \
+        else (SuppressionFile() if suppressions is None
+              else _load_suppressions(suppressions))
+    return _filter(findings, source.splitlines(), supp)
+
+
+def lint_paths(paths, registry_names=None, strict=False, suppressions=None,
+               relative_to=None):
+    """Lint files/directories.  Returns sorted findings.
+
+    ``registry_names``: pass a set to enable TS105 with it, ``None`` to
+    resolve from the live registry (TS105 silently off if that import
+    fails).  ``suppressions``: a path, a ``SuppressionFile``, or ``None``
+    for the repo default.  ``relative_to``: base dir findings' paths are
+    reported (and glob-matched) against; defaults to the repo root when
+    linting inside it, else cwd.
+    """
+    if registry_names is None:
+        registry_names = registry_op_names()
+    supp = _load_suppressions(suppressions)
+    if relative_to is None:
+        relative_to = os.getcwd()
+    all_findings = []
+    for fpath in _iter_py_files(paths):
+        try:
+            with open(fpath, encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=fpath)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            # un-parseable file: real finding, not a crash
+            from .findings import Finding
+            all_findings.append(Finding(
+                _rel(fpath, relative_to), getattr(e, "lineno", 0) or 0, 0,
+                "TS101", "file does not parse: %s" % e))
+            continue
+        rel = _rel(fpath, relative_to)
+        findings = []
+        tracing_safety.run(rel, tree, registry_names, findings)
+        host_sync.run(rel, tree, findings, strict=strict)
+        all_findings.extend(_filter(findings, source.splitlines(), supp))
+    all_findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return all_findings
+
+
+def _rel(path, base):
+    ap = os.path.abspath(path)
+    ab = os.path.abspath(base)
+    if ap.startswith(ab + os.sep):
+        return os.path.relpath(ap, ab)
+    return path
+
+
+def check_registry(suppressions=None, probe=True, strict=False):
+    """RC3xx pass over the live registry, suppression-filtered."""
+    from . import registry_check
+
+    supp = _load_suppressions(suppressions)
+    findings = registry_check.run(probe=probe, strict=strict)
+    return _filter(findings, None, supp)
+
+
+def lint_block(block, registry_names=None, strict=False):
+    """Lint a live HybridBlock's ``hybrid_forward`` (and its children's).
+
+    Returns findings whose paths are ``<ClassName>.hybrid_forward``.
+    Blocks whose source is unavailable (built in a REPL, C extension) are
+    skipped.
+    """
+    import inspect
+
+    if registry_names is None:
+        registry_names = registry_op_names()
+    findings = []
+    seen = set()
+    stack = [block]
+    while stack:
+        b = stack.pop()
+        if id(b) in seen:
+            continue
+        seen.add(id(b))
+        stack.extend(getattr(b, "_children", {}).values())
+        fwd = getattr(type(b), "hybrid_forward", None)
+        if fwd is None:
+            continue
+        try:
+            source = textwrap.dedent(inspect.getsource(fwd))
+        except (OSError, TypeError):
+            continue
+        pseudo = "%s.hybrid_forward" % type(b).__name__
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            continue
+        fs = []
+        tracing_safety.run(pseudo, tree, registry_names, fs)
+        host_sync.run(pseudo, tree, fs, strict=strict)
+        findings.extend(_filter(fs, source.splitlines(), SuppressionFile()))
+    return findings

@@ -1,0 +1,122 @@
+"""EA4xx — runtime engine dependency auditor (``MXNET_ENGINE_AUDIT=1``).
+
+The TPU engine (``engine.py``) keeps MXNet's versioned-variable contract:
+every mutation of engine-visible state flows through ``Engine.push`` with a
+declared ``write_vars`` set, and ``push`` is the only caller of
+``Var.on_write``.  The whole "async engine collapses onto XLA enqueue
+order" argument rests on that contract — state changing outside a declared
+write set is invisible to the executable caches keyed on versions, and is
+precisely the class of reference bugs the registry docstring claims is
+"gone by design".  This auditor makes the claim checkable:
+
+* ``EA401`` *out-of-band write* — a var arrives at ``push`` with a version
+  different from the one the engine last published for it: something wrote
+  it while skipping ``Var.on_write``/the declared write set (or bumped it
+  by hand and never declared the write).
+* ``EA402`` *overlapping concurrent writes* — two threads are inside
+  ``push`` simultaneously with intersecting write sets; enqueue order no
+  longer determines the final version.
+* ``EA403`` *version regression* — a var's version moved backwards; state
+  was rolled back behind the engine's back.
+
+Enable with ``MXNET_ENGINE_AUDIT=1`` (checked at Engine construction), or
+programmatically::
+
+    from mxnet_tpu.analysis import install, uninstall
+    audit = install()           # raises EngineAuditError on violation
+    audit = install(strict=False)   # collect into audit.violations
+"""
+from __future__ import annotations
+
+import threading
+
+from ..base import MXNetError
+from .findings import rule_doc
+
+
+class EngineAuditError(MXNetError):
+    """A declared read/write var set was violated (see rule EA4xx)."""
+
+    def __init__(self, rule, message):
+        super().__init__("%s — %s" % (message, rule_doc(rule)))
+        self.rule = rule
+
+
+class EngineAudit:
+    """Validates var sets at every ``Engine.push``; see module docstring.
+
+    The engine calls ``before_push``/``after_push`` around the op body when
+    an audit is installed (``Engine._audit``).  Thread-safe: the writing-set
+    table is the whole point of EA402.
+    """
+
+    def __init__(self, strict=True):
+        self.strict = strict
+        self.violations = []  # (rule, message) when strict=False
+        self._lock = threading.Lock()
+        self._published = {}  # vid -> version as last seen by the engine
+        self._writing = {}    # vid -> thread ident currently writing it
+        self.checked_pushes = 0
+
+    def _violate(self, rule, message):
+        if self.strict:
+            raise EngineAuditError(rule, message)
+        self.violations.append((rule, message))
+
+    def before_push(self, read_vars, write_vars, op_name):
+        me = threading.get_ident()
+        name = op_name or "<op>"
+        with self._lock:
+            self.checked_pushes += 1
+            for v in tuple(read_vars) + tuple(write_vars):
+                last = self._published.get(v.vid)
+                if last is None:
+                    self._published[v.vid] = v.version
+                elif v.version < last:
+                    self._violate(
+                        "EA403",
+                        "var #%d at version %d but engine last published "
+                        "%d (push of %s)" % (v.vid, v.version, last, name))
+                elif v.version != last:
+                    self._violate(
+                        "EA401",
+                        "var #%d at version %d but engine last published "
+                        "%d: it was written outside a declared write set "
+                        "(push of %s)" % (v.vid, v.version, last, name))
+            for v in write_vars:
+                owner = self._writing.get(v.vid)
+                if owner is not None and owner != me:
+                    self._violate(
+                        "EA402",
+                        "var #%d is in the write set of two concurrent "
+                        "pushes (threads %d and %d; push of %s)"
+                        % (v.vid, owner, me, name))
+                else:
+                    self._writing[v.vid] = me
+
+    def after_push(self, read_vars, write_vars, op_name):
+        me = threading.get_ident()
+        with self._lock:
+            for v in write_vars:
+                if self._writing.get(v.vid) == me:
+                    del self._writing[v.vid]
+            # publish post-push versions (push bumped the write vars)
+            for v in tuple(read_vars) + tuple(write_vars):
+                self._published[v.vid] = v.version
+
+
+def install(engine=None, strict=True):
+    """Attach a fresh ``EngineAudit`` to the engine; returns it."""
+    if engine is None:
+        from ..engine import Engine
+        engine = Engine.get()
+    audit = EngineAudit(strict=strict)
+    engine._audit = audit
+    return audit
+
+
+def uninstall(engine=None):
+    if engine is None:
+        from ..engine import Engine
+        engine = Engine.get()
+    engine._audit = None

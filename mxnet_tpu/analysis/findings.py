@@ -1,0 +1,104 @@
+"""Finding record + the rule table shared by every mxlint pass.
+
+Rule ID bands (stable, documented in ``docs/static_analysis.md``):
+
+* ``TS1xx`` — tracing safety (static, hybrid_forward / jitted bodies only)
+* ``HS2xx`` — host-sync hygiene (static, any code)
+* ``RC3xx`` — op-registry consistency (semi-static, needs an importable
+  registry)
+* ``EA4xx`` — engine dependency audit (runtime only; listed here so the
+  audit raises with the same vocabulary the linter reports in)
+"""
+from __future__ import annotations
+
+
+# rule id -> (slug, default-on, one-line doc)
+RULES = {
+    "TS101": ("data-dependent-branch", True,
+              "`if` on a traced array value — XLA tracing bakes in one "
+              "branch (or crashes on ConcretizationError)"),
+    "TS102": ("data-dependent-loop", True,
+              "`while` on a traced array value — trip count cannot be "
+              "staged into the graph"),
+    "TS103": ("host-coercion-in-trace", True,
+              ".asnumpy()/.asscalar()/.item()/float()/int()/bool() on a "
+              "traced array forces a device->host sync mid-trace"),
+    "TS104": ("traced-array-mutation", True,
+              "in-place subscript store into a traced array — functional "
+              "arrays ignore it silently under tracing"),
+    "TS105": ("unregistered-op", True,
+              "call to an F.<op> absent from ops.registry "
+              "(_REGISTRY/_ALIASES) — fails only at first trace"),
+    "HS201": ("host-sync-in-loop", True,
+              ".asnumpy()/.asscalar()/.item() inside a loop — one "
+              "device->host pull per iteration stalls the async stream"),
+    "HS202": ("blocking-wait-in-loop", True,
+              "wait_to_read()/waitall()/block_until_ready() inside a loop "
+              "serializes dispatch against the device"),
+    "HS203": ("ndarray-print-in-loop", True,
+              "printing a device array inside a loop implicitly syncs "
+              "every iteration (repr pulls the buffer)"),
+    "HS204": ("per-batch-metric-update", False,
+              "metric.update() per batch may pull device buffers each "
+              "iteration; accumulate on device and pull once per get() "
+              "(advisory, enabled with --strict)"),
+    "RC301": ("num-outputs-mismatch", True,
+              "registered num_outputs disagrees with the forward's actual "
+              "output count under jax.eval_shape"),
+    "RC302": ("missing-op-doc", False,
+              "registered op has no docstring (advisory — most ops are "
+              "registered lambdas; enabled with --strict)"),
+    "RC303": ("incoherent-input-names", True,
+              "input_names empty/duplicated or colliding with attr names "
+              "for a non-variadic op"),
+    "RC304": ("alias-shadows-primary", True,
+              "an alias name collides with a primary op name (lookup "
+              "would silently prefer the primary)"),
+    "RC305": ("non-differentiable-forward", True,
+              "float-valued op's forward fails jax.vjp under eval_shape — "
+              "gradient expected but untraceable"),
+    "EA401": ("out-of-band-write", True,
+              "a var's version changed outside Engine.push — a write "
+              "skipped Var.on_write / the declared write set"),
+    "EA402": ("overlapping-concurrent-writes", True,
+              "two threads pushed overlapping write sets concurrently"),
+    "EA403": ("version-regression", True,
+              "a var's version moved backwards — state was rolled back "
+              "or a stale Var was resurrected"),
+}
+
+
+def rule_doc(rule_id):
+    slug, _default, doc = RULES[rule_id]
+    return "%s (%s): %s" % (rule_id, slug, doc)
+
+
+class Finding:
+    """One lint finding, printable as ``path:line:col: RULE message``."""
+
+    __slots__ = ("path", "line", "col", "rule", "message")
+
+    def __init__(self, path, line, col, rule, message):
+        self.path = path
+        self.line = line
+        self.col = col
+        self.rule = rule
+        self.message = message
+
+    @property
+    def slug(self):
+        return RULES[self.rule][0]
+
+    def __repr__(self):
+        return "Finding(%s:%s:%s %s)" % (self.path, self.line, self.col,
+                                         self.rule)
+
+    def __str__(self):
+        return "%s:%d:%d: %s [%s] %s" % (
+            self.path, self.line, self.col, self.rule, self.slug,
+            self.message)
+
+    def as_dict(self):
+        return {"path": self.path, "line": self.line, "col": self.col,
+                "rule": self.rule, "slug": self.slug,
+                "message": self.message}

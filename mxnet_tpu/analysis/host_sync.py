@@ -1,0 +1,204 @@
+"""HS2xx — host-sync detector: static pass + runtime ``SyncCounter``.
+
+Static side: flags implicit device→host syncs *inside loops* anywhere in a
+module (not just traced bodies) — each such sync stalls the PJRT stream
+once per iteration, which is exactly the failure mode that shows up in
+benchmarks as a mysterious 2-10x slowdown with no error:
+
+* ``HS201`` — ``.asnumpy()``/``.asscalar()``/``.item()`` in a loop body
+* ``HS202`` — ``.wait_to_read()``/``waitall()``/``.block_until_ready()``
+  in a loop body
+* ``HS203`` — ``print()`` of a value assigned from a device op in a loop
+  (``repr`` pulls the buffer)
+* ``HS204`` — per-batch ``metric.update()`` (advisory; only with
+  ``--strict`` — after ``metric.py``'s device-side accumulation this is
+  cheap for the built-in metrics, but custom metrics may still pull)
+
+Runtime side: ``SyncCounter`` subscribes to the engine's sync-hook surface
+(``Engine.add_hook(fn, kind='sync')``; every ``asnumpy``/``wait_to_read``/
+``waitall`` reports through ``Engine.notify_sync``) and aggregates
+syncs-per-step, the number to watch when a training loop underperforms.
+"""
+from __future__ import annotations
+
+import ast
+import collections
+
+from .findings import Finding
+
+_PULL_METHODS = frozenset({"asnumpy", "asscalar", "item"})
+_WAIT_METHODS = frozenset({"wait_to_read", "block_until_ready"})
+_WAIT_FUNCS = frozenset({"waitall"})
+
+# call chains whose results we consider device arrays for HS203 taint:
+# nd.zeros(...), mx.nd.ones(...), F.softmax(...), mx.np.arange(...)
+_DEVICE_MODULES = frozenset({"nd", "F", "np", "npx"})
+
+
+def _dotted(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_device_producer(call):
+    """Heuristic: does this Call produce a device array?"""
+    fname = _dotted(call.func)
+    if not fname:
+        return False
+    head = fname.split(".")[0]
+    if head in ("mx", "mxnet", "mxnet_tpu"):
+        parts = fname.split(".")
+        return len(parts) >= 2 and parts[1] in _DEVICE_MODULES
+    return head in _DEVICE_MODULES and "." in fname
+
+
+class _HostSyncChecker(ast.NodeVisitor):
+    def __init__(self, path, findings, strict=False):
+        self.path = path
+        self.findings = findings
+        self.strict = strict
+        self.loop_depth = 0
+        self.device_names = set()  # names assigned from device producers
+
+    def _flag(self, node, rule, message):
+        self.findings.append(Finding(self.path, node.lineno,
+                                     getattr(node, "col_offset", 0),
+                                     rule, message))
+
+    # -- device-name taint (for HS203 only) -------------------------------
+    def visit_Assign(self, node):
+        produces = (isinstance(node.value, ast.Call)
+                    and _is_device_producer(node.value))
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                if produces:
+                    self.device_names.add(tgt.id)
+                else:
+                    self.device_names.discard(tgt.id)
+        self.generic_visit(node)
+
+    # -- loops -------------------------------------------------------------
+    def _loop(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_For = _loop
+    visit_While = _loop
+    visit_AsyncFor = _loop
+
+    # -- calls -------------------------------------------------------------
+    def visit_Call(self, node):
+        if self.loop_depth > 0:
+            fn = node.func
+            if isinstance(fn, ast.Attribute):
+                if fn.attr in _PULL_METHODS:
+                    self._flag(node, "HS201",
+                               ".%s() inside a loop pulls device->host "
+                               "every iteration; accumulate on device and "
+                               "pull once outside" % fn.attr)
+                elif fn.attr in _WAIT_METHODS or fn.attr in _WAIT_FUNCS:
+                    self._flag(node, "HS202",
+                               ".%s() inside a loop serializes the async "
+                               "stream every iteration" % fn.attr)
+                elif (self.strict and fn.attr == "update"
+                      and "metric" in _dotted(fn.value).lower()):
+                    self._flag(node, "HS204",
+                               "per-batch metric.update(); built-in "
+                               "metrics accumulate on device, custom ones "
+                               "may sync per batch")
+            elif isinstance(fn, ast.Name):
+                if fn.id in _WAIT_FUNCS:
+                    self._flag(node, "HS202",
+                               "%s() inside a loop blocks on everything "
+                               "in flight every iteration" % fn.id)
+                elif fn.id == "print":
+                    for a in node.args:
+                        if (isinstance(a, ast.Name)
+                                and a.id in self.device_names):
+                            self._flag(node, "HS203",
+                                       "printing device array %r in a "
+                                       "loop syncs every iteration "
+                                       "(format once outside, or pull "
+                                       "explicitly)" % a.id)
+                            break
+        self.generic_visit(node)
+
+
+def run(path, tree, findings=None, strict=False):
+    """Run the HS pass over one parsed module; returns the findings list."""
+    if findings is None:
+        findings = []
+    _HostSyncChecker(path, findings, strict=strict).visit(tree)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# runtime mode
+# ---------------------------------------------------------------------------
+class SyncCounter:
+    """Count device→host syncs per training step via the engine sync hook.
+
+    Usage::
+
+        with SyncCounter() as sc:
+            for batch in loader:
+                step(batch)
+                sc.step()
+        print(sc.report())   # {'steps': N, 'total': M, 'per_step': ...}
+
+    A steady-state training step should report ~0 syncs; one sync per step
+    means a hidden ``.asnumpy()`` (run ``tools/mxlint.py`` to find it).
+    """
+
+    def __init__(self, engine=None):
+        if engine is None:
+            from ..engine import Engine
+            engine = Engine.get()
+        self._engine = engine
+        self.origins = collections.Counter()
+        self.per_step = []
+        self._in_step = 0
+
+    # the hook: one call per sync event
+    def _on_sync(self, origin):
+        self.origins[origin] += 1
+        self._in_step += 1
+
+    def install(self):
+        self._engine.add_hook(self._on_sync, kind="sync")
+        return self
+
+    def uninstall(self):
+        self._engine.remove_hook(self._on_sync, kind="sync")
+
+    __enter__ = install
+
+    def __exit__(self, *exc):
+        self.uninstall()
+
+    def step(self):
+        """Mark a step boundary; returns syncs observed in the step."""
+        n, self._in_step = self._in_step, 0
+        self.per_step.append(n)
+        return n
+
+    @property
+    def total(self):
+        return sum(self.origins.values())
+
+    def report(self):
+        steps = len(self.per_step)
+        return {
+            "steps": steps,
+            "total": self.total,
+            "per_step": list(self.per_step),
+            "syncs_per_step": (sum(self.per_step) / steps) if steps else 0.0,
+            "origins": dict(self.origins),
+        }

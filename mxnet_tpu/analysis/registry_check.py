@@ -1,0 +1,159 @@
+"""RC3xx — op-registry consistency checker.
+
+``ops/registry.py`` claims shape/dtype inference "falls out of
+``jax.eval_shape`` on the same function, so ops can never disagree with
+their inference".  True for shapes — but the registry still carries
+*declared* metadata (``num_outputs``, ``input_names``, docs) that nothing
+cross-checked until now.  This pass closes the loop abstractly (no device
+work, everything under ``jax.eval_shape``):
+
+* ``RC301`` — declared ``num_outputs`` vs the forward's actual output
+  count when probed with abstract inputs.
+* ``RC302`` — registered op without a docstring.
+* ``RC303`` — ``input_names`` empty/duplicated for a non-variadic op, or
+  colliding with ``attr_names`` (the positional-attr dispatcher would
+  mis-bind).
+* ``RC304`` — an alias that shadows a primary op name (``get()`` resolves
+  the primary first, so the alias silently never fires).
+* ``RC305`` — a float-valued op whose forward has no abstract ``jax.vjp``
+  — a gradient is expected (autograd's lazy tape will vjp it on backward)
+  but tracing one fails.
+
+Probing is best-effort: ops whose forwards need attrs or specific ranks
+reject the generic probe shapes with a shape/type error — those are
+*skipped*, not flagged (the check only asserts on ops it could actually
+evaluate).  Known-intentional exceptions live in the suppression file as
+``op:<name>: RULE`` entries.
+"""
+from __future__ import annotations
+
+from .findings import Finding
+
+# probe shape-sets tried in order until one traces (all inputs share a
+# shape; rank variety covers elementwise, matmul-ish and NHWC-ish ops)
+_PROBE_SHAPES = ((2, 3), (2, 3, 4), (1, 4, 8, 8), (4,))
+
+# int-valued / index-producing / mode-gated ops legitimately have no vjp;
+# the built-in list covers jax primitives' hard non-differentiables, the
+# suppression file covers op-specific judgment calls
+_NONDIFF_HINTS = ("argmax", "argmin", "argsort", "topk", "one_hot", "shape",
+                  "size", "round", "floor", "ceil", "sign", "equal",
+                  "not_equal", "greater", "lesser", "logical", "random",
+                  "sample", "multinomial")
+
+
+def _probe_args(reg, shape, jnp):
+    import jax
+
+    args = []
+    if reg.needs_rng:
+        args.append(jax.ShapeDtypeStruct((2,), jnp.uint32))
+    n = max(len(reg.input_names), 1) if not reg.variadic else 2
+    for _ in range(n):
+        args.append(jax.ShapeDtypeStruct(shape, jnp.float32))
+    return tuple(args)
+
+
+def _eval_op(reg):
+    """Try to eval_shape the raw forward; returns (outputs, args) or None."""
+    import jax
+    import jax.numpy as jnp
+
+    attrs = {}
+    if reg.needs_mode:
+        attrs["_mode"] = "predict"
+    for shape in _PROBE_SHAPES:
+        args = _probe_args(reg, shape, jnp)
+        try:
+            out = jax.eval_shape(lambda *xs: reg.forward(*xs, **attrs), *args)
+        except Exception:
+            continue
+        outs = out if isinstance(out, tuple) else (out,)
+        return outs, args
+    return None
+
+
+def run(registry=None, aliases=None, findings=None, probe=True,
+        strict=False):
+    """Check every registered op; returns the findings list.
+
+    Findings carry pseudo-paths ``op:<name>`` so the suppression file can
+    allowlist individual ops.  ``num_outputs=-1`` is the registry's
+    "variadic outputs" convention (split/topk/multi-tensor optimizers) and
+    exempts an op from output-count checks.  ``strict`` enables the
+    advisory RC302 docstring rule.
+    """
+    if registry is None or aliases is None:
+        from ..ops import registry as _reg
+        registry = _reg._REGISTRY if registry is None else registry
+        aliases = _reg._ALIASES if aliases is None else aliases
+    if findings is None:
+        findings = []
+
+    for alias_name in sorted(aliases):
+        if alias_name in registry:
+            findings.append(Finding(
+                "op:%s" % alias_name, 0, 0, "RC304",
+                "alias %r also names a primary op; get() always resolves "
+                "the primary, the alias target %r is unreachable"
+                % (alias_name, aliases[alias_name])))
+
+    for name in sorted(registry):
+        reg = registry[name]
+        path = "op:%s" % name
+        if strict and not (reg.doc or "").strip():
+            findings.append(Finding(
+                path, 0, 0, "RC302",
+                "op %r has no docstring (OpReg.doc is empty)" % name))
+        if not reg.variadic:
+            if len(set(reg.input_names)) != len(reg.input_names):
+                findings.append(Finding(
+                    path, 0, 0, "RC303",
+                    "op %r declares duplicate input_names %r"
+                    % (name, reg.input_names)))
+            overlap = set(reg.input_names) & set(reg.attr_names)
+            if overlap:
+                findings.append(Finding(
+                    path, 0, 0, "RC303",
+                    "op %r: names %r are both inputs and attrs — the "
+                    "positional-attr binder would mis-bind"
+                    % (name, sorted(overlap))))
+        if reg.num_outputs < 1 and reg.num_outputs != -1:
+            findings.append(Finding(
+                path, 0, 0, "RC303",
+                "op %r declares num_outputs=%r" % (name, reg.num_outputs)))
+
+        if not probe:
+            continue
+        probed = _eval_op(reg)
+        if probed is None:
+            continue  # needs attrs/specific ranks: skipped, not flagged
+        outs, args = probed
+        if reg.num_outputs != -1 and len(outs) != reg.num_outputs:
+            findings.append(Finding(
+                path, 0, 0, "RC301",
+                "op %r declares num_outputs=%d but its forward returned "
+                "%d output(s) under jax.eval_shape"
+                % (name, reg.num_outputs, len(outs))))
+            continue
+        lname = name.lower()
+        if (all(o.dtype.kind == "f" for o in outs)
+                and not any(h in lname for h in _NONDIFF_HINTS)
+                and not reg.needs_rng):
+            import jax
+
+            attrs = {"_mode": "predict"} if reg.needs_mode else {}
+
+            def fwd(*xs):
+                out = reg.forward(*xs, **attrs)
+                return out if isinstance(out, tuple) else (out,)
+
+            try:
+                jax.eval_shape(lambda *xs: jax.vjp(fwd, *xs), *args)
+            except Exception as e:
+                findings.append(Finding(
+                    path, 0, 0, "RC305",
+                    "op %r: float-valued forward has no abstract jax.vjp "
+                    "(%s: %s) — gradient expected but untraceable"
+                    % (name, type(e).__name__, str(e).split("\n")[0][:120])))
+    return findings

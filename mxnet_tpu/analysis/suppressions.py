@@ -1,0 +1,84 @@
+"""Suppression plumbing: inline comments + the repo-level suppression file.
+
+Inline (on the finding's line, or alone on the line directly above):
+
+* ``# mxlint: disable=TS101`` / ``# mxlint: disable=HS201,HS202``
+* ``# mxlint: allow-host-sync`` — shorthand for every sync-shaped rule
+  (HS201, HS202, HS203, HS204, TS103); this is the comment the framework's
+  own intentional syncs carry (e.g. ``metric.py``'s one pull per ``get()``).
+
+Suppression file (default ``tools/mxlint_suppressions.txt``), one entry per
+line, ``#`` comments allowed::
+
+    <path-glob>: RULE[,RULE...]     # why
+    op:<op-name>: RULE[,RULE...]    # registry-check allowlist
+
+Path globs match against the path as reported (relative to the lint root)
+via ``fnmatch``, so ``mxnet_tpu/kvstore/*`` works.  ``op:`` entries feed the
+registry consistency checker, whose findings have pseudo-paths
+``op:<name>``.
+"""
+from __future__ import annotations
+
+import fnmatch
+import re
+
+_INLINE = re.compile(r"#\s*mxlint:\s*(allow-host-sync|disable=([A-Z]{2}\d{3}"
+                     r"(?:\s*,\s*[A-Z]{2}\d{3})*))")
+
+_ALLOW_HOST_SYNC = frozenset({"HS201", "HS202", "HS203", "HS204", "TS103"})
+
+
+def inline_suppressed(source_lines, finding):
+    """True if the finding's own line (or a pure-comment line directly
+    above it) carries a matching ``# mxlint:`` comment."""
+    for lineno in (finding.line, finding.line - 1):
+        if not 1 <= lineno <= len(source_lines):
+            continue
+        text = source_lines[lineno - 1]
+        if lineno != finding.line and not text.lstrip().startswith("#"):
+            continue
+        for m in _INLINE.finditer(text):
+            if m.group(1) == "allow-host-sync":
+                if finding.rule in _ALLOW_HOST_SYNC:
+                    return True
+            else:
+                rules = {r.strip() for r in m.group(2).split(",")}
+                if finding.rule in rules:
+                    return True
+    return False
+
+
+class SuppressionFile:
+    """Parsed ``mxlint_suppressions.txt``; answers path/rule queries."""
+
+    def __init__(self, entries=()):
+        # list of (path_glob, frozenset(rules))
+        self.entries = list(entries)
+
+    @classmethod
+    def load(cls, path):
+        entries = []
+        with open(path, encoding="utf-8") as f:
+            for raw in f:
+                line = raw.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                if ":" not in line:
+                    raise ValueError(
+                        "malformed suppression entry %r (want "
+                        "'<glob>: RULE[,RULE]')" % raw.strip())
+                # rsplit: 'op:<name>: RULES' keeps 'op:<name>' intact
+                glob, rules = line.rsplit(":", 1)
+                entries.append((glob.strip(),
+                                frozenset(r.strip() for r in
+                                          rules.split(",") if r.strip())))
+        return cls(entries)
+
+    def suppressed(self, finding):
+        for glob, rules in self.entries:
+            if finding.rule not in rules and "*" not in rules:
+                continue
+            if fnmatch.fnmatch(finding.path, glob):
+                return True
+        return False

@@ -1,0 +1,287 @@
+"""TS1xx — tracing-safety lint over traceable function bodies.
+
+Targets, found purely syntactically so the pass runs on un-importable
+sources:
+
+* every ``def hybrid_forward(self, F, ...)`` (the ``HybridBlock`` contract:
+  tensor inputs are everything after ``F``, plus ``*args``/``**params``);
+* functions decorated with ``jit`` / ``jax.jit`` / ``partial(jax.jit, ...)``
+  (all parameters treated as traced);
+* module-level functions passed by name to ``hybridize(...)`` or
+  ``jax.jit(...)`` anywhere in the same module.
+
+The pass runs a small intraprocedural taint analysis: tensor parameters
+seed the tainted set; taint flows through arithmetic, comparisons,
+subscripts, method calls on tainted receivers and ``F.*`` op calls, and
+stops at host metadata (``.shape``/``.dtype``/``.ndim``/``.size``/
+``len()``) and ``is None`` checks — that is exactly the boundary between
+"graph value" and "Python value" that XLA tracing enforces at runtime.
+Over-taint produces false positives (suppressible), under-taint misses
+bugs; the metadata stops above keep the framework's own 100+
+``hybrid_forward`` bodies clean without suppressions.
+"""
+from __future__ import annotations
+
+import ast
+
+from .findings import Finding
+
+# attributes of an array that live on the HOST (reading them under trace is
+# free and yields plain Python values)
+_METADATA_ATTRS = frozenset({
+    "shape", "dtype", "ndim", "size", "context", "ctx", "stype", "name",
+})
+
+# calls that launder taint into host values we intentionally don't chase
+_HOST_BUILTINS = frozenset({
+    "len", "isinstance", "issubclass", "getattr", "hasattr", "type", "str",
+    "repr", "range", "enumerate", "zip", "list", "tuple", "dict", "set",
+    "sorted", "reversed", "print", "format", "id", "callable", "min", "max",
+})
+
+# builtin coercions that force a concrete value out of a tracer (TS103)
+_COERCIONS = frozenset({"float", "int", "bool", "complex"})
+
+# method names that force a device->host sync (TS103)
+_SYNC_METHODS = frozenset({"asnumpy", "asscalar", "item", "tolist",
+                           "wait_to_read"})
+
+
+def _decorator_is_jit(dec):
+    """True for @jit, @jax.jit, @partial(jax.jit, ...), @functools.partial(
+    jax.jit, ...)."""
+    if isinstance(dec, ast.Call):
+        fname = _dotted(dec.func)
+        if fname in ("partial", "functools.partial") and dec.args:
+            return _dotted(dec.args[0]) in ("jit", "jax.jit")
+        return _dotted(dec.func) in ("jit", "jax.jit")
+    return _dotted(dec) in ("jit", "jax.jit")
+
+
+def _dotted(node):
+    """'a.b.c' for Name/Attribute chains, else ''. """
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _jit_call_targets(tree):
+    """Names of module-level functions passed to hybridize()/jax.jit()."""
+    targets = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = _dotted(node.func)
+        if fname.endswith("hybridize") or fname in ("jit", "jax.jit"):
+            for a in node.args:
+                if isinstance(a, ast.Name):
+                    targets.add(a.id)
+    return targets
+
+
+def collect_traced_functions(tree):
+    """Yield (funcdef, f_param_name_or_None, traced_param_names)."""
+    jit_targets = _jit_call_targets(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        args = node.args
+        names = [a.arg for a in args.args]
+        if node.name == "hybrid_forward" and len(names) >= 2:
+            f_param = names[1]
+            traced = set(names[2:])
+            if args.vararg:
+                traced.add(args.vararg.arg)
+            if args.kwarg:
+                traced.add(args.kwarg.arg)
+            traced.update(a.arg for a in args.kwonlyargs)
+            yield node, f_param, traced
+        elif (any(_decorator_is_jit(d) for d in node.decorator_list)
+              or node.name in jit_targets):
+            traced = {n for n in names if n != "self"}
+            if args.vararg:
+                traced.add(args.vararg.arg)
+            if args.kwarg:
+                traced.add(args.kwarg.arg)
+            traced.update(a.arg for a in args.kwonlyargs)
+            yield node, None, traced
+
+
+class _TaintChecker(ast.NodeVisitor):
+    """One traceable function body; records TS findings."""
+
+    def __init__(self, path, f_param, tainted, registry_names, findings):
+        self.path = path
+        self.f_param = f_param
+        self.tainted = set(tainted)
+        self.registry_names = registry_names  # None disables TS105
+        self.findings = findings
+
+    # -- taint query ------------------------------------------------------
+    def is_tainted(self, node):
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _METADATA_ATTRS:
+                return False
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.BinOp):
+            return self.is_tainted(node.left) or self.is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_tainted(node.operand)
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` are presence checks on the
+            # PYTHON reference, legal under tracing
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return (self.is_tainted(node.left)
+                    or any(self.is_tainted(c) for c in node.comparators))
+        if isinstance(node, ast.BoolOp):
+            return any(self.is_tainted(v) for v in node.values)
+        if isinstance(node, ast.IfExp):
+            return self.is_tainted(node.body) or self.is_tainted(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.is_tainted(e) for e in node.elts)
+        if isinstance(node, ast.Starred):
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Name):
+                if fn.id in _HOST_BUILTINS or fn.id in _COERCIONS:
+                    return False
+                # helper(x): assume array-in, array-out
+                return any(self.is_tainted(a) for a in node.args)
+            if isinstance(fn, ast.Attribute):
+                if fn.attr in _SYNC_METHODS:
+                    return False  # result is a host value (and flagged)
+                if (isinstance(fn.value, ast.Name)
+                        and fn.value.id == self.f_param):
+                    return True  # F.op(...) produces a traced array
+                if self.is_tainted(fn.value):
+                    return True  # x.reshape(...), self.proj(x)...
+                return any(self.is_tainted(a) for a in node.args)
+        return False
+
+    def _flag(self, node, rule, message):
+        self.findings.append(Finding(self.path, node.lineno,
+                                     getattr(node, "col_offset", 0),
+                                     rule, message))
+
+    # -- statements -------------------------------------------------------
+    def visit_Assign(self, node):
+        if self.is_tainted(node.value):
+            for tgt in node.targets:
+                self._taint_target(tgt)
+        else:
+            for tgt in node.targets:
+                self._untaint_target(tgt)
+        self._check_mutation_targets(node.targets)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None and self.is_tainted(node.value):
+            self._taint_target(node.target)
+        self._check_mutation_targets([node.target])
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        if isinstance(node.target, ast.Name) and self.is_tainted(node.value):
+            self.tainted.add(node.target.id)
+        self._check_mutation_targets([node.target])
+        self.generic_visit(node)
+
+    def _taint_target(self, tgt):
+        if isinstance(tgt, ast.Name):
+            self.tainted.add(tgt.id)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self._taint_target(e)
+        elif isinstance(tgt, ast.Starred):
+            self._taint_target(tgt.value)
+
+    def _untaint_target(self, tgt):
+        if isinstance(tgt, ast.Name):
+            self.tainted.discard(tgt.id)
+
+    def _check_mutation_targets(self, targets):
+        for tgt in targets:
+            if (isinstance(tgt, ast.Subscript)
+                    and self.is_tainted(tgt.value)):
+                self._flag(tgt, "TS104",
+                           "in-place subscript store into traced array "
+                           "%r; use functional updates "
+                           "(e.g. F.where / concat)" % _dotted(tgt.value))
+
+    def visit_If(self, node):
+        if self.is_tainted(node.test):
+            self._flag(node.test, "TS101",
+                       "branch condition depends on a traced array value; "
+                       "use F.where or hoist the decision out of the "
+                       "traced region")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        if self.is_tainted(node.test):
+            self._flag(node.test, "TS102",
+                       "loop condition depends on a traced array value; "
+                       "use a static trip count or F.contrib.while_loop")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        if self.is_tainted(node.test):
+            self._flag(node.test, "TS101",
+                       "assert on a traced array value forces "
+                       "concretization mid-trace")
+        self.generic_visit(node)
+
+    # -- expressions ------------------------------------------------------
+    def visit_Call(self, node):
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in _SYNC_METHODS and self.is_tainted(fn.value):
+                self._flag(node, "TS103",
+                           ".%s() on a traced array syncs device->host "
+                           "mid-trace" % fn.attr)
+            elif (isinstance(fn.value, ast.Name)
+                  and fn.value.id == self.f_param
+                  and self.registry_names is not None
+                  and fn.attr not in self.registry_names):
+                self._flag(node, "TS105",
+                           "%s.%s is not a registered op (ops.registry "
+                           "_REGISTRY/_ALIASES)" % (fn.value.id, fn.attr))
+        elif isinstance(fn, ast.Name) and fn.id in _COERCIONS:
+            if any(self.is_tainted(a) for a in node.args):
+                self._flag(node, "TS103",
+                           "%s() on a traced array concretizes it "
+                           "mid-trace" % fn.id)
+        self.generic_visit(node)
+
+    # nested defs get their own traced-function treatment only if they
+    # qualify; inside a traced body a nested def shares the tainted env
+    def visit_FunctionDef(self, node):
+        self.generic_visit(node)
+
+
+def check_function(path, funcdef, f_param, traced_params, registry_names,
+                   findings):
+    checker = _TaintChecker(path, f_param, traced_params, registry_names,
+                            findings)
+    for stmt in funcdef.body:
+        checker.visit(stmt)
+
+
+def run(path, tree, registry_names=None, findings=None):
+    """Run the TS pass over one parsed module; returns the findings list."""
+    if findings is None:
+        findings = []
+    for funcdef, f_param, traced in collect_traced_functions(tree):
+        check_function(path, funcdef, f_param, traced, registry_names,
+                       findings)
+    return findings

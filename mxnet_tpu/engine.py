@@ -76,9 +76,14 @@ class Engine:
     def __init__(self):
         self.stats = _Stats()
         self._hooks = []  # profiler hooks: fn(op_name, t_start, t_end)
+        self._sync_hooks = []  # sync hooks: fn(origin) per device->host sync
         self.kind = os.environ.get("MXNET_ENGINE_TYPE", "NaiveEngine")
         self._inflight = []  # recent output buffers (bounded ring)
         self._inflight_cap = int(os.environ.get("MXNET_ENGINE_INFLIGHT_CAP", "512"))
+        self._audit = None  # EA4xx dependency auditor (docs/static_analysis.md)
+        if os.environ.get("MXNET_ENGINE_AUDIT", "0") not in ("", "0"):
+            from .analysis.engine_audit import EngineAudit
+            self._audit = EngineAudit()
 
     @staticmethod
     def get():
@@ -91,6 +96,9 @@ class Engine:
         """Run ``fn`` now; device-side it is async.  Bumps write-var versions."""
         for v in read_vars:
             v.rethrow()
+        audit = self._audit
+        if audit is not None:
+            audit.before_push(read_vars, write_vars, op_name)
         self.stats.ops_pushed += 1
         t0 = time.perf_counter() if self._hooks else 0.0
         try:
@@ -98,9 +106,13 @@ class Engine:
         except Exception as e:
             for v in write_vars:
                 v.set_exception(e)
+            if audit is not None:
+                audit.after_push(read_vars, write_vars, op_name)
             raise
         for v in write_vars:
             v.on_write()
+        if audit is not None:
+            audit.after_push(read_vars, write_vars, op_name)
         if self._hooks:
             t1 = time.perf_counter()
             for h in self._hooks:
@@ -121,7 +133,7 @@ class Engine:
             )
             for d in old:
                 try:
-                    d.block_until_ready()
+                    d.block_until_ready()  # mxlint: allow-host-sync
                 except AttributeError:
                     pass
 
@@ -130,20 +142,47 @@ class Engine:
         var.rethrow()
 
     def wait_for_all(self):
+        self.notify_sync("waitall")
         pending, self._inflight = self._inflight, []
         for d in pending:
             try:
-                d.block_until_ready()
+                d.block_until_ready()  # mxlint: allow-host-sync
             except AttributeError:
                 pass
 
     # -- instrumentation --------------------------------------------------
-    def add_hook(self, fn):
-        self._hooks.append(fn)
+    def add_hook(self, fn, kind="op"):
+        """Register an instrumentation hook, idempotently.
 
-    def remove_hook(self, fn):
-        if fn in self._hooks:
-            self._hooks.remove(fn)
+        ``kind='op'``: ``fn(op_name, t_start, t_end)`` after every push.
+        ``kind='sync'``: ``fn(origin)`` on every device->host sync
+        (``asnumpy``/``wait_to_read``/``waitall`` report through
+        ``notify_sync``) — the surface ``analysis.SyncCounter`` builds on.
+        Registering the same hook twice is a no-op, so callers wrapped in
+        retry/setup code can't double-count.
+        """
+        hooks = self._hooks_of(kind)
+        if fn not in hooks:
+            hooks.append(fn)
+
+    def remove_hook(self, fn, kind="op"):
+        hooks = self._hooks_of(kind)
+        if fn in hooks:
+            hooks.remove(fn)
+
+    def _hooks_of(self, kind):
+        if kind == "op":
+            return self._hooks
+        if kind == "sync":
+            return self._sync_hooks
+        raise ValueError("unknown hook kind %r (want 'op' or 'sync')" % kind)
+
+    def notify_sync(self, origin):
+        """Report one device->host sync to the sync hooks (cheap when none
+        are registered — a single truthiness check on the hot path)."""
+        if self._sync_hooks:
+            for h in self._sync_hooks:
+                h(origin)
 
 
 def waitall():

@@ -419,12 +419,25 @@ class HybridBlock(Block):
         self._flags = {}
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False,
-                  **kwargs):
+                  lint=False, **kwargs):
         """Arm/disarm compilation (parity: HybridBlock.hybridize:1043).
 
         ``static_alloc``/``static_shape`` accepted for API parity; XLA's
         buffer assignment always behaves like static_alloc=True.
+
+        ``lint=True`` runs the mxlint tracing-safety pass (TS1xx,
+        ``mxnet_tpu.analysis``) over this block's ``hybrid_forward`` source
+        — and every child's — before arming, and raises ``MXNetError`` on
+        findings: the static analogue of tracing the block and hitting a
+        ConcretizationError three epochs in.
         """
+        if active and lint:
+            findings = self.lint()
+            if findings:
+                raise MXNetError(
+                    "hybridize(lint=True): tracing-safety findings in "
+                    "hybrid_forward:\n  "
+                    + "\n  ".join(str(f) for f in findings))
         self._active = active
         self._flags = dict(static_alloc=static_alloc,
                            static_shape=static_shape, **kwargs)
@@ -433,6 +446,13 @@ class HybridBlock(Block):
             self._warmed_up = False
         super().hybridize(active, static_alloc=static_alloc,
                           static_shape=static_shape, **kwargs)
+
+    def lint(self):
+        """Run the mxlint tracing-safety pass over this block tree's
+        ``hybrid_forward`` sources; returns a list of findings (empty when
+        trace-safe).  See ``docs/static_analysis.md``."""
+        from ..analysis import lint_block
+        return lint_block(self)
 
     def clear_cache(self):
         self._cached_ops = {}

@@ -308,7 +308,7 @@ def _init_data(data, allow_empty, default_name):
     out = []
     for k, v in data.items():
         if isinstance(v, NDArray):
-            v = v.asnumpy()
+            v = v.asnumpy()  # mxlint: allow-host-sync (serialization path)
         out.append((k, np.ascontiguousarray(v)))
     return out
 

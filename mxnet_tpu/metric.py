@@ -5,10 +5,15 @@ Capability parity with the reference's ``python/mxnet/metric.py``
 F1:745, MCC:839, Perplexity:954, MAE/MSE/RMSE:1078-1207, CrossEntropy:1272,
 PearsonCorrelation:1416, Loss, Custom, CompositeEvalMetric:301).
 
-Metrics accumulate on the host: device arrays are pulled with ``asnumpy()``
-once per update (the single sync point), everything after is NumPy.  This is
-the TPU-correct design — metric math is tiny and branchy, exactly what you
-do NOT want inside an XLA program.
+Sync discipline (this file is a permanent ``tools/mxlint.py`` target): the
+hot per-batch metrics (``Accuracy``, ``Loss``) reduce ON DEVICE in
+``update()`` — a tiny async reduction enqueued on the PJRT stream, zero
+host pulls per batch — and queue the resulting scalar; ``get()`` sums the
+queue and pulls ONCE (the single intentional sync, marked
+``# mxlint: allow-host-sync``).  The branchy long-tail metrics (F1, MCC,
+PCC, ...) still pull per ``update()``: their math is host-shaped and they
+run per-epoch, not per-batch — that trade is intentional and recorded in
+``tools/mxlint_suppressions.txt``.
 """
 from __future__ import annotations
 
@@ -59,6 +64,10 @@ class EvalMetric:
         self.output_names = output_names
         self.label_names = label_names
         self._kwargs = kwargs
+        # device-side accumulation queue: (scalar device array, host count)
+        # pairs appended by update(), drained by ONE pull in get()
+        self._pending = []
+        self._pending_inst = 0
         self.reset()
 
     def __str__(self):
@@ -92,17 +101,45 @@ class EvalMetric:
         self.sum_metric = 0.0
         self.global_num_inst = 0
         self.global_sum_metric = 0.0
+        self._pending = []
+        self._pending_inst = 0
 
     def reset_local(self):
+        # flush first: queued device sums predate the reset and must still
+        # land in the *global* accumulators
+        self._flush()
         self.num_inst = 0
         self.sum_metric = 0.0
 
+    def _device_accumulate(self, value, n):
+        """Queue a device-side partial sum (no sync): ``value`` is a scalar
+        device array, ``n`` the instance count (host metadata)."""
+        self._pending.append(value)
+        self._pending_inst += int(n)
+
+    def _flush(self):
+        """Drain the device queue with ONE host pull."""
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, []
+        n, self._pending_inst = self._pending_inst, 0
+        total = pending[0]
+        for v in pending[1:]:
+            total = total + v  # device-side adds, still async
+        total = float(total)  # mxlint: allow-host-sync (the one pull)
+        self.sum_metric += total
+        self.global_sum_metric += total
+        self.num_inst += n
+        self.global_num_inst += n
+
     def get(self):
+        self._flush()
         if self.num_inst == 0:
             return (self.name, float('nan'))
         return (self.name, self.sum_metric / self.num_inst)
 
     def get_global(self):
+        self._flush()
         if self.global_num_inst == 0:
             return (self.name, float('nan'))
         return (self.name, self.global_sum_metric / self.global_num_inst)
@@ -224,6 +261,17 @@ class Accuracy(EvalMetric):
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds, True)
         for label, pred in zip(labels, preds):
+            if hasattr(pred, 'data') and hasattr(label, 'data'):
+                # device path: enqueue the reduction, pull nothing — the
+                # correct-count lands in the pending queue and is pulled
+                # once per get() (see module docstring)
+                pd, ld = pred.data(), label.data()
+                if pd.ndim > ld.ndim:
+                    pd = pd.argmax(axis=self.axis)
+                correct = (pd.astype('int32').ravel()
+                           == ld.astype('int32').ravel()).sum()
+                self._device_accumulate(correct, ld.size)
+                continue
             pred, label = _as_numpy(pred), _as_numpy(label)
             if pred.ndim > label.ndim:
                 pred = numpy.argmax(pred, axis=self.axis)
@@ -755,6 +803,10 @@ class Loss(EvalMetric):
         else:
             pred_list = [preds]
         for pred in pred_list:
+            if hasattr(pred, 'data'):
+                # device path: async sum now, one pull per get()
+                self._device_accumulate(pred.data().sum(), pred.size)
+                continue
             pred = _as_numpy(pred)
             loss = float(numpy.sum(pred))
             self.sum_metric += loss

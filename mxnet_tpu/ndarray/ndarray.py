@@ -139,6 +139,7 @@ class NDArray:
     # ------------------------------------------------------------------
     def wait_to_read(self):
         self._var.rethrow()
+        Engine.get().notify_sync("wait_to_read")
         if self._dlpack_mirror is not None:
             self._sync_dlpack_write()
         self._data.block_until_ready()
@@ -146,6 +147,7 @@ class NDArray:
 
     def asnumpy(self):
         self._var.rethrow()
+        Engine.get().notify_sync("asnumpy")
         if self._dlpack_mirror is not None:
             self._sync_dlpack_write()
         return _np.asarray(self._data)

@@ -134,6 +134,8 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
         lv = list(lv_list)
         outs_acc = None
         steps = 0
+        # the ndarray-mode while_loop's host cond is its documented
+        # semantics (one pull per step)  # mxlint: allow-host-sync
         while steps < max_iterations and bool(cond(*lv).asnumpy().item()):
             outs, new_lv = func(*lv)
             lv = _as_list(new_lv)

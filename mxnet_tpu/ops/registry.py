@@ -114,8 +114,24 @@ def get(name):
     return reg
 
 
-def list_ops():
-    return sorted(set(_REGISTRY) | set(_ALIASES))
+def list_ops(detail=False):
+    """Registered op names, primaries and aliases together.
+
+    ``detail=False`` (default): sorted list of names.
+    ``detail=True``: sorted list of ``(name, num_outputs, needs_rng,
+    needs_mode)`` tuples — aliases report their target's metadata, so the
+    registry's whole public surface is introspectable (used by the RC3xx
+    consistency pass and ``tools/mxlint.py``).
+    """
+    if not detail:
+        return sorted(set(_REGISTRY) | set(_ALIASES))
+    out = []
+    for name in sorted(set(_REGISTRY) | set(_ALIASES)):
+        reg = _REGISTRY.get(name) or _REGISTRY.get(_ALIASES.get(name, ""))
+        if reg is None:
+            continue  # dangling alias; RC3xx reports it, don't crash here
+        out.append((name, reg.num_outputs, reg.needs_rng, reg.needs_mode))
+    return out
 
 
 def _freeze(v):

@@ -525,16 +525,18 @@ class DistServer:
                     (key,) = f
                     st = self._key(key)
                     with st.lock:
+                        # server wire send needs host bytes
                         val = st.value if isinstance(st.value, np.ndarray) \
-                            else st.value.asnumpy()
+                            else st.value.asnumpy()  # mxlint: allow-host-sync
                     _send(sock, CMD_OK, val)
                     self._prof_span("KVStoreServer::pull", t0)
                 elif cmd == CMD_ROW_SPARSE_PULL:
                     key, row_ids = f
                     st = self._key(key)
                     with st.lock:
+                        # server wire send needs host bytes
                         base = st.value if isinstance(st.value, np.ndarray) \
-                            else st.value.asnumpy()
+                            else st.value.asnumpy()  # mxlint: allow-host-sync
                         rows = base[np.asarray(row_ids)]
                     _send(sock, CMD_OK, rows)
                 elif cmd == CMD_BARRIER:
@@ -831,6 +833,7 @@ class DistKVStore(KVStoreBase):
         values = [value] if not isinstance(key, (list, tuple)) else value
         for k, v in zip(keys, values):
             if self._rank == 0:
+                # init ships host bytes over the wire  # mxlint: allow-host-sync
                 arr = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
                 self._rpc(k, CMD_INIT, str(k), arr)
         self.barrier()

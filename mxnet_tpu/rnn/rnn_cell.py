@@ -448,6 +448,7 @@ class FusedRNNCell(BaseRNNCell):
         num_input = w0.shape[1]
         pieces = []
         for name, shape in self._layer_param_shapes(num_input):
+            # one-time parameter packing  # mxlint: allow-host-sync
             pieces.append(args.pop(name).asnumpy().reshape(-1))
         args[self._parameter.name] = nd.array(_np.concatenate(pieces))
         return args

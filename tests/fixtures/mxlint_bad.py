@@ -1,0 +1,67 @@
+# flake8: noqa
+"""Known-bad bodies for the mxlint test suite (tests/test_mxlint.py).
+
+Every deliberately-bad line carries a trailing ``# expect: RULE`` marker;
+the test parses the markers and asserts the linter produces EXACTLY those
+findings on this file — one per marker, none elsewhere.  The module is a
+lint corpus, never imported by the framework (note ``F.totally_bogus_op``).
+"""
+
+
+class BadBranch:
+    def hybrid_forward(self, F, x):
+        if x > 0:  # expect: TS101
+            return x
+        return F.negative(x)
+
+
+class BadWhile:
+    def hybrid_forward(self, F, x):
+        while x.sum() > 0:  # expect: TS102
+            x = x - 1
+        return x
+
+
+class BadCoercion:
+    def hybrid_forward(self, F, x):
+        scale = x.item()  # expect: TS103
+        return x * scale
+
+
+class BadFloatCoercion:
+    def hybrid_forward(self, F, x):
+        bias = float(x)  # expect: TS103
+        return x + bias
+
+
+class BadMutation:
+    def hybrid_forward(self, F, x):
+        x[0] = 0.0  # expect: TS104
+        return x
+
+
+class BadOpName:
+    def hybrid_forward(self, F, x):
+        return F.totally_bogus_op(x)  # expect: TS105
+
+
+def train_loop_pull(batches, loss_fn):
+    total = 0.0
+    for b in batches:
+        total += loss_fn(b).asscalar()  # expect: HS201
+    return total
+
+
+def train_loop_wait(batches, step):
+    for b in batches:
+        out = step(b)
+        out.wait_to_read()  # expect: HS202
+    return out
+
+
+def train_loop_print(nd, n):
+    acc = nd.zeros((1,))
+    for _ in range(n):
+        print(acc)  # expect: HS203
+        acc = acc + 1
+    return acc

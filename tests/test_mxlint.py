@@ -1,0 +1,227 @@
+"""mxlint: fixture corpus, CLI exit codes, registry introspection, and the
+runtime SyncCounter / engine-hook surfaces (docs/static_analysis.md)."""
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.analysis import SyncCounter, lint_paths, lint_source
+from mxnet_tpu.analysis.suppressions import SuppressionFile
+from mxnet_tpu.engine import Engine
+from mxnet_tpu.ops import registry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "mxlint_bad.py")
+
+
+# ---------------------------------------------------------------------------
+# fixture corpus: every `# expect: RULE` marker produces exactly that
+# finding on that line, and nothing else fires anywhere in the file
+# ---------------------------------------------------------------------------
+def _expected_markers():
+    out = []
+    with open(FIXTURE) as f:
+        for lineno, line in enumerate(f, 1):
+            m = re.search(r"#\s*expect:\s*([A-Z]+\d+)", line)
+            if m:
+                out.append((lineno, m.group(1)))
+    return sorted(out)
+
+
+def test_fixture_findings_match_markers_exactly():
+    expected = _expected_markers()
+    assert len(expected) >= 8, "fixture corpus lost its markers"
+    findings = lint_paths([FIXTURE], relative_to=REPO,
+                          suppressions=SuppressionFile())
+    got = sorted((f.line, f.rule) for f in findings)
+    assert got == expected, "\n".join(str(f) for f in findings)
+
+
+@pytest.mark.parametrize("rule", ["TS101", "TS102", "TS103", "TS104",
+                                  "TS105", "HS201", "HS202", "HS203"])
+def test_fixture_covers_rule(rule):
+    assert rule in {r for _, r in _expected_markers()}
+
+
+def test_inline_disable_suppresses():
+    src = ("def hybrid_forward(self, F, x):\n"
+           "    if x > 0:  # mxlint: disable=TS101\n"
+           "        return x\n"
+           "    return F.negative(x)\n")
+    assert lint_source(src) == []
+    # same body without the pragma does fire
+    assert [f.rule for f in lint_source(src.replace(
+        "  # mxlint: disable=TS101", ""))] == ["TS101"]
+
+
+def test_allow_host_sync_pragma_covers_hs_rules():
+    src = ("def f(batches):\n"
+           "    for b in batches:\n"
+           "        v = b.asscalar()  # mxlint: allow-host-sync\n"
+           "    return v\n")
+    assert lint_source(src) == []
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+def _run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mxlint.py")]
+        + list(argv),
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+
+def test_cli_nonzero_with_rule_ids_on_bad_fixture():
+    r = _run_cli(FIXTURE, "--no-registry-check")
+    assert r.returncode == 1, r.stdout + r.stderr
+    for rule in ("TS101", "TS102", "TS103", "TS104", "TS105",
+                 "HS201", "HS202", "HS203"):
+        assert rule in r.stdout, (rule, r.stdout)
+    # findings print as path:line:col: RULE [slug] message
+    assert re.search(r"mxlint_bad\.py:\d+:\d+: TS101 \[", r.stdout)
+
+
+def test_cli_list_rules_exits_zero():
+    r = _run_cli("--list-rules")
+    assert r.returncode == 0, r.stderr
+    for rule in ("TS105", "HS204", "RC304", "EA402"):
+        assert rule in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# registry introspection (satellite: list_ops detail mode)
+# ---------------------------------------------------------------------------
+def test_list_ops_detail_tuples():
+    detail = registry.list_ops(detail=True)
+    assert detail, "registry is empty?"
+    names = [t[0] for t in detail]
+    assert names == sorted(names)
+    for name, num_outputs, needs_rng, needs_mode in detail:
+        assert isinstance(name, str)
+        assert isinstance(num_outputs, int)
+        assert isinstance(needs_rng, bool)
+        assert isinstance(needs_mode, bool)
+    # detail mode covers the same public surface as the name list
+    assert set(names) == set(registry.list_ops())
+    # aliases report their target's metadata
+    by_name = dict((t[0], t[1:]) for t in detail)
+    for alias, target in registry._ALIASES.items():
+        if target in registry._REGISTRY:
+            assert by_name[alias] == by_name[target], alias
+
+
+def test_no_alias_shadows_primary():
+    shadows = set(registry._ALIASES) & set(registry._REGISTRY)
+    assert not shadows, ("aliases silently ignored in favour of primaries: "
+                         "%s" % sorted(shadows))
+
+
+# ---------------------------------------------------------------------------
+# runtime: SyncCounter + engine hook idempotency
+# ---------------------------------------------------------------------------
+def test_sync_counter_counts_pulls():
+    a = nd.array([1.0, 2.0, 3.0])
+    with SyncCounter() as sc:
+        b = a * 2
+        b.asnumpy()
+        b.asnumpy()
+        assert sc.step() == 2
+        (a + b).asnumpy()
+        assert sc.step() == 1
+    rep = sc.report()
+    assert rep["steps"] == 2
+    assert rep["total"] == 3
+    assert rep["syncs_per_step"] == pytest.approx(1.5)
+    assert rep["origins"].get("asnumpy") == 3
+
+
+def test_sync_counter_sees_waitall():
+    with SyncCounter() as sc:
+        mx.waitall()
+    assert sc.origins.get("waitall") == 1
+
+
+def test_sync_counter_uninstalls():
+    a = nd.array([1.0])
+    sc = SyncCounter().install()
+    sc.uninstall()
+    a.asnumpy()
+    assert sc.total == 0
+
+
+def test_add_hook_idempotent_no_double_count():
+    """Satellite regression: registering the same hook twice must not
+    double-count (setup/retry code paths call add_hook unconditionally)."""
+    eng = Engine.get()
+    calls = []
+    hook = lambda *a: calls.append(a)  # noqa: E731
+    eng.add_hook(hook)
+    eng.add_hook(hook)  # second registration: no-op
+    try:
+        assert eng._hooks.count(hook) == 1
+        before = eng.stats.ops_pushed
+        nd.array([1.0, 2.0]).sum().asnumpy()
+        pushed = eng.stats.ops_pushed - before
+        assert pushed >= 1
+        # one hook call per push — NOT two
+        assert len(calls) == pushed, (len(calls), pushed)
+    finally:
+        eng.remove_hook(hook)
+    assert hook not in eng._hooks
+
+
+def test_sync_hook_idempotent_no_double_count():
+    eng = Engine.get()
+    sc = SyncCounter(eng)
+    sc.install()
+    sc.install()  # double-install must not double-count
+    try:
+        assert eng._sync_hooks.count(sc._on_sync) == 1
+        nd.array([1.0]).asnumpy()
+        assert sc.origins["asnumpy"] == 1
+    finally:
+        sc.uninstall()
+    assert sc._on_sync not in eng._sync_hooks
+
+
+def test_hook_kind_validated():
+    with pytest.raises(ValueError):
+        Engine.get().add_hook(lambda *a: None, kind="bogus")
+
+
+# ---------------------------------------------------------------------------
+# HybridBlock.lint() / hybridize(lint=True)
+# ---------------------------------------------------------------------------
+def test_block_lint_flags_bad_body_and_hybridize_raises():
+    from mxnet_tpu.gluon import HybridBlock
+
+    class Bad(HybridBlock):
+        def hybrid_forward(self, F, x):
+            if x > 0:
+                return x
+            return F.negative(x)
+
+    b = Bad()
+    findings = b.lint()
+    assert [f.rule for f in findings] == ["TS101"]
+    assert findings[0].path == "Bad.hybrid_forward"
+    with pytest.raises(mx.MXNetError, match="TS101"):
+        b.hybridize(lint=True)
+
+
+def test_block_lint_clean_body_hybridizes():
+    from mxnet_tpu.gluon import HybridBlock, nn
+
+    net = nn.Dense(4)
+    assert net.lint() == []
+    net.initialize()
+    net.hybridize(lint=True)
+    out = net(nd.array([[1.0, 2.0, 3.0]]))
+    assert out.shape == (1, 4)
+    assert isinstance(net, HybridBlock)

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""mxlint — framework-aware static analysis for mxnet_tpu code.
+
+Runs the tracing-safety (TS1xx) and host-sync (HS2xx) passes over the given
+files/directories, plus the op-registry consistency pass (RC3xx) when the
+framework imports.  The repo's own tree is a permanent lint target::
+
+    python tools/mxlint.py mxnet_tpu/ examples/
+
+Exit status: 0 when clean (after suppressions), 1 when any finding remains,
+2 on usage error.  See docs/static_analysis.md for the rule catalogue and
+suppression syntax (`# mxlint: allow-host-sync`,
+`# mxlint: disable=TS101`, tools/mxlint_suppressions.txt).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+# the linter only needs host CPU; don't touch accelerators just to parse ASTs
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="mxlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", help="files or directories to lint")
+    ap.add_argument("--strict", action="store_true",
+                    help="enable advisory rules (HS204)")
+    ap.add_argument("--no-registry-check", action="store_true",
+                    help="skip the RC3xx registry consistency pass")
+    ap.add_argument("--no-probe", action="store_true",
+                    help="registry pass: structural checks only, no "
+                         "jax.eval_shape probing")
+    ap.add_argument("--suppressions", default=None, metavar="FILE",
+                    help="suppression file (default: "
+                         "tools/mxlint_suppressions.txt if present)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    args = ap.parse_args(argv)
+
+    from mxnet_tpu.analysis import RULES, lint_paths, check_registry
+
+    if args.list_rules:
+        for rid in sorted(RULES):
+            slug, default_on, doc = RULES[rid]
+            print("%s  %-28s %s%s" % (rid, slug, doc,
+                                      "" if default_on else "  [--strict]"))
+        return 0
+
+    if not args.paths:
+        ap.error("no paths given (try: python tools/mxlint.py mxnet_tpu/)")
+
+    findings = lint_paths(args.paths, strict=args.strict,
+                          suppressions=args.suppressions,
+                          relative_to=_REPO_ROOT)
+    if not args.no_registry_check:
+        try:
+            findings.extend(check_registry(suppressions=args.suppressions,
+                                           probe=not args.no_probe,
+                                           strict=args.strict))
+        except Exception as e:
+            print("mxlint: registry check skipped (%s: %s)"
+                  % (type(e).__name__, e), file=sys.stderr)
+
+    if args.format == "json":
+        print(json.dumps([f.as_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f)
+        n = len(findings)
+        print("mxlint: %d finding%s" % (n, "" if n == 1 else "s"))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
