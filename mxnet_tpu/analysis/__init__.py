@@ -10,7 +10,7 @@ and framework code keeps two contracts:
 2. every device→host sync on the eager path is *intentional*, because each
    one stalls the PJRT stream the engine relies on for overlap.
 
-This package enforces both, statically and at runtime, with four passes:
+This package enforces both, statically and at runtime, with six passes:
 
 * **tracing-safety lint** (``TS1xx``, ``tracing_safety``) — AST pass over
   ``hybrid_forward`` bodies and jit-wrapped functions: data-dependent
@@ -28,6 +28,15 @@ This package enforces both, statically and at runtime, with four passes:
   registered op must have a coherent ``num_outputs``/``input_names``/doc
   and, where a gradient is expected, a differentiable forward under
   ``jax.eval_shape``.
+* **graph verifier** (``GS5xx``, ``graph_verify``) — per-node abstract
+  interpreter over ``Symbol._topo_nodes()`` that blames shape/dtype
+  failures on the offending node (``Symbol.lint()``, the
+  ``MXNET_GRAPH_VERIFY=1`` bind pre-flight, ``.json`` files on the CLI).
+* **collective consistency checker** (``CC6xx``, ``collective_check``) —
+  static checks on literal collective programs (unknown axis names,
+  non-permutation ``ppermute`` perms, collectives under data-dependent
+  branches) plus runtime pre-dispatch validators used by
+  ``parallel/pipeline.py`` and ``parallel/dist_kvstore.py``.
 
 CLI: ``python tools/mxlint.py mxnet_tpu/ examples/`` (the repo's own source
 is a permanent lint target; intentional syncs carry
@@ -36,14 +45,20 @@ is a permanent lint target; intentional syncs carry
 """
 from __future__ import annotations
 
-from .findings import Finding, RULES, rule_doc
-from .driver import lint_paths, lint_source, lint_block, check_registry
+from .findings import Finding, RULES, SEVERITY, rule_doc, severity_at_least
+from .driver import (lint_paths, lint_source, lint_block, check_registry,
+                     verify_symbol_file)
+from .graph_verify import verify_symbol, input_consumers, blame_unresolved
+from .collective_check import check_axis, check_ppermute
 from .host_sync import SyncCounter
 from .engine_audit import EngineAudit, EngineAuditError, install, uninstall
 
 __all__ = [
-    "Finding", "RULES", "rule_doc",
+    "Finding", "RULES", "SEVERITY", "rule_doc", "severity_at_least",
     "lint_paths", "lint_source", "lint_block", "check_registry",
+    "verify_symbol_file",
+    "verify_symbol", "input_consumers", "blame_unresolved",
+    "check_axis", "check_ppermute",
     "SyncCounter",
     "EngineAudit", "EngineAuditError", "install", "uninstall",
 ]

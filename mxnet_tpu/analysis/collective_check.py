@@ -1,0 +1,378 @@
+"""CC6xx — collective-consistency checks for the parallel layer.
+
+Two halves share one rule vocabulary:
+
+* a **static AST pass** (:func:`run`, wired into the mxlint driver) that
+  checks literal collective programs against meshes it can see being
+  built in the same module (``make_mesh({...})`` / ``global_mesh({...})``
+  / ``Mesh(devs, (...))``) — unknown ``axis_name`` strings (CC601),
+  non-permutation literal ``ppermute`` perms (CC602), and collectives
+  under data-dependent branches (CC603, the classic SPMD deadlock);
+* **runtime pre-dispatch validators** (:func:`check_axis`,
+  :func:`check_ppermute`) called by ``parallel/pipeline.py``, ``moe.py``
+  and ``ring_attention.py`` before building a shard_map program, raising
+  ``MXNetError`` with the same CC6xx vocabulary.  CC604 (pipeline
+  geometry) and CC605 (kvstore key divergence) live entirely in their
+  runtime call sites — their inputs are never module-level literals.
+
+The static pass is deliberately conservative: axis names that are Python
+variables, meshes built from runtime device counts, and perms built by
+comprehension are all skipped, never guessed at.  CC601 only fires in a
+module that builds at least one statically-known mesh, and ``P()`` spec
+literals are only checked inside ``shard_map(...)`` call arguments —
+free-standing ``PartitionSpec`` values (e.g. for ``device_put``) are out
+of scope.
+"""
+from __future__ import annotations
+
+import ast
+
+from .findings import Finding
+from .tracing_safety import _dotted
+
+# lax collectives that take an axis name; value = positional index of it
+_AXIS_ARG_POS = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1,
+    "ppermute": 1, "pshuffle": 1,
+    "all_gather": 1, "all_to_all": 1, "psum_scatter": 1,
+    "axis_index": 0,
+}
+
+_LAX_PREFIXES = ("lax", "jax.lax")
+
+
+def _is_collective(fname):
+    """'lax.psum' / 'jax.lax.ppermute' -> the op's short name, else None."""
+    parts = fname.rsplit(".", 1)
+    if len(parts) == 2 and parts[0] in _LAX_PREFIXES \
+            and parts[1] in _AXIS_ARG_POS:
+        return parts[1]
+    return None
+
+
+def _literal_strs(node):
+    """[(string, ast_node)] for a Constant str or tuple/list of them."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [(node.value, node)]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append((e.value, e))
+        return out
+    return []
+
+
+def _axis_arg(call, op):
+    """The axis-name argument node of a collective call, or None."""
+    pos = _AXIS_ARG_POS[op]
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    if len(call.args) > pos:
+        return call.args[pos]
+    return None
+
+
+def _literal_perm(node):
+    """[(src, dst)] for a literal list/tuple of int pairs, else None."""
+    if not isinstance(node, (ast.List, ast.Tuple)):
+        return None
+    pairs = []
+    for e in node.elts:
+        if not (isinstance(e, (ast.Tuple, ast.List)) and len(e.elts) == 2):
+            return None
+        s, d = e.elts
+        if not (isinstance(s, ast.Constant) and isinstance(s.value, int)
+                and isinstance(d, ast.Constant)
+                and isinstance(d.value, int)):
+            return None
+        pairs.append((s.value, d.value))
+    return pairs
+
+
+def _collect_meshes(tree):
+    """Statically-known meshes: var name -> {axis: size|None}, plus the
+    union over all of them (for collectives whose mesh isn't named)."""
+    per_var, union = {}, {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            continue
+        fname = _dotted(node.value.func)
+        short = fname.rsplit(".", 1)[-1]
+        axes = None
+        if short in ("make_mesh", "global_mesh") and node.value.args:
+            spec = node.value.args[0]
+            if isinstance(spec, ast.Dict) and all(
+                    isinstance(k, ast.Constant)
+                    and isinstance(k.value, str) for k in spec.keys):
+                axes = {}
+                for k, v in zip(spec.keys, spec.values):
+                    axes[k.value] = (v.value if isinstance(v, ast.Constant)
+                                     and isinstance(v.value, int) else None)
+        elif short == "Mesh" and len(node.value.args) >= 2:
+            names = _literal_strs(node.value.args[1])
+            if names:
+                axes = {n: None for n, _ in names}
+        if axes:
+            per_var[node.targets[0].id] = axes
+            for a, sz in axes.items():
+                union.setdefault(a, sz)
+    return per_var, union
+
+
+def _mentions(test, params):
+    return any(isinstance(n, ast.Name) and n.id in params
+               for n in ast.walk(test))
+
+
+def _is_none_check(test):
+    return (isinstance(test, ast.Compare)
+            and all(isinstance(op, (ast.Is, ast.IsNot))
+                    for op in test.ops))
+
+
+def _collectives_in(node):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            op = _is_collective(_dotted(sub.func))
+            if op:
+                yield sub, op
+
+
+class _Pass:
+    def __init__(self, path, tree, findings):
+        self.path = path
+        self.tree = tree
+        self.findings = findings
+        self.meshes, self.known = _collect_meshes(tree)
+        self.local_defs = {n.name: n for n in ast.walk(tree)
+                           if isinstance(n, ast.FunctionDef)}
+        self._flagged = set()
+
+    def flag(self, node, rule, message):
+        key = (node.lineno, getattr(node, "col_offset", 0), rule)
+        if key in self._flagged:
+            return
+        self._flagged.add(key)
+        self.findings.append(Finding(self.path, node.lineno,
+                                     getattr(node, "col_offset", 0),
+                                     rule, message))
+
+    def run(self):
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _dotted(node.func)
+            op = _is_collective(fname)
+            if op:
+                self._check_collective(node, op)
+            short = fname.rsplit(".", 1)[-1]
+            if short == "cond" and fname.rsplit(".", 1)[0] in _LAX_PREFIXES:
+                self._check_cond_branches(node)
+            elif short == "switch" \
+                    and fname.rsplit(".", 1)[0] in _LAX_PREFIXES:
+                self._check_switch_branches(node)
+            elif short == "shard_map":
+                self._check_shard_map(node)
+        return self.findings
+
+    # -- CC601 + CC602 on one collective call -----------------------------
+    def _check_collective(self, call, op):
+        axis_node = _axis_arg(call, op)
+        axis_size = None
+        if axis_node is not None and self.known:
+            for name, strnode in _literal_strs(axis_node):
+                if name not in self.known:
+                    self.flag(strnode, "CC601",
+                              "%s over axis %r, but the meshes built in "
+                              "this module only define axes %s — dispatch "
+                              "will fail (or deadlock a multihost job "
+                              "waiting on peers that never enter)"
+                              % (op, name, sorted(self.known)))
+                elif self.known.get(name) is not None:
+                    axis_size = self.known[name]
+        elif axis_node is not None:
+            lits = _literal_strs(axis_node)
+            if len(lits) == 1:
+                axis_size = None  # axis unknown, size unknowable
+        if op != "ppermute":
+            return
+        perm_node = None
+        for kw in call.keywords:
+            if kw.arg == "perm":
+                perm_node = kw.value
+        if perm_node is None and len(call.args) > 2:
+            perm_node = call.args[2]
+        if perm_node is None:
+            return
+        pairs = _literal_perm(perm_node)
+        if pairs is None:
+            return
+        problems = []
+        srcs = [s for s, _ in pairs]
+        dsts = [d for _, d in pairs]
+        dup_src = sorted({s for s in srcs if srcs.count(s) > 1})
+        dup_dst = sorted({d for d in dsts if dsts.count(d) > 1})
+        if dup_src:
+            problems.append("duplicate source rank(s) %s" % dup_src)
+        if dup_dst:
+            problems.append("duplicate destination rank(s) %s — those "
+                            "lanes silently receive zeros" % dup_dst)
+        if axis_size is not None:
+            bad = sorted({r for r in srcs + dsts
+                          if not 0 <= r < axis_size})
+            if bad:
+                problems.append("rank(s) %s out of range for axis of "
+                                "size %d" % (bad, axis_size))
+        if problems:
+            self.flag(perm_node, "CC602",
+                      "ppermute perm %s is not a permutation: %s"
+                      % (pairs, "; ".join(problems)))
+
+    # -- CC603: collectives inside cond/switch branch functions -----------
+    def _branch_fns(self, exprs):
+        for e in exprs:
+            if isinstance(e, ast.Lambda):
+                yield e
+            elif isinstance(e, ast.Name) and e.id in self.local_defs:
+                yield self.local_defs[e.id]
+
+    def _flag_branch_collectives(self, fn, where):
+        for call, op in _collectives_in(fn):
+            self.flag(call, "CC603",
+                      "%s inside a %s branch: only the taken branch's "
+                      "program runs per device, so devices disagreeing "
+                      "on the predicate deadlock the collective — hoist "
+                      "it out of the branch or make the predicate "
+                      "replicated" % (op, where))
+
+    def _check_cond_branches(self, call):
+        exprs = list(call.args[1:3])
+        exprs += [kw.value for kw in call.keywords
+                  if kw.arg in ("true_fun", "false_fun")]
+        for fn in self._branch_fns(exprs):
+            self._flag_branch_collectives(fn, "lax.cond")
+
+    def _check_switch_branches(self, call):
+        if len(call.args) > 1 and isinstance(call.args[1],
+                                             (ast.List, ast.Tuple)):
+            for fn in self._branch_fns(call.args[1].elts):
+                self._flag_branch_collectives(fn, "lax.switch")
+
+    # -- shard_map: spec-literal CC601 + branchy-body CC603 ----------------
+    def _shard_map_axes(self, call):
+        for kw in call.keywords:
+            if kw.arg == "mesh" and isinstance(kw.value, ast.Name):
+                axes = self.meshes.get(kw.value.id)
+                if axes:
+                    return axes
+        return self.known
+
+    def _check_shard_map(self, call):
+        axes = self._shard_map_axes(call)
+        if axes:
+            for kw in call.keywords:
+                if kw.arg not in ("in_specs", "out_specs"):
+                    continue
+                for sub in ast.walk(kw.value):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    short = _dotted(sub.func).rsplit(".", 1)[-1]
+                    if short not in ("P", "PartitionSpec"):
+                        continue
+                    for name, strnode in _literal_strs(
+                            ast.Tuple(elts=list(sub.args))):
+                        if name not in axes:
+                            self.flag(strnode, "CC601",
+                                      "shard_map %s names axis %r, but "
+                                      "its mesh only defines axes %s"
+                                      % (kw.arg, name, sorted(axes)))
+        # body: collectives under a parameter-dependent Python branch
+        fn = call.args[0] if call.args else None
+        if isinstance(fn, ast.Call) \
+                and _dotted(fn.func).rsplit(".", 1)[-1] == "partial" \
+                and fn.args:
+            fn = fn.args[0]
+        if isinstance(fn, ast.Name):
+            fn = self.local_defs.get(fn.id)
+        if not isinstance(fn, (ast.FunctionDef, ast.Lambda)):
+            return
+        params = {a.arg for a in fn.args.args}
+        for stmt in ast.walk(fn):
+            if not isinstance(stmt, (ast.If, ast.While)):
+                continue
+            if _is_none_check(stmt.test) \
+                    or not _mentions(stmt.test, params):
+                continue
+            for call_, op in _collectives_in(stmt):
+                self.flag(call_, "CC603",
+                          "%s under a Python branch on a shard_map "
+                          "parameter: per-device data can disagree on "
+                          "the predicate, so some devices skip the "
+                          "collective and the rest deadlock waiting "
+                          "for them" % op)
+
+
+def run(path, tree, findings=None):
+    """Run the static CC pass over one parsed module."""
+    if findings is None:
+        findings = []
+    return _Pass(path, tree, findings).run()
+
+
+# ---------------------------------------------------------------------------
+# runtime pre-dispatch validators (same vocabulary, raise instead of report)
+# ---------------------------------------------------------------------------
+
+def check_axis(mesh, axis_name, op="collective"):
+    """Raise MXNetError (CC601) if ``axis_name`` is not a mesh axis."""
+    from ..base import MXNetError
+
+    names = tuple(getattr(mesh, "axis_names", ()))
+    wanted = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    missing = [a for a in wanted if a not in names]
+    if missing:
+        raise MXNetError(
+            "CC601 (unknown-axis-name): %s uses axis %s but the mesh only "
+            "defines axes %s" % (op, missing if len(missing) > 1
+                                 else repr(missing[0]), list(names)))
+
+
+def check_ppermute(mesh, axis_name, perm, require_total=False,
+                   op="ppermute"):
+    """Raise MXNetError (CC602) unless ``perm`` is a valid (partial)
+    permutation of ``range(mesh.shape[axis_name])``.
+
+    ``require_total=False`` accepts partial permutations — gpipe's
+    forward shift ``[(i, i+1) for i in range(n-1)]`` deliberately leaves
+    the last stage without a destination.  Pass ``require_total=True``
+    for rotations that must touch every rank.
+    """
+    from ..base import MXNetError
+
+    check_axis(mesh, axis_name, op=op)
+    n = dict(mesh.shape)[axis_name]
+    pairs = [(int(s), int(d)) for s, d in perm]
+    problems = []
+    bad = sorted({r for p in pairs for r in p if not 0 <= r < n})
+    if bad:
+        problems.append("rank(s) %s out of range for axis %r of size %d"
+                        % (bad, axis_name, n))
+    srcs = [s for s, _ in pairs]
+    dsts = [d for _, d in pairs]
+    if len(set(srcs)) != len(srcs):
+        problems.append("duplicate source rank(s) %s"
+                        % sorted({s for s in srcs if srcs.count(s) > 1}))
+    if len(set(dsts)) != len(dsts):
+        problems.append("duplicate destination rank(s) %s"
+                        % sorted({d for d in dsts if dsts.count(d) > 1}))
+    if require_total and not problems and len(pairs) != n:
+        problems.append("perm has %d pair(s) but axis %r has %d ranks and "
+                        "require_total=True" % (len(pairs), axis_name, n))
+    if problems:
+        raise MXNetError(
+            "CC602 (non-permutation-ppermute): %s perm %s over axis %r: %s"
+            % (op, pairs, axis_name, "; ".join(problems)))

@@ -16,7 +16,7 @@ import ast
 import os
 import textwrap
 
-from . import host_sync, tracing_safety
+from . import collective_check, host_sync, tracing_safety
 from .suppressions import SuppressionFile, inline_suppressed
 
 _SKIP_DIRS = frozenset({"__pycache__", ".git", "node_modules", "build",
@@ -92,6 +92,7 @@ def lint_source(source, path="<string>", registry_names=None, strict=False,
     findings = []
     tracing_safety.run(path, tree, registry_names, findings)
     host_sync.run(path, tree, findings, strict=strict)
+    collective_check.run(path, tree, findings)
     supp = suppressions if isinstance(suppressions, SuppressionFile) \
         else (SuppressionFile() if suppressions is None
               else _load_suppressions(suppressions))
@@ -131,6 +132,7 @@ def lint_paths(paths, registry_names=None, strict=False, suppressions=None,
         findings = []
         tracing_safety.run(rel, tree, registry_names, findings)
         host_sync.run(rel, tree, findings, strict=strict)
+        collective_check.run(rel, tree, findings)
         all_findings.extend(_filter(findings, source.splitlines(), supp))
     all_findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return all_findings
@@ -142,6 +144,29 @@ def _rel(path, base):
     if ap.startswith(ab + os.sep):
         return os.path.relpath(ap, ab)
     return path
+
+
+def verify_symbol_file(path, relative_to=None, suppressions=None):
+    """GS5xx-verify a serialized Symbol (``.json`` from ``Symbol.save``).
+
+    A file that doesn't load as a symbol graph yields one GS501 finding
+    rather than a crash, mirroring the un-parseable-``.py`` behaviour.
+    """
+    from .graph_verify import verify_symbol
+
+    supp = _load_suppressions(suppressions)
+    if relative_to is None:
+        relative_to = os.getcwd()
+    rel = _rel(path, relative_to)
+    try:
+        from ..symbol.symbol import load
+        sym = load(path)
+    except Exception as e:
+        from .findings import Finding
+        return _filter([Finding(rel, 0, 0, "GS501",
+                                "file does not load as a symbol graph: %s"
+                                % e)], None, supp)
+    return _filter(verify_symbol(sym, path=rel), None, supp)
 
 
 def check_registry(suppressions=None, probe=True, strict=False):

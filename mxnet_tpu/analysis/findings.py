@@ -8,6 +8,12 @@ Rule ID bands (stable, documented in ``docs/static_analysis.md``):
   registry)
 * ``EA4xx`` — engine dependency audit (runtime only; listed here so the
   audit raises with the same vocabulary the linter reports in)
+* ``GS5xx`` — graph verification (per-node abstract interpretation over
+  ``Symbol._topo_nodes()``; ``Symbol.lint()``, ``MXNET_GRAPH_VERIFY=1``
+  bind pre-flight, and ``.json`` symbol files passed to the CLI)
+* ``CC6xx`` — collective consistency (static AST pass over ``parallel/``
+  programs + runtime pre-dispatch validators in ``pipeline.py`` /
+  ``dist_kvstore.py``, which raise with the same vocabulary)
 """
 from __future__ import annotations
 
@@ -65,7 +71,59 @@ RULES = {
     "EA403": ("version-regression", True,
               "a var's version moved backwards — state was rolled back "
               "or a stale Var was resurrected"),
+    "GS501": ("node-shape-mismatch", True,
+              "an op node's shape/dtype check failed under per-node "
+              "abstract evaluation — the finding names the op, the node, "
+              "its input shapes and the producing nodes"),
+    "GS502": ("unresolved-input", True,
+              "a graph input's shape cannot be inferred or hinted — the "
+              "finding names the first consumer node that needed it"),
+    "GS503": ("duplicate-node-name", True,
+              "two graph nodes share one name — name-keyed bindings and "
+              "serialization silently alias one of them"),
+    "GS504": ("dead-argument", True,
+              "a supplied argument/shape binding matches no graph input — "
+              "the executor would silently drop it"),
+    "GS505": ("dtype-conflict", True,
+              "a multi-input node joins float inputs of different widths "
+              "— silent promotion hides a precision/memory bug"),
+    "CC601": ("unknown-axis-name", True,
+              "a collective/shard_map spec names an axis absent from the "
+              "mesh — fails only at dispatch, or deadlocks multihost"),
+    "CC602": ("non-permutation-ppermute", True,
+              "a ppermute perm with duplicate sources/destinations or "
+              "out-of-range ranks — lanes silently receive zeros or the "
+              "program is rejected at lowering"),
+    "CC603": ("collective-under-branch", True,
+              "a collective inside a data-dependent branch — ranks that "
+              "disagree on the predicate deadlock the collective"),
+    "CC604": ("pipeline-schedule-mismatch", True,
+              "pipeline stage/microbatch geometry disagrees with the mesh "
+              "axis (stacked leading dim != n_stages, empty schedule)"),
+    "CC605": ("kvstore-key-divergence", True,
+              "dist-kvstore push/pull key sets diverge from the "
+              "initialized schema — sync mode barriers per key and "
+              "divergent sets deadlock the round"),
 }
+
+# rule id -> severity; rules not listed are "error".  Ordering:
+# note < warn < error (``--fail-on`` thresholds exit status on this).
+SEVERITY = {
+    "HS201": "warn",
+    "HS202": "warn",
+    "HS203": "warn",
+    "HS204": "note",
+    "RC302": "note",
+    "GS504": "warn",
+    "GS505": "warn",
+}
+
+_SEVERITY_RANK = {"note": 0, "warn": 1, "error": 2}
+
+
+def severity_at_least(finding, threshold):
+    """True if ``finding``'s severity is at or above ``threshold``."""
+    return _SEVERITY_RANK[finding.severity] >= _SEVERITY_RANK[threshold]
 
 
 def rule_doc(rule_id):
@@ -89,6 +147,10 @@ class Finding:
     def slug(self):
         return RULES[self.rule][0]
 
+    @property
+    def severity(self):
+        return SEVERITY.get(self.rule, "error")
+
     def __repr__(self):
         return "Finding(%s:%s:%s %s)" % (self.path, self.line, self.col,
                                          self.rule)
@@ -101,4 +163,4 @@ class Finding:
     def as_dict(self):
         return {"path": self.path, "line": self.line, "col": self.col,
                 "rule": self.rule, "slug": self.slug,
-                "message": self.message}
+                "severity": self.severity, "message": self.message}

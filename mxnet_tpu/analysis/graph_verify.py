@@ -1,0 +1,259 @@
+"""GS5xx — per-node graph verification for Symbol DAGs.
+
+The reference validated graphs through nnvm's ``InferShape``/``InferType``
+passes, which attribute a failure to the offending node; the rebuild's
+whole-graph ``jax.eval_shape`` instead surfaces one opaque traceback with
+no node attribution.  This pass restores the per-node story: an abstract
+interpreter walks ``Symbol._topo_nodes()`` in topo order, propagating
+``jax.ShapeDtypeStruct``s node by node (reusing ``ops.registry`` metadata
+and ``symbol/shape_hints.py`` to fill parameter shapes), so a mismatch is
+blamed on exactly one node with its input shapes and producing nodes.
+
+Rules (catalogue in ``findings.RULES``):
+
+* ``GS501`` — a node's shape/dtype check failed (or its op is not
+  registered, or it produced a different output count than declared)
+* ``GS502`` — an input variable's shape is unresolvable; the finding
+  names the FIRST consumer node that needed it
+* ``GS503`` — duplicate node names (name-keyed bindings silently alias)
+* ``GS504`` — a supplied argument binding matches no graph input
+* ``GS505`` — a join node mixes float inputs of different widths
+
+Entry points: :func:`verify_symbol` (programmatic), ``Symbol.lint()``
+(method sugar), the ``MXNET_GRAPH_VERIFY=1`` pre-flight in
+``bind``/``simple_bind``, and ``tools/mxlint.py <file>.json`` for
+serialized graphs.  Findings use pseudo-paths ``symbol:<name>`` (or the
+file path for ``.json`` inputs); the ``line`` is the node's 1-based topo
+position, which is stable for a given graph.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .findings import Finding
+
+
+def _first_line(exc):
+    s = str(exc).strip()
+    return s.splitlines()[0] if s else type(exc).__name__
+
+
+def _slot_names(node):
+    """Input slot names for an op node (registry order), falling back to
+    positional ``arg<i>`` labels for variadic/unknown ops."""
+    try:
+        from ..ops import registry as _reg
+
+        reg = _reg.get(node.op)
+        if not reg.variadic and len(reg.input_names) >= len(node.inputs):
+            return list(reg.input_names[:len(node.inputs)])
+    except Exception:
+        pass
+    return ["arg%d" % i for i in range(len(node.inputs))]
+
+
+def input_consumers(sym):
+    """Map variable name -> [(consumer_node, slot_name)] in topo order.
+
+    The shared blame helper: both GS502 and the enriched
+    ``infer_shape: cannot infer ...`` error path use it to answer
+    "which node needed this input".
+    """
+    out = {}
+    for node in sym._topo_nodes():
+        if node.is_variable:
+            continue
+        for slot, (inp, _idx) in zip(_slot_names(node), node.inputs):
+            if inp.is_variable:
+                out.setdefault(inp.name, []).append((node, slot))
+    return out
+
+
+def blame_unresolved(sym, missing):
+    """Human-readable blame for unresolved inputs: each name annotated
+    with the first consumer node that needed it."""
+    consumers = input_consumers(sym)
+    parts = []
+    for name in missing:
+        uses = consumers.get(name)
+        if uses:
+            node, slot = uses[0]
+            parts.append("%r (first needed by node %r (%s) as input %r)"
+                         % (name, node.name, node.op, slot))
+        else:
+            parts.append("%r (never consumed by any op node)" % (name,))
+    return ", ".join(parts)
+
+
+def _var_dtype(node, arg_dtypes):
+    dt = arg_dtypes.get(node.name)
+    if dt is None:
+        dt = node.attrs.get("__dtype__")
+    return _np.dtype(dt) if dt is not None else _np.dtype(_np.float32)
+
+
+def verify_symbol(sym, arg_shapes=None, arg_dtypes=None, path=None):
+    """Run the GS5xx checks over one Symbol; returns a list of Findings.
+
+    ``arg_shapes``/``arg_dtypes`` (name->shape / name->dtype) seed the
+    propagation on top of the ``shape=``/``dtype=`` attrs attached at
+    ``var()`` creation; ``shape_hints`` fills parameter shapes the same
+    way ``infer_shape`` does, so a graph that binds cleanly lints
+    cleanly with only its data shapes supplied.
+    """
+    import jax
+
+    from ..ops import registry as _reg
+    from ..symbol import shape_hints
+    from ..symbol.symbol import _op_attrs
+
+    findings = []
+    if path is None:
+        path = "symbol:%s" % (sym.name or "group%d" % len(sym._outputs))
+    arg_shapes = dict(arg_shapes or {})
+    arg_dtypes = dict(arg_dtypes or {})
+    nodes = sym._topo_nodes()
+    topo_line = {id(n): i + 1 for i, n in enumerate(nodes)}
+
+    def flag(node, rule, message):
+        findings.append(Finding(path, topo_line[id(node)], 0, rule, message))
+
+    # -- GS503: duplicate node names --------------------------------------
+    seen = {}
+    for node in nodes:
+        prev = seen.get(node.name)
+        if prev is not None:
+            flag(node, "GS503",
+                 "duplicate node name %r: this %s node collides with the "
+                 "%s node at topo position %d — name-keyed bindings and "
+                 "serialization silently alias one of them"
+                 % (node.name, node.op or "variable",
+                    prev.op or "variable", topo_line[id(prev)]))
+        else:
+            seen[node.name] = node
+
+    # -- GS504: supplied bindings that match no graph input ----------------
+    graph_inputs = set(sym.list_inputs())
+    for name in sorted(set(arg_shapes) | set(arg_dtypes)):
+        if name not in graph_inputs:
+            shown = sorted(graph_inputs)
+            if len(shown) > 8:
+                shown = shown[:8] + ["..."]
+            findings.append(Finding(path, 0, 0, "GS504",
+                                    "argument %r matches no graph input "
+                                    "(inputs: %s) — binding would silently "
+                                    "drop it" % (name, shown)))
+
+    # -- per-node abstract interpretation ---------------------------------
+    vals = {}          # id(node) -> tuple of ShapeDtypeStruct|None per output
+    unresolved = {}    # var name -> (var_node, consumer_node, slot)
+
+    for node in nodes:
+        if node.is_variable:
+            shp = arg_shapes.get(node.name)
+            if shp is None and "__shape__" in node.attrs:
+                s = tuple(node.attrs["__shape__"])
+                if all(d != 0 for d in s):
+                    shp = s
+            if shp is None:
+                vals[id(node)] = (None,)
+            else:
+                vals[id(node)] = (jax.ShapeDtypeStruct(
+                    tuple(shp), _var_dtype(node, arg_dtypes)),)
+            continue
+
+        n_out = max(1, node.num_outputs)
+        try:
+            reg = _reg.get(node.op)
+        except Exception as e:
+            flag(node, "GS501", "node %r: %s" % (node.name, _first_line(e)))
+            vals[id(node)] = (None,) * n_out
+            continue
+
+        entries = node.inputs
+        ins = [vals[id(inp)][idx] for inp, idx in entries]
+
+        # fill missing variable inputs from the op's shape hint (the same
+        # backwards solving infer_shape uses)
+        if any(s is None for s in ins):
+            shapes_in = [None if s is None else tuple(s.shape) for s in ins]
+            try:
+                hinted = shape_hints.hint(node.op, reg.input_names,
+                                          shapes_in, node.attrs)
+            except Exception:
+                hinted = None
+            if hinted:
+                for i, ((inp, _idx), s) in enumerate(zip(entries, hinted)):
+                    if s is not None and ins[i] is None and inp.is_variable:
+                        vals[id(inp)] = (jax.ShapeDtypeStruct(
+                            tuple(s), _var_dtype(inp, arg_dtypes)),)
+            ins = [vals[id(inp)][idx] for inp, idx in entries]
+
+        if any(s is None for s in ins):
+            # variables still unknown get GS502 (blamed on their first
+            # consumer); a None from a FAILED producer node is a cascade —
+            # stay silent, the producer already carries the finding
+            for slot, ((inp, _idx), s) in zip(_slot_names(node),
+                                              zip(entries, ins)):
+                if s is None and inp.is_variable \
+                        and inp.name not in unresolved:
+                    unresolved[inp.name] = (inp, node, slot)
+            vals[id(node)] = (None,) * n_out
+            continue
+
+        # -- GS505: mixed float widths at a join ---------------------------
+        # cast-type ops (Cast, amp_cast, amp_multicast — NOT broadcast_*,
+        # whose "cast" is a substring accident) exist to mix dtypes
+        is_cast = "cast" in node.op.lower().split("_")
+        if len(ins) >= 2 and not is_cast:
+            widths = sorted({str(s.dtype) for s in ins
+                             if _np.dtype(s.dtype).kind == "f"})
+            if len(widths) > 1:
+                flag(node, "GS505",
+                     "node %r (%s) joins inputs of mixed float dtypes %s "
+                     "(from %s) — silent promotion to the widest; cast "
+                     "explicitly if intended"
+                     % (node.name, node.op, widths,
+                        ["%s[%d]" % (inp.name, idx)
+                         for inp, idx in entries]))
+
+        # -- GS501: per-node abstract evaluation ---------------------------
+        attrs = _op_attrs(node, "predict" if reg.needs_mode else None)
+
+        def one(*arrs, _reg_=reg, _attrs_=attrs):
+            a = list(arrs)
+            if _reg_.needs_rng:
+                a = [jax.random.PRNGKey(0)] + a
+            out = _reg_.forward(*a, **_attrs_)
+            return out if isinstance(out, tuple) else (out,)
+
+        try:
+            outs = jax.eval_shape(one, *ins)
+        except Exception as e:
+            flag(node, "GS501",
+                 "node %r (op %s): shape/dtype check failed for input "
+                 "shapes %s (inputs: %s): %s"
+                 % (node.name, node.op,
+                    [tuple(s.shape) for s in ins],
+                    ["%s[%d]" % (inp.name, idx) for inp, idx in entries],
+                    _first_line(e)))
+            vals[id(node)] = (None,) * n_out
+            continue
+        if len(outs) != node.num_outputs:
+            flag(node, "GS501",
+                 "node %r (op %s) declares %d outputs but its forward "
+                 "produced %d under abstract evaluation"
+                 % (node.name, node.op, node.num_outputs, len(outs)))
+        vals[id(node)] = tuple(outs) + (None,) * max(
+            0, node.num_outputs - len(outs))
+
+    # -- GS502: unresolved inputs, blamed on their first consumer ----------
+    for name, (var_node, consumer, slot) in unresolved.items():
+        flag(var_node, "GS502",
+             "cannot infer shape of input %r — first needed by node %r "
+             "(%s) as input %r; pass its shape to lint()/infer_shape or "
+             "attach shape= at var()"
+             % (name, consumer.name, consumer.op, slot))
+
+    findings.sort(key=lambda f: (f.line, f.rule))
+    return findings

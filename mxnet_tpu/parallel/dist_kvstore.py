@@ -709,6 +709,10 @@ class DistKVStore(KVStoreBase):
         self._lock = threading.Lock()
         self._gc = None
         self._optimizer = None
+        # keys this worker has init()ed — every worker runs the same init
+        # sequence, so the local schema mirrors the cluster's and push/
+        # pull key sets can be validated BEFORE any RPC (CC605)
+        self._key_schema = set()
 
     # -- plumbing ----------------------------------------------------------
     def _shard(self, key):
@@ -828,9 +832,34 @@ class DistKVStore(KVStoreBase):
         self._gc = GradientCompression(
             compression_params.get("threshold", 0.5))
 
+    def _check_keys(self, op, keys):
+        """CC605 pre-dispatch validation: duplicate keys in one call, or
+        push/pull keys outside the init()ed schema, deadlock sync mode
+        (the server barriers per key counting ONE contribution per worker
+        per round) — fail here, before any bytes hit the wire."""
+        ks = [str(k) for k in keys]
+        dups = sorted({k for k in ks if ks.count(k) > 1})
+        if dups:
+            raise MXNetError(
+                "CC605 (kvstore-key-divergence): duplicate key(s) %s in "
+                "one %s call — sync mode counts one contribution per "
+                "worker per key per round, so a double push wedges the "
+                "round" % (dups, op))
+        if op != "init" and self._key_schema:
+            unknown = sorted(set(ks) - self._key_schema)
+            if unknown:
+                raise MXNetError(
+                    "CC605 (kvstore-key-divergence): %s of key(s) %s not "
+                    "in the initialized schema %s — workers must init() "
+                    "every key on every worker first, or divergent key "
+                    "sets deadlock the sync round"
+                    % (op, unknown, sorted(self._key_schema)))
+
     def init(self, key, value):
         keys = [key] if not isinstance(key, (list, tuple)) else key
         values = [value] if not isinstance(key, (list, tuple)) else value
+        self._check_keys("init", keys)
+        self._key_schema.update(str(k) for k in keys)
         for k, v in zip(keys, values):
             if self._rank == 0:
                 # init ships host bytes over the wire  # mxlint: allow-host-sync
@@ -866,6 +895,7 @@ class DistKVStore(KVStoreBase):
     def push(self, key, value, priority=0):
         keys = [key] if not isinstance(key, (list, tuple)) else key
         values = [value] if not isinstance(key, (list, tuple)) else value
+        self._check_keys("push", keys)
         for k, v in zip(keys, values):
             merged = self._local_merge(v)
             kind, *fields = self._encode(k, merged)
@@ -874,6 +904,7 @@ class DistKVStore(KVStoreBase):
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys = [key] if not isinstance(key, (list, tuple)) else key
         outs = [out] if not isinstance(key, (list, tuple)) else out
+        self._check_keys("pull", keys)
         for k, o in zip(keys, outs):
             val = self._rpc(k, CMD_PULL, str(k))
             dsts = o if isinstance(o, (list, tuple)) else [o]
@@ -895,6 +926,7 @@ class DistKVStore(KVStoreBase):
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         if row_ids is None:
             return self.pull(key, out, priority)
+        self._check_keys("row_sparse_pull", [key])
         rows_np = row_ids.asnumpy().astype(np.int64) \
             if hasattr(row_ids, "asnumpy") else np.asarray(row_ids,
                                                            np.int64)

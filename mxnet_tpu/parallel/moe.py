@@ -88,6 +88,9 @@ def moe_ffn_sharded(x, router_w, w_in, w_out, mesh, axis_name="expert",
     E/ep experts, and partial outputs are ``psum``-combined.  Numerics
     match :func:`moe_ffn` exactly (same routing, same capacity).
     """
+    from ..analysis.collective_check import check_axis
+
+    check_axis(mesh, axis_name, op="moe_ffn_sharded")
     ep = mesh.shape[axis_name]
     e = router_w.shape[1]
     if e % ep != 0:
@@ -111,7 +114,9 @@ def moe_ffn_sharded(x, router_w, w_in, w_out, mesh, axis_name="expert",
         y = jnp.einsum("sec,ecd->sd", cloc, expert_out)
         return jax.lax.psum(y, axis_name).astype(xl.dtype), aux
 
-    return jax.shard_map(
+    from .mesh import shard_map
+
+    return shard_map(
         local, mesh=mesh,
         in_specs=(P(), P(), P(axis_name), P(axis_name)),
         out_specs=(P(), P()),

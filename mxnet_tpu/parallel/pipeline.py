@@ -37,17 +37,35 @@ def gpipe(stage_fn, stacked_params, x_microbatches, mesh, axis_name="pp"):
     mesh : jax.sharding.Mesh containing ``axis_name``
     Returns the last stage's outputs, (M, microbatch, ...), replicated.
     """
+    from ..analysis.collective_check import check_axis, check_ppermute
+
+    check_axis(mesh, axis_name, op="gpipe")
     n = mesh.shape[axis_name]
     m = x_microbatches.shape[0]
     if n < 2:
-        raise MXNetError("gpipe needs a pipeline axis of size >= 2")
+        raise MXNetError("CC604 (pipeline-schedule-mismatch): gpipe needs "
+                         "a pipeline axis of size >= 2")
+    if m < 1:
+        raise MXNetError("CC604 (pipeline-schedule-mismatch): gpipe needs "
+                         "at least one microbatch (x_microbatches has "
+                         "leading dim 0)")
+    # every check above/below uses static shape metadata only — gpipe runs
+    # under jax.grad, so the arrays themselves may be tracers
+    bad = [tuple(p.shape) for p in jax.tree_util.tree_leaves(stacked_params)
+           if hasattr(p, "shape") and (p.ndim == 0 or p.shape[0] != n)]
+    if bad:
+        raise MXNetError(
+            "CC604 (pipeline-schedule-mismatch): stacked_params leaves "
+            "must have leading axis n_stages=%d (the %r mesh axis); got "
+            "leaf shapes %s" % (n, axis_name, bad))
+    perm = [(i, i + 1) for i in range(n - 1)]  # last stage keeps its output
+    check_ppermute(mesh, axis_name, perm, op="gpipe")
 
     def per_device(params_local, xs):
         # shard_map gives each device a leading-axis slice of size 1
         params = jax.tree_util.tree_map(lambda p: p[0], params_local)
         idx = lax.axis_index(axis_name)
         state0 = jnp.zeros(xs.shape[1:], xs.dtype)
-        perm = [(i, i + 1) for i in range(n - 1)]
 
         def tick(state, t):
             x_t = xs[jnp.clip(t, 0, m - 1)]
@@ -65,7 +83,9 @@ def gpipe(stage_fn, stacked_params, x_microbatches, mesh, axis_name="pp"):
         mine = jnp.where(idx == n - 1, mine, jnp.zeros_like(mine))
         return lax.psum(mine, axis_name)
 
-    return jax.shard_map(
+    from .mesh import shard_map
+
+    return shard_map(
         per_device, mesh=mesh,
         in_specs=(P(axis_name), P()), out_specs=P(),
         check_vma=False,
@@ -190,6 +210,14 @@ class HostPipeline:
         """Returns (mean loss over microbatches, per-stage grads)."""
         n = self.n_stages
         m = len(x_microbatches)
+        if m != len(y_microbatches):
+            raise MXNetError(
+                "CC604 (pipeline-schedule-mismatch): %d x microbatches "
+                "but %d y microbatches — the schedule would silently "
+                "truncate to the shorter list" % (m, len(y_microbatches)))
+        if m < 1:
+            raise MXNetError("CC604 (pipeline-schedule-mismatch): need at "
+                             "least one microbatch")
         acts = [[None] * m for _ in range(n)]  # stage input per mb
         for j, x in enumerate(x_microbatches):
             acts[0][j] = self._put_act(jnp.asarray(x), 0)

@@ -79,8 +79,11 @@ def ring_attention_sharded(q, k, v, mesh, axis_name="sp", scale=None,
     """Shard (B, T, D) [or (B, H, T, D)] on the sequence axis and run
     ring attention over ``axis_name`` of ``mesh``."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
 
+    from .mesh import shard_map
+    from ..analysis.collective_check import check_axis
+
+    check_axis(mesh, axis_name, op="ring_attention_sharded")
     four_d = q.ndim == 4
     if four_d:
         b, h, t, d = q.shape

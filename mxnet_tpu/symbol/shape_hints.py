@@ -9,10 +9,36 @@ everything else is solved by ``jax.eval_shape`` forward propagation.
 from __future__ import annotations
 
 
+def _as_int(v, default=0):
+    """Attr int that may arrive as a string (load_json keeps '3' raw when
+    it round-tripped through a user-edited JSON)."""
+    if v is None:
+        return default
+    if isinstance(v, str):
+        return int(float(v))
+    return int(v)
+
+
 def _as_tuple(v, n=None):
+    """Attr tuple that may arrive as an int, an iterable, or a string
+    form like '(3, 3)' / '[3, 3]' / '3' from serialized graphs."""
     if isinstance(v, int):
         return (v,) * (n or 1)
-    return tuple(v)
+    if isinstance(v, str):
+        body = v.strip().strip("()[]")
+        if not body:
+            return ()
+        return tuple(int(float(p)) for p in body.split(",") if p.strip())
+    return tuple(int(d) for d in v)
+
+
+def _flag(v, default=False):
+    """Attr bool that may arrive as a string ('True'/'1'/'false')."""
+    if v is None:
+        return default
+    if isinstance(v, str):
+        return v.lower() in ("1", "true", "yes", "on")
+    return bool(v)
 
 
 def hint(op, input_names, shapes, attrs):
@@ -31,8 +57,8 @@ def _fully_connected(known, attrs):
     data = known.get("data")
     if data is None:
         return None
-    num_hidden = int(attrs.get("num_hidden", 0))
-    flatten = attrs.get("flatten", True)
+    num_hidden = _as_int(attrs.get("num_hidden"))
+    flatten = _flag(attrs.get("flatten"), True)
     in_units = 1
     if flatten:
         for d in data[1:]:
@@ -40,7 +66,7 @@ def _fully_connected(known, attrs):
     else:
         in_units = data[-1]
     out = {"weight": (num_hidden, in_units)}
-    if not attrs.get("no_bias", False):
+    if not _flag(attrs.get("no_bias")):
         out["bias"] = (num_hidden,)
     return out
 
@@ -50,25 +76,36 @@ def _convolution(known, attrs):
     if data is None:
         return None
     kernel = _as_tuple(attrs.get("kernel", ()))
-    num_filter = int(attrs.get("num_filter", 0))
-    num_group = int(attrs.get("num_group", 1))
+    num_filter = _as_int(attrs.get("num_filter"))
+    num_group = _as_int(attrs.get("num_group"), 1)
     in_c = data[1]
     out = {"weight": (num_filter, in_c // num_group) + kernel}
-    if not attrs.get("no_bias", False):
+    if not _flag(attrs.get("no_bias")):
         out["bias"] = (num_filter,)
     return out
 
 
 def _deconvolution(known, attrs):
-    data = known.get("data")
-    if data is None:
-        return None
     kernel = _as_tuple(attrs.get("kernel", ()))
-    num_filter = int(attrs.get("num_filter", 0))
-    num_group = int(attrs.get("num_group", 1))
-    in_c = data[1]
+    num_filter = _as_int(attrs.get("num_filter"))
+    num_group = _as_int(attrs.get("num_group"), 1)
+    data = known.get("data")
+    if data is not None:
+        in_c = data[1]
+    else:
+        # backwards: recover the input-channel count from a known weight
+        # (in_c, num_filter // num_group, *kernel) — lets infer_shape
+        # run data-shape-free when only parameters are bound
+        weight = known.get("weight")
+        if weight is None or len(weight) < 2:
+            return None
+        in_c = weight[0]
+        if not num_filter:
+            num_filter = weight[1] * num_group
+        if not kernel:
+            kernel = tuple(weight[2:])
     out = {"weight": (in_c, num_filter // num_group) + kernel}
-    if not attrs.get("no_bias", True):
+    if not _flag(attrs.get("no_bias"), True):
         out["bias"] = (num_filter,)
     return out
 
@@ -100,8 +137,14 @@ def _instance_norm(known, attrs):
 
 
 def _embedding(known, attrs):
-    input_dim = int(attrs.get("input_dim", 0))
-    output_dim = int(attrs.get("output_dim", 0))
+    input_dim = _as_int(attrs.get("input_dim"))
+    output_dim = _as_int(attrs.get("output_dim"))
+    # backwards: a known weight shape (vocab, dim) fills whatever the
+    # attrs leave out (deferred-init Gluon blocks carry 0 dims)
+    weight = known.get("weight")
+    if weight is not None and len(weight) == 2:
+        input_dim = input_dim or weight[0]
+        output_dim = output_dim or weight[1]
     if not input_dim or not output_dim:
         return None
     return {"weight": (input_dim, output_dim)}

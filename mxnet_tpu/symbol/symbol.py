@@ -18,6 +18,7 @@ cannot disagree with execution here by construction).
 from __future__ import annotations
 
 import json
+import os
 import threading
 
 import numpy as _np
@@ -356,6 +357,25 @@ class Symbol:
     def eval(self, ctx=None, **kwargs):
         return self.eval_imperative(kwargs)
 
+    # -- verification ------------------------------------------------------
+    def lint(self, arg_dtypes=None, **arg_shapes):
+        """GS5xx graph verification: per-node shape/dtype propagation that
+        blames failures on the offending node (see
+        ``mxnet_tpu/analysis/graph_verify.py``).  Returns a list of
+        ``Finding``s — empty means the graph is well-formed given the
+        supplied shapes::
+
+            sym.lint(data=(8, 10))          # shapes as kwargs
+            sym.lint(arg_dtypes={"data": "float16"}, data=(8, 10))
+
+        Also runs automatically as a bind/simple_bind pre-flight when
+        ``MXNET_GRAPH_VERIFY=1``.
+        """
+        from ..analysis.graph_verify import verify_symbol
+
+        return verify_symbol(self, arg_shapes=arg_shapes,
+                             arg_dtypes=arg_dtypes)
+
     # -- inference ---------------------------------------------------------
     @property
     def shape(self):
@@ -479,6 +499,8 @@ class Symbol:
                     shared_exec=None, shared_buffer=None, **kwargs):
         from .executor import Executor
 
+        if _graph_verify_enabled():
+            _preflight_verify(self, kwargs, type_dict)
         arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
         if arg_shapes is None or any(s is None for s in arg_shapes):
             raise MXNetError(
@@ -503,8 +525,40 @@ class Symbol:
         aux_names = self.list_auxiliary_states()
         if isinstance(aux_states, (list, tuple)):
             aux_states = dict(zip(aux_names, aux_states))
+        if _graph_verify_enabled():
+            bound = dict(args or {})
+            bound.update(aux_states or {})
+            shapes = {k: tuple(v.shape) for k, v in bound.items()
+                      if hasattr(v, "shape")}
+            dtypes = {k: v.dtype for k, v in bound.items()
+                      if hasattr(v, "dtype")}
+            _preflight_verify(self, shapes, dtypes)
         return Executor(self, ctx, args or {}, aux_states or {}, grad_req,
                         args_grad=args_grad, group2ctx=group2ctx)
+
+
+def _graph_verify_enabled():
+    """MXNET_GRAPH_VERIFY=1 turns on the GS5xx bind/simple_bind pre-flight
+    (docs/env_vars.md)."""
+    return os.environ.get("MXNET_GRAPH_VERIFY", "").lower() \
+        in ("1", "true", "yes", "on")
+
+
+def _preflight_verify(sym, arg_shapes, arg_dtypes):
+    """Run GS5xx over the graph before building the Executor; raise on
+    error-severity findings so a bad graph fails with per-node blame
+    instead of a whole-graph eval_shape traceback.  Warn-severity
+    findings (e.g. GS504 dead arguments, which bind tolerates) don't
+    block."""
+    from ..analysis.graph_verify import verify_symbol
+
+    findings = verify_symbol(sym, arg_shapes=arg_shapes,
+                             arg_dtypes=arg_dtypes)
+    errors = [f for f in findings if f.severity == "error"]
+    if errors:
+        raise MXNetError(
+            "graph verification failed (MXNET_GRAPH_VERIFY=1):\n"
+            + "\n".join(str(f) for f in errors))
 
 
 def _solve_shapes(sym, known, partial):
@@ -524,12 +578,12 @@ def _solve_shapes(sym, known, partial):
             else:
                 raise MXNetError(
                     "infer_shape: cannot infer %s from given inputs"
-                    % missing)
+                    % _blame(sym, missing))
         known = hinted
         missing = [n for n in input_names if n not in known]
         if missing and not partial:
             raise MXNetError(
-                "infer_shape: unresolved inputs %s" % missing)
+                "infer_shape: unresolved inputs %s" % _blame(sym, missing))
         if missing:
             return {**known, "__outputs__": [None] * len(sym._outputs)}
     dtypes = {}
@@ -544,6 +598,17 @@ def _solve_shapes(sym, known, partial):
     solved = dict(known)
     solved["__outputs__"] = [tuple(o.shape) for o in outs]
     return solved
+
+
+def _blame(sym, missing):
+    """Annotate unresolved input names with their first consumer node
+    (shared with the GS502 graph-verify rule); plain list on any
+    failure so the original error never gets worse."""
+    try:
+        from ..analysis.graph_verify import blame_unresolved
+        return blame_unresolved(sym, missing)
+    except Exception:
+        return missing
 
 
 def _hint_missing(sym, known, missing):

@@ -1,0 +1,56 @@
+# flake8: noqa
+"""Known-bad collective programs for the CC6xx static pass
+(tests/test_collective_check.py).
+
+Same contract as ``mxlint_bad.py``: every deliberately-bad line carries a
+trailing ``# expect: RULE`` marker and the test asserts the pass produces
+EXACTLY those findings — one per marker, none elsewhere.  The module is a
+lint corpus only; it is parsed, never imported/executed.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from mxnet_tpu import parallel
+
+mesh = parallel.make_mesh({"dp": 4, "tp": 2})
+
+
+def unknown_axis_psum(x):
+    return lax.psum(x, "model")  # expect: CC601
+
+
+def unknown_axis_in_shard_map_spec(fn, x):
+    return jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(P("pp"),),  # expect: CC601
+        out_specs=P())(x)
+
+
+def non_permutation_duplicate_dest(x):
+    return lax.ppermute(x, "dp", perm=[(0, 1), (2, 1), (3, 0)])  # expect: CC602
+
+
+def non_permutation_out_of_range(x):
+    return lax.ppermute(x, "dp", perm=[(0, 5)])  # expect: CC602
+
+
+def collective_under_cond(x):
+    def hot(a):
+        return lax.psum(a, "dp")  # expect: CC603
+
+    def cold(a):
+        return a
+
+    return lax.cond(x.sum() > 0, hot, cold, x)
+
+
+def collective_under_data_branch(x):
+    def body(a):
+        if a.sum() > 0:
+            a = lax.psum(a, "dp")  # expect: CC603
+        return a
+
+    return jax.shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                         out_specs=P("dp"))(x)

@@ -1,0 +1,54 @@
+# flake8: noqa
+"""Known-bad Symbol graphs for the GS5xx verifier tests
+(tests/test_graph_verify.py).
+
+Unlike the source-text corpus (``mxlint_bad.py``), these are LIVE graph
+builders: each function returns ``(symbol, lint_kwargs)`` and the test
+asserts ``symbol.lint(**lint_kwargs)`` yields exactly one finding of the
+named rule.  Imported via importlib by the test, never by the framework.
+"""
+from mxnet_tpu import symbol as S
+import mxnet_tpu as mx
+
+
+def shape_mismatch():
+    """GS501: (2, 3) + (4, 5) cannot broadcast."""
+    a = S.var("a", shape=(2, 3))
+    b = S.var("b", shape=(4, 5))
+    return a + b, {}
+
+
+def unresolved_input():
+    """GS502: 'mystery' has no shape, no hint can solve it."""
+    data = S.var("data", shape=(4, 8))
+    return mx.sym.broadcast_mul(data, S.var("mystery")), {}
+
+
+def duplicate_names():
+    """GS503: two DISTINCT variable nodes both named 'x'."""
+    x1 = S.var("x", shape=(2, 2))
+    x2 = S.var("x", shape=(2, 2))
+    return x1 + x2, {}
+
+
+def dead_argument():
+    """GS504: binding supplies a name no graph input has."""
+    sym = S.var("data", shape=(2, 2)) * 2.0
+    return sym, {"extra_weight": (2, 2)}
+
+
+def dtype_conflict():
+    """GS505: float32 joins float16 (evaluates fine via promotion, so
+    ONLY the dtype rule fires)."""
+    a = S.var("a", shape=(2, 2), dtype="float32")
+    b = S.var("b", shape=(2, 2), dtype="float16")
+    return a + b, {}
+
+
+BUILDERS = {
+    "GS501": shape_mismatch,
+    "GS502": unresolved_input,
+    "GS503": duplicate_names,
+    "GS504": dead_argument,
+    "GS505": dtype_conflict,
+}

@@ -1,0 +1,188 @@
+"""CC6xx collective consistency: the static AST pass over the fixture
+corpus (exact marker match, mxlint_bad.py idiom) and the runtime
+pre-dispatch validators (check_axis / check_ppermute / gpipe /
+HostPipeline / DistKVStore key schema)."""
+import os
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd, parallel
+from mxnet_tpu.analysis import check_axis, check_ppermute
+from mxnet_tpu.analysis.driver import lint_paths
+from mxnet_tpu.analysis.suppressions import SuppressionFile
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.parallel.dist_kvstore import DistKVStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "collective_bad.py")
+
+
+# ---------------------------------------------------------------------------
+# static pass: fixture corpus, exact marker match
+# ---------------------------------------------------------------------------
+def _expected_markers():
+    expected = []
+    with open(FIXTURE) as f:
+        for lineno, line in enumerate(f, start=1):
+            m = re.search(r"#\s*expect:\s*([A-Z]+\d+)", line)
+            if m:
+                expected.append((lineno, m.group(1)))
+    return sorted(expected)
+
+
+def test_fixture_findings_match_markers_exactly():
+    findings = lint_paths([FIXTURE], suppressions=SuppressionFile())
+    got = sorted((f.line, f.rule) for f in findings
+                 if f.rule.startswith("CC"))
+    expected = _expected_markers()
+    assert expected, "fixture has no # expect: markers"
+    assert got == expected, (
+        "static CC pass disagrees with fixture markers:\n"
+        "expected %s\ngot      %s\nfindings:\n%s"
+        % (expected, got, "\n".join(str(f) for f in findings)))
+
+
+def test_fixture_covers_all_static_rules():
+    rules = {r for _, r in _expected_markers()}
+    assert rules == {"CC601", "CC602", "CC603"}
+
+
+# ---------------------------------------------------------------------------
+# runtime validators: check_axis / check_ppermute
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def mesh():
+    devs = np.array(jax.devices()[:4]).reshape(4)
+    return jax.sharding.Mesh(devs, ("dp",))
+
+
+def test_check_axis_unknown_axis(mesh):
+    with pytest.raises(MXNetError, match="CC601") as exc:
+        check_axis(mesh, "model", op="psum")
+    assert "dp" in str(exc.value)  # the valid axes are listed
+
+
+def test_check_axis_known_axis_passes(mesh):
+    check_axis(mesh, "dp", op="psum")
+
+
+def test_check_ppermute_duplicate_destination(mesh):
+    with pytest.raises(MXNetError, match="CC602"):
+        check_ppermute(mesh, "dp", [(0, 1), (2, 1), (3, 0)])
+
+
+def test_check_ppermute_out_of_range(mesh):
+    with pytest.raises(MXNetError, match="CC602"):
+        check_ppermute(mesh, "dp", [(0, 5)])
+
+
+def test_check_ppermute_partial_perm_allowed(mesh):
+    # gpipe's stage shift deliberately drops the last source — partial
+    # permutations are legal unless the caller demands totality
+    check_ppermute(mesh, "dp", [(i, i + 1) for i in range(3)])
+
+
+def test_check_ppermute_require_total(mesh):
+    with pytest.raises(MXNetError, match="CC602"):
+        check_ppermute(mesh, "dp", [(i, i + 1) for i in range(3)],
+                       require_total=True)
+
+
+def test_check_ppermute_full_rotation_passes(mesh):
+    check_ppermute(mesh, "dp", [(i, (i + 1) % 4) for i in range(4)],
+                   require_total=True)
+
+
+# ---------------------------------------------------------------------------
+# gpipe / HostPipeline geometry validation (CC604)
+# ---------------------------------------------------------------------------
+def _pp_mesh(n):
+    devs = np.array(jax.devices()[:n]).reshape(n)
+    return jax.sharding.Mesh(devs, ("pp",))
+
+
+def test_gpipe_rejects_bad_stacked_leading_dim():
+    mesh = _pp_mesh(4)
+    params = {"w": jnp.ones((3, 2, 2))}  # leading 3 != n_stages 4
+    x = jnp.ones((2, 1, 2))
+    with pytest.raises(MXNetError, match="CC604") as exc:
+        parallel.gpipe(lambda p, a: a @ p["w"], params, x, mesh)
+    assert "(3, 2, 2)" in str(exc.value)
+
+
+def test_gpipe_rejects_zero_microbatches():
+    mesh = _pp_mesh(4)
+    params = {"w": jnp.ones((4, 2, 2))}
+    x = jnp.ones((0, 1, 2))
+    with pytest.raises(MXNetError, match="CC604"):
+        parallel.gpipe(lambda p, a: a @ p["w"], params, x, mesh)
+
+
+def test_gpipe_rejects_missing_axis():
+    mesh = _pp_mesh(4)
+    params = {"w": jnp.ones((4, 2, 2))}
+    x = jnp.ones((2, 1, 2))
+    with pytest.raises(MXNetError, match="CC601"):
+        parallel.gpipe(lambda p, a: a @ p["w"], params, x, mesh,
+                       axis_name="pipe")
+
+
+def test_gpipe_valid_geometry_still_runs():
+    mesh = _pp_mesh(4)
+    params = {"w": jnp.stack([jnp.eye(2)] * 4)}
+    x = jnp.ones((2, 1, 2))
+    out = parallel.gpipe(lambda p, a: a @ p["w"], params, x, mesh)
+    assert out.shape == (2, 1, 2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+def test_host_pipeline_rejects_mismatched_microbatch_lists():
+    fns = [lambda p, a: a + p, lambda p, a: a * p]
+    params = [jnp.zeros(()), jnp.ones(())]
+    pipe = parallel.HostPipeline(fns, params,
+                                 lambda out, y: jnp.mean((out - y) ** 2))
+    xs = [jnp.ones((2, 2)), jnp.ones((2, 2))]
+    ys = [jnp.ones((2, 2))]
+    with pytest.raises(MXNetError, match="CC604") as exc:
+        pipe.forward_backward(xs, ys)
+    assert "2 x microbatches but 1 y microbatches" in str(exc.value)
+
+
+def test_host_pipeline_rejects_empty_schedule():
+    fns = [lambda p, a: a + p, lambda p, a: a * p]
+    params = [jnp.zeros(()), jnp.ones(())]
+    pipe = parallel.HostPipeline(fns, params,
+                                 lambda out, y: jnp.mean((out - y) ** 2))
+    with pytest.raises(MXNetError, match="CC604"):
+        pipe.forward_backward([], [])
+
+
+# ---------------------------------------------------------------------------
+# DistKVStore key-schema validation (CC605) — all checks fire BEFORE any
+# RPC, so no server is needed in these tests
+# ---------------------------------------------------------------------------
+def test_kvstore_push_unknown_key():
+    kv = DistKVStore()
+    kv._key_schema.update({"w0", "w1"})
+    with pytest.raises(MXNetError, match="CC605") as exc:
+        kv.push("b0", nd.ones((2,)))
+    msg = str(exc.value)
+    assert "'b0'" in msg and "w0" in msg  # names the schema too
+
+
+def test_kvstore_pull_unknown_key():
+    kv = DistKVStore()
+    kv._key_schema.update({"w0"})
+    with pytest.raises(MXNetError, match="CC605"):
+        kv.pull("bias", out=nd.zeros((2,)))
+
+
+def test_kvstore_duplicate_keys_in_one_call():
+    kv = DistKVStore()
+    with pytest.raises(MXNetError, match="CC605") as exc:
+        kv.push(["w", "w"], [nd.ones((2,)), nd.ones((2,))])
+    assert "duplicate" in str(exc.value)

@@ -1,0 +1,183 @@
+"""GS5xx graph verification: fixture corpus (one finding per rule),
+Symbol.lint(), the MXNET_GRAPH_VERIFY bind pre-flight, the enriched
+infer_shape blame line, and CLI verification of serialized .json graphs
+(docs/static_analysis.md)."""
+import importlib.util
+import os
+import subprocess
+import sys
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import symbol as S
+from mxnet_tpu.base import MXNetError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "graph_bad.py")
+
+
+def _load_fixture():
+    spec = importlib.util.spec_from_file_location("graph_bad", FIXTURE)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# fixture corpus: exactly one finding per rule
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("rule", ["GS501", "GS502", "GS503", "GS504",
+                                  "GS505"])
+def test_fixture_one_finding_per_rule(rule):
+    sym, kwargs = _load_fixture().BUILDERS[rule]()
+    findings = sym.lint(**kwargs)
+    assert [f.rule for f in findings] == [rule], \
+        "\n".join(str(f) for f in findings)
+
+
+def test_shape_mismatch_blames_node_and_shapes():
+    """The acceptance criterion: the offending node + its input shapes,
+    not a raw whole-graph eval_shape traceback."""
+    sym, kwargs = _load_fixture().shape_mismatch()
+    (f,) = sym.lint(**kwargs)
+    assert f.rule == "GS501"
+    assert "broadcast_add" in f.message
+    assert "(2, 3)" in f.message and "(4, 5)" in f.message
+    # producing entries are named too
+    assert "a[0]" in f.message and "b[0]" in f.message
+
+
+def test_unresolved_input_names_first_consumer():
+    sym, kwargs = _load_fixture().unresolved_input()
+    (f,) = sym.lint(**kwargs)
+    assert f.rule == "GS502"
+    assert "'mystery'" in f.message
+    assert "broadcast_mul" in f.message  # which consumer needed it
+
+
+def test_clean_mlp_lints_empty_with_data_shape_only():
+    """Weight shapes come from shape_hints, exactly like infer_shape."""
+    data = S.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="act1")
+    net = mx.sym.SoftmaxOutput(net, name="sm")
+    assert net.lint(data=(8, 10)) == []
+
+
+def test_lint_accepts_arg_dtypes():
+    a = S.var("a", shape=(2, 2))
+    b = S.var("b", shape=(2, 2))
+    sym = a + b
+    assert sym.lint() == []
+    findings = sym.lint(arg_dtypes={"a": "float16"})
+    assert [f.rule for f in findings] == ["GS505"]
+
+
+# ---------------------------------------------------------------------------
+# MXNET_GRAPH_VERIFY pre-flight in bind / simple_bind
+# ---------------------------------------------------------------------------
+def test_bind_preflight_raises_with_node_blame(monkeypatch):
+    monkeypatch.setenv("MXNET_GRAPH_VERIFY", "1")
+    sym, _ = _load_fixture().shape_mismatch()
+    with pytest.raises(MXNetError, match="GS501") as exc:
+        sym.bind(args={"a": nd.zeros((2, 3)), "b": nd.zeros((4, 5))})
+    assert "broadcast_add" in str(exc.value)
+    assert "eval_shape" not in str(exc.value).splitlines()[0]
+
+
+def test_simple_bind_preflight_raises(monkeypatch):
+    monkeypatch.setenv("MXNET_GRAPH_VERIFY", "1")
+    sym, _ = _load_fixture().shape_mismatch()
+    with pytest.raises(MXNetError, match="GS501"):
+        sym.simple_bind(a=(2, 3), b=(4, 5))
+
+
+def test_preflight_off_by_default(monkeypatch):
+    monkeypatch.delenv("MXNET_GRAPH_VERIFY", raising=False)
+    sym, _ = _load_fixture().shape_mismatch()
+    # without the pre-flight the mismatch surfaces at execution, not bind
+    ex = sym.bind(args={"a": nd.zeros((2, 3)), "b": nd.zeros((4, 5))})
+    assert ex is not None
+
+
+def test_preflight_clean_graph_binds(monkeypatch):
+    monkeypatch.setenv("MXNET_GRAPH_VERIFY", "1")
+    a = S.var("a", shape=(2, 2))
+    sym = a * 2.0
+    ex = sym.bind(args={"a": nd.ones((2, 2))})
+    out = ex.forward()[0]
+    assert out.shape == (2, 2)
+
+
+def test_preflight_tolerates_warn_findings(monkeypatch):
+    """GS504 (dead argument) is warn severity — bind legitimately ignores
+    extra bindings, so the pre-flight must not block on it."""
+    monkeypatch.setenv("MXNET_GRAPH_VERIFY", "1")
+    sym = S.var("data", shape=(2, 2)) * 2.0
+    ex = sym.bind(args={"data": nd.ones((2, 2)),
+                        "extra_weight": nd.ones((2, 2))})
+    assert ex is not None
+
+
+# ---------------------------------------------------------------------------
+# enriched infer_shape error path (shared blame helper)
+# ---------------------------------------------------------------------------
+def test_infer_shape_error_names_consumer():
+    p, q = S.var("p"), S.var("q")
+    with pytest.raises(MXNetError, match="needed by") as exc:
+        (p + q).infer_shape()
+    msg = str(exc.value)
+    assert "infer_shape: cannot infer" in msg
+    assert "'p'" in msg and "'q'" in msg
+    assert "broadcast_add" in msg
+
+
+def test_input_consumers_helper():
+    from mxnet_tpu.analysis import input_consumers
+
+    data = S.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    cons = input_consumers(net)
+    assert [c[0].name for c in cons["data"]] == ["fc"]
+    assert cons["data"][0][1] == "data"  # slot name from the registry
+    assert "fc_weight" in cons
+
+
+# ---------------------------------------------------------------------------
+# CLI: serialized .json symbol files
+# ---------------------------------------------------------------------------
+def _run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mxlint.py")]
+        + list(argv),
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+
+def test_cli_flags_bad_symbol_json(tmp_path):
+    sym, _ = _load_fixture().shape_mismatch()
+    path = tmp_path / "bad-symbol.json"
+    sym.save(str(path))
+    r = _run_cli(str(path), "--no-registry-check")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "GS501" in r.stdout
+    assert "broadcast_add" in r.stdout
+
+
+def test_cli_clean_symbol_json_exits_zero(tmp_path):
+    a = S.var("a", shape=(2, 2))
+    sym = a + a
+    path = tmp_path / "good-symbol.json"
+    sym.save(str(path))
+    r = _run_cli(str(path), "--no-registry-check")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_unloadable_json_is_gs501(tmp_path):
+    path = tmp_path / "not-a-symbol.json"
+    path.write_text('{"hello": 1}')
+    r = _run_cli(str(path), "--no-registry-check")
+    assert r.returncode == 1
+    assert "GS501" in r.stdout

@@ -90,8 +90,34 @@ def test_cli_nonzero_with_rule_ids_on_bad_fixture():
 def test_cli_list_rules_exits_zero():
     r = _run_cli("--list-rules")
     assert r.returncode == 0, r.stderr
-    for rule in ("TS105", "HS204", "RC304", "EA402"):
+    for rule in ("TS105", "HS204", "RC304", "EA402", "GS501", "CC601"):
         assert rule in r.stdout
+
+
+def test_cli_fail_on_threshold(tmp_path):
+    # one warn-severity finding (HS201: asscalar in a loop)
+    src = ("def f(batches):\n"
+           "    t = 0.0\n"
+           "    for b in batches:\n"
+           "        t += b.asscalar()\n"
+           "    return t\n")
+    p = tmp_path / "warny.py"
+    p.write_text(src)
+    # default threshold is warn -> fails
+    r = _run_cli(str(p), "--no-registry-check")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "HS201" in r.stdout
+    # raising the threshold to error passes, but the finding still prints
+    r = _run_cli(str(p), "--no-registry-check", "--fail-on", "error")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "HS201" in r.stdout
+
+
+def test_cli_fail_on_rejects_bad_value(tmp_path):
+    p = tmp_path / "empty.py"
+    p.write_text("x = 1\n")
+    r = _run_cli(str(p), "--fail-on", "fatal")
+    assert r.returncode == 2  # argparse usage error (documented exit code)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,113 @@
+"""symbol/shape_hints.py unit tests: forward weight solving, the string
+attr forms serialized graphs carry ("(3, 3)", "True"), and the backwards
+solving added for Embedding and Deconvolution (weight known, data/attrs
+not)."""
+import mxnet_tpu as mx
+from mxnet_tpu.symbol import shape_hints
+
+
+def _hint(op, input_names, shapes, attrs):
+    return shape_hints.hint(op, input_names, shapes, attrs)
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected
+# ---------------------------------------------------------------------------
+def test_fc_basic():
+    out = _hint("FullyConnected", ["data", "weight", "bias"],
+                [(8, 10), None, None], {"num_hidden": 16})
+    assert out == [None, (16, 10), (16,)]
+
+
+def test_fc_no_bias_string_flag():
+    out = _hint("FullyConnected", ["data", "weight"],
+                [(8, 10), None], {"num_hidden": "16", "no_bias": "True"})
+    assert out == [None, (16, 10)]
+
+
+def test_fc_flatten():
+    out = _hint("FullyConnected", ["data", "weight", "bias"],
+                [(8, 3, 4), None, None], {"num_hidden": 5})
+    assert out[1] == (5, 12)
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution (string attrs from load_json)
+# ---------------------------------------------------------------------------
+def test_conv_string_attrs():
+    out = _hint("Convolution", ["data", "weight", "bias"],
+                [(2, 3, 32, 32), None, None],
+                {"kernel": "(3, 3)", "num_filter": "8"})
+    assert out == [None, (8, 3, 3, 3), (8,)]
+
+
+def test_deconv_forward():
+    out = _hint("Deconvolution", ["data", "weight"],
+                [(2, 4, 8, 8), None],
+                {"kernel": (3, 3), "num_filter": 6})
+    assert out == [None, (4, 6, 3, 3)]
+
+
+def test_deconv_backwards_from_weight():
+    # no data shape, no attrs — everything recovered from the weight
+    out = _hint("Deconvolution", ["data", "weight"],
+                [None, (4, 6, 3, 3)], {})
+    assert out == [None, (4, 6, 3, 3)]
+
+
+def test_deconv_backwards_respects_num_group():
+    out = _hint("Deconvolution", ["data", "weight"],
+                [None, (4, 3, 3, 3)], {"num_group": "2"})
+    assert out == [None, (4, 3, 3, 3)]
+
+
+def test_deconv_nothing_known():
+    assert _hint("Deconvolution", ["data", "weight"],
+                 [None, None], {"kernel": (3, 3)}) is None
+
+
+# ---------------------------------------------------------------------------
+# Embedding
+# ---------------------------------------------------------------------------
+def test_embedding_from_attrs():
+    out = _hint("Embedding", ["data", "weight"],
+                [(2, 5), None], {"input_dim": 100, "output_dim": 16})
+    assert out == [None, (100, 16)]
+
+
+def test_embedding_from_string_attrs():
+    out = _hint("Embedding", ["data", "weight"],
+                [(2, 5), None], {"input_dim": "100", "output_dim": "16"})
+    assert out == [None, (100, 16)]
+
+
+def test_embedding_backwards_from_weight():
+    # deferred-init attrs carry 0 dims; a known weight fills them
+    out = _hint("Embedding", ["data", "weight"],
+                [(2, 5), (100, 16)], {"input_dim": 0, "output_dim": 0})
+    assert out == [None, (100, 16)]
+
+
+def test_embedding_nothing_known():
+    assert _hint("Embedding", ["data", "weight"],
+                 [(2, 5), None], {"input_dim": 0, "output_dim": 0}) is None
+
+
+# ---------------------------------------------------------------------------
+# end to end through infer_shape
+# ---------------------------------------------------------------------------
+def test_embedding_infer_shape_fills_weight():
+    sym = mx.sym.Embedding(mx.sym.var("data"), input_dim=100,
+                           output_dim=16, name="emb")
+    arg_shapes, out_shapes, _ = sym.infer_shape(data=(2, 5))
+    shapes = dict(zip(sym.list_arguments(), arg_shapes))
+    assert shapes["emb_weight"] == (100, 16)
+    assert out_shapes == [(2, 5, 16)]
+
+
+def test_deconv_infer_shape_fills_weight():
+    sym = mx.sym.Deconvolution(mx.sym.var("data"), kernel=(3, 3),
+                               num_filter=6, name="dc")
+    arg_shapes, _, _ = sym.infer_shape(data=(2, 4, 8, 8))
+    shapes = dict(zip(sym.list_arguments(), arg_shapes))
+    assert shapes["dc_weight"] == (4, 6, 3, 3)

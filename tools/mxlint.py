@@ -1,16 +1,22 @@
 #!/usr/bin/env python
 """mxlint — framework-aware static analysis for mxnet_tpu code.
 
-Runs the tracing-safety (TS1xx) and host-sync (HS2xx) passes over the given
-files/directories, plus the op-registry consistency pass (RC3xx) when the
-framework imports.  The repo's own tree is a permanent lint target::
+Runs the tracing-safety (TS1xx), host-sync (HS2xx) and collective-
+consistency (CC6xx) passes over the given files/directories, plus the
+op-registry consistency pass (RC3xx) when the framework imports.
+Explicitly-passed ``.json`` files are verified as serialized Symbol
+graphs with the per-node GS5xx pass.  The repo's own tree is a permanent
+lint target::
 
     python tools/mxlint.py mxnet_tpu/ examples/
+    python tools/mxlint.py model-symbol.json
 
-Exit status: 0 when clean (after suppressions), 1 when any finding remains,
-2 on usage error.  See docs/static_analysis.md for the rule catalogue and
-suppression syntax (`# mxlint: allow-host-sync`,
-`# mxlint: disable=TS101`, tools/mxlint_suppressions.txt).
+Exit status (stable, scripted against by CI): 0 when clean (after
+suppressions and the ``--fail-on`` threshold), 1 when any finding at or
+above the threshold remains, 2 on usage error.  See
+docs/static_analysis.md for the rule catalogue and suppression syntax
+(`# mxlint: allow-host-sync`, `# mxlint: disable=TS101`,
+tools/mxlint_suppressions.txt).
 """
 from __future__ import annotations
 
@@ -33,6 +39,12 @@ def main(argv=None):
     ap.add_argument("paths", nargs="*", help="files or directories to lint")
     ap.add_argument("--strict", action="store_true",
                     help="enable advisory rules (HS204)")
+    ap.add_argument("--fail-on", choices=("note", "warn", "error"),
+                    default="warn", metavar="SEVERITY",
+                    help="minimum severity that fails the run (note|warn|"
+                         "error; default: warn — advisory notes print but "
+                         "don't fail).  Findings below the threshold are "
+                         "still printed.")
     ap.add_argument("--no-registry-check", action="store_true",
                     help="skip the RC3xx registry consistency pass")
     ap.add_argument("--no-probe", action="store_true",
@@ -46,7 +58,8 @@ def main(argv=None):
                     help="print the rule catalogue and exit")
     args = ap.parse_args(argv)
 
-    from mxnet_tpu.analysis import RULES, lint_paths, check_registry
+    from mxnet_tpu.analysis import (RULES, lint_paths, check_registry,
+                                    severity_at_least, verify_symbol_file)
 
     if args.list_rules:
         for rid in sorted(RULES):
@@ -58,9 +71,18 @@ def main(argv=None):
     if not args.paths:
         ap.error("no paths given (try: python tools/mxlint.py mxnet_tpu/)")
 
-    findings = lint_paths(args.paths, strict=args.strict,
+    # explicitly-passed .json files are serialized Symbol graphs (GS5xx);
+    # directory walks stay .py-only
+    sym_files = [p for p in args.paths
+                 if os.path.isfile(p) and p.endswith(".json")]
+    py_paths = [p for p in args.paths if p not in sym_files]
+
+    findings = lint_paths(py_paths, strict=args.strict,
                           suppressions=args.suppressions,
-                          relative_to=_REPO_ROOT)
+                          relative_to=_REPO_ROOT) if py_paths else []
+    for p in sym_files:
+        findings.extend(verify_symbol_file(
+            p, relative_to=_REPO_ROOT, suppressions=args.suppressions))
     if not args.no_registry_check:
         try:
             findings.extend(check_registry(suppressions=args.suppressions,
@@ -77,7 +99,8 @@ def main(argv=None):
             print(f)
         n = len(findings)
         print("mxlint: %d finding%s" % (n, "" if n == 1 else "s"))
-    return 1 if findings else 0
+    return 1 if any(severity_at_least(f, args.fail_on)
+                    for f in findings) else 0
 
 
 if __name__ == "__main__":
