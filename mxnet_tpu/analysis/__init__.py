@@ -10,7 +10,7 @@ and framework code keeps two contracts:
 2. every device→host sync on the eager path is *intentional*, because each
    one stalls the PJRT stream the engine relies on for overlap.
 
-This package enforces both, statically and at runtime, with six passes:
+This package enforces both, statically and at runtime, with seven passes:
 
 * **tracing-safety lint** (``TS1xx``, ``tracing_safety``) — AST pass over
   ``hybrid_forward`` bodies and jit-wrapped functions: data-dependent
@@ -37,6 +37,11 @@ This package enforces both, statically and at runtime, with six passes:
   non-permutation ``ppermute`` perms, collectives under data-dependent
   branches) plus runtime pre-dispatch validators used by
   ``parallel/pipeline.py`` and ``parallel/dist_kvstore.py``.
+* **robustness checker** (``RB7xx``, ``wait_loops``) — flags
+  ``Condition.wait(timeout=...)`` whose return value is ignored inside a
+  re-check loop with no deadline: the exact silent-hang shape that
+  wedged the distributed tier before the fault-tolerance work
+  (``docs/fault_tolerance.md``).
 
 CLI: ``python tools/mxlint.py mxnet_tpu/ examples/`` (the repo's own source
 is a permanent lint target; intentional syncs carry

@@ -14,6 +14,8 @@ Rule ID bands (stable, documented in ``docs/static_analysis.md``):
 * ``CC6xx`` — collective consistency (static AST pass over ``parallel/``
   programs + runtime pre-dispatch validators in ``pipeline.py`` /
   ``dist_kvstore.py``, which raise with the same vocabulary)
+* ``RB7xx`` — robustness (static; unbounded condition-wait loops that
+  turn a dead peer into a silent hang)
 """
 from __future__ import annotations
 
@@ -104,6 +106,11 @@ RULES = {
               "dist-kvstore push/pull key sets diverge from the "
               "initialized schema — sync mode barriers per key and "
               "divergent sets deadlock the round"),
+    "RB701": ("wait-without-deadline", True,
+              "Condition.wait(timeout=...) return ignored inside a "
+              "re-check loop with no deadline — a dead peer re-waits "
+              "forever (silent hang); track a monotonic deadline and "
+              "raise naming what's missing"),
 }
 
 # rule id -> severity; rules not listed are "error".  Ordering:

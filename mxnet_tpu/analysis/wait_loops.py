@@ -1,0 +1,92 @@
+"""RB7xx — robustness: unbounded condition-wait loops.
+
+``RB701`` flags the silent-hang shape that wedged the distributed tier
+(the pre-fault-tolerance ``DistServer._do_push``/``_do_barrier``):
+
+.. code-block:: python
+
+    while not predicate:
+        cv.wait(timeout=60)      # return value ignored, loop unbounded
+
+The ``timeout=`` looks like a safety net but isn't one: ``wait`` returns
+``False`` on timeout, the loop ignores it and re-waits, so a peer that
+died turns into an *infinite* re-check loop with zero diagnostics.  The
+fix is a real deadline — compute ``remaining = deadline - monotonic()``
+each pass and raise (naming what's missing) when it runs out.
+
+Heuristic: an expression-statement ``<obj>.wait(timeout=...)`` inside a
+``while`` body is flagged UNLESS the loop shows deadline evidence —
+a call to ``time.monotonic``/``time.time``/``perf_counter`` anywhere in
+the loop, or an identifier mentioning ``deadline``/``remaining``.
+A ``wait`` whose result is consumed (``if not cv.wait(...)``,
+``ok = cv.wait(...)``) is not an Expr statement and never matches.
+"""
+from __future__ import annotations
+
+import ast
+
+from .findings import Finding
+
+_CLOCK_FUNCS = frozenset({"monotonic", "time", "perf_counter",
+                          "monotonic_ns", "time_ns", "perf_counter_ns"})
+_DEADLINE_WORDS = ("deadline", "remaining", "time_left", "timeleft")
+
+
+def _has_deadline_evidence(loop):
+    """True if the while-loop's subtree (test included) computes or
+    consults a deadline."""
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else \
+                (fn.id if isinstance(fn, ast.Name) else "")
+            if name in _CLOCK_FUNCS:
+                return True
+        elif isinstance(node, ast.Name):
+            low = node.id.lower()
+            if any(w in low for w in _DEADLINE_WORDS):
+                return True
+        elif isinstance(node, ast.Attribute):
+            low = node.attr.lower()
+            if any(w in low for w in _DEADLINE_WORDS):
+                return True
+    return False
+
+
+def _is_ignored_timed_wait(stmt):
+    """Expr-statement ``<obj>.wait(timeout=...)`` (result discarded)."""
+    if not isinstance(stmt, ast.Expr) or not isinstance(stmt.value, ast.Call):
+        return False
+    call = stmt.value
+    fn = call.func
+    if not (isinstance(fn, ast.Attribute) and fn.attr == "wait"):
+        return False
+    return any(kw.arg == "timeout" for kw in call.keywords) or call.args
+
+
+class _WaitLoopChecker(ast.NodeVisitor):
+    def __init__(self, path, findings):
+        self.path = path
+        self.findings = findings
+
+    def visit_While(self, node):
+        if not _has_deadline_evidence(node):
+            for stmt in ast.walk(node):
+                if _is_ignored_timed_wait(stmt):
+                    self.findings.append(Finding(
+                        self.path, stmt.lineno,
+                        getattr(stmt, "col_offset", 0), "RB701",
+                        ".wait(timeout=...) return value ignored in a "
+                        "re-check loop with no deadline: a dead peer "
+                        "re-waits forever with zero diagnostics — track "
+                        "`remaining = deadline - monotonic()` and raise "
+                        "(naming what is missing) when it expires"))
+        self.generic_visit(node)
+
+
+def run(path, tree, findings=None):
+    """Run the RB pass over one parsed module; returns the findings list."""
+    if findings is None:
+        findings = []
+    _WaitLoopChecker(path, findings).visit(tree)
+    return findings

@@ -7,6 +7,9 @@ ordinary Python exceptions rather than ``MXGetLastError`` strings.
 """
 from __future__ import annotations
 
+import os as _os
+from contextlib import contextmanager as _contextmanager
+
 
 class MXNetError(RuntimeError):
     """Default error thrown by the runtime (parity: include/mxnet/c_api.h error path)."""
@@ -40,3 +43,30 @@ class classproperty:
 
     def __get__(self, obj, owner):
         return self.fget(owner)
+
+
+@_contextmanager
+def atomic_path(fname):
+    """Write-then-rename: yield a temp path in ``fname``'s directory; on
+    clean exit ``os.replace`` it over ``fname``, on error unlink it.
+
+    Every checkpoint writer (``nd.save``, ``save_checkpoint``,
+    ``Trainer.save_states``, ``Block.save_parameters``) goes through
+    this, so a preemption mid-write can never leave a torn file where a
+    loadable checkpoint used to be — the previous checkpoint survives
+    untouched until the new bytes are fully on disk (same-directory
+    rename keeps the replace atomic on POSIX; cross-device tmp dirs
+    would silently degrade it to copy+delete).
+    """
+    fname = _os.fspath(fname)
+    d, base = _os.path.split(_os.path.abspath(fname))
+    tmp = _os.path.join(d, ".%s.tmp.%d" % (base, _os.getpid()))
+    try:
+        yield tmp
+        _os.replace(tmp, fname)
+    except BaseException:
+        try:
+            _os.unlink(tmp)
+        except OSError:
+            pass
+        raise

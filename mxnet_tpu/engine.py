@@ -26,6 +26,8 @@ import os
 import threading
 import time
 
+from .testing.faults import maybe_inject as _inject
+
 _lock = threading.Lock()
 _var_counter = [0]
 
@@ -102,6 +104,10 @@ class Engine:
         self.stats.ops_pushed += 1
         t0 = time.perf_counter() if self._hooks else 0.0
         try:
+            # chaos hook: an injected op failure takes the same
+            # set_exception path a real one would (tests assert the
+            # async rethrow at the next read of a poisoned var)
+            _inject("engine_push", op=op_name)
             out = fn()
         except Exception as e:
             for v in write_vars:

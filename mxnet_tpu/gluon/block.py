@@ -272,7 +272,11 @@ class Block:
 
     # -- checkpointing ---------------------------------------------------
     def save_parameters(self, filename, deduplicate=False):
-        """Parity: Block.save_parameters (block.py:417); block-local names."""
+        """Parity: Block.save_parameters (block.py:417); block-local names.
+
+        The write is atomic (``nd.save`` goes through ``base.atomic_path``):
+        an interrupted save leaves any previous file loadable.
+        """
         params = self._collect_params_with_prefix()
         from ..ndarray import ndarray as _ndm
 

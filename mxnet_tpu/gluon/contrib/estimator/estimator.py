@@ -49,6 +49,9 @@ class Estimator:
         self.trainer = trainer
         self.context = context
         self.stop_training = False
+        # set by CheckpointHandler(resume_from_checkpoint=True) at
+        # train_begin; StoppingHandler budgets remaining epochs from it
+        self.resumed_from_epoch = 0
 
     # ------------------------------------------------------------------
     def _ensure_trainer(self):

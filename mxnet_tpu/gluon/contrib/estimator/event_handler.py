@@ -8,6 +8,9 @@ from __future__ import annotations
 
 import logging
 import os
+import re
+import signal
+import threading
 import time
 
 import numpy as np
@@ -55,7 +58,15 @@ class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
 
     def train_begin(self, estimator, *args, **kwargs):
         self.current_batch = 0
-        self.current_epoch = 0
+        # a CheckpointHandler(resume_from_checkpoint=True) runs first
+        # (user handlers precede the auto-appended StoppingHandler in
+        # the estimator's stable priority sort) and records the epoch
+        # it restored — the epoch budget counts from there, not zero
+        self.current_epoch = getattr(estimator, "resumed_from_epoch", 0)
+        if self.max_epoch and self.current_epoch >= self.max_epoch:
+            # already trained to budget: don't run a single extra epoch
+            self.stop_training = True
+            estimator.stop_training = True
 
     def batch_end(self, estimator, *args, **kwargs):
         self.current_batch += 1
@@ -170,9 +181,19 @@ class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):
         self.logger.info(" ".join(str(p) for p in parts))
 
 
-class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+class CheckpointHandler(TrainBegin, TrainEnd, BatchEnd, EpochEnd):
     """Save parameters (and trainer states) periodically, keeping the best
-    by a monitored metric (ref :392)."""
+    by a monitored metric (ref :392).
+
+    Preemption safety (docs/fault_tolerance.md): every write is atomic
+    (``save_parameters``/``save_states`` rename a fully-written temp file
+    into place), ``resume_from_checkpoint=True`` restores the latest
+    ``<prefix>-epoch<N>.params`` (+ ``.states``) at ``train_begin`` and
+    publishes ``estimator.resumed_from_epoch`` so the stopping handler
+    budgets the REMAINING epochs, and a SIGTERM received during training
+    checkpoints to ``<prefix>-sigterm.params`` before re-raising the
+    previous handler — the standard eviction flow on preemptible pods.
+    """
 
     def __init__(self, model_dir, model_prefix="model", monitor=None,
                  verbose=0, save_best=False, mode="auto", epoch_period=1,
@@ -185,9 +206,11 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
         self.epoch_period = epoch_period
         self.batch_period = batch_period
         self.max_checkpoints = max_checkpoints
+        self.resume_from_checkpoint = resume_from_checkpoint
         self.current_epoch = 0
         self.current_batch = 0
         self.saved = []
+        self._prev_sigterm = None
         if mode == "auto" and monitor is not None:
             name = monitor.get()[0] if hasattr(monitor, "get") else ""
             mode = "max" if "acc" in str(name) or "f1" in str(name) \
@@ -198,6 +221,71 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
 
     def train_begin(self, estimator, *args, **kwargs):
         os.makedirs(self.model_dir, exist_ok=True)
+        if self.resume_from_checkpoint:
+            self._resume(estimator)
+        self._install_sigterm(estimator)
+
+    def train_end(self, estimator, *args, **kwargs):
+        self._restore_sigterm()
+
+    def _latest_epoch_checkpoint(self):
+        """(epoch, path) of the newest ``<prefix>-epoch<N>.params`` in
+        ``model_dir``, or (None, None)."""
+        pat = re.compile(r"^%s-epoch(\d+)\.params$"
+                         % re.escape(self.model_prefix))
+        best = (None, None)
+        try:
+            entries = os.listdir(self.model_dir)
+        except OSError:
+            return best
+        for name in entries:
+            m = pat.match(name)
+            if m and (best[0] is None or int(m.group(1)) > best[0]):
+                best = (int(m.group(1)),
+                        os.path.join(self.model_dir, name))
+        return best
+
+    def _resume(self, estimator):
+        epoch, path = self._latest_epoch_checkpoint()
+        if path is None:
+            estimator.resumed_from_epoch = 0
+            return
+        estimator.net.load_parameters(path)
+        if estimator.trainer is not None and \
+                os.path.exists(path + ".states"):
+            try:
+                estimator.trainer.load_states(path + ".states")
+            except Exception:
+                logging.getLogger("mxnet_tpu.estimator").warning(
+                    "resume: restored %s but not %s.states", path, path)
+        self.current_epoch = epoch
+        estimator.resumed_from_epoch = epoch
+        logging.getLogger("mxnet_tpu.estimator").info(
+            "resumed from checkpoint %s (epoch %d)", path, epoch)
+
+    def _install_sigterm(self, estimator):
+        # signal handlers are a main-thread privilege; estimator.fit on
+        # a worker thread just skips the SIGTERM hook
+        if threading.current_thread() is not threading.main_thread():
+            return
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _on_term(signum, frame):
+            self._save(estimator, "sigterm")
+            self._restore_sigterm()
+            if callable(prev):
+                prev(signum, frame)
+            else:
+                raise SystemExit(128 + signum)
+
+        self._prev_sigterm = prev
+        signal.signal(signal.SIGTERM, _on_term)
+
+    def _restore_sigterm(self):
+        if self._prev_sigterm is not None and \
+                threading.current_thread() is threading.main_thread():
+            signal.signal(signal.SIGTERM, self._prev_sigterm)
+            self._prev_sigterm = None
 
     def batch_end(self, estimator, *args, **kwargs):
         self.current_batch += 1

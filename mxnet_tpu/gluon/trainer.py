@@ -284,8 +284,13 @@ class Trainer:
             "num_update": self._optimizer.num_update,
             "index_update_count": self._optimizer._index_update_count,
         }
-        with open(fname, "wb") as f:
-            pickle.dump(payload, f)
+        from ..base import atomic_path
+
+        # atomic: a preemption mid-dump must not corrupt the previous
+        # states file (docs/fault_tolerance.md)
+        with atomic_path(fname) as tmp:
+            with open(tmp, "wb") as f:
+                pickle.dump(payload, f)
 
     def load_states(self, fname):
         import pickle

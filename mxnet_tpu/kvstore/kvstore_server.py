@@ -5,10 +5,17 @@ A process launched with ``DMLC_ROLE=server`` calls ``KVStoreServer.run()``
 tools/launch.py arranges) and blocks serving pushes/pulls until every
 distinct worker rank has sent STOP (ps-lite Finalize semantics; the
 launcher additionally terminates servers if a worker dies without one).
+
+Preemption: a SIGTERM (the TPU-pod eviction signal) triggers a clean
+``DistServer.shutdown()`` — the listener and every connection close, so
+workers see a connection error immediately (and retry/fail fast) instead
+of waiting out their wire timeout against a half-dead process.
 """
 from __future__ import annotations
 
 import os
+import signal
+import threading
 
 
 class KVStoreServer:
@@ -25,6 +32,15 @@ class KVStoreServer:
         server = DistServer(
             _server_port(self._root_port, self._server_id),
             self._num_workers, sync=self._sync)
+        if threading.current_thread() is threading.main_thread():
+            prev = signal.getsignal(signal.SIGTERM)
+
+            def _on_term(signum, frame):
+                server.shutdown()
+                if callable(prev):
+                    prev(signum, frame)
+
+            signal.signal(signal.SIGTERM, _on_term)
         server.run()
 
 

@@ -20,7 +20,12 @@ BatchEndParam = namedtuple(
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                     remove_amp_cast=True):
     """Checkpoint symbol + parameters to ``prefix-symbol.json`` and
-    ``prefix-%04d.params``."""
+    ``prefix-%04d.params``.
+
+    Both writes are atomic (tmp + ``os.replace`` via ``base.atomic_path``):
+    a preemption mid-checkpoint leaves the previous epoch's files intact
+    and loadable (docs/fault_tolerance.md).
+    """
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}

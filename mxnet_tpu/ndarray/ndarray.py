@@ -781,7 +781,12 @@ def save(fname, data):
     Format: the reference's binary ``.params`` container (versioned
     magic numbers, ``src/ndarray/ndarray.cc:1586-1860``) — files are
     interchangeable with reference MXNet in both directions.
+
+    Atomic: bytes land in a same-directory temp file that is renamed
+    over ``fname`` only once complete, so a preemption mid-write never
+    corrupts an existing checkpoint (docs/fault_tolerance.md).
     """
+    from ..base import atomic_path
     from . import legacy_io
 
     if isinstance(data, NDArray):
@@ -793,7 +798,8 @@ def save(fname, data):
         arrays = [data[k].asnumpy() for k in names]
     else:
         raise TypeError("unsupported save payload")
-    legacy_io.save_params(fname, arrays, names)
+    with atomic_path(fname) as tmp:
+        legacy_io.save_params(tmp, arrays, names)
 
 
 def load(fname, ctx=None):

@@ -27,6 +27,22 @@ scalar hyperparameters), not a code object; optimizers carrying an
 ``lr_scheduler`` must schedule worker-side (documented limitation —
 the reference shipped the whole pickled object, an RCE by design).
 
+Fault tolerance (``docs/fault_tolerance.md``): wire protocol v2 carries a
+``{rank, seq}`` header on every *mutating* command (init/push/barrier/
+set-optimizer/stop) — the per-worker monotonic sequence number lets the
+server deduplicate replays, so the client can retry any failed RPC with
+capped exponential backoff (``MXNET_KVSTORE_RETRIES`` ×
+``MXNET_KVSTORE_BACKOFF``), evicting the dead socket, reconnecting,
+re-handshaking and replaying the in-flight request; the server applies
+each mutation exactly once (pulls are idempotent and retry freely).
+Sync rounds and barriers carry a hard deadline
+(``MXNET_KVSTORE_BARRIER_TIMEOUT``) after which the server *names the
+missing ranks* in an error reply instead of wedging every worker —
+optionally (``MXNET_KVSTORE_ALLOW_DEGRADED=1``) marking them dead and
+continuing with the survivors.  All of it is exercised by the seeded
+fault-injection harness (``mxnet_tpu.testing.faults``) hooked into
+``_send``/``_recv``/``_sock``/``DistServer._handle``.
+
 Environment (reference names, ``tools/launch.py`` sets them):
 ``DMLC_ROLE`` (worker|server|scheduler), ``DMLC_PS_ROOT_URI``,
 ``DMLC_PS_ROOT_PORT``, ``DMLC_NUM_WORKER``, ``DMLC_NUM_SERVER``,
@@ -34,14 +50,17 @@ plus ``MXNET_KVSTORE_SECRET`` (optional shared secret).
 """
 from __future__ import annotations
 
+import collections
 import hashlib
 import hmac as _hmac
 import json
 import os
+import random as _random
 import secrets as _secrets
 import socket
 import struct
 import threading
+import time as _time
 import warnings
 
 import numpy as np
@@ -50,20 +69,24 @@ from ..base import MXNetError
 from ..kvstore.base import KVStoreBase
 from ..ndarray.ndarray import NDArray
 from ..ndarray import sparse as _sp
+from ..testing.faults import maybe_inject as _inject, set_role as _set_role
 
 
 # ---------------------------------------------------------------------------
-# wire protocol: MAGIC | ver u8 | cmd u8 | nfields u8 | fields
+# wire protocol v2: MAGIC | ver u8 | cmd u8 | nfields u8 | fields
 # field := tag u8 | payload
 #   'S' string:  u32 len | utf8
 #   'B' bytes:   u32 len | raw
 #   'J' json:    u32 len | utf8(json)
 #   'F' float64: f64
 #   'T' tensor:  u8 dlen | dtype-ascii | u8 ndim | i64*ndim dims | u64 | raw
+# v2 (over v1): every mutating command's FIRST field is a 'J' meta dict
+# {"rank": int, "seq": int} — the worker's monotonic sequence number the
+# server dedups replayed mutations on (docs/fault_tolerance.md).
 # ---------------------------------------------------------------------------
 
 _MAGIC = b"MXKV"
-_VERSION = 1
+_VERSION = 2
 
 CMD_OK = 0
 CMD_INIT = 1
@@ -77,7 +100,48 @@ CMD_HELLO = 8
 CMD_PROFILER = 9
 CMD_ERR = 255
 
+# commands that change server state: these carry the {rank, seq} meta
+# header and are dedup'd server-side (pulls retry freely without one)
+_MUTATING = frozenset({CMD_INIT, CMD_PUSH, CMD_BARRIER, CMD_SET_OPTIMIZER,
+                       CMD_STOP})
+
 _MAX_FRAME = 1 << 34  # 16 GiB sanity ceiling per tensor/string
+
+
+def _retries():
+    """Max RPC retries after the first attempt (MXNET_KVSTORE_RETRIES)."""
+    return int(os.environ.get("MXNET_KVSTORE_RETRIES", "4"))
+
+
+def _backoff():
+    """Base backoff (s) for RPC retries; attempt k sleeps
+    ``base * 2**k`` (capped at 5s) with ±25% jitter so reconnecting
+    workers don't stampede the recovering server in lockstep."""
+    return float(os.environ.get("MXNET_KVSTORE_BACKOFF", "0.2"))
+
+
+def _backoff_sleep(attempt):
+    base = _backoff()
+    _time.sleep(min(base * (2 ** attempt), 5.0)
+                * (0.75 + _random.random() * 0.5))
+
+
+def _barrier_timeout():
+    """Hard deadline (s) for a sync round / barrier wait on the SERVER
+    (MXNET_KVSTORE_BARRIER_TIMEOUT).  When it expires the server replies
+    with an error naming the missing ranks instead of wedging every
+    worker forever.  0 disables (returns +inf)."""
+    t = float(os.environ.get("MXNET_KVSTORE_BARRIER_TIMEOUT", "600"))
+    return t if t > 0 else float("inf")
+
+
+def _allow_degraded():
+    """MXNET_KVSTORE_ALLOW_DEGRADED=1: on a round/barrier timeout, mark
+    the missing ranks dead and continue with the survivors instead of
+    erroring the round (dist_async jobs that prefer progress over
+    completeness; dist_sync semantics become best-effort)."""
+    return os.environ.get("MXNET_KVSTORE_ALLOW_DEGRADED", "0") \
+        not in ("", "0")
 
 
 def _wire_timeout():
@@ -132,6 +196,7 @@ def _recv_exact(sock, n):
 def _send(sock, cmd, *fields):
     """Encode small parts into one header buffer; large tensor payloads
     are sent as zero-copy memoryviews (no 64MB tobytes round trips)."""
+    _inject("send", sock=sock, cmd=cmd)
     out = bytearray()
     out += _MAGIC
     out += struct.pack("<BBB", _VERSION, cmd, len(fields))
@@ -172,6 +237,7 @@ def _recv(sock, max_bytes=_MAX_FRAME):
     """Decode one frame.  ``max_bytes`` caps any single field allocation —
     servers keep it tiny until the peer has authenticated, so an
     unauthenticated connection cannot force multi-GiB allocations."""
+    _inject("recv", sock=sock)
     magic = _recv_exact(sock, 4)
     if magic != _MAGIC:
         raise MXNetError("wire: bad magic %r" % magic)
@@ -388,14 +454,22 @@ class GradientCompression:
 # ---------------------------------------------------------------------------
 
 class _KeyState:
-    __slots__ = ("value", "pending", "round", "round_done", "lock")
+    __slots__ = ("value", "pending", "contributors", "round", "round_done",
+                 "last_error", "lock")
 
     def __init__(self):
         self.value = None
         self.pending = []  # accumulated pushes this round
+        self.contributors = set()  # worker ranks that pushed this round
         self.round = 0
         self.round_done = threading.Condition()
+        self.last_error = None  # (generation, message) of a timed-out round
         self.lock = threading.Lock()
+
+
+class _RoundError(MXNetError):
+    """A sync round / barrier expired its deadline; the message names the
+    ranks that never contributed (docs/fault_tolerance.md)."""
 
 
 class DistServer:
@@ -408,6 +482,11 @@ class DistServer:
     Async mode: every push applies immediately.
     """
 
+    # replies remembered per rank for sequence-number dedup; bounded —
+    # a client holds at most a few RPCs in flight, so a replayed seq is
+    # always among the most recent entries
+    _SEQ_CACHE_DEPTH = 256
+
     def __init__(self, port, num_workers, sync=True):
         self._port = int(port)
         self._num_workers = int(num_workers)
@@ -417,12 +496,80 @@ class DistServer:
         self._updater = None
         self._optimizer = None
         self._barrier_count = 0
+        self._barrier_ranks = set()
         self._barrier_gen = 0
+        self._barrier_error = None  # (generation, message)
         self._barrier_cv = threading.Condition()
         self._stop = threading.Event()
         self._stop_count = 0
         self._stopped_ranks = set()
         self._stop_lock = threading.Lock()
+        # fault-tolerance state (docs/fault_tolerance.md)
+        self._seq_cache = {}  # rank -> OrderedDict(seq -> (cmd, fields))
+        self._seq_cv = threading.Condition()  # guards + signals _seq_cache
+        self._dead_ranks = set()  # ranks declared dead after a timeout
+        self._replays = 0  # dedup'd (replayed) mutations served from cache
+        self._srv_sock = None
+        self._conns = []
+
+    # -- sequence-number dedup ---------------------------------------------
+    def _seq_claim(self, rank, seq):
+        """Atomically claim a sequence number at frame-decode time.
+
+        Returns ``(False, None)`` for a first-seen seq (the caller must
+        apply the mutation and ``_seq_store`` the reply), else
+        ``(True, reply)`` — where ``reply`` is ``None`` while the
+        ORIGINAL request is still mid-apply on another connection.
+        Claiming before applying (not after) is what closes the race
+        where a fast retry lands on a new connection while the first
+        copy is still being applied: the replay must wait for the
+        original's reply, never re-apply.
+        """
+        with self._seq_cv:
+            cache = self._seq_cache.setdefault(rank,
+                                               collections.OrderedDict())
+            if seq in cache:
+                self._replays += 1
+                return True, cache[seq]
+            cache[seq] = None  # claimed; apply in progress
+            while len(cache) > self._SEQ_CACHE_DEPTH:
+                cache.popitem(last=False)
+            return False, None
+
+    def _seq_store(self, rank, seq, reply):
+        with self._seq_cv:
+            cache = self._seq_cache.setdefault(rank,
+                                               collections.OrderedDict())
+            cache[seq] = reply
+            self._seq_cv.notify_all()
+
+    def _seq_await(self, rank, seq):
+        """Block until the original request for ``seq`` stores its reply
+        (returns it), or the deadline passes (returns ``None`` — the
+        original handler died mid-apply and will never answer)."""
+        deadline = _time.monotonic() + _barrier_timeout()
+        with self._seq_cv:
+            while True:
+                reply = self._seq_cache.get(rank, {}).get(seq)
+                if reply is not None:
+                    return reply
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._seq_cv.wait(timeout=min(remaining, 60.0))
+
+    def _live_workers(self):
+        return self._num_workers - len(self._dead_ranks)
+
+    def _mark_dead(self, ranks):
+        """Degraded mode: declare ranks dead so later rounds/barriers/stop
+        count only the survivors."""
+        self._dead_ranks.update(ranks)
+        warnings.warn(
+            "kvstore server: continuing degraded without rank(s) %s "
+            "(%d/%d workers remain)" % (sorted(ranks),
+                                        self._live_workers(),
+                                        self._num_workers))
 
     def _key(self, k):
         with self._keys_lock:
@@ -489,6 +636,7 @@ class DistServer:
 
     def _handle(self, sock):
         authed = not _secret()
+        _set_role("server")
         # unauthenticated peers get a short deadline (can't park a server
         # thread); once authenticated the connection may legitimately sit
         # idle between training rounds, so the deadline comes off
@@ -498,6 +646,7 @@ class DistServer:
                 # unauthenticated peers may only send tiny (HELLO) frames
                 cmd, f = _recv(
                     sock, max_bytes=_MAX_FRAME if authed else 4096)
+                _inject("server_handle", server=self, cmd=cmd)
                 if cmd == CMD_HELLO:
                     authed = _server_hello(sock, f)
                     if not authed:
@@ -507,18 +656,53 @@ class DistServer:
                 if not authed:
                     _send(sock, CMD_ERR, "unauthenticated")
                     return
+                # mutating commands carry the {rank, seq} meta header:
+                # a replayed sequence number is answered from the reply
+                # cache WITHOUT re-applying (exactly-once mutations under
+                # client retry; docs/fault_tolerance.md)
+                rank = seq = None
+                if cmd in _MUTATING and f and isinstance(f[0], dict) \
+                        and "seq" in f[0]:
+                    rank, seq = int(f[0].get("rank", 0)), int(f[0]["seq"])
+                    f = f[1:]
+                    replay, cached = self._seq_claim(rank, seq)
+                    if replay:
+                        # the original may still be mid-apply on another
+                        # connection: wait for ITS reply — re-applying
+                        # here would break exactly-once
+                        if cached is None:
+                            cached = self._seq_await(rank, seq)
+                        if cached is None:
+                            _send(sock, CMD_ERR,
+                                  "replayed request (rank %d seq %d) "
+                                  "never completed server-side"
+                                  % (rank, seq))
+                        else:
+                            _send(sock, cached[0], *cached[1])
+                        if cmd == CMD_STOP:
+                            return
+                        continue
+
+                def reply(rcmd, *rfields):
+                    if seq is not None:
+                        self._seq_store(rank, seq, (rcmd, rfields))
+                    _send(sock, rcmd, *rfields)
+
                 if cmd == CMD_INIT:
                     key, value = f
                     st = self._key(key)
                     with st.lock:
                         if st.value is None:
                             st.value = np.asarray(value)
-                    _send(sock, CMD_OK)
+                    reply(CMD_OK)
                 elif cmd == CMD_PUSH:
                     t0 = self._prof_now()
                     key = f[0]
-                    self._do_push(key, self._decode(f[1], f[2:]))
-                    _send(sock, CMD_OK)
+                    try:
+                        self._do_push(key, self._decode(f[1], f[2:]), rank)
+                        reply(CMD_OK)
+                    except _RoundError as e:
+                        reply(CMD_ERR, str(e))
                     self._prof_span("KVStoreServer::push", t0)
                 elif cmd == CMD_PULL:
                     t0 = self._prof_now()
@@ -540,14 +724,17 @@ class DistServer:
                         rows = base[np.asarray(row_ids)]
                     _send(sock, CMD_OK, rows)
                 elif cmd == CMD_BARRIER:
-                    self._do_barrier()
-                    _send(sock, CMD_OK)
+                    try:
+                        self._do_barrier(rank)
+                        reply(CMD_OK)
+                    except _RoundError as e:
+                        reply(CMD_ERR, str(e))
                 elif cmd == CMD_SET_OPTIMIZER:
                     from .. import optimizer as opt_mod
 
                     self._optimizer = _optimizer_from_config(f[0])
                     self._updater = opt_mod.get_updater(self._optimizer)
-                    _send(sock, CMD_OK)
+                    reply(CMD_OK)
                 elif cmd == CMD_PROFILER:
                     # remote profiling (parity: the reference's
                     # kSetProfilerParams server command,
@@ -582,23 +769,29 @@ class DistServer:
                         _send(sock, CMD_ERR,
                               "profiler %s failed: %s" % (action, pe))
                 elif cmd == CMD_STOP:
-                    _send(sock, CMD_OK)
+                    reply(CMD_OK)
                     # the server dies only when EVERY distinct worker
                     # rank said stop (ps-lite Finalize semantics): under
                     # load, worker finish times skew by many seconds —
                     # the first finisher must not kill the service under
                     # the rest.  Duplicate stops from one rank (retry,
-                    # second DistKVStore instance) don't count twice; a
-                    # rankless STOP (legacy frame) falls back to a
-                    # counter.
+                    # second DistKVStore instance) don't count twice —
+                    # the meta rank (or a legacy rank field) keys a set;
+                    # a rankless STOP falls back to a counter.  Ranks
+                    # declared dead by a degraded round count as stopped
+                    # (they will never say goodbye).
+                    stop_rank = str(rank) if rank is not None \
+                        else (str(f[0]) if f else None)
                     with self._stop_lock:
-                        if f:
-                            self._stopped_ranks.add(str(f[0]))
-                            done = len(self._stopped_ranks) \
+                        if stop_rank is not None:
+                            self._stopped_ranks.add(stop_rank)
+                            done = len(self._stopped_ranks
+                                       | {str(r)
+                                          for r in self._dead_ranks}) \
                                 >= self._num_workers
                         else:
                             self._stop_count += 1
-                            done = self._stop_count >= self._num_workers
+                            done = self._stop_count >= self._live_workers()
                         if done:
                             self._stop.set()
                     return
@@ -630,37 +823,124 @@ class DistServer:
             return codes.astype(np.float32) * threshold
         raise MXNetError("bad payload kind %r" % (kind,))
 
-    def _do_push(self, key, value):
+    def _missing_ranks(self, contributed):
+        known = {int(r) for r in contributed if r is not None}
+        return sorted(set(range(self._num_workers)) - known
+                      - self._dead_ranks)
+
+    def _complete_round(self, st, key):
+        """Merge + apply the pending pushes and release the round.
+        Caller holds ``st.round_done``."""
+        merged = self._merge(st.pending)
+        with st.lock:
+            self._apply(st, key, merged)
+        st.pending = []
+        st.contributors = set()
+        st.round += 1
+        st.round_done.notify_all()
+
+    def _do_push(self, key, value, rank=None):
         st = self._key(key)
         if not self._sync:
             with st.lock:
                 self._apply(st, key, value)
             return
         with st.round_done:
+            gen = st.round
             st.pending.append(value)
-            if len(st.pending) == self._num_workers:
-                merged = self._merge(st.pending)
-                with st.lock:
-                    self._apply(st, key, merged)
-                st.pending = []
-                st.round += 1
-                st.round_done.notify_all()
-            else:
-                gen = st.round
-                while st.round == gen:
-                    st.round_done.wait(timeout=60)
+            st.contributors.add(rank)
+            # release on DISTINCT live contributors, not raw push count:
+            # a rankless (legacy) push falls back to counting entries
+            arrived = len({r for r in st.contributors if r is not None}) \
+                if rank is not None else len(st.pending)
+            if arrived >= self._live_workers():
+                self._complete_round(st, key)
+                return
+            # deadline loop (NOT a bare re-check wait: a dead worker must
+            # surface as an error naming it, never a silent wedge — RB701)
+            deadline = _time.monotonic() + _barrier_timeout()
+            while st.round == gen:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    missing = self._missing_ranks(st.contributors)
+                    msg = ("sync round for key %r timed out after %gs "
+                           "(MXNET_KVSTORE_BARRIER_TIMEOUT) waiting on "
+                           "rank(s) %s — %d/%d contributions arrived"
+                           % (key, _barrier_timeout(), missing,
+                              len(st.pending), self._live_workers()))
+                    if _allow_degraded() and st.pending:
+                        self._mark_dead(missing)
+                        self._complete_round(st, key)
+                        return
+                    st.last_error = (gen, msg)
+                    st.pending = []
+                    st.contributors = set()
+                    st.round += 1
+                    st.round_done.notify_all()
+                    raise _RoundError(msg)
+                st.round_done.wait(timeout=min(remaining, 60.0))
+            # round advanced while we waited: if it advanced BECAUSE a
+            # peer's deadline fired, we share its fate
+            if st.last_error is not None and st.last_error[0] == gen:
+                raise _RoundError(st.last_error[1])
 
-    def _do_barrier(self):
+    def _do_barrier(self, rank=None):
         with self._barrier_cv:
             gen = self._barrier_gen
             self._barrier_count += 1
-            if self._barrier_count == self._num_workers:
+            self._barrier_ranks.add(rank)
+            arrived = len({r for r in self._barrier_ranks
+                           if r is not None}) \
+                if rank is not None else self._barrier_count
+            if arrived >= self._live_workers():
                 self._barrier_count = 0
+                self._barrier_ranks = set()
                 self._barrier_gen += 1
                 self._barrier_cv.notify_all()
-            else:
-                while self._barrier_gen == gen:
-                    self._barrier_cv.wait(timeout=60)
+                return
+            deadline = _time.monotonic() + _barrier_timeout()
+            while self._barrier_gen == gen:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    missing = self._missing_ranks(self._barrier_ranks)
+                    msg = ("barrier timed out after %gs "
+                           "(MXNET_KVSTORE_BARRIER_TIMEOUT) waiting on "
+                           "rank(s) %s" % (_barrier_timeout(), missing))
+                    if _allow_degraded():
+                        self._mark_dead(missing)
+                        self._barrier_count = 0
+                        self._barrier_ranks = set()
+                        self._barrier_gen += 1
+                        self._barrier_cv.notify_all()
+                        return
+                    self._barrier_error = (gen, msg)
+                    self._barrier_count = 0
+                    self._barrier_ranks = set()
+                    self._barrier_gen += 1
+                    self._barrier_cv.notify_all()
+                    raise _RoundError(msg)
+                self._barrier_cv.wait(timeout=min(remaining, 60.0))
+            if self._barrier_error is not None \
+                    and self._barrier_error[0] == gen:
+                raise _RoundError(self._barrier_error[1])
+
+    def shutdown(self):
+        """Hard-stop the server NOW: close the listener and every live
+        connection (used by the SIGTERM handler in ``kvstore_server`` and
+        the ``kill_server`` fault action — simulates preemption)."""
+        self._stop.set()
+        srv, self._srv_sock = self._srv_sock, None
+        if srv is not None:
+            try:
+                srv.close()
+            except OSError:
+                pass
+        conns, self._conns = list(self._conns), []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
 
     def run(self):
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -670,6 +950,7 @@ class DistServer:
         srv.bind(("", self._port))
         srv.listen(64)
         srv.settimeout(1.0)
+        self._srv_sock = srv
         threads = []
         while not self._stop.is_set():
             try:
@@ -677,11 +958,17 @@ class DistServer:
                 _tune_socket(conn)
             except socket.timeout:
                 continue
+            except OSError:
+                break  # listener closed by shutdown()
+            self._conns.append(conn)
             t = threading.Thread(target=self._handle, args=(conn,),
                                  daemon=True)
             t.start()
             threads.append(t)
-        srv.close()
+        try:
+            srv.close()
+        except OSError:
+            pass
 
 
 # ---------------------------------------------------------------------------
@@ -709,10 +996,20 @@ class DistKVStore(KVStoreBase):
         self._lock = threading.Lock()
         self._gc = None
         self._optimizer = None
+        # per-worker monotonic sequence number stamped on every mutating
+        # RPC — the server dedups replays on it, making retries safe
+        # (wire protocol v2, docs/fault_tolerance.md)
+        self._seq = 0
+        self._seq_lock = threading.Lock()
         # keys this worker has init()ed — every worker runs the same init
         # sequence, so the local schema mirrors the cluster's and push/
         # pull key sets can be validated BEFORE any RPC (CC605)
         self._key_schema = set()
+
+    def _next_seq(self):
+        with self._seq_lock:
+            self._seq += 1
+            return self._seq
 
     # -- plumbing ----------------------------------------------------------
     def _shard(self, key):
@@ -733,6 +1030,7 @@ class DistKVStore(KVStoreBase):
         with self._lock:
             s = self._socks.get(server_id)
             if s is None:
+                _inject("connect", server=server_id)
                 addr = (self._root,
                         _server_port(self._root_port, server_id))
                 # retry refused connects: at job start the server process
@@ -741,10 +1039,9 @@ class DistKVStore(KVStoreBase):
                 # short deadline — the wire-read timeout is sized for
                 # sync-round reads waiting on slow compiles (30min); a dead
                 # or misaddressed server must fail in seconds, not that
-                import time as _time
-
-                deadline = _time.monotonic() + min(
-                    _wire_timeout() or 60, 60)
+                deadline = _time.monotonic() + float(os.environ.get(
+                    "MXNET_KVSTORE_CONNECT_TIMEOUT",
+                    min(_wire_timeout() or 60, 60)))
                 while True:
                     try:
                         s = socket.create_connection(addr, timeout=60)
@@ -763,13 +1060,64 @@ class DistKVStore(KVStoreBase):
                 self._socks[server_id] = s
             return s
 
-    def _rpc(self, key, cmd, *fields):
-        s = self._sock(self._shard(key))
+    def _evict(self, server_id, sock=None):
+        """Drop a (dead) cached socket so the next RPC reconnects.  A
+        send/recv failure MUST evict: leaving the broken FD in ``_socks``
+        would make every later RPC to that shard reuse it and fail."""
         with self._lock:
-            _send(s, cmd, *fields)
-            rcmd, rfields = _recv(s)
-        if rcmd != CMD_OK:
-            raise MXNetError("kvstore rpc failed: %r" % (rfields,))
+            cached = self._socks.get(server_id)
+            if cached is not None and (sock is None or cached is sock):
+                del self._socks[server_id]
+                try:
+                    cached.close()
+                except OSError:
+                    pass
+
+    def _rpc_to(self, server_id, cmd, *fields, mutating=False):
+        """One request/reply exchange with retry.
+
+        Mutating commands get a fresh sequence number stamped into the
+        v2 meta header ONCE, then the whole request is replayed verbatim
+        on retry — the server's dedup cache makes the retry idempotent.
+        Transport failures (reset, refused, EOF) evict the socket, back
+        off exponentially with jitter, reconnect (re-handshaking), and
+        replay.  Server-reported errors (CMD_ERR) and wire timeouts are
+        NOT retried: the peer is alive and said no.
+        """
+        _set_role("worker", rank=self._rank)
+        if mutating:
+            fields = ({"rank": self._rank, "seq": self._next_seq()},) \
+                + fields
+        attempts = _retries() + 1
+        last_err = None
+        for attempt in range(attempts):
+            s = None
+            try:
+                s = self._sock(server_id)
+                with self._lock:
+                    _send(s, cmd, *fields)
+                    rcmd, rfields = _recv(s)
+                if rcmd != CMD_OK:
+                    raise MXNetError(
+                        "kvstore rpc (cmd %d, server %d) failed: %s"
+                        % (cmd, server_id,
+                           rfields[0] if rfields else "<no detail>"))
+                return rfields
+            except (ConnectionError, OSError) as e:
+                last_err = e
+                if s is not None:
+                    self._evict(server_id, s)
+                if attempt + 1 >= attempts:
+                    break
+                _backoff_sleep(attempt)
+        raise MXNetError(
+            "kvstore rpc (cmd %d, server %d) failed after %d attempt(s): "
+            "%s (MXNET_KVSTORE_RETRIES/MXNET_KVSTORE_BACKOFF tune the "
+            "retry schedule)" % (cmd, server_id, attempts, last_err))
+
+    def _rpc(self, key, cmd, *fields, mutating=False):
+        rfields = self._rpc_to(self._shard(key), cmd, *fields,
+                               mutating=mutating)
         return rfields[0] if rfields else None
 
     # -- remote (server-side) profiling ------------------------------------
@@ -779,13 +1127,7 @@ class DistKVStore(KVStoreBase):
         include/mxnet/kvstore.h:49)."""
         outs = []
         for sid in range(self._num_servers):
-            s = self._sock(sid)
-            with self._lock:
-                _send(s, CMD_PROFILER, cfg)
-                rcmd, rfields = _recv(s)
-            if rcmd != CMD_OK:
-                raise MXNetError("server profiler command failed: %r"
-                                 % (rfields,))
+            rfields = self._rpc_to(sid, CMD_PROFILER, cfg)
             outs.append(rfields[0] if rfields else "")
         return outs
 
@@ -864,7 +1206,7 @@ class DistKVStore(KVStoreBase):
             if self._rank == 0:
                 # init ships host bytes over the wire  # mxlint: allow-host-sync
                 arr = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
-                self._rpc(k, CMD_INIT, str(k), arr)
+                self._rpc(k, CMD_INIT, str(k), arr, mutating=True)
         self.barrier()
 
     def _encode(self, key, v):
@@ -899,7 +1241,7 @@ class DistKVStore(KVStoreBase):
         for k, v in zip(keys, values):
             merged = self._local_merge(v)
             kind, *fields = self._encode(k, merged)
-            self._rpc(k, CMD_PUSH, str(k), kind, *fields)
+            self._rpc(k, CMD_PUSH, str(k), kind, *fields, mutating=True)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys = [key] if not isinstance(key, (list, tuple)) else key
@@ -941,14 +1283,10 @@ class DistKVStore(KVStoreBase):
             dst._set_data(full)
 
     def barrier(self):
-        # every worker must hit every server for a true global barrier
+        # every worker must hit every server for a true global barrier;
+        # mutating: a replayed barrier must not double-count this rank
         for sid in range(self._num_servers):
-            s = self._sock(sid)
-            with self._lock:
-                _send(s, CMD_BARRIER)
-                rcmd, _f = _recv(s)
-            if rcmd != CMD_OK:
-                raise MXNetError("barrier failed")
+            self._rpc_to(sid, CMD_BARRIER, mutating=True)
 
     def set_optimizer(self, optimizer):
         """Run the optimizer server-side (parity: SendCommandToServers)."""
@@ -956,12 +1294,7 @@ class DistKVStore(KVStoreBase):
         if self._rank == 0:
             cfg = _optimizer_to_config(optimizer)
             for sid in range(self._num_servers):
-                s = self._sock(sid)
-                with self._lock:
-                    _send(s, CMD_SET_OPTIMIZER, cfg)
-                    rcmd, _f = _recv(s)
-                if rcmd != CMD_OK:
-                    raise MXNetError("set_optimizer failed")
+                self._rpc_to(sid, CMD_SET_OPTIMIZER, cfg, mutating=True)
         self.barrier()
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
@@ -973,14 +1306,14 @@ class DistKVStore(KVStoreBase):
     def stop(self):
         # EVERY server shard gets this worker's stop (even ones this
         # worker never pushed to): the server quits once each distinct
-        # rank has said goodbye
+        # rank has said goodbye.  Tolerate dead servers: stop() runs on
+        # teardown paths where a shard may already have been killed.
         for sid in range(self._num_servers):
             try:
-                s = self._sock(sid)
-                with self._lock:
-                    _send(s, CMD_STOP, str(self._rank))
-                    _recv(s)
-                s.close()
-            except OSError:
+                self._rpc_to(sid, CMD_STOP, str(self._rank),
+                             mutating=True)
+            except (MXNetError, OSError):
                 pass
-        self._socks.clear()
+            self._evict(sid)
+        with self._lock:
+            self._socks.clear()

@@ -421,8 +421,11 @@ class JitTrainStep:
                           for s in self._opt_state],
             "t": self._t,
         }
-        with open(fname, "wb") as f:
-            pickle.dump(payload, f)
+        from ..base import atomic_path
+
+        with atomic_path(fname) as tmp:
+            with open(tmp, "wb") as f:
+                pickle.dump(payload, f)
 
     def load_states(self, fname):
         """Restore a save_states checkpoint (same net/optimizer config).

@@ -490,8 +490,13 @@ class Symbol:
         }, indent=2)
 
     def save(self, fname):
-        with open(fname, "w") as f:
-            f.write(self.tojson())
+        from ..base import atomic_path
+
+        # atomic: never leave a half-written symbol.json next to a
+        # loadable .params file (docs/fault_tolerance.md)
+        with atomic_path(fname) as tmp:
+            with open(tmp, "w") as f:
+                f.write(self.tojson())
 
     # -- binding -----------------------------------------------------------
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,

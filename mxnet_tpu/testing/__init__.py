@@ -1,0 +1,19 @@
+"""Deterministic test harnesses for the framework itself.
+
+Import-light by design (stdlib only at import time): ``engine.py`` and
+``parallel/dist_kvstore.py`` import :mod:`mxnet_tpu.testing.faults` on
+their hot paths, so this package must never pull in jax/numpy.
+
+* ``faults`` — seeded, replayable fault injection for the distributed
+  tier and the engine (``FaultPlan``, ``MXNET_FAULT_PLAN``).  See
+  ``docs/fault_tolerance.md``.
+"""
+from __future__ import annotations
+
+from .faults import (FaultInjected, FaultPlan, current, install,
+                     maybe_inject, set_role, uninstall)
+
+__all__ = [
+    "FaultInjected", "FaultPlan", "current", "install", "maybe_inject",
+    "set_role", "uninstall",
+]

@@ -1,0 +1,250 @@
+"""Deterministic fault injection for the distributed tier and the engine.
+
+Every chaos test in ``tests/test_fault_tolerance.py`` is driven by a
+:class:`FaultPlan`: a list of rules saying *where* (injection site),
+*when* (match predicates + skip/repeat counters + seeded coin flips) and
+*what* (connection reset, truncated frame, delay, raised exception,
+server kill) to inject.  The plan is pure data — JSON-serializable, and
+loadable from the ``MXNET_FAULT_PLAN`` environment variable (inline JSON
+or a path to a JSON file), so a failing CI run reproduces locally from
+the plan + seed printed in the log.
+
+Injection sites (each a single ``maybe_inject(site, **ctx)`` call in
+framework code; zero cost when no plan is installed):
+
+=================  ==========================================================
+site               where / ctx
+=================  ==========================================================
+``send``           ``dist_kvstore._send`` entry; ctx: ``cmd`` (wire command
+                   int), ``sock``, plus the caller's role/rank
+``recv``           ``dist_kvstore._recv`` entry; ctx: ``sock``, role/rank
+``connect``        ``DistKVStore._sock`` before ``create_connection``;
+                   ctx: ``server`` (server id), role/rank
+``server_handle``  ``DistServer._handle`` after each decoded frame;
+                   ctx: ``cmd``, ``server`` (the DistServer), role
+``engine_push``    ``Engine.push`` before running the op; ctx: ``op``
+=================  ==========================================================
+
+Rule fields (all optional except ``site`` and ``action``):
+
+* ``match`` — dict of ctx-key → expected value; the rule only considers
+  calls whose ctx matches every entry (missing keys never match).
+* ``after`` — skip the first N matching calls (default 0).
+* ``times`` — fire at most N times (default 1; ``0``/``null`` = forever).
+* ``prob`` — fire with this probability.  The coin flip is derived from
+  ``(plan seed, rule index, match ordinal)``, NOT from a shared RNG
+  stream, so one rule's decisions are independent of how other rules'
+  calls interleave across threads — the same seed always produces the
+  same decision for the k-th matching call of a rule.
+* ``action`` — one of:
+
+  - ``"reset"``    raise ``ConnectionResetError`` (peer vanished)
+  - ``"refuse"``   raise ``ConnectionRefusedError`` (nobody listening)
+  - ``"truncate"`` write a partial frame header to ``ctx['sock']``, close
+    it, then raise ``ConnectionResetError`` — the peer sees a truncated
+    frame, the caller sees a dead socket
+  - ``"delay"``    ``time.sleep(rule['delay'])`` then continue normally
+  - ``"raise"``    raise :class:`FaultInjected` (``rule['message']``) —
+    simulates an op failure / a crashing participant
+  - ``"kill_server"`` call ``ctx['server'].shutdown()`` then raise
+    ``ConnectionResetError`` — the whole server process "dies" mid-round
+
+Every firing is appended to ``plan.events`` (site, action, rule index,
+ordinal, scalar ctx), so a test can assert the *exact* injection
+sequence — and that two runs from the same seed produce the same one.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+
+class FaultInjected(RuntimeError):
+    """Raised by ``action: "raise"`` rules (and used as the marker type
+    for injected op failures in ``Engine.push`` chaos tests)."""
+
+
+_tls = threading.local()
+
+
+def set_role(role, **extra):
+    """Tag the calling thread for rule matching (``role`` plus e.g.
+    ``rank``).  ``DistServer._handle`` threads tag themselves
+    ``server``; ``DistKVStore`` RPCs tag ``worker`` with their rank."""
+    ctx = {"role": role}
+    ctx.update(extra)
+    _tls.ctx = ctx
+
+
+def _thread_ctx():
+    return getattr(_tls, "ctx", None)
+
+
+class FaultPlan:
+    """A seeded, replayable chaos schedule (see module docstring)."""
+
+    def __init__(self, seed=0, rules=()):
+        self.seed = int(seed)
+        self.rules = [dict(r) for r in rules]
+        for i, r in enumerate(self.rules):
+            if "site" not in r or "action" not in r:
+                raise ValueError(
+                    "fault rule %d needs 'site' and 'action': %r" % (i, r))
+        self.events = []
+        self._matched = [0] * len(self.rules)  # matching calls seen
+        self._fired = [0] * len(self.rules)    # injections performed
+        self._lock = threading.Lock()
+
+    # -- (de)serialization --------------------------------------------------
+    def to_json(self):
+        return json.dumps({"seed": self.seed, "rules": self.rules})
+
+    @classmethod
+    def from_json(cls, text):
+        cfg = json.loads(text)
+        if isinstance(cfg, list):  # bare rule list: seed 0
+            cfg = {"rules": cfg}
+        return cls(seed=cfg.get("seed", 0), rules=cfg.get("rules", ()))
+
+    # -- deterministic per-rule coin ---------------------------------------
+    def _coin(self, rule_idx, ordinal, prob):
+        # splitmix64-ish scramble of (seed, rule, ordinal): stable across
+        # processes and independent of cross-thread interleaving
+        x = (self.seed * 0x9E3779B97F4A7C15
+             + rule_idx * 0xBF58476D1CE4E5B9 + ordinal) & 0xFFFFFFFFFFFFFFFF
+        x ^= x >> 30
+        x = (x * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+        x ^= x >> 27
+        x = (x * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+        x ^= x >> 31
+        return (x / 2.0 ** 64) < prob
+
+    # -- firing -------------------------------------------------------------
+    def fire(self, site, ctx):
+        """Evaluate every rule against one hook call; perform at most one
+        action (the first rule that decides to fire wins)."""
+        action = None
+        with self._lock:
+            for i, rule in enumerate(self.rules):
+                if rule["site"] != site:
+                    continue
+                match = rule.get("match")
+                if match and any(ctx.get(k) != v for k, v in match.items()):
+                    continue
+                self._matched[i] += 1
+                ordinal = self._matched[i]
+                if ordinal <= int(rule.get("after", 0)):
+                    continue
+                times = rule.get("times", 1)
+                if times and self._fired[i] >= int(times):
+                    continue
+                prob = rule.get("prob")
+                if prob is not None and not self._coin(i, ordinal,
+                                                       float(prob)):
+                    continue
+                self._fired[i] += 1
+                action = rule
+                self.events.append({
+                    "site": site, "action": rule["action"], "rule": i,
+                    "n": self._fired[i],
+                    "ctx": {k: v for k, v in ctx.items()
+                            if isinstance(v, (int, float, str, bool))},
+                })
+                break
+        if action is not None:
+            self._perform(action, ctx)
+
+    @staticmethod
+    def _perform(rule, ctx):
+        act = rule["action"]
+        if act == "delay":
+            time.sleep(float(rule.get("delay", 0.1)))
+            return
+        if act == "reset":
+            raise ConnectionResetError(
+                "fault-injected connection reset (%s)" % rule.get("site"))
+        if act == "refuse":
+            raise ConnectionRefusedError("fault-injected connection refusal")
+        if act == "raise":
+            raise FaultInjected(rule.get("message", "fault-injected failure"))
+        if act == "truncate":
+            sock = ctx.get("sock")
+            if sock is not None:
+                try:
+                    sock.sendall(b"MX")  # half a magic: peer sees EOF mid-frame
+                except OSError:
+                    pass
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            raise ConnectionResetError("fault-injected truncated frame")
+        if act == "kill_server":
+            server = ctx.get("server")
+            if server is not None:
+                server.shutdown()
+            raise ConnectionResetError("fault-injected server kill")
+        raise ValueError("unknown fault action %r" % (act,))
+
+
+# ---------------------------------------------------------------------------
+# global plan registry (explicit install() for tests, env for processes)
+# ---------------------------------------------------------------------------
+
+_PLAN = None
+_ENV_CACHE = (None, None)  # (raw env string, parsed plan)
+_ENV_LOCK = threading.Lock()
+
+
+def install(plan):
+    """Make ``plan`` the process-wide active plan; returns it."""
+    global _PLAN
+    _PLAN = plan
+    return plan
+
+
+def uninstall():
+    """Deactivate any installed plan (env plans reload on next use)."""
+    global _PLAN, _ENV_CACHE
+    _PLAN = None
+    _ENV_CACHE = (None, None)
+
+
+def current():
+    """The active plan: the installed one, else ``MXNET_FAULT_PLAN``
+    (inline JSON, or a path to a JSON file), else ``None``."""
+    if _PLAN is not None:
+        return _PLAN
+    raw = os.environ.get("MXNET_FAULT_PLAN")
+    if not raw:
+        return None
+    global _ENV_CACHE
+    with _ENV_LOCK:
+        cached_raw, cached_plan = _ENV_CACHE
+        if raw == cached_raw:
+            return cached_plan
+        text = raw
+        if not raw.lstrip().startswith(("{", "[")):
+            with open(raw, encoding="utf-8") as f:
+                text = f.read()
+        plan = FaultPlan.from_json(text)
+        _ENV_CACHE = (raw, plan)
+        return plan
+
+
+def maybe_inject(site, **ctx):
+    """Hook point: no-op unless a plan is active (one dict lookup)."""
+    plan = _PLAN
+    if plan is None and not os.environ.get("MXNET_FAULT_PLAN"):
+        return
+    plan = current()
+    if plan is None:
+        return
+    tctx = _thread_ctx()
+    if tctx:
+        merged = dict(tctx)
+        merged.update(ctx)
+        ctx = merged
+    plan.fire(site, ctx)

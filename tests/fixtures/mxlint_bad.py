@@ -65,3 +65,23 @@ def train_loop_print(nd, n):
         print(acc)  # expect: HS203
         acc = acc + 1
     return acc
+
+
+def bad_wait_loop(cv, ready):
+    while not ready():
+        cv.wait(timeout=60)  # expect: RB701
+
+
+def good_wait_loop(cv, ready, monotonic, deadline):
+    while not ready():
+        remaining = deadline - monotonic()
+        if remaining <= 0:
+            raise TimeoutError("peer missing")
+        cv.wait(timeout=min(remaining, 60.0))
+
+
+def good_wait_consumed(cv, ready):
+    # result consumed: not an ignored wait, never flagged
+    while not ready():
+        if not cv.wait(timeout=60):
+            raise TimeoutError("peer missing")

@@ -1,0 +1,377 @@
+"""Chaos matrix for the fault-tolerance tier (docs/fault_tolerance.md).
+
+Every scenario is driven by a seeded :class:`FaultPlan` — the seed comes
+from ``MXNET_CHAOS_SEED`` (CI pins and echoes it, so a red run replays
+locally from the log line).  CPU-only, in-process cluster (threads), no
+sleeps beyond the injected ones.
+
+Covered: seeded server-kill-mid-round (MXNetError naming the missing
+ranks, within the deadline), socket-reset-mid-push with sequence-number
+dedup (applied exactly once), frame truncation, delayed connect via the
+``MXNET_FAULT_PLAN`` env path, engine async-exception rethrow under an
+injected op failure, and interrupted-checkpoint-write / estimator-resume
+round trips.
+"""
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.base import MXNetError, atomic_path
+from mxnet_tpu.engine import Engine, Var
+from mxnet_tpu.gluon.contrib.estimator import (
+    Estimator, CheckpointHandler)
+from mxnet_tpu.parallel.dist_kvstore import (
+    CMD_PULL, CMD_PUSH, DistKVStore, DistServer, _server_port)
+from mxnet_tpu.testing import faults
+from mxnet_tpu.testing.faults import FaultInjected, FaultPlan
+
+SEED = int(os.environ.get("MXNET_CHAOS_SEED", "1337"))
+
+_PORT_SEQ = [23310]
+
+
+def _probe_free(root_port, num_servers):
+    import socket as _socket
+
+    for sid in range(num_servers):
+        s = _socket.socket()
+        try:
+            s.bind(("", _server_port(root_port, sid)))
+        except OSError:
+            return False
+        finally:
+            s.close()
+    return True
+
+
+def _start_cluster(num_workers, sync=True, num_servers=1):
+    import random
+
+    for _ in range(50):
+        _PORT_SEQ[0] += 10
+        root_port = _PORT_SEQ[0]
+        if _probe_free(root_port, num_servers):
+            break
+        _PORT_SEQ[0] += random.randint(10, 200)
+    else:
+        raise RuntimeError("no free port range found")
+    servers = []
+    for sid in range(num_servers):
+        srv = DistServer(_server_port(root_port, sid), num_workers,
+                         sync=sync)
+        t = threading.Thread(target=srv.run, daemon=True)
+        t.start()
+        servers.append(srv)
+    time.sleep(0.2)
+
+    def make_worker(rank):
+        os.environ["DMLC_PS_ROOT_PORT"] = str(root_port)
+        os.environ["DMLC_NUM_WORKER"] = str(num_workers)
+        os.environ["DMLC_NUM_SERVER"] = str(num_servers)
+        kv = DistKVStore("dist_sync" if sync else "dist_async")
+        kv._rank = rank
+        return kv
+
+    return servers, make_worker
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    dmlc = {k: os.environ.get(k) for k in
+            ("DMLC_PS_ROOT_PORT", "DMLC_NUM_WORKER", "DMLC_NUM_SERVER")}
+    yield
+    faults.uninstall()
+    for k, v in dmlc.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+@pytest.fixture()
+def _fast_retries(monkeypatch):
+    monkeypatch.setenv("MXNET_KVSTORE_RETRIES", "2")
+    monkeypatch.setenv("MXNET_KVSTORE_BACKOFF", "0.02")
+    monkeypatch.setenv("MXNET_KVSTORE_CONNECT_TIMEOUT", "1")
+
+
+# ---------------------------------------------------------------------------
+# the plan itself: seeded, replayable, env-loadable
+# ---------------------------------------------------------------------------
+def test_same_seed_same_injection_sequence():
+    rules = [{"site": "send", "action": "delay", "delay": 0.0,
+              "prob": 0.5, "times": 0}]
+
+    def drive(plan):
+        for i in range(64):
+            faults.install(plan)
+            faults.maybe_inject("send", cmd=i)
+            faults.uninstall()
+        return [(e["rule"], e["n"], e["ctx"]["cmd"]) for e in plan.events]
+
+    a = drive(FaultPlan(seed=SEED, rules=rules))
+    b = drive(FaultPlan(seed=SEED, rules=rules))
+    assert a == b and 0 < len(a) < 64  # replayable, and prob<1 really skips
+    c = drive(FaultPlan(seed=SEED + 1, rules=rules))
+    assert a != c  # a different seed is a different schedule
+
+
+def test_plan_roundtrips_through_json_and_env(tmp_path, monkeypatch):
+    plan = FaultPlan(seed=SEED, rules=[
+        {"site": "recv", "action": "reset", "after": 3}])
+    clone = FaultPlan.from_json(plan.to_json())
+    assert clone.seed == SEED and clone.rules == plan.rules
+
+    p = tmp_path / "plan.json"
+    p.write_text(plan.to_json())
+    monkeypatch.setenv("MXNET_FAULT_PLAN", str(p))
+    env_plan = faults.current()
+    assert env_plan.seed == SEED and env_plan.rules == plan.rules
+    # inline JSON works too
+    monkeypatch.setenv("MXNET_FAULT_PLAN", plan.to_json())
+    assert faults.current().rules == plan.rules
+
+
+# ---------------------------------------------------------------------------
+# failure detection: a worker dying mid-sync-round must end the round
+# with an error NAMING it, within the deadline — never a hang
+# ---------------------------------------------------------------------------
+def test_dead_worker_mid_round_names_missing_rank(monkeypatch,
+                                                  _fast_retries):
+    monkeypatch.setenv("MXNET_KVSTORE_BARRIER_TIMEOUT", "3")
+    plan = faults.install(FaultPlan(seed=SEED, rules=[
+        {"site": "send", "action": "raise", "times": 1,
+         "match": {"role": "worker", "rank": 1, "cmd": CMD_PUSH},
+         "message": "rank 1 preempted mid-round"}]))
+    servers, make_worker = _start_cluster(2, sync=True)
+    kvs = [make_worker(r) for r in range(2)]
+    errors = [None, None]
+
+    def worker(rank):
+        kv = kvs[rank]
+        try:
+            kv.init("w", nd.zeros((2, 2)))
+            kv.push("w", nd.array(np.ones((2, 2), np.float32)))
+        except (MXNetError, FaultInjected) as e:
+            errors[rank] = e
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=worker, args=(r,)) for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 25, "round did not end within the deadline"
+    assert isinstance(errors[1], FaultInjected)  # the injected death
+    # the SURVIVOR got a server-side error naming the dead rank
+    assert isinstance(errors[0], MXNetError), errors[0]
+    assert "rank(s) [1]" in str(errors[0]) and \
+        "MXNET_KVSTORE_BARRIER_TIMEOUT" in str(errors[0])
+    # the injection sequence is exactly the planned one
+    assert [(e["site"], e["action"]) for e in plan.events] == \
+        [("send", "raise")]
+    servers[0].shutdown()
+
+
+def test_server_killed_mid_round_fails_fast(monkeypatch, _fast_retries):
+    plan = faults.install(FaultPlan(seed=SEED, rules=[
+        {"site": "server_handle", "action": "kill_server", "times": 1,
+         "match": {"cmd": CMD_PUSH}}]))
+    servers, make_worker = _start_cluster(1, sync=True)
+    kv = make_worker(0)
+    kv.init("w", nd.zeros((2,)))
+    t0 = time.monotonic()
+    with pytest.raises(MXNetError, match="attempt"):
+        kv.push("w", nd.array(np.ones((2,), np.float32)))
+    assert time.monotonic() - t0 < 20, "worker hung on a dead server"
+    assert servers[0]._stop.is_set()
+    assert [e["action"] for e in plan.events] == ["kill_server"]
+
+
+# ---------------------------------------------------------------------------
+# idempotent retry: reset mid-push → replay → server dedups on seq
+# ---------------------------------------------------------------------------
+def test_push_reset_retries_and_applies_exactly_once(_fast_retries):
+    # reset the worker's socket on the recv of the PUSH reply: the server
+    # has already applied the push, so the replay MUST be answered from
+    # the seq cache — a double apply would move the weight twice.
+    # worker recv ordinals (no secret → no handshake frames):
+    # 1 init-reply, 2 barrier, 3 set_optimizer, 4 barrier, 5 push-reply
+    faults.install(FaultPlan(seed=SEED, rules=[
+        {"site": "recv", "action": "reset", "after": 4, "times": 1,
+         "match": {"role": "worker"}}]))
+    servers, make_worker = _start_cluster(1, sync=True)
+    kv = make_worker(0)
+    kv.init("w", nd.zeros((4,)))
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.5))
+    kv.push("w", nd.array(np.ones((4,), np.float32)))
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    # sgd: w -= 0.5 * grad, applied ONCE → -0.5 (twice would be -1.0)
+    np.testing.assert_allclose(out.asnumpy(), np.full((4,), -0.5), 1e-6)
+    assert servers[0]._replays == 1, \
+        "replayed push was not served from the dedup cache"
+    kv.stop()
+
+
+def test_truncated_frame_on_pull_retries(_fast_retries):
+    plan = faults.install(FaultPlan(seed=SEED, rules=[
+        {"site": "send", "action": "truncate", "times": 1,
+         "match": {"role": "worker", "cmd": CMD_PULL}}]))
+    servers, make_worker = _start_cluster(1, sync=True)
+    kv = make_worker(0)
+    kv.init("w", nd.array(np.arange(6, dtype=np.float32)))
+    out = nd.zeros((6,))
+    kv.pull("w", out=out)  # truncated once, then retried clean
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.arange(6, dtype=np.float32))
+    assert [e["action"] for e in plan.events] == ["truncate"]
+    kv.stop()
+
+
+def test_delayed_connect_via_env_plan(monkeypatch, _fast_retries):
+    plan_json = json.dumps({"seed": SEED, "rules": [
+        {"site": "connect", "action": "delay", "delay": 0.4, "times": 1,
+         "match": {"role": "worker"}}]})
+    servers, make_worker = _start_cluster(1, sync=True)
+    monkeypatch.setenv("MXNET_FAULT_PLAN", plan_json)
+    kv = make_worker(0)
+    t0 = time.monotonic()
+    kv.init("w", nd.zeros((2,)))
+    assert time.monotonic() - t0 >= 0.4  # the delay really ran
+    assert [e["action"] for e in faults.current().events] == ["delay"]
+    monkeypatch.delenv("MXNET_FAULT_PLAN")
+    faults.uninstall()
+    kv.stop()
+
+
+# ---------------------------------------------------------------------------
+# engine: injected op failure takes the async-exception path
+# ---------------------------------------------------------------------------
+def test_engine_injected_failure_poisons_and_rethrows():
+    faults.install(FaultPlan(seed=SEED, rules=[
+        {"site": "engine_push", "action": "raise",
+         "match": {"op": "chaos_matmul"},
+         "message": "injected op failure"}]))
+    eng = Engine.get()
+    v = Var()
+    with pytest.raises(FaultInjected, match="injected op failure"):
+        eng.push(lambda: 42, write_vars=(v,), op_name="chaos_matmul")
+    # stored on the write var: the next reader rethrows (Var.rethrow)
+    with pytest.raises(FaultInjected):
+        eng.push(lambda: 1, read_vars=(v,), op_name="reader")
+    # unmatched ops are untouched
+    eng.push(lambda: 1, write_vars=(Var(),), op_name="other_op")
+
+
+# ---------------------------------------------------------------------------
+# preemption-safe checkpoints
+# ---------------------------------------------------------------------------
+def test_atomic_path_failure_preserves_previous(tmp_path):
+    target = tmp_path / "ckpt.bin"
+    target.write_bytes(b"good checkpoint")
+    with pytest.raises(RuntimeError):
+        with atomic_path(str(target)) as tmp:
+            with open(tmp, "wb") as f:
+                f.write(b"half a check")  # interrupted mid-stream
+            raise RuntimeError("preempted")
+    assert target.read_bytes() == b"good checkpoint"
+    assert [p for p in os.listdir(tmp_path) if "tmp" in p] == []
+    with atomic_path(str(target)) as tmp:
+        with open(tmp, "wb") as f:
+            f.write(b"new checkpoint")
+    assert target.read_bytes() == b"new checkpoint"
+
+
+def test_interrupted_nd_save_keeps_previous_loadable(tmp_path,
+                                                     monkeypatch):
+    fname = str(tmp_path / "w.params")
+    nd.save(fname, {"w": nd.array(np.ones((3,), np.float32))})
+
+    from mxnet_tpu.ndarray import legacy_io
+    real = legacy_io.save_params
+
+    def dying_save(path, arrays, names):
+        with open(path, "wb") as f:
+            f.write(b"\x12\x34")  # partial garbage, then the plug is pulled
+        raise KeyboardInterrupt
+
+    monkeypatch.setattr(legacy_io, "save_params", dying_save)
+    with pytest.raises(KeyboardInterrupt):
+        nd.save(fname, {"w": nd.array(np.zeros((3,), np.float32))})
+    monkeypatch.setattr(legacy_io, "save_params", real)
+    loaded = nd.load(fname)
+    np.testing.assert_allclose(loaded["w"].asnumpy(), np.ones((3,)))
+    assert [p for p in os.listdir(tmp_path) if "tmp" in p] == []
+
+
+def _toy_data(n=32, d=8, classes=3, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, d).astype(np.float32)
+    w = rs.randn(d, classes).astype(np.float32)
+    y = (x @ w).argmax(1).astype(np.float32)
+    return [(nd.array(x[i:i + 16]), nd.array(y[i:i + 16]))
+            for i in range(0, n, 16)]
+
+
+def test_estimator_resumes_from_latest_checkpoint(tmp_path):
+    data = _toy_data()
+    net = gluon.nn.Dense(3)
+    net.initialize(mx.init.Xavier())
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss())
+    est.fit(data, epochs=2, event_handlers=[CheckpointHandler(
+        str(tmp_path), model_prefix="toy", epoch_period=1)])
+    assert os.path.exists(tmp_path / "toy-epoch2.params")
+
+    net2 = gluon.nn.Dense(3)
+    net2.initialize(mx.init.Xavier())
+    est2 = Estimator(net2, gluon.loss.SoftmaxCrossEntropyLoss())
+    ckpt2 = CheckpointHandler(str(tmp_path), model_prefix="toy",
+                              epoch_period=1, resume_from_checkpoint=True)
+    est2.fit(data, epochs=4, event_handlers=[ckpt2])
+    # resumed at 2, trained exactly the 2 REMAINING epochs
+    assert est2.resumed_from_epoch == 2
+    assert ckpt2.current_epoch == 4
+    assert os.path.exists(tmp_path / "toy-epoch4.params")
+    # restored weights really came from the epoch-2 file: a fresh fit
+    # with the budget already met trains zero epochs
+    net3 = gluon.nn.Dense(3)
+    net3.initialize(mx.init.Xavier())
+    est3 = Estimator(net3, gluon.loss.SoftmaxCrossEntropyLoss())
+    ckpt3 = CheckpointHandler(str(tmp_path), model_prefix="toy",
+                              epoch_period=1, resume_from_checkpoint=True)
+    est3.fit(data, epochs=4, event_handlers=[ckpt3])
+    assert est3.resumed_from_epoch == 4 and ckpt3.current_epoch == 4
+    loaded = gluon.nn.Dense(3)
+    loaded.load_parameters(str(tmp_path / "toy-epoch4.params"))
+    np.testing.assert_allclose(
+        loaded.weight.data().asnumpy(), net3.weight.data().asnumpy())
+
+
+def test_sigterm_checkpoints_before_exit(tmp_path):
+    # in_units pinned: params must be materialized without a forward
+    # pass, since SIGTERM can arrive before the first batch
+    net = gluon.nn.Dense(3, in_units=8)
+    net.initialize(mx.init.Xavier())
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss())
+    ckpt = CheckpointHandler(str(tmp_path), model_prefix="toy")
+    ckpt.train_begin(est)  # installs the SIGTERM hook (main thread)
+    try:
+        handler = signal.getsignal(signal.SIGTERM)
+        assert callable(handler)
+        with pytest.raises(SystemExit):
+            handler(signal.SIGTERM, None)
+        assert os.path.exists(tmp_path / "toy-sigterm.params")
+        # the hook restored the previous disposition before exiting
+        assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
+    finally:
+        ckpt._restore_sigterm()
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)

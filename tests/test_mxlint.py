@@ -42,9 +42,36 @@ def test_fixture_findings_match_markers_exactly():
 
 
 @pytest.mark.parametrize("rule", ["TS101", "TS102", "TS103", "TS104",
-                                  "TS105", "HS201", "HS202", "HS203"])
+                                  "TS105", "HS201", "HS202", "HS203",
+                                  "RB701"])
 def test_fixture_covers_rule(rule):
     assert rule in {r for _, r in _expected_markers()}
+
+
+# ---------------------------------------------------------------------------
+# RB701: ignored Condition.wait(timeout=...) in an unbounded re-check loop
+# ---------------------------------------------------------------------------
+def test_rb701_flags_ignored_timed_wait():
+    src = ("def f(cv, ready):\n"
+           "    while not ready():\n"
+           "        cv.wait(timeout=60)\n")
+    assert [f.rule for f in lint_source(src)] == ["RB701"]
+
+
+def test_rb701_quiet_with_deadline_or_consumed_result():
+    deadline = ("def f(cv, ready, deadline):\n"
+                "    import time\n"
+                "    while not ready():\n"
+                "        remaining = deadline - time.monotonic()\n"
+                "        if remaining <= 0:\n"
+                "            raise TimeoutError()\n"
+                "        cv.wait(timeout=min(remaining, 60.0))\n")
+    consumed = ("def f(cv, ready):\n"
+                "    while not ready():\n"
+                "        if not cv.wait(timeout=60):\n"
+                "            raise TimeoutError()\n")
+    assert lint_source(deadline) == []
+    assert lint_source(consumed) == []
 
 
 def test_inline_disable_suppresses():
