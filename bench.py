@@ -441,6 +441,50 @@ def _run(platform):
     return img_s
 
 
+def _dispatch_rate(bulk_size):
+    """Imperative ops/sec through a 20-op elementwise chain.
+
+    The op-bulking microbenchmark (docs/perf.md): the same python loop is
+    timed with bulking off (``bulk_size=0`` — one jitted dispatch per op,
+    the pre-BulkEngine hot path) and on (``bulk_size=20`` — the chain
+    defers into one segment and flushes as ONE fused executable).  Host
+    dispatch dominates, so the number is CPU-stable and platform jitter
+    barely moves it.
+    """
+    from mxnet_tpu import engine as _engine
+    from mxnet_tpu import nd
+
+    chain_len, n_iters = 20, 30
+    x = nd.ones((64, 64))
+
+    def run_iter():
+        with _engine.bulk(bulk_size):
+            a = x
+            for i in range(chain_len):
+                a = (a + 1.0) if i % 2 else (a * 1.0009765625)
+        a.wait_to_read()
+
+    for _ in range(3):  # warmup: compile both the per-op and segment paths
+        run_iter()
+
+    def window():
+        t0 = time.perf_counter()
+        for _ in range(n_iters):
+            run_iter()
+        return chain_len * n_iters / (time.perf_counter() - t0)
+
+    return _median_windows(
+        window, label="dispatch_%s" % ("bulked" if bulk_size else "eager"))
+
+
+def _run_dispatch_eager(platform):
+    return _dispatch_rate(0)
+
+
+def _run_dispatch_bulked(platform):
+    return _dispatch_rate(20)
+
+
 _SPECS = {
     # name -> (runner, metric, unit, baseline or None)
     "train": (_run, "resnet50_train_throughput", "images/sec",
@@ -450,6 +494,10 @@ _SPECS = {
     "bert": (_run_bert, "bert_base_train_throughput", "samples/sec", None),
     "llama": (_run_llama, "llama_decoder_train_throughput", "tokens/sec",
               None),
+    "dispatch_eager": (_run_dispatch_eager, "imperative_dispatch_eager",
+                       "ops/sec", None),
+    "dispatch_bulked": (_run_dispatch_bulked, "imperative_dispatch_bulked",
+                        "ops/sec", None),
 }
 
 
@@ -506,7 +554,8 @@ def main():
     budget = float(os.environ.get("MXNET_BENCH_BUDGET", "2700"))
     head = _measure("train", platform, fallback)
     metrics = [head]
-    for name in ("infer", "bert", "llama"):
+    for name in ("infer", "bert", "llama", "dispatch_eager",
+                 "dispatch_bulked"):
         elapsed = time.perf_counter() - t_start
         if elapsed > budget:
             _log("budget %.0fs spent (%.0fs elapsed); skipping %s"

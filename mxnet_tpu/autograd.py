@@ -39,9 +39,23 @@ def is_training():
     return _st().training
 
 
+def _flush_bulk(origin):
+    # a recording boundary is a bulk-segment boundary: taped ops need
+    # per-op vjps, and pre-boundary lazy values must land before the tape
+    # starts (docs/perf.md "Op bulking").  Engine._instance (not .get())
+    # so merely toggling recording never constructs an engine.
+    from .engine import Engine
+
+    eng = Engine._instance
+    if eng is not None:
+        eng.flush_bulk(origin)
+
+
 def set_recording(is_record):
     st = _st()
     prev, st.recording = st.recording, bool(is_record)
+    if prev != st.recording:
+        _flush_bulk("autograd_boundary")
     return prev
 
 
@@ -64,11 +78,16 @@ class _RecordingStateScope:
             st.recording = self._rec
         if self._train is not None:
             st.training = self._train
+        if st.recording != self._prev[0]:
+            _flush_bulk("autograd_boundary")
         return self
 
     def __exit__(self, *args):
         st = _st()
+        changed = st.recording != self._prev[0]
         st.recording, st.training = self._prev
+        if changed:
+            _flush_bulk("autograd_boundary")
 
 
 def record(train_mode=True):

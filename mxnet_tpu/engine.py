@@ -19,12 +19,20 @@ What survives from the reference engine, and what this module provides:
   ``MXNET_ENGINE_TYPE`` exactly like the reference (``src/engine/engine.cc:32``).
 * ``wait_for_var`` / ``wait_for_all`` — blocking sync, incl. async exception
   rethrow (parity: ``src/engine/threaded_engine.cc:383-436``).
+* op bulking (``BulkEngine`` / ``bulk(size)``) — the reference's imperative
+  segment fusion (``MXNET_EXEC_BULK_EXEC_*``, imperative_utils.h
+  ``CreateEngineOpSeg``): consecutive deferrable ops collect into a
+  ``BulkSegment`` and flush as ONE jitted, XLA-fused executable at the
+  first sync point, so N python/PJRT dispatches collapse into ~1.
 """
 from __future__ import annotations
 
+import collections
 import os
 import threading
 import time
+
+import jax
 
 from .testing.faults import maybe_inject as _inject
 
@@ -57,11 +65,165 @@ class Var:
 
 
 class _Stats:
-    __slots__ = ("ops_pushed", "bulk_ops")
+    __slots__ = ("ops_pushed", "bulk_ops", "bulk_segments")
 
     def __init__(self):
         self.ops_pushed = 0
-        self.bulk_ops = 0
+        self.bulk_ops = 0       # ops that executed inside a bulk segment
+        self.bulk_segments = 0  # segments flushed (each = one push)
+
+
+# ----------------------------------------------------------------------------
+# op bulking (reference: MXNET_EXEC_BULK_EXEC_* segments,
+# src/imperative/imperative_utils.h CreateEngineOpSeg)
+# ----------------------------------------------------------------------------
+
+# jitted segment executables keyed by the op-sequence structure
+# (op name, static attrs, argument wiring) — the engine-level analogue of
+# CachedOp's executable cache for code that never calls hybridize().
+# jax.jit adds the per-(shape, dtype) level underneath, so re-running the
+# same imperative stream with the same avals re-traces nothing.
+_SEGMENT_CACHE = collections.OrderedDict()
+_SEGMENT_CACHE_CAP = 256
+_trace_count = [0]
+
+
+def bulk_trace_count():
+    """How many times a bulk segment has been (re)traced by XLA — the
+    probe tests use to assert segment-cache hits (no retrace)."""
+    return _trace_count[0]
+
+
+def _build_segment_fn(steps):
+    """One traceable callable running every deferred step in push order.
+
+    ``steps`` is a sequence of ``(run_fn, slots, n_out)``; each slot is
+    ``('v', i)`` — the i-th value produced inside the segment — or
+    ``('x', i)`` — the i-th external (concrete) input.  All produced
+    values are returned so the signature depends only on the op sequence,
+    never on which outputs happen to still be referenced at flush time
+    (liveness-dependent signatures would make cache hits GC-timing flaky).
+    """
+    steps = tuple(steps)
+
+    def seg_run(*ext):
+        _trace_count[0] += 1  # python body → runs only while tracing
+        vals = []
+        for run_fn, slots, _n_out in steps:
+            args = [vals[i] if kind == "v" else ext[i] for kind, i in slots]
+            vals.extend(run_fn(*args))
+        return tuple(vals)
+
+    return jax.jit(seg_run)
+
+
+class _BulkRef:
+    """A promised output of a not-yet-flushed segment (lazy NDArray chunk)."""
+
+    __slots__ = ("segment", "index", "aval", "value", "failed")
+
+    def __init__(self, segment, index, aval):
+        self.segment = segment
+        self.index = index
+        self.aval = aval      # jax.ShapeDtypeStruct from eval_shape
+        self.value = None     # concrete jax.Array after flush
+        self.failed = False   # the flush raised; value will never arrive
+
+
+class BulkSegment:
+    """A deferred run of consecutive imperative ops, flushed as ONE push.
+
+    Built by ``ops.registry`` (which owns op semantics: attrs, fields,
+    eval_shape) and executed here (which owns scheduling: the single
+    ``Engine.push``, var poisoning, stats, inflight tracking).
+    """
+
+    __slots__ = ("engine", "cap", "steps", "key_parts", "ext", "_ext_ids",
+                 "refs", "write_vars", "flushed", "n_ops")
+
+    def __init__(self, engine, cap):
+        self.engine = engine
+        self.cap = cap            # flush after this many ops (0 = unbounded)
+        self.steps = []           # (run_fn, slots, n_out)
+        self.key_parts = []       # hashable mirror of steps → cache key
+        self.ext = []             # external concrete inputs, dedup by id
+        self._ext_ids = {}
+        self.refs = []            # _BulkRef per produced value, in order
+        self.write_vars = []      # Vars of every NDArray built on a ref
+        self.flushed = False
+        self.n_ops = 0
+
+    def defer(self, step_key, run_fn, handles, out_avals):
+        """Append one op; ``handles`` are ``('v', _BulkRef)`` for values
+        produced earlier in this segment or ``('x', jax.Array)`` for
+        concrete inputs.  Returns one ``_BulkRef`` per output."""
+        slots = []
+        for kind, v in handles:
+            if kind == "v":
+                slots.append(("v", v.index))
+            else:
+                i = self._ext_ids.get(id(v))
+                if i is None:
+                    i = len(self.ext)
+                    self.ext.append(v)
+                    self._ext_ids[id(v)] = i
+                slots.append(("x", i))
+        slots = tuple(slots)
+        base = len(self.refs)
+        refs = [_BulkRef(self, base + j, aval)
+                for j, aval in enumerate(out_avals)]
+        self.refs.extend(refs)
+        self.steps.append((run_fn, slots, len(out_avals)))
+        self.key_parts.append((step_key, slots, len(out_avals)))
+        self.n_ops += 1
+        return refs
+
+    def add_write_vars(self, new_vars):
+        self.write_vars.extend(new_vars)
+
+    def flush(self, origin="flush"):
+        """Execute the whole segment as one engine push. Idempotent.
+
+        On failure every unresolved ref is marked dead and every output
+        var poisoned via ``Var.set_exception`` — the same async-rethrow
+        contract the eager path gives a single failing op.
+        """
+        if self.flushed:
+            return
+        self.flushed = True
+        eng = self.engine
+        st = eng._bulk_state()
+        if st.seg is self:
+            st.seg = None
+        if not self.steps:
+            return
+        key = tuple(self.key_parts)
+        fn = _SEGMENT_CACHE.get(key)
+        if fn is None:
+            fn = _build_segment_fn(self.steps)
+            _SEGMENT_CACHE[key] = fn
+            while len(_SEGMENT_CACHE) > _SEGMENT_CACHE_CAP:
+                _SEGMENT_CACHE.popitem(last=False)
+        else:
+            _SEGMENT_CACHE.move_to_end(key)
+        ext = self.ext
+        try:
+            # one push for the whole op stream; write-var versions were
+            # already bumped at defer time (exactly as eager would have),
+            # so the push declares none — it only publishes values.
+            vals = eng.push(lambda: fn(*ext),
+                            op_name="bulk_segment[%d]" % self.n_ops)
+        except Exception as e:
+            for r in self.refs:
+                if r.value is None:
+                    r.failed = True
+            for v in self.write_vars:
+                v.set_exception(e)
+            raise
+        eng.stats.bulk_segments += 1
+        for r, val in zip(self.refs, vals):
+            r.value = val
+            eng.track(val)
 
 
 class Engine:
@@ -80,8 +242,17 @@ class Engine:
         self._hooks = []  # profiler hooks: fn(op_name, t_start, t_end)
         self._sync_hooks = []  # sync hooks: fn(origin) per device->host sync
         self.kind = os.environ.get("MXNET_ENGINE_TYPE", "NaiveEngine")
-        self._inflight = []  # recent output buffers (bounded ring)
+        self._inflight = collections.deque()  # recent output buffers (ring)
         self._inflight_cap = int(os.environ.get("MXNET_ENGINE_INFLIGHT_CAP", "512"))
+        # op bulking knobs (reference: MXNET_EXEC_BULK_EXEC_*,
+        # docs/env_vars.md) — segments are per-thread
+        self._bulk_tls = threading.local()
+        self._bulk_train = os.environ.get(
+            "MXNET_EXEC_BULK_EXEC_TRAIN", "1") not in ("", "0")
+        self._bulk_infer = os.environ.get(
+            "MXNET_EXEC_BULK_EXEC_INFERENCE", "1") not in ("", "0")
+        self._bulk_max = int(os.environ.get(
+            "MXNET_EXEC_BULK_EXEC_MAX_NODE", "15"))
         self._audit = None  # EA4xx dependency auditor (docs/static_analysis.md)
         if os.environ.get("MXNET_ENGINE_AUDIT", "0") not in ("", "0"):
             from .analysis.engine_audit import EngineAudit
@@ -129,27 +300,90 @@ class Engine:
         """Remember a dispatched buffer so wait_for_all() can sync on it."""
         self._inflight.append(data)
         if len(self._inflight) > self._inflight_cap:
-            # ring full: SYNC the oldest half before dropping it, so
+            # ring full: retire the oldest half before dropping it, so
             # waitall() semantics stay exact (Engine::WaitForAll blocks on
             # every outstanding op; silently forgetting buffers could let
-            # waitall() return with work — and async errors — in flight)
-            old, self._inflight = (
-                self._inflight[: self._inflight_cap // 2],
-                self._inflight[self._inflight_cap // 2:],
-            )
-            for d in old:
+            # waitall() return with work — and async errors — in flight).
+            # Only buffers still in flight cost a block; anything PJRT has
+            # already finished (is_ready) is dropped without stalling.
+            for _ in range(self._inflight_cap // 2):
+                d = self._inflight.popleft()
                 try:
-                    d.block_until_ready()  # mxlint: allow-host-sync
+                    ready = d.is_ready()
                 except AttributeError:
-                    pass
+                    ready = False  # unknown state: assume still in flight
+                if not ready:
+                    try:
+                        d.block_until_ready()  # mxlint: allow-host-sync
+                    except AttributeError:
+                        pass
+
+    # -- bulking ----------------------------------------------------------
+    def _bulk_state(self):
+        tls = self._bulk_tls
+        if not hasattr(tls, "seg"):
+            tls.seg = None     # this thread's open BulkSegment
+            tls.scopes = []    # explicit bulk(size) scope stack
+        return tls
+
+    def bulk_size(self):
+        """Segment cap for the next deferred op; 0 = dispatch eagerly.
+
+        An explicit ``bulk(size)`` scope wins; otherwise ``BulkEngine``
+        bulks up to ``MXNET_EXEC_BULK_EXEC_MAX_NODE`` when the mode knob
+        (TRAIN/INFERENCE) allows.  Always 0 while autograd records (the
+        tape needs per-op vjps), while an op profiler hook is attached
+        (per-op spans, reference parity: profiling disables bulking), or
+        under the EA4xx auditor (it validates the eager push stream).
+        """
+        st = self._bulk_state()
+        if st.scopes:
+            size = st.scopes[-1]
+        elif self.kind == "BulkEngine":
+            if self._hooks or self._audit is not None:
+                return 0
+            size = self._bulk_max
+        else:
+            return 0
+        if size <= 0:
+            return 0
+        from . import autograd
+
+        if autograd.is_recording():
+            return 0
+        if not st.scopes:
+            knob = self._bulk_train if autograd.is_training() \
+                else self._bulk_infer
+            if not knob:
+                return 0
+        return size
+
+    def current_segment(self, size=None):
+        """This thread's open segment, creating one if needed."""
+        st = self._bulk_state()
+        seg = st.seg
+        if seg is None or seg.flushed:
+            seg = BulkSegment(self, size if size is not None
+                              else self.bulk_size())
+            st.seg = seg
+        return seg
+
+    def flush_bulk(self, origin="flush"):
+        """Flush this thread's open segment, if any (cheap when none)."""
+        st = self._bulk_state()
+        seg = st.seg
+        st.seg = None
+        if seg is not None and not seg.flushed:
+            seg.flush(origin)
 
     # -- sync -------------------------------------------------------------
     def wait_for_var(self, var):
         var.rethrow()
 
     def wait_for_all(self):
+        self.flush_bulk("waitall")
         self.notify_sync("waitall")
-        pending, self._inflight = self._inflight, []
+        pending, self._inflight = self._inflight, collections.deque()
         for d in pending:
             try:
                 d.block_until_ready()  # mxlint: allow-host-sync
@@ -193,3 +427,44 @@ class Engine:
 
 def waitall():
     Engine.get().wait_for_all()
+
+
+def set_bulk_size(size):
+    """Set the default segment cap (parity: mxnet.engine.set_bulk_size).
+    Returns the previous cap.  Only takes effect under ``BulkEngine`` or
+    inside an explicit :class:`bulk` scope."""
+    eng = Engine.get()
+    prev, eng._bulk_max = eng._bulk_max, int(size)
+    return prev
+
+
+class bulk:
+    """Scope bulking consecutive imperative ops (parity: mxnet.engine.bulk).
+
+    ::
+
+        with mx.engine.bulk(16):
+            for _ in range(100):
+                x = x + 1          # deferred; flushes every 16 ops
+        x.asnumpy()                # sync point: flushes the tail
+
+    Works under any engine kind — the scope overrides the engine default,
+    so ``bulk(0)`` also force-disables bulking under ``BulkEngine``.
+    Entering and leaving the scope are segment boundaries.
+    """
+
+    def __init__(self, size):
+        self.size = int(size)
+
+    def __enter__(self):
+        eng = Engine.get()
+        eng.flush_bulk("bulk_scope_enter")
+        eng._bulk_state().scopes.append(self.size)
+        return self
+
+    def __exit__(self, *exc):
+        eng = Engine.get()
+        try:
+            eng.flush_bulk("bulk_scope_exit")
+        finally:
+            eng._bulk_state().scopes.pop()

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 
 from ..base import MXNetError
 from ..context import Context, current_context
-from ..engine import Engine, Var
+from ..engine import Engine, Var, _BulkRef
 from .. import autograd
 from ..ops import registry as _reg
 
@@ -48,7 +48,7 @@ class NDArray:
     _op_result_cls = None  # resolved to NDArray below; mx.np overrides
 
     __slots__ = (
-        "_data", "_ctx", "_var",
+        "_data", "_pending", "_ctx", "_var",
         "_marked", "_grad", "_grad_req", "_grad_gen", "_fresh_grad",
         "_grad_owner", "_dlpack_mirror",
         "_tape_node", "_tape_index",
@@ -56,8 +56,27 @@ class NDArray:
     )
 
     def __init__(self, data, ctx=None, dtype=None):
-        if isinstance(data, NDArray):
-            data = data._data
+        # a deferred bulk-segment output (engine._BulkRef) makes a LAZY
+        # array: ``_data`` holds only the aval until the segment flushes
+        pending = None
+        if isinstance(data, _BulkRef):
+            pending = data
+        elif isinstance(data, NDArray):
+            p = data._pending
+            if p is not None:
+                jdt0 = _to_jax_dtype(dtype)
+                if jdt0 is None or jdt0 == p.aval.dtype:
+                    pending = p  # share the promise; no forced flush
+                else:
+                    data = data.data()  # dtype change needs the value
+            if pending is None:
+                data = data._data
+        if pending is not None:
+            self._data = jax.ShapeDtypeStruct(tuple(pending.aval.shape),
+                                              pending.aval.dtype)
+            self._pending = pending
+            self._init_rest(ctx)
+            return
         jdt = _to_jax_dtype(dtype)
         if not isinstance(data, jax.Array):
             data = _np.asarray(data, dtype=jdt or None)
@@ -68,6 +87,10 @@ class NDArray:
         elif jdt is not None and data.dtype != jdt:
             data = data.astype(jdt)
         self._data = data
+        self._pending = None
+        self._init_rest(ctx)
+
+    def _init_rest(self, ctx):
         self._ctx = ctx if ctx is not None else current_context()
         self._var = Var()
         self._marked = False
@@ -85,14 +108,36 @@ class NDArray:
     # ------------------------------------------------------------------
     def data(self):
         """The raw jax.Array (framework-internal)."""
+        if self._pending is not None:
+            self._materialize()
         if self._dlpack_mirror is not None:
             self._sync_dlpack_write()
         return self._data
+
+    def _materialize(self):
+        """Resolve a deferred bulk-segment output into a concrete buffer.
+
+        Reading a lazy array is a sync point: the open segment flushes
+        (one fused push) and the promised value lands here.  If the flush
+        failed, the first reader gets the original exception (propagated
+        from flush / rethrown off this var) and the value is gone for good.
+        """
+        p = self._pending
+        if p.value is None and not p.failed:
+            p.segment.flush("data")
+        if p.value is None:
+            self._var.rethrow()
+            raise MXNetError(
+                "deferred NDArray lost: the bulk segment computing it "
+                "failed (the original error was raised at the first read)")
+        self._data = p.value
+        self._pending = None
 
     def _set_data(self, new_data):
         """In-place write: swap buffer + bump the engine var version."""
         old = self._data
         self._data = new_data
+        self._pending = None  # an overwrite supersedes any deferred value
         self._var.on_write()
         # grad-view write-through: reference .grad is the ACTUAL shared
         # NDArray, so mutating it mutates the stored gradient.  Our wrapper
@@ -140,17 +185,13 @@ class NDArray:
     def wait_to_read(self):
         self._var.rethrow()
         Engine.get().notify_sync("wait_to_read")
-        if self._dlpack_mirror is not None:
-            self._sync_dlpack_write()
-        self._data.block_until_ready()
+        self.data().block_until_ready()
         return self
 
     def asnumpy(self):
         self._var.rethrow()
         Engine.get().notify_sync("asnumpy")
-        if self._dlpack_mirror is not None:
-            self._sync_dlpack_write()
-        return _np.asarray(self._data)
+        return _np.asarray(self.data())
 
     def __array__(self, dtype=None, copy=None):
         # numpy protocol: without this np.asarray() would fall back to
@@ -184,7 +225,8 @@ class NDArray:
 
     def __repr__(self):
         return "\n%s\n<NDArray %s @%s>" % (
-            _np.asarray(self._data), "x".join(map(str, self.shape)), self._ctx)
+            _np.asarray(self.data()), "x".join(map(str, self.shape)),
+            self._ctx)
 
     # ------------------------------------------------------------------
     # autograd
@@ -249,7 +291,8 @@ class NDArray:
                           retain_graph=retain_graph, train_mode=train_mode)
 
     def detach(self):
-        out = NDArray(self._data, ctx=self._ctx)
+        # passing the NDArray (not its buffer) keeps a deferred value lazy
+        out = NDArray(self, ctx=self._ctx)
         return out
 
     # ------------------------------------------------------------------
@@ -262,14 +305,14 @@ class NDArray:
         return _reg.invoke("cast", [self], {"dtype": _np.dtype(jdt).name})
 
     def copy(self):
-        return NDArray(self._data, ctx=self._ctx)
+        return NDArray(self, ctx=self._ctx)
 
     def copyto(self, other):
         if isinstance(other, NDArray):
             if other.shape != self.shape:
                 raise ValueError("copyto shape mismatch")
             other._set_data(
-                jax.device_put(self._data, other._ctx.jax_device).astype(other.dtype))
+                jax.device_put(self.data(), other._ctx.jax_device).astype(other.dtype))
             return other
         if isinstance(other, Context):
             return self.as_in_context(other)
@@ -278,7 +321,7 @@ class NDArray:
     def as_in_context(self, context):
         if context == self._ctx:
             return self
-        out = NDArray(jax.device_put(self._data, context.jax_device), ctx=context)
+        out = NDArray(jax.device_put(self.data(), context.jax_device), ctx=context)
         out._tape_node = self._tape_node
         out._tape_index = self._tape_index
         return out
@@ -299,6 +342,8 @@ class NDArray:
         """The object whose ``__dlpack__`` we export: the device buffer when
         the backend supports external references, else a host copy."""
         self._var.rethrow()
+        if self._pending is not None:
+            self._materialize()
         if self._dlpack_mirror is not None:
             return self._dlpack_mirror
         try:
@@ -330,6 +375,8 @@ class NDArray:
         written after that.
         """
         self._var.rethrow()
+        if self._pending is not None:
+            self._materialize()
         if self._dlpack_mirror is None:
             self._dlpack_mirror = _np.array(self._data)  # writable host copy
         self._var.on_write()
@@ -603,7 +650,17 @@ class NDArray:
         return self
 
     def _adopt(self, res):
-        self._set_data(res._data)
+        p = res._pending
+        if p is not None and self._grad_owner is None \
+                and self._dlpack_mirror is None:
+            # adopt the promise itself: the in-place write stays deferred
+            # but its version bump happens NOW, exactly when eager would
+            self._data = jax.ShapeDtypeStruct(tuple(p.aval.shape),
+                                              p.aval.dtype)
+            self._pending = p
+            self._var.on_write()
+        else:
+            self._set_data(res.data())
         self._tape_node = res._tape_node
         self._tape_index = res._tape_index
 
@@ -636,21 +693,21 @@ class NDArray:
             (out,) = invoke_fn(lambda d: (d[key],), [self],
                                op_name="_index")
             return out
-        return NDArray(self._data[key], ctx=self._ctx)
+        return NDArray(self.data()[key], ctx=self._ctx)
 
     def __setitem__(self, key, value):
         if autograd.is_recording() and self._in_graph:
             raise MXNetError("in-place assignment on a taped array")
         key = self._conv_index(key)
         if isinstance(value, NDArray):
-            value = value._data
+            value = value.data()
         elif not isinstance(value, jax.Array):
             value = _np.asarray(value)
         if key is Ellipsis or (isinstance(key, slice) and key == slice(None)):
             new = jnp.broadcast_to(jnp.asarray(value, dtype=self.dtype),
                                    self.shape)
         else:
-            new = self._data.at[key].set(jnp.asarray(value, dtype=self.dtype))
+            new = self.data().at[key].set(jnp.asarray(value, dtype=self.dtype))
         self._set_data(jnp.asarray(new, dtype=self.dtype))
 
     # ------------------------------------------------------------------

@@ -143,12 +143,12 @@ def _freeze(v):
 
 
 @functools.lru_cache(maxsize=None)
-def _jitted(name, fields, attrs_key):
-    """One jitted callable per (op, input fields, static attrs).
+def _op_run(name, fields, attrs_key):
+    """Raw (unjitted) runner per (op, input fields, static attrs).
 
-    This cache is the TPU analogue of the reference's per-op FCompute
-    dispatch table + CachedOp executable cache (cached_op.cc:417): XLA adds
-    the per-shape/dtype level underneath automatically.
+    Shared by the eager path (jitted whole in :func:`_jitted`) and the
+    bulking path (inlined into one segment-wide jit) so both dispatch
+    routes trace the exact same python callable.
     """
     reg = get(name)
     attrs = dict(attrs_key)
@@ -167,7 +167,18 @@ def _jitted(name, fields, attrs_key):
             return out if isinstance(out, tuple) else (out,)
 
     run.__name__ = name.lstrip("_") or name
-    jitted = jax.jit(run)
+    return run
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted(name, fields, attrs_key):
+    """One jitted callable per (op, input fields, static attrs).
+
+    This cache is the TPU analogue of the reference's per-op FCompute
+    dispatch table + CachedOp executable cache (cached_op.cc:417): XLA adds
+    the per-shape/dtype level underneath automatically.
+    """
+    jitted = jax.jit(_op_run(name, fields, attrs_key))
     try:
         # marks this callable as cacheable for the lazy tape's jitted
         # backward (autograd._node_backward)
@@ -177,9 +188,22 @@ def _jitted(name, fields, attrs_key):
     return jitted
 
 
+@functools.lru_cache(maxsize=None)
+def _out_avals(name, fields, attrs_key, aval_key):
+    """Output ShapeDtypeStructs for one deferred op (lazy NDArray shape/
+    dtype come from here — same ``jax.eval_shape`` mechanism the registry
+    already uses for inference, so bulked ops can't disagree with it)."""
+    run = _op_run(name, fields, attrs_key)
+    args = [jax.ShapeDtypeStruct(s, d) for s, d in aval_key]
+    return tuple(jax.eval_shape(run, *args))
+
+
 def _prep(reg, datas, attrs, fields):
     """Normalize (datas, attrs, fields) and resolve the jitted callable."""
-    attrs = {k: v for k, v in (attrs or {}).items() if v is not None or True}
+    # drop unset attrs: every registered forward defaults its optional
+    # params, so a None-valued attr is the default spelled loudly — keeping
+    # it would only fragment the _jitted/_out_avals cache keys
+    attrs = {k: v for k, v in (attrs or {}).items() if v is not None}
     if reg.needs_mode and "_mode" not in attrs:
         attrs["_mode"] = "train" if autograd.is_training() else "predict"
     from .. import amp as _amp
@@ -264,23 +288,113 @@ def register_invoke_override(name, handler):
     _INVOKE_OVERRIDES[name] = handler
 
 
+def _try_bulk(reg, inputs, attrs, out, fields, eng):
+    """Defer one imperative op into the current bulk segment.
+
+    Returns the op result (lazy NDArrays promised by the segment), or
+    ``NotImplemented`` to fall through to the eager path.  Non-deferrable
+    ops (RNG-keyed, AMP-rewritten, non-NDArray operands) conservatively
+    flush the open segment first so program order is preserved.
+    """
+    from ..ndarray.ndarray import NDArray
+
+    size = eng.bulk_size()
+    if size <= 0:
+        return NotImplemented
+    if reg.needs_rng:
+        eng.flush_bulk("rng:%s" % reg.name)
+        return NotImplemented
+    if not inputs or any(not isinstance(x, NDArray) for x in inputs):
+        eng.flush_bulk("nondeferrable:%s" % reg.name)
+        return NotImplemented
+    from .. import amp as _amp
+
+    if _amp.is_active():
+        eng.flush_bulk("amp:%s" % reg.name)
+        return NotImplemented
+    attrs = {k: v for k, v in (attrs or {}).items() if v is not None}
+    if reg.needs_mode and "_mode" not in attrs:
+        attrs["_mode"] = "train" if autograd.is_training() else "predict"
+    if fields is None:
+        fields = reg.input_names[: len(inputs)]
+    fields = tuple(fields)
+    try:
+        attrs_key = _freeze(attrs)
+        hash(attrs_key)
+    except TypeError:
+        eng.flush_bulk("unhashable_attrs:%s" % reg.name)
+        return NotImplemented
+
+    seg = eng.current_segment(size)
+    handles = []
+    aval_key = []
+    for x in inputs:
+        p = x._pending
+        if p is not None and p.value is None and not p.failed \
+                and p.segment is seg:
+            handles.append(("v", p))
+            aval_key.append((tuple(p.aval.shape), p.aval.dtype))
+        else:
+            d = x.data()  # materializes refs from older segments
+            if isinstance(d, jax.core.Tracer):
+                # inside a jit/eval_shape trace (hybridize, control flow):
+                # deferring would leak the tracer past its trace — run
+                # eagerly, which simply inlines into the enclosing trace
+                return NotImplemented
+            handles.append(("x", d))
+            aval_key.append((tuple(d.shape), d.dtype))
+    try:
+        out_avals = _out_avals(reg.name, fields, attrs_key, tuple(aval_key))
+    except Exception:
+        return NotImplemented  # let the eager path raise the canonical error
+
+    run_fn = _op_run(reg.name, fields, attrs_key)
+    refs = seg.defer((reg.name, fields, attrs_key), run_fn, handles,
+                     out_avals)
+    eng.stats.bulk_ops += 1
+    ctx = inputs[0].context
+    cls = inputs[0]._op_result_cls
+    results = [cls(r, ctx=ctx) for r in refs]
+    # output vars join the segment's write set: version bumps happened at
+    # construction/adopt exactly as eager, but a failed flush must still be
+    # able to poison every promised output (async rethrow contract)
+    seg.add_write_vars([a._var for a in results])
+    if seg.cap and seg.n_ops >= seg.cap:
+        seg.flush("max_node")
+    if out is not None:
+        outs_list = out if isinstance(out, (list, tuple)) else [out]
+        for dst, src in zip(outs_list, results):
+            dst._adopt(src)
+        return out
+    if len(results) == 1:
+        return results[0]
+    return results
+
+
 def invoke(name, inputs, attrs=None, out=None, fields=None):
     """Imperative invoke on NDArrays (parity: Imperative::Invoke).
 
     Records a tape node when autograd is recording and any input is in-graph.
+    When bulking is active (engine.bulk_size() > 0) deferrable ops join the
+    open BulkSegment instead and return lazy NDArrays.
     """
     from ..ndarray.ndarray import NDArray
 
+    eng = Engine.get()
     handler = _INVOKE_OVERRIDES.get(name)
     if handler is not None:
+        # overrides run op-specific host logic the segment can't see
+        eng.flush_bulk("override:%s" % name)
         res = handler(inputs, attrs or {}, out)
         if res is not NotImplemented:
             return res
 
     reg = get(name)
+    res = _try_bulk(reg, inputs, attrs, out, fields, eng)
+    if res is not NotImplemented:
+        return res
     datas = tuple(x.data() for x in inputs)
     recording = autograd.is_recording() and any(x._in_graph for x in inputs)
-    eng = Engine.get()
     node = None
     fn, datas2, n_rng = _prep(reg, datas, attrs, fields)
     outs = eng.push(lambda: fn(*datas2), op_name=name)
@@ -316,9 +430,7 @@ def invoke(name, inputs, attrs=None, out=None, fields=None):
     if out is not None:
         outs_list = out if isinstance(out, (list, tuple)) else [out]
         for dst, src in zip(outs_list, results):
-            dst._set_data(src.data())
-            dst._tape_node = src._tape_node
-            dst._tape_index = src._tape_index
+            dst._adopt(src)
         return out
     if len(results) == 1:
         return results[0]

@@ -31,10 +31,14 @@ def _stub(mod, monkeypatch, values):
     monkeypatch.setattr(mod, "_SPECS", specs)
 
 
+_STUB_VALUES = {"train": 100.0, "infer": 200.0, "bert": 300.0,
+                "llama": 400.0, "dispatch_eager": 500.0,
+                "dispatch_bulked": 600.0}
+
+
 def test_single_metric_line(monkeypatch, capsys):
     mod = _load_bench()
-    _stub(mod, monkeypatch,
-          {"train": 100.0, "infer": 200.0, "bert": 300.0, "llama": 400.0})
+    _stub(mod, monkeypatch, _STUB_VALUES)
     monkeypatch.setattr(sys, "argv", ["bench.py", "bert"])
     mod.main()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
@@ -46,8 +50,7 @@ def test_single_metric_line(monkeypatch, capsys):
 
 def test_default_mode_emits_all_metrics_in_one_line(monkeypatch, capsys):
     mod = _load_bench()
-    _stub(mod, monkeypatch,
-          {"train": 100.0, "infer": 200.0, "bert": 300.0, "llama": 400.0})
+    _stub(mod, monkeypatch, _STUB_VALUES)
     monkeypatch.setattr(sys, "argv", ["bench.py"])
     mod.main()
     out_lines = [ln for ln in capsys.readouterr().out.strip().splitlines()
@@ -59,19 +62,24 @@ def test_default_mode_emits_all_metrics_in_one_line(monkeypatch, capsys):
     assert rec["value"] == 100.0
     assert rec["vs_baseline"] > 0
     assert rec["platform"] == "cpu" and rec["fallback"] is False
-    # all four metrics in the array, each with provenance
+    # every metric in the array, each with provenance
     names = [m["metric"] for m in rec["metrics"]]
     assert names == ["resnet50_train_throughput",
                      "resnet50_infer_throughput",
                      "bert_base_train_throughput",
-                     "llama_decoder_train_throughput"]
+                     "llama_decoder_train_throughput",
+                     "imperative_dispatch_eager",
+                     "imperative_dispatch_bulked"]
     assert all("platform" in m and "fallback" in m for m in rec["metrics"])
+    # the op-bulking microbench rides in the metrics array (ISSUE 4)
+    by_name = {m["metric"]: m for m in rec["metrics"]}
+    assert by_name["imperative_dispatch_eager"]["value"] == 500.0
+    assert by_name["imperative_dispatch_bulked"]["value"] == 600.0
 
 
 def test_budget_exhaustion_marks_skipped(monkeypatch, capsys):
     mod = _load_bench()
-    _stub(mod, monkeypatch,
-          {"train": 100.0, "infer": 200.0, "bert": 300.0, "llama": 400.0})
+    _stub(mod, monkeypatch, _STUB_VALUES)
     monkeypatch.setattr(sys, "argv", ["bench.py"])
     monkeypatch.setenv("MXNET_BENCH_BUDGET", "0")
     mod.main()
@@ -79,7 +87,7 @@ def test_budget_exhaustion_marks_skipped(monkeypatch, capsys):
                       if ln.startswith("{")][-1])
     assert rec["value"] == 100.0  # headline always measured
     skipped = [m for m in rec["metrics"] if m.get("skipped")]
-    assert len(skipped) == 3
+    assert len(skipped) == 5
     assert all(m["value"] == 0.0 for m in skipped)
 
 
@@ -90,16 +98,21 @@ def test_failed_benchmark_emits_zero_not_crash(monkeypatch, capsys):
         raise RuntimeError("synthetic failure")
 
     monkeypatch.setattr(mod, "_init_backend", lambda: ("cpu", True))
+    monkeypatch.setattr(mod.time, "sleep", lambda s: None)  # retry pauses
     monkeypatch.setattr(mod, "_SPECS", {
         "train": (boom, "resnet50_train_throughput", "images/sec", 363.69),
         "infer": (boom, "resnet50_infer_throughput", "images/sec", 2085.51),
         "bert": (boom, "bert_base_train_throughput", "samples/sec", None),
         "llama": (boom, "llama_decoder_train_throughput", "tokens/sec",
                   None),
+        "dispatch_eager": (boom, "imperative_dispatch_eager", "ops/sec",
+                           None),
+        "dispatch_bulked": (boom, "imperative_dispatch_bulked", "ops/sec",
+                            None),
     })
     monkeypatch.setattr(sys, "argv", ["bench.py"])
     mod.main()
     rec = json.loads([ln for ln in capsys.readouterr().out.splitlines()
                       if ln.startswith("{")][-1])
     assert rec["value"] == 0.0 and rec["fallback"] is True
-    assert len(rec["metrics"]) == 4
+    assert len(rec["metrics"]) == 6

@@ -1,0 +1,337 @@
+"""Op bulking (BulkEngine / engine.bulk): semantics pinned by ISSUE 4.
+
+The contract under test: consecutive deferrable imperative ops collect
+into ONE engine push (a jitted, XLA-fused segment), lazy outputs carry
+eval_shape avals until a sync point flushes them, numerics and version
+bumps are indistinguishable from the eager engine, failed segments poison
+their outputs through ``Var.set_exception`` (async rethrow), and repeated
+identical streams hit the segment cache without retracing.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu import engine as engine_mod
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.engine import Engine
+
+
+@pytest.fixture
+def eng():
+    e = Engine.get()
+    e.flush_bulk("test_setup")
+    return e
+
+
+def _chain(x, n=20):
+    y = x
+    for i in range(n):
+        y = (y + 1.0) if i % 2 else (y * 1.5)
+    return y
+
+
+def test_20_op_chain_is_one_push_bit_identical(eng):
+    x = nd.ones((8, 8))
+    ref = _chain(x).asnumpy()  # eager
+    p0, b0, s0 = (eng.stats.ops_pushed, eng.stats.bulk_ops,
+                  eng.stats.bulk_segments)
+    with engine_mod.bulk(32):
+        y = _chain(x)
+        # nothing dispatched yet: the whole chain is deferred
+        assert eng.stats.ops_pushed == p0
+        assert y._pending is not None
+    out = y.asnumpy()  # scope exit flushed; read resolves the promise
+    assert eng.stats.ops_pushed == p0 + 1
+    assert eng.stats.bulk_ops == b0 + 20
+    assert eng.stats.bulk_segments == s0 + 1
+    assert np.array_equal(out, ref), "bulked numerics differ from eager"
+
+
+def test_lazy_ndarray_carries_aval_without_flushing(eng):
+    with engine_mod.bulk(16):
+        y = nd.ones((3, 4), dtype="float32") + 1.0
+        p0 = eng.stats.ops_pushed
+        # shape/dtype/size/ndim come from jax.eval_shape, not a flush
+        assert y.shape == (3, 4)
+        assert str(y.dtype) == "float32"
+        assert y.size == 12 and y.ndim == 2 and len(y) == 3
+        assert eng.stats.ops_pushed == p0
+
+
+@pytest.mark.parametrize("sync", [
+    "asnumpy", "wait_to_read", "waitall", "float", "bool", "getitem",
+    "setitem", "repr", "array",
+])
+def test_segment_flushes_at_every_sync_point(eng, sync):
+    with engine_mod.bulk(64):
+        y = (nd.ones((2, 2)) + 1.0) * 2.0
+        p0 = eng.stats.ops_pushed
+        if sync == "asnumpy":
+            y.asnumpy()
+        elif sync == "wait_to_read":
+            y.wait_to_read()
+        elif sync == "waitall":
+            mx.nd.waitall()
+        elif sync == "float":
+            float(y.sum())
+        elif sync == "bool":
+            bool(y.sum() > 0)
+        elif sync == "getitem":
+            y[0, 0].asnumpy()
+        elif sync == "setitem":
+            y[0, 0] = 7.0
+        elif sync == "repr":
+            repr(y)
+        elif sync == "array":
+            np.asarray(y)
+        assert eng.stats.ops_pushed > p0, "%s did not flush" % sync
+        assert np.asarray(y.data()).flat[-1] == 4.0
+
+
+def test_autograd_recording_boundary_flushes(eng):
+    w = nd.ones((3,))
+    w.attach_grad()
+    with engine_mod.bulk(64):
+        c = nd.ones((3,)) * 2.0 + 1.0
+        p0 = eng.stats.ops_pushed
+        with autograd.record():
+            # entering the scope flushed the pending segment; ops in here
+            # run eagerly (the tape needs per-op vjps)
+            assert eng.stats.ops_pushed == p0 + 1
+            loss = (w * c).sum()
+    loss.backward()
+    np.testing.assert_allclose(w.grad.asnumpy(), 3.0)
+
+
+def test_var_version_bumps_match_eager(eng):
+    a = nd.ones((2, 2))
+    v0 = a._var.version
+    with engine_mod.bulk(16):
+        a += 1.0  # deferred, but the write is visible NOW
+        assert a._var.version == v0 + 1
+        a *= 2.0
+        assert a._var.version == v0 + 2
+        # out= bumps the destination at call time too
+        dst = nd.zeros((2, 2))
+        d0 = dst._var.version
+        nd.broadcast_add(a, a, out=dst)
+        assert dst._var.version == d0 + 1
+    np.testing.assert_allclose(a.asnumpy(), 4.0)
+    np.testing.assert_allclose(dst.asnumpy(), 8.0)
+
+
+def test_failed_segment_poisons_all_outputs(eng, monkeypatch):
+    orig = Engine.push
+
+    def failing(self, fn, *args, **kwargs):
+        if (kwargs.get("op_name") or "").startswith("bulk_segment["):
+            raise RuntimeError("segment boom")
+        return orig(self, fn, *args, **kwargs)
+
+    monkeypatch.setattr(Engine, "push", failing)
+    with engine_mod.bulk(16):
+        a = nd.ones((2,)) + 1.0
+        b = a * 3.0
+        with pytest.raises(RuntimeError, match="segment boom"):
+            b.asnumpy()
+        # the sibling output's var was poisoned: async rethrow at ITS read
+        with pytest.raises(RuntimeError, match="segment boom"):
+            a.asnumpy()
+        # after the rethrow the value is permanently gone
+        with pytest.raises(MXNetError, match="deferred NDArray lost"):
+            a.asnumpy()
+
+
+def test_segment_cache_no_retrace_on_repeat(eng):
+    def step(x):
+        with engine_mod.bulk(16):
+            y = x
+            for _ in range(5):
+                y = y * 2.0 + 1.0
+        return y.asnumpy()
+
+    x = nd.ones((4, 4))
+    r1 = step(x)
+    t1 = engine_mod.bulk_trace_count()
+    r2 = step(x)
+    assert engine_mod.bulk_trace_count() == t1, \
+        "identical op stream retraced its segment"
+    assert np.array_equal(r1, r2)
+    # a different shape is a cache hit at the python level but a fresh
+    # XLA trace underneath (jax.jit's aval-level cache)
+    step(nd.ones((2, 2)))
+    assert engine_mod.bulk_trace_count() == t1 + 1
+
+
+def test_max_node_cap_splits_segments(eng):
+    p0 = eng.stats.ops_pushed
+    with engine_mod.bulk(4):
+        z = _chain(nd.ones((4,)), n=10)
+    z.wait_to_read()
+    # 10 ops at cap 4 -> segments of 4, 4, 2
+    assert eng.stats.ops_pushed - p0 == 3
+
+
+def test_bulk_engine_env_selection(monkeypatch):
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "BulkEngine")
+    monkeypatch.setenv("MXNET_EXEC_BULK_EXEC_MAX_NODE", "15")
+    old = Engine._instance
+    Engine._instance = None
+    try:
+        e = Engine.get()
+        assert e.kind == "BulkEngine"
+        p0 = e.stats.ops_pushed
+        y = _chain(nd.ones((3, 3)), n=10)
+        assert e.stats.ops_pushed == p0, "BulkEngine should defer by default"
+        y.asnumpy()
+        assert e.stats.ops_pushed == p0 + 1
+    finally:
+        Engine._instance = old
+
+
+def test_bulk_engine_inference_knob_disables(monkeypatch):
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "BulkEngine")
+    monkeypatch.setenv("MXNET_EXEC_BULK_EXEC_INFERENCE", "0")
+    old = Engine._instance
+    Engine._instance = None
+    try:
+        e = Engine.get()
+        p0 = e.stats.ops_pushed
+        _chain(nd.ones((3,)), n=4).asnumpy()
+        assert e.stats.ops_pushed == p0 + 4, \
+            "MXNET_EXEC_BULK_EXEC_INFERENCE=0 must fall back to eager"
+    finally:
+        Engine._instance = old
+
+
+def test_bulk_scope_zero_disables_under_bulk_engine(monkeypatch):
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "BulkEngine")
+    old = Engine._instance
+    Engine._instance = None
+    try:
+        e = Engine.get()
+        p0 = e.stats.ops_pushed
+        with engine_mod.bulk(0):
+            _chain(nd.ones((3,)), n=4).asnumpy()
+        assert e.stats.ops_pushed == p0 + 4
+    finally:
+        Engine._instance = old
+
+
+def test_rng_ops_flush_and_run_eagerly(eng):
+    mx.random.seed(7)
+    with engine_mod.bulk(16):
+        a = nd.ones((4,)) + 1.0
+        p0 = eng.stats.ops_pushed
+        r = mx.nd.random.uniform(shape=(4,))  # RNG-keyed: can't defer
+        # the pending segment flushed first, then the rng op pushed eagerly
+        assert eng.stats.ops_pushed == p0 + 2
+        assert a._pending is None or a._pending.value is not None
+    assert r.asnumpy().shape == (4,)
+
+
+OPWAVE_CASES = [
+    ("elemwise", lambda x: ((x + 1.5) * 2.0 - 0.25) / 3.0),
+    ("unary", lambda x: x.abs().sqrt().exp().tanh()),
+    ("reduce", lambda x: x.sum(axis=1, keepdims=True) + x.mean()),
+    ("matmul", lambda x: x.dot(x.T) * 0.1),
+    ("softmax", lambda x: x.softmax(axis=-1).log_softmax(axis=-1)),
+    ("shape", lambda x: (x.reshape(-1).expand_dims(0).squeeze(0)
+                         .reshape(4, 6).transpose())),
+    ("compare", lambda x: (x > 0.2) * x + x.clip(-0.5, 0.5)),
+    ("mixed", lambda x: (x.relu() + x.sigmoid()).sum(axis=0).square()),
+]
+
+
+@pytest.mark.parametrize("name,fn", OPWAVE_CASES, ids=[c[0] for c in OPWAVE_CASES])
+def test_bulked_numerics_identical_to_eager(eng, name, fn):
+    x = nd.array(np.random.RandomState(42).randn(4, 6).astype(np.float32))
+    ref = fn(x).asnumpy()
+    with engine_mod.bulk(64):
+        lazy = fn(x)
+    out = lazy.asnumpy()
+    assert np.array_equal(out, ref), \
+        "op wave %r: bulked result differs bitwise from eager" % name
+
+
+def test_prep_drops_none_attrs_from_cache_key(eng):
+    """Satellite regression: the old filter (`if v is not None or True`)
+    kept None attrs, so {axis: None} and {} fragmented the _jitted cache."""
+    from mxnet_tpu.ops import registry as reg
+
+    x = nd.ones((3, 3))
+    a = reg.invoke("sum", [x], {"axis": None, "keepdims": False})
+    b = reg.invoke("sum", [x], {"keepdims": False})
+    assert np.array_equal(a.asnumpy(), b.asnumpy())
+    fn_a = reg._jitted("sum", ("data",), reg._freeze({"keepdims": False}))
+    info = reg._jitted.cache_info()
+    # the explicit-None spelling must resolve to the SAME cached callable
+    reg.invoke("sum", [x], {"axis": None, "keepdims": False})
+    assert reg._jitted.cache_info().misses == info.misses
+    assert fn_a is reg._jitted("sum", ("data",),
+                               reg._freeze({"keepdims": False}))
+
+
+def test_inflight_ring_is_deque_and_skips_ready_buffers(monkeypatch):
+    """Satellite: the overflow path only blocks on buffers still in
+    flight; already-ready (or foreign) objects are dropped without a sync."""
+    import collections
+
+    monkeypatch.setenv("MXNET_ENGINE_INFLIGHT_CAP", "8")
+    e = Engine()
+    assert isinstance(e._inflight, collections.deque)
+
+    class Probe:
+        def __init__(self, ready):
+            self.ready = ready
+            self.blocked = False
+
+        def is_ready(self):
+            return self.ready
+
+        def block_until_ready(self):
+            self.blocked = True
+
+    ready = [Probe(True) for _ in range(4)]
+    pending = [Probe(False) for _ in range(4)]
+    for p in ready + pending:
+        e.track(p)
+    e.track(object())  # overflow: retires the oldest half (the ready ones)
+    assert not any(p.blocked for p in ready), \
+        "ready buffers must not be blocked on"
+    assert len(e._inflight) == 5
+
+
+def test_deferred_value_survives_source_overwrite(eng):
+    # snapshot semantics: an op reads its input's value AT CALL TIME,
+    # even if the input is overwritten before the segment flushes
+    a = nd.ones((3,))
+    with engine_mod.bulk(16):
+        b = a + 1.0        # reads a == 1
+        a[:] = 100.0       # setitem is a sync for a, but b's promise holds
+        c = b * 2.0
+    np.testing.assert_allclose(c.asnumpy(), 4.0)
+    np.testing.assert_allclose(a.asnumpy(), 100.0)
+
+
+def test_profiler_sees_one_named_segment_op(eng, tmp_path):
+    from mxnet_tpu import profiler
+
+    fname = str(tmp_path / "bulk_profile.json")
+    profiler.set_config(filename=fname)
+    profiler.set_state("run")
+    try:
+        with engine_mod.bulk(16):
+            _chain(nd.ones((4, 4)), n=6).wait_to_read()
+        import json
+
+        table = profiler.dumps(aggregate=False)
+        events = json.loads(table)
+    finally:
+        profiler.set_state("stop")
+    segs = [ev for ev in events if ev["name"].startswith("bulk_segment[")]
+    assert any(ev["name"] == "bulk_segment[6]" and ev["cat"] == "bulk"
+               for ev in segs)

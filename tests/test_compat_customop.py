@@ -198,4 +198,4 @@ def test_waitall_syncs_overflowed_ring(monkeypatch):
         assert sorted(synced) == list(range(20))
     finally:
         eng._inflight_cap = old_cap
-        eng._inflight = []
+        eng._inflight.clear()
