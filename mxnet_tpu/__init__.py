@@ -206,6 +206,7 @@ from . import monitor  # noqa: F401
 from . import visualization  # noqa: F401
 from .monitor import Monitor  # noqa: F401
 from . import profiler  # noqa: F401
+from . import telemetry  # noqa: F401
 from . import test_utils  # noqa: F401
 from . import amp  # noqa: F401
 from . import contrib  # noqa: F401

@@ -45,6 +45,17 @@ class classproperty:
         return self.fget(owner)
 
 
+def env_flag(name, default=False):
+    """Boolean env knob: unset → ``default``; set → false only for
+    ``""`` and ``"0"`` (the convention every ``MXNET_*`` switch in this
+    repo follows, so ``MXNET_TELEMETRY=0`` and ``MXNET_TELEMETRY=``
+    both disable while any other value enables)."""
+    v = _os.environ.get(name)
+    if v is None:
+        return bool(default)
+    return v not in ("", "0")
+
+
 @_contextmanager
 def atomic_path(fname):
     """Write-then-rename: yield a temp path in ``fname``'s directory; on

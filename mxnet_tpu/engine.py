@@ -34,6 +34,7 @@ import time
 
 import jax
 
+from .telemetry import metrics as _metrics
 from .testing.faults import maybe_inject as _inject
 
 _lock = threading.Lock()
@@ -65,12 +66,15 @@ class Var:
 
 
 class _Stats:
-    __slots__ = ("ops_pushed", "bulk_ops", "bulk_segments")
+    __slots__ = ("ops_pushed", "bulk_ops", "bulk_segments",
+                 "sync_origins", "flush_origins")
 
     def __init__(self):
         self.ops_pushed = 0
         self.bulk_ops = 0       # ops that executed inside a bulk segment
         self.bulk_segments = 0  # segments flushed (each = one push)
+        self.sync_origins = {}   # device->host syncs by origin
+        self.flush_origins = {}  # segment flushes by origin kind
 
 
 # ----------------------------------------------------------------------------
@@ -86,6 +90,8 @@ class _Stats:
 _SEGMENT_CACHE = collections.OrderedDict()
 _SEGMENT_CACHE_CAP = 256
 _trace_count = [0]
+_seg_cache_stats = {"hits": 0, "misses": 0}  # exported by the collector
+_SEGMENT_OPS_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
 
 
 def bulk_trace_count():
@@ -200,13 +206,17 @@ class BulkSegment:
         key = tuple(self.key_parts)
         fn = _SEGMENT_CACHE.get(key)
         if fn is None:
+            _seg_cache_stats["misses"] += 1
             fn = _build_segment_fn(self.steps)
             _SEGMENT_CACHE[key] = fn
             while len(_SEGMENT_CACHE) > _SEGMENT_CACHE_CAP:
                 _SEGMENT_CACHE.popitem(last=False)
         else:
+            _seg_cache_stats["hits"] += 1
             _SEGMENT_CACHE.move_to_end(key)
         ext = self.ext
+        n_traces0 = _trace_count[0]
+        t_flush0 = time.perf_counter()
         try:
             # one push for the whole op stream; write-var versions were
             # already bumped at defer time (exactly as eager would have),
@@ -221,6 +231,23 @@ class BulkSegment:
                 v.set_exception(e)
             raise
         eng.stats.bulk_segments += 1
+        if _metrics.enabled():
+            # origins like "rng:<op>" truncate to "rng" so the metric
+            # label set stays bounded (docs/observability.md)
+            kind = origin.split(":", 1)[0]
+            fo = eng.stats.flush_origins
+            fo[kind] = fo.get(kind, 0) + 1
+            _metrics.histogram(
+                "mxnet_engine_bulk_segment_ops",
+                help="ops fused per flushed bulk segment",
+                buckets=_SEGMENT_OPS_BUCKETS).observe(self.n_ops)
+            retraces = _trace_count[0] - n_traces0
+            if retraces:
+                # first run of a (structure, avals) pair: the push wall
+                # time is trace+compile dominated — record it per retrace
+                _metrics.record_compile(
+                    "bulk_segment", ("bulk_segment", key),
+                    time.perf_counter() - t_flush0, n=retraces)
         for r, val in zip(self.refs, vals):
             r.value = val
             eng.track(val)
@@ -420,9 +447,51 @@ class Engine:
     def notify_sync(self, origin):
         """Report one device->host sync to the sync hooks (cheap when none
         are registered — a single truthiness check on the hot path)."""
+        if _metrics.enabled():
+            so = self.stats.sync_origins
+            so[origin] = so.get(origin, 0) + 1
         if self._sync_hooks:
             for h in self._sync_hooks:
                 h(origin)
+
+
+def _telemetry_collector():
+    """Export engine aggregates at snapshot time (docs/observability.md).
+
+    ``Engine.stats`` and the segment cache already count on the hot
+    path; mirroring them here instead of inc'ing registry counters per
+    push keeps telemetry's per-op cost at zero for these families.
+    """
+    eng = Engine._instance
+    if eng is None:
+        return
+    st = eng.stats
+    _metrics.counter("mxnet_engine_ops_pushed_total",
+                     help="ops dispatched through Engine.push"
+                     ).set(st.ops_pushed)
+    _metrics.counter("mxnet_engine_bulk_ops_total",
+                     help="ops that executed inside a bulk segment"
+                     ).set(st.bulk_ops)
+    _metrics.gauge("mxnet_engine_inflight_depth",
+                   help="buffers tracked for waitall"
+                   ).set(len(eng._inflight))
+    for origin, n in list(st.sync_origins.items()):
+        _metrics.counter("mxnet_engine_sync_total",
+                         help="device->host syncs by origin",
+                         origin=origin).set(n)
+    for origin, n in list(st.flush_origins.items()):
+        _metrics.counter("mxnet_engine_bulk_segments_total",
+                         help="bulk segments flushed, by flush origin",
+                         origin=origin).set(n)
+    _metrics.counter("mxnet_engine_segment_cache_hits_total",
+                     help="bulk segment executable cache hits"
+                     ).set(_seg_cache_stats["hits"])
+    _metrics.counter("mxnet_engine_segment_cache_misses_total",
+                     help="bulk segment executable cache misses"
+                     ).set(_seg_cache_stats["misses"])
+
+
+_metrics.register_collector(_telemetry_collector)
 
 
 def waitall():

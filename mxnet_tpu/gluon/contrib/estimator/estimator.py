@@ -8,8 +8,11 @@ the hybridized net (hybridize() before fit for the fused path).
 """
 from __future__ import annotations
 
+import time as _time
+
 from .... import autograd
 from ....base import MXNetError
+from ....telemetry import metrics as _metrics
 from ....metric import EvalMetric, Loss as LossMetric
 from ... import trainer as trainer_mod
 from ...loss import Loss
@@ -103,12 +106,27 @@ class Estimator:
                 data, label = (batch_fn or self._batch_fn)(batch)
                 for h in batch_begin:
                     h.batch_begin(self, batch=batch)
+                t0 = _time.perf_counter() if _metrics.enabled() else 0.0
                 with autograd.record():
                     pred = self.net(data)
                     loss = self.loss(pred, label)
                 loss.backward()
                 batch_size = data.shape[0]
                 self.trainer.step(batch_size)
+                if _metrics.enabled():
+                    # whole fwd+bwd+step dispatch for one batch —
+                    # coarser than mxnet_trainer_step_seconds, which
+                    # times only the optimizer step inside it
+                    dt = _time.perf_counter() - t0
+                    _metrics.histogram(
+                        "mxnet_estimator_batch_seconds",
+                        help="estimator fwd+bwd+step dispatch per batch"
+                    ).observe(dt)
+                    if dt > 0:
+                        _metrics.gauge(
+                            "mxnet_estimator_samples_per_sec",
+                            help="batch_size / last batch time"
+                        ).set(batch_size / dt)
                 self.train_loss_metric.update(0, loss)
                 for h in batch_end:
                     if h.batch_end(self, batch=batch, pred=pred,

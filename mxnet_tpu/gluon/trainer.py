@@ -14,11 +14,14 @@ KVStore object remains for API parity and for the dist_* modes.
 """
 from __future__ import annotations
 
+import time as _time
+
 import jax
 import jax.numpy as jnp
 
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
+from ..telemetry import metrics as _metrics
 from .. import optimizer as opt_mod
 from .parameter import ParameterDict, Parameter
 
@@ -137,14 +140,26 @@ class Trainer:
         """
         if not self._kv_initialized:
             self._init_kvstore()
+        t0 = _time.perf_counter() if _metrics.enabled() else 0.0
         self._optimizer.rescale_grad = self._scale / batch_size
         kv = self._kvstore
         if kv is not None and str(kv.type).startswith("dist") \
                 and self._update_on_kvstore is not False:
             self._dist_step(ignore_stale_grad)
-            return
-        self.allreduce_grads()
-        self.update(batch_size, ignore_stale_grad, _rescaled=True)
+        else:
+            self.allreduce_grads()
+            self.update(batch_size, ignore_stale_grad, _rescaled=True)
+        if _metrics.enabled():
+            # dispatch time, not device time: the update is async on
+            # the PJRT stream (docs/observability.md)
+            dt = _time.perf_counter() - t0
+            _metrics.histogram("mxnet_trainer_step_seconds",
+                               help="Trainer.step dispatch wall time"
+                               ).observe(dt)
+            if dt > 0:
+                _metrics.gauge("mxnet_trainer_samples_per_sec",
+                               help="batch_size / last step time"
+                               ).set(batch_size / dt)
 
     def _dist_step(self, ignore_stale_grad=False):
         """Push grads / pull weights through a distributed kvstore whose

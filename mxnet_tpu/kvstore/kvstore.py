@@ -16,6 +16,7 @@ import jax.numpy as jnp
 
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
+from ..telemetry import metrics as _metrics
 from .base import KVStoreBase, create_via_registry
 
 
@@ -123,6 +124,9 @@ class KVStore(KVStoreBase):
         from ..ndarray.sparse import RowSparseNDArray
 
         keys = _as_list(key)
+        _metrics.counter("mxnet_kvstore_push_total",
+                         help="keys pushed", store=self._type
+                         ).inc(len(keys))
         if len(keys) == 1:
             values = [value]
         else:
@@ -156,6 +160,9 @@ class KVStore(KVStoreBase):
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys = _as_list(key)
+        _metrics.counter("mxnet_kvstore_pull_total",
+                         help="keys pulled", store=self._type
+                         ).inc(len(keys))
         if len(keys) == 1:
             outs = [out]
         else:

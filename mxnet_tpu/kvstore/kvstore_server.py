@@ -28,10 +28,20 @@ class KVStoreServer:
 
     def run(self):
         from ..parallel.dist_kvstore import DistServer, _server_port
+        from ..telemetry import metrics as _metrics
 
         server = DistServer(
             _server_port(self._root_port, self._server_id),
             self._num_workers, sync=self._sync)
+        # one-time bootstrap facts: which shard this is and how many
+        # workers it expects (MXNET_TELEMETRY_DUMP snapshots from a
+        # server process then identify themselves)
+        _metrics.gauge("mxnet_kvstore_server_id",
+                       help="shard id of this server process"
+                       ).set(self._server_id)
+        _metrics.gauge("mxnet_kvstore_server_expected_workers",
+                       help="worker ranks this server waits for"
+                       ).set(self._num_workers)
         if threading.current_thread() is threading.main_thread():
             prev = signal.getsignal(signal.SIGTERM)
 

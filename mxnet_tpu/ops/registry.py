@@ -24,12 +24,14 @@ from __future__ import annotations
 
 import functools
 import inspect
+import time
 
 import jax
 
 from ..base import MXNetError
 from .. import autograd
 from ..engine import Engine
+from ..telemetry import metrics as _metrics
 
 _REGISTRY = {}
 _ALIASES = {}
@@ -198,6 +200,66 @@ def _out_avals(name, fields, attrs_key, aval_key):
     return tuple(jax.eval_shape(run, *args))
 
 
+def _telemetry_collector():
+    """Export the executable-cache aggregates at snapshot time.
+
+    ``_jitted``'s lru_cache already counts every eager-path resolution
+    (one per invoke), so telemetry reads the totals for free instead of
+    inc'ing counters on the dispatch hot path.
+    """
+    info = _jitted.cache_info()
+    _metrics.counter("mxnet_jit_cache_hits_total",
+                     help="jitted-callable cache hits (op, fields, attrs)"
+                     ).set(info.hits)
+    _metrics.counter("mxnet_jit_cache_misses_total",
+                     help="jitted-callable cache misses").set(info.misses)
+    _metrics.gauge("mxnet_jit_cache_size",
+                   help="distinct jitted callables held"
+                   ).set(info.currsize)
+
+
+_metrics.register_collector(_telemetry_collector)
+
+
+# jitted fn -> last observed executable-cache size (-1: fn has no
+# probe).  Keeping the last size here makes the steady-state compile
+# check one dict hit + one _cache_size() instead of probing twice per
+# dispatch; entries live exactly as long as the _jitted cache does.
+_exec_cache_sizes = {}
+
+
+def _push_op(eng, fn, datas, name):
+    """Eager push of a jitted op with compile tracking.
+
+    XLA compiles lazily on the first call per (shape, dtype): a growth
+    of ``fn._cache_size()`` across the push means this call paid a
+    trace+compile, so its wall time goes to ``mxnet_compile_seconds``
+    and the retrace watchdog (``fn`` identifies the op signature — one
+    jitted callable per (op, fields, attrs) via the ``_jitted`` cache).
+    Never wraps ``fn`` itself: autograd and the segment cache key on
+    the bare callable's identity.
+    """
+    if not _metrics._ENABLED:
+        return eng.push(lambda: fn(*datas), op_name=name)
+    n0 = _exec_cache_sizes.get(fn)
+    if n0 is None:
+        try:
+            n0 = fn._cache_size()
+        except Exception:
+            n0 = -1  # non-jit callable or jax without the probe
+        _exec_cache_sizes[fn] = n0
+    if n0 < 0:
+        return eng.push(lambda: fn(*datas), op_name=name)
+    t0 = time.perf_counter()
+    outs = eng.push(lambda: fn(*datas), op_name=name)
+    n1 = fn._cache_size()
+    if n1 > n0:
+        _exec_cache_sizes[fn] = n1
+        _metrics.record_compile(name, fn, time.perf_counter() - t0,
+                                n=n1 - n0)
+    return outs
+
+
 def _prep(reg, datas, attrs, fields):
     """Normalize (datas, attrs, fields) and resolve the jitted callable."""
     # drop unset attrs: every registered forward defaults its optional
@@ -232,7 +294,7 @@ def invoke_raw(name, datas, attrs=None, fields=None):
     if autograd.is_recording():
         outs, vjp = eng.push(lambda: jax.vjp(fn, *datas), op_name=name)
     else:
-        outs = eng.push(lambda: fn(*datas), op_name=name)
+        outs = _push_op(eng, fn, datas, name)
         vjp = None
     for o in outs:
         eng.track(o)
@@ -397,7 +459,7 @@ def invoke(name, inputs, attrs=None, out=None, fields=None):
     recording = autograd.is_recording() and any(x._in_graph for x in inputs)
     node = None
     fn, datas2, n_rng = _prep(reg, datas, attrs, fields)
-    outs = eng.push(lambda: fn(*datas2), op_name=name)
+    outs = _push_op(eng, fn, datas2, name)
     if recording:
         # lazy tape (reference records AGInfo nodes, not gradients):
         # the forward runs through its cached jitted executable as usual

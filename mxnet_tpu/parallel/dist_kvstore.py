@@ -69,6 +69,7 @@ from ..base import MXNetError
 from ..kvstore.base import KVStoreBase
 from ..ndarray.ndarray import NDArray
 from ..ndarray import sparse as _sp
+from ..telemetry import metrics as _metrics
 from ..testing.faults import maybe_inject as _inject, set_role as _set_role
 
 
@@ -106,6 +107,14 @@ _MUTATING = frozenset({CMD_INIT, CMD_PUSH, CMD_BARRIER, CMD_SET_OPTIMIZER,
                        CMD_STOP})
 
 _MAX_FRAME = 1 << 34  # 16 GiB sanity ceiling per tensor/string
+
+# human-readable command labels for metrics and trace spans
+_CMD_NAMES = {
+    CMD_OK: "ok", CMD_INIT: "init", CMD_PUSH: "push", CMD_PULL: "pull",
+    CMD_ROW_SPARSE_PULL: "row_sparse_pull", CMD_BARRIER: "barrier",
+    CMD_SET_OPTIMIZER: "set_optimizer", CMD_STOP: "stop",
+    CMD_HELLO: "hello", CMD_PROFILER: "profiler", CMD_ERR: "err",
+}
 
 
 def _retries():
@@ -530,6 +539,10 @@ class DistServer:
                                                collections.OrderedDict())
             if seq in cache:
                 self._replays += 1
+                _metrics.counter(
+                    "mxnet_kvstore_replay_hits_total",
+                    help="replayed mutations answered from the dedup "
+                         "cache without re-applying").inc()
                 return True, cache[seq]
             cache[seq] = None  # claimed; apply in progress
             while len(cache) > self._SEQ_CACHE_DEPTH:
@@ -629,10 +642,24 @@ class DistServer:
         return _prof._now_us()
 
     @staticmethod
-    def _prof_span(name, t0):
+    def _prof_span(name, t0, rank=None, span=None, command=None):
+        """Record one handler span + its latency histogram.
+
+        Spans land on trace pid ``rank + 1`` (the requesting worker's
+        rank; pid 0 stays the local process) carrying the wire span id,
+        so a merged trace shows this handler nested under the worker's
+        ``kv_<command>`` RPC span that caused it."""
         from .. import profiler as _prof
 
-        _prof.add_span(name, t0, _prof._now_us(), cat="kvstore")
+        t1 = _prof._now_us()
+        _prof.add_span(name, t0, t1, cat="kvstore",
+                       pid=0 if rank is None else rank + 1,
+                       args={"span": span} if span else None)
+        if command is not None and _metrics.enabled():
+            _metrics.histogram(
+                "mxnet_kvstore_server_handle_seconds",
+                help="server-side request handler wall time",
+                command=command).observe((t1 - t0) / 1e6)
 
     def _handle(self, sock):
         authed = not _secret()
@@ -660,10 +687,11 @@ class DistServer:
                 # a replayed sequence number is answered from the reply
                 # cache WITHOUT re-applying (exactly-once mutations under
                 # client retry; docs/fault_tolerance.md)
-                rank = seq = None
+                rank = seq = span = None
                 if cmd in _MUTATING and f and isinstance(f[0], dict) \
                         and "seq" in f[0]:
                     rank, seq = int(f[0].get("rank", 0)), int(f[0]["seq"])
+                    span = f[0].get("span")  # trace correlation id
                     f = f[1:]
                     replay, cached = self._seq_claim(rank, seq)
                     if replay:
@@ -700,10 +728,16 @@ class DistServer:
                     key = f[0]
                     try:
                         self._do_push(key, self._decode(f[1], f[2:]), rank)
+                        # span closes BEFORE the reply: the worker may
+                        # tear the profiler down the moment its RPC
+                        # returns, and nesting under the worker's
+                        # kv_push span requires ending first anyway
+                        self._prof_span("KVStoreServer::push", t0,
+                                        rank=rank, span=span,
+                                        command="push")
                         reply(CMD_OK)
                     except _RoundError as e:
                         reply(CMD_ERR, str(e))
-                    self._prof_span("KVStoreServer::push", t0)
                 elif cmd == CMD_PULL:
                     t0 = self._prof_now()
                     (key,) = f
@@ -712,8 +746,9 @@ class DistServer:
                         # server wire send needs host bytes
                         val = st.value if isinstance(st.value, np.ndarray) \
                             else st.value.asnumpy()  # mxlint: allow-host-sync
+                    self._prof_span("KVStoreServer::pull", t0,
+                                    rank=rank, span=span, command="pull")
                     _send(sock, CMD_OK, val)
-                    self._prof_span("KVStoreServer::pull", t0)
                 elif cmd == CMD_ROW_SPARSE_PULL:
                     key, row_ids = f
                     st = self._key(key)
@@ -750,6 +785,12 @@ class DistServer:
                             _send(sock, CMD_OK, "")
                         elif action == "set_config":
                             _prof.set_config(**cfg.get("config", {}))
+                            _send(sock, CMD_OK, "")
+                        elif action == "pause":
+                            _prof.pause()
+                            _send(sock, CMD_OK, "")
+                        elif action == "resume":
+                            _prof.resume()
                             _send(sock, CMD_OK, "")
                         elif action == "dump":
                             _prof.dump(finished=bool(cfg.get("finished",
@@ -1083,11 +1124,25 @@ class DistKVStore(KVStoreBase):
         off exponentially with jitter, reconnect (re-handshaking), and
         replay.  Server-reported errors (CMD_ERR) and wire timeouts are
         NOT retried: the peer is alive and said no.
+
+        While the profiler is recording, mutating meta also carries a
+        span id ("rank:seq"); the server stamps the same id on its
+        handler span, so ``telemetry.merge_traces`` correlates this
+        worker-side RPC span with the server-side work it caused.
         """
+        from .. import profiler as _prof
+
         _set_role("worker", rank=self._rank)
+        cmd_name = _CMD_NAMES.get(cmd, str(cmd))
+        span_id = None
         if mutating:
-            fields = ({"rank": self._rank, "seq": self._next_seq()},) \
-                + fields
+            meta = {"rank": self._rank, "seq": self._next_seq()}
+            if _prof._recording():
+                span_id = "%d:%d" % (self._rank, meta["seq"])
+                meta["span"] = span_id
+            fields = (meta,) + fields
+        t_us0 = _prof._now_us()
+        t_rpc0 = _time.perf_counter()
         attempts = _retries() + 1
         last_err = None
         for attempt in range(attempts):
@@ -1102,6 +1157,15 @@ class DistKVStore(KVStoreBase):
                         "kvstore rpc (cmd %d, server %d) failed: %s"
                         % (cmd, server_id,
                            rfields[0] if rfields else "<no detail>"))
+                if _metrics.enabled():
+                    _metrics.histogram(
+                        "mxnet_kvstore_rpc_seconds",
+                        help="client RPC round-trip incl. retries",
+                        command=cmd_name,
+                    ).observe(_time.perf_counter() - t_rpc0)
+                _prof.add_span("kv_" + cmd_name, t_us0, _prof._now_us(),
+                               cat="kvstore",
+                               args={"span": span_id} if span_id else None)
                 return rfields
             except (ConnectionError, OSError) as e:
                 last_err = e
@@ -1109,6 +1173,10 @@ class DistKVStore(KVStoreBase):
                     self._evict(server_id, s)
                 if attempt + 1 >= attempts:
                     break
+                _metrics.counter(
+                    "mxnet_kvstore_rpc_retries_total",
+                    help="transport-failure retries (backoff + replay)",
+                    command=cmd_name).inc()
                 _backoff_sleep(attempt)
         raise MXNetError(
             "kvstore rpc (cmd %d, server %d) failed after %d attempt(s): "
@@ -1138,6 +1206,14 @@ class DistKVStore(KVStoreBase):
     def set_server_profiler_config(self, **config):
         self._profiler_broadcast({"action": "set_config",
                                   "config": config})
+
+    def server_profiler_pause(self):
+        """Pause event collection in every server process (routing parity
+        with ``set_server_profiler_state`` — profiler.pause('server'))."""
+        self._profiler_broadcast({"action": "pause"})
+
+    def server_profiler_resume(self):
+        self._profiler_broadcast({"action": "resume"})
 
     def server_profiler_dump(self, finished=True):
         """Every server writes its own chrome-trace file server-side."""
@@ -1285,8 +1361,16 @@ class DistKVStore(KVStoreBase):
     def barrier(self):
         # every worker must hit every server for a true global barrier;
         # mutating: a replayed barrier must not double-count this rank
+        t0 = _time.perf_counter()
         for sid in range(self._num_servers):
             self._rpc_to(sid, CMD_BARRIER, mutating=True)
+        if _metrics.enabled():
+            # wall time this rank spent blocked = straggler skew seen
+            # from here (the sum over all shards, like the wait itself)
+            _metrics.histogram(
+                "mxnet_kvstore_barrier_seconds",
+                help="time this rank waited in a global barrier",
+            ).observe(_time.perf_counter() - t0)
 
     def set_optimizer(self, optimizer):
         """Run the optimizer server-side (parity: SendCommandToServers)."""

@@ -36,10 +36,18 @@ _state = {"running": False, "paused": False, "hook": None,
           "xla_active": False}
 _events = []  # chrome trace event dicts
 _t0 = time.perf_counter()
+# wall-clock time of local ts==0: lets telemetry.merge_traces align
+# dumps from different processes (each has its own perf_counter epoch)
+# onto one timeline.  Embedded in every dump as otherData.wall_t0_us.
+_wall0 = time.time()
 
 
 def _now_us():
     return (time.perf_counter() - _t0) * 1e6
+
+
+def _recording():
+    return _state["running"] and not _state["paused"]
 
 
 def set_config(**kwargs):
@@ -69,24 +77,31 @@ def _engine_hook(op_name, t_start, t_end):
     add_span(op_name, (t_start - _t0) * 1e6, (t_end - _t0) * 1e6, cat=cat)
 
 
-def add_span(name, t_start_us, t_end_us, cat="operator", tid=None):
+def add_span(name, t_start_us, t_end_us, cat="operator", tid=None,
+             pid=0, args=None):
     """Record one complete duration event; timestamps are ``_now_us()``
     values (server request handlers and other non-engine
     instrumentation report through this).  ``tid`` defaults to the
     calling thread so concurrent handlers land on distinct trace
-    tracks instead of overlapping on one."""
-    if not _state["running"] or _state["paused"]:
+    tracks instead of overlapping on one.  ``pid`` is the trace
+    process track — dist servers record at ``rank + 1`` so merged
+    traces keep worker/server timelines apart; ``args`` carries
+    correlation ids (e.g. the kvstore wire span id)."""
+    if not _recording():
         return
     if tid is None:
         import threading
 
         tid = threading.get_ident() & 0xFFFF
+    ev = {
+        "name": name, "ph": "X", "cat": cat,
+        "ts": t_start_us, "dur": t_end_us - t_start_us,
+        "pid": pid, "tid": tid,
+    }
+    if args:
+        ev["args"] = dict(args)
     with _lock:
-        _events.append({
-            "name": name, "ph": "X", "cat": cat,
-            "ts": t_start_us, "dur": t_end_us - t_start_us,
-            "pid": 0, "tid": tid,
-        })
+        _events.append(ev)
 
 
 def set_state(state="stop", profile_process="worker"):
@@ -140,11 +155,19 @@ def profiler_set_state(state="stop"):
 
 
 def pause(profile_process="worker"):
-    """Suspend event collection without tearing down (parity: :193)."""
+    """Suspend event collection without tearing down (parity: :193).
+    ``profile_process='server'`` pauses every dist server's profiler
+    over the kvstore wire, same routing as ``set_state``/``dump``."""
+    if profile_process == "server":
+        _require_kv_handle().server_profiler_pause()
+        return
     _state["paused"] = True
 
 
 def resume(profile_process="worker"):
+    if profile_process == "server":
+        _require_kv_handle().server_profiler_resume()
+        return
     _state["paused"] = False
 
 
@@ -157,13 +180,21 @@ def dump(finished=True, profile_process="worker"):
         return
     if finished and _state["running"]:
         set_state("stop")
-    with _lock:
-        trace = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+    trace = get_trace()
     with open(_config["filename"], "w") as f:
         json.dump(trace, f)
     if not _config["continuous_dump"]:
         with _lock:
             _events.clear()
+
+
+def get_trace():
+    """The collected events as a chrome-trace dict (what ``dump`` would
+    write), without touching disk or profiler state.  Includes the
+    wall-clock anchor ``telemetry.merge_traces`` aligns timelines by."""
+    with _lock:
+        return {"traceEvents": list(_events), "displayTimeUnit": "ms",
+                "otherData": {"wall_t0_us": _wall0 * 1e6}}
 
 
 def dump_profile():
@@ -253,14 +284,16 @@ class _Span:
     def stop(self):
         if self._start is None:
             return
+        start, self._start = self._start, None
+        if not _recording():  # same gate as add_span
+            return
         with _lock:
             _events.append({
                 "name": self.name, "ph": "X",
-                "cat": str(self.domain), "ts": self._start,
-                "dur": _now_us() - self._start,
+                "cat": str(self.domain), "ts": start,
+                "dur": _now_us() - start,
                 "pid": 0, "tid": self._tid_id,
             })
-        self._start = None
 
     def __str__(self):
         return self.name
@@ -285,7 +318,9 @@ class Counter:
             self.set_value(value)
 
     def set_value(self, value):
-        self._value = value
+        self._value = value  # value tracks even while not recording
+        if not _recording():  # same gate as add_span
+            return
         with _lock:
             _events.append({"name": self.name, "ph": "C",
                             "ts": _now_us(), "pid": 0,
@@ -317,6 +352,8 @@ class Marker:
         self.name = name
 
     def mark(self, scope="process"):
+        if not _recording():  # same gate as add_span
+            return
         with _lock:
             _events.append({"name": self.name, "ph": "i",
                             "ts": _now_us(), "pid": 0, "tid": 0,

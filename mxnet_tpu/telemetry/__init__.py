@@ -1,0 +1,21 @@
+"""``mx.telemetry`` — always-on metrics + cross-process trace merging.
+
+See docs/observability.md.  Quick tour::
+
+    import mxnet_tpu as mx
+    mx.telemetry.counter("my_counter_total", my_label="x").inc()
+    mx.telemetry.snapshot()          # JSON-able dict of every family
+    print(mx.telemetry.prometheus_text())
+    mx.telemetry.dump("metrics.prom")
+
+    # one timeline from N per-process profiler dumps
+    mx.telemetry.merge_traces(["worker0.json", "server.json"],
+                              out="merged.json")
+"""
+from .metrics import (  # noqa: F401
+    counter, gauge, histogram,
+    enabled, enable, disable,
+    snapshot, prometheus_text, dump, reset,
+    register_collector, record_compile,
+)
+from .trace import merge_traces  # noqa: F401

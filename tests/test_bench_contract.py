@@ -33,6 +33,7 @@ def _stub(mod, monkeypatch, values):
 
 _STUB_VALUES = {"train": 100.0, "infer": 200.0, "bert": 300.0,
                 "llama": 400.0, "dispatch_eager": 500.0,
+                "dispatch_eager_notelemetry": 550.0,
                 "dispatch_bulked": 600.0}
 
 
@@ -69,6 +70,7 @@ def test_default_mode_emits_all_metrics_in_one_line(monkeypatch, capsys):
                      "bert_base_train_throughput",
                      "llama_decoder_train_throughput",
                      "imperative_dispatch_eager",
+                     "imperative_dispatch_eager_notelemetry",
                      "imperative_dispatch_bulked"]
     assert all("platform" in m and "fallback" in m for m in rec["metrics"])
     # the op-bulking microbench rides in the metrics array (ISSUE 4)
@@ -87,7 +89,7 @@ def test_budget_exhaustion_marks_skipped(monkeypatch, capsys):
                       if ln.startswith("{")][-1])
     assert rec["value"] == 100.0  # headline always measured
     skipped = [m for m in rec["metrics"] if m.get("skipped")]
-    assert len(skipped) == 5
+    assert len(skipped) == 6
     assert all(m["value"] == 0.0 for m in skipped)
 
 
@@ -107,6 +109,9 @@ def test_failed_benchmark_emits_zero_not_crash(monkeypatch, capsys):
                   None),
         "dispatch_eager": (boom, "imperative_dispatch_eager", "ops/sec",
                            None),
+        "dispatch_eager_notelemetry": (
+            boom, "imperative_dispatch_eager_notelemetry", "ops/sec",
+            None),
         "dispatch_bulked": (boom, "imperative_dispatch_bulked", "ops/sec",
                             None),
     })
@@ -115,4 +120,4 @@ def test_failed_benchmark_emits_zero_not_crash(monkeypatch, capsys):
     rec = json.loads([ln for ln in capsys.readouterr().out.splitlines()
                       if ln.startswith("{")][-1])
     assert rec["value"] == 0.0 and rec["fallback"] is True
-    assert len(rec["metrics"]) == 6
+    assert len(rec["metrics"]) == 7

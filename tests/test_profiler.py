@@ -72,3 +72,92 @@ def test_profiler_custom_objects(tmp_path):
     assert "loss_scale" in by_name
     assert by_name["loss_scale"][-1]["args"]["loss_scale"] == 10
     assert "checkpoint" in by_name and by_name["checkpoint"][0]["ph"] == "i"
+
+
+def test_custom_objects_silent_while_stopped():
+    # regression: Counter/Marker/Task used to append events even with
+    # the profiler stopped, polluting the next run's dump
+    assert not profiler._state["running"]
+    n0 = len(profiler._events)
+    dom = profiler.Domain("idle")
+    task = dom.new_task("ghost_task")
+    task.start()
+    ctr = dom.new_counter("ghost_counter", 1)
+    ctr += 5
+    dom.new_marker("ghost_marker").mark()
+    task.stop()
+    assert len(profiler._events) == n0
+    # the counter VALUE still tracks so a later recorded set_value
+    # reports the true running total
+    assert ctr._value == 6
+
+
+def test_custom_objects_silent_while_paused(tmp_path):
+    fname = str(tmp_path / "paused.json")
+    profiler.set_config(filename=fname)
+    profiler.set_state("run")
+    dom = profiler.Domain("pausedom")
+    profiler.pause()
+    task = dom.new_task("paused_task")
+    task.start()
+    dom.new_counter("paused_counter", 3)
+    dom.new_marker("paused_marker").mark()
+    task.stop()
+    profiler.resume()
+    dom.new_marker("live_marker").mark()
+    profiler.set_state("stop")
+    profiler.dump()
+    with open(fname) as f:
+        names = {e["name"] for e in json.load(f)["traceEvents"]}
+    assert "paused_task" not in names
+    assert "paused_counter" not in names
+    assert "paused_marker" not in names
+    assert "live_marker" in names
+
+
+class _FakeDistKV:
+    """Records the server-profiler wire commands the profiler routes."""
+
+    def __init__(self):
+        self.calls = []
+
+    def set_server_profiler_state(self, state):
+        self.calls.append(("state", state))
+
+    def server_profiler_pause(self):
+        self.calls.append(("pause",))
+
+    def server_profiler_resume(self):
+        self.calls.append(("resume",))
+
+    def server_profiler_dump(self, finished=True):
+        self.calls.append(("dump", finished))
+
+
+def test_pause_resume_route_to_server_over_wire():
+    # regression: pause/resume used to ignore profile_process='server'
+    # and silently pause the local worker profiler instead
+    fake = _FakeDistKV()
+    profiler.set_kvstore_handle(fake)
+    try:
+        assert not profiler._state["paused"]
+        profiler.pause(profile_process="server")
+        assert ("pause",) in fake.calls
+        assert not profiler._state["paused"]  # local state untouched
+        profiler.resume(profile_process="server")
+        assert ("resume",) in fake.calls
+        profiler.set_state("run", profile_process="server")
+        assert ("state", "run") in fake.calls
+        assert not profiler._state["running"]
+    finally:
+        profiler.set_kvstore_handle(None)
+
+
+def test_server_commands_require_kv_handle():
+    import pytest
+
+    profiler.set_kvstore_handle(None)
+    with pytest.raises(RuntimeError, match="dist kvstore"):
+        profiler.pause(profile_process="server")
+    with pytest.raises(RuntimeError, match="dist kvstore"):
+        profiler.resume(profile_process="server")
