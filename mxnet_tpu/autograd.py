@@ -39,23 +39,13 @@ def is_training():
     return _st().training
 
 
-def _flush_bulk(origin):
-    # a recording boundary is a bulk-segment boundary: taped ops need
-    # per-op vjps, and pre-boundary lazy values must land before the tape
-    # starts (docs/perf.md "Op bulking").  Engine._instance (not .get())
-    # so merely toggling recording never constructs an engine.
-    from .engine import Engine
-
-    eng = Engine._instance
-    if eng is not None:
-        eng.flush_bulk(origin)
-
-
 def set_recording(is_record):
+    # NOT a bulk-segment boundary: ops recorded under the tape defer into
+    # segments like any other op (their TapeNode primals hold _BulkRefs
+    # that resolve — flushing on demand — at backward time), so a whole
+    # recorded forward fuses without the tape ever forcing a flush.
     st = _st()
     prev, st.recording = st.recording, bool(is_record)
-    if prev != st.recording:
-        _flush_bulk("autograd_boundary")
     return prev
 
 
@@ -78,16 +68,11 @@ class _RecordingStateScope:
             st.recording = self._rec
         if self._train is not None:
             st.training = self._train
-        if st.recording != self._prev[0]:
-            _flush_bulk("autograd_boundary")
         return self
 
     def __exit__(self, *args):
         st = _st()
-        changed = st.recording != self._prev[0]
         st.recording, st.training = self._prev
-        if changed:
-            _flush_bulk("autograd_boundary")
 
 
 def record(train_mode=True):
@@ -194,6 +179,33 @@ def current_backward_gen():
     return _backward_gen[0]
 
 
+def _resolve_prim_datas(datas):
+    """Materialize any ``_BulkRef`` primals recorded through a segment.
+
+    A TapeNode recorded while its op was deferred holds segment promises
+    instead of concrete buffers; the first backward that needs one
+    flushes its segment (one fused push) and reads the landed value —
+    the tape itself never forces a flush at record time.
+    """
+    from .engine import _BulkRef
+
+    if not any(type(d) is _BulkRef for d in datas):
+        return datas
+    out = []
+    for d in datas:
+        if type(d) is _BulkRef:
+            if d.value is None and not d.failed:
+                d.segment.flush("backward")
+            if d.value is None:
+                raise MXNetError(
+                    "cannot run backward: a deferred forward value was "
+                    "lost (its bulk segment failed)")
+            out.append(d.value)
+        else:
+            out.append(d)
+    return tuple(out)
+
+
 def _node_backward(node, cts):
     """Run one node's backward.
 
@@ -209,6 +221,7 @@ def _node_backward(node, cts):
     if node.vjp_fn is not None:
         return node.vjp_fn(cts)
     fn, datas, _n_rng = node.prim
+    datas = _resolve_prim_datas(datas)
     bwd = getattr(fn, "_mx_bwd", None)
     if bwd is None:
         def bwd_fn(primals, cotangents):
@@ -320,6 +333,7 @@ def _apply_node_vjp_taped(node, cts):
         return [None if g is None else NDArray(g) for g in raw]
 
     fn, datas, n_rng = node.prim
+    datas = _resolve_prim_datas(datas)
     n_prim = len(datas)
 
     def full(*args):

@@ -28,17 +28,25 @@ What survives from the reference engine, and what this module provides:
 from __future__ import annotations
 
 import collections
+import itertools
 import os
+import sys
 import threading
 import time
+import weakref
 
 import jax
 
 from .telemetry import metrics as _metrics
 from .testing.faults import maybe_inject as _inject
 
-_lock = threading.Lock()
-_var_counter = [0]
+# itertools.count holds the GIL for the whole increment, so ids stay
+# unique across threads without a lock on the NDArray hot path
+_var_ids = itertools.count(1)
+
+# bound on first use by Engine.bulk_size (importing at module scope would
+# cycle: autograd lazily imports the engine for segment flushes)
+_autograd = None
 
 
 class Var:
@@ -47,9 +55,7 @@ class Var:
     __slots__ = ("vid", "version", "_exc")
 
     def __init__(self):
-        with _lock:
-            _var_counter[0] += 1
-            self.vid = _var_counter[0]
+        self.vid = next(_var_ids)
         self.version = 0
         self._exc = None
 
@@ -66,13 +72,14 @@ class Var:
 
 
 class _Stats:
-    __slots__ = ("ops_pushed", "bulk_ops", "bulk_segments",
+    __slots__ = ("ops_pushed", "bulk_ops", "bulk_segments", "bulk_donated",
                  "sync_origins", "flush_origins")
 
     def __init__(self):
         self.ops_pushed = 0
         self.bulk_ops = 0       # ops that executed inside a bulk segment
         self.bulk_segments = 0  # segments flushed (each = one push)
+        self.bulk_donated = 0   # dead input buffers donated to XLA
         self.sync_origins = {}   # device->host syncs by origin
         self.flush_origins = {}  # segment flushes by origin kind
 
@@ -83,15 +90,48 @@ class _Stats:
 # ----------------------------------------------------------------------------
 
 # jitted segment executables keyed by the op-sequence structure
-# (op name, static attrs, argument wiring) — the engine-level analogue of
-# CachedOp's executable cache for code that never calls hybridize().
-# jax.jit adds the per-(shape, dtype) level underneath, so re-running the
-# same imperative stream with the same avals re-traces nothing.
-_SEGMENT_CACHE = collections.OrderedDict()
-_SEGMENT_CACHE_CAP = 256
+# (op name, static attrs, argument wiring, donated-input set) — the
+# engine-level analogue of CachedOp's executable cache for code that never
+# calls hybridize().  jax.jit adds the per-(shape, dtype) level underneath,
+# so re-running the same imperative stream with the same avals re-traces
+# nothing.  Segments are bucketed into size tiers, each with its own LRU
+# budget: short interactive chains (<=8 ops) and long fused training steps
+# (<=64) churn at very different rates, and one flat LRU lets a burst of
+# small segments evict the expensive long-segment executables.
+_SEG_TIER_BOUNDS = (8, 16, 32, 64)
+_SEG_TIER_LABELS = ("le8", "le16", "le32", "le64")
+
+
+def _parse_tier_budgets():
+    vals = [128, 64, 32, 32]  # sums to the old flat cap of 256
+    raw = os.environ.get("MXNET_EXEC_BULK_SEG_CACHE_BUDGETS", "").strip()
+    if raw:
+        try:
+            parts = [int(p) for p in raw.split(",")]
+        except ValueError:
+            parts = []
+        for i, p in enumerate(parts[: len(vals)]):
+            if p > 0:
+                vals[i] = p
+    return tuple(vals)
+
+
+_SEG_TIER_BUDGETS = _parse_tier_budgets()
+_SEG_TIERS = tuple(collections.OrderedDict() for _ in _SEG_TIER_BOUNDS)
+_seg_tier_stats = tuple({"hits": 0, "misses": 0, "evictions": 0}
+                        for _ in _SEG_TIER_BOUNDS)
+_seg_cache_stats = {"hits": 0, "misses": 0}  # all-tier totals (collector)
 _trace_count = [0]
-_seg_cache_stats = {"hits": 0, "misses": 0}  # exported by the collector
 _SEGMENT_OPS_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+
+def _tier_index(n_ops):
+    for i, bound in enumerate(_SEG_TIER_BOUNDS):
+        if n_ops <= bound:
+            return i
+    # explicit bulk(size) scopes may exceed the largest bound; they share
+    # the top tier rather than getting an unbounded one
+    return len(_SEG_TIER_BOUNDS) - 1
 
 
 def bulk_trace_count():
@@ -100,7 +140,7 @@ def bulk_trace_count():
     return _trace_count[0]
 
 
-def _build_segment_fn(steps):
+def _build_segment_fn(steps, donate=(), exact=False, example_args=None):
     """One traceable callable running every deferred step in push order.
 
     ``steps`` is a sequence of ``(run_fn, slots, n_out)``; each slot is
@@ -109,6 +149,21 @@ def _build_segment_fn(steps):
     values are returned so the signature depends only on the op sequence,
     never on which outputs happen to still be referenced at flush time
     (liveness-dependent signatures would make cache hits GC-timing flaky).
+    ``donate`` are ext indices whose buffers are dead at flush time; XLA
+    may reuse them for outputs (donated inputs are deleted after the call).
+
+    ``exact=True`` compiles ahead-of-time with XLA optimizations off
+    (``xla_backend_optimization_level=0``) so every op keeps exactly the
+    rounding its standalone eager executable produces.  The default O2
+    pipeline fuses across op boundaries (FMA contraction, output
+    rematerialization) and drifts intermediates off eager by ulps — it
+    even strips ``optimization_barrier`` before fusing, so barriers can't
+    pin the numerics.  Recorded segments need the exact path because the
+    tape re-linearizes against segment intermediates and bulked grads
+    must be BIT-identical to eager; unrecorded segments keep the fast
+    fused path (the forward values the user sees are checked against
+    eager by tier-1 at default opts).  The dispatch win (one push per N
+    ops) is identical either way.
     """
     steps = tuple(steps)
 
@@ -120,7 +175,11 @@ def _build_segment_fn(steps):
             vals.extend(run_fn(*args))
         return tuple(vals)
 
-    return jax.jit(seg_run)
+    jitted = jax.jit(seg_run, donate_argnums=donate)
+    if not exact:
+        return jitted
+    return jitted.lower(*example_args).compile(
+        compiler_options={"xla_backend_optimization_level": 0})
 
 
 class _BulkRef:
@@ -145,7 +204,7 @@ class BulkSegment:
     """
 
     __slots__ = ("engine", "cap", "steps", "key_parts", "ext", "_ext_ids",
-                 "refs", "write_vars", "flushed", "n_ops")
+                 "ext_src", "refs", "write_vars", "flushed", "n_ops", "taped")
 
     def __init__(self, engine, cap):
         self.engine = engine
@@ -154,25 +213,41 @@ class BulkSegment:
         self.key_parts = []       # hashable mirror of steps → cache key
         self.ext = []             # external concrete inputs, dedup by id
         self._ext_ids = {}
+        self.ext_src = []         # per ext: [(weakref(NDArray), Var, version)]
         self.refs = []            # _BulkRef per produced value, in order
         self.write_vars = []      # Vars of every NDArray built on a ref
         self.flushed = False
         self.n_ops = 0
+        self.taped = False        # any op recorded into the autograd tape
 
     def defer(self, step_key, run_fn, handles, out_avals):
         """Append one op; ``handles`` are ``('v', _BulkRef)`` for values
-        produced earlier in this segment or ``('x', jax.Array)`` for
-        concrete inputs.  Returns one ``_BulkRef`` per output."""
+        produced earlier in this segment or ``('x', jax.Array, NDArray)``
+        for concrete inputs (the NDArray that supplied the buffer, or
+        ``None`` — supplier identity drives input-buffer donation).
+        Returns one ``_BulkRef`` per output."""
         slots = []
-        for kind, v in handles:
-            if kind == "v":
-                slots.append(("v", v.index))
+        for h in handles:
+            if h[0] == "v":
+                slots.append(("v", h[1].index))
             else:
+                v = h[1]
                 i = self._ext_ids.get(id(v))
                 if i is None:
                     i = len(self.ext)
                     self.ext.append(v)
                     self._ext_ids[id(v)] = i
+                    self.ext_src.append([])
+                owner = h[2] if len(h) > 2 else None
+                src = self.ext_src[i]
+                if owner is None:
+                    self.ext_src[i] = None  # unknown supplier: never donate
+                elif src is not None:
+                    try:
+                        src.append((weakref.ref(owner), owner._var,
+                                    owner._var.version))
+                    except TypeError:  # unweakrefable supplier: never donate
+                        self.ext_src[i] = None
                 slots.append(("x", i))
         slots = tuple(slots)
         base = len(self.refs)
@@ -186,6 +261,57 @@ class BulkSegment:
 
     def add_write_vars(self, new_vars):
         self.write_vars.extend(new_vars)
+
+    def _donation(self, eng):
+        """Ext indices whose buffers are provably dead → XLA donation.
+
+        An ext buffer is donatable iff (a) every NDArray that ever
+        supplied it has moved on (collected, or its engine var version
+        bumped past the supply-time version — in-place ``out=`` adoption
+        and rebinding both land here), (b) its aval matches some segment
+        output (XLA can only reuse matching buffers; anything else would
+        warn and donate for nothing), and (c) a refcount audit shows no
+        OTHER owner: exactly the ext list, the local probe, and tracked
+        in-flight occurrences hold it.  (c) is the safety net — buffer
+        shares the suppliers can't see (detach/copy views, autograd tape
+        primals, another thread's segment) all show up as extra refs and
+        veto the donation, so a donated buffer can never be read again.
+        """
+        if not eng._bulk_donate:
+            return ()
+        donate = []
+        out_avals = None
+        inflight = None
+        for i, srcs in enumerate(self.ext_src):
+            if not srcs:  # None (opted out) or no recorded supplier
+                continue
+            dead = True
+            for wref, var, ver in srcs:
+                nd = wref()
+                if nd is not None and var.version == ver:
+                    dead = False
+                    break
+            if not dead:
+                continue
+            b = self.ext[i]
+            if out_avals is None:
+                out_avals = {(tuple(r.aval.shape), r.aval.dtype)
+                             for r in self.refs}
+            if (tuple(b.shape), b.dtype) not in out_avals:
+                continue
+            if inflight is None:
+                inflight = collections.Counter(map(id, eng._inflight))
+            # refs: ext list + local ``b`` + getrefcount's argument,
+            # plus tracked in-flight entries
+            if sys.getrefcount(b) <= 3 + inflight[id(b)]:
+                donate.append(i)
+        if donate:
+            # the donated buffers are deleted by the call; purge them from
+            # the in-flight ring so waitall() never blocks on a dead buffer
+            donated_ids = {id(self.ext[i]) for i in donate}
+            eng._inflight = collections.deque(
+                d for d in eng._inflight if id(d) not in donated_ids)
+        return tuple(donate)
 
     def flush(self, origin="flush"):
         """Execute the whole segment as one engine push. Idempotent.
@@ -203,20 +329,36 @@ class BulkSegment:
             st.seg = None
         if not self.steps:
             return
-        key = tuple(self.key_parts)
-        fn = _SEGMENT_CACHE.get(key)
-        if fn is None:
-            _seg_cache_stats["misses"] += 1
-            fn = _build_segment_fn(self.steps)
-            _SEGMENT_CACHE[key] = fn
-            while len(_SEGMENT_CACHE) > _SEGMENT_CACHE_CAP:
-                _SEGMENT_CACHE.popitem(last=False)
-        else:
-            _seg_cache_stats["hits"] += 1
-            _SEGMENT_CACHE.move_to_end(key)
-        ext = self.ext
+        donate = self._donation(eng)
+        # taped segments compile ahead-of-time (see _build_segment_fn), so
+        # their cache key must pin the concrete ext avals jit would have
+        # re-traced on; untaped segments let jit handle shape polymorphism
+        exact = self.taped
+        key = (tuple(self.key_parts), donate, exact and tuple(
+            (tuple(a.shape), str(a.dtype)) for a in self.ext))
+        ti = _tier_index(self.n_ops)
+        tier = _SEG_TIERS[ti]
+        tstats = _seg_tier_stats[ti]
+        # snapshot BEFORE the cache lookup: exact (taped) segments trace
+        # at build time inside _build_segment_fn, not at first call
         n_traces0 = _trace_count[0]
         t_flush0 = time.perf_counter()
+        fn = tier.get(key)
+        if fn is None:
+            tstats["misses"] += 1
+            _seg_cache_stats["misses"] += 1
+            fn = _build_segment_fn(self.steps, donate, exact=exact,
+                                   example_args=self.ext)
+            tier[key] = fn
+            budget = _SEG_TIER_BUDGETS[ti]
+            while len(tier) > budget:
+                tier.popitem(last=False)
+                tstats["evictions"] += 1
+        else:
+            tstats["hits"] += 1
+            _seg_cache_stats["hits"] += 1
+            tier.move_to_end(key)
+        ext = self.ext
         try:
             # one push for the whole op stream; write-var versions were
             # already bumped at defer time (exactly as eager would have),
@@ -229,8 +371,10 @@ class BulkSegment:
                     r.failed = True
             for v in self.write_vars:
                 v.set_exception(e)
+            self._release()
             raise
         eng.stats.bulk_segments += 1
+        eng.stats.bulk_donated += len(donate)
         if _metrics.enabled():
             # origins like "rng:<op>" truncate to "rng" so the metric
             # label set stays bounded (docs/observability.md)
@@ -250,7 +394,20 @@ class BulkSegment:
                     time.perf_counter() - t_flush0, n=retraces)
         for r, val in zip(self.refs, vals):
             r.value = val
-            eng.track(val)
+        eng.track_many(vals)
+        self._release()
+
+    def _release(self):
+        """Drop input/step references once flushed: lazy tape nodes and
+        lazy NDArrays can pin _BulkRefs (→ this segment) long after the
+        flush, and holding every ext buffer alive through them would keep
+        whole training steps' worth of inputs resident."""
+        self.steps = ()
+        self.key_parts = ()
+        self.ext = ()
+        self._ext_ids = None
+        self.ext_src = ()
+        self.write_vars = ()
 
 
 class Engine:
@@ -268,7 +425,7 @@ class Engine:
         self.stats = _Stats()
         self._hooks = []  # profiler hooks: fn(op_name, t_start, t_end)
         self._sync_hooks = []  # sync hooks: fn(origin) per device->host sync
-        self.kind = os.environ.get("MXNET_ENGINE_TYPE", "NaiveEngine")
+        self.kind = os.environ.get("MXNET_ENGINE_TYPE", "BulkEngine")
         self._inflight = collections.deque()  # recent output buffers (ring)
         self._inflight_cap = int(os.environ.get("MXNET_ENGINE_INFLIGHT_CAP", "512"))
         # op bulking knobs (reference: MXNET_EXEC_BULK_EXEC_*,
@@ -279,7 +436,14 @@ class Engine:
         self._bulk_infer = os.environ.get(
             "MXNET_EXEC_BULK_EXEC_INFERENCE", "1") not in ("", "0")
         self._bulk_max = int(os.environ.get(
-            "MXNET_EXEC_BULK_EXEC_MAX_NODE", "15"))
+            "MXNET_EXEC_BULK_EXEC_MAX_NODE", "64"))
+        self._bulk_donate = os.environ.get(
+            "MXNET_EXEC_BULK_DONATE", "1") not in ("", "0")
+        # profiling normally disables implicit bulking (per-op spans,
+        # reference parity); MXNET_PROFILE_BULK=1 keeps segments fused so
+        # the profiler sees the execution mode it is actually measuring
+        self._profile_bulk = os.environ.get(
+            "MXNET_PROFILE_BULK", "0") not in ("", "0")
         self._audit = None  # EA4xx dependency auditor (docs/static_analysis.md)
         if os.environ.get("MXNET_ENGINE_AUDIT", "0") not in ("", "0"):
             from .analysis.engine_audit import EngineAudit
@@ -323,27 +487,40 @@ class Engine:
                 h(op_name or getattr(fn, "__name__", "op"), t0, t1)
         return out
 
+    def track_many(self, vals):
+        """Track a batch of buffers (segment flush) in one extend."""
+        self._inflight.extend(vals)
+        if len(self._inflight) > self._inflight_cap:
+            self._retire_inflight()
+
     def track(self, data):
         """Remember a dispatched buffer so wait_for_all() can sync on it."""
         self._inflight.append(data)
         if len(self._inflight) > self._inflight_cap:
-            # ring full: retire the oldest half before dropping it, so
-            # waitall() semantics stay exact (Engine::WaitForAll blocks on
-            # every outstanding op; silently forgetting buffers could let
-            # waitall() return with work — and async errors — in flight).
-            # Only buffers still in flight cost a block; anything PJRT has
-            # already finished (is_ready) is dropped without stalling.
-            for _ in range(self._inflight_cap // 2):
-                d = self._inflight.popleft()
+            self._retire_inflight()
+
+    def _retire_inflight(self):
+        # ring full: retire the oldest half before dropping it, so
+        # waitall() semantics stay exact (Engine::WaitForAll blocks on
+        # every outstanding op; silently forgetting buffers could let
+        # waitall() return with work — and async errors — in flight).
+        # Only buffers still in flight cost a block; anything PJRT has
+        # already finished (is_ready) is dropped without stalling.
+        for _ in range(self._inflight_cap // 2):
+            if not self._inflight:
+                break
+            d = self._inflight.popleft()
+            try:
+                ready = d.is_ready()
+            except AttributeError:
+                ready = False  # unknown state: assume still in flight
+            except RuntimeError:
+                continue  # donated-and-deleted buffer: nothing to wait on
+            if not ready:
                 try:
-                    ready = d.is_ready()
-                except AttributeError:
-                    ready = False  # unknown state: assume still in flight
-                if not ready:
-                    try:
-                        d.block_until_ready()  # mxlint: allow-host-sync
-                    except AttributeError:
-                        pass
+                    d.block_until_ready()  # mxlint: allow-host-sync
+                except (AttributeError, RuntimeError):
+                    pass
 
     # -- bulking ----------------------------------------------------------
     def _bulk_state(self):
@@ -358,32 +535,31 @@ class Engine:
 
         An explicit ``bulk(size)`` scope wins; otherwise ``BulkEngine``
         bulks up to ``MXNET_EXEC_BULK_EXEC_MAX_NODE`` when the mode knob
-        (TRAIN/INFERENCE) allows.  Always 0 while autograd records (the
-        tape needs per-op vjps), while an op profiler hook is attached
-        (per-op spans, reference parity: profiling disables bulking), or
-        under the EA4xx auditor (it validates the eager push stream).
+        (TRAIN/INFERENCE) allows.  Recording does NOT disable bulking:
+        taped ops defer too, and the tape re-linearizes through the
+        segment's promised values at backward time.  Implicit bulking
+        still steps aside while an op profiler hook is attached (per-op
+        spans, reference parity — unless MXNET_PROFILE_BULK=1 keeps
+        segments fused under the profiler) and under the EA4xx auditor
+        (it validates the eager push stream).
         """
+        global _autograd
         st = self._bulk_state()
         if st.scopes:
             size = st.scopes[-1]
-        elif self.kind == "BulkEngine":
-            if self._hooks or self._audit is not None:
-                return 0
-            size = self._bulk_max
-        else:
+            return size if size > 0 else 0
+        if self.kind != "BulkEngine":
             return 0
+        if self._audit is not None or (self._hooks and not self._profile_bulk):
+            return 0
+        size = self._bulk_max
         if size <= 0:
             return 0
-        from . import autograd
-
-        if autograd.is_recording():
-            return 0
-        if not st.scopes:
-            knob = self._bulk_train if autograd.is_training() \
-                else self._bulk_infer
-            if not knob:
-                return 0
-        return size
+        if _autograd is None:
+            from . import autograd as _autograd  # noqa: F811 (bind once)
+        knob = self._bulk_train if _autograd.is_training() \
+            else self._bulk_infer
+        return size if knob else 0
 
     def current_segment(self, size=None):
         """This thread's open segment, creating one if needed."""
@@ -403,6 +579,25 @@ class Engine:
         if seg is not None and not seg.flushed:
             seg.flush(origin)
 
+    def flush_if_referencing(self, buffers, origin="donation_guard"):
+        """Flush this thread's open segment if it captured any of
+        ``buffers`` as an external input.
+
+        Callers that donate buffers to XLA outside the bulk machinery
+        (``gluon.Trainer``'s fused optimizer update) must drain pending
+        deferred work first: XLA deletes a donated buffer even while a
+        pending segment still holds it as an ext input, and the
+        segment's later flush would read a dead array.  Cheap when the
+        segment doesn't touch the buffers — bulking continues across
+        the donating call.
+        """
+        st = self._bulk_state()
+        seg = st.seg
+        if seg is None or seg.flushed or not seg.ext:
+            return
+        if {id(b) for b in buffers} & seg._ext_ids.keys():
+            self.flush_bulk(origin)
+
     # -- sync -------------------------------------------------------------
     def wait_for_var(self, var):
         var.rethrow()
@@ -414,7 +609,9 @@ class Engine:
         for d in pending:
             try:
                 d.block_until_ready()  # mxlint: allow-host-sync
-            except AttributeError:
+            except (AttributeError, RuntimeError):
+                # RuntimeError: buffer was donated to a segment and
+                # deleted — by definition nothing can still be computing it
                 pass
 
     # -- instrumentation --------------------------------------------------
@@ -489,6 +686,23 @@ def _telemetry_collector():
     _metrics.counter("mxnet_engine_segment_cache_misses_total",
                      help="bulk segment executable cache misses"
                      ).set(_seg_cache_stats["misses"])
+    _metrics.counter("mxnet_engine_bulk_donated_total",
+                     help="dead segment inputs donated to XLA"
+                     ).set(st.bulk_donated)
+    for label, tstats, tier in zip(_SEG_TIER_LABELS, _seg_tier_stats,
+                                   _SEG_TIERS):
+        _metrics.counter("mxnet_engine_segment_cache_tier_hits_total",
+                         help="segment cache hits by size tier",
+                         tier=label).set(tstats["hits"])
+        _metrics.counter("mxnet_engine_segment_cache_tier_misses_total",
+                         help="segment cache misses by size tier",
+                         tier=label).set(tstats["misses"])
+        _metrics.counter("mxnet_engine_segment_cache_tier_evictions_total",
+                         help="segment cache LRU evictions by size tier",
+                         tier=label).set(tstats["evictions"])
+        _metrics.gauge("mxnet_engine_segment_cache_tier_size",
+                       help="segment executables held by size tier",
+                       tier=label).set(len(tier))
 
 
 _metrics.register_collector(_telemetry_collector)
@@ -503,7 +717,13 @@ def set_bulk_size(size):
     Returns the previous cap.  Only takes effect under ``BulkEngine`` or
     inside an explicit :class:`bulk` scope."""
     eng = Engine.get()
-    prev, eng._bulk_max = eng._bulk_max, int(size)
+    size = int(size)
+    if size <= 0:
+        # disabling bulking must fully disable deferral, not just cap new
+        # segments: any already-deferred ops flush NOW so everything after
+        # this call observes concrete program order
+        eng.flush_bulk("bulk_size_zero")
+    prev, eng._bulk_max = eng._bulk_max, size
     return prev
 
 

@@ -280,6 +280,14 @@ class Trainer:
             wds.append(jnp.float32(opt._get_wd(i)))
             ts.append(jnp.int32(opt._index_update_count[i]))
 
+        # the fused call donates weight/state buffers; a pending bulk
+        # segment may still hold an old weight as input (e.g. a recorded
+        # forward whose output was never read) — drain it first or its
+        # flush would read a deleted array (engine.flush_if_referencing)
+        from ..engine import Engine
+
+        Engine.get().flush_if_referencing(
+            weights + jax.tree_util.tree_leaves(states), "trainer_step")
         new_weights, new_states = fused(weights, grads, states, lrs, wds, ts)
         for i, nw, ns in zip(active, new_weights, new_states):
             self._params[i]._data._set_data(nw)

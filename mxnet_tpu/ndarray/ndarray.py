@@ -72,8 +72,7 @@ class NDArray:
             if pending is None:
                 data = data._data
         if pending is not None:
-            self._data = jax.ShapeDtypeStruct(tuple(pending.aval.shape),
-                                              pending.aval.dtype)
+            self._data = pending.aval  # ShapeDtypeStruct placeholder
             self._pending = pending
             self._init_rest(ctx)
             return
@@ -655,8 +654,7 @@ class NDArray:
                 and self._dlpack_mirror is None:
             # adopt the promise itself: the in-place write stays deferred
             # but its version bump happens NOW, exactly when eager would
-            self._data = jax.ShapeDtypeStruct(tuple(p.aval.shape),
-                                              p.aval.dtype)
+            self._data = p.aval  # ShapeDtypeStruct placeholder
             self._pending = p
             self._var.on_write()
         else:

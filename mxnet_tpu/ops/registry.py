@@ -390,12 +390,14 @@ def _try_bulk(reg, inputs, attrs, out, fields, eng):
     seg = eng.current_segment(size)
     handles = []
     aval_key = []
+    prim_datas = []
     for x in inputs:
         p = x._pending
         if p is not None and p.value is None and not p.failed \
                 and p.segment is seg:
             handles.append(("v", p))
             aval_key.append((tuple(p.aval.shape), p.aval.dtype))
+            prim_datas.append(p)
         else:
             d = x.data()  # materializes refs from older segments
             if isinstance(d, jax.core.Tracer):
@@ -403,8 +405,9 @@ def _try_bulk(reg, inputs, attrs, out, fields, eng):
                 # deferring would leak the tracer past its trace — run
                 # eagerly, which simply inlines into the enclosing trace
                 return NotImplemented
-            handles.append(("x", d))
+            handles.append(("x", d, x))  # x: supplier, for buffer donation
             aval_key.append((tuple(d.shape), d.dtype))
+            prim_datas.append(d)
     try:
         out_avals = _out_avals(reg.name, fields, attrs_key, tuple(aval_key))
     except Exception:
@@ -421,6 +424,23 @@ def _try_bulk(reg, inputs, attrs, out, fields, eng):
     # construction/adopt exactly as eager, but a failed flush must still be
     # able to poison every promised output (async rethrow contract)
     seg.add_write_vars([a._var for a in results])
+    if autograd.is_recording() and any(x._in_graph for x in inputs):
+        # segment-spanning tape: record against the SAME jitted callable
+        # the eager path would store (identical _mx_bwd vjp executable →
+        # bitwise-identical grads); primals that are still promises
+        # (_BulkRef) resolve lazily at backward time
+        node = autograd.TapeNode(
+            None,
+            list(inputs),
+            [(tuple(a.shape), a.dtype) for a in out_avals],
+            op_name=reg.name,
+            prim=(_jitted(reg.name, fields, attrs_key),
+                  tuple(prim_datas), 0),
+        )
+        for i, arr in enumerate(results):
+            arr._tape_node = node
+            arr._tape_index = i
+        seg.taped = True  # flush compiles the exact (bitwise-eager) build
     if seg.cap and seg.n_ops >= seg.cap:
         seg.flush("max_node")
     if out is not None:

@@ -71,10 +71,20 @@ def profiler_set_config(mode="symbolic", filename="profile.json"):
 
 def _engine_hook(op_name, t_start, t_end):
     # a flushed bulk segment arrives as ONE push named bulk_segment[N];
-    # give it its own category so traces distinguish fused segments from
-    # single-op dispatches (engine.BulkSegment.flush)
-    cat = "bulk" if op_name.startswith("bulk_segment[") else "operator"
-    add_span(op_name, (t_start - _t0) * 1e6, (t_end - _t0) * 1e6, cat=cat)
+    # give it its own category (with the fused op count in args) so
+    # traces distinguish fused segments from single-op dispatches and
+    # tooling can sum ops without parsing names (engine.BulkSegment.flush)
+    args = None
+    if op_name.startswith("bulk_segment["):
+        cat = "bulk"
+        try:
+            args = {"ops": int(op_name[len("bulk_segment["):-1])}
+        except ValueError:
+            pass
+    else:
+        cat = "operator"
+    add_span(op_name, (t_start - _t0) * 1e6, (t_end - _t0) * 1e6, cat=cat,
+             args=args)
 
 
 def add_span(name, t_start_us, t_end_us, cat="operator", tid=None,

@@ -34,7 +34,9 @@ def _stub(mod, monkeypatch, values):
 _STUB_VALUES = {"train": 100.0, "infer": 200.0, "bert": 300.0,
                 "llama": 400.0, "dispatch_eager": 500.0,
                 "dispatch_eager_notelemetry": 550.0,
-                "dispatch_bulked": 600.0}
+                "dispatch_bulked": 600.0,
+                "dispatch_bulked_train": 650.0,
+                "dispatch_bulked_long": 700.0}
 
 
 def test_single_metric_line(monkeypatch, capsys):
@@ -71,12 +73,17 @@ def test_default_mode_emits_all_metrics_in_one_line(monkeypatch, capsys):
                      "llama_decoder_train_throughput",
                      "imperative_dispatch_eager",
                      "imperative_dispatch_eager_notelemetry",
-                     "imperative_dispatch_bulked"]
+                     "imperative_dispatch_bulked",
+                     "imperative_dispatch_bulked_train",
+                     "imperative_dispatch_bulked_long"]
     assert all("platform" in m and "fallback" in m for m in rec["metrics"])
-    # the op-bulking microbench rides in the metrics array (ISSUE 4)
+    # the op-bulking microbench rides in the metrics array (ISSUE 4);
+    # the recorded-chain and 64-op variants joined in ISSUE 6
     by_name = {m["metric"]: m for m in rec["metrics"]}
     assert by_name["imperative_dispatch_eager"]["value"] == 500.0
     assert by_name["imperative_dispatch_bulked"]["value"] == 600.0
+    assert by_name["imperative_dispatch_bulked_train"]["value"] == 650.0
+    assert by_name["imperative_dispatch_bulked_long"]["value"] == 700.0
 
 
 def test_budget_exhaustion_marks_skipped(monkeypatch, capsys):
@@ -89,7 +96,7 @@ def test_budget_exhaustion_marks_skipped(monkeypatch, capsys):
                       if ln.startswith("{")][-1])
     assert rec["value"] == 100.0  # headline always measured
     skipped = [m for m in rec["metrics"] if m.get("skipped")]
-    assert len(skipped) == 6
+    assert len(skipped) == 8
     assert all(m["value"] == 0.0 for m in skipped)
 
 
@@ -114,10 +121,14 @@ def test_failed_benchmark_emits_zero_not_crash(monkeypatch, capsys):
             None),
         "dispatch_bulked": (boom, "imperative_dispatch_bulked", "ops/sec",
                             None),
+        "dispatch_bulked_train": (
+            boom, "imperative_dispatch_bulked_train", "ops/sec", None),
+        "dispatch_bulked_long": (
+            boom, "imperative_dispatch_bulked_long", "ops/sec", None),
     })
     monkeypatch.setattr(sys, "argv", ["bench.py"])
     mod.main()
     rec = json.loads([ln for ln in capsys.readouterr().out.splitlines()
                       if ln.startswith("{")][-1])
     assert rec["value"] == 0.0 and rec["fallback"] is True
-    assert len(rec["metrics"]) == 7
+    assert len(rec["metrics"]) == 9

@@ -1,4 +1,4 @@
-"""Op bulking (BulkEngine / engine.bulk): semantics pinned by ISSUE 4.
+"""Op bulking (BulkEngine / engine.bulk): semantics pinned by ISSUE 4/6.
 
 The contract under test: consecutive deferrable imperative ops collect
 into ONE engine push (a jitted, XLA-fused segment), lazy outputs carry
@@ -6,6 +6,12 @@ eval_shape avals until a sync point flushes them, numerics and version
 bumps are indistinguishable from the eager engine, failed segments poison
 their outputs through ``Var.set_exception`` (async rethrow), and repeated
 identical streams hit the segment cache without retracing.
+
+ISSUE 6 extensions: BulkEngine is the DEFAULT engine (cap 64),
+``autograd.record()`` no longer flushes at the boundary (taped ops defer
+and the tape resolves promises at backward time, with grads
+bitwise-identical to eager), dead input buffers are donated to XLA, and
+the segment cache is size-tiered with per-tier LRU budgets.
 """
 import os
 
@@ -91,19 +97,91 @@ def test_segment_flushes_at_every_sync_point(eng, sync):
         assert np.asarray(y.data()).flat[-1] == 4.0
 
 
-def test_autograd_recording_boundary_flushes(eng):
+def test_autograd_recording_does_not_flush(eng):
+    # ISSUE 6: the record() boundary is NOT a segment boundary — taped ops
+    # defer too, and backward resolves the promises by flushing on demand
     w = nd.ones((3,))
     w.attach_grad()
     with engine_mod.bulk(64):
         c = nd.ones((3,)) * 2.0 + 1.0
         p0 = eng.stats.ops_pushed
         with autograd.record():
-            # entering the scope flushed the pending segment; ops in here
-            # run eagerly (the tape needs per-op vjps)
-            assert eng.stats.ops_pushed == p0 + 1
+            assert eng.stats.ops_pushed == p0, \
+                "entering record() must not flush the pending segment"
             loss = (w * c).sum()
-    loss.backward()
+        assert eng.stats.ops_pushed == p0, \
+            "leaving record() must not flush either"
+        s0 = eng.stats.bulk_segments
+        loss.backward()  # backward-triggered flush: ONE fused push
+        assert eng.stats.bulk_segments == s0 + 1
     np.testing.assert_allclose(w.grad.asnumpy(), 3.0)
+
+
+def _recorded_chain_grads(x_np, bulk_cap, n=20):
+    x = nd.array(x_np)
+    x.attach_grad()
+    with engine_mod.bulk(bulk_cap):
+        with autograd.record():
+            y = x
+            for i in range(n):
+                y = y * 1.25 if i % 2 == 0 else y + 0.5
+            loss = (y * y).sum()
+        loss.backward()
+    return x.grad.asnumpy(), y.asnumpy()
+
+
+def test_recorded_20op_chain_one_segment_bitwise_grads(eng):
+    xv = np.random.RandomState(11).randn(8, 8).astype(np.float32)
+    g_eager, y_eager = _recorded_chain_grads(xv, 0)
+    s0 = eng.stats.bulk_segments
+    g_bulk, y_bulk = _recorded_chain_grads(xv, 64)
+    # chain + loss deferred into ONE segment, flushed by backward
+    # (array/grad-buffer creation pushes eagerly and forms no segment)
+    assert eng.stats.bulk_segments == s0 + 1
+    assert np.array_equal(y_bulk, y_eager), \
+        "bulked recorded forward differs bitwise from eager"
+    assert np.array_equal(g_bulk, g_eager), \
+        "grads through a bulked forward differ bitwise from eager"
+
+
+def test_recorded_mixed_ops_bitwise_grads(eng):
+    # matmul + tanh + broadcast: the exact-compile path must pin every
+    # op's rounding, not just elementwise chains
+    rs = np.random.RandomState(3)
+    xv, wv = (rs.randn(8, 8).astype(np.float32) for _ in range(2))
+
+    def run(cap):
+        x, w = nd.array(xv), nd.array(wv)
+        x.attach_grad()
+        w.attach_grad()
+        with engine_mod.bulk(cap):
+            with autograd.record():
+                h = nd.tanh(nd.dot(x, w)) * 1.25 + 0.5
+                loss = (h * h).sum()
+            loss.backward()
+        return x.grad.asnumpy(), w.grad.asnumpy()
+
+    ge, gb = run(0), run(64)
+    assert np.array_equal(ge[0], gb[0]) and np.array_equal(ge[1], gb[1])
+
+
+def test_higher_order_grads_through_segment_smoke(eng):
+    xv = np.random.RandomState(5).randn(4, 4).astype(np.float32)
+
+    def run(cap):
+        x = nd.array(xv)
+        x.attach_grad()
+        with engine_mod.bulk(cap):
+            with autograd.record():
+                y = x * x * x
+                loss = y.sum()
+            g = autograd.grad(loss, [x], create_graph=True)[0]
+            with autograd.record():
+                g2 = (g * g).sum()
+            g2.backward()
+        return x.grad.asnumpy()
+
+    assert np.array_equal(run(0), run(64))
 
 
 def test_var_version_bumps_match_eager(eng):
@@ -317,6 +395,168 @@ def test_deferred_value_survives_source_overwrite(eng):
     np.testing.assert_allclose(a.asnumpy(), 100.0)
 
 
+def test_dead_rebind_buffers_are_donated(eng):
+    d0 = eng.stats.bulk_donated
+    with engine_mod.bulk(16):
+        a = nd.ones((16, 16))
+        a.wait_to_read()
+        for _ in range(4):
+            a = a + 1.0  # each rebind kills the previous supplier
+        a.wait_to_read()
+    assert eng.stats.bulk_donated > d0
+    np.testing.assert_allclose(a.asnumpy(), 5.0)
+
+
+def test_donation_never_aliases_live_buffer(eng):
+    # a foreign handle to the input buffer (detach/copy view, another
+    # tape's primal, ...) must veto donation: read-after-donate would
+    # observe XLA reusing the storage for an output
+    with engine_mod.bulk(16):
+        z = nd.ones((8, 8)) + 1.0
+        z.wait_to_read()
+        raw = z.data()              # foreign reference to the same buffer
+        expect = np.asarray(raw).copy()
+        z = z + 1.0                 # supplier moves on: donation candidate
+        z = z + 1.0
+        z.wait_to_read()
+    assert np.array_equal(np.asarray(raw), expect), \
+        "donated a buffer that was still externally referenced"
+
+
+def test_live_ndarray_input_is_never_donated(eng):
+    with engine_mod.bulk(16):
+        z = nd.ones((8, 8)) * 2.0
+        z.wait_to_read()
+        # (the ones-temporary above WAS legitimately donated; snapshot now)
+        d0 = eng.stats.bulk_donated
+        w = z + 1.0                 # z stays live: supplier not dead
+        w = w + 1.0
+        w.wait_to_read()
+    np.testing.assert_allclose(z.asnumpy(), 2.0)
+    assert eng.stats.bulk_donated == d0
+
+
+def test_default_engine_is_bulk_with_64_cap(monkeypatch):
+    monkeypatch.delenv("MXNET_ENGINE_TYPE", raising=False)
+    monkeypatch.delenv("MXNET_EXEC_BULK_EXEC_MAX_NODE", raising=False)
+    old = Engine._instance
+    Engine._instance = None
+    try:
+        e = Engine.get()
+        assert e.kind == "BulkEngine", "BulkEngine must be the default"
+        assert e._bulk_max == 64
+        x = nd.ones((3,))
+        x.wait_to_read()
+        p0 = e.stats.ops_pushed
+        y = _chain(x, n=70)
+        y.wait_to_read()
+        # 70 ops at the 64 cap -> segments of 64 + 6
+        assert e.stats.ops_pushed - p0 == 2
+    finally:
+        Engine._instance = old
+
+
+def test_segment_cache_tier_eviction(eng, monkeypatch):
+    import collections
+
+    monkeypatch.setattr(engine_mod, "_SEG_TIER_BUDGETS", (1, 1, 1, 1))
+    monkeypatch.setattr(engine_mod, "_SEG_TIERS",
+                        tuple(collections.OrderedDict() for _ in range(4)))
+    stats = tuple({"hits": 0, "misses": 0, "evictions": 0}
+                  for _ in range(4))
+    monkeypatch.setattr(engine_mod, "_seg_tier_stats", stats)
+
+    def run(mult):
+        with engine_mod.bulk(8):
+            y = nd.ones((4,)) * mult + 1.0
+        y.wait_to_read()
+
+    run(2.0)
+    run(2.0)   # same structure: cache hit in the le8 tier
+    assert stats[0]["hits"] == 1 and stats[0]["misses"] == 1
+    run(3.0)   # different attrs: new key evicts the old (budget 1)
+    assert stats[0]["evictions"] == 1
+    run(2.0)   # the evicted structure misses again
+    assert stats[0]["misses"] == 3
+    assert len(engine_mod._SEG_TIERS[0]) == 1
+
+
+def test_tier_budget_env_knob(monkeypatch):
+    monkeypatch.setenv("MXNET_EXEC_BULK_SEG_CACHE_BUDGETS", "2,3,4,5")
+    assert engine_mod._parse_tier_budgets() == (2, 3, 4, 5)
+    monkeypatch.delenv("MXNET_EXEC_BULK_SEG_CACHE_BUDGETS")
+    assert engine_mod._parse_tier_budgets() == (128, 64, 32, 32)
+
+
+def test_nested_bulk_zero_flushes_pending(eng):
+    # ISSUE 6 bugfix: bulk(0) must flush the PENDING segment on entry,
+    # not merely stop new deferrals
+    with engine_mod.bulk(16):
+        a = nd.ones((3,)) + 1.0    # ones pushes eagerly; +1.0 defers
+        p1 = eng.stats.ops_pushed
+        with engine_mod.bulk(0):
+            assert eng.stats.ops_pushed == p1 + 1, \
+                "entering bulk(0) must flush the pending segment"
+            b = a * 2.0            # dispatches eagerly inside the scope
+            assert eng.stats.ops_pushed == p1 + 2
+        c = b + 1.0                # outer scope resumes deferral
+        assert eng.stats.ops_pushed == p1 + 2
+    np.testing.assert_allclose(c.asnumpy(), 5.0)
+
+
+def test_set_bulk_size_zero_flushes_pending(monkeypatch):
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "BulkEngine")
+    old = Engine._instance
+    Engine._instance = None
+    try:
+        e = Engine.get()
+        x = nd.ones((3,))
+        x.wait_to_read()
+        p0 = e.stats.ops_pushed
+        y = x + 1.0                # deferred under the default
+        assert e.stats.ops_pushed == p0
+        prev = engine_mod.set_bulk_size(0)
+        assert e.stats.ops_pushed == p0 + 1, \
+            "set_bulk_size(0) must flush the pending segment"
+        z = y * 2.0                # eager from here on
+        assert e.stats.ops_pushed == p0 + 2
+        engine_mod.set_bulk_size(prev)
+        np.testing.assert_allclose(z.asnumpy(), 4.0)
+    finally:
+        Engine._instance = old
+
+
+def test_profile_bulk_env_keeps_segments_fused(monkeypatch):
+    # MXNET_PROFILE_BULK=1: the profiler hook no longer disables implicit
+    # bulking; the trace gets ONE cat="bulk" span with the op count
+    from mxnet_tpu import profiler
+
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "BulkEngine")
+    monkeypatch.setenv("MXNET_PROFILE_BULK", "1")
+    old = Engine._instance
+    Engine._instance = None
+    try:
+        e = Engine.get()
+        x = nd.ones((4,))
+        x.wait_to_read()
+        profiler.set_state("run")
+        try:
+            s0 = e.stats.bulk_segments
+            y = _chain(x, n=6)
+            y.wait_to_read()
+            assert e.stats.bulk_segments == s0 + 1
+        finally:
+            profiler.set_state("stop")
+        import json
+
+        events = json.loads(profiler.dumps(aggregate=False))
+        assert any(ev["cat"] == "bulk" and ev["name"] == "bulk_segment[6]"
+                   and ev.get("args", {}).get("ops") == 6
+                   for ev in events)
+    finally:
+        Engine._instance = old
+
+
 def test_profiler_sees_one_named_segment_op(eng, tmp_path):
     from mxnet_tpu import profiler
 
@@ -335,3 +575,26 @@ def test_profiler_sees_one_named_segment_op(eng, tmp_path):
     segs = [ev for ev in events if ev["name"].startswith("bulk_segment[")]
     assert any(ev["name"] == "bulk_segment[6]" and ev["cat"] == "bulk"
                for ev in segs)
+
+
+def test_trainer_donation_drains_pending_segment(eng):
+    # Trainer.step's fused update DONATES old weight/state buffers to
+    # XLA.  A recorded forward whose output is never read leaves its
+    # segment pending while holding the old weight as an ext input —
+    # the step must drain that segment (flush_if_referencing) or the
+    # segment's eventual flush reads a deleted array.
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize(mx.init.Constant(0.5))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = nd.array([[1.0, 2.0]])
+    with autograd.record():
+        y = net(x)          # y is never read: segment stays pending
+    y.backward()            # vjp inputs are concrete — still no flush
+    trainer.step(1)         # donates the old weight buffer
+    y.wait_to_read()        # flushes the segment: must not hit a dead array
+    np.testing.assert_allclose(net.weight.data().asnumpy(), [[0.4, 0.3]],
+                               rtol=1e-5)

@@ -143,17 +143,24 @@ def test_engine_families_track_op_stream():
 
 
 def test_compile_histogram_and_jit_cache():
+    from mxnet_tpu import engine
+
+    # per-op compile tracking is an eager-dispatch surface; under the
+    # BulkEngine default relu would ride a segment and compile as
+    # op="bulk_segment" instead, so pin the eager path
     s0 = telemetry.snapshot()
-    x = nd.ones((17, 3))  # fresh shape: forces one XLA compile
-    y = nd.relu(x)
-    y.wait_to_read()
+    with engine.bulk(0):
+        x = nd.ones((17, 3))  # fresh shape: forces one XLA compile
+        y = nd.relu(x)
+        y.wait_to_read()
     s1 = telemetry.snapshot()
     assert _series_value(s1, "mxnet_compiles_total", op="relu") > \
         _series_value(s0, "mxnet_compiles_total", op="relu")
     assert _series_value(s1, "mxnet_compile_seconds", op="relu") > 0
     # same shape again: cache hit, no new compile
-    z = nd.relu(nd.ones((17, 3)))
-    z.wait_to_read()
+    with engine.bulk(0):
+        z = nd.relu(nd.ones((17, 3)))
+        z.wait_to_read()
     s2 = telemetry.snapshot()
     assert _series_value(s2, "mxnet_compiles_total", op="relu") == \
         _series_value(s1, "mxnet_compiles_total", op="relu")
