@@ -3,8 +3,8 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
 "platform", "fallback", "metrics"} — the headline ResNet-50 train
 number at top level, plus a "metrics" array carrying the secondary
-benchmarks (inference, BERT, Llama) so one driver artifact records the
-whole headline set.  "platform" is the PJRT platform the numbers were
+benchmarks (inference, BERT, Llama, dispatch, cold start) so one driver
+artifact records the whole headline set.  "platform" is the PJRT platform the numbers were
 measured on and "fallback" is True iff the accelerator was unreachable
 and the run degraded to CPU — a fallback number can never masquerade as
 a chip number again.
@@ -543,6 +543,167 @@ def _run_dispatch_bulked_long(platform):
     return _dispatch_rate(None, chain_len=64, label="dispatch_bulked_long")
 
 
+def _cold_probe(workload):
+    """Subprocess entry for the cold-start benchmark (`--cold-probe <w>`).
+
+    Times compile+first-step for a small training workload in THIS fresh
+    process and prints a parseable ``COLD_START_SECONDS=`` line on
+    stdout.  The parent (``_run_cold_start``) owns the compilation-cache
+    contract through the ``MXNET_COMPILE_CACHE*`` env vars, which
+    ``import mxnet_tpu`` applies (compile_cache.configure) — so this
+    path must NOT go through ``_init_backend``, whose workspace-local
+    ``.jax_cache`` override would shadow the parent's cache dir and make
+    every "cold" run warm.
+    """
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+
+    platform = jax.default_backend()
+    on_accel = platform not in ("cpu",)
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    if workload == "resnet50":
+        from mxnet_tpu.gluon.model_zoo import vision
+
+        # CPU config leans bigger than the throughput bench's: the warm
+        # process pays a fixed ~4s of tracing either way, so the compile
+        # share must dominate for the cold/warm ratio to mean anything
+        batch, image = (32, 224) if on_accel else (4, 64)
+        net = vision.resnet50_v1()
+        x = rng.rand(batch, 3, image, image).astype(np.float32)
+        y = rng.randint(0, 1000, batch).astype(np.float32)
+    elif workload == "bert":
+        from mxnet_tpu.gluon.model_zoo import bert
+
+        vocab = 1000
+        batch, seqlen = (8, 64) if on_accel else (2, 16)
+        inner = bert.bert_small(vocab_size=vocab)
+
+        class MLM(gluon.HybridBlock):
+            def __init__(self, net):
+                super().__init__()
+                self.inner = net
+
+            def hybrid_forward(self, F, toks):
+                _, _, logits = self.inner(toks)
+                return F.reshape(logits, shape=(-1, vocab))
+
+        net = MLM(inner)
+        x = rng.randint(0, vocab, (batch, seqlen)).astype(np.int32)
+        y = rng.randint(0, vocab, batch * seqlen).astype(np.float32)
+    elif workload == "llama":
+        from mxnet_tpu.gluon.model_zoo import llama
+
+        vocab = 512
+        batch, seqlen = (8, 64) if on_accel else (2, 16)
+        inner = llama.llama_small()
+
+        class LM(gluon.HybridBlock):
+            def __init__(self, net):
+                super().__init__()
+                self.inner = net
+
+            def hybrid_forward(self, F, toks):
+                return F.reshape(self.inner(toks), shape=(-1, vocab))
+
+        net = LM(inner)
+        x = rng.randint(0, vocab, (batch, seqlen)).astype(np.int32)
+        y = rng.randint(0, vocab, batch * seqlen).astype(np.float32)
+    else:
+        raise SystemExit("unknown cold-probe workload %r" % (workload,))
+    net.initialize(mx.init.Xavier())
+    step = parallel.JitTrainStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        "sgd", {"learning_rate": 0.1})
+    # the warm process exercises BOTH halves of the cold-start fix: the
+    # persistent compilation cache (jit retraces, compile comes from
+    # disk) and the AOT executable the cold process exported (no trace
+    # at all — load_executable + first step is the whole startup)
+    cache_dir = os.environ.get("MXNET_COMPILE_CACHE_DIR", "")
+    bundle = os.path.join(cache_dir, "%s_step.mxaot" % workload) \
+        if cache_dir else ""
+    if bundle and os.path.exists(bundle):
+        t0 = time.perf_counter()
+        step.load_executable(bundle, x, y)
+        loss = step.step(x, y)
+        jax.block_until_ready(loss)
+    else:
+        t0 = time.perf_counter()
+        loss = step.step(x, y)
+        jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    if bundle and not os.path.exists(bundle):
+        step.save_executable(bundle)  # untimed: arms the warm process
+    _log("%s cold probe (platform=%s): %.3fs loss=%.4f"
+         % (workload, platform, dt, float(loss)))
+    print("COLD_START_SECONDS=%.3f" % dt, flush=True)
+
+
+def _run_cold_start(workload):
+    """`<workload>_cold_start_seconds`: compile+first-step wall time in a
+    FRESH process — the number the persistent compilation cache exists
+    to kill (docs/perf.md "cold start").
+
+    Spawns ``--cold-probe <workload>`` twice against ONE empty temp
+    cache dir: the first (cold) process pays real XLA compiles and
+    populates the cache; the second (warm) process shares the dir and
+    should spend ~0 in the compiler.  The metric value is the COLD
+    number; the warm number and speedup ride along as extra fields.
+    """
+    import shutil
+    import subprocess
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="mxnet-coldstart-")
+    env = dict(os.environ)
+    env.update({
+        "MXNET_COMPILE_CACHE": "1",
+        "MXNET_COMPILE_CACHE_DIR": cache_dir,
+        "MXNET_COMPILE_CACHE_MIN_SECS": "0",
+    })
+    script = os.path.abspath(__file__)
+
+    def probe(label):
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, script, "--cold-probe", workload],
+            env=env, capture_output=True, text=True, timeout=900)
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stderr[-2000:] + "\n")
+            raise RuntimeError("%s %s probe exited %d"
+                               % (workload, label, proc.returncode))
+        for line in proc.stdout.splitlines():
+            if line.startswith("COLD_START_SECONDS="):
+                secs = float(line.split("=", 1)[1])
+                _log("%s %s process: %.3fs compile+first step (wall %.1fs)"
+                     % (workload, label, secs, time.perf_counter() - t0))
+                return secs
+        sys.stderr.write(proc.stderr[-2000:] + "\n")
+        raise RuntimeError("%s %s probe printed no COLD_START_SECONDS"
+                           % (workload, label))
+
+    try:
+        cold = probe("cold")
+        warm = probe("warm")
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    return {"value": cold, "warm_seconds": round(warm, 3),
+            "cold_warm_speedup": round(cold / warm, 2) if warm > 0 else 0.0}
+
+
+def _run_cold_resnet50(platform):
+    return _run_cold_start("resnet50")
+
+
+def _run_cold_bert(platform):
+    return _run_cold_start("bert")
+
+
+def _run_cold_llama(platform):
+    return _run_cold_start("llama")
+
+
 _SPECS = {
     # name -> (runner, metric, unit, baseline or None)
     "train": (_run, "resnet50_train_throughput", "images/sec",
@@ -565,6 +726,15 @@ _SPECS = {
     "dispatch_bulked_long": (
         _run_dispatch_bulked_long, "imperative_dispatch_bulked_long",
         "ops/sec", None),
+    # cold-start seconds: LOWER is better (the other metrics are rates);
+    # value is the cold-process number, warm_seconds/cold_warm_speedup
+    # ride along as extra record fields
+    "cold_resnet50": (_run_cold_resnet50, "resnet50_cold_start_seconds",
+                      "seconds", None),
+    "cold_bert": (_run_cold_bert, "bert_cold_start_seconds", "seconds",
+                  None),
+    "cold_llama": (_run_cold_llama, "llama_cold_start_seconds", "seconds",
+                   None),
 }
 
 
@@ -589,7 +759,11 @@ def _measure(name, platform, fallback):
                 time.sleep(15)
             else:
                 _log("%s benchmark failed twice; emitting value 0" % name)
-    return {
+    extra = {}
+    if isinstance(value, dict):  # cold-start runners return value+extras
+        extra = {k: v for k, v in value.items() if k != "value"}
+        value = value["value"]
+    rec = {
         "metric": metric,
         "value": round(value, 2),
         "unit": unit,
@@ -597,9 +771,14 @@ def _measure(name, platform, fallback):
         "platform": platform,
         "fallback": fallback,
     }
+    rec.update(extra)
+    return rec
 
 
 def main():
+    if len(sys.argv) >= 3 and sys.argv[1] == "--cold-probe":
+        _cold_probe(sys.argv[2])  # subprocess mode: no _init_backend
+        return
     t_start = time.perf_counter()
     requested = [a for a in sys.argv[1:] if a in _SPECS and a != "train"]
     try:
@@ -623,7 +802,8 @@ def main():
     metrics = [head]
     for name in ("infer", "bert", "llama", "dispatch_eager",
                  "dispatch_eager_notelemetry", "dispatch_bulked",
-                 "dispatch_bulked_train", "dispatch_bulked_long"):
+                 "dispatch_bulked_train", "dispatch_bulked_long",
+                 "cold_resnet50", "cold_bert", "cold_llama"):
         elapsed = time.perf_counter() - t_start
         if elapsed > budget:
             _log("budget %.0fs spent (%.0fs elapsed); skipping %s"

@@ -108,55 +108,22 @@ _honor_int64_tensor_size()
 
 
 def _honor_compile_cache():
-    """Persistent XLA executable cache, ON by default.
+    """Persistent XLA executable cache, ON by default (accelerator procs).
 
-    ``MXNET_COMPILE_CACHE=0`` disables; ``MXNET_COMPILE_CACHE_DIR`` picks the
-    directory (default ``$XDG_CACHE_HOME/mxnet_tpu/xla_cache``);
-    ``MXNET_COMPILE_CACHE_MIN_SECS`` sets the minimum compile time worth
-    persisting (default 1.0 — sub-second compiles cost more to serialize
-    than to redo).  See docs/env_vars.md.
+    ``MXNET_COMPILE_CACHE=0`` disables, ``=1`` forces on, a *path* value
+    forces on with that directory; ``MXNET_COMPILE_CACHE_DIR`` /
+    ``MXNET_COMPILE_CACHE_MIN_SECS`` / ``MXNET_COMPILE_CACHE_BUDGET_MB``
+    refine it.  See docs/env_vars.md and mxnet_tpu/compile_cache.py.
 
     The reference pays per-process graph-init cost in milliseconds (its
     kernels are precompiled into libmxnet.so); under XLA a cold llama train
     step is ~2 minutes of compile, so without this every NEW process pays it
     (round-4 verdict: the cache was wired up in bench.py only).
     """
-    import os
-
-    mode = os.environ.get("MXNET_COMPILE_CACHE", "auto").lower()
-    if mode in ("0", "false"):
-        return
     try:
-        import jax
+        from . import compile_cache
 
-        if mode == "auto" and not os.environ.get("MXNET_COMPILE_CACHE_DIR"):
-            # default-on for ACCELERATOR processes only: XLA:CPU cache
-            # entries are AOT objects keyed without host machine features —
-            # an entry compiled elsewhere (e.g. through the device tunnel's
-            # cpu staging platform) can SIGILL a pure-CPU process that
-            # loads it (observed killing dist-kvstore servers).  CPU
-            # compiles are cheap; TPU compiles are the minutes-long ones
-            # worth persisting.  Set MXNET_COMPILE_CACHE=1 or an explicit
-            # _DIR to opt a CPU process in.
-            plats = str(getattr(jax.config, "jax_platforms", "") or "")
-            primary = plats.split(",")[0].strip() if plats else ""
-            # unknown/unset platform counts as CPU: a host with no
-            # accelerator plugin auto-selects cpu with an EMPTY config,
-            # and enabling the cache there reopens the AOT-SIGILL hazard
-            if primary in ("cpu", ""):
-                return
-
-        cache_dir = os.environ.get("MXNET_COMPILE_CACHE_DIR")
-        if not cache_dir:
-            base = (os.environ.get("XDG_CACHE_HOME")
-                    or os.path.join(os.path.expanduser("~"), ".cache"))
-            cache_dir = os.path.join(base, "mxnet_tpu", "xla_cache")
-        os.makedirs(cache_dir, exist_ok=True)
-        min_secs = float(os.environ.get("MXNET_COMPILE_CACHE_MIN_SECS", "1.0"))
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                          min_secs)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        compile_cache.configure()
     except Exception:
         pass  # a cache is an optimization; never break import over it
 
@@ -207,6 +174,7 @@ from . import visualization  # noqa: F401
 from .monitor import Monitor  # noqa: F401
 from . import profiler  # noqa: F401
 from . import telemetry  # noqa: F401
+from . import compile_cache  # noqa: F401
 from . import test_utils  # noqa: F401
 from . import amp  # noqa: F401
 from . import contrib  # noqa: F401

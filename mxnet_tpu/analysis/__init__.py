@@ -10,7 +10,7 @@ and framework code keeps two contracts:
 2. every device→host sync on the eager path is *intentional*, because each
    one stalls the PJRT stream the engine relies on for overlap.
 
-This package enforces both, statically and at runtime, with seven passes:
+This package enforces both, statically and at runtime, with eight passes:
 
 * **tracing-safety lint** (``TS1xx``, ``tracing_safety``) — AST pass over
   ``hybrid_forward`` bodies and jit-wrapped functions: data-dependent
@@ -42,6 +42,12 @@ This package enforces both, statically and at runtime, with seven passes:
   re-check loop with no deadline: the exact silent-hang shape that
   wedged the distributed tier before the fault-tolerance work
   (``docs/fault_tolerance.md``).
+* **compile-cache key hygiene** (``CS8xx``, ``cache_keys``) — op attrs
+  that fragment the executable cache: set/dict/fresh-array/lambda attr
+  values are unhashable or identity-keyed, so the call retraces every
+  time and never hits the persistent disk cache
+  (``compile_cache.py``); explicit ``attr=None`` needlessly splits
+  entries (advisory).
 
 CLI: ``python tools/mxlint.py mxnet_tpu/ examples/`` (the repo's own source
 is a permanent lint target; intentional syncs carry

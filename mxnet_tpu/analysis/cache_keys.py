@@ -1,0 +1,149 @@
+"""CS8xx — compile-cache key hygiene: op attrs that fragment the cache.
+
+Every imperative op call is dispatched through ``ops.registry._push_op``,
+and the op's keyword attrs become part of the jit cache key (and, since
+the persistent compilation cache, part of the cross-process disk key).
+An attr value that hashes by identity or not at all silently turns the
+executable cache into a per-call-site miss machine:
+
+* ``CS801`` — unhashable attr value: a set literal/comprehension or a
+  fresh ``np``/``jnp``/``nd`` array constructed in the call.  Sets raise
+  ``TypeError`` at key time; a fresh array object per call keys by
+  identity, so EVERY call is a cache miss that recompiles (and never
+  hits the persistent disk cache).
+* ``CS802`` — identity-keyed attr: a ``lambda`` passed as an attr.
+  Each evaluation of the call site mints a new function object → new
+  key → retrace, even though the behaviour is identical.
+* ``CS803`` — unfrozen dict attr: a dict literal/comprehension as an
+  attr value.  Dicts are unhashable; freeze to a sorted tuple of items
+  (``tuple(sorted(d.items()))``) before it reaches ``_jitted``.
+* ``CS804`` — explicit ``None`` attr (advisory, ``--strict``): passing
+  ``attr=None`` still enters the cache key, so the call site compiles a
+  SEPARATE executable from an otherwise-identical site that omits the
+  attr.  Drop the keyword to share the entry.
+
+Heuristic: keyword arguments of op invocations — calls through ``F.<op>``
+(the trace-time namespace), ``nd.<op>`` / ``sym.<op>`` / ``mx.nd.<op>``
+(the eager/symbolic frontends), and direct ``_push_op(...)`` calls.
+Positional args are data (traced by aval, not by value) and never flagged.
+"""
+from __future__ import annotations
+
+import ast
+
+from .findings import Finding
+
+# roots whose attribute calls are op invocations with cache-keyed attrs
+_OP_NAMESPACES = frozenset({"F", "nd", "sym"})
+_ARRAY_ROOTS = frozenset({"np", "numpy", "jnp", "nd", "mx", "onp"})
+_ARRAY_FUNCS = frozenset({"array", "asarray", "ones", "zeros", "full",
+                          "arange", "empty"})
+
+
+def _root_name(node):
+    """Leftmost ``Name`` of an attribute chain (``mx.nd.op`` → ``mx``)."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _chain_attrs(node):
+    """Attribute names of a chain, outermost last (``mx.nd.op`` →
+    ``["nd", "op"]``)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    return parts[::-1]
+
+
+def _is_op_call(call):
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id in ("_push_op", "push_op")
+    if not isinstance(fn, ast.Attribute):
+        return False
+    root = _root_name(fn)
+    if root in _OP_NAMESPACES:
+        return True
+    # mx.nd.op / mx.sym.op: namespace appears inside the chain
+    chain = _chain_attrs(fn)[:-1]  # drop the op name itself
+    return root == "mx" and any(a in ("nd", "sym") for a in chain)
+
+
+def _is_fresh_array_call(node):
+    """``np.array(...)`` / ``jnp.asarray(...)`` / ``nd.ones(...)`` etc."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)):
+        return False
+    return (node.func.attr in _ARRAY_FUNCS
+            and _root_name(node.func) in _ARRAY_ROOTS)
+
+
+def _is_ctor_call(node, name):
+    """``set(...)`` / ``dict(...)`` builtin-constructor call."""
+    return (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id == name)
+
+
+class _CacheKeyChecker(ast.NodeVisitor):
+    def __init__(self, path, findings, strict):
+        self.path = path
+        self.findings = findings
+        self.strict = strict
+
+    def _flag(self, node, rule, msg):
+        self.findings.append(Finding(
+            self.path, node.lineno, getattr(node, "col_offset", 0),
+            rule, msg))
+
+    def visit_Call(self, node):
+        if _is_op_call(node):
+            for kw in node.keywords:
+                if kw.arg is None:  # **kwargs passthrough: opaque, skip
+                    continue
+                v = kw.value
+                if isinstance(v, (ast.Set, ast.SetComp)) \
+                        or _is_ctor_call(v, "set"):
+                    self._flag(v, "CS801",
+                               "op attr `%s` is a set literal — unhashable "
+                               "in the executable cache key (TypeError at "
+                               "dispatch); use a sorted tuple" % kw.arg)
+                elif _is_fresh_array_call(v):
+                    self._flag(v, "CS801",
+                               "op attr `%s` constructs a fresh array per "
+                               "call — keyed by object identity, every "
+                               "call misses the executable cache and "
+                               "recompiles (and can never hit the "
+                               "persistent disk cache); pass data "
+                               "positionally or hoist a hashable constant"
+                               % kw.arg)
+                elif isinstance(v, ast.Lambda):
+                    self._flag(v, "CS802",
+                               "op attr `%s` is a lambda — a new function "
+                               "object (new cache key) per evaluation; "
+                               "hoist it to a module-level def so the key "
+                               "is stable" % kw.arg)
+                elif isinstance(v, (ast.Dict, ast.DictComp)) \
+                        or _is_ctor_call(v, "dict"):
+                    self._flag(v, "CS803",
+                               "op attr `%s` is a dict — unhashable in "
+                               "the executable cache key; freeze to "
+                               "tuple(sorted(d.items()))" % kw.arg)
+                elif (self.strict and isinstance(v, ast.Constant)
+                      and v.value is None):
+                    self._flag(v, "CS804",
+                               "op attr `%s=None` still enters the cache "
+                               "key — this call site compiles a separate "
+                               "executable from one that omits the attr; "
+                               "drop the keyword to share the entry"
+                               % kw.arg)
+        self.generic_visit(node)
+
+
+def run(path, tree, findings=None, strict=False):
+    """Run the CS pass over one parsed module; returns the findings list."""
+    if findings is None:
+        findings = []
+    _CacheKeyChecker(path, findings, strict).visit(tree)
+    return findings

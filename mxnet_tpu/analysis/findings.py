@@ -16,6 +16,10 @@ Rule ID bands (stable, documented in ``docs/static_analysis.md``):
   ``dist_kvstore.py``, which raise with the same vocabulary)
 * ``RB7xx`` — robustness (static; unbounded condition-wait loops that
   turn a dead peer into a silent hang)
+* ``CS8xx`` — compile-cache key hygiene (static AST over op invocations;
+  attr values that make the executable cache key unhashable or
+  identity-keyed fragment both the in-process jit cache and the
+  persistent disk cache — see ``compile_cache.py``)
 """
 from __future__ import annotations
 
@@ -111,6 +115,22 @@ RULES = {
               "re-check loop with no deadline — a dead peer re-waits "
               "forever (silent hang); track a monotonic deadline and "
               "raise naming what's missing"),
+    "CS801": ("unhashable-op-attr", True,
+              "op attr is a set literal or a fresh np/jnp/nd array — "
+              "unhashable or identity-keyed in the executable cache key, "
+              "so every call recompiles and never hits the persistent "
+              "disk cache"),
+    "CS802": ("identity-keyed-attr", True,
+              "op attr is a lambda — each evaluation mints a new function "
+              "object (new cache key) → retrace despite identical "
+              "behaviour; hoist to a module-level def"),
+    "CS803": ("unfrozen-dict-attr", True,
+              "op attr is a dict literal — unhashable in the executable "
+              "cache key; freeze to tuple(sorted(d.items()))"),
+    "CS804": ("explicit-none-attr", False,
+              "attr passed explicitly as None enters the cache key and "
+              "compiles a separate executable from call sites that omit "
+              "it (advisory, enabled with --strict)"),
 }
 
 # rule id -> severity; rules not listed are "error".  Ordering:
@@ -123,6 +143,9 @@ SEVERITY = {
     "RC302": "note",
     "GS504": "warn",
     "GS505": "warn",
+    "CS802": "warn",
+    "CS803": "warn",
+    "CS804": "note",
 }
 
 _SEVERITY_RANK = {"note": 0, "warn": 1, "error": 2}

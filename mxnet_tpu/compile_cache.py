@@ -1,0 +1,384 @@
+"""Persistent cross-process compilation cache + AOT warm start.
+
+TPU-native answer to the reference's deployment story (the C predict API and
+``amalgamation/``: load an artifact, run immediately, no frontend).  Under
+XLA every process pays trace+compile for every executable it touches — a
+cold llama train step is ~2 minutes of compile — so this module provides
+two escape hatches, wired under every compile site the framework has
+(``_jitted`` eager ops, bulk segments, ``hybridize()``'d blocks,
+``JitTrainStep``, ``deploy.export_model``):
+
+1. **Persistent compilation cache** — JAX's disk cache, enabled and managed
+   here.  ``MXNET_COMPILE_CACHE`` controls it: ``0`` disables, ``1`` forces
+   on, a *path* forces on with that directory, and the default ``auto``
+   enables it for accelerator processes only (XLA:CPU cache entries are AOT
+   objects keyed without host machine features — an entry compiled
+   elsewhere can SIGILL a pure-CPU process that loads it).
+   ``MXNET_COMPILE_CACHE_DIR`` picks the directory (default
+   ``$XDG_CACHE_HOME/mxnet_tpu/xla_cache``), ``MXNET_COMPILE_CACHE_MIN_SECS``
+   the minimum compile time worth persisting, and
+   ``MXNET_COMPILE_CACHE_BUDGET_MB`` an LRU size budget enforced here (not
+   via jax's own ``jax_compilation_cache_max_size``) so evictions are
+   *countable*.  Hit/miss/write/evict counters and a size gauge export
+   through telemetry as ``mxnet_compile_cache_*``.
+
+2. **AOT executable serialization** — ``serialize_compiled`` /
+   ``deserialize_compiled`` wrap PJRT executable pickling
+   (``jax.experimental.serialize_executable``) and ``save_bundle`` /
+   ``load_bundle`` give ``hybridize(aot=...)``, ``JitTrainStep
+   .save_executable`` and ``Predictor.warm()`` a common signed artifact
+   format, so a fleet restart compiles *nothing*.
+
+Keying notes: bulk segments are structurally keyed by op sequence in
+``engine.py``; the exact O0 taped path compiles through
+``lower().compile(compiler_options=...)`` under a *differently named*
+traced callable, so O0 and O2 artifacts can never collide in the disk
+cache (the HLO module name and the compiler options both enter jax's
+cache key).
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import pickle
+import threading
+
+from .base import MXNetError, atomic_path
+
+_AOT_MAGIC = b"MXAOT1\n"
+
+_lock = threading.Lock()
+# raw monitoring-event tallies; "misses" is derived (requests - hits)
+_stats = {"hits": 0, "writes": 0, "requests": 0, "evictions": 0,
+          "aot_loads": 0, "aot_saves": 0}
+_state = {"enabled": False, "dir": None, "budget_mb": 0.0,
+          "listener": False, "collector": False, "atexit": False}
+
+
+def default_cache_dir():
+    base = (os.environ.get("XDG_CACHE_HOME")
+            or os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(base, "mxnet_tpu", "xla_cache")
+
+
+def enabled():
+    """True when the persistent disk cache was activated by configure()."""
+    return _state["enabled"]
+
+
+def cache_dir():
+    """The active cache directory, or None when disabled."""
+    return _state["dir"] if _state["enabled"] else None
+
+
+def persistent_hits():
+    """Monotonic count of executables loaded from the disk cache.
+
+    Cheap enough for the dispatch hot path: engine/registry snapshot it
+    around a push to tell a disk hit (fast, warm start) from a true
+    retrace, so warm processes neither pollute ``mxnet_compile_seconds``
+    nor trip the MXNET_RETRACE_WARN_THRESHOLD watchdog.
+    """
+    return _stats["hits"]
+
+
+def stats():
+    with _lock:
+        out = dict(_stats)
+    out["misses"] = max(0, out["requests"] - out["hits"])
+    return out
+
+
+def cache_size_bytes():
+    d = _state["dir"]
+    if not d or not os.path.isdir(d):
+        return 0
+    total = 0
+    try:
+        for ent in os.scandir(d):
+            try:
+                if ent.is_file():
+                    total += ent.stat().st_size
+            except OSError:
+                continue
+    except OSError:
+        return 0
+    return total
+
+
+def _listener(event, **kwargs):
+    # jax emits these from compiler.py/compilation_cache.py:
+    #   cache_hits                 -> executable deserialized from disk
+    #   cache_misses               -> entry WRITTEN to disk (fired on put)
+    #   compile_requests_use_cache -> any compile request with cache on
+    if not event.startswith("/jax/compilation_cache/"):
+        return
+    with _lock:
+        if event.endswith("/cache_hits"):
+            _stats["hits"] += 1
+        elif event.endswith("/cache_misses"):
+            _stats["writes"] += 1
+        elif event.endswith("/compile_requests_use_cache"):
+            _stats["requests"] += 1
+
+
+def _collector():
+    from .telemetry import metrics as _m
+
+    snap = stats()
+    _m.counter("mxnet_compile_cache_hits_total",
+               "Executables loaded from the persistent compile cache"
+               ).set(snap["hits"])
+    _m.counter("mxnet_compile_cache_misses_total",
+               "Compile requests the persistent cache could not serve"
+               ).set(snap["misses"])
+    _m.counter("mxnet_compile_cache_writes_total",
+               "Executables written to the persistent compile cache"
+               ).set(snap["writes"])
+    _m.counter("mxnet_compile_cache_evictions_total",
+               "Cache entries evicted by MXNET_COMPILE_CACHE_BUDGET_MB"
+               ).set(snap["evictions"])
+    _m.counter("mxnet_compile_cache_aot_loads_total",
+               "AOT executables deserialized from bundles"
+               ).set(snap["aot_loads"])
+    if _state["enabled"]:
+        _m.gauge("mxnet_compile_cache_size_bytes",
+                 "Total bytes in the persistent compile cache directory"
+                 ).set(cache_size_bytes())
+
+
+def _ensure_observability():
+    if not _state["listener"]:
+        try:
+            from jax._src import monitoring
+
+            monitoring.register_event_listener(_listener)
+            _state["listener"] = True
+        except Exception:
+            pass
+    if not _state["collector"]:
+        try:
+            from .telemetry import metrics as _m
+
+            _m.register_collector(_collector)
+            _state["collector"] = True
+        except Exception:
+            pass
+
+
+def enforce_budget(budget_mb=None):
+    """Evict oldest-mtime cache entries until the directory fits the budget.
+
+    Deliberately NOT delegated to jax's ``jax_compilation_cache_max_size``:
+    jax evicts silently, and the whole point of owning eviction is the
+    ``mxnet_compile_cache_evictions_total`` counter.  Returns the number of
+    entries evicted.
+    """
+    if budget_mb is None:
+        budget_mb = _state["budget_mb"]
+    d = _state["dir"]
+    if not budget_mb or budget_mb <= 0 or not d or not os.path.isdir(d):
+        return 0
+    budget = float(budget_mb) * 1024 * 1024
+    # jax stores each executable as "<key>-cache" plus a tiny "<key>-atime"
+    # companion it touches on every read; group the pair into one logical
+    # entry, use the freshest mtime of the pair as its LRU recency, and
+    # evict both files together so no orphans accumulate
+    groups = {}
+    try:
+        for ent in os.scandir(d):
+            try:
+                if not ent.is_file():
+                    continue
+                st = ent.stat()
+            except OSError:
+                continue
+            key = ent.name
+            for suffix in ("-atime", "-cache"):
+                if key.endswith(suffix):
+                    key = key[: -len(suffix)]
+                    break
+            mtime, size, paths = groups.get(key, (0.0, 0, []))
+            groups[key] = (max(mtime, st.st_mtime), size + st.st_size,
+                           paths + [ent.path])
+    except OSError:
+        return 0
+    total = sum(sz for _, sz, _ in groups.values())
+    if total <= budget:
+        return 0
+    evicted = 0
+    for _, sz, paths in sorted(groups.values()):  # least recently used first
+        if total <= budget:
+            break
+        removed = False
+        for path in paths:
+            try:
+                os.remove(path)
+                removed = True
+            except OSError:
+                continue
+        if removed:
+            total -= sz
+            evicted += 1
+    if evicted:
+        with _lock:
+            _stats["evictions"] += evicted
+    return evicted
+
+
+def _looks_like_path(raw):
+    return (os.sep in raw or raw.startswith(("~", ".", "$"))
+            or (os.altsep and os.altsep in raw))
+
+
+def configure(env=None):
+    """Resolve the MXNET_COMPILE_CACHE* env contract and apply it to jax.
+
+    Called once at ``import mxnet_tpu`` (before any compile can happen).
+    Never raises: a cache is an optimization and must not break import.
+    Returns True when the persistent cache ended up enabled.
+    """
+    if env is None:
+        env = os.environ
+    raw = env.get("MXNET_COMPILE_CACHE", "auto")
+    mode = raw.lower()
+    if mode in ("0", "false", "off", "no"):
+        return False
+    try:
+        import jax
+
+        dir_from_mode = None
+        if mode not in ("1", "true", "on", "yes", "auto") \
+                and _looks_like_path(raw):
+            dir_from_mode = os.path.expandvars(os.path.expanduser(raw))
+        forced = mode in ("1", "true", "on", "yes") or bool(dir_from_mode)
+
+        cache_dir_ = (env.get("MXNET_COMPILE_CACHE_DIR") or dir_from_mode
+                      or None)
+        if not forced and not cache_dir_:
+            # auto: default-on for ACCELERATOR processes only — XLA:CPU
+            # cache entries are AOT objects keyed without host machine
+            # features; an entry compiled elsewhere (e.g. through a device
+            # tunnel's cpu staging platform) can SIGILL a pure-CPU process
+            # that loads it (observed killing dist-kvstore servers).  CPU
+            # compiles are cheap; TPU compiles are the minutes-long ones
+            # worth persisting.  MXNET_COMPILE_CACHE=1 / a path value / an
+            # explicit _DIR opts a CPU process in.
+            plats = str(getattr(jax.config, "jax_platforms", "") or "")
+            primary = plats.split(",")[0].strip() if plats else ""
+            # unknown/unset platform counts as CPU: a host with no
+            # accelerator plugin auto-selects cpu with an EMPTY config
+            if primary in ("cpu", ""):
+                return False
+        if not cache_dir_:
+            cache_dir_ = default_cache_dir()
+        os.makedirs(cache_dir_, exist_ok=True)
+        min_secs = float(env.get("MXNET_COMPILE_CACHE_MIN_SECS", "1.0"))
+        jax.config.update("jax_compilation_cache_dir", cache_dir_)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_secs)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        _state["enabled"] = True
+        _state["dir"] = cache_dir_
+        try:
+            _state["budget_mb"] = float(
+                env.get("MXNET_COMPILE_CACHE_BUDGET_MB", "0") or "0")
+        except ValueError:
+            _state["budget_mb"] = 0.0
+        _ensure_observability()
+        enforce_budget()
+        if not _state["atexit"]:
+            atexit.register(enforce_budget)
+            _state["atexit"] = True
+        return True
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# AOT executable serialization (PJRT pickling + bundle format)
+# ---------------------------------------------------------------------------
+
+def serialize_compiled(compiled):
+    """``jax.stages.Compiled`` -> opaque bytes (device-independent pickle)."""
+    from jax.experimental import serialize_executable as _se
+
+    payload, in_tree, out_tree = _se.serialize(compiled)
+    return pickle.dumps(
+        {"payload": payload, "in_tree": in_tree, "out_tree": out_tree},
+        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def deserialize_compiled(blob, backend=None):
+    """Inverse of :func:`serialize_compiled`; returns a callable Compiled."""
+    from jax.experimental import serialize_executable as _se
+
+    try:
+        doc = pickle.loads(blob)
+        out = _se.deserialize_and_load(doc["payload"], doc["in_tree"],
+                                       doc["out_tree"], backend=backend)
+    except MXNetError:
+        raise
+    except Exception as e:
+        raise MXNetError(
+            "failed to deserialize AOT executable (%s: %s) — bundles are "
+            "only loadable on the jax version/backend that produced them"
+            % (type(e).__name__, e))
+    with _lock:
+        _stats["aot_loads"] += 1
+    return out
+
+
+def save_bundle(path, entries, meta=None):
+    """Write an AOT bundle: ``{key: serialized-executable-bytes}`` + meta.
+
+    Atomic (tmp + rename) so an interrupted save never corrupts a bundle a
+    serving fleet is about to load.
+    """
+    import jax
+
+    doc = {
+        "jax_version": jax.__version__,
+        "platform": jax.default_backend(),
+        "meta": dict(meta or {}),
+        "entries": dict(entries),
+    }
+    with atomic_path(path) as tmp:
+        with open(tmp, "wb") as f:
+            f.write(_AOT_MAGIC)
+            pickle.dump(doc, f, protocol=pickle.HIGHEST_PROTOCOL)
+    with _lock:
+        _stats["aot_saves"] += len(doc["entries"])
+
+
+def load_bundle(path):
+    """Read an AOT bundle; validates magic + platform before any load."""
+    import jax
+
+    try:
+        with open(path, "rb") as f:
+            magic = f.read(len(_AOT_MAGIC))
+            if magic != _AOT_MAGIC:
+                raise MXNetError(
+                    "%s is not an mxnet_tpu AOT bundle (bad magic)" % path)
+            doc = pickle.load(f)
+    except MXNetError:
+        raise
+    except Exception as e:
+        raise MXNetError("failed to read AOT bundle %s (%s: %s)"
+                         % (path, type(e).__name__, e))
+    plat = doc.get("platform")
+    if plat and plat != jax.default_backend():
+        raise MXNetError(
+            "AOT bundle %s was compiled for platform %r but this process "
+            "runs %r — recompile or re-export on the target platform"
+            % (path, plat, jax.default_backend()))
+    ver = doc.get("jax_version")
+    if ver and ver != jax.__version__:
+        import warnings
+
+        warnings.warn(
+            "AOT bundle %s was produced under jax %s (running %s); "
+            "deserialization may fail across versions"
+            % (path, ver, jax.__version__))
+    return doc

@@ -104,6 +104,8 @@ def export_model(net, example_inputs, path, embed_params=True,
         "n_inputs": len(xs),
         "n_params": len(params),
         "param_names": [p.name for p in params],
+        "param_shapes": [list(np.asarray(w).shape) for w in weights],
+        "param_dtypes": [str(np.asarray(w).dtype) for w in weights],
         "input_shapes": [list(x.shape) for x in xs],
         "input_dtypes": [str(x.dtype) for x in xs],
         "platforms": list(exp.platforms),
@@ -141,21 +143,75 @@ class Predictor:
 
         self._exp = jexport.deserialize(module)
         self._weights = ()
-        if not self.meta["embed_params"]:
+        if not self.meta["embed_params"] and self.meta["n_params"]:
             import io as _io
 
-            blobs = np.load(_io.BytesIO(rest))
-            self._weights = tuple(
-                blobs["param_%05d" % i]
-                for i in range(self.meta["n_params"]))
+            # validate the weight blobs AT LOAD (parity: the predict API's
+            # provided-shape checks) — a truncated artifact or one whose
+            # stored weights no longer match the module signature must fail
+            # here, not as an opaque XLA error on the first request
+            try:
+                blobs = np.load(_io.BytesIO(rest))
+                ws = tuple(blobs["param_%05d" % i]
+                           for i in range(self.meta["n_params"]))
+            except MXNetError:
+                raise
+            except Exception as e:
+                raise MXNetError(
+                    "%s: embed_params=False artifact is missing/corrupt "
+                    "weight blobs (%s: %s)" % (path, type(e).__name__, e))
+            self._check_param_sig(ws, path)
+            self._weights = ws
+
+    def _check_param_sig(self, arrays, origin="set_params"):
+        shapes = self.meta.get("param_shapes")
+        dtypes = self.meta.get("param_dtypes")
+        if shapes is None:
+            return  # pre-param-sig artifact: best effort
+        for i, (a, shape, dt) in enumerate(zip(arrays, shapes, dtypes)):
+            if list(a.shape) != shape or str(a.dtype) != dt:
+                raise MXNetError(
+                    "%s: param %d (%s) mismatch: got %s %s, module wants "
+                    "%s %s" % (origin, i,
+                               self.meta["param_names"][i],
+                               tuple(a.shape), a.dtype, tuple(shape), dt))
 
     def set_params(self, arrays):
-        """Swap the weights of a ``embed_params=False`` artifact."""
+        """Swap the weights of a ``embed_params=False`` artifact.
+
+        Shape/dtype-checked against the module signature immediately — a
+        wrong weight set raises HERE, not on the next ``predict``.
+        """
         if self.meta["embed_params"]:
             raise MXNetError("artifact has embedded params")
         if len(arrays) != self.meta["n_params"]:
             raise MXNetError("expected %d params" % self.meta["n_params"])
-        self._weights = tuple(np.asarray(a) for a in arrays)
+        ws = tuple(np.asarray(a) for a in arrays)
+        self._check_param_sig(ws)
+        self._weights = ws
+
+    def warm(self):
+        """Pre-compile the module before the first request.
+
+        Runs the exported forward once on zeros shaped from the artifact's
+        input signature, so the PJRT compile (disk-cached via
+        compile_cache.py when MXNET_COMPILE_CACHE is on) happens at server
+        startup instead of on the first live request.  Returns ``self``
+        for ``Predictor(path).warm()`` chaining.
+        """
+        zeros = tuple(
+            np.zeros(shape, dtype=dt)
+            for shape, dt in zip(self.meta["input_shapes"],
+                                 self.meta["input_dtypes"]))
+        if self.meta["embed_params"]:
+            self._exp.call(*zeros)
+        else:
+            if len(self._weights) != self.meta["n_params"]:
+                raise MXNetError(
+                    "warm() before set_params on an embed_params=False "
+                    "artifact with no stored weights")
+            self._exp.call(zeros, self._weights)
+        return self
 
     def predict(self, *inputs):
         """Run the compiled forward; returns NDArray or list of them."""

@@ -421,9 +421,12 @@ class HybridBlock(Block):
         self._cached_ops = {}      # (shapes,dtypes,mode) -> compiled record
         self._warmed_up = False
         self._flags = {}
+        self._aot_path = None      # hybridize(aot=...) bundle file
+        self._aot_ops = {}         # (shapes,dtypes,mode) -> AOT record
+        self._aot_entries = None   # raw bundle entries (lazy load)
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False,
-                  lint=False, **kwargs):
+                  lint=False, aot=None, **kwargs):
         """Arm/disarm compilation (parity: HybridBlock.hybridize:1043).
 
         ``static_alloc``/``static_shape`` accepted for API parity; XLA's
@@ -434,6 +437,16 @@ class HybridBlock(Block):
         — and every child's — before arming, and raises ``MXNetError`` on
         findings: the static analogue of tracing the block and hitting a
         ConcretizationError three epochs in.
+
+        ``aot=path`` arms warm-start serialization (compile_cache.py): each
+        input signature this block compiles for is AOT-exported to ``path``
+        (PJRT executable serialization), and a fresh process that
+        hybridizes with the same ``aot=path`` loads the executable instead
+        of tracing+compiling — bitwise-identical outputs, zero compiles.
+        AOT entries serve inference; calls under ``autograd.record()`` fall
+        back to the live jit path (a deserialized executable cannot be
+        re-linearized for vjp).  Parameters must be initialized (e.g. via
+        ``load_parameters``) before an AOT entry can serve.
         """
         if active and lint:
             findings = self.lint()
@@ -443,6 +456,10 @@ class HybridBlock(Block):
                     "hybrid_forward:\n  "
                     + "\n  ".join(str(f) for f in findings))
         self._active = active
+        self._aot_path = aot if active else None
+        if not active or aot is None:
+            self._aot_ops = {}
+            self._aot_entries = None
         self._flags = dict(static_alloc=static_alloc,
                            static_shape=static_shape, **kwargs)
         if not active:
@@ -460,6 +477,8 @@ class HybridBlock(Block):
 
     def clear_cache(self):
         self._cached_ops = {}
+        self._aot_ops = {}
+        self._aot_entries = None
         self._warmed_up = False
 
     def cast(self, dtype):
@@ -532,18 +551,36 @@ class HybridBlock(Block):
 
     # -- cached (compiled) path ------------------------------------------
     def _call_cached(self, *inputs):
+        key = (tuple((tuple(a.shape), str(a.dtype)) for a in inputs),
+               autograd.is_training())
+        if self._aot_path is not None and not autograd.is_recording():
+            # warm start: serve a deserialized executable — no warmup
+            # forward, no trace, no compile.  Recording falls through to
+            # the live jit path (a loaded executable has no vjp).
+            rec = self._aot_ops.get(key)
+            if rec is None:
+                rec = self._try_aot_load(key)
+                if rec is not None:
+                    self._aot_ops[key] = rec
+            if rec is not None:
+                return self._run_cached(rec, inputs)
         if not self._warmed_up:
             # First call after hybridize(): run imperatively — this resolves
             # deferred parameter shapes (CachedOp's _deferred_infer_shape) and
             # gives the answer for free; compile on the next call.
             self._warmed_up = True
             return self._forward_imperative(*inputs)
-        key = (tuple((tuple(a.shape), str(a.dtype)) for a in inputs),
-               autograd.is_training())
         rec = self._cached_ops.get(key)
         if rec is None:
             rec = self._build_cache(inputs)
             self._cached_ops[key] = rec
+            if self._aot_path is not None:
+                self._aot_export(key, rec, inputs)
+                aot_rec = self._aot_ops.get(key)
+                if aot_rec is not None and not autograd.is_recording():
+                    # run the executable we just compiled for export rather
+                    # than paying jit's own compile of the same program
+                    rec = aot_rec
         return self._run_cached(rec, inputs)
 
     def _build_cache(self, inputs):
@@ -582,6 +619,104 @@ class HybridBlock(Block):
         except Exception:
             pass
         return {"fn": jitted, "params": params, "meta": meta}
+
+    # -- AOT warm start (hybridize(aot=path), see compile_cache.py) -------
+    def _bundle_entries(self):
+        import os
+        import warnings
+
+        from .. import compile_cache as _ccache
+
+        if self._aot_entries is None:
+            self._aot_entries = {}
+            if self._aot_path and os.path.exists(self._aot_path):
+                try:
+                    doc = _ccache.load_bundle(self._aot_path)
+                    self._aot_entries = dict(doc["entries"])
+                except MXNetError as e:
+                    warnings.warn(
+                        "hybridize(aot=%r): ignoring unusable bundle (%s); "
+                        "falling back to live compilation"
+                        % (self._aot_path, e))
+        return self._aot_entries
+
+    def _try_aot_load(self, key):
+        import warnings
+
+        from .. import compile_cache as _ccache
+
+        entry = self._bundle_entries().get(repr(key))
+        if entry is None:
+            return None
+        params = list(self.collect_params().values())
+        try:
+            for p in params:
+                p._check_initialized()
+        except Exception:
+            return None  # deferred params: warm up imperatively first
+        names = [p.name for p in params]
+        if names != entry["param_names"]:
+            raise MXNetError(
+                "hybridize(aot=%r): bundle entry was exported with "
+                "parameters %s but this block has %s — the architecture "
+                "changed since export" % (self._aot_path,
+                                          entry["param_names"], names))
+        try:
+            compiled = _ccache.deserialize_compiled(entry["blob"])
+        except MXNetError as e:
+            warnings.warn("hybridize(aot=%r): %s; falling back to live "
+                          "compilation" % (self._aot_path, e))
+            return None
+        pmap = {p.name: p for p in params}
+        aux = [pmap[n] for n in entry["aux_names"]]
+        return {"fn": compiled, "params": params,
+                "meta": {"n_outputs": entry["n_outputs"],
+                         "aux_params": aux},
+                "aot": True}
+
+    def _aot_export(self, key, rec, inputs):
+        import warnings
+
+        from .. import compile_cache as _ccache
+
+        params = rec["params"]
+        datas = (
+            (_random.next_key(),)
+            + tuple(p.data().data() for p in params)
+            + tuple(x.data() for x in inputs)
+        )
+        try:
+            # lower() traces fn, filling rec["meta"] exactly as a call would
+            compiled = rec["fn"].lower(*datas).compile()
+            blob = _ccache.serialize_compiled(compiled)
+        except Exception as e:
+            warnings.warn(
+                "hybridize(aot=%r): executable export failed (%s: %s); the "
+                "block still runs, but a fresh process will recompile"
+                % (self._aot_path, type(e).__name__, e))
+            return
+        meta = rec["meta"]
+        entries = self._bundle_entries()
+        entries[repr(key)] = {
+            "blob": blob,
+            "n_outputs": meta["n_outputs"],
+            "aux_names": [p.name for p in meta["aux_params"]],
+            "param_names": [p.name for p in params],
+        }
+        try:
+            _ccache.save_bundle(self._aot_path, entries,
+                                meta={"block": self.name})
+        except Exception as e:
+            warnings.warn("hybridize(aot=%r): bundle write failed (%s: %s)"
+                          % (self._aot_path, type(e).__name__, e))
+            return
+        # serve subsequent non-recording calls straight from the compiled
+        # executable — the exporting process pays exactly one compile
+        self._aot_ops[key] = {"fn": compiled, "params": params,
+                              "meta": {"n_outputs": meta["n_outputs"],
+                                       "aux_params": list(
+                                           meta["aux_params"])},
+                              "aot": True}
 
     def _run_cached(self, rec, inputs):
         params = rec["params"]

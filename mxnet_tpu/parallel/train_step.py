@@ -293,6 +293,8 @@ class JitTrainStep:
                     for b in batch]
         self._ensure_init(batch_nd)
         arrays = self._place_batch(batch_nd)
+        self._batch_avals = tuple(
+            jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrays)
         if self._step_fn is None:
             self._step_fn = self._build(arrays)
         self._t += 1
@@ -351,6 +353,10 @@ class JitTrainStep:
         arrays = self._place_batch(batch_nd)
         if self._step_fn is None:
             self._step_fn = self._build(arrays)
+        if not hasattr(self, "_raw_step"):
+            # _step_fn came from load_executable: the loop body needs the
+            # traceable python step, so build it once (no call, no compile)
+            self._build(arrays)
         if not hasattr(self, "_step_n_cache"):
             self._step_n_cache = {}
         # keyed on the scheduler OBJECT too: swapping in a different
@@ -462,6 +468,94 @@ class JitTrainStep:
                 for st in payload["opt_state"]]
         self._t = int(payload["t"])
         self._opt.num_update = self._t
+
+    def save_executable(self, fname):
+        """AOT-export the compiled train step (compile_cache.py bundle).
+
+        A fleet restart then calls ``load_executable`` and compiles
+        NOTHING — the multi-minute cold trace+compile of the full
+        step collapses to a deserialization.  Run at least one ``step``
+        first (the executable and its placement must exist).  Pairs with
+        ``save_states`` — this file carries the *program*, the states
+        checkpoint carries the *data*.
+        """
+        from .. import compile_cache as _ccache
+
+        if self._step_fn is None:
+            raise MXNetError(
+                "save_executable before the first step: run one step so "
+                "the executable exists")
+        if self._mesh is not None:
+            raise MXNetError(
+                "save_executable does not support mesh-placed steps: "
+                "sharded executables are not portable across process "
+                "topologies")
+
+        def aval(a):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        w_avals = [aval(w) for w in self._weights]
+        s_avals = [None if s is None else jax.tree_util.tree_map(aval, s)
+                   for s in self._opt_state]
+        compiled = self._step_fn.lower(
+            aval(_random.next_key()),
+            jax.ShapeDtypeStruct((), jnp.float32),
+            w_avals, s_avals,
+            jax.ShapeDtypeStruct((), jnp.int32),
+            *self._batch_avals).compile()
+        entry = {
+            "blob": _ccache.serialize_compiled(compiled),
+            "param_names": [p.name for p in self._params],
+            "weight_sig": [(tuple(w.shape), str(w.dtype))
+                           for w in self._weights],
+            "batch_sig": [(tuple(a.shape), str(a.dtype))
+                          for a in self._batch_avals],
+        }
+        _ccache.save_bundle(fname, {"step": entry},
+                            meta={"kind": "train_step"})
+
+    def load_executable(self, fname, *batch):
+        """Load a ``save_executable`` bundle instead of trace+compiling.
+
+        ``batch`` is one example batch (same shapes/dtypes as training
+        will use) — it establishes parameter placement exactly like the
+        first ``step`` would, and is NOT stepped on.  Raises MXNetError
+        when the bundle's parameter set or batch signature does not match
+        this net — at load, not on the first training step.
+        """
+        from .. import compile_cache as _ccache
+
+        if self._mesh is not None:
+            raise MXNetError(
+                "load_executable does not support mesh-placed steps")
+        batch_nd = [b if isinstance(b, NDArray) else nd.array(b)
+                    for b in batch]
+        self._ensure_init(batch_nd)
+        arrays = self._place_batch(batch_nd)
+        doc = _ccache.load_bundle(fname)
+        entry = doc["entries"].get("step")
+        if entry is None or doc.get("meta", {}).get("kind") != "train_step":
+            raise MXNetError(
+                "%s is not a JitTrainStep executable bundle" % fname)
+        names = [p.name for p in self._params]
+        if entry["param_names"] != names:
+            raise MXNetError(
+                "load_executable: bundle was exported with parameters %s "
+                "but this net has %s" % (entry["param_names"], names))
+        w_sig = [(tuple(w.shape), str(w.dtype)) for w in self._weights]
+        if entry["weight_sig"] != w_sig:
+            raise MXNetError(
+                "load_executable: weight signature mismatch — bundle %s "
+                "vs net %s" % (entry["weight_sig"], w_sig))
+        b_sig = [(tuple(a.shape), str(a.dtype)) for a in arrays]
+        if entry["batch_sig"] != b_sig:
+            raise MXNetError(
+                "load_executable: executable was compiled for batch %s "
+                "but got %s" % (entry["batch_sig"], b_sig))
+        self._step_fn = _ccache.deserialize_compiled(entry["blob"])
+        self._batch_avals = tuple(
+            jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrays)
+        return self
 
     def sync_params(self):
         """Write the jitted weights back into the gluon Parameters.

@@ -1,0 +1,39 @@
+# flake8: noqa
+"""Known-bad op-attr shapes for the CS8xx pass (tests/test_cache_keys_lint.py).
+
+Same contract as ``mxlint_bad.py``: every deliberately-bad line carries a
+trailing ``# expect: RULE`` marker and the test asserts the linter
+produces EXACTLY those findings — one per marker, none elsewhere.
+``# expect-strict:`` markers fire only under ``--strict`` (CS804 is
+advisory).  Never imported by the framework.
+"""
+
+
+class FragmentedAttrs:
+    def hybrid_forward(self, F, x):
+        a = F.topk(x, axes={0, 1})  # expect: CS801
+        b = F.pad(x, pad_width=np.array([1, 1]))  # expect: CS801
+        c = F.custom(x, fn=lambda v: v + 1)  # expect: CS802
+        d = F.reshape_like(x, mapping={"lhs": 0})  # expect: CS803
+        return a + b + c + d
+
+
+def eager_call_sites(nd, mx):
+    a = nd.sum(x, axis={0})  # expect: CS801
+    b = mx.nd.concat(x, y, extra=dict(depth=2))  # expect: CS803
+    return a + b
+
+
+def strict_only(F, x):
+    return F.clip(x, a_min=None, a_max=1.0)  # expect-strict: CS804
+
+
+def clean_call_sites(F, nd, x, shape, fn):
+    # hashable constants, tuples, positional data, **kwargs passthrough,
+    # and variables (opaque — never flagged) stay quiet
+    a = F.reshape(x, shape=(2, -1))
+    b = F.sum(x, axis=0, keepdims=True)
+    c = nd.array([1.0, 2.0])           # positional data, not an attr
+    d = F.custom(x, fn=fn)             # variable: opaque, not flagged
+    e = F.broadcast_to(x, **{"shape": shape})
+    return a + b + c + d + e

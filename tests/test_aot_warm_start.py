@@ -1,0 +1,197 @@
+"""AOT warm start (ISSUE 7 tentpole, part 2): serialized executables that
+make a fresh process skip trace AND compile.
+
+Three surfaces share one bundle format (compile_cache.save_bundle /
+load_bundle): ``hybridize(aot=path)``, ``JitTrainStep.save_executable /
+load_executable``, and ``Predictor.warm()`` (tested in test_deploy.py).
+The cross-process claims — bitwise-equal outputs, zero live jit in the
+loading process — only mean anything in a genuinely fresh interpreter,
+so the round-trips run as subprocesses.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu import compile_cache
+from mxnet_tpu.gluon import nn
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, timeout=300):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([sys.executable, "-c", script], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=timeout)
+    assert r.returncode == 0, r.stderr
+    return r.stdout
+
+
+_HYBRID = r"""
+import sys
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import nn
+
+phase, tmp = sys.argv[1], sys.argv[2]
+net = nn.HybridSequential()
+net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+x = nd.array(np.arange(12, dtype="float32").reshape(2, 6))
+net.initialize(mx.init.Xavier())
+if phase == "export":
+    net.hybridize(aot=tmp + "/net.aot")
+    net(x)       # warmup (imperative, resolves deferred shapes)
+    y = net(x)   # build + export + run the AOT executable
+    assert len(net._aot_ops) == 1, net._aot_ops
+    net.save_parameters(tmp + "/net.params")
+    np.save(tmp + "/out.npy", y.asnumpy())
+    print("EXPORT_OK")
+else:
+    net(x)       # resolve deferred shapes so load_parameters matches
+    net.load_parameters(tmp + "/net.params")
+    net.hybridize(aot=tmp + "/net.aot")
+    y = net(x)   # must come from the bundle: no warmup, no live jit
+    ref = np.load(tmp + "/out.npy")
+    assert np.array_equal(y.asnumpy(), ref), "not bitwise equal"
+    assert len(net._aot_ops) == 1 and len(net._cached_ops) == 0, \
+        (net._aot_ops, net._cached_ops)
+    assert mx.compile_cache.stats()["aot_loads"] >= 1
+    print("LOAD_OK")
+"""
+
+
+def test_hybridize_aot_roundtrip_fresh_process(tmp_path):
+    tmp = str(tmp_path)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    for phase, marker in (("export", "EXPORT_OK"), ("load", "LOAD_OK")):
+        r = subprocess.run(
+            [sys.executable, "-c", _HYBRID, phase, tmp], cwd=REPO,
+            env=env, capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stderr
+        assert marker in r.stdout
+    assert os.path.exists(os.path.join(tmp, "net.aot"))
+
+
+_JTS = r"""
+import sys
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import nn, loss as gloss
+from mxnet_tpu.parallel import JitTrainStep
+
+phase, tmp = sys.argv[1], sys.argv[2]
+mx.random.seed(7)
+net = nn.HybridSequential()
+net.add(nn.Dense(8, activation="relu"), nn.Dense(1))
+net.initialize(mx.init.Xavier(rnd_type="gaussian"))
+step = JitTrainStep(net, loss=gloss.L2Loss(), optimizer="sgd",
+                    optimizer_params={"learning_rate": 0.05})
+X = np.arange(24, dtype="float32").reshape(4, 6) / 24.0
+y = np.ones((4, 1), dtype="float32")
+if phase == "export":
+    step.step(X, y)
+    step.save_executable(tmp + "/step.aot")
+    step.save_states(tmp + "/step.states")
+    l2 = step.step(X, y)
+    np.save(tmp + "/loss.npy", np.float32(l2))
+    print("EXPORT_OK")
+else:
+    step.load_executable(tmp + "/step.aot", X, y)
+    step.load_states(tmp + "/step.states")
+    l2 = step.step(X, y)
+    ref = np.load(tmp + "/loss.npy")
+    assert np.float32(l2) == ref, (float(l2), float(ref))
+    # a mismatched batch signature must raise AT LOAD, not at step time
+    step2 = JitTrainStep(net, loss=gloss.L2Loss(), optimizer="sgd")
+    try:
+        step2.load_executable(tmp + "/step.aot", X[:2], y[:2])
+    except mx.MXNetError:
+        print("LOAD_OK MISMATCH_RAISES_OK")
+    else:
+        raise AssertionError("wrong batch signature loaded silently")
+"""
+
+
+def test_train_step_executable_roundtrip_fresh_process(tmp_path):
+    tmp = str(tmp_path)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    for phase, marker in (("export", "EXPORT_OK"),
+                          ("load", "MISMATCH_RAISES_OK")):
+        r = subprocess.run(
+            [sys.executable, "-c", _JTS, phase, tmp], cwd=REPO,
+            env=env, capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stderr
+        assert marker in r.stdout
+
+
+def test_save_executable_before_first_step_raises(tmp_path):
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.parallel import JitTrainStep
+
+    net = nn.Dense(2)
+    net.initialize(mx.init.Xavier())
+    step = JitTrainStep(net, loss=gloss.L2Loss(), optimizer="sgd")
+    with pytest.raises(MXNetError, match="step"):
+        step.save_executable(str(tmp_path / "never.aot"))
+
+
+def test_aot_block_still_records_gradients(tmp_path):
+    """Recording calls fall through to the live jit path: an AOT
+    executable has no vjp, so training on an aot-armed block must keep
+    working (and keep numerics) instead of failing or going grad-less."""
+    from mxnet_tpu import autograd
+
+    mx.random.seed(11)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, activation="relu"), nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.ones((2, 3), np.float32))
+    x.attach_grad()
+    net(x)  # resolve shapes
+    net.hybridize(aot=str(tmp_path / "net.aot"))
+    net(x)  # warmup
+    net(x)  # build + export
+    assert net._aot_ops
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    assert x.grad is not None
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+
+
+def test_bundle_rejects_wrong_platform_and_magic(tmp_path):
+    import pickle
+
+    # wrong platform: refuse before any executable deserializes
+    bad = str(tmp_path / "wrong_platform.aot")
+    with open(bad, "wb") as f:
+        f.write(compile_cache._AOT_MAGIC)
+        pickle.dump({"jax_version": "0.0.0", "platform": "notaplatform",
+                     "meta": {}, "entries": {}}, f)
+    with pytest.raises(MXNetError, match="platform"):
+        compile_cache.load_bundle(bad)
+    # bad magic
+    junk = str(tmp_path / "junk.aot")
+    with open(junk, "wb") as f:
+        f.write(b"not a bundle")
+    with pytest.raises(MXNetError, match="magic"):
+        compile_cache.load_bundle(junk)
+
+
+def test_bundle_roundtrip_preserves_entries(tmp_path):
+    path = str(tmp_path / "b.aot")
+    entries = {"k1": b"\x00\x01", "k2": b"\xff"}
+    compile_cache.save_bundle(path, entries, meta={"kind": "test"})
+    doc = compile_cache.load_bundle(path)
+    assert doc["entries"] == entries
+    assert doc["meta"]["kind"] == "test"

@@ -36,7 +36,14 @@ _STUB_VALUES = {"train": 100.0, "infer": 200.0, "bert": 300.0,
                 "dispatch_eager_notelemetry": 550.0,
                 "dispatch_bulked": 600.0,
                 "dispatch_bulked_train": 650.0,
-                "dispatch_bulked_long": 700.0}
+                "dispatch_bulked_long": 700.0,
+                # cold-start runners return value + extra record fields
+                "cold_resnet50": {"value": 30.0, "warm_seconds": 2.0,
+                                  "cold_warm_speedup": 15.0},
+                "cold_bert": {"value": 20.0, "warm_seconds": 2.0,
+                              "cold_warm_speedup": 10.0},
+                "cold_llama": {"value": 10.0, "warm_seconds": 2.0,
+                               "cold_warm_speedup": 5.0}}
 
 
 def test_single_metric_line(monkeypatch, capsys):
@@ -75,7 +82,10 @@ def test_default_mode_emits_all_metrics_in_one_line(monkeypatch, capsys):
                      "imperative_dispatch_eager_notelemetry",
                      "imperative_dispatch_bulked",
                      "imperative_dispatch_bulked_train",
-                     "imperative_dispatch_bulked_long"]
+                     "imperative_dispatch_bulked_long",
+                     "resnet50_cold_start_seconds",
+                     "bert_cold_start_seconds",
+                     "llama_cold_start_seconds"]
     assert all("platform" in m and "fallback" in m for m in rec["metrics"])
     # the op-bulking microbench rides in the metrics array (ISSUE 4);
     # the recorded-chain and 64-op variants joined in ISSUE 6
@@ -84,6 +94,12 @@ def test_default_mode_emits_all_metrics_in_one_line(monkeypatch, capsys):
     assert by_name["imperative_dispatch_bulked"]["value"] == 600.0
     assert by_name["imperative_dispatch_bulked_train"]["value"] == 650.0
     assert by_name["imperative_dispatch_bulked_long"]["value"] == 700.0
+    # cold-start records (ISSUE 7): dict-returning runners surface the
+    # cold number as "value" and the warm/speedup extras as fields
+    cold = by_name["resnet50_cold_start_seconds"]
+    assert cold["value"] == 30.0 and cold["unit"] == "seconds"
+    assert cold["warm_seconds"] == 2.0
+    assert cold["cold_warm_speedup"] == 15.0
 
 
 def test_budget_exhaustion_marks_skipped(monkeypatch, capsys):
@@ -96,7 +112,7 @@ def test_budget_exhaustion_marks_skipped(monkeypatch, capsys):
                       if ln.startswith("{")][-1])
     assert rec["value"] == 100.0  # headline always measured
     skipped = [m for m in rec["metrics"] if m.get("skipped")]
-    assert len(skipped) == 8
+    assert len(skipped) == 11
     assert all(m["value"] == 0.0 for m in skipped)
 
 
@@ -125,10 +141,14 @@ def test_failed_benchmark_emits_zero_not_crash(monkeypatch, capsys):
             boom, "imperative_dispatch_bulked_train", "ops/sec", None),
         "dispatch_bulked_long": (
             boom, "imperative_dispatch_bulked_long", "ops/sec", None),
+        "cold_resnet50": (boom, "resnet50_cold_start_seconds", "seconds",
+                          None),
+        "cold_bert": (boom, "bert_cold_start_seconds", "seconds", None),
+        "cold_llama": (boom, "llama_cold_start_seconds", "seconds", None),
     })
     monkeypatch.setattr(sys, "argv", ["bench.py"])
     mod.main()
     rec = json.loads([ln for ln in capsys.readouterr().out.splitlines()
                       if ln.startswith("{")][-1])
     assert rec["value"] == 0.0 and rec["fallback"] is True
-    assert len(rec["metrics"]) == 9
+    assert len(rec["metrics"]) == 12

@@ -1,0 +1,98 @@
+"""CS8xx compile-cache key hygiene pass (mxnet_tpu/analysis/cache_keys.py):
+fixture corpus + targeted shapes (docs/static_analysis.md pass 8).
+
+The rules exist because op attrs enter BOTH the in-process jit cache key
+and (since the persistent compilation cache) the cross-process disk key
+— an identity-keyed attr silently turns every call into a recompile that
+can never warm-start.
+"""
+import os
+import re
+
+import pytest
+
+from mxnet_tpu.analysis import lint_paths, lint_source
+from mxnet_tpu.analysis.suppressions import SuppressionFile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "cache_keys_bad.py")
+
+# op names the fixture invokes — handed to lint_paths so TS105
+# (unregistered-op) stays quiet and the marker match is exact
+_FIXTURE_OPS = {"topk", "pad", "custom", "reshape_like", "sum", "reshape",
+                "clip", "broadcast_to", "concat", "array", "negative"}
+
+
+def _expected_markers(strict):
+    out = []
+    with open(FIXTURE) as f:
+        for lineno, line in enumerate(f, 1):
+            m = re.search(r"#\s*expect(-strict)?:\s*([A-Z]+\d+)", line)
+            if m and (strict or not m.group(1)):
+                out.append((lineno, m.group(2)))
+    return sorted(out)
+
+
+@pytest.mark.parametrize("strict", [False, True])
+def test_fixture_findings_match_markers_exactly(strict):
+    expected = _expected_markers(strict)
+    assert len(expected) >= 6, "fixture corpus lost its markers"
+    findings = lint_paths([FIXTURE], registry_names=_FIXTURE_OPS,
+                          relative_to=REPO, strict=strict,
+                          suppressions=SuppressionFile())
+    got = sorted((f.line, f.rule) for f in findings)
+    assert got == expected, "\n".join(str(f) for f in findings)
+
+
+@pytest.mark.parametrize("rule", ["CS801", "CS802", "CS803", "CS804"])
+def test_fixture_covers_rule(rule):
+    assert rule in {r for _, r in _expected_markers(strict=True)}
+
+
+def test_cs801_set_and_fresh_array():
+    src = ("def f(F, x):\n"
+           "    a = F.sum(x, axis={0, 1})\n"
+           "    b = F.pad(x, width=np.array([1]))\n"
+           "    return a + b\n")
+    assert [f.rule for f in lint_source(src)] == ["CS801", "CS801"]
+
+
+def test_cs802_lambda_attr_warns():
+    src = "def f(F, x):\n    return F.custom(x, fn=lambda v: v)\n"
+    (f,) = lint_source(src)
+    assert f.rule == "CS802" and f.severity == "warn"
+
+
+def test_cs803_dict_attr_both_spellings():
+    src = ("def f(F, x):\n"
+           "    a = F.take(x, mapping={'a': 1})\n"
+           "    b = F.take(x, mapping=dict(a=1))\n"
+           "    return a + b\n")
+    assert [f.rule for f in lint_source(src)] == ["CS803", "CS803"]
+
+
+def test_cs804_none_attr_is_strict_only_note():
+    src = "def f(F, x):\n    return F.clip(x, a_min=None, a_max=1.0)\n"
+    assert lint_source(src) == []
+    (f,) = lint_source(src, strict=True)
+    assert f.rule == "CS804" and f.severity == "note"
+
+
+def test_quiet_shapes_never_flagged():
+    # tuples/constants, positional data, variables, **kwargs passthrough,
+    # and non-op calls (plain functions, method calls off other roots)
+    src = ("def f(F, nd, x, shape, cb, helper):\n"
+           "    a = F.reshape(x, shape=(2, -1))\n"
+           "    b = F.sum(x, axis=0, keepdims=True)\n"
+           "    c = nd.array([1.0, 2.0])\n"
+           "    d = F.custom(x, fn=cb)\n"
+           "    e = F.broadcast_to(x, **{'shape': shape})\n"
+           "    g = helper(x, mapping={'a': 1})\n"
+           "    return a + b + c + d + e + g\n")
+    assert lint_source(src, strict=True) == []
+
+
+def test_inline_suppression_applies():
+    src = ("def f(F, x):\n"
+           "    return F.sum(x, axis={0})  # mxlint: disable=CS801\n")
+    assert lint_source(src) == []

@@ -72,3 +72,90 @@ def test_bad_file_rejected():
             f.write(b"not a model")
         with pytest.raises(MXNetError):
             deploy.Predictor(path)
+
+
+def test_set_params_wrong_shape_raises_at_set():
+    # ISSUE 7: a wrong weight set must fail at set_params (against the
+    # param_shapes/param_dtypes recorded in the artifact meta), not as an
+    # opaque XLA error on the next predict
+    mx.random.seed(3)
+    net = _net()
+    x = nd.array(np.random.RandomState(3).rand(2, 8).astype(np.float32))
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "m.mxtpu")
+        deploy.export_model(net, (x,), path, embed_params=False)
+        pred = deploy.Predictor(path)
+        bad = [np.zeros_like(w) for w in pred._weights]
+        bad[0] = np.zeros(tuple(s + 1 for s in bad[0].shape),
+                          bad[0].dtype)
+        with pytest.raises(MXNetError, match="mismatch"):
+            pred.set_params(bad)
+        # dtype mismatch is caught too
+        bad = [np.zeros_like(w) for w in pred._weights]
+        bad[1] = bad[1].astype(np.float64)
+        with pytest.raises(MXNetError, match="mismatch"):
+            pred.set_params(bad)
+
+
+def test_truncated_weight_blobs_fail_at_load():
+    mx.random.seed(4)
+    net = _net()
+    x = nd.array(np.random.RandomState(4).rand(2, 8).astype(np.float32))
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "m.mxtpu")
+        deploy.export_model(net, (x,), path, embed_params=False)
+        # chop off the trailing npz weight blobs: load must raise a
+        # named MXNetError, not crash on the first request
+        blob = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(blob[:-200])
+        with pytest.raises(MXNetError, match="weight blobs"):
+            deploy.Predictor(path)
+
+
+def test_embed_params_false_fresh_process_roundtrip(tmp_path):
+    """The A/B-able artifact round-trips across processes: export here,
+    load + predict in a FRESH interpreter, numerics match."""
+    import subprocess
+    import sys
+
+    mx.random.seed(5)
+    net = _net()
+    x = np.random.RandomState(5).rand(2, 8).astype(np.float32)
+    ref = net(nd.array(x)).asnumpy()
+    path = os.path.join(str(tmp_path), "m.mxtpu")
+    deploy.export_model(net, (nd.array(x),), path, embed_params=False)
+    np.save(os.path.join(str(tmp_path), "x.npy"), x)
+    np.save(os.path.join(str(tmp_path), "ref.npy"), ref)
+    script = (
+        "import numpy as np\n"
+        "from mxnet_tpu import deploy\n"
+        "x = np.load(%r)\n"
+        "ref = np.load(%r)\n"
+        "pred = deploy.Predictor(%r).warm()\n"
+        "out = pred.predict(x).asnumpy()\n"
+        "assert np.abs(out - ref).max() < 1e-2, np.abs(out - ref).max()\n"
+        "print('ROUNDTRIP_OK')\n"
+        % (os.path.join(str(tmp_path), "x.npy"),
+           os.path.join(str(tmp_path), "ref.npy"), path))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run([sys.executable, "-c", script], cwd=repo, env=env,
+                       capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stderr
+    assert "ROUNDTRIP_OK" in r.stdout
+
+
+def test_warm_requires_params_on_separate_artifact():
+    mx.random.seed(6)
+    net = _net()
+    x = nd.array(np.random.RandomState(6).rand(2, 8).astype(np.float32))
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "m.mxtpu")
+        deploy.export_model(net, (x,), path, embed_params=False)
+        pred = deploy.Predictor(path)
+        assert pred.warm() is pred  # stored weights: warm-able
+        pred._weights = ()  # simulate a loader that strips weights
+        with pytest.raises(MXNetError, match="warm"):
+            pred.warm()
