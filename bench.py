@@ -640,6 +640,32 @@ def _cold_probe(workload):
     print("COLD_START_SECONDS=%.3f" % dt, flush=True)
 
 
+def _probe_subprocess(args, env, marker, label, timeout=900):
+    """Re-run THIS script in a fresh interpreter and parse one marker line.
+
+    The shared skeleton of every probe-style benchmark (cold start,
+    serving): claims like "compile+first-step in a fresh process" or
+    "zero live jits while serving" only mean anything in an interpreter
+    that did none of the parent's warmup, so the probe body runs behind
+    a ``bench.py --<mode> ...`` re-invocation and reports through a
+    single ``MARKER=payload`` stdout line.  Returns the payload string;
+    raises with the probe's stderr tail on any failure.
+    """
+    import subprocess
+
+    script = os.path.abspath(__file__)
+    proc = subprocess.run([sys.executable, script] + list(args), env=env,
+                          capture_output=True, text=True, timeout=timeout)
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr[-2000:] + "\n")
+        raise RuntimeError("%s probe exited %d" % (label, proc.returncode))
+    for line in proc.stdout.splitlines():
+        if line.startswith(marker):
+            return line[len(marker):]
+    sys.stderr.write(proc.stderr[-2000:] + "\n")
+    raise RuntimeError("%s probe printed no %s line" % (label, marker))
+
+
 def _run_cold_start(workload):
     """`<workload>_cold_start_seconds`: compile+first-step wall time in a
     FRESH process — the number the persistent compilation cache exists
@@ -652,7 +678,6 @@ def _run_cold_start(workload):
     number; the warm number and speedup ride along as extra fields.
     """
     import shutil
-    import subprocess
     import tempfile
 
     cache_dir = tempfile.mkdtemp(prefix="mxnet-coldstart-")
@@ -662,26 +687,15 @@ def _run_cold_start(workload):
         "MXNET_COMPILE_CACHE_DIR": cache_dir,
         "MXNET_COMPILE_CACHE_MIN_SECS": "0",
     })
-    script = os.path.abspath(__file__)
 
     def probe(label):
         t0 = time.perf_counter()
-        proc = subprocess.run(
-            [sys.executable, script, "--cold-probe", workload],
-            env=env, capture_output=True, text=True, timeout=900)
-        if proc.returncode != 0:
-            sys.stderr.write(proc.stderr[-2000:] + "\n")
-            raise RuntimeError("%s %s probe exited %d"
-                               % (workload, label, proc.returncode))
-        for line in proc.stdout.splitlines():
-            if line.startswith("COLD_START_SECONDS="):
-                secs = float(line.split("=", 1)[1])
-                _log("%s %s process: %.3fs compile+first step (wall %.1fs)"
-                     % (workload, label, secs, time.perf_counter() - t0))
-                return secs
-        sys.stderr.write(proc.stderr[-2000:] + "\n")
-        raise RuntimeError("%s %s probe printed no COLD_START_SECONDS"
-                           % (workload, label))
+        secs = float(_probe_subprocess(
+            ["--cold-probe", workload], env, "COLD_START_SECONDS=",
+            "%s %s" % (workload, label)))
+        _log("%s %s process: %.3fs compile+first step (wall %.1fs)"
+             % (workload, label, secs, time.perf_counter() - t0))
+        return secs
 
     try:
         cold = probe("cold")
@@ -690,6 +704,121 @@ def _run_cold_start(workload):
         shutil.rmtree(cache_dir, ignore_errors=True)
     return {"value": cold, "warm_seconds": round(warm, 3),
             "cold_warm_speedup": round(cold / warm, 2) if warm > 0 else 0.0}
+
+
+# serving bench workload: seeded, mixed-length (the length spread is
+# what continuous batching exploits and static batching wastes)
+_SERVE_N_REQUESTS = 64
+_SERVE_WORKLOAD = dict(rate_rps=2000.0, prompt_range=(2, 30),
+                       max_new_range=(2, 64), vocab_size=512, seed=0)
+
+
+def _serve_export(path):
+    """Subprocess entry (`--serve-export <path>`): AOT-compile the
+    llama_small serving bundle.  THIS process pays the jits so the probe
+    process can claim zero live compiles."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, serve
+    from mxnet_tpu.gluon.model_zoo import llama
+
+    mx.random.seed(0)
+    net = llama.llama_small()
+    net.initialize()
+    net(nd.array(np.zeros((1, 4), np.int32)))
+    g = serve.export_serving_bundle(net, path, page_size=8, num_pages=512,
+                                    max_batch=8, prefill_buckets=(16, 32))
+    _log("serve export: %s" % g.describe())
+    print("SERVE_EXPORT_OK", flush=True)
+
+
+def _serve_probe(path):
+    """Subprocess entry (`--serve-probe <bundle>`): measure continuous
+    batching against the static baseline IN THE SAME PROCESS.
+
+    Continuous: drive the seeded Poisson workload through the running
+    scheduler (drive_workload paces real submit threads' arrivals —
+    sleeps are fine here, this is a benchmark, not the unit suite).
+    Static: replay the identical request set through static_generate
+    (fixed groups, no mid-flight admission, each group at the pace of
+    its slowest member) on the same runner and arena — the measured gap
+    is pure scheduling.  Also reports the process's live-compile count:
+    nonzero means the AOT warm start regressed and the throughput
+    numbers are polluted by jit time.
+    """
+    from mxnet_tpu import serve
+    from mxnet_tpu.telemetry import metrics as telemetry_metrics
+
+    srv = serve.LlamaServer(path).start()
+    wl = serve.poisson_workload(_SERVE_N_REQUESTS, **_SERVE_WORKLOAD)
+    reqs, wall = serve.drive_workload(srv, wl, timeout=600)
+    srv.stop()
+    done = [r for r in reqs if r.error is None]
+    tokens = sum(len(r.tokens) for r in done)
+    sched = srv.scheduler
+
+    static_wl = serve.poisson_workload(_SERVE_N_REQUESTS, **_SERVE_WORKLOAD)
+    static_srv = serve.LlamaServer(path)  # NOT started: caller-side loop
+    t0 = time.perf_counter()
+    outs = static_srv.static_generate([req for _, req in static_wl])
+    static_wall = time.perf_counter() - t0
+    static_tokens = sum(len(t) for t in outs)
+
+    snap = telemetry_metrics.snapshot()
+    compiles = sum(s["value"] for s in snap.get(
+        "mxnet_compiles_total", {}).get("series", []))
+    doc = {
+        "continuous_tok_s": round(tokens / wall, 2),
+        "static_tok_s": round(static_tokens / static_wall, 2),
+        "completed": len(done),
+        "n_requests": len(reqs),
+        "ttft_p50_ms": round(sched.percentile("ttft", 0.50) * 1e3, 2),
+        "ttft_p99_ms": round(sched.percentile("ttft", 0.99) * 1e3, 2),
+        "tpot_p50_ms": round(sched.percentile("tpot", 0.50) * 1e3, 3),
+        "live_compiles": int(compiles),
+    }
+    print("SERVE_RESULT=%s" % json.dumps(doc), flush=True)
+
+
+def _run_serve(platform):
+    """`llama_serve_tok_s`: continuous-batching serving throughput over
+    the AOT bundle, vs the naive static-batch baseline in the same run.
+
+    Two fresh subprocesses through :func:`_probe_subprocess`:
+    ``--serve-export`` compiles the bundle (paying every jit), then
+    ``--serve-probe`` serves the seeded mixed-length Poisson workload
+    with zero live compiles and measures both schedulers on the same
+    runner+arena.  The metric value is continuous tok/s; the static
+    number, the speedup, and the TTFT/TPOT percentiles ride along.
+    """
+    import shutil
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="mxnet-serve-bench-")
+    bundle = os.path.join(tmp, "llama_small.mxaot")
+    env = dict(os.environ)
+    try:
+        _probe_subprocess(["--serve-export", bundle], env,
+                          "SERVE_EXPORT_OK", "serve export")
+        doc = json.loads(_probe_subprocess(
+            ["--serve-probe", bundle], env, "SERVE_RESULT=", "serve"))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    static = doc["static_tok_s"]
+    speedup = round(doc["continuous_tok_s"] / static, 2) if static else 0.0
+    _log("serve: %.1f tok/s continuous vs %.1f static (%.2fx), "
+         "ttft p50/p99 %.1f/%.1f ms, %d/%d completed, %d live compiles"
+         % (doc["continuous_tok_s"], static, speedup, doc["ttft_p50_ms"],
+            doc["ttft_p99_ms"], doc["completed"], doc["n_requests"],
+            doc["live_compiles"]))
+    return {"value": doc["continuous_tok_s"],
+            "static_tok_s": static,
+            "continuous_vs_static": speedup,
+            "ttft_p50_ms": doc["ttft_p50_ms"],
+            "ttft_p99_ms": doc["ttft_p99_ms"],
+            "tpot_p50_ms": doc["tpot_p50_ms"],
+            "completed": doc["completed"],
+            "n_requests": doc["n_requests"],
+            "live_compiles": doc["live_compiles"]}
 
 
 def _run_cold_resnet50(platform):
@@ -735,6 +864,9 @@ _SPECS = {
                   None),
     "cold_llama": (_run_cold_llama, "llama_cold_start_seconds", "seconds",
                    None),
+    # serving throughput: value is continuous-batching tok/s; the static
+    # baseline, speedup and TTFT percentiles ride along as extra fields
+    "serve": (_run_serve, "llama_serve_tok_s", "tokens/sec", None),
 }
 
 
@@ -779,6 +911,12 @@ def main():
     if len(sys.argv) >= 3 and sys.argv[1] == "--cold-probe":
         _cold_probe(sys.argv[2])  # subprocess mode: no _init_backend
         return
+    if len(sys.argv) >= 3 and sys.argv[1] == "--serve-export":
+        _serve_export(sys.argv[2])  # subprocess mode: pays the AOT jits
+        return
+    if len(sys.argv) >= 3 and sys.argv[1] == "--serve-probe":
+        _serve_probe(sys.argv[2])  # subprocess mode: zero live compiles
+        return
     t_start = time.perf_counter()
     requested = [a for a in sys.argv[1:] if a in _SPECS and a != "train"]
     try:
@@ -803,7 +941,7 @@ def main():
     for name in ("infer", "bert", "llama", "dispatch_eager",
                  "dispatch_eager_notelemetry", "dispatch_bulked",
                  "dispatch_bulked_train", "dispatch_bulked_long",
-                 "cold_resnet50", "cold_bert", "cold_llama"):
+                 "serve", "cold_resnet50", "cold_bert", "cold_llama"):
         elapsed = time.perf_counter() - t_start
         if elapsed > budget:
             _log("budget %.0fs spent (%.0fs elapsed); skipping %s"

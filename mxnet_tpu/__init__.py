@@ -182,6 +182,7 @@ from . import runtime  # noqa: F401
 from . import rtc  # noqa: F401
 from . import operator  # noqa: F401
 from . import deploy  # noqa: F401
+from . import serve  # noqa: F401
 from . import library  # noqa: F401
 from . import numpy as np  # noqa: F401
 from . import numpy_extension as npx  # noqa: F401

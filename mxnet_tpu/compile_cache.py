@@ -141,6 +141,9 @@ def _collector():
     _m.counter("mxnet_compile_cache_aot_loads_total",
                "AOT executables deserialized from bundles"
                ).set(snap["aot_loads"])
+    _m.counter("mxnet_compile_cache_aot_saves_total",
+               "AOT executables serialized into bundles"
+               ).set(snap["aot_saves"])
     if _state["enabled"]:
         _m.gauge("mxnet_compile_cache_size_bytes",
                  "Total bytes in the persistent compile cache directory"
@@ -326,6 +329,10 @@ def deserialize_compiled(blob, backend=None):
             % (type(e).__name__, e))
     with _lock:
         _stats["aot_loads"] += 1
+    # the aot_loads counter must be published even in processes where the
+    # disk cache is off (CPU serving procs): configure() never ran
+    # _ensure_observability there, so register the collector here too
+    _ensure_observability()
     return out
 
 
@@ -349,6 +356,7 @@ def save_bundle(path, entries, meta=None):
             pickle.dump(doc, f, protocol=pickle.HIGHEST_PROTOCOL)
     with _lock:
         _stats["aot_saves"] += len(doc["entries"])
+    _ensure_observability()
 
 
 def load_bundle(path):

@@ -34,6 +34,33 @@ from .base import MXNetError
 
 
 _MAGIC = b"MXTPU1\n"
+_AOT_MAGIC = b"MXAOT1\n"  # compile_cache bundles (serving tier)
+
+
+def export_serving_bundle(net, path, **kwargs):
+    """Export a Llama-family ``net`` as an AOT serving bundle: the
+    paged prefill/decode executable pair plus the KV-page geometry in
+    the bundle meta.  Thin re-export of
+    :func:`mxnet_tpu.serve.export_serving_bundle` so deployment code
+    has one module to import for both artifact kinds.  See
+    docs/serving.md."""
+    from .serve.model import export_serving_bundle as _export
+
+    return _export(net, path, **kwargs)
+
+
+def load_serving_bundle(path, expect_geometry=None):
+    """Load + validate a serving bundle: ``(KVGeometry, executables)``.
+
+    All checks run at load time — bundle kind, complete KV-page
+    geometry (page size, num pages, dtype, …), presence of every
+    executable the geometry names, and agreement with
+    ``expect_geometry`` when given — so a mismatched bundle fails here
+    with a field-by-field error instead of inside XLA on the first
+    decode."""
+    from .serve.model import load_serving_executables
+
+    return load_serving_executables(path, expect=expect_geometry)
 
 
 def export_model(net, example_inputs, path, embed_params=True,
@@ -132,7 +159,20 @@ class Predictor:
 
     def __init__(self, path):
         with open(path, "rb") as f:
-            if f.read(len(_MAGIC)) != _MAGIC:
+            magic = f.read(len(_MAGIC))
+            if magic == _AOT_MAGIC:
+                # an AOT serving bundle, not a StableHLO artifact: say so
+                # (and validate its KV geometry) instead of failing as a
+                # generic bad-magic or, worse, later inside XLA
+                from .serve.model import read_bundle_geometry
+
+                geometry, _ = read_bundle_geometry(path)
+                raise MXNetError(
+                    "%s is an AOT serving bundle [%s], not a StableHLO "
+                    "artifact — load it with serve.LlamaServer(path) or "
+                    "deploy.load_serving_bundle(path)"
+                    % (path, geometry.describe()))
+            if magic != _MAGIC:
                 raise MXNetError("%s is not an exported model" % path)
             hlen = int.from_bytes(f.read(8), "little")
             self.meta = json.loads(f.read(hlen).decode())

@@ -424,13 +424,22 @@ class BulkSegment:
         """Drop input/step references once flushed: lazy tape nodes and
         lazy NDArrays can pin _BulkRefs (→ this segment) long after the
         flush, and holding every ext buffer alive through them would keep
-        whole training steps' worth of inputs resident."""
+        whole training steps' worth of inputs resident.
+
+        ``refs`` is dropped too: each _BulkRef.value pins one output
+        buffer, and live consumers (lazy NDArrays, tape nodes) hold their
+        OWN _BulkRef — the segment's list is a pure duplicate.  Keeping it
+        would add one refcount to every output for the segment cache's
+        lifetime, so a segment-N output fed to segment N+1 as a dead ext
+        input could never pass the _donation refcount audit — exactly the
+        steady-state KV-update shape of a decode loop."""
         self.steps = ()
         self.key_parts = ()
         self.ext = ()
         self._ext_ids = None
         self.ext_src = ()
         self.write_vars = ()
+        self.refs = ()
 
 
 class Engine:
@@ -620,6 +629,24 @@ class Engine:
             return
         if {id(b) for b in buffers} & seg._ext_ids.keys():
             self.flush_bulk(origin)
+
+    def pending_reads(self, buffers):
+        """Which of ``buffers`` this thread's open segment still reads.
+
+        The page-liveness query behind ``serve.PagedKVArena``: a KV page
+        buffer that appears as an ext input of an unflushed segment must
+        not be overwritten or donated until that segment runs, so the
+        arena asks here before recycling pages and flushes (via
+        ``flush_if_referencing``) when the answer is non-empty.  Returns
+        the subset of ``buffers`` captured as ext inputs — empty tuple
+        when nothing pends, which is the cheap common case.
+        """
+        st = self._bulk_state()
+        seg = st.seg
+        if seg is None or seg.flushed or not seg.ext:
+            return ()
+        ids = seg._ext_ids
+        return tuple(b for b in buffers if id(b) in ids)
 
     # -- sync -------------------------------------------------------------
     def wait_for_var(self, var):

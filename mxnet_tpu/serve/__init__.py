@@ -1,0 +1,38 @@
+"""Production inference serving tier (docs/serving.md).
+
+Three coupled pieces (ISSUE 8 tentpole):
+
+- :mod:`.scheduler` — async continuous batching: bounded admission
+  queue with backpressure, prefill/decode split over bucketed sequence
+  lengths, slot recycling on EOS;
+- :mod:`.arena` — paged KV-cache arena: block tables over fixed-size
+  KV pages held as NDArrays, reuse gated on the engine's
+  var-dependency tracking (``Engine.pending_reads``);
+- :mod:`.model` — AOT-compiled paged prefill/decode executables in a
+  PR 7 ``MXAOT1`` bundle, so a serving process performs zero live jits.
+
+Quick start::
+
+    from mxnet_tpu import serve
+    from mxnet_tpu.gluon.model_zoo.llama import llama_small
+
+    net = llama_small(); net.initialize()
+    serve.export_serving_bundle(net, "llama.mxaot",
+                                page_size=8, num_pages=64, max_batch=4,
+                                prefill_buckets=(16, 32))
+    with serve.LlamaServer("llama.mxaot") as srv:
+        tokens = srv.generate([1, 2, 3], max_new_tokens=16)
+"""
+from .arena import PagedKVArena
+from .model import (KVGeometry, check_geometry, export_serving_bundle,
+                    geometry_from_net, load_serving_executables)
+from .scheduler import Request, Scheduler, ServeQueueFull, greedy_sampler
+from .server import (AOTRunner, LlamaServer, drive_workload,
+                     poisson_workload)
+
+__all__ = [
+    "AOTRunner", "KVGeometry", "LlamaServer", "PagedKVArena", "Request",
+    "Scheduler", "ServeQueueFull", "check_geometry", "drive_workload",
+    "export_serving_bundle", "geometry_from_net", "greedy_sampler",
+    "load_serving_executables", "poisson_workload",
+]

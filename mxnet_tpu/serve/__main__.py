@@ -1,0 +1,36 @@
+"""CLI: ``python -m mxnet_tpu.serve --bundle llama.mxaot --port 8000``.
+
+Loads the AOT serving bundle (zero live compiles), starts the
+continuous-batching loop, and exposes the stdlib HTTP front:
+``POST /v1/generate {"prompt": [...ids], "max_new_tokens": n}``,
+``GET /metrics`` (Prometheus), ``GET /healthz`` (scheduler stats).
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+from .server import LlamaServer
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="mxnet_tpu.serve")
+    ap.add_argument("--bundle", required=True,
+                    help="MXAOT1 serving bundle (export_serving_bundle)")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--queue-depth", type=int, default=None)
+    args = ap.parse_args(argv)
+    srv = LlamaServer(args.bundle, queue_depth=args.queue_depth).start()
+    host, port = srv.serve_http(port=args.port, host=args.host)
+    print("serving %s on http://%s:%d  [%s]"
+          % (args.bundle, host, port, srv.geometry.describe()))
+    try:
+        while True:
+            time.sleep(60)
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,164 @@
+"""Paged KV-cache arena: fixed-size pages, block tables, liveness-safe
+reuse.
+
+The whole cache is two NDArrays shaped ``(L, P, page, KV, D)`` (one for
+K, one for V).  Sequences own pages through host-side block tables —
+int32 rows mapping ``token_position // page_size`` to a page index — so
+admission never copies or reshapes cache memory: allocating a sequence
+is popping page ids off a free list, finishing one is pushing them back.
+Page 0 is reserved as the **null page**: inactive decode slots point
+their block-table row at it and scribble there harmlessly.
+
+Reuse safety rides on the engine's var-dependency tracking.  The decode
+/prefill executables *donate* the KV buffers on accelerator backends
+(XLA deletes them; see model._donate_kv for the CPU exception), and a
+freed page may be handed to a new sequence while imperative NDArray ops
+— a debug checksum, an eviction scorer — sit deferred in an open bulk
+segment that captured the old buffer as an ext input.  Before any
+donating call or page reuse the arena asks ``Engine.pending_reads`` and
+drains via ``flush_if_referencing``, so a pending segment always reads
+the pre-reuse snapshot (tests/test_serve.py stress-tests this).
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+from ..base import MXNetError
+from ..engine import Engine
+from ..telemetry import metrics as _metrics
+
+
+class PagedKVArena:
+    """Block-table allocator over two arena NDArrays (K and V)."""
+
+    def __init__(self, geometry):
+        import jax
+
+        from ..ndarray.ndarray import NDArray
+
+        self.geometry = geometry
+        shape = geometry.kv_shape()
+        dtype = np.dtype(geometry.dtype)
+        # device_put, NOT nd.zeros: a serving process must not push ops
+        # (zero live compiles — the tentpole claim of the AOT warm start)
+        self.kv_k = NDArray(jax.device_put(np.zeros(shape, dtype)))
+        self.kv_v = NDArray(jax.device_put(np.zeros(shape, dtype)))
+        # page 0 is the null page — never allocated
+        self._free = collections.deque(range(1, geometry.num_pages))
+        self._owner = {}          # page id -> owner tag (request id)
+        self.liveness_flushes = 0  # times a pending segment forced a flush
+
+    # -- capacity ---------------------------------------------------------
+    @property
+    def free_pages(self):
+        return len(self._free)
+
+    @property
+    def total_pages(self):
+        """Allocatable pages (the null page is not part of the budget)."""
+        return self.geometry.num_pages - 1
+
+    def pages_needed(self, total_tokens):
+        """Pages a sequence of ``total_tokens`` (prompt + budget) needs."""
+        return -(-int(total_tokens) // self.geometry.page_size)
+
+    def utilization(self):
+        used = self.total_pages - len(self._free)
+        return used / float(self.total_pages)
+
+    # -- alloc/free -------------------------------------------------------
+    def alloc(self, n_pages, owner):
+        """Claim ``n_pages`` for ``owner``; None when the arena is full.
+
+        Handing a previously-freed page to a new owner is the reuse
+        moment: drain any bulk segment still reading the arena buffers
+        first, so deferred imperative work observes the pre-reuse
+        snapshot before the next executable call overwrites the page.
+        """
+        n_pages = int(n_pages)
+        if n_pages <= 0:
+            raise MXNetError("alloc wants a positive page count")
+        if n_pages > self.geometry.max_pages_per_seq:
+            raise MXNetError(
+                "sequence needs %d pages but max_pages_per_seq is %d "
+                "(max context %d tokens)"
+                % (n_pages, self.geometry.max_pages_per_seq,
+                   self.geometry.max_context))
+        if n_pages > len(self._free):
+            return None
+        self.drain_pending_readers("serve_arena_alloc")
+        pages = [self._free.popleft() for _ in range(n_pages)]
+        for p in pages:
+            self._owner[p] = owner
+        self._gauges()
+        return pages
+
+    def free(self, pages, owner=None):
+        """Return ``pages`` to the free list (idempotence guarded)."""
+        for p in pages:
+            have = self._owner.pop(p, None)
+            if have is None or p == 0:
+                raise MXNetError("freeing page %d that is not allocated"
+                                 % p)
+            if owner is not None and have != owner:
+                raise MXNetError(
+                    "page %d is owned by %r, not %r — double free or "
+                    "block-table corruption" % (p, have, owner))
+            self._free.append(p)
+        self._gauges()
+
+    def owner_of(self, page):
+        return self._owner.get(page)
+
+    def block_row(self, pages):
+        """Block-table row (maxp,) int32 for a page list; unused entries
+        point at the null page."""
+        row = np.zeros(self.geometry.max_pages_per_seq, dtype=np.int32)
+        row[: len(pages)] = pages
+        return row
+
+    # -- engine liveness --------------------------------------------------
+    def buffers(self):
+        """The concrete arena buffers (for liveness queries/donation)."""
+        return (self.kv_k.data(), self.kv_v.data())
+
+    def drain_pending_readers(self, origin):
+        """Flush this thread's bulk segment if it still reads the arena.
+
+        Called before page reuse and before every donating executable
+        call: XLA deletes donated buffers even while a pending segment
+        holds them as ext inputs, and a recycled page must not be
+        overwritten under a deferred read.  Cheap no-op when nothing
+        pends (the steady-state serving case — no imperative ops at all).
+        """
+        eng = Engine.get()
+        bufs = self.buffers()
+        if eng.pending_reads(bufs):
+            eng.flush_if_referencing(bufs, origin)
+            self.liveness_flushes += 1
+            if _metrics.enabled():
+                _metrics.counter(
+                    "mxnet_serve_arena_liveness_flushes_total",
+                    help="bulk-segment flushes forced because a pending "
+                         "segment still read the KV arena").inc()
+
+    def adopt(self, new_k, new_v):
+        """Swap in the post-call arena buffers (when donation is on the
+        executables delete the old ones, so this is the only live
+        reference handoff; without donation the old buffers simply drop
+        their last reference here)."""
+        self.kv_k._set_data(new_k)
+        self.kv_v._set_data(new_v)
+
+    def _gauges(self):
+        if _metrics.enabled():
+            _metrics.gauge(
+                "mxnet_serve_arena_utilization",
+                help="fraction of allocatable KV pages in use",
+            ).set(self.utilization())
+            _metrics.gauge(
+                "mxnet_serve_arena_pages_in_use",
+                help="allocated KV pages (null page excluded)",
+            ).set(self.total_pages - len(self._free))

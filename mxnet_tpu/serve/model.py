@@ -1,0 +1,524 @@
+"""Paged-attention prefill/decode graphs + AOT serving bundles.
+
+The serving tier never runs the gluon model: at export time the Llama
+weights are pulled out of the block tree and baked as XLA constants into
+two purpose-built graphs —
+
+- ``prefill_<T>`` (one per sequence-length bucket): runs the whole
+  prompt through full causal attention, scatters every K/V row into the
+  paged arena, and returns the logits of the last real token;
+- ``decode``: one token per active slot, batched over the server's
+  fixed ``max_batch`` — RoPE at the slot's position, scatter into the
+  page the block table names, then attention over the gathered pages.
+
+On accelerator backends both donate the KV arena buffers (argnums 0/1),
+so the steady-state decode loop updates the cache in place with zero
+copies; on CPU donation is off by default because donated aliasing does
+not survive executable serialization there (see _donate_kv).  The compiled
+executables ship in a PR 7 ``MXAOT1`` bundle whose meta carries the
+KV-page geometry; a serving process deserializes them at startup and
+performs **zero live jits** (asserted by the serve-smoke CI job).
+
+Numerics match ``gluon.model_zoo.llama`` exactly: RMSNorm in f32
+(``lax.rsqrt``), rotate-half RoPE with the same inv-freq table, GQA via
+post-projection head repeat — the paged decode's logits agree with the
+full-sequence forward to float tolerance (tests/test_serve_e2e.py).
+"""
+from __future__ import annotations
+
+import math
+import os
+
+import numpy as np
+
+from ..base import MXNetError
+
+BUNDLE_KIND = "serving"
+
+# geometry fields a serving bundle must carry; the load-time validator
+# refuses a bundle missing any of them (satellite: fail at load, not
+# inside XLA on the first mismatched decode)
+_GEOM_INT_FIELDS = ("num_layers", "num_heads", "num_kv_heads", "head_dim",
+                    "units", "hidden_size", "vocab_size", "page_size",
+                    "num_pages", "max_pages_per_seq", "max_batch")
+
+
+class KVGeometry:
+    """Shape contract between exporter, arena, scheduler and executables.
+
+    Everything the serving process must agree on with the bundle lives
+    here: the paged-KV layout (``page_size`` tokens per page,
+    ``num_pages`` total — page 0 is reserved as the null page inactive
+    slots scribble on), the decode batch width ``max_batch`` the
+    executable was compiled for, and the prefill bucket ladder.
+    """
+
+    def __init__(self, num_layers, num_heads, num_kv_heads, head_dim,
+                 units, hidden_size, vocab_size, page_size, num_pages,
+                 max_pages_per_seq, max_batch, prefill_buckets,
+                 dtype="float32", rope_base=10000.0, eps=1e-6,
+                 tie_embeddings=False):
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.num_kv_heads = int(num_kv_heads)
+        self.head_dim = int(head_dim)
+        self.units = int(units)
+        self.hidden_size = int(hidden_size)
+        self.vocab_size = int(vocab_size)
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.max_pages_per_seq = int(max_pages_per_seq)
+        self.max_batch = int(max_batch)
+        self.prefill_buckets = tuple(sorted(int(b) for b in prefill_buckets))
+        self.dtype = str(dtype)
+        self.rope_base = float(rope_base)
+        self.eps = float(eps)
+        self.tie_embeddings = bool(tie_embeddings)
+        self.validate()
+
+    @property
+    def max_context(self):
+        """Tokens addressable per sequence (prompt + generated)."""
+        return self.max_pages_per_seq * self.page_size
+
+    def validate(self):
+        if self.page_size <= 0 or self.num_pages <= 1:
+            raise MXNetError(
+                "KV geometry needs page_size>0 and num_pages>1 (page 0 is "
+                "the reserved null page); got page_size=%d num_pages=%d"
+                % (self.page_size, self.num_pages))
+        if self.max_batch <= 0 or self.max_pages_per_seq <= 0:
+            raise MXNetError("KV geometry needs max_batch>0 and "
+                             "max_pages_per_seq>0")
+        if not self.prefill_buckets:
+            raise MXNetError("KV geometry needs at least one prefill bucket")
+        if self.prefill_buckets[-1] > self.max_context:
+            raise MXNetError(
+                "largest prefill bucket (%d) exceeds max context %d "
+                "(= max_pages_per_seq %d x page_size %d)"
+                % (self.prefill_buckets[-1], self.max_context,
+                   self.max_pages_per_seq, self.page_size))
+        if self.num_heads % self.num_kv_heads:
+            raise MXNetError("num_heads must be a multiple of num_kv_heads")
+
+    def to_dict(self):
+        return {
+            "num_layers": self.num_layers, "num_heads": self.num_heads,
+            "num_kv_heads": self.num_kv_heads, "head_dim": self.head_dim,
+            "units": self.units, "hidden_size": self.hidden_size,
+            "vocab_size": self.vocab_size, "page_size": self.page_size,
+            "num_pages": self.num_pages,
+            "max_pages_per_seq": self.max_pages_per_seq,
+            "max_batch": self.max_batch,
+            "prefill_buckets": list(self.prefill_buckets),
+            "dtype": self.dtype, "rope_base": self.rope_base,
+            "eps": self.eps, "tie_embeddings": self.tie_embeddings,
+        }
+
+    @classmethod
+    def from_dict(cls, d, origin="bundle"):
+        missing = [f for f in _GEOM_INT_FIELDS if f not in d]
+        if missing or "prefill_buckets" not in d:
+            raise MXNetError(
+                "%s: serving bundle geometry is missing %s — re-export "
+                "with serve.export_serving_bundle"
+                % (origin, ", ".join(missing) or "prefill_buckets"))
+        return cls(**d)
+
+    def kv_shape(self):
+        """Arena buffer shape: (L, P, page, KV-heads, head-dim)."""
+        return (self.num_layers, self.num_pages, self.page_size,
+                self.num_kv_heads, self.head_dim)
+
+    def describe(self):
+        return ("layers=%d heads=%d/%d head_dim=%d pages=%dx%d "
+                "max_batch=%d buckets=%s dtype=%s"
+                % (self.num_layers, self.num_heads, self.num_kv_heads,
+                   self.head_dim, self.num_pages, self.page_size,
+                   self.max_batch, list(self.prefill_buckets), self.dtype))
+
+
+def _env_int(name, default):
+    v = os.environ.get(name, "")
+    return int(v) if v.strip() else default
+
+
+def default_buckets():
+    """Prefill bucket ladder from MXNET_SERVE_BUCKETS (docs/env_vars.md)."""
+    raw = os.environ.get("MXNET_SERVE_BUCKETS", "").strip()
+    if not raw:
+        return (32, 128, 512)
+    try:
+        return tuple(sorted({int(t) for t in raw.split(",") if t.strip()}))
+    except ValueError:
+        raise MXNetError("MXNET_SERVE_BUCKETS must be comma-separated ints, "
+                         "got %r" % raw)
+
+
+def geometry_from_net(net, page_size=None, num_pages=None, max_batch=None,
+                      prefill_buckets=None, max_pages_per_seq=None):
+    """Derive a :class:`KVGeometry` from a ``LlamaModel`` block tree,
+    filling paging knobs from ``MXNET_SERVE_*`` env defaults."""
+    blocks = list(net.blocks._children.values())
+    if not blocks:
+        raise MXNetError("model has no decoder blocks")
+    attn = blocks[0].attn
+    embed_w = net.embed.weight.data()
+    page_size = page_size or _env_int("MXNET_SERVE_PAGE_SIZE", 16)
+    num_pages = num_pages or _env_int("MXNET_SERVE_NUM_PAGES", 512)
+    max_batch = max_batch or _env_int("MXNET_SERVE_MAX_BATCH", 8)
+    buckets = tuple(prefill_buckets) if prefill_buckets \
+        else default_buckets()
+    if max_pages_per_seq is None:
+        # default: one sequence may address half the arena, capped so the
+        # bucket ladder always fits
+        need = -(-max(buckets) // page_size)
+        max_pages_per_seq = max(need + 1, (num_pages - 1) // 2)
+    return KVGeometry(
+        num_layers=len(blocks), num_heads=attn._heads,
+        num_kv_heads=attn._kv_heads,
+        head_dim=attn._units // attn._heads, units=net._units,
+        hidden_size=blocks[0].ffn.gate.weight.shape[0],
+        vocab_size=embed_w.shape[0], page_size=page_size,
+        num_pages=num_pages, max_pages_per_seq=max_pages_per_seq,
+        max_batch=max_batch, prefill_buckets=buckets,
+        dtype=str(embed_w.dtype), rope_base=attn._base,
+        eps=blocks[0].attn_norm._eps, tie_embeddings=net._tie)
+
+
+def _pull(param):
+    """Export-time weight pull — runs once per parameter per export, not
+    on any serving path."""
+    return param.data().asnumpy()  # mxlint: allow-host-sync
+
+
+def extract_weights(net):
+    """Pull the Llama weights out of the block tree as numpy arrays.
+
+    Returns ``(embed, layers, norm, head)`` where ``layers`` is a list of
+    per-block dicts; ``head`` is None for tied embeddings.  Dense weights
+    keep the gluon (out, in) layout — the graphs apply ``x @ W.T``.
+    """
+    embed = _pull(net.embed.weight)
+    layers = []
+    for blk in net.blocks._children.values():
+        layers.append({
+            "attn_norm": _pull(blk.attn_norm.weight),
+            "q": _pull(blk.attn.q_proj.weight),
+            "k": _pull(blk.attn.k_proj.weight),
+            "v": _pull(blk.attn.v_proj.weight),
+            "o": _pull(blk.attn.o_proj.weight),
+            "ffn_norm": _pull(blk.ffn_norm.weight),
+            "gate": _pull(blk.ffn.gate.weight),
+            "up": _pull(blk.ffn.up.weight),
+            "down": _pull(blk.ffn.down.weight),
+        })
+    norm = _pull(net.norm.weight)
+    head = None if net._tie else _pull(net.lm_head.weight)
+    return embed, layers, norm, head
+
+
+def _rmsnorm(x, gamma, eps):
+    """f32-accumulated RMSNorm, bitwise-matching ops.nn.RMSNorm."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    x32 = x.astype(jnp.float32)
+    y = x32 * lax.rsqrt(jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+                        + eps)
+    return (y * gamma.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope_tables(positions, head_dim, base):
+    """cos/sin tables (…, half) for rotate-half RoPE at ``positions``
+    (float32, any leading shape) — same inv-freq form as llama._rope."""
+    import jax.numpy as jnp
+
+    half = head_dim // 2
+    inv = jnp.arange(0, half, dtype=jnp.float32) * (-2.0 / head_dim)
+    inv_freq = jnp.exp(inv * math.log(base))
+    freqs = positions[..., None] * inv_freq
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def _rotate(x, cos, sin):
+    """Rotate-half on (…, D); cos/sin broadcast over the head axis."""
+    import jax.numpy as jnp
+
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                           axis=-1)
+
+
+def build_decode_fn(weights, geometry):
+    """One batched decode step over the paged arena.
+
+    Signature (all positional; kv buffers donated by the AOT compile
+    when the backend supports it — see ``_donate_kv``):
+    ``(kv_k, kv_v, tokens (B,) i32, positions (B,) i32,
+    block_table (B, maxp) i32) -> (kv_k, kv_v, logits (B, V) f32)``.
+
+    Inactive slots point their block-table row at the reserved null page
+    0 with position 0 — their scatters land there harmlessly and their
+    logits are discarded by the scheduler.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    embed, layers, norm, head = weights
+    g = geometry
+    H, KV, D, S = g.num_heads, g.num_kv_heads, g.head_dim, g.page_size
+    scale = 1.0 / math.sqrt(D)
+    ctx = g.max_pages_per_seq * S
+
+    def decode(kv_k, kv_v, tokens, positions, block_table):
+        b = tokens.shape[0]
+        x = embed[tokens]                                    # (B, U)
+        cos, sin = _rope_tables(positions.astype(jnp.float32), D,
+                                g.rope_base)                 # (B, half)
+        cos, sin = cos[:, None, :], sin[:, None, :]          # (B, 1, half)
+        rows = jnp.arange(b)
+        pid = block_table[rows, positions // S]              # (B,)
+        slot = positions % S
+        valid = jnp.arange(ctx)[None, :] <= positions[:, None]  # (B, C)
+        for li, lw in enumerate(layers):
+            h = _rmsnorm(x, lw["attn_norm"], g.eps)
+            q = _rotate((h @ lw["q"].T).reshape(b, H, D), cos, sin)
+            k = _rotate((h @ lw["k"].T).reshape(b, KV, D), cos, sin)
+            v = (h @ lw["v"].T).reshape(b, KV, D)
+            kv_k = kv_k.at[li, pid, slot].set(k)
+            kv_v = kv_v.at[li, pid, slot].set(v)
+            # gather this sequence's pages: (B, maxp, S, KV, D) -> (B, C,…)
+            keys = kv_k[li, block_table].reshape(b, ctx, KV, D)
+            vals = kv_v[li, block_table].reshape(b, ctx, KV, D)
+            keys = jnp.repeat(keys, H // KV, axis=2)         # GQA repeat
+            vals = jnp.repeat(vals, H // KV, axis=2)
+            scores = jnp.einsum("bhd,bchd->bhc", q, keys) * scale
+            scores = jnp.where(valid[:, None, :],
+                               scores.astype(jnp.float32), -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+            att = jnp.einsum("bhc,bchd->bhd", probs, vals)
+            x = x + att.reshape(b, H * D) @ lw["o"].T
+            h2 = _rmsnorm(x, lw["ffn_norm"], g.eps)
+            x = x + (jax.nn.silu(h2 @ lw["gate"].T)
+                     * (h2 @ lw["up"].T)) @ lw["down"].T
+        xh = _rmsnorm(x, norm, g.eps)
+        hw = embed if head is None else head
+        return kv_k, kv_v, (xh @ hw.T).astype(jnp.float32)
+
+    return decode
+
+
+def build_prefill_fn(weights, geometry, bucket):
+    """Whole-prompt pass for one padded bucket length ``T``.
+
+    ``(kv_k, kv_v, tokens (T,) i32, length () i32,
+    block_table (maxp,) i32) -> (kv_k, kv_v, logits (V,) f32)``.
+
+    Every position's K/V is scattered into the arena (pad positions land
+    on the null page or on this sequence's own not-yet-read slots, both
+    harmless); the returned logits are the last REAL token's — the first
+    generated token comes straight out of prefill.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    embed, layers, norm, head = weights
+    g = geometry
+    H, KV, D, S = g.num_heads, g.num_kv_heads, g.head_dim, g.page_size
+    scale = 1.0 / math.sqrt(D)
+    t = int(bucket)
+
+    def prefill(kv_k, kv_v, tokens, length, block_table):
+        x = embed[tokens]                                    # (T, U)
+        pos = jnp.arange(t)
+        cos, sin = _rope_tables(pos.astype(jnp.float32), D, g.rope_base)
+        cos, sin = cos[:, None, :], sin[:, None, :]          # (T, 1, half)
+        pid = block_table[pos // S]                          # (T,)
+        slot = pos % S
+        causal = (pos[None, :] <= pos[:, None]) \
+            & (pos[None, :] < length)                        # (T, T)
+        for li, lw in enumerate(layers):
+            h = _rmsnorm(x, lw["attn_norm"], g.eps)
+            q = _rotate((h @ lw["q"].T).reshape(t, H, D), cos, sin)
+            k = _rotate((h @ lw["k"].T).reshape(t, KV, D), cos, sin)
+            v = (h @ lw["v"].T).reshape(t, KV, D)
+            kv_k = kv_k.at[li, pid, slot].set(k)
+            kv_v = kv_v.at[li, pid, slot].set(v)
+            keys = jnp.repeat(k, H // KV, axis=1)            # (T, H, D)
+            vals = jnp.repeat(v, H // KV, axis=1)
+            scores = jnp.einsum("thd,uhd->htu", q, keys) * scale
+            scores = jnp.where(causal[None, :, :],
+                               scores.astype(jnp.float32), -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+            att = jnp.einsum("htu,uhd->thd", probs, vals)
+            x = x + att.reshape(t, H * D) @ lw["o"].T
+            h2 = _rmsnorm(x, lw["ffn_norm"], g.eps)
+            x = x + (jax.nn.silu(h2 @ lw["gate"].T)
+                     * (h2 @ lw["up"].T)) @ lw["down"].T
+        xh = _rmsnorm(x, norm, g.eps)
+        last = jnp.take(xh, length - 1, axis=0)              # (U,)
+        hw = embed if head is None else head
+        return kv_k, kv_v, (last @ hw.T).astype(jnp.float32)
+
+    return prefill
+
+
+def _donate_kv():
+    """Should the serving executables donate the KV buffers (args 0, 1)?
+
+    ``MXNET_SERVE_AOT_DONATE`` = ``1`` forces on, ``0`` forces off,
+    unset/``auto`` donates everywhere EXCEPT the CPU backend.  On CPU
+    (jax 0.4.37) an executable that carries input-output aliasing does
+    not survive ``serialize_executable`` → ``deserialize_and_load``:
+    the reloaded binary's aliasing metadata is wrong and every run
+    corrupts the allocator heap — results stay correct but the process
+    dies with ``corrupted double-linked list`` / SIGSEGV at teardown
+    (~50% of runs; bisected fresh-vs-deserialized × donate-vs-not, only
+    the deserialized+donated cell fails).  Donation-free decode costs
+    one KV-arena copy per step, which CPU serving (tests, smoke CI)
+    can afford; accelerator backends keep the zero-copy path.
+    """
+    mode = os.environ.get("MXNET_SERVE_AOT_DONATE", "auto").lower()
+    if mode in ("1", "true"):
+        return True
+    if mode in ("0", "false"):
+        return False
+    import jax
+
+    return jax.default_backend() != "cpu"
+
+
+def _aot_compile(fn, avals):
+    """jit → lower → compile, KV buffers (argnums 0, 1) donated when
+    the backend supports aliasing across serialization (_donate_kv)."""
+    import jax
+
+    kwargs = {"donate_argnums": (0, 1)} if _donate_kv() else {}
+    return jax.jit(fn, **kwargs).lower(*avals).compile()
+
+
+def compile_serving_executables(net, geometry):
+    """Build + AOT-compile the decode and per-bucket prefill graphs.
+
+    Returns ``{name: jax.stages.Compiled}`` with weights baked in as
+    constants — the bundle is self-contained, no .params sidecar.
+    """
+    import jax
+
+    g = geometry
+    raw = extract_weights(net)
+    dev = lambda a: jax.device_put(np.asarray(a, dtype=g.dtype))  # noqa: E731
+    weights = (dev(raw[0]), [{k: dev(v) for k, v in lw.items()}
+                             for lw in raw[1]], dev(raw[2]),
+               None if raw[3] is None else dev(raw[3]))
+    kv = jax.ShapeDtypeStruct(g.kv_shape(), np.dtype(g.dtype))
+    i32 = np.dtype(np.int32)
+    exes = {}
+    dec_avals = (kv, kv, jax.ShapeDtypeStruct((g.max_batch,), i32),
+                 jax.ShapeDtypeStruct((g.max_batch,), i32),
+                 jax.ShapeDtypeStruct((g.max_batch, g.max_pages_per_seq),
+                                      i32))
+    exes["decode"] = _aot_compile(build_decode_fn(weights, g), dec_avals)
+    for b in g.prefill_buckets:
+        pf_avals = (kv, kv, jax.ShapeDtypeStruct((b,), i32),
+                    jax.ShapeDtypeStruct((), i32),
+                    jax.ShapeDtypeStruct((g.max_pages_per_seq,), i32))
+        exes["prefill_%d" % b] = _aot_compile(
+            build_prefill_fn(weights, g, b), pf_avals)
+    return exes
+
+
+def export_serving_bundle(net, path, page_size=None, num_pages=None,
+                          max_batch=None, prefill_buckets=None,
+                          max_pages_per_seq=None):
+    """Export ``net`` as a self-contained MXAOT1 serving bundle.
+
+    The bundle carries the AOT-compiled decode + per-bucket prefill
+    executables (weights baked in) and the :class:`KVGeometry` in its
+    meta, so ``serve.LlamaServer(path)`` starts with zero live compiles.
+    Paging knobs default from ``MXNET_SERVE_*`` (docs/env_vars.md).
+    Returns the geometry.
+    """
+    from .. import compile_cache as _ccache
+
+    g = geometry_from_net(net, page_size=page_size, num_pages=num_pages,
+                          max_batch=max_batch,
+                          prefill_buckets=prefill_buckets,
+                          max_pages_per_seq=max_pages_per_seq)
+    exes = compile_serving_executables(net, g)
+    entries = {name: _ccache.serialize_compiled(c)
+               for name, c in exes.items()}
+    _ccache.save_bundle(path, entries,
+                        meta={"kind": BUNDLE_KIND,
+                              "geometry": g.to_dict()})
+    return g
+
+
+def read_bundle_geometry(path):
+    """Parse + validate a serving bundle's KV geometry WITHOUT
+    deserializing any executable (cheap inspection: Predictor's
+    redirect error, doctor tools).  Returns ``(KVGeometry, doc)``."""
+    from .. import compile_cache as _ccache
+
+    doc = _ccache.load_bundle(path)
+    meta = doc.get("meta", {})
+    if meta.get("kind") != BUNDLE_KIND:
+        raise MXNetError(
+            "%s is not a serving bundle (kind=%r) — export one with "
+            "serve.export_serving_bundle(net, path)"
+            % (path, meta.get("kind")))
+    return KVGeometry.from_dict(meta.get("geometry", {}), origin=path), doc
+
+
+def load_serving_executables(path, expect=None):
+    """Load a serving bundle: ``(KVGeometry, {name: Compiled})``.
+
+    Validation happens HERE, not on the first decode: the bundle must be
+    a serving bundle, its meta must carry a complete geometry, every
+    executable named by the geometry must be present, and — when the
+    caller passes ``expect`` (a KVGeometry or partial dict) — the
+    KV-page geometry must agree field by field, each mismatch named in
+    the error.
+    """
+    from .. import compile_cache as _ccache
+
+    g, doc = read_bundle_geometry(path)
+    if expect is not None:
+        check_geometry(g, expect, origin=path)
+    want = ["decode"] + ["prefill_%d" % b for b in g.prefill_buckets]
+    entries = doc.get("entries", {})
+    missing = [n for n in want if n not in entries]
+    if missing:
+        raise MXNetError("%s: serving bundle is missing executables %s "
+                         "for geometry [%s]"
+                         % (path, missing, g.describe()))
+    exes = {n: _ccache.deserialize_compiled(entries[n]) for n in want}
+    return g, exes
+
+
+def check_geometry(got, expect, origin="bundle"):
+    """Field-by-field KV geometry comparison with a clear error.
+
+    ``expect``: KVGeometry or a dict of the subset to pin (e.g.
+    ``{"page_size": 16, "dtype": "float32"}``).
+    """
+    exp = expect.to_dict() if isinstance(expect, KVGeometry) else dict(expect)
+    gd = got.to_dict()
+    bad = []
+    for field, want in exp.items():
+        if field not in gd:
+            raise MXNetError("%s: unknown geometry field %r" % (origin,
+                                                                field))
+        have = gd[field]
+        if field == "prefill_buckets":
+            want = list(want)
+        if have != want:
+            bad.append("%s: bundle has %r, caller expects %r"
+                       % (field, have, want))
+    if bad:
+        raise MXNetError(
+            "%s: KV-page geometry mismatch — refusing to serve (this "
+            "would fail inside XLA on the first decode):\n  %s"
+            % (origin, "\n  ".join(bad)))

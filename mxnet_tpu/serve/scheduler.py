@@ -1,0 +1,384 @@
+"""Continuous-batching scheduler: the deterministic serving core.
+
+One ``step()`` is the whole policy — admit, prefill, decode, complete:
+
+1. **admit**: while a decode slot is free, the admission queue is
+   non-empty, and the arena can page the head request, pop it, allocate
+   its pages, pick the smallest prefill bucket covering the prompt, and
+   run prefill — the first generated token falls out of the prefill
+   logits, which is when TTFT stops ticking;
+2. **decode**: one batched step over every active slot (inactive slots
+   ride along pointing at the arena's null page);
+3. **complete**: slots whose newest token hit EOS or the budget free
+   their pages, fulfill their futures, and are immediately reusable —
+   the next ``step()`` refills them from the queue (slot recycling).
+
+The class is jax-free: model execution hides behind a two-method runner
+(``prefill``/``decode``), so the scheduler tests drive ``step()`` with a
+scripted fake and no sleeps, while the server plugs in the AOT runner
+and a background thread.  Backpressure is a bounded admission queue —
+``submit`` raises :class:`ServeQueueFull` instead of buffering without
+limit (HTTP surfaces it as 503).
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import os
+import threading
+import time
+
+import numpy as np
+
+from ..base import MXNetError
+from ..telemetry import metrics as _metrics
+
+# TTFT/TPOT bucket ladders (seconds): decode steps sit well under the
+# engine's default op buckets, so the serve histograms get their own
+_TTFT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+_TPOT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                 0.025, 0.05, 0.1, 0.25, 1.0)
+
+
+class ServeQueueFull(MXNetError):
+    """Admission queue at MXNET_SERVE_QUEUE_DEPTH — shed load upstream."""
+
+
+class Request:
+    """One generation request and its (thread-safe) result future."""
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt, max_new_tokens=None, eos_id=None):
+        self.rid = next(Request._ids)
+        self.prompt = [int(t) for t in prompt]
+        if not self.prompt:
+            raise MXNetError("empty prompt")
+        self.max_new_tokens = int(max_new_tokens
+                                  if max_new_tokens is not None
+                                  else _env_int("MXNET_SERVE_MAX_NEW", 128))
+        if self.max_new_tokens <= 0:
+            raise MXNetError("max_new_tokens must be positive")
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.tokens = []          # generated ids (never includes prompt)
+        self.submit_t = None      # clock() at admission-queue entry
+        self.first_token_t = None  # clock() when prefill produced token 0
+        self.finish_t = None
+        self.error = None
+        self._done = threading.Event()
+
+    @property
+    def ttft(self):
+        if self.submit_t is None or self.first_token_t is None:
+            return None
+        return self.first_token_t - self.submit_t
+
+    def result(self, timeout=None):
+        """Block for the generated tokens (raises the request's error)."""
+        if not self._done.wait(timeout):
+            raise MXNetError("request %d still in flight after %ss"
+                             % (self.rid, timeout))
+        if self.error is not None:
+            raise self.error
+        return list(self.tokens)
+
+    def done(self):
+        return self._done.is_set()
+
+
+class _Slot:
+    """One in-flight decode lane: request + position + block-table row."""
+
+    __slots__ = ("req", "pages", "row", "position")
+
+    def __init__(self, req, pages, row, position):
+        self.req = req
+        self.pages = pages
+        self.row = row            # np (maxp,) int32 block-table row
+        self.position = position  # next token's position (0-based)
+
+
+def _env_int(name, default):
+    v = os.environ.get(name, "")
+    return int(v) if v.strip() else default
+
+
+def greedy_sampler(logits, req):
+    """Default sampler: argmax on host (deterministic, no device work)."""
+    return int(np.argmax(logits))
+
+
+class Scheduler:
+    """Admission + in-flight batching over a runner and a page arena.
+
+    ``runner`` needs two methods (numpy in, numpy out):
+    ``prefill(bucket, tokens (Lp,), length, block_row) -> logits (V,)``
+    and ``decode(tokens (B,), positions (B,), block_tables (B, maxp))
+    -> logits (B, V)``.  ``clock`` is injectable so tests measure
+    nothing real.
+    """
+
+    def __init__(self, runner, arena, queue_depth=None, sampler=None,
+                 clock=time.monotonic):
+        self.runner = runner
+        self.arena = arena
+        self.geometry = arena.geometry
+        self.queue_depth = int(queue_depth if queue_depth is not None
+                               else _env_int("MXNET_SERVE_QUEUE_DEPTH", 64))
+        self.sampler = sampler or greedy_sampler
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._queue = collections.deque()
+        self._slots = [None] * self.geometry.max_batch
+        self._work = threading.Condition(self._lock)
+        # aggregate counters (served through stats()/telemetry)
+        self.admitted = 0
+        self.rejected = 0
+        self.completed = 0
+        self.tokens_generated = 0
+        self.decode_steps = 0
+        self.prefills = 0
+        self._ttfts = collections.deque(maxlen=4096)
+        self._tpots = collections.deque(maxlen=4096)
+
+    # -- admission --------------------------------------------------------
+    def pick_bucket(self, prompt_len):
+        """Smallest prefill bucket covering ``prompt_len`` (None: too
+        long for the ladder — reject at submit, not at prefill)."""
+        for b in self.geometry.prefill_buckets:
+            if prompt_len <= b:
+                return b
+        return None
+
+    def submit(self, req):
+        """Queue ``req``; backpressure + obvious rejections happen NOW."""
+        if self.pick_bucket(len(req.prompt)) is None:
+            self._reject(req, MXNetError(
+                "prompt of %d tokens exceeds the largest prefill bucket "
+                "(%d) this bundle was exported with"
+                % (len(req.prompt), self.geometry.prefill_buckets[-1])))
+            return req
+        total = len(req.prompt) + req.max_new_tokens
+        if total > self.geometry.max_context:
+            self._reject(req, MXNetError(
+                "prompt %d + max_new %d exceeds max context %d (= "
+                "max_pages_per_seq x page_size)"
+                % (len(req.prompt), req.max_new_tokens,
+                   self.geometry.max_context)))
+            return req
+        with self._lock:
+            if len(self._queue) >= self.queue_depth:
+                self.rejected += 1
+                self._count_req("rejected")
+                raise ServeQueueFull(
+                    "admission queue full (%d waiting, "
+                    "MXNET_SERVE_QUEUE_DEPTH=%d)"
+                    % (len(self._queue), self.queue_depth))
+            req.submit_t = self.clock()
+            self._queue.append(req)
+            self._gauges_locked()
+            self._work.notify()
+        return req
+
+    def _reject(self, req, err):
+        self.rejected += 1
+        self._count_req("rejected")
+        req.error = err
+        req.finish_t = self.clock()
+        req._done.set()
+
+    # -- the scheduling step ---------------------------------------------
+    def step(self):
+        """One admit→prefill→decode→complete round; True if any work ran."""
+        worked = self._admit()
+        if self._decode_once():
+            worked = True
+        return worked
+
+    def _admit(self):
+        admitted = False
+        while True:
+            with self._lock:
+                free = [i for i, s in enumerate(self._slots) if s is None]
+                if not free or not self._queue:
+                    break
+                req = self._queue[0]
+                pages = self.arena.alloc(
+                    self.arena.pages_needed(
+                        len(req.prompt) + req.max_new_tokens), req.rid)
+                if pages is None:
+                    break  # head-of-line waits for pages, not forever slots
+                self._queue.popleft()
+                slot_i = free[0]
+                slot = _Slot(req, pages, self.arena.block_row(pages),
+                             position=len(req.prompt))
+                self._slots[slot_i] = slot
+                self.admitted += 1
+                self._count_req("admitted")
+                self._gauges_locked()
+            self._prefill(slot)
+            admitted = True
+        return admitted
+
+    def _prefill(self, slot):
+        req = slot.req
+        bucket = self.pick_bucket(len(req.prompt))
+        t0 = self.clock()
+        try:
+            logits = self.runner.prefill(
+                bucket, np.asarray(req.prompt, dtype=np.int32),
+                len(req.prompt), slot.row)
+        except Exception as e:  # poison the request, free the lane
+            self._fail_slot(slot, e)
+            return
+        self.prefills += 1
+        first = self.sampler(logits, req)
+        req.tokens.append(first)
+        self.tokens_generated += 1
+        req.first_token_t = self.clock()
+        ttft = req.first_token_t - req.submit_t
+        self._ttfts.append(ttft)
+        if _metrics.enabled():
+            _metrics.histogram(
+                "mxnet_serve_ttft_seconds",
+                help="submit -> first generated token (prefill included)",
+                buckets=_TTFT_BUCKETS).observe(ttft)
+            _metrics.histogram(
+                "mxnet_serve_prefill_seconds",
+                help="wall time of one bucketed prefill call",
+                buckets=_TTFT_BUCKETS).observe(req.first_token_t - t0)
+        self._maybe_complete(slot)
+
+    def _decode_once(self):
+        with self._lock:
+            active = [(i, s) for i, s in enumerate(self._slots)
+                      if s is not None]
+        if not active:
+            return False
+        g = self.geometry
+        tokens = np.zeros(g.max_batch, dtype=np.int32)
+        positions = np.zeros(g.max_batch, dtype=np.int32)
+        tables = np.zeros((g.max_batch, g.max_pages_per_seq),
+                          dtype=np.int32)
+        for i, s in active:
+            tokens[i] = s.req.tokens[-1]
+            positions[i] = s.position
+            tables[i] = s.row
+        t0 = self.clock()
+        try:
+            logits = self.runner.decode(tokens, positions, tables)
+        except Exception as e:
+            for _, s in active:
+                self._fail_slot(s, e)
+            return True
+        self.decode_steps += 1
+        dt = self.clock() - t0
+        for i, s in active:
+            s.position += 1
+            tok = self.sampler(logits[i], s.req)
+            s.req.tokens.append(tok)
+            self.tokens_generated += 1
+            self._tpots.append(dt)
+            self._maybe_complete(s)
+        if _metrics.enabled():
+            _metrics.histogram(
+                "mxnet_serve_tpot_seconds",
+                help="wall time of one batched decode step",
+                buckets=_TPOT_BUCKETS).observe(dt)
+            _metrics.counter(
+                "mxnet_serve_decode_steps_total",
+                help="batched decode steps executed").inc()
+            _metrics.counter(
+                "mxnet_serve_tokens_total",
+                help="tokens generated across all requests",
+            ).inc(len(active))
+        return True
+
+    # -- completion -------------------------------------------------------
+    def _maybe_complete(self, slot):
+        req = slot.req
+        done = len(req.tokens) >= req.max_new_tokens
+        if req.eos_id is not None and req.tokens \
+                and req.tokens[-1] == req.eos_id:
+            done = True
+        if done:
+            self._finish_slot(slot, error=None)
+
+    def _fail_slot(self, slot, err):
+        self._finish_slot(slot, error=err)
+
+    def _finish_slot(self, slot, error):
+        req = slot.req
+        with self._lock:
+            for i, s in enumerate(self._slots):
+                if s is slot:
+                    self._slots[i] = None
+                    break
+            self.arena.free(slot.pages, owner=req.rid)
+            self.completed += 1
+            self._count_req("failed" if error is not None else "completed")
+            self._gauges_locked()
+        req.error = error
+        req.finish_t = self.clock()
+        req._done.set()
+
+    # -- introspection ----------------------------------------------------
+    def active_slots(self):
+        with self._lock:
+            return sum(1 for s in self._slots if s is not None)
+
+    def queue_len(self):
+        with self._lock:
+            return len(self._queue)
+
+    def has_work(self):
+        with self._lock:
+            return bool(self._queue) \
+                or any(s is not None for s in self._slots)
+
+    def wait_for_work(self, timeout):
+        """Server-thread parking: wake on submit or after ``timeout``."""
+        with self._work:
+            if not self._queue and all(s is None for s in self._slots):
+                self._work.wait(timeout)
+
+    def percentile(self, which, q):
+        """Exact percentile over the recent-window deques ('ttft'/'tpot')."""
+        data = sorted(self._ttfts if which == "ttft" else self._tpots)
+        if not data:
+            return 0.0
+        i = min(len(data) - 1, int(round(q * (len(data) - 1))))
+        return data[i]
+
+    def stats(self):
+        with self._lock:
+            active = sum(1 for s in self._slots if s is not None)
+            qlen = len(self._queue)
+        return {
+            "admitted": self.admitted, "rejected": self.rejected,
+            "completed": self.completed,
+            "tokens_generated": self.tokens_generated,
+            "decode_steps": self.decode_steps, "prefills": self.prefills,
+            "active_slots": active, "queue_len": qlen,
+            "arena_utilization": self.arena.utilization(),
+            "ttft_p50_s": self.percentile("ttft", 0.50),
+            "ttft_p99_s": self.percentile("ttft", 0.99),
+            "tpot_p50_s": self.percentile("tpot", 0.50),
+        }
+
+    def _count_req(self, status):
+        if _metrics.enabled():
+            _metrics.counter(
+                "mxnet_serve_requests_total",
+                help="requests by outcome", status=status).inc()
+
+    def _gauges_locked(self):
+        if _metrics.enabled():
+            _metrics.gauge(
+                "mxnet_serve_queue_depth",
+                help="requests waiting for admission").set(len(self._queue))
+            _metrics.gauge(
+                "mxnet_serve_batch_occupancy",
+                help="active decode slots (of max_batch)",
+            ).set(sum(1 for s in self._slots if s is not None))

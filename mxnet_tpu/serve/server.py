@@ -1,0 +1,309 @@
+"""LlamaServer: AOT warm-start serving over the paged arena.
+
+Startup deserializes the bundle's decode + prefill executables (PR 7
+``MXAOT1`` path), builds the arena with plain ``device_put`` zeros, and
+spins one scheduler thread — **no jit anywhere on the serving path**, so
+``mxnet_compiles_total`` stays empty for the process lifetime (the
+serve-smoke CI job asserts exactly this from the telemetry dump).
+
+The runner is the only jax-touching layer: it drains pending bulk
+segments that still read the arena (the executables donate the KV
+buffers on accelerator backends — see model._donate_kv), calls the
+deserialized executable, adopts the new buffers into
+the arena, and hands numpy logits back to the jax-free scheduler.
+Sampling is host-side numpy, so the decode loop's device work is exactly
+one executable call per step.
+
+``static_generate`` is the naive baseline the serving bench compares
+against: fixed batches, no mid-flight admission, every batch runs until
+its slowest member finishes — same runner, same arena, so the measured
+gap is pure scheduling.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import numpy as np
+
+from ..base import MXNetError
+from ..telemetry import metrics as _metrics
+from .arena import PagedKVArena
+from .scheduler import Request, Scheduler
+
+
+class AOTRunner:
+    """Executes the bundle's compiled graphs against one arena."""
+
+    def __init__(self, executables, arena):
+        self._exes = executables
+        self.arena = arena
+        g = arena.geometry
+        self._pad = {b: np.zeros(b, dtype=np.int32)
+                     for b in g.prefill_buckets}
+
+    def prefill(self, bucket, tokens, length, block_row):
+        exe = self._exes.get("prefill_%d" % bucket)
+        if exe is None:
+            raise MXNetError("bundle has no prefill executable for "
+                             "bucket %d" % bucket)
+        padded = self._pad[bucket].copy()
+        padded[:length] = tokens
+        self.arena.drain_pending_readers("serve_prefill")
+        k, v, logits = exe(self.arena.kv_k.data(), self.arena.kv_v.data(),
+                           padded, np.int32(length),
+                           block_row.astype(np.int32))
+        self.arena.adopt(k, v)
+        return np.asarray(logits)  # mxlint: allow-host-sync
+
+    def decode(self, tokens, positions, block_tables):
+        self.arena.drain_pending_readers("serve_decode")
+        k, v, logits = self._exes["decode"](
+            self.arena.kv_k.data(), self.arena.kv_v.data(),
+            tokens.astype(np.int32), positions.astype(np.int32),
+            block_tables.astype(np.int32))
+        self.arena.adopt(k, v)
+        return np.asarray(logits)  # mxlint: allow-host-sync
+
+
+class LlamaServer:
+    """Continuous-batching inference server over an AOT serving bundle.
+
+    ``LlamaServer(path).start()`` then ``submit(prompt) -> Request`` /
+    ``generate(prompt) -> tokens``.  Geometry validation happens at
+    load (``expect_geometry`` pins fields); admission backpressure
+    raises ``ServeQueueFull``.
+    """
+
+    def __init__(self, bundle_path, expect_geometry=None, queue_depth=None,
+                 sampler=None):
+        from .model import load_serving_executables
+
+        self.geometry, exes = load_serving_executables(
+            bundle_path, expect=expect_geometry)
+        self.arena = PagedKVArena(self.geometry)
+        self.runner = AOTRunner(exes, self.arena)
+        self.scheduler = Scheduler(self.runner, self.arena,
+                                   queue_depth=queue_depth, sampler=sampler)
+        self._stop = threading.Event()
+        self._thread = None
+        self._http = None
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="mxnet-serve", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.is_set():
+            if not self.scheduler.step():
+                self.scheduler.wait_for_work(0.005)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        if self._http is not None:
+            self._http.shutdown()
+            self._http = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- request surface --------------------------------------------------
+    def submit(self, prompt, max_new_tokens=None, eos_id=None):
+        """Enqueue; returns the Request future (``.result(timeout)``)."""
+        if self._thread is None:
+            raise MXNetError("server not started — call start() first")
+        return self.scheduler.submit(
+            Request(prompt, max_new_tokens=max_new_tokens, eos_id=eos_id))
+
+    def generate(self, prompt, max_new_tokens=None, eos_id=None,
+                 timeout=300):
+        return self.submit(prompt, max_new_tokens=max_new_tokens,
+                           eos_id=eos_id).result(timeout)
+
+    def stats(self):
+        return self.scheduler.stats()
+
+    # -- naive baseline (bench comparison) --------------------------------
+    def static_generate(self, requests):
+        """Static batching: groups of ``max_batch``, no admission between
+        steps, each group decodes until its SLOWEST member finishes.
+        Returns the token lists in request order.  Runs on the caller's
+        thread — stop() the background loop first or don't start() it.
+        """
+        g = self.geometry
+        sched = self.scheduler
+        out = []
+        for base in range(0, len(requests), g.max_batch):
+            group = requests[base: base + g.max_batch]
+            slots = []
+            for req in group:
+                pages = self.arena.alloc(
+                    self.arena.pages_needed(
+                        len(req.prompt) + req.max_new_tokens), req.rid)
+                if pages is None:
+                    raise MXNetError("arena too small for a static batch")
+                row = self.arena.block_row(pages)
+                logits = self.runner.prefill(
+                    sched.pick_bucket(len(req.prompt)),
+                    np.asarray(req.prompt, dtype=np.int32),
+                    len(req.prompt), row)
+                req.tokens.append(sched.sampler(logits, req))
+                slots.append((req, pages, row))
+            # the whole group decodes in lockstep until every member is
+            # done — finished lanes keep burning a slot (that waste IS
+            # the baseline being measured)
+            def _busy(req):
+                if len(req.tokens) >= req.max_new_tokens:
+                    return False
+                return not (req.eos_id is not None
+                            and req.tokens[-1] == req.eos_id)
+            while any(_busy(req) for req, _, _ in slots):
+                tokens = np.zeros(g.max_batch, dtype=np.int32)
+                positions = np.zeros(g.max_batch, dtype=np.int32)
+                tables = np.zeros((g.max_batch, g.max_pages_per_seq),
+                                  dtype=np.int32)
+                for i, (req, _, row) in enumerate(slots):
+                    tokens[i] = req.tokens[-1]
+                    positions[i] = len(req.prompt) + len(req.tokens) - 1
+                    tables[i] = row
+                logits = self.runner.decode(tokens, positions, tables)
+                for i, (req, _, _) in enumerate(slots):
+                    if _busy(req):
+                        req.tokens.append(sched.sampler(logits[i], req))
+            for req, pages, _ in slots:
+                self.arena.free(pages, owner=req.rid)
+                out.append(list(req.tokens))
+        return out
+
+    # -- HTTP front -------------------------------------------------------
+    def serve_http(self, port=0, host="127.0.0.1"):
+        """Minimal stdlib HTTP front (POST /v1/generate, GET /metrics,
+        GET /healthz).  Returns the bound (host, port)."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from .scheduler import ServeQueueFull
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet: telemetry is the record
+                pass
+
+            def _send(self, code, body, ctype="application/json"):
+                payload = body.encode() if isinstance(body, str) \
+                    else json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    self._send(200, _metrics.prometheus_text(),
+                               ctype="text/plain; version=0.0.4")
+                elif self.path == "/healthz":
+                    self._send(200, server.stats())
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/v1/generate":
+                    self._send(404, {"error": "not found"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    doc = json.loads(self.rfile.read(n) or b"{}")
+                    req = server.submit(
+                        doc["prompt"],
+                        max_new_tokens=doc.get("max_new_tokens"),
+                        eos_id=doc.get("eos_id"))
+                except ServeQueueFull as e:
+                    self._send(503, {"error": str(e)})
+                    return
+                except (MXNetError, KeyError, ValueError) as e:
+                    self._send(400, {"error": str(e)})
+                    return
+                if req.done() and req.error is not None:
+                    # rejected at submit (prompt over the bucket ladder,
+                    # budget over max context): client error, not a 500
+                    self._send(400, {"error": str(req.error)})
+                    return
+                try:
+                    tokens = req.result(timeout=doc.get("timeout", 300))
+                except MXNetError as e:
+                    self._send(500, {"error": str(e)})
+                    return
+                self._send(200, {"tokens": tokens,
+                                 "ttft_s": req.ttft})
+
+        self._http = ThreadingHTTPServer((host, port), Handler)
+        threading.Thread(target=self._http.serve_forever,
+                         name="mxnet-serve-http", daemon=True).start()
+        return self._http.server_address
+
+
+def poisson_workload(n_requests, rate_rps, prompt_range, max_new_range,
+                     vocab_size, seed=0, eos_id=None):
+    """Seeded mixed-length Poisson workload: ``[(arrival_s, Request)]``.
+
+    Prompt lengths draw uniform over ``prompt_range``; generation budgets
+    draw a geometric-ish heavy tail clipped to ``max_new_range`` — the
+    length spread is what separates continuous batching from the static
+    baseline (a static batch runs at the pace of its slowest member).
+    """
+    rng = np.random.default_rng(seed)
+    lo_p, hi_p = prompt_range
+    lo_n, hi_n = max_new_range
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_rps, size=n_requests))
+    out = []
+    for i in range(n_requests):
+        plen = int(rng.integers(lo_p, hi_p + 1))
+        budget = int(np.clip(lo_n + rng.geometric(
+            2.0 / (lo_n + hi_n)), lo_n, hi_n))
+        prompt = rng.integers(0, vocab_size, size=plen).tolist()
+        out.append((float(arrivals[i]),
+                    Request(prompt, max_new_tokens=budget, eos_id=eos_id)))
+    return out
+
+
+def drive_workload(server, workload, timeout=600, clock=time.monotonic,
+                   sleep=time.sleep):
+    """Replay a :func:`poisson_workload` against a started server.
+
+    Returns ``(requests, wall_seconds)`` — wall time from first submit to
+    last completion.  Used by the serving bench and the serve-smoke CI
+    job (which passes a null ``sleep`` to hammer the queue).
+    """
+    t0 = clock()
+    reqs = []
+    for arrival, req in workload:
+        lag = arrival - (clock() - t0)
+        if lag > 0:
+            sleep(lag)
+        try:
+            server.scheduler.submit(req)
+        except MXNetError as e:  # queue-full backpressure: shed, record
+            if req.error is None:
+                req.error = e
+            req._done.set()
+        reqs.append(req)
+    for req in reqs:
+        try:
+            req.result(timeout=timeout)
+        except MXNetError:
+            pass  # rejected/failed requests surface via req.error
+    return reqs, clock() - t0

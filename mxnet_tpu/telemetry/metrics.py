@@ -261,6 +261,34 @@ def snapshot():
     return out
 
 
+def histogram_quantile(series, q):
+    """Estimate the ``q``-quantile (0..1) from one snapshot histogram
+    series (Prometheus ``histogram_quantile`` semantics: cumulative
+    buckets, linear interpolation within the winning bucket, +Inf
+    clamped to the largest finite bound).
+
+    ``series`` is one entry of ``snapshot()[family]["series"]`` — the
+    shape the telemetry dump stores, so serving dashboards and the
+    serve-smoke CI job can read p50/p99 TTFT straight off a dump without
+    the process that produced it.  Returns 0.0 for an empty histogram.
+    """
+    total = series.get("count", 0)
+    if not total:
+        return 0.0
+    rank = q * total
+    prev_bound, prev_acc = 0.0, 0
+    finite = [(float(b), c) for b, c in series["buckets"].items()
+              if b != "+Inf"]
+    finite.sort()
+    for bound, acc in finite:
+        if acc >= rank:
+            span = acc - prev_acc
+            frac = (rank - prev_acc) / span if span else 1.0
+            return prev_bound + (bound - prev_bound) * frac
+        prev_bound, prev_acc = bound, acc
+    return finite[-1][0] if finite else 0.0
+
+
 def _fmt_labels(labels, extra=None):
     parts = ["%s=%s" % (k, json.dumps(str(v))) for k, v in labels.items()]
     if extra:

@@ -37,6 +37,13 @@ _STUB_VALUES = {"train": 100.0, "infer": 200.0, "bert": 300.0,
                 "dispatch_bulked": 600.0,
                 "dispatch_bulked_train": 650.0,
                 "dispatch_bulked_long": 700.0,
+                # serving runner (ISSUE 8): continuous tok/s as value,
+                # static baseline + latency percentiles as extras
+                "serve": {"value": 1000.0, "static_tok_s": 500.0,
+                          "continuous_vs_static": 2.0,
+                          "ttft_p50_ms": 10.0, "ttft_p99_ms": 50.0,
+                          "tpot_p50_ms": 2.0, "completed": 64,
+                          "n_requests": 64, "live_compiles": 0},
                 # cold-start runners return value + extra record fields
                 "cold_resnet50": {"value": 30.0, "warm_seconds": 2.0,
                                   "cold_warm_speedup": 15.0},
@@ -83,6 +90,7 @@ def test_default_mode_emits_all_metrics_in_one_line(monkeypatch, capsys):
                      "imperative_dispatch_bulked",
                      "imperative_dispatch_bulked_train",
                      "imperative_dispatch_bulked_long",
+                     "llama_serve_tok_s",
                      "resnet50_cold_start_seconds",
                      "bert_cold_start_seconds",
                      "llama_cold_start_seconds"]
@@ -100,6 +108,15 @@ def test_default_mode_emits_all_metrics_in_one_line(monkeypatch, capsys):
     assert cold["value"] == 30.0 and cold["unit"] == "seconds"
     assert cold["warm_seconds"] == 2.0
     assert cold["cold_warm_speedup"] == 15.0
+    # serving record (ISSUE 8): continuous tok/s is the value; the
+    # static baseline measured in the SAME run and the TTFT percentiles
+    # ride along (the >=1.5x claim is checked against these two fields)
+    srv = by_name["llama_serve_tok_s"]
+    assert srv["value"] == 1000.0 and srv["unit"] == "tokens/sec"
+    assert srv["static_tok_s"] == 500.0
+    assert srv["continuous_vs_static"] == 2.0
+    assert srv["ttft_p50_ms"] == 10.0 and srv["ttft_p99_ms"] == 50.0
+    assert srv["live_compiles"] == 0
 
 
 def test_budget_exhaustion_marks_skipped(monkeypatch, capsys):
@@ -112,7 +129,7 @@ def test_budget_exhaustion_marks_skipped(monkeypatch, capsys):
                       if ln.startswith("{")][-1])
     assert rec["value"] == 100.0  # headline always measured
     skipped = [m for m in rec["metrics"] if m.get("skipped")]
-    assert len(skipped) == 11
+    assert len(skipped) == 12
     assert all(m["value"] == 0.0 for m in skipped)
 
 
@@ -141,6 +158,7 @@ def test_failed_benchmark_emits_zero_not_crash(monkeypatch, capsys):
             boom, "imperative_dispatch_bulked_train", "ops/sec", None),
         "dispatch_bulked_long": (
             boom, "imperative_dispatch_bulked_long", "ops/sec", None),
+        "serve": (boom, "llama_serve_tok_s", "tokens/sec", None),
         "cold_resnet50": (boom, "resnet50_cold_start_seconds", "seconds",
                           None),
         "cold_bert": (boom, "bert_cold_start_seconds", "seconds", None),
@@ -151,4 +169,4 @@ def test_failed_benchmark_emits_zero_not_crash(monkeypatch, capsys):
     rec = json.loads([ln for ln in capsys.readouterr().out.splitlines()
                       if ln.startswith("{")][-1])
     assert rec["value"] == 0.0 and rec["fallback"] is True
-    assert len(rec["metrics"]) == 12
+    assert len(rec["metrics"]) == 13

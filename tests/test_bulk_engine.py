@@ -598,3 +598,56 @@ def test_trainer_donation_drains_pending_segment(eng):
     y.wait_to_read()        # flushes the segment: must not hit a dead array
     np.testing.assert_allclose(net.weight.data().asnumpy(), [[0.4, 0.3]],
                                rtol=1e-5)
+
+
+def test_cross_segment_rebind_chain_donates(eng):
+    # ISSUE 8: a segment's output fed to the NEXT segment as a dead ext
+    # input must be donatable — segments release their pinned output
+    # refs at resolve time, so only the consumer's own handle remains.
+    # This is the steady-state shape of a serving decode loop (cache
+    # out of segment N = cache into segment N+1).
+    d0 = eng.stats.bulk_donated
+    with engine_mod.bulk(2):
+        a = nd.ones((16, 16))
+        a.wait_to_read()
+        for _ in range(8):      # 2 ops/segment -> 4 cross-segment handoffs
+            a = a + 1.0
+        a.wait_to_read()
+    assert eng.stats.bulk_donated >= d0 + 3, \
+        "cross-segment dead inputs must be donated"
+    np.testing.assert_allclose(a.asnumpy(), 9.0)
+
+
+def test_cross_segment_inplace_update_donates(eng):
+    # in-place out= updates bump the var version past supply time, so
+    # the superseded buffer donates even though the NDArray persists
+    d0 = eng.stats.bulk_donated
+    with engine_mod.bulk(2):
+        cache = nd.ones((16, 16))
+        one = nd.ones((16, 16))
+        cache.wait_to_read()
+        one.wait_to_read()
+        for _ in range(8):
+            nd.elemwise_add(cache, one, out=cache)
+        cache.wait_to_read()
+    assert eng.stats.bulk_donated >= d0 + 3
+    np.testing.assert_allclose(cache.asnumpy(), 9.0)
+
+
+def test_pending_reads_tracks_open_segment_ext_inputs(eng):
+    # Engine.pending_reads is the serving arena's liveness query: it
+    # must name exactly the buffers the open segment still reads, and
+    # go empty once that segment flushes.
+    a = nd.ones((4, 4))
+    a.wait_to_read()
+    buf = a.data()
+    assert eng.pending_reads((buf,)) == ()
+    with engine_mod.bulk(16):
+        b = a * 2.0                       # defers; captures buf as ext
+        assert eng.pending_reads((buf,)) == (buf,)
+        other = nd.ones((4, 4))
+        other.wait_to_read()
+        assert eng.pending_reads((other.data(),)) == ()
+        eng.flush_if_referencing((buf,), "test_pending_reads")
+        assert eng.pending_reads((buf,)) == ()
+    np.testing.assert_allclose(b.asnumpy(), 2.0)

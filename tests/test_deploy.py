@@ -159,3 +159,28 @@ def test_warm_requires_params_on_separate_artifact():
         pred._weights = ()  # simulate a loader that strips weights
         with pytest.raises(MXNetError, match="warm"):
             pred.warm()
+
+
+def test_predictor_redirects_aot_serving_bundle():
+    # ISSUE 8: handing a serving bundle to the StableHLO loader must
+    # fail with a redirect that names the right loader AND the bundle's
+    # KV-page geometry — validated from the meta alone, no executable
+    # deserialization (cheap even for multi-GB bundles)
+    from mxnet_tpu import compile_cache
+    from mxnet_tpu.serve.model import KVGeometry
+
+    g = KVGeometry(num_layers=1, num_heads=2, num_kv_heads=1, head_dim=4,
+                   units=8, hidden_size=16, vocab_size=32, page_size=4,
+                   num_pages=8, max_pages_per_seq=3, max_batch=2,
+                   prefill_buckets=(8,))
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "srv.mxaot")
+        compile_cache.save_bundle(
+            path, {"decode": b"\x00"},
+            meta={"kind": "serving", "geometry": g.to_dict()})
+        with pytest.raises(MXNetError) as ei:
+            deploy.Predictor(path)
+        msg = str(ei.value)
+        assert "serving bundle" in msg
+        assert "load_serving_bundle" in msg
+        assert "pages=8x4" in msg

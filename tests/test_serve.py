@@ -1,0 +1,357 @@
+"""Serving-tier core tests (ISSUE 8): deterministic, seeded, no sleeps.
+
+The scheduler is jax-free by design — model execution hides behind a
+two-method runner — so these tests drive ``step()`` on the calling
+thread with a scripted fake runner and an injected counter clock.  The
+paged arena IS real (its buffers are plain device_put zeros), so the
+liveness tests exercise the actual ``Engine.pending_reads`` /
+``flush_if_referencing`` path under op bulking.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import engine as engine_mod
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.engine import Engine
+from mxnet_tpu.serve import (PagedKVArena, Request, Scheduler,
+                             ServeQueueFull)
+from mxnet_tpu.serve.model import KVGeometry
+
+
+def tiny_geometry(**over):
+    kw = dict(num_layers=1, num_heads=2, num_kv_heads=1, head_dim=4,
+              units=8, hidden_size=16, vocab_size=32, page_size=4,
+              num_pages=9, max_pages_per_seq=4, max_batch=2,
+              prefill_buckets=(4, 8))
+    kw.update(over)
+    return KVGeometry(**kw)
+
+
+class FakeRunner:
+    """Scripted runner: records every call, returns zero logits (token
+    choice is the sampler's job, injected per test)."""
+
+    def __init__(self, geometry):
+        self.g = geometry
+        self.prefills = []
+        self.decodes = []
+
+    def prefill(self, bucket, tokens, length, block_row):
+        self.prefills.append((bucket, [int(t) for t in tokens],
+                              int(length), np.array(block_row)))
+        return np.zeros(self.g.vocab_size, dtype=np.float32)
+
+    def decode(self, tokens, positions, block_tables):
+        self.decodes.append((np.array(tokens), np.array(positions),
+                             np.array(block_tables)))
+        return np.zeros((self.g.max_batch, self.g.vocab_size),
+                        dtype=np.float32)
+
+
+def counter_clock(step=0.01):
+    c = itertools.count()
+    return lambda: next(c) * step
+
+
+def make_sched(g=None, queue_depth=8, sampler=None):
+    g = g or tiny_geometry()
+    arena = PagedKVArena(g)
+    runner = FakeRunner(g)
+    sched = Scheduler(runner, arena, queue_depth=queue_depth,
+                      sampler=sampler, clock=counter_clock())
+    return sched, runner, arena
+
+
+def run_to_completion(sched, max_steps=10_000):
+    steps = 0
+    while sched.has_work():
+        sched.step()
+        steps += 1
+        assert steps < max_steps, "scheduler failed to drain"
+    return steps
+
+
+# -- admission + backpressure -------------------------------------------
+
+def test_queue_backpressure_raises_serve_queue_full():
+    sched, _, _ = make_sched(queue_depth=2)
+    sched.submit(Request([1, 2], max_new_tokens=4))
+    sched.submit(Request([3], max_new_tokens=4))
+    with pytest.raises(ServeQueueFull, match="MXNET_SERVE_QUEUE_DEPTH"):
+        sched.submit(Request([4], max_new_tokens=4))
+    assert sched.rejected == 1 and sched.queue_len() == 2
+
+
+def test_overlong_prompt_rejected_at_submit():
+    sched, runner, _ = make_sched()
+    req = sched.submit(Request(list(range(9)), max_new_tokens=2))
+    assert req.done()
+    with pytest.raises(MXNetError, match="prefill bucket"):
+        req.result(timeout=0)
+    assert not runner.prefills  # never reached the model
+
+
+def test_over_context_budget_rejected_at_submit():
+    # max_context = 4 pages x 4 tokens = 16; prompt 8 + budget 12 > 16
+    sched, _, _ = make_sched()
+    req = sched.submit(Request(list(range(8)), max_new_tokens=12))
+    assert req.done()
+    with pytest.raises(MXNetError, match="max context"):
+        req.result(timeout=0)
+
+
+def test_admission_waits_for_pages_not_slots():
+    # one request holds every free page; the queue head must wait even
+    # though a decode slot is free, and admit as soon as pages return
+    g = tiny_geometry(num_pages=5, max_pages_per_seq=4)  # 4 free pages
+    sched, _, arena = make_sched(g)
+    big = sched.submit(Request([1, 2, 3, 4], max_new_tokens=12))  # 4 pages
+    small = sched.submit(Request([5], max_new_tokens=3))          # 1 page
+    sched.step()  # admits big only: arena is out of pages
+    assert sched.active_slots() == 1 and sched.queue_len() == 1
+    assert arena.free_pages == 0
+    run_to_completion(sched)
+    assert big.result(timeout=0) is not None
+    assert small.result(timeout=0) is not None
+    assert arena.free_pages == 4  # every page returned
+
+
+# -- bucket selection ----------------------------------------------------
+
+def test_prefill_uses_smallest_covering_bucket():
+    sched, runner, _ = make_sched()
+    sched.submit(Request([1, 2, 3], max_new_tokens=1))     # 3 -> bucket 4
+    sched.submit(Request([1] * 5, max_new_tokens=1))       # 5 -> bucket 8
+    run_to_completion(sched)
+    assert [p[0] for p in runner.prefills] == [4, 8]
+    assert sched.pick_bucket(4) == 4 and sched.pick_bucket(8) == 8
+    assert sched.pick_bucket(9) is None
+
+
+# -- EOS + slot recycling ------------------------------------------------
+
+def test_eos_frees_slot_and_next_request_reuses_it():
+    g = tiny_geometry(max_batch=1)
+    # scripted sampler: first request emits EOS (7) on its 2nd token
+    script = {0: iter([5, 7]), 1: iter([6, 6, 6])}
+
+    def sampler(logits, req):
+        return next(script[req.rid % 2])
+
+    sched, _, arena = make_sched(g, sampler=sampler)
+    a = Request([1, 2], max_new_tokens=8, eos_id=7)
+    b = Request([3, 4], max_new_tokens=3)
+    a.rid, b.rid = 0, 1  # pin ids for the script
+    sched.submit(a)
+    sched.submit(b)
+    sched.step()  # admit a (sole slot), prefill, decode once
+    run_to_completion(sched)
+    assert a.result(timeout=0) == [5, 7], "EOS must end the sequence"
+    assert b.result(timeout=0) == [6, 6, 6], "recycled slot serves b"
+    assert sched.active_slots() == 0
+    assert arena.free_pages == arena.total_pages
+
+
+def test_eos_in_prefill_token_completes_without_decode():
+    sched, runner, _ = make_sched(sampler=lambda lg, rq: 9)
+    req = sched.submit(Request([1], max_new_tokens=8, eos_id=9))
+    sched.step()
+    assert req.done() and req.result(timeout=0) == [9]
+    assert not runner.decodes  # finished straight out of prefill
+
+
+# -- decode batching -----------------------------------------------------
+
+def test_inactive_slots_ride_null_page():
+    # one active slot out of two: the decode call's inactive lane must
+    # carry position 0 and an all-null-page block row
+    sched, runner, _ = make_sched(sampler=lambda lg, rq: 3)
+    sched.submit(Request([1, 2], max_new_tokens=2))
+    run_to_completion(sched)
+    assert runner.decodes, "budget 2 needs a decode after prefill"
+    tokens, positions, tables = runner.decodes[0]
+    active = [i for i in range(2) if positions[i] != 0 or tokens[i] != 0]
+    assert len(active) == 1
+    inactive = 1 - active[0]
+    assert np.all(tables[inactive] == 0), "inactive row must be null page"
+
+
+def test_two_requests_share_one_decode_batch():
+    sched, runner, _ = make_sched(sampler=lambda lg, rq: 3)
+    a = sched.submit(Request([1, 2], max_new_tokens=3))
+    b = sched.submit(Request([3], max_new_tokens=3))
+    run_to_completion(sched)
+    assert a.result(timeout=0) == [3, 3, 3]
+    assert b.result(timeout=0) == [3, 3, 3]
+    # token 0 comes from prefill; the remaining 2 each ride batched steps
+    assert sched.decode_steps == 2, "both sequences must share each step"
+
+
+def test_runner_failure_poisons_slot_and_frees_pages():
+    class Boom(FakeRunner):
+        def decode(self, *a):
+            raise RuntimeError("device fell over")
+
+    g = tiny_geometry()
+    arena = PagedKVArena(g)
+    sched = Scheduler(Boom(g), arena, queue_depth=4,
+                      sampler=lambda lg, rq: 1, clock=counter_clock())
+    req = sched.submit(Request([1], max_new_tokens=4))
+    sched.step()
+    assert req.done()
+    with pytest.raises(RuntimeError, match="fell over"):
+        req.result(timeout=0)
+    assert arena.free_pages == arena.total_pages
+    assert sched.active_slots() == 0
+
+
+# -- deterministic seeded drain -----------------------------------------
+
+def test_seeded_mixed_workload_drains_deterministically():
+    from mxnet_tpu.serve import poisson_workload
+
+    def run_once():
+        g = tiny_geometry(num_pages=17, max_batch=4)
+        sched, runner, arena = make_sched(g, queue_depth=64,
+                                          sampler=lambda lg, rq: 2)
+        wl = poisson_workload(16, rate_rps=1e9, prompt_range=(1, 8),
+                              max_new_range=(1, 8),
+                              vocab_size=g.vocab_size, seed=11)
+        for _, req in wl:
+            sched.submit(req)
+        run_to_completion(sched)
+        assert arena.free_pages == arena.total_pages
+        assert sched.completed == 16
+        return ([tuple(req.tokens) for _, req in wl],
+                sched.decode_steps, sched.prefills)
+
+    assert run_once() == run_once(), "same seed must replay identically"
+
+
+def test_ttft_and_percentiles_use_injected_clock():
+    sched, _, _ = make_sched(sampler=lambda lg, rq: 1)
+    req = sched.submit(Request([1, 2], max_new_tokens=2))
+    run_to_completion(sched)
+    assert req.ttft is not None and req.ttft > 0
+    assert sched.percentile("ttft", 0.5) > 0
+    assert sched.percentile("tpot", 0.5) > 0
+    st = sched.stats()
+    assert st["completed"] == 1 and st["tokens_generated"] == 2
+    assert st["ttft_p50_s"] == sched.percentile("ttft", 0.5)
+
+
+# -- arena ---------------------------------------------------------------
+
+def test_arena_never_hands_out_null_page():
+    arena = PagedKVArena(tiny_geometry())
+    pages = arena.alloc(arena.total_pages // 2, owner="a")
+    pages += arena.alloc(arena.total_pages - len(pages), owner="b")
+    assert 0 not in pages and len(set(pages)) == len(pages)
+    assert arena.alloc(1, owner="c") is None  # full, not an exception
+
+
+def test_arena_free_guards_double_free_and_owner():
+    arena = PagedKVArena(tiny_geometry())
+    pages = arena.alloc(2, owner="a")
+    arena.free(pages, owner="a")
+    with pytest.raises(MXNetError, match="not allocated"):
+        arena.free(pages, owner="a")
+    p2 = arena.alloc(1, owner="b")
+    with pytest.raises(MXNetError, match="owned by"):
+        arena.free(p2, owner="a")
+
+
+def test_arena_rejects_over_max_pages_per_seq():
+    arena = PagedKVArena(tiny_geometry())
+    with pytest.raises(MXNetError, match="max_pages_per_seq"):
+        arena.alloc(5, owner="a")
+
+
+def test_block_row_pads_with_null_page():
+    arena = PagedKVArena(tiny_geometry())
+    pages = arena.alloc(2, owner="a")
+    row = arena.block_row(pages)
+    assert row.shape == (4,) and row.dtype == np.int32
+    assert list(row[:2]) == pages and list(row[2:]) == [0, 0]
+
+
+def test_arena_alloc_drains_pending_bulk_readers():
+    """The never-reuse-a-live-page claim: a bulk segment holding the
+    arena buffer as a deferred ext input must flush before pages are
+    handed to a new owner — the deferred op reads the pre-reuse
+    snapshot, not whatever the next executable scribbles."""
+    eng = Engine.get()
+    eng.flush_bulk("test_setup")
+    arena = PagedKVArena(tiny_geometry())
+    # fill the arena so the next alloc can only be served by recycling
+    first = arena.alloc(4, owner="a")
+    arena.alloc(4, owner="b")
+    arena.free(first, owner="a")
+    flushes0 = arena.liveness_flushes
+    with engine_mod.bulk(64):
+        # deferred imperative read of the K arena (an eviction scorer,
+        # a debug checksum, ...) — captured as an ext input, not run
+        probe = nd.NDArray(arena.kv_k.data()).sum()
+        assert eng.pending_reads(arena.buffers()) != ()
+        reused = arena.alloc(4, owner="c")  # the reuse moment
+        assert eng.pending_reads(arena.buffers()) == ()
+        assert set(reused) == set(first), "free list must recycle pages"
+    assert arena.liveness_flushes == flushes0 + 1
+    assert float(probe.asnumpy()) == 0.0  # read the pre-reuse snapshot
+
+
+def test_arena_alloc_skips_flush_when_nothing_pends():
+    eng = Engine.get()
+    eng.flush_bulk("test_setup")
+    arena = PagedKVArena(tiny_geometry())
+    arena.alloc(1, owner="a")
+    assert arena.liveness_flushes == 0
+
+
+def test_arena_stress_never_reuses_live_page():
+    """Seeded alloc/free churn with deferred readers injected at random
+    points: every deferred sum must observe the arena value at its call
+    time (zeros — nothing writes), and page accounting must balance."""
+    eng = Engine.get()
+    eng.flush_bulk("test_setup")
+    g = tiny_geometry(num_pages=9)
+    arena = PagedKVArena(g)
+    rng = np.random.default_rng(3)
+    held = {}
+    probes = []
+    with engine_mod.bulk(64):
+        for i in range(200):
+            roll = rng.integers(0, 3)
+            if roll == 0 and held:
+                key = list(held)[int(rng.integers(0, len(held)))]
+                arena.free(held.pop(key), owner=key)
+            elif roll == 1:
+                probes.append(nd.NDArray(arena.kv_k.data()).sum())
+            else:
+                n = int(rng.integers(1, g.max_pages_per_seq + 1))
+                pages = arena.alloc(n, owner=i)
+                if pages is not None:
+                    held[i] = pages
+    for key in list(held):
+        arena.free(held.pop(key), owner=key)
+    assert arena.free_pages == arena.total_pages
+    for p in probes:
+        assert float(p.asnumpy()) == 0.0
+
+
+# -- request surface -----------------------------------------------------
+
+def test_request_validates_inputs():
+    with pytest.raises(MXNetError, match="empty"):
+        Request([])
+    with pytest.raises(MXNetError, match="positive"):
+        Request([1], max_new_tokens=0)
+
+
+def test_request_result_timeout_message():
+    req = Request([1], max_new_tokens=1)
+    with pytest.raises(MXNetError, match="in flight"):
+        req.result(timeout=0)
