@@ -516,14 +516,19 @@ def _run_dispatch_eager_notelemetry(platform):
     the "near-zero telemetry overhead" claim into a tracked number
     (acceptance: on/off gap <= 3%; docs/observability.md)."""
     from mxnet_tpu import telemetry
+    from mxnet_tpu.telemetry import flight
 
     was_on = telemetry.enabled()
+    flight_on = flight.enabled()
     telemetry.disable()
+    flight.disable()
     try:
         return _dispatch_rate(None, label="dispatch_default_notelemetry")
     finally:
         if was_on:
             telemetry.enable()
+        if flight_on:
+            flight.enable()
 
 
 def _run_dispatch_bulked(platform):
@@ -902,9 +907,22 @@ def _measure(name, platform, fallback):
         "vs_baseline": round(value / baseline, 3) if baseline else 0.0,
         "platform": platform,
         "fallback": fallback,
+        "peak_device_bytes": _peak_device_bytes(),
     }
     rec.update(extra)
     return rec
+
+
+def _peak_device_bytes():
+    """High-water mark of live device bytes at record time (0 if the
+    accounting layer is unavailable — the record schema stays stable)."""
+    try:
+        from mxnet_tpu.telemetry import memdump
+
+        memdump.refresh()
+        return int(memdump.peak_bytes())
+    except Exception:
+        return 0
 
 
 def main():
@@ -950,6 +968,7 @@ def main():
                 "metric": _SPECS[name][1], "value": 0.0,
                 "unit": _SPECS[name][2], "vs_baseline": 0.0,
                 "platform": platform, "fallback": fallback,
+                "peak_device_bytes": _peak_device_bytes(),
                 "skipped": "time budget",
             })
             continue

@@ -38,6 +38,8 @@ import weakref
 import jax
 
 from . import compile_cache as _ccache
+from .telemetry import flight as _flight
+from .telemetry import memdump as _memdump
 from .telemetry import metrics as _metrics
 from .testing.faults import maybe_inject as _inject
 
@@ -358,6 +360,7 @@ class BulkSegment:
         n_disk0 = _ccache.persistent_hits()
         t_flush0 = time.perf_counter()
         fn = tier.get(key)
+        cached = fn is not None
         if fn is None:
             tstats["misses"] += 1
             _seg_cache_stats["misses"] += 1
@@ -372,6 +375,9 @@ class BulkSegment:
             tstats["hits"] += 1
             _seg_cache_stats["hits"] += 1
             tier.move_to_end(key)
+        _flight.record("engine.flush", origin=origin, ops=self.n_ops,
+                       tier=_SEG_TIER_LABELS[ti], cached=cached,
+                       donated=len(donate))
         ext = self.ext
         try:
             # one push for the whole op stream; write-var versions were
@@ -380,6 +386,11 @@ class BulkSegment:
             vals = eng.push(lambda: fn(*ext),
                             op_name="bulk_segment[%d]" % self.n_ops)
         except Exception as e:
+            # push() already recorded engine.poison + crash-dumped; this
+            # event names the segment-level blast radius
+            _flight.record("engine.flush_failed", origin=origin,
+                           ops=self.n_ops, error=type(e).__name__,
+                           writes=len(self.write_vars))
             for r in self.refs:
                 if r.value is None:
                     r.failed = True
@@ -496,6 +507,7 @@ class Engine:
         if audit is not None:
             audit.before_push(read_vars, write_vars, op_name)
         self.stats.ops_pushed += 1
+        _flight.record("engine.push", op=op_name or "op")
         t0 = time.perf_counter() if self._hooks else 0.0
         try:
             # chaos hook: an injected op failure takes the same
@@ -504,10 +516,16 @@ class Engine:
             _inject("engine_push", op=op_name)
             out = fn()
         except Exception as e:
+            # black box first: the poisoned vars will rethrow far from
+            # here, so the ring must already hold the story
+            _flight.record("engine.poison", op=op_name or "op",
+                           error=type(e).__name__, writes=len(write_vars))
+            _memdump.maybe_oom_report(e)
             for v in write_vars:
                 v.set_exception(e)
             if audit is not None:
                 audit.after_push(read_vars, write_vars, op_name)
+            _flight.crash_dump("poison")
             raise
         for v in write_vars:
             v.on_write()
@@ -694,6 +712,7 @@ class Engine:
     def notify_sync(self, origin):
         """Report one device->host sync to the sync hooks (cheap when none
         are registered — a single truthiness check on the hot path)."""
+        _flight.record("engine.sync", origin=origin)
         if _metrics.enabled():
             so = self.stats.sync_origins
             so[origin] = so.get(origin, 0) + 1

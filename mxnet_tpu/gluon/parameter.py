@@ -22,6 +22,7 @@ import numpy as _np
 from ..base import MXNetError
 from ..context import current_context
 from ..ndarray.ndarray import NDArray, _to_jax_dtype
+from ..telemetry import memdump as _memdump
 from .. import initializer as init_mod
 from .. import autograd
 
@@ -123,7 +124,9 @@ class Parameter:
             # batches agree on placement (a tpu-committed weight plus a
             # cpu-committed batch is a device-mismatch error at dispatch)
             data = jax.device_put(data, ctx.jax_device)
-        self._data = NDArray(data, ctx=ctx)
+            _memdump.tag(data, origin="param", label=self.name)
+        with _memdump.origin("param"):
+            self._data = NDArray(data, ctx=ctx)
         if self._grad_req != "null":
             self._data.attach_grad(self._grad_req)
         self._deferred_init = None

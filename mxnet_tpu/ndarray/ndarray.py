@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from ..base import MXNetError
 from ..context import Context, current_context
 from ..engine import Engine, Var, _BulkRef
+from ..telemetry import memdump as _memdump
 from .. import autograd
 from ..ops import registry as _reg
 
@@ -83,6 +84,10 @@ class NDArray:
                 data = data.astype(_np.float32)
             ctx = ctx if ctx is not None else current_context()
             data = jax.device_put(data, ctx.jax_device)
+            # a host->device upload is a real allocation (params, data
+            # batches) — attribute it; op results (already jax.Array)
+            # churn too fast to tag and count as "temp" in the sweep
+            _memdump.tag(data)
         elif jdt is not None and data.dtype != jdt:
             data = data.astype(jdt)
         self._data = data
@@ -235,6 +240,8 @@ class NDArray:
         self._marked = True
         self._grad_req = grad_req
         self._grad = jnp.zeros(self.shape, self.dtype) if grad_req != "null" else None
+        if self._grad is not None:
+            _memdump.tag(self._grad, origin="grad")
 
     @property
     def grad(self):
@@ -280,6 +287,10 @@ class NDArray:
             self._grad = ct
         else:
             self._grad = self._grad + ct
+        # re-attribute: accumulation replaced the buffer attach_grad
+        # tagged (no-op for deferred/sparse values — tag() only takes
+        # concrete jax.Arrays, and backward flushes before returning)
+        _memdump.tag(self._grad, origin="grad")
 
     def zero_grad(self):
         if self._grad is not None:

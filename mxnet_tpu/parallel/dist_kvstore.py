@@ -69,6 +69,7 @@ from ..base import MXNetError
 from ..kvstore.base import KVStoreBase
 from ..ndarray.ndarray import NDArray
 from ..ndarray import sparse as _sp
+from ..telemetry import flight as _flight
 from ..telemetry import metrics as _metrics
 from ..testing.faults import maybe_inject as _inject, set_role as _set_role
 
@@ -673,6 +674,9 @@ class DistServer:
                 # unauthenticated peers may only send tiny (HELLO) frames
                 cmd, f = _recv(
                     sock, max_bytes=_MAX_FRAME if authed else 4096)
+                # record BEFORE the chaos hook: a kill_server injection
+                # must leave the handled command in the flight ring
+                _flight.record("kv.serve", cmd=_CMD_NAMES.get(cmd, str(cmd)))
                 _inject("server_handle", server=self, cmd=cmd)
                 if cmd == CMD_HELLO:
                     authed = _server_hello(sock, f)
@@ -1149,9 +1153,14 @@ class DistKVStore(KVStoreBase):
             s = None
             try:
                 s = self._sock(server_id)
+                _flight.record("kv.send", cmd=cmd_name, server=server_id,
+                               attempt=attempt,
+                               **({"span": span_id} if span_id else {}))
                 with self._lock:
                     _send(s, cmd, *fields)
                     rcmd, rfields = _recv(s)
+                _flight.record("kv.recv", cmd=cmd_name, server=server_id,
+                               ok=rcmd == CMD_OK)
                 if rcmd != CMD_OK:
                     raise MXNetError(
                         "kvstore rpc (cmd %d, server %d) failed: %s"
@@ -1169,6 +1178,9 @@ class DistKVStore(KVStoreBase):
                 return rfields
             except (ConnectionError, OSError) as e:
                 last_err = e
+                _flight.record("kv.retry", cmd=cmd_name, server=server_id,
+                               attempt=attempt, error=type(e).__name__,
+                               final=attempt + 1 >= attempts)
                 if s is not None:
                     self._evict(server_id, s)
                 if attempt + 1 >= attempts:
@@ -1178,6 +1190,7 @@ class DistKVStore(KVStoreBase):
                     help="transport-failure retries (backoff + replay)",
                     command=cmd_name).inc()
                 _backoff_sleep(attempt)
+        _flight.crash_dump("kv_rpc_failed")
         raise MXNetError(
             "kvstore rpc (cmd %d, server %d) failed after %d attempt(s): "
             "%s (MXNET_KVSTORE_RETRIES/MXNET_KVSTORE_BACKOFF tune the "

@@ -27,6 +27,7 @@ import numpy as np
 
 from ..base import MXNetError
 from ..engine import Engine
+from ..telemetry import memdump as _memdump
 from ..telemetry import metrics as _metrics
 
 
@@ -45,6 +46,8 @@ class PagedKVArena:
         # (zero live compiles — the tentpole claim of the AOT warm start)
         self.kv_k = NDArray(jax.device_put(np.zeros(shape, dtype)))
         self.kv_v = NDArray(jax.device_put(np.zeros(shape, dtype)))
+        _memdump.tag(self.kv_k.data(), origin="kv_page", label="arena.k")
+        _memdump.tag(self.kv_v.data(), origin="kv_page", label="arena.v")
         # page 0 is the null page — never allocated
         self._free = collections.deque(range(1, geometry.num_pages))
         self._owner = {}          # page id -> owner tag (request id)
@@ -151,6 +154,10 @@ class PagedKVArena:
         their last reference here)."""
         self.kv_k._set_data(new_k)
         self.kv_v._set_data(new_v)
+        # re-attribute: the swap is the only place fresh arena storage
+        # appears, and an untagged buffer would sweep as "temp"
+        _memdump.tag(new_k, origin="kv_page", label="arena.k")
+        _memdump.tag(new_v, origin="kv_page", label="arena.v")
 
     def _gauges(self):
         if _metrics.enabled():

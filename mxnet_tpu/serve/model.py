@@ -409,7 +409,12 @@ def compile_serving_executables(net, geometry):
 
     g = geometry
     raw = extract_weights(net)
-    dev = lambda a: jax.device_put(np.asarray(a, dtype=g.dtype))  # noqa: E731
+    from ..telemetry import memdump as _memdump
+
+    def dev(a):
+        buf = jax.device_put(np.asarray(a, dtype=g.dtype))
+        _memdump.tag(buf, origin="param", label="serving_weight")
+        return buf
     weights = (dev(raw[0]), [{k: dev(v) for k, v in lw.items()}
                              for lw in raw[1]], dev(raw[2]),
                None if raw[3] is None else dev(raw[3]))

@@ -31,6 +31,7 @@ import time
 import numpy as np
 
 from ..base import MXNetError
+from ..telemetry import flight as _flight
 from ..telemetry import metrics as _metrics
 
 # TTFT/TPOT bucket ladders (seconds): decode steps sit well under the
@@ -52,6 +53,9 @@ class Request:
 
     def __init__(self, prompt, max_new_tokens=None, eos_id=None):
         self.rid = next(Request._ids)
+        # globally-unique-enough id stamped into flight events and served
+        # back by GET /v1/trace/<id> (pid disambiguates across ranks)
+        self.trace_id = "%x-%x" % (os.getpid(), self.rid)
         self.prompt = [int(t) for t in prompt]
         if not self.prompt:
             raise MXNetError("empty prompt")
@@ -63,7 +67,9 @@ class Request:
         self.eos_id = None if eos_id is None else int(eos_id)
         self.tokens = []          # generated ids (never includes prompt)
         self.submit_t = None      # clock() at admission-queue entry
+        self.admit_t = None       # clock() when a decode slot was assigned
         self.first_token_t = None  # clock() when prefill produced token 0
+        self.first_decode_t = None  # clock() at the first decode-step token
         self.finish_t = None
         self.error = None
         self._done = threading.Event()
@@ -73,6 +79,19 @@ class Request:
         if self.submit_t is None or self.first_token_t is None:
             return None
         return self.first_token_t - self.submit_t
+
+    def breakdown(self):
+        """Where the TTFT went: queue wait, prefill, first decode step.
+        Unreached phases are None (e.g. a rejected request has only
+        ``queue_wait=None``)."""
+        def _d(a, b):
+            return None if a is None or b is None else b - a
+        return {
+            "queue_wait_s": _d(self.submit_t, self.admit_t),
+            "prefill_s": _d(self.admit_t, self.first_token_t),
+            "first_decode_s": _d(self.first_token_t, self.first_decode_t),
+            "ttft_s": self.ttft,
+        }
 
     def result(self, timeout=None):
         """Block for the generated tokens (raises the request's error)."""
@@ -141,6 +160,47 @@ class Scheduler:
         self.prefills = 0
         self._ttfts = collections.deque(maxlen=4096)
         self._tpots = collections.deque(maxlen=4096)
+        # per-request traces (GET /v1/trace/<id>): bounded FIFO so a
+        # long-lived server can't grow without limit.  Own lock — trace
+        # events are appended while self._lock is held (non-reentrant).
+        self._trace_lock = threading.Lock()
+        self._traces = collections.OrderedDict()
+        self._trace_cap = _env_int("MXNET_SERVE_TRACE_CAP", 512)
+
+    # -- per-request tracing ----------------------------------------------
+    def _trace_new(self, req):
+        with self._trace_lock:
+            self._traces[req.trace_id] = {
+                "trace_id": req.trace_id, "rid": req.rid,
+                "prompt_len": len(req.prompt), "status": "queued",
+                "events": [],
+            }
+            while len(self._traces) > self._trace_cap:
+                self._traces.popitem(last=False)
+
+    def _trace_event(self, req, event, status=None, **fields):
+        """One scheduler transition: stamped into the flight ring (with
+        the request's trace id) AND onto the request's stored trace."""
+        _flight.record("serve." + event, tid=req.trace_id, rid=req.rid,
+                       **fields)
+        with self._trace_lock:
+            tr = self._traces.get(req.trace_id)
+            if tr is None:
+                return
+            tr["events"].append(dict(fields, event=event, t=self.clock()))
+            if status is not None:
+                tr["status"] = status
+
+    def trace(self, trace_id):
+        """The stored trace of one request (``GET /v1/trace/<id>``);
+        None when unknown/evicted."""
+        with self._trace_lock:
+            tr = self._traces.get(trace_id)
+            if tr is None:
+                return None
+            tr = dict(tr)
+            tr["events"] = [dict(e) for e in tr["events"]]
+            return tr
 
     # -- admission --------------------------------------------------------
     def pick_bucket(self, prompt_len):
@@ -153,6 +213,7 @@ class Scheduler:
 
     def submit(self, req):
         """Queue ``req``; backpressure + obvious rejections happen NOW."""
+        self._trace_new(req)
         if self.pick_bucket(len(req.prompt)) is None:
             self._reject(req, MXNetError(
                 "prompt of %d tokens exceeds the largest prefill bucket "
@@ -171,12 +232,15 @@ class Scheduler:
             if len(self._queue) >= self.queue_depth:
                 self.rejected += 1
                 self._count_req("rejected")
+                self._trace_event(req, "rejected", status="rejected",
+                                  reason="queue_full")
                 raise ServeQueueFull(
                     "admission queue full (%d waiting, "
                     "MXNET_SERVE_QUEUE_DEPTH=%d)"
                     % (len(self._queue), self.queue_depth))
             req.submit_t = self.clock()
             self._queue.append(req)
+            self._trace_event(req, "submit", prompt_len=len(req.prompt))
             self._gauges_locked()
             self._work.notify()
         return req
@@ -184,6 +248,8 @@ class Scheduler:
     def _reject(self, req, err):
         self.rejected += 1
         self._count_req("rejected")
+        self._trace_event(req, "rejected", status="rejected",
+                          reason=str(err))
         req.error = err
         req.finish_t = self.clock()
         req._done.set()
@@ -215,7 +281,17 @@ class Scheduler:
                              position=len(req.prompt))
                 self._slots[slot_i] = slot
                 self.admitted += 1
+                req.admit_t = self.clock()
                 self._count_req("admitted")
+                self._trace_event(req, "admit", status="active",
+                                  slot=slot_i, pages=len(pages))
+                if _metrics.enabled() and req.submit_t is not None:
+                    _metrics.histogram(
+                        "mxnet_serve_queue_wait_seconds",
+                        help="submit -> decode-slot assignment "
+                             "(TTFT breakdown: time spent queued)",
+                        buckets=_TTFT_BUCKETS,
+                    ).observe(req.admit_t - req.submit_t)
                 self._gauges_locked()
             self._prefill(slot)
             admitted = True
@@ -239,6 +315,8 @@ class Scheduler:
         req.first_token_t = self.clock()
         ttft = req.first_token_t - req.submit_t
         self._ttfts.append(ttft)
+        self._trace_event(req, "prefill", bucket=bucket,
+                          prefill_s=req.first_token_t - t0, ttft_s=ttft)
         if _metrics.enabled():
             _metrics.histogram(
                 "mxnet_serve_ttft_seconds",
@@ -274,12 +352,28 @@ class Scheduler:
             return True
         self.decode_steps += 1
         dt = self.clock() - t0
+        # one flight event per batched step, not per request — decode is
+        # the serve hot loop and the ring must outlast a request's life
+        _flight.record("serve.decode", batch=len(active), dur=round(dt, 6))
         for i, s in active:
             s.position += 1
             tok = self.sampler(logits[i], s.req)
             s.req.tokens.append(tok)
             self.tokens_generated += 1
             self._tpots.append(dt)
+            req = s.req
+            if req.first_decode_t is None and len(req.tokens) >= 2:
+                req.first_decode_t = self.clock()
+                self._trace_event(
+                    req, "first_decode",
+                    first_decode_s=req.first_decode_t - req.first_token_t)
+                if _metrics.enabled() and req.first_token_t is not None:
+                    _metrics.histogram(
+                        "mxnet_serve_first_decode_seconds",
+                        help="first token -> first decode-step token "
+                             "(TTFT breakdown: decode pipeline entry)",
+                        buckets=_TPOT_BUCKETS,
+                    ).observe(req.first_decode_t - req.first_token_t)
             self._maybe_complete(s)
         if _metrics.enabled():
             _metrics.histogram(
@@ -321,6 +415,17 @@ class Scheduler:
             self._gauges_locked()
         req.error = error
         req.finish_t = self.clock()
+        status = "failed" if error is not None else "completed"
+        self._trace_event(req, "finish", status=status,
+                          tokens=len(req.tokens),
+                          error=(type(error).__name__ if error else ""))
+        with self._trace_lock:
+            tr = self._traces.get(req.trace_id)
+            if tr is not None:
+                tr["tokens"] = list(req.tokens)
+                tr["breakdown"] = req.breakdown()
+                if error is not None:
+                    tr["error"] = str(error)
         req._done.set()
 
     # -- introspection ----------------------------------------------------

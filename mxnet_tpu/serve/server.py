@@ -28,6 +28,8 @@ import time
 import numpy as np
 
 from ..base import MXNetError
+from ..telemetry import flight as _flight
+from ..telemetry import memdump as _memdump
 from ..telemetry import metrics as _metrics
 from .arena import PagedKVArena
 from .scheduler import Request, Scheduler
@@ -55,6 +57,7 @@ class AOTRunner:
                            padded, np.int32(length),
                            block_row.astype(np.int32))
         self.arena.adopt(k, v)
+        _memdump.tag(logits, origin="activation", label="prefill_logits")
         return np.asarray(logits)  # mxlint: allow-host-sync
 
     def decode(self, tokens, positions, block_tables):
@@ -64,6 +67,7 @@ class AOTRunner:
             tokens.astype(np.int32), positions.astype(np.int32),
             block_tables.astype(np.int32))
         self.arena.adopt(k, v)
+        _memdump.tag(logits, origin="activation", label="decode_logits")
         return np.asarray(logits)  # mxlint: allow-host-sync
 
 
@@ -136,6 +140,25 @@ class LlamaServer:
     def stats(self):
         return self.scheduler.stats()
 
+    def healthz(self):
+        """The GET /healthz body: scheduler stats plus the operational
+        signals an external prober actually pages on — arena pressure,
+        queue depth, live device memory, flight-recorder state."""
+        st = self.scheduler.stats()
+        try:
+            by_origin, total = _memdump.refresh()
+        except Exception:           # health must not 500 on accounting
+            by_origin, total = {}, 0
+        st.update({
+            "ok": True,
+            "queue_depth": st["queue_len"],
+            "live_device_bytes": total,
+            "device_bytes_by_origin": by_origin,
+            "peak_device_bytes": _memdump.peak_bytes(),
+            "flight": _flight.status(),
+        })
+        return st
+
     # -- naive baseline (bench comparison) --------------------------------
     def static_generate(self, requests):
         """Static batching: groups of ``max_batch``, no admission between
@@ -191,7 +214,8 @@ class LlamaServer:
     # -- HTTP front -------------------------------------------------------
     def serve_http(self, port=0, host="127.0.0.1"):
         """Minimal stdlib HTTP front (POST /v1/generate, GET /metrics,
-        GET /healthz).  Returns the bound (host, port)."""
+        GET /healthz, GET /v1/trace/<id>).  Returns the bound
+        (host, port)."""
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         from .scheduler import ServeQueueFull
@@ -216,7 +240,16 @@ class LlamaServer:
                     self._send(200, _metrics.prometheus_text(),
                                ctype="text/plain; version=0.0.4")
                 elif self.path == "/healthz":
-                    self._send(200, server.stats())
+                    self._send(200, server.healthz())
+                elif self.path.startswith("/v1/trace/"):
+                    tid = self.path[len("/v1/trace/"):]
+                    tr = server.scheduler.trace(tid)
+                    if tr is None:
+                        self._send(404, {"error": "unknown trace id %r "
+                                                  "(evicted or never seen)"
+                                                  % tid})
+                    else:
+                        self._send(200, tr)
                 else:
                     self._send(404, {"error": "not found"})
 
@@ -248,7 +281,9 @@ class LlamaServer:
                     self._send(500, {"error": str(e)})
                     return
                 self._send(200, {"tokens": tokens,
-                                 "ttft_s": req.ttft})
+                                 "ttft_s": req.ttft,
+                                 "trace_id": req.trace_id,
+                                 "breakdown": req.breakdown()})
 
         self._http = ThreadingHTTPServer((host, port), Handler)
         threading.Thread(target=self._http.serve_forever,

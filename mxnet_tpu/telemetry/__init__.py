@@ -1,4 +1,5 @@
-"""``mx.telemetry`` — always-on metrics + cross-process trace merging.
+"""``mx.telemetry`` — always-on metrics, flight recorder, memory
+accounting + cross-process trace merging.
 
 See docs/observability.md.  Quick tour::
 
@@ -11,6 +12,13 @@ See docs/observability.md.  Quick tour::
     # one timeline from N per-process profiler dumps
     mx.telemetry.merge_traces(["worker0.json", "server.json"],
                               out="merged.json")
+
+    # black-box forensics: last 4096 framework events, crash-dumped
+    mx.telemetry.flight.events(kind="kv", last=10)
+    mx.telemetry.flight.dump("flight.json")
+
+    # who owns the device memory?
+    mx.telemetry.memdump.device_bytes()   # {"param": ..., "kv_page": ...}
 """
 from .metrics import (  # noqa: F401
     counter, gauge, histogram,
@@ -19,3 +27,5 @@ from .metrics import (  # noqa: F401
     register_collector, record_compile,
 )
 from .trace import merge_traces  # noqa: F401
+from . import flight  # noqa: F401
+from . import memdump  # noqa: F401

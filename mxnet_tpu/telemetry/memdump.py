@@ -1,0 +1,285 @@
+"""Device-memory accounting: who owns every live device buffer?
+
+Buffer donation (PR 6) and the paged KV arena (PR 8) made device-memory
+ownership invisible — the exact class of bug (the CPU donation heap
+corruption) we already hit blind.  This module answers two questions:
+
+- **"what is on the device right now, and why?"** — live accounting by
+  *origin* (``param`` / ``activation`` / ``kv_page`` / ``temp`` /
+  ``grad``), exported as ``mxnet_device_bytes{origin}`` gauges plus a
+  ``mxnet_device_peak_bytes`` watermark.
+- **"what was on the device when we OOMed?"** — a RESOURCE_EXHAUSTED
+  interceptor (wired into the engine's push/flush exception paths) that
+  dumps the top-K largest buffers with their origin, label, and the
+  flight-recorder seq of their allocation, before re-raising.
+
+Design: **zero hot-path cost**.  The authoritative live set is
+``jax.live_arrays()``, walked only at snapshot time (a telemetry
+collector, same pattern as the engine stats).  Tags add *attribution*
+and are applied only at low-frequency allocation sites — host→device
+uploads in ``NDArray.__init__``, ``attach_grad``, KV-arena page
+buffers, serving weight upload — never per-op: an untagged live buffer
+is attributed to ``temp`` (op temporaries are exactly the buffers that
+churn too fast to be worth tagging).  Tag liveness rides on
+``weakref.finalize``; a periodic sweep against the live set prunes
+anything a finalizer missed, so ``id()`` reuse cannot misattribute.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import sys
+import threading
+import weakref
+
+import jax
+
+from ..base import atomic_path, env_flag
+from . import flight
+from .metrics import gauge, register_collector
+
+__all__ = [
+    "origin", "current_origin", "tag", "refresh", "device_bytes",
+    "peak_bytes", "topk", "reconcile", "is_oom", "oom_report",
+    "maybe_oom_report", "enabled", "reset",
+]
+
+ORIGINS = ("param", "activation", "kv_page", "temp", "grad")
+
+_ENABLED = env_flag("MXNET_MEMDUMP", True)
+
+_origin_var = contextvars.ContextVar("mxnet_memdump_origin", default="temp")
+
+_lock = threading.Lock()
+_tags = {}          # id(jax.Array) -> dict(ref, origin, nbytes, seq, ...)
+_seen_origins = set(ORIGINS)
+_peak = 0
+_freed_count = 0
+_freed_bytes = 0
+
+
+def enabled():
+    return _ENABLED
+
+
+def current_origin():
+    return _origin_var.get()
+
+
+@contextlib.contextmanager
+def origin(name):
+    """Scope: buffers tagged inside are attributed to ``name``.
+
+    >>> with memdump.origin("param"):
+    ...     w = mx.nd.array(weights)
+    """
+    tok = _origin_var.set(name)
+    try:
+        yield
+    finally:
+        _origin_var.reset(tok)
+
+
+def _on_free(key, nbytes):
+    global _freed_count, _freed_bytes
+    with _lock:
+        if _tags.pop(key, None) is not None:
+            _freed_count += 1
+            _freed_bytes += nbytes
+
+
+def tag(buf, origin=None, label=None):
+    """Attribute ``buf`` (a ``jax.Array``) to an origin.  Called at
+    allocation sites, NOT per-op.  Returns the flight seq of the
+    ``mem.tag`` event (or -1 when disabled / untaggable)."""
+    if not _ENABLED or buf is None or not isinstance(buf, jax.Array):
+        return -1
+    o = origin or _origin_var.get()
+    try:
+        nbytes = int(buf.nbytes)
+    except Exception:
+        return -1
+    seq = flight.record("mem.tag", origin=o, nbytes=nbytes,
+                        label=label or "")
+    key = id(buf)
+    rec = {"ref": weakref.ref(buf), "origin": o, "nbytes": nbytes,
+           "seq": seq, "label": label or "",
+           "shape": tuple(getattr(buf, "shape", ())),
+           "dtype": str(getattr(buf, "dtype", "?"))}
+    with _lock:
+        _tags[key] = rec
+        _seen_origins.add(o)
+    try:
+        weakref.finalize(buf, _on_free, key, nbytes)
+    except TypeError:
+        pass  # unweakrefable backend array: the sweep prunes it instead
+    return seq
+
+
+def _sweep():
+    """Walk the live set, attribute bytes by origin, prune dead tags.
+    Returns ``(by_origin, total, live_tagged, live_untagged)``."""
+    live = jax.live_arrays()
+    by = dict.fromkeys(_seen_origins, 0)
+    tagged = untagged = 0
+    live_keys = set()
+    with _lock:
+        tags = dict(_tags)
+    for a in live:
+        try:
+            nbytes = int(a.nbytes)
+        except Exception:
+            continue
+        key = id(a)
+        rec = tags.get(key)
+        # identity check defeats id() reuse if a finalizer was missed
+        if rec is not None and rec["ref"]() is a:
+            by[rec["origin"]] = by.get(rec["origin"], 0) + nbytes
+            tagged += 1
+            live_keys.add(key)
+        else:
+            by["temp"] = by.get("temp", 0) + nbytes
+            untagged += 1
+    with _lock:
+        for key in list(_tags):
+            if key not in live_keys and _tags[key]["ref"]() is None:
+                del _tags[key]
+    return by, sum(by.values()), tagged, untagged
+
+
+def refresh():
+    """Recompute live device bytes, publish the gauges, advance the peak
+    watermark.  Returns ``(by_origin, total_bytes)``.  Snapshot-time
+    cost only — this is the registered telemetry collector."""
+    global _peak
+    by, total, _, _ = _sweep()
+    with _lock:
+        if total > _peak:
+            _peak = total
+    for o, v in sorted(by.items()):
+        gauge("mxnet_device_bytes", help="live device bytes by origin",
+              origin=o).set(v)
+    gauge("mxnet_device_peak_bytes",
+          help="peak observed live device bytes (sampled at snapshots, "
+               "OOM reports and explicit refresh)").set(_peak)
+    return by, total
+
+
+def device_bytes():
+    """Live device bytes by origin (runs a sweep)."""
+    return refresh()[0]
+
+
+def peak_bytes():
+    """The peak watermark as of the last :func:`refresh`/sweep."""
+    with _lock:
+        return _peak
+
+
+def topk(k=None):
+    """The K largest live *tagged* buffers, as attribution dicts
+    (origin, nbytes, shape, dtype, label, flight seq)."""
+    if k is None:
+        k = int(os.environ.get("MXNET_MEMDUMP_TOPK", "20") or 20)
+    live = jax.live_arrays()
+    with _lock:
+        tags = dict(_tags)
+    out = []
+    for a in live:
+        rec = tags.get(id(a))
+        if rec is not None and rec["ref"]() is a:
+            out.append({"origin": rec["origin"], "nbytes": rec["nbytes"],
+                        "shape": list(rec["shape"]), "dtype": rec["dtype"],
+                        "label": rec["label"], "flight_seq": rec["seq"]})
+    out.sort(key=lambda r: -r["nbytes"])
+    return out[:k]
+
+
+def reconcile():
+    """Cross-check frees/donations against the engine's own stats — a
+    drifting delta between ``finalized_frees`` and what the engine
+    thinks it donated is how the donation heap bug would have surfaced
+    *before* corrupting anything."""
+    from ..engine import Engine
+    by, total, tagged, untagged = _sweep()
+    stats = Engine.get().stats
+    return {
+        "live_bytes": total,
+        "live_by_origin": by,
+        "live_tagged": tagged,
+        "live_untagged": untagged,
+        "finalized_frees": _freed_count,
+        "finalized_bytes": _freed_bytes,
+        "engine_donated": getattr(stats, "bulk_donated", 0),
+        "engine_ops_pushed": getattr(stats, "ops_pushed", 0),
+    }
+
+
+# ----------------------------------------------------------------------
+# OOM interception
+# ----------------------------------------------------------------------
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                "OOM: ", "Allocator ran out")
+
+
+def is_oom(exc):
+    s = "%s: %s" % (type(exc).__name__, exc)
+    return any(m in s for m in _OOM_MARKERS)
+
+
+def oom_report(exc, path=None):
+    """Dump the attribution story of an OOM: totals by origin + top-K
+    buffers with their allocation flight seqs.  Writes JSON to ``path``
+    (default ``MXNET_MEMDUMP_PATH`` when set), always prints a compact
+    table to stderr and records a ``mem.oom`` flight event.  Never
+    raises — the caller re-raises the original error."""
+    try:
+        by, total = refresh()
+        top = topk()
+        doc = {"error": "%s: %s" % (type(exc).__name__, exc),
+               "total_bytes": total, "by_origin": by,
+               "peak_bytes": peak_bytes(), "topk": top}
+        flight.record("mem.oom", total=total,
+                      error=type(exc).__name__)
+        flight.crash_dump("oom")
+        lines = ["[mxnet_tpu] device OOM: %d live bytes" % total]
+        for o, v in sorted(by.items(), key=lambda kv: -kv[1]):
+            if v:
+                lines.append("  %-12s %d" % (o, v))
+        for r in top[:5]:
+            lines.append("  top: %s %s %s %db (flight seq %s)"
+                         % (r["origin"], r["dtype"], r["shape"],
+                            r["nbytes"], r["flight_seq"]))
+        print("\n".join(lines), file=sys.stderr)
+        path = path or os.environ.get("MXNET_MEMDUMP_PATH") or None
+        if path:
+            with atomic_path(path) as tmp:
+                with open(tmp, "w") as f:
+                    json.dump(doc, f)
+        return doc
+    except Exception:
+        return None
+
+
+def maybe_oom_report(exc):
+    """Engine choke-point hook: report iff ``exc`` smells like device
+    memory exhaustion.  Returns True when a report was made."""
+    if not is_oom(exc):
+        return False
+    oom_report(exc)
+    return True
+
+
+register_collector(refresh)
+
+
+def reset():
+    """Test hook: drop tags and the peak watermark."""
+    global _peak, _freed_count, _freed_bytes
+    with _lock:
+        _tags.clear()
+        _peak = 0
+        _freed_count = 0
+        _freed_bytes = 0

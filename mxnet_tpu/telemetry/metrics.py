@@ -38,7 +38,8 @@ from ..base import atomic_path, env_flag
 
 _ENABLED = env_flag("MXNET_TELEMETRY", True)
 
-_lock = threading.Lock()          # guards registration, not updates
+_lock = threading.Lock()          # guards registration (updates take
+                                  # the per-metric lock instead)
 _METRICS = {}                     # (name, labels_tuple) -> metric object
 _FAMILIES = {}                    # name -> (kind, help)
 _COLLECTORS = []                  # snapshot-time exporters
@@ -68,16 +69,24 @@ def disable():
 
 class Counter:
     """Monotonic count.  ``set()`` exists for collectors that mirror an
-    externally-maintained total (e.g. ``Engine.stats.ops_pushed``)."""
+    externally-maintained total (e.g. ``Engine.stats.ops_pushed``).
 
-    __slots__ = ("value",)
+    Updates take a per-metric lock: ``value += n`` is three bytecodes and
+    the serving tier mutates handles from the scheduler loop and every
+    HTTP thread at once — without the lock, concurrent increments lose
+    counts.  Engine hot-path families are collector-backed (one ``set``
+    at snapshot time), so the lock never sits on the dispatch path."""
+
+    __slots__ = ("value", "_lk")
 
     def __init__(self):
         self.value = 0
+        self._lk = threading.Lock()
 
     def inc(self, n=1):
         if _ENABLED:
-            self.value += n
+            with self._lk:
+                self.value += n
 
     def set(self, value):
         if _ENABLED:
@@ -85,10 +94,11 @@ class Counter:
 
 
 class Gauge:
-    __slots__ = ("value",)
+    __slots__ = ("value", "_lk")
 
     def __init__(self):
         self.value = 0
+        self._lk = threading.Lock()
 
     def set(self, value):
         if _ENABLED:
@@ -96,30 +106,35 @@ class Gauge:
 
     def inc(self, n=1):
         if _ENABLED:
-            self.value += n
+            with self._lk:
+                self.value += n
 
     def dec(self, n=1):
         if _ENABLED:
-            self.value -= n
+            with self._lk:
+                self.value -= n
 
 
 class Histogram:
     """Prometheus-style histogram: per-bucket counts (cumulated at export
-    time), plus ``sum`` and ``count``."""
+    time), plus ``sum`` and ``count``.  ``observe`` locks so concurrent
+    observers can't lose bucket increments (see Counter)."""
 
-    __slots__ = ("bounds", "counts", "sum", "count")
+    __slots__ = ("bounds", "counts", "sum", "count", "_lk")
 
     def __init__(self, bounds):
         self.bounds = tuple(bounds)
         self.counts = [0] * (len(self.bounds) + 1)  # last = +Inf
         self.sum = 0.0
         self.count = 0
+        self._lk = threading.Lock()
 
     def observe(self, value):
         if _ENABLED:
-            self.counts[bisect.bisect_left(self.bounds, value)] += 1
-            self.sum += value
-            self.count += 1
+            with self._lk:
+                self.counts[bisect.bisect_left(self.bounds, value)] += 1
+                self.sum += value
+                self.count += 1
 
 
 _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}

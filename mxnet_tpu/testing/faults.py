@@ -60,6 +60,8 @@ import os
 import threading
 import time
 
+from ..telemetry import flight as _flight
+
 
 class FaultInjected(RuntimeError):
     """Raised by ``action: "raise"`` rules (and used as the marker type
@@ -152,6 +154,13 @@ class FaultPlan:
                     "ctx": {k: v for k, v in ctx.items()
                             if isinstance(v, (int, float, str, bool))},
                 })
+                # chaos forensics: the flight dump of a killed process
+                # must name what was injected where (docs/observability.md)
+                scalars = {k: v for k, v in ctx.items()
+                           if isinstance(v, (int, float, str, bool))
+                           and k not in ("site", "action", "rule", "n")}
+                _flight.record("fault", site=site, action=rule["action"],
+                               rule=i, n=self._fired[i], **scalars)
                 break
         if action is not None:
             self._perform(action, ctx)

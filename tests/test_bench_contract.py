@@ -63,6 +63,9 @@ def test_single_metric_line(monkeypatch, capsys):
     assert rec["value"] == 300.0
     assert rec["platform"] == "cpu"
     assert rec["fallback"] is False
+    # ISSUE 9: every record carries the device-memory high-water mark
+    assert isinstance(rec["peak_device_bytes"], int)
+    assert rec["peak_device_bytes"] >= 0
 
 
 def test_default_mode_emits_all_metrics_in_one_line(monkeypatch, capsys):
@@ -95,6 +98,10 @@ def test_default_mode_emits_all_metrics_in_one_line(monkeypatch, capsys):
                      "bert_cold_start_seconds",
                      "llama_cold_start_seconds"]
     assert all("platform" in m and "fallback" in m for m in rec["metrics"])
+    # ISSUE 9: memory provenance in every row, headline included
+    assert isinstance(rec["peak_device_bytes"], int)
+    assert all(isinstance(m["peak_device_bytes"], int)
+               and m["peak_device_bytes"] >= 0 for m in rec["metrics"])
     # the op-bulking microbench rides in the metrics array (ISSUE 4);
     # the recorded-chain and 64-op variants joined in ISSUE 6
     by_name = {m["metric"]: m for m in rec["metrics"]}

@@ -15,6 +15,7 @@ round trips.
 import json
 import os
 import signal
+import sys
 import threading
 import time
 
@@ -194,6 +195,68 @@ def test_server_killed_mid_round_fails_fast(monkeypatch, _fast_retries):
     assert time.monotonic() - t0 < 20, "worker hung on a dead server"
     assert servers[0]._stop.is_set()
     assert [e["action"] for e in plan.events] == ["kill_server"]
+
+
+def test_kill_server_chaos_run_leaves_forensic_flight_dump(
+        tmp_path, monkeypatch, _fast_retries):
+    """The ISSUE 9 acceptance scenario: a seeded kill_server chaos run
+    must leave a flight dump — written by the RPC failure path itself —
+    that tools/mxflight.py parses, containing the final engine flush and
+    the kvstore RPC to the killed server as the last send before death,
+    plus the fault event naming the injection."""
+    from mxnet_tpu.telemetry import flight
+
+    flight.reset()
+    # arm the dump path WITHOUT installing process-global hooks (the
+    # SIGTERM test below asserts the default disposition); the final
+    # RPC failure calls flight.crash_dump() which only needs the path
+    dump_path = tmp_path / "flight-chaos.json"
+    monkeypatch.setattr(flight, "_armed_path", str(dump_path))
+    faults.install(FaultPlan(seed=SEED, rules=[
+        {"site": "server_handle", "action": "kill_server", "times": 1,
+         "match": {"cmd": CMD_PUSH}}]))
+    servers, make_worker = _start_cluster(1, sync=True)
+    kv = make_worker(0)
+    kv.init("w", nd.zeros((2,)))
+    # a real engine op computes the pushed value: its bulk segment
+    # flushes when the push serializes it — the "final flush" on record
+    grad = nd.array(np.ones((2,), np.float32)) * 2
+    with pytest.raises(MXNetError):
+        kv.push("w", grad)
+
+    # the black box was written by the failure, not by the test
+    doc = flight.load(str(dump_path))
+    assert doc["meta"]["reason"] == "kv_rpc_failed"
+    evs = doc["events"]
+    kinds = [e["kind"] for e in evs]
+    # the injection itself is on the record
+    (fault,) = [e for e in evs if e["kind"] == "fault"]
+    assert fault["action"] == "kill_server"
+    assert fault["site"] == "server_handle"
+    # the last RPC before death is the push to the killed server
+    sends = [e for e in evs if e["kind"] == "kv.send"]
+    assert sends, kinds
+    assert sends[-1]["cmd"] == "push"
+    killed_server = sends[-1]["server"]
+    # every retry attempt targeted the same dead server and is recorded
+    retries = [e for e in evs if e["kind"] == "kv.retry"]
+    assert retries and all(r["server"] == killed_server for r in retries)
+    assert retries[-1]["final"] is True
+    # the engine work that preceded the RPC (init/push buffers) is there
+    assert "engine.flush" in kinds
+    flush_seq = max(e["seq"] for e in evs if e["kind"] == "engine.flush")
+    assert flush_seq < sends[-1]["seq"], \
+        "the final flush must precede the dying RPC on the timeline"
+
+    # and tools/mxflight.py can pretty-print it
+    import subprocess
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "mxflight.py"),
+         "show", str(dump_path), "--kind", "kv"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "kv.send" in r.stdout and "cmd=push" in r.stdout
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,273 @@
+"""Flight recorder: ring semantics, dumps, crash hooks, CLI and trace
+merge (docs/observability.md "Flight recorder")."""
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.telemetry import flight
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ring():
+    flight.reset()
+    was = flight.enabled()
+    flight.enable()
+    yield
+    flight.reset()
+    if not was:
+        flight.disable()
+
+
+# ---------------------------------------------------------------------------
+# ring semantics
+# ---------------------------------------------------------------------------
+
+def test_record_returns_monotonic_seqs_and_events_sorted():
+    s0 = flight.record("t.alpha", x=1)
+    s1 = flight.record("t.beta", x=2)
+    s2 = flight.record("t.alpha", x=3)
+    assert s0 < s1 < s2
+    evs = flight.events()
+    assert [e["seq"] for e in evs] == sorted(e["seq"] for e in evs)
+    assert [e["kind"] for e in evs[-3:]] == ["t.alpha", "t.beta", "t.alpha"]
+    assert evs[-1]["x"] == 3
+    assert evs[-1]["ts"] >= evs[-3]["ts"] >= 0.0
+
+
+def test_kind_filter_exact_and_dotted_prefix():
+    flight.record("kv.send", cmd="push")
+    flight.record("kv.recv", cmd="push")
+    flight.record("kvx.other")
+    flight.record("engine.push", op="add")
+    kv = flight.events(kind="kv")
+    assert {e["kind"] for e in kv} == {"kv.send", "kv.recv"}
+    assert [e["kind"] for e in flight.events(kind="kv.send")] == ["kv.send"]
+    assert flight.events(kind="engine.push", last=1)[0]["op"] == "add"
+
+
+def test_ring_wraps_and_counts_dropped():
+    cap = flight.status()["capacity"]
+    for i in range(cap + 100):
+        flight.record("t.wrap", i=i)
+    st = flight.status()
+    assert st["recorded"] == cap + 100
+    assert st["dropped"] == 100
+    evs = flight.events(kind="t.wrap")
+    assert len(evs) == cap
+    # oldest survivors are exactly the post-wrap window
+    assert evs[0]["i"] == 100 and evs[-1]["i"] == cap + 99
+
+
+def test_disable_stops_recording_but_keeps_ring():
+    flight.record("t.kept")
+    flight.disable()
+    assert flight.record("t.lost") == -1
+    flight.enable()
+    kinds = {e["kind"] for e in flight.events(kind="t")}
+    assert "t.kept" in kinds and "t.lost" not in kinds
+
+
+# ---------------------------------------------------------------------------
+# dumps
+# ---------------------------------------------------------------------------
+
+def test_dump_load_roundtrip_and_meta(tmp_path):
+    flight.record("t.one", a=1)
+    flight.record("t.two", b="x")
+    path = flight.dump(tmp_path / "f.json", reason="unit")
+    doc = flight.load(path)
+    assert doc["meta"]["pid"] == os.getpid()
+    assert doc["meta"]["reason"] == "unit"
+    assert doc["meta"]["wall_t0_us"] > 0
+    assert doc["meta"]["dropped"] == 0
+    kinds = [e["kind"] for e in doc["events"]]
+    assert "t.one" in kinds and "t.two" in kinds
+
+
+def test_dump_expands_pid_and_rank_placeholders(tmp_path):
+    flight.record("t.x")
+    out = flight.dump(str(tmp_path / "flight-{rank}-{pid}.json"))
+    assert out.endswith("flight-0-%d.json" % os.getpid())
+    assert os.path.exists(out)
+
+
+def test_load_rejects_non_dumps(tmp_path):
+    p = tmp_path / "notdump.json"
+    p.write_text(json.dumps({"traceEvents": []}))
+    with pytest.raises(ValueError):
+        flight.load(str(p))
+
+
+def test_dump_without_path_or_arming_raises():
+    if flight.armed():
+        pytest.skip("MXNET_FLIGHT_DUMP armed in this environment")
+    with pytest.raises(ValueError):
+        flight.dump()
+
+
+def test_crash_dump_noop_unarmed_and_writes_when_armed(tmp_path,
+                                                       monkeypatch):
+    monkeypatch.setattr(flight, "_armed_path", None)
+    assert flight.crash_dump("poison") is None
+    # arm WITHOUT installing process-wide hooks (other tests assert the
+    # default SIGTERM disposition) — crash_dump only needs the path
+    monkeypatch.setattr(flight, "_armed_path", str(tmp_path / "c.json"))
+    flight.record("engine.poison", op="add")
+    out = flight.crash_dump("poison")
+    doc = flight.load(out)
+    assert doc["meta"]["reason"] == "poison"
+    assert any(e["kind"] == "engine.poison" for e in doc["events"])
+
+
+# ---------------------------------------------------------------------------
+# engine wiring
+# ---------------------------------------------------------------------------
+
+def test_engine_ops_leave_flush_and_sync_events():
+    x = nd.ones((4, 4))
+    y = (x * 2 + 1)
+    y.asnumpy()  # sync => flush
+    evs = flight.events(kind="engine")
+    kinds = {e["kind"] for e in evs}
+    assert "engine.sync" in kinds
+    # BulkEngine default: the sync flushed the deferred segment
+    flushes = [e for e in evs if e["kind"] == "engine.flush"]
+    assert flushes and flushes[-1]["ops"] >= 1
+
+
+def test_failed_op_records_poison_event():
+    a = nd.ones((2, 3))
+    b = nd.ones((4, 5))
+    with pytest.raises(Exception):
+        (a + b).asnumpy()  # shape mismatch surfaces at flush
+    assert any(e["kind"] == "engine.poison"
+               for e in flight.events(kind="engine"))
+
+
+# ---------------------------------------------------------------------------
+# crash hooks (subprocess: hooks are process-global)
+# ---------------------------------------------------------------------------
+
+_CRASH_SCRIPT = """
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+x = nd.ones((2, 2)) * 3
+x.asnumpy()
+raise RuntimeError("synthetic crash")
+"""
+
+
+def test_armed_process_dumps_on_unhandled_exception(tmp_path):
+    dump = tmp_path / "crash-{pid}.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXNET_FLIGHT_DUMP=str(dump))
+    r = subprocess.run([sys.executable, "-c", _CRASH_SCRIPT], env=env,
+                       cwd=REPO, capture_output=True, text=True,
+                       timeout=120)
+    assert r.returncode != 0 and "synthetic crash" in r.stderr
+    (path,) = tmp_path.glob("crash-*.json")
+    doc = flight.load(str(path))
+    assert doc["meta"]["reason"] == "exception:RuntimeError"
+    assert any(e["kind"] == "engine.flush" for e in doc["events"])
+
+
+_TERM_SCRIPT = """
+import os, signal, sys
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+nd.ones((2, 2)).asnumpy()
+sys.stdout.write("ready\\n"); sys.stdout.flush()
+signal.pause()
+"""
+
+
+def test_armed_process_dumps_on_sigterm(tmp_path):
+    dump = tmp_path / "term.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXNET_FLIGHT_DUMP=str(dump))
+    proc = subprocess.Popen([sys.executable, "-c", _TERM_SCRIPT], env=env,
+                            cwd=REPO, stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "ready"
+        proc.terminate()
+        proc.wait(timeout=60)
+    finally:
+        proc.kill()
+    # exit status stays "killed by SIGTERM" (the hook chains to SIG_DFL)
+    assert proc.returncode == -signal.SIGTERM
+    doc = flight.load(str(dump))
+    assert doc["meta"]["reason"] == "sigterm"
+
+
+def test_unarmed_process_installs_no_hooks():
+    script = ("import signal, sys\n"
+              "import mxnet_tpu as mx\n"
+              "from mxnet_tpu.telemetry import flight\n"
+              "assert not flight.armed()\n"
+              "assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL\n"
+              "assert sys.excepthook is sys.__excepthook__\n"
+              "print('ok')\n")
+    env = {k: v for k, v in os.environ.items() if k != "MXNET_FLIGHT_DUMP"}
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([sys.executable, "-c", script], env=env, cwd=REPO,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "ok" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# tools/mxflight.py + trace merge
+# ---------------------------------------------------------------------------
+
+def _load_mxflight():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "mxflight_under_test", os.path.join(REPO, "tools", "mxflight.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_mxflight_show_filters(tmp_path, capsys):
+    flight.record("kv.send", cmd="push", server=0)
+    flight.record("engine.flush", ops=3)
+    path = flight.dump(tmp_path / "d.json")
+    cli = _load_mxflight()
+    assert cli.main(["show", path, "--kind", "kv", "--last", "5"]) == 0
+    out = capsys.readouterr().out
+    assert "kv.send" in out and "engine.flush" not in out
+    assert "cmd=push" in out
+
+
+def test_mxflight_merge_aligns_on_one_timeline(tmp_path, capsys):
+    flight.record("engine.flush", ops=1)
+    p0 = flight.dump(tmp_path / "r0.json")
+    flight.record("kv.send", cmd="pull", server=1)
+    p1 = flight.dump(tmp_path / "r1.json")
+    out = tmp_path / "merged.json"
+    cli = _load_mxflight()
+    assert cli.main(["merge", p0, p1, "-o", str(out)]) == 0
+    merged = json.load(open(out))
+    names = [e["name"] for e in merged["traceEvents"]
+             if e.get("ph") == "i"]
+    assert "engine.flush" in names and "kv.send" in names
+    # each dump landed on its own process track
+    pids = {e["pid"] for e in merged["traceEvents"] if e.get("ph") == "i"}
+    assert len(pids) == 2
+
+
+def test_to_trace_carries_wall_anchor(tmp_path):
+    flight.record("t.a")
+    doc = flight.load(flight.dump(tmp_path / "a.json"))
+    tr = flight.to_trace(doc)
+    assert tr["otherData"]["wall_t0_us"] == doc["meta"]["wall_t0_us"]
+    (ev,) = [e for e in tr["traceEvents"] if e["name"] == "t.a"]
+    assert ev["ph"] == "i" and ev["ts"] >= 0

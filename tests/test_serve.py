@@ -243,6 +243,90 @@ def test_ttft_and_percentiles_use_injected_clock():
     assert st["ttft_p50_s"] == sched.percentile("ttft", 0.5)
 
 
+# -- per-request tracing (ISSUE 9) ---------------------------------------
+
+def test_request_trace_records_lifecycle_and_breakdown():
+    sched, _, _ = make_sched(sampler=lambda lg, rq: 1)
+    req = sched.submit(Request([1, 2, 3], max_new_tokens=3))
+    run_to_completion(sched)
+    tr = sched.trace(req.trace_id)
+    assert tr is not None and tr["rid"] == req.rid
+    assert tr["status"] == "completed"
+    assert tr["prompt_len"] == 3 and tr["tokens"] == req.tokens
+    names = [e["event"] for e in tr["events"]]
+    assert names[0] == "submit"
+    assert names.index("admit") < names.index("prefill")
+    assert names[-1] == "finish"
+    # the injected counter clock makes every slice exact and positive
+    bd = tr["breakdown"]
+    assert bd["queue_wait_s"] == req.admit_t - req.submit_t > 0
+    assert bd["prefill_s"] == req.first_token_t - req.admit_t > 0
+    assert bd["first_decode_s"] == req.first_decode_t - req.first_token_t
+    assert bd["ttft_s"] == req.ttft
+    # clock ticks are in the event stream too (monotone non-decreasing)
+    ts = [e["t"] for e in tr["events"]]
+    assert ts == sorted(ts)
+
+
+def test_trace_ids_are_unique_and_unknown_id_returns_none():
+    sched, _, _ = make_sched()
+    a = Request([1], max_new_tokens=1)
+    b = Request([2], max_new_tokens=1)
+    assert a.trace_id != b.trace_id
+    assert sched.trace("nope") is None
+
+
+def test_rejected_request_leaves_a_trace():
+    sched, _, _ = make_sched(queue_depth=0)
+    req = Request([1], max_new_tokens=1)
+    with pytest.raises(ServeQueueFull):
+        sched.submit(req)
+    tr = sched.trace(req.trace_id)
+    assert tr["status"] == "rejected"
+    assert tr["events"][-1]["reason"] == "queue_full"
+
+
+def test_trace_store_evicts_fifo_at_cap(monkeypatch):
+    monkeypatch.setenv("MXNET_SERVE_TRACE_CAP", "4")
+    sched, _, _ = make_sched(queue_depth=64, sampler=lambda lg, rq: 1)
+    reqs = [sched.submit(Request([1], max_new_tokens=1))
+            for _ in range(6)]
+    run_to_completion(sched)
+    kept = [r for r in reqs if sched.trace(r.trace_id) is not None]
+    assert len(kept) == 4
+    assert kept == reqs[2:]  # oldest two evicted
+
+
+def test_serve_flight_events_carry_trace_id():
+    from mxnet_tpu.telemetry import flight
+
+    flight.reset()
+    sched, _, _ = make_sched(sampler=lambda lg, rq: 1)
+    req = sched.submit(Request([1, 2], max_new_tokens=2))
+    run_to_completion(sched)
+    evs = flight.events(kind="serve")
+    mine = [e for e in evs if e.get("tid") == req.trace_id]
+    kinds = [e["kind"] for e in mine]
+    for k in ("serve.submit", "serve.admit", "serve.prefill",
+              "serve.first_decode", "serve.finish"):
+        assert k in kinds, kinds
+    # decode steps are recorded per BATCH, not per request
+    assert any(e["kind"] == "serve.decode" for e in evs)
+
+
+def test_queue_wait_and_first_decode_histograms_populate():
+    from mxnet_tpu import telemetry
+
+    sched, _, _ = make_sched(sampler=lambda lg, rq: 1)
+    sched.submit(Request([1, 2], max_new_tokens=2))
+    run_to_completion(sched)
+    snap = telemetry.snapshot()
+    for fam in ("mxnet_serve_queue_wait_seconds",
+                "mxnet_serve_first_decode_seconds"):
+        (series,) = snap[fam]["series"]
+        assert series["count"] >= 1, fam
+
+
 # -- arena ---------------------------------------------------------------
 
 def test_arena_never_hands_out_null_page():

@@ -189,12 +189,43 @@ def test_http_generate_metrics_healthz(bundle):
             doc = json.loads(resp.read())
         assert doc["tokens"] == greedy_reference(net, [3, 1, 4], 4)
         assert doc["ttft_s"] is None or doc["ttft_s"] >= 0
+        # ISSUE 9: responses carry the trace id + TTFT breakdown
+        assert doc["trace_id"]
+        bd = doc["breakdown"]
+        assert set(bd) == {"queue_wait_s", "prefill_s", "first_decode_s",
+                           "ttft_s"}
+        assert bd["queue_wait_s"] >= 0 and bd["prefill_s"] >= 0
         with urllib.request.urlopen(base + "/healthz") as resp:
             stats = json.loads(resp.read())
         assert stats["completed"] >= 1
+        # ISSUE 9: operational signals an external prober pages on
+        assert stats["ok"] is True
+        assert 0.0 <= stats["arena_utilization"] <= 1.0
+        assert stats["queue_depth"] >= 0
+        assert stats["live_device_bytes"] > 0
+        assert stats["device_bytes_by_origin"]["param"] > 0
+        assert stats["flight"]["enabled"] in (True, False)
+        assert stats["flight"]["capacity"] > 0
         with urllib.request.urlopen(base + "/metrics") as resp:
             text = resp.read().decode()
         assert "mxnet_serve_requests_total" in text
+        assert "mxnet_device_bytes" in text
+        assert "mxnet_serve_queue_wait_seconds" in text
+        # ISSUE 9: per-request trace endpoint replays the request's life
+        with urllib.request.urlopen(
+                base + "/v1/trace/" + doc["trace_id"]) as resp:
+            tr = json.loads(resp.read())
+        assert tr["trace_id"] == doc["trace_id"]
+        assert tr["status"] == "completed"
+        assert tr["tokens"] == doc["tokens"]
+        names = [e["event"] for e in tr["events"]]
+        assert names[0] == "submit" and "admit" in names
+        assert "prefill" in names and "finish" in names
+        assert tr["breakdown"]["ttft_s"] >= 0
+        # unknown trace id: 404, not 500
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/v1/trace/doesnotexist")
+        assert ei.value.code == 404
         # bad request: missing prompt
         bad = urllib.request.Request(base + "/v1/generate", data=b"{}")
         with pytest.raises(urllib.error.HTTPError) as ei:

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Doc-drift check: every registered ``mxnet_*`` metric family must have
+a row in docs/observability.md.
+
+Three PRs in a row hand-synced the metric catalog table; this makes the
+strict-lint CI job fail instead when someone registers a new family
+(``telemetry.counter/gauge/histogram("mxnet_...")``) without documenting
+it.
+
+Mechanics: an AST walk over ``mxnet_tpu/`` collects every string-literal
+family name passed to a counter/gauge/histogram call; the docs side
+collects every ``mxnet_*`` code span in docs/observability.md, expanding
+the table's ``_suffix`` shorthand (a cell like
+`` `mxnet_engine_segment_cache_hits_total` / `_misses_total` `` also
+documents ``mxnet_engine_segment_cache_misses_total`` — each shorthand
+combines with every underscore-prefix of the last full name on the
+line, so the check never needs to guess which split was meant).
+
+Exit status 1 lists the undocumented families.  Run directly or via the
+mxlint CI job; tests/test_docs.py keeps it honest in tier-1.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_REGISTRY_CALLS = {"counter", "gauge", "histogram"}
+_CODE_SPAN = re.compile(r"`([A-Za-z0-9_]+)`")
+
+
+def registered_families(root=None):
+    """Every string-literal ``mxnet_*`` family passed to a registry call
+    anywhere under ``root`` (default: the mxnet_tpu package)."""
+    root = root or os.path.join(_REPO_ROOT, "mxnet_tpu")
+    found = set()
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path) as f:
+                try:
+                    tree = ast.parse(f.read(), filename=path)
+                except SyntaxError:
+                    continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                func = node.func
+                name = func.attr if isinstance(func, ast.Attribute) \
+                    else (func.id if isinstance(func, ast.Name) else None)
+                if name not in _REGISTRY_CALLS:
+                    continue
+                arg0 = node.args[0]
+                if isinstance(arg0, ast.Constant) \
+                        and isinstance(arg0.value, str) \
+                        and arg0.value.startswith("mxnet_"):
+                    found.add(arg0.value)
+    return found
+
+
+def documented_families(md_path=None):
+    """Every ``mxnet_*`` code span in the doc, with ``_suffix`` shorthand
+    expanded against the last full name on the same line."""
+    md_path = md_path or os.path.join(_REPO_ROOT, "docs",
+                                      "observability.md")
+    with open(md_path) as f:
+        text = f.read()
+    out = set()
+    for line in text.splitlines():
+        base = None
+        for span in _CODE_SPAN.findall(line):
+            if span.startswith("mxnet_"):
+                out.add(span)
+                base = span
+            elif span.startswith("_") and base:
+                # `_misses_total` after `..._hits_total`: try every
+                # underscore split of the base — over-approximating is
+                # harmless, the check only tests membership
+                for i, ch in enumerate(base):
+                    if ch == "_":
+                        out.add(base[:i] + span)
+    return out
+
+
+def missing_families(root=None, md_path=None):
+    return sorted(registered_families(root) - documented_families(md_path))
+
+
+def main(argv=None):
+    missing = missing_families()
+    if missing:
+        print("ERROR: %d registered metric families have no row in "
+              "docs/observability.md:" % len(missing), file=sys.stderr)
+        for name in missing:
+            print("  - %s" % name, file=sys.stderr)
+        print("add a row to the metric catalog table (or fix the name).",
+              file=sys.stderr)
+        return 1
+    print("metric docs in sync: %d families documented"
+          % len(registered_families()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
