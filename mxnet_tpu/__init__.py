@@ -135,6 +135,7 @@ from .context import (  # noqa: F401
     Context, cpu, cpu_pinned, gpu, tpu, num_gpus, num_tpus, current_context,
 )
 from . import engine  # noqa: F401
+from . import sharding  # noqa: F401
 from . import layout  # noqa: F401
 from .layout import layout_scope, set_default_layout  # noqa: F401
 from . import random  # noqa: F401

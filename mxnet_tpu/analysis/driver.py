@@ -16,8 +16,8 @@ import ast
 import os
 import textwrap
 
-from . import (cache_keys, collective_check, host_sync, tracing_safety,
-               wait_loops)
+from . import (cache_keys, collective_check, host_sync, sharding_check,
+               tracing_safety, wait_loops)
 from .suppressions import SuppressionFile, inline_suppressed
 
 _SKIP_DIRS = frozenset({"__pycache__", ".git", "node_modules", "build",
@@ -96,6 +96,7 @@ def lint_source(source, path="<string>", registry_names=None, strict=False,
     collective_check.run(path, tree, findings)
     wait_loops.run(path, tree, findings)
     cache_keys.run(path, tree, findings, strict=strict)
+    sharding_check.run(path, tree, findings, strict=strict)
     supp = suppressions if isinstance(suppressions, SuppressionFile) \
         else (SuppressionFile() if suppressions is None
               else _load_suppressions(suppressions))
@@ -138,6 +139,7 @@ def lint_paths(paths, registry_names=None, strict=False, suppressions=None,
         collective_check.run(rel, tree, findings)
         wait_loops.run(rel, tree, findings)
         cache_keys.run(rel, tree, findings, strict=strict)
+        sharding_check.run(rel, tree, findings, strict=strict)
         all_findings.extend(_filter(findings, source.splitlines(), supp))
     all_findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return all_findings

@@ -20,6 +20,9 @@ Rule ID bands (stable, documented in ``docs/static_analysis.md``):
   attr values that make the executable cache key unhashable or
   identity-keyed fragment both the in-process jit cache and the
   persistent disk cache — see ``compile_cache.py``)
+* ``SH9xx`` — sharding hygiene (static AST over ``PartitionSpec``
+  literals and reshard call sites; the dynamic half of the same
+  contract is ``MXNET_SHARDING_VERIFY`` — see ``docs/sharding.md``)
 """
 from __future__ import annotations
 
@@ -131,6 +134,14 @@ RULES = {
               "attr passed explicitly as None enters the cache key and "
               "compiles a separate executable from call sites that omit "
               "it (advisory, enabled with --strict)"),
+    "SH901": ("unknown-mesh-axis", True,
+              "a PartitionSpec literal names an axis no statically-"
+              "visible mesh defines — surfaces only as an async XLA "
+              "error far from the typo"),
+    "SH902": ("reshard-in-loop", True,
+              "reshard()/nd.shard() inside a loop — cross-device data "
+              "movement every iteration; shard once outside, or use "
+              "with_sharding_constraint (an annotation) in traced code"),
 }
 
 # rule id -> severity; rules not listed are "error".  Ordering:
@@ -146,6 +157,7 @@ SEVERITY = {
     "CS802": "warn",
     "CS803": "warn",
     "CS804": "note",
+    "SH902": "warn",
 }
 
 _SEVERITY_RANK = {"note": 0, "warn": 1, "error": 2}

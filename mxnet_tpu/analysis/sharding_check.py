@@ -1,0 +1,166 @@
+"""SH9xx — sharding-consistency checks (mxlint pass 9).
+
+The GSPMD substrate (``mxnet_tpu/sharding/``) makes placement a
+first-class value: ``PartitionSpec`` axis names must exist in the mesh
+they bind to, and resharding moves real bytes over ICI/DCN.  Both
+mistakes are invisible at the call site — a bad axis name surfaces as
+an async XLA error far from the spec literal, and a reshard in a hot
+loop silently serializes device traffic the way a host sync in a loop
+serializes dispatch.  This pass catches the statically visible cases:
+
+* ``SH901`` — a ``PartitionSpec``/``P`` literal names an axis that no
+  mesh built in the same module defines.  Fires only in modules that
+  build at least one statically-known mesh (``Mesh({...})``,
+  ``make_mesh({...})``, ``global_mesh({...})``, or the raw
+  ``Mesh(devs, ("a", "b"))`` spelling) — variables and runtime-shaped
+  meshes are never guessed at, same conservatism as CC601.
+* ``SH902`` — ``.reshard(...)`` or ``nd.shard(...)`` inside a
+  ``for``/``while`` body: resharding is cross-device data movement;
+  in a loop it is the new host-sync-in-loop.  Hoist the placement out
+  of the loop, or annotate intermediates with
+  ``with_sharding_constraint`` (a compile-time annotation, free at
+  runtime) instead.
+
+Runtime counterpart: ``MXNET_SHARDING_VERIFY=1``
+(``sharding/verify.py``) pre-flights dynamically built spec/mesh pairs
+the AST cannot see.
+"""
+from __future__ import annotations
+
+import ast
+
+from .findings import Finding
+from .tracing_safety import _dotted
+
+_MESH_BUILDERS = frozenset({"make_mesh", "global_mesh", "Mesh"})
+_SPEC_NAMES = frozenset({"P", "PartitionSpec"})
+
+
+def _dict_axes(node):
+    """``{"data": 4, "model": -1}`` literal → {name: size|None}, else None."""
+    if not isinstance(node, ast.Dict):
+        return None
+    if not all(isinstance(k, ast.Constant) and isinstance(k.value, str)
+               for k in node.keys):
+        return None
+    axes = {}
+    for k, v in zip(node.keys, node.values):
+        axes[k.value] = (v.value if isinstance(v, ast.Constant)
+                         and isinstance(v.value, int) else None)
+    return axes
+
+
+def _name_tuple(node):
+    """``("data", "model")`` literal → axis names, else None."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    names = [e.value for e in node.elts
+             if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return names or None
+
+
+def _collect_mesh_axes(tree):
+    """Union of axis names over every statically-known mesh in the module.
+
+    Returns None when NO mesh is statically known — SH901 then stays
+    silent for the whole module (nothing to check literals against).
+    """
+    axes = None
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        short = _dotted(node.func).rsplit(".", 1)[-1]
+        if short not in _MESH_BUILDERS or not node.args:
+            continue
+        found = _dict_axes(node.args[0])
+        if found is None and short == "Mesh" and len(node.args) >= 2:
+            names = _name_tuple(node.args[1])
+            found = {n: None for n in names} if names else None
+        if found is not None:
+            axes = dict(axes or {})
+            axes.update(found)
+    return axes
+
+
+def _spec_axis_nodes(call):
+    """(axis_name, ast_node) for every literal axis entry of a
+    ``P(...)`` call, flattening tuple entries (``P(("dp", "tp"))``)."""
+    out = []
+    for a in list(call.args) + [kw.value for kw in call.keywords]:
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            out.append((a.value, a))
+        elif isinstance(a, (ast.Tuple, ast.List)):
+            for e in a.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    out.append((e.value, e))
+    return out
+
+
+class _ShardingChecker(ast.NodeVisitor):
+    def __init__(self, path, findings, mesh_axes):
+        self.path = path
+        self.findings = findings
+        self.mesh_axes = mesh_axes  # None: no statically-known mesh
+        self.loop_depth = 0
+
+    def _flag(self, node, rule, msg):
+        self.findings.append(Finding(
+            self.path, node.lineno, getattr(node, "col_offset", 0),
+            rule, msg))
+
+    # -- loops (SH902 scope) ----------------------------------------------
+    def _loop(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_For = visit_AsyncFor = visit_While = _loop
+
+    # comprehensions iterate too
+    def _comp(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_ListComp = visit_SetComp = visit_DictComp = _comp
+    visit_GeneratorExp = _comp
+
+    def visit_Call(self, node):
+        fn = node.func
+        short = _dotted(fn).rsplit(".", 1)[-1]
+        # SH901: spec literal vs statically-known mesh axes
+        if short in _SPEC_NAMES and self.mesh_axes is not None:
+            for name, n in _spec_axis_nodes(node):
+                if name not in self.mesh_axes:
+                    self._flag(
+                        n, "SH901",
+                        "PartitionSpec axis %r is not an axis of any mesh "
+                        "built in this module (axes: %s) — GSPMD raises "
+                        "asynchronously, far from this literal"
+                        % (name, sorted(self.mesh_axes)))
+        # SH902: resharding inside a loop body
+        if self.loop_depth > 0 and isinstance(fn, ast.Attribute):
+            if fn.attr == "reshard":
+                self._flag(
+                    node, "SH902",
+                    ".reshard() inside a loop: every iteration moves the "
+                    "full array across devices (ICI/DCN traffic, like a "
+                    "host sync in a loop) — hoist the placement out of "
+                    "the loop or use with_sharding_constraint")
+            elif fn.attr == "shard" and _dotted(fn.value).rsplit(
+                    ".", 1)[-1] in ("nd", "ndarray"):
+                self._flag(
+                    node, "SH902",
+                    "nd.shard() inside a loop: allocates and moves a "
+                    "fresh distributed copy per iteration — shard once "
+                    "before the loop")
+        self.generic_visit(node)
+
+
+def run(path, tree, findings=None, strict=False):
+    """Run the SH pass over one parsed module; returns the findings list."""
+    if findings is None:
+        findings = []
+    mesh_axes = _collect_mesh_axes(tree)
+    _ShardingChecker(path, findings, mesh_axes).visit(tree)
+    return findings

@@ -25,17 +25,32 @@ def _accelerator_platforms():
 
 
 class Context:
-    """A logical device. ``Context('tpu', 0)`` resolves to the first TPU chip."""
+    """A logical device. ``Context('tpu', 0)`` resolves to the first TPU chip.
+
+    With ``mesh=`` a context names a device *set*: ``mx.tpu(mesh=...)``
+    entered as a scope also sets the ambient mesh, so ``nd.shard`` /
+    ``JitTrainStep`` inside the scope pick it up implicitly (the GSPMD
+    substrate, ``mxnet_tpu/sharding/``).  Placement of plain arrays
+    still resolves to one device (``jax_device``); the mesh governs
+    sharded placement.
+    """
 
     _default_ctx = threading.local()
 
-    def __init__(self, device_type, device_id=0):
+    def __init__(self, device_type, device_id=0, mesh=None):
         if isinstance(device_type, Context):
+            if mesh is None:
+                mesh = device_type.mesh
             device_type, device_id = device_type.device_type, device_type.device_id
         if device_type not in _DEVTYPE2ID:
             raise ValueError("unknown device type %r" % (device_type,))
         self.device_type = device_type
         self.device_id = device_id
+        if mesh is not None:
+            from .sharding import Mesh as _Mesh
+
+            mesh = mesh if isinstance(mesh, _Mesh) else _Mesh(mesh)
+        self.mesh = mesh
         self._old_ctx = None
 
     @property
@@ -52,12 +67,16 @@ class Context:
             isinstance(other, Context)
             and self.device_type == other.device_type
             and self.device_id == other.device_id
+            and self.mesh == other.mesh
         )
 
     def __hash__(self):
-        return hash((self.device_type, self.device_id))
+        return hash((self.device_type, self.device_id, self.mesh))
 
     def __repr__(self):
+        if self.mesh is not None:
+            return "%s(%d, mesh=%s)" % (self.device_type, self.device_id,
+                                        dict(self.mesh.shape))
         return "%s(%d)" % (self.device_type, self.device_id)
 
     def __str__(self):
@@ -68,10 +87,18 @@ class Context:
             Context._default_ctx.value = Context("cpu", 0)
         self._old_ctx = Context._default_ctx.value
         Context._default_ctx.value = self
+        if self.mesh is not None:
+            from . import sharding as _sharding
+
+            _sharding.push_mesh(self.mesh)
         return self
 
     def __exit__(self, *args):
         Context._default_ctx.value = self._old_ctx
+        if self.mesh is not None:
+            from . import sharding as _sharding
+
+            _sharding.pop_mesh()
 
     def empty_cache(self):
         """Parity with mx.Context.empty_cache; PJRT pools its own memory."""
@@ -106,22 +133,25 @@ def _resolve_jax_device(device_type, device_id):
     return accels[device_id % len(accels)]
 
 
-def cpu(device_id=0):
-    return Context("cpu", device_id)
+def cpu(device_id=0, mesh=None):
+    return Context("cpu", device_id, mesh=mesh)
 
 
 def cpu_pinned(device_id=0):
     return Context("cpu_pinned", device_id)
 
 
-def gpu(device_id=0):
+def gpu(device_id=0, mesh=None):
     """Kept for API parity; resolves to an accelerator (TPU on TPU hosts)."""
-    return Context("gpu", device_id)
+    return Context("gpu", device_id, mesh=mesh)
 
 
-def tpu(device_id=0):
-    """First-class TPU context (north-star feature; no reference counterpart)."""
-    return Context("tpu", device_id)
+def tpu(device_id=0, mesh=None):
+    """First-class TPU context (north-star feature; no reference counterpart).
+
+    ``mx.tpu(mesh={"data": 8})`` names a device set: entering it as a
+    scope makes the mesh ambient for sharded placement."""
+    return Context("tpu", device_id, mesh=mesh)
 
 
 def num_gpus():

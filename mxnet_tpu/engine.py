@@ -347,9 +347,16 @@ class BulkSegment:
         donate = self._donation(eng)
         # taped segments compile ahead-of-time (see _build_segment_fn), so
         # their cache key must pin the concrete ext avals jit would have
-        # re-traced on; untaped segments let jit handle shape polymorphism
+        # re-traced on; untaped segments let jit handle shape polymorphism.
+        # Placement is ALWAYS part of the key: a jax sharding object
+        # (SingleDeviceSharding or NamedSharding) encodes platform, device
+        # and partition spec, so a segment traced against cpu:0 inputs can
+        # never serve sharded (or other-device) inputs — the exact path
+        # pins its lowering at build time and would silently compute on
+        # the wrong placement otherwise.
         exact = self.taped
-        key = (tuple(self.key_parts), donate, exact and tuple(
+        placements = tuple(getattr(a, "sharding", None) for a in self.ext)
+        key = (tuple(self.key_parts), donate, placements, exact and tuple(
             (tuple(a.shape), str(a.dtype)) for a in self.ext))
         ti = _tier_index(self.n_ops)
         tier = _SEG_TIERS[ti]

@@ -20,7 +20,7 @@ from ..ops import control_flow as _cf  # noqa: F401
 
 from .ndarray import (  # noqa: F401
     NDArray, array, empty, zeros, ones, full, arange, zeros_like, ones_like,
-    concatenate, moveaxis, save, load, waitall,
+    concatenate, moveaxis, save, load, waitall, shard,
     from_dlpack, to_dlpack_for_read, to_dlpack_for_write,
 )
 from . import random  # noqa: F401

@@ -180,6 +180,17 @@ class NDArray:
         return "default"
 
     @property
+    def sharding(self):
+        """The jax sharding of the backing buffer (SingleDeviceSharding
+        for plain arrays, NamedSharding after ``nd.shard``/``reshard``).
+
+        Reading a lazy (bulk-deferred) array's sharding is a sync point:
+        the open segment flushes so the concrete buffer can answer.
+        """
+        self._var.rethrow()
+        return self.data().sharding
+
+    @property
     def _in_graph(self):
         return self._marked or self._tape_node is not None
 
@@ -338,6 +349,46 @@ class NDArray:
 
     def as_in_ctx(self, context):
         return self.as_in_context(context)
+
+    def reshard(self, spec=None, mesh=None):
+        """In-place redistribute onto ``mesh`` per ``spec`` (async push).
+
+        The data movement is ``jax.device_put`` pushed through the
+        engine like any op — dispatch returns immediately and the swap
+        publishes a future-backed buffer.  ``mesh`` defaults to the
+        ambient mesh (``with Mesh(...):`` / ``mx.tpu(mesh=...)``).
+        Counted by ``mxnet_reshard_total{axis}`` — resharding in a hot
+        loop is an mxlint finding (SH902).
+        """
+        if autograd.is_recording() and self._in_graph:
+            # in-place placement swap on a taped array would invalidate
+            # the recorded primals; use nd.shard() for a taped copy
+            raise MXNetError("reshard on a taped array; use nd.shard()")
+        from .. import sharding as _sharding
+
+        sh = _sharding.named_sharding(mesh, spec)
+        _sharding.maybe_verify(sh.mesh, sh.spec, shape=self.shape,
+                               what="reshard")
+        data = self.data()
+        eng = Engine.get()
+        new = eng.push(lambda: jax.device_put(data, sh),
+                       read_vars=(self._var,), op_name="reshard")
+        eng.track(new)
+        _sharding.record_reshard(sh.spec, data.nbytes, origin="reshard")
+        self._set_data(new)
+        return self
+
+    def with_sharding_constraint(self, spec=None, mesh=None):
+        """Pin this array's partitioning through a recorded op — the
+        traceable form of :func:`shard` (usable under autograd,
+        ``hybridize`` and inside bulk segments; under jit it lowers to
+        the GSPMD annotation rather than a data movement)."""
+        from .. import sharding as _sharding
+
+        sh = _sharding.named_sharding(mesh, spec)
+        _sharding.maybe_verify(sh.mesh, sh.spec, shape=self.shape,
+                               what="with_sharding_constraint")
+        return _reg.invoke("_sharding_constraint", [self], {"sharding": sh})
 
     def as_nd_ndarray(self):
         return self
@@ -775,6 +826,38 @@ def _as_nd(x, ctx=None):
     if isinstance(x, NDArray):
         return x
     return NDArray(x, ctx=ctx)
+
+
+def shard(arr, spec=None, mesh=None):
+    """A copy of ``arr`` distributed onto ``mesh`` per ``spec``.
+
+    ``mesh`` defaults to the ambient mesh (``with Mesh(...):`` or
+    ``mx.tpu(mesh=...)``); ``spec=None`` replicates.  The movement is a
+    ``jax.device_put`` pushed through the engine — async like any op.
+    Under autograd recording the put is routed through a recorded op
+    (``device_put`` is differentiable: gradients reshard back), so a
+    sharded forward stays on the tape.
+    """
+    from .. import sharding as _sharding
+
+    arr = _as_nd(arr)
+    sh = _sharding.named_sharding(mesh, spec)
+    _sharding.maybe_verify(sh.mesh, sh.spec, shape=arr.shape, what="shard")
+    _sharding.record_reshard(sh.spec, arr.dtype.itemsize * arr.size,
+                             origin="shard")
+    if autograd.is_recording() and arr._in_graph:
+        from ..ops.registry import invoke_fn
+
+        (out,) = invoke_fn(lambda d: (jax.device_put(d, sh),), [arr],
+                           op_name="_shard")
+        return out
+    data = arr.data()
+    eng = Engine.get()
+    new = eng.push(lambda: jax.device_put(data, sh),
+                   read_vars=(arr._var,), op_name="shard")
+    eng.track(new)
+    out = NDArray(new, ctx=arr._ctx)
+    return out
 
 
 # ----------------------------------------------------------------------------

@@ -635,6 +635,23 @@ def _correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
 
 
 # ----------------------------------------------------------------------------
+# sharding constraint (GSPMD substrate, mxnet_tpu/sharding/): pins an
+# intermediate's partitioning inside a trace.  ``sharding`` is a
+# NamedSharding — hashable, so it rides the registry's static-attr cache
+# keys; under jit the constraint is the GSPMD annotation, eagerly it is
+# a device_put.  No reference counterpart (placement there is a device
+# list, not a compiler annotation).
+# ----------------------------------------------------------------------------
+
+
+@register("_sharding_constraint")
+def _sharding_constraint(data, sharding=None):
+    if sharding is None:
+        return data
+    return lax.with_sharding_constraint(data, sharding)
+
+
+# ----------------------------------------------------------------------------
 # legacy/version aliases: the reference keeps *_v1 registrations of ops it
 # later rewrote (batch_norm_v1.cc, convolution_v1.cc, pooling_v1.cc); here
 # they are pure aliases of the modern kernels

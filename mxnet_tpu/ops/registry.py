@@ -19,6 +19,17 @@ RNG ops declare ``needs_rng``: the dispatcher prepends a fresh threefry key
 from the global ``mxnet_tpu.random`` state (reference: ``kRandom`` resource,
 ``src/resource.cc``).  Mode-aware ops (dropout, BN) declare ``needs_mode`` and
 receive ``_mode='train'|'predict'`` as a static attr.
+
+Sharding propagation (mxnet_tpu/sharding/): every dispatch route ends in
+``jax.jit``, and jit specializes per input *sharding* as well as per
+shape/dtype — GSPMD then partitions the computation, so an op over
+``nd.shard``-ed inputs runs as ONE multi-device executable with sharded
+outputs; no registry-side bookkeeping is needed.  The two places where
+that implicit keying is not enough own it explicitly: taped bulk
+segments pin their lowering, so ``engine.BulkSegment.flush`` folds the
+ext-input placements into the segment-cache key, and in-trace
+re-annotation goes through the ``_sharding_constraint`` op (ops/misc.py)
+whose NamedSharding attr is hashable and thus part of ``_jitted``'s key.
 """
 from __future__ import annotations
 

@@ -13,9 +13,10 @@ This package is the TPU-native answer to three reference subsystems at once
   ``src/executor/graph_executor.cc:1043``) — generalized to tensor/pipeline
   sharding rules over named mesh axes.
 """
+from ..sharding import Mesh, PartitionSpec, P, as_jax_mesh  # noqa: F401
 from .mesh import (  # noqa: F401
     make_mesh, current_mesh, data_sharding, replicated, shard_params,
-    MeshScope,
+    MeshScope, shard_map,
 )
 from .train_step import JitTrainStep  # noqa: F401
 from .tp_rules import megatron_rule, pattern_rule  # noqa: F401

@@ -89,7 +89,9 @@ def moe_ffn_sharded(x, router_w, w_in, w_out, mesh, axis_name="expert",
     match :func:`moe_ffn` exactly (same routing, same capacity).
     """
     from ..analysis.collective_check import check_axis
+    from .. import sharding as _sharding
 
+    mesh = _sharding.as_jax_mesh(mesh)
     check_axis(mesh, axis_name, op="moe_ffn_sharded")
     ep = mesh.shape[axis_name]
     e = router_w.shape[1]

@@ -74,19 +74,22 @@ def host_local_to_global(array, mesh, spec):
     from jax.experimental import multihost_utils
 
     from ..ndarray.ndarray import NDArray
+    from .. import sharding as _sharding
 
     if isinstance(array, NDArray):
         array = array.data()
     return multihost_utils.host_local_array_to_global_array(
-        np.asarray(array), mesh, spec)
+        np.asarray(array), _sharding.as_jax_mesh(mesh), spec)
 
 
 def global_to_host_local(array, mesh, spec):
     """Inverse of :func:`host_local_to_global` (fetch this host's rows)."""
     from jax.experimental import multihost_utils
 
+    from .. import sharding as _sharding
+
     return multihost_utils.global_array_to_host_local_array(
-        array, mesh, spec)
+        array, _sharding.as_jax_mesh(mesh), spec)
 
 
 def sync_global_devices(tag="barrier"):

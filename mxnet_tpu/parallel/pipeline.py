@@ -38,7 +38,9 @@ def gpipe(stage_fn, stacked_params, x_microbatches, mesh, axis_name="pp"):
     Returns the last stage's outputs, (M, microbatch, ...), replicated.
     """
     from ..analysis.collective_check import check_axis, check_ppermute
+    from .. import sharding as _sharding
 
+    mesh = _sharding.as_jax_mesh(mesh)
     check_axis(mesh, axis_name, op="gpipe")
     n = mesh.shape[axis_name]
     m = x_microbatches.shape[0]

@@ -82,7 +82,9 @@ def ring_attention_sharded(q, k, v, mesh, axis_name="sp", scale=None,
 
     from .mesh import shard_map
     from ..analysis.collective_check import check_axis
+    from .. import sharding as _sharding
 
+    mesh = _sharding.as_jax_mesh(mesh)
     check_axis(mesh, axis_name, op="ring_attention_sharded")
     four_d = q.ndim == 4
     if four_d:

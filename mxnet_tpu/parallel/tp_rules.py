@@ -27,6 +27,8 @@ import fnmatch
 
 from jax.sharding import PartitionSpec as P
 
+from .. import sharding as _sharding
+
 __all__ = ["pattern_rule", "megatron_rule",
            "COLUMN_PATTERNS", "ROW_PATTERNS", "EMBED_PATTERNS"]
 
@@ -66,8 +68,15 @@ def pattern_rule(patterns, mesh=None, default=None):
     First matching glob wins.  When ``mesh`` is given, a spec whose named
     axes do not evenly divide the corresponding dim is replaced by
     ``default`` (replication) instead of failing inside GSPMD.
+
+    ``mesh`` may be a ``sharding.Mesh``, a raw jax mesh, or an axes
+    dict; ``None`` picks up the ambient mesh (``with Mesh(...):`` /
+    ``mx.tpu(mesh=...)``) when one is active.
     """
     pats = list(patterns)
+    if mesh is None:
+        mesh = _sharding.current_mesh()
+    mesh = _sharding.as_jax_mesh(mesh)
 
     def rule(name, shape):
         for pat, spec in pats:

@@ -39,7 +39,13 @@ class JitTrainStep:
     loss : gluon loss Block, or None (net's first output IS the loss)
     optimizer : str or Optimizer
     optimizer_params : dict, for the str form
-    mesh : jax.sharding.Mesh or None
+    mesh : a ``sharding.Mesh``, raw jax mesh, axes dict, or None.
+        None picks up the ambient mesh when one is active (``with
+        Mesh(...):`` / ``mx.tpu(mesh=...)``); otherwise single-device.
+        Every spelling normalizes to the same jax mesh, so a step built
+        from a mesh context compiles the identical executable (and
+        produces bitwise-identical losses) as one built from the raw
+        mesh — the substrate guarantee tests/test_sharding.py asserts.
     data_axis : mesh axis name carrying the batch dimension
     param_rule : fn(param_name, shape) -> PartitionSpec or None
         tensor-parallel sharding rule; None replicates parameters.
@@ -58,7 +64,11 @@ class JitTrainStep:
             optimizer = _opt_mod.create(optimizer,
                                         **(optimizer_params or {}))
         self._opt = optimizer
-        self._mesh = mesh
+        from .. import sharding as _sharding
+
+        if mesh is None:
+            mesh = _sharding.current_mesh()
+        self._mesh = _sharding.as_jax_mesh(mesh)
         self._data_axis = data_axis
         self._param_rule = param_rule
         self._params = None
@@ -134,12 +144,18 @@ class JitTrainStep:
             host.shape, sharding, lambda idx: host[idx])
 
     def _place_on_mesh(self, param_rule):
+        from .. import sharding as _sharding
+
         mesh = self._mesh
         def spec_for(p):
             s = param_rule(p.name, tuple(p.shape)) if param_rule else None
             return s if s is not None else P()
         self._param_shardings = [
             NamedSharding(mesh, spec_for(p)) for p in self._params]
+        if _sharding.verify_enabled():
+            for p, sh in zip(self._params, self._param_shardings):
+                _sharding.verify_spec(mesh, sh.spec, shape=tuple(p.shape),
+                                      what="param[%s]" % p.name)
         put = self._put_global if self._multiprocess else jax.device_put
         self._weights = [
             put(w, s)

@@ -34,7 +34,7 @@ from ..telemetry import metrics as _metrics
 class PagedKVArena:
     """Block-table allocator over two arena NDArrays (K and V)."""
 
-    def __init__(self, geometry):
+    def __init__(self, geometry, mesh=None, kv_spec=None):
         import jax
 
         from ..ndarray.ndarray import NDArray
@@ -44,8 +44,20 @@ class PagedKVArena:
         dtype = np.dtype(geometry.dtype)
         # device_put, NOT nd.zeros: a serving process must not push ops
         # (zero live compiles — the tentpole claim of the AOT warm start)
-        self.kv_k = NDArray(jax.device_put(np.zeros(shape, dtype)))
-        self.kv_v = NDArray(jax.device_put(np.zeros(shape, dtype)))
+        # With mesh=/kv_spec= the arena buffers live sharded on the mesh
+        # — KV heads (dim 3) on the tp axis is the canonical spec; the
+        # serving executables' kv arguments then inherit the placement.
+        placement = None
+        if mesh is not None or kv_spec is not None:
+            from .. import sharding as _sharding
+
+            placement = _sharding.named_sharding(mesh, kv_spec)
+            _sharding.maybe_verify(placement.mesh, placement.spec,
+                                   shape=shape, what="kv_arena")
+        self.kv_k = NDArray(jax.device_put(np.zeros(shape, dtype),
+                                           placement))
+        self.kv_v = NDArray(jax.device_put(np.zeros(shape, dtype),
+                                           placement))
         _memdump.tag(self.kv_k.data(), origin="kv_page", label="arena.k")
         _memdump.tag(self.kv_v.data(), origin="kv_page", label="arena.v")
         # page 0 is the null page — never allocated

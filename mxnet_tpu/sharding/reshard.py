@@ -1,0 +1,30 @@
+"""Resharding bookkeeping: telemetry counters + flight events.
+
+A reshard is a cross-device data movement (all-gather / all-to-all over
+ICI/DCN once meshes span chips) — expensive enough that every one is
+counted (``mxnet_reshard_total{axis}`` / ``mxnet_reshard_bytes_total``)
+and flight-recorded (kind ``reshard``), and resharding inside a loop is
+an mxlint finding (SH902).  The actual data movement lives on the
+NDArray entry points (``nd.shard`` / ``NDArray.reshard`` — an engine
+push of ``jax.device_put``); this module is the observability half so
+serve/train call sites share one code path.
+"""
+from __future__ import annotations
+
+from ..telemetry import flight as _flight
+from ..telemetry import metrics as _metrics
+from .spec import canonicalize_spec, spec_axes_label
+
+
+def record_reshard(spec, nbytes, origin="reshard"):
+    """Count one reshard of ``nbytes`` onto ``spec`` (label by mesh axes)."""
+    axis = spec_axes_label(canonicalize_spec(spec))
+    if _metrics.enabled():
+        _metrics.counter(
+            "mxnet_reshard_total",
+            help="array reshard operations by target mesh axes",
+            axis=axis).inc()
+        _metrics.counter(
+            "mxnet_reshard_bytes_total",
+            help="bytes moved by reshard operations").inc(int(nbytes))
+    _flight.record("reshard", axis=axis, bytes=int(nbytes), origin=origin)

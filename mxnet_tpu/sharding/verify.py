@@ -1,0 +1,82 @@
+"""Bind-time spec/mesh pre-flight (``MXNET_SHARDING_VERIFY``).
+
+Analogous to ``MXNET_GRAPH_VERIFY``: off by default because the checks
+walk the spec per shard/reshard call, on in CI and during bring-up.
+When a spec is wrong, XLA's error surfaces asynchronously from deep
+inside ``device_put`` dispatch; this pre-flight raises a synchronous
+``MXNetError`` naming the axis/dimension at the call site instead.
+
+The static half of the same contract is mxlint pass 9 (SH9xx,
+``analysis/sharding_check.py``): SH901 catches unknown axis names
+without running the program at all; this module catches what statics
+cannot — meshes and specs built dynamically.
+"""
+from __future__ import annotations
+
+import os
+
+from ..base import MXNetError
+from .spec import as_jax_mesh, canonicalize_spec
+
+ENV = "MXNET_SHARDING_VERIFY"
+
+
+def enabled():
+    return os.environ.get(ENV, "0").lower() in ("1", "true", "yes", "on")
+
+
+def _spec_entries(spec):
+    """Per-dimension lists of axis names (tuple entries flattened)."""
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(())
+        elif isinstance(entry, (tuple, list)):
+            out.append(tuple(entry))
+        else:
+            out.append((entry,))
+    return out
+
+
+def verify_spec(mesh, spec, shape=None, what="shard"):
+    """Raise MXNetError unless ``spec`` binds cleanly onto ``mesh``.
+
+    Checks: every named axis exists in the mesh; the spec is not longer
+    than the array rank; every partitioned dimension divides evenly by
+    the product of its axis sizes (jax rejects ragged ``device_put``
+    shards with a generic ValueError from deep inside dispatch; this
+    names the dim and the call site instead).
+    """
+    jm = as_jax_mesh(mesh)
+    spec = canonicalize_spec(spec)
+    entries = _spec_entries(spec)
+    names = tuple(jm.axis_names)
+    sizes = dict(jm.shape)
+    for dim, axes in enumerate(entries):
+        for a in axes:
+            if a not in names:
+                raise MXNetError(
+                    "%s: %s: PartitionSpec axis %r (dim %d) is not an axis "
+                    "of the mesh %s" % (ENV, what, a, dim, dict(sizes)))
+    if shape is not None:
+        if len(entries) > len(shape):
+            raise MXNetError(
+                "%s: %s: spec %s has %d entries but the array has rank %d"
+                % (ENV, what, tuple(spec), len(entries), len(shape)))
+        for dim, axes in enumerate(entries):
+            if not axes:
+                continue
+            part = 1
+            for a in axes:
+                part *= sizes[a]
+            if shape[dim] % part:
+                raise MXNetError(
+                    "%s: %s: dim %d of shape %s is not divisible by the "
+                    "%d-way partition %s" % (ENV, what, dim, tuple(shape),
+                                             part, axes))
+
+
+def maybe_verify(mesh, spec, shape=None, what="shard"):
+    """The gated form call sites use: a no-op unless the env flag is on."""
+    if enabled():
+        verify_spec(mesh, spec, shape=shape, what=what)

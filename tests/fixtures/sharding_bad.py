@@ -1,0 +1,37 @@
+# flake8: noqa
+"""Known-bad sharding shapes for the SH9xx pass (tests/test_sharding_lint.py).
+
+Same contract as ``mxlint_bad.py``: every deliberately-bad line carries a
+trailing ``# expect: RULE`` marker and the test asserts the linter
+produces EXACTLY those findings — one per marker, none elsewhere.
+Never imported by the framework.
+"""
+
+from mxnet_tpu.sharding import Mesh, P
+
+mesh = Mesh({"data": 4, "model": 2})
+
+
+def bad_axis_literals():
+    a = P("data", "modle")          # expect: SH901  (typo'd axis name)
+    b = P(("data", "expert"))       # expect: SH901  (tuple entry unknown)
+    c = P(None, "model")            # clean: axis exists
+    return a, b, c
+
+
+def reshard_in_loops(arrs, nd, spec):
+    for a in arrs:
+        a.reshard(spec)             # expect: SH902
+    i = 0
+    while i < 3:
+        x = nd.shard(arrs[0], spec)  # expect: SH902
+        i += 1
+    arrs[0].reshard(spec)           # clean: not in a loop
+    y = [a.with_sharding_constraint(spec) for a in arrs]  # clean: annotation
+    return x, y
+
+
+def suppressed_reshard(arrs, spec):
+    for a in arrs:
+        a.reshard(spec)  # mxlint: disable=SH902  (documented elastic resize)
+    return arrs

@@ -1,0 +1,114 @@
+"""SH9xx sharding pass (mxnet_tpu/analysis/sharding_check.py): fixture
+corpus + targeted shapes (docs/static_analysis.md pass 9).
+
+SH901 exists because a typo'd PartitionSpec axis surfaces as an async
+XLA error far from the literal; SH902 because a reshard in a hot loop
+is cross-device data movement every iteration — the sharded analogue of
+the host-sync-in-loop rules (HS2xx).
+"""
+import os
+import re
+
+from mxnet_tpu.analysis import lint_paths, lint_source
+from mxnet_tpu.analysis.suppressions import SuppressionFile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "sharding_bad.py")
+
+_FIXTURE_OPS = {"shard", "reshard", "with_sharding_constraint"}
+
+
+def _expected_markers():
+    out = []
+    with open(FIXTURE) as f:
+        for lineno, line in enumerate(f, 1):
+            m = re.search(r"#\s*expect:\s*([A-Z]+\d+)", line)
+            if m:
+                out.append((lineno, m.group(1)))
+    return sorted(out)
+
+
+def test_fixture_findings_match_markers_exactly():
+    expected = _expected_markers()
+    assert len(expected) >= 4, "fixture corpus lost its markers"
+    findings = lint_paths([FIXTURE], registry_names=_FIXTURE_OPS,
+                          relative_to=REPO,
+                          suppressions=SuppressionFile())
+    got = sorted((f.line, f.rule) for f in findings)
+    assert got == expected, "\n".join(str(f) for f in findings)
+
+
+def test_fixture_covers_both_rules():
+    rules = {r for _, r in _expected_markers()}
+    assert rules == {"SH901", "SH902"}
+
+
+def test_sh901_unknown_axis_against_dict_mesh():
+    src = ("from mxnet_tpu.sharding import Mesh, P\n"
+           "m = Mesh({'data': 8})\n"
+           "s = P('model')\n")
+    assert [f.rule for f in lint_source(src)] == ["SH901"]
+
+
+def test_sh901_raw_jax_mesh_spelling():
+    src = ("from jax.sharding import Mesh, PartitionSpec\n"
+           "m = Mesh(devs, ('dp', 'tp'))\n"
+           "good = PartitionSpec('dp', 'tp')\n"
+           "bad = PartitionSpec('pp')\n")
+    assert [(f.line, f.rule) for f in lint_source(src)] == [(4, "SH901")]
+
+
+def test_sh901_silent_without_static_mesh():
+    # no mesh the AST can see → nothing to check literals against
+    src = "from jax.sharding import PartitionSpec as P\ns = P('anything')\n"
+    assert lint_source(src) == []
+
+
+def test_sh901_make_mesh_form_and_tuple_axes():
+    src = ("from mxnet_tpu.parallel import make_mesh\n"
+           "from jax.sharding import PartitionSpec as P\n"
+           "m = make_mesh({'data': 4, 'model': -1})\n"
+           "ok = P(('data', 'model'))\n"
+           "bad = P(('data', 'expert'))\n")
+    assert [(f.line, f.rule) for f in lint_source(src)] == [(5, "SH901")]
+
+
+def test_sh902_reshard_in_for_and_while():
+    src = ("def f(arrs, spec):\n"
+           "    for a in arrs:\n"
+           "        a.reshard(spec)\n"
+           "    while True:\n"
+           "        arrs[0].reshard(spec)\n")
+    assert [f.rule for f in lint_source(src)] == ["SH902", "SH902"]
+
+
+def test_sh902_nd_shard_in_comprehension():
+    src = ("def f(nd, arrs, spec):\n"
+           "    return [nd.shard(a, spec) for a in arrs]\n")
+    assert [f.rule for f in lint_source(src)] == ["SH902"]
+
+
+def test_sh902_quiet_outside_loops_and_for_constraints():
+    src = ("def f(nd, arrs, spec):\n"
+           "    a = arrs[0].reshard(spec)\n"
+           "    for x in arrs:\n"
+           "        x = x.with_sharding_constraint(spec)\n"
+           "    return a\n")
+    assert lint_source(src) == []
+
+
+def test_sh902_inline_suppression():
+    src = ("def f(arrs, spec):\n"
+           "    for a in arrs:\n"
+           "        a.reshard(spec)  # mxlint: disable=SH902\n")
+    assert lint_source(src) == []
+
+
+def test_repo_tree_is_sh_clean():
+    """The framework's own code must never reshard in a loop or name a
+    phantom axis (same permanent-target contract as the other passes)."""
+    findings = [f for f in lint_paths(
+        [os.path.join(REPO, "mxnet_tpu"), os.path.join(REPO, "examples")],
+        relative_to=REPO)
+        if f.rule.startswith("SH")]
+    assert findings == [], "\n".join(str(f) for f in findings)

@@ -1,9 +1,10 @@
 #!/usr/bin/env python
 """mxlint — framework-aware static analysis for mxnet_tpu code.
 
-Runs the tracing-safety (TS1xx), host-sync (HS2xx) and collective-
-consistency (CC6xx) passes over the given files/directories, plus the
-op-registry consistency pass (RC3xx) when the framework imports.
+Runs the tracing-safety (TS1xx), host-sync (HS2xx), collective-
+consistency (CC6xx), cache-key (CS8xx) and sharding (SH9xx) passes over
+the given files/directories, plus the op-registry consistency pass
+(RC3xx) when the framework imports.
 Explicitly-passed ``.json`` files are verified as serialized Symbol
 graphs with the per-node GS5xx pass.  The repo's own tree is a permanent
 lint target::
