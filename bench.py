@@ -826,6 +826,43 @@ def _run_serve(platform):
             "live_compiles": doc["live_compiles"]}
 
 
+def _run_planner(platform):
+    """`python bench.py planner`: wall-clock seconds for one auto-sharding
+    plan of the llama_small parameter tree on an abstract 4x2 mesh
+    (docs/sharding.md "auto rules").  Pure host-side static analysis —
+    no devices, no compiles — so the number is the `rules="auto"` tax a
+    training run pays at first step.  LOWER is better; one warm-up plan
+    absorbs import/bytecode costs, then the median of 10 runs is
+    reported."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, planner
+    from mxnet_tpu.gluon.model_zoo import llama
+
+    mx.random.seed(0)
+    net = llama.llama_small()
+    net.initialize(mx.init.Xavier())
+    net(nd.array([[1, 2, 3, 4]], dtype="int32"))  # resolve deferred shapes
+    params = [(p.name, tuple(p.shape), str(p.dtype or "float32"))
+              for p in net.collect_params().values()]
+    axes = {"data": 4, "model": 2}
+
+    def one_plan():
+        t0 = time.perf_counter()
+        pl = planner.plan(params, axes, step_tokens=128, optimizer_slots=1)
+        dt = time.perf_counter() - t0
+        assert pl.feasible, pl.explain()
+        return dt
+
+    one_plan()  # warm-up
+    times = sorted(one_plan() for _ in range(10))
+    secs = times[len(times) // 2]
+    _log("planner: llama_small on 4x2 planned in %.4fs" % secs)
+    # the headline value rounds to 2 decimals (a sub-centisecond plan
+    # would read 0.00 — the failure sentinel); planner_ms keeps precision
+    return {"value": secs, "planner_ms": round(secs * 1e3, 3),
+            "n_params": len(params)}
+
+
 def _run_cold_resnet50(platform):
     return _run_cold_start("resnet50")
 
@@ -872,6 +909,9 @@ _SPECS = {
     # serving throughput: value is continuous-batching tok/s; the static
     # baseline, speedup and TTFT percentiles ride along as extra fields
     "serve": (_run_serve, "llama_serve_tok_s", "tokens/sec", None),
+    # auto-sharding planner latency: pure host-side static analysis,
+    # LOWER is better (it is the rules="auto" first-step tax)
+    "planner": (_run_planner, "planner_seconds", "seconds", None),
 }
 
 
@@ -959,7 +999,8 @@ def main():
     for name in ("infer", "bert", "llama", "dispatch_eager",
                  "dispatch_eager_notelemetry", "dispatch_bulked",
                  "dispatch_bulked_train", "dispatch_bulked_long",
-                 "serve", "cold_resnet50", "cold_bert", "cold_llama"):
+                 "serve", "planner", "cold_resnet50", "cold_bert",
+                 "cold_llama"):
         elapsed = time.perf_counter() - t_start
         if elapsed > budget:
             _log("budget %.0fs spent (%.0fs elapsed); skipping %s"

@@ -10,7 +10,7 @@ and framework code keeps two contracts:
 2. every device→host sync on the eager path is *intentional*, because each
    one stalls the PJRT stream the engine relies on for overlap.
 
-This package enforces both, statically and at runtime, with eight passes:
+This package enforces both, statically and at runtime, with ten passes:
 
 * **tracing-safety lint** (``TS1xx``, ``tracing_safety``) — AST pass over
   ``hybrid_forward`` bodies and jit-wrapped functions: data-dependent
@@ -48,17 +48,29 @@ This package enforces both, statically and at runtime, with eight passes:
   time and never hits the persistent disk cache
   (``compile_cache.py``); explicit ``attr=None`` needlessly splits
   entries (advisory).
+* **sharding hygiene** (``SH9xx``, ``sharding_check``) — PartitionSpec
+  literals naming axes no statically-known mesh defines; reshard /
+  ``nd.shard`` / eager ``with_sharding_constraint`` inside loop bodies
+  (cross-device data movement per iteration).
+* **planner/cost diagnostics** (``SP10xx``, ``planner_check``) — the
+  sharding planner's byte maths (``spmd_cost``) run statically:
+  placements predicted to exceed a declared per-device capacity,
+  dominant parameters fully replicated onto a multi-device mesh,
+  conflicting spec constraints inside one hot loop.
 
 CLI: ``python tools/mxlint.py mxnet_tpu/ examples/`` (the repo's own source
 is a permanent lint target; intentional syncs carry
 ``# mxlint: allow-host-sync`` or an entry in
-``tools/mxlint_suppressions.txt``).  Docs: ``docs/static_analysis.md``.
+``tools/mxlint_suppressions.txt``; ``--pass SP10`` runs one pass family
+in isolation).  Docs: ``docs/static_analysis.md``.
 """
 from __future__ import annotations
 
 from .findings import Finding, RULES, SEVERITY, rule_doc, severity_at_least
 from .driver import (lint_paths, lint_source, lint_block, check_registry,
-                     verify_symbol_file)
+                     verify_symbol_file, normalize_only, rule_selected)
+from .spmd_cost import (Calibration, CostReport, analyze_params,
+                        analyze_symbol, per_device_bytes)
 from .graph_verify import verify_symbol, input_consumers, blame_unresolved
 from .collective_check import check_axis, check_ppermute
 from .host_sync import SyncCounter
@@ -67,7 +79,9 @@ from .engine_audit import EngineAudit, EngineAuditError, install, uninstall
 __all__ = [
     "Finding", "RULES", "SEVERITY", "rule_doc", "severity_at_least",
     "lint_paths", "lint_source", "lint_block", "check_registry",
-    "verify_symbol_file",
+    "verify_symbol_file", "normalize_only", "rule_selected",
+    "Calibration", "CostReport", "analyze_params", "analyze_symbol",
+    "per_device_bytes",
     "verify_symbol", "input_consumers", "blame_unresolved",
     "check_axis", "check_ppermute",
     "SyncCounter",

@@ -16,12 +16,74 @@ import ast
 import os
 import textwrap
 
-from . import (cache_keys, collective_check, host_sync, sharding_check,
-               tracing_safety, wait_loops)
+from . import (cache_keys, collective_check, host_sync, planner_check,
+               sharding_check, tracing_safety, wait_loops)
 from .suppressions import SuppressionFile, inline_suppressed
 
 _SKIP_DIRS = frozenset({"__pycache__", ".git", "node_modules", "build",
                         "dist", ".ipynb_checkpoints"})
+
+# rule-band prefix -> pass family, for --pass/--only selection.  RC/EA/GS
+# bands don't run through lint_source but are still valid selectors (the
+# CLI gates the registry check / symbol files on them).
+PASS_BANDS = ("TS", "HS", "RC", "EA", "GS", "CC", "RB", "CS", "SH", "SP")
+
+
+def normalize_only(only):
+    """Normalize a ``--pass``/``--only`` selection to a tuple of rule-id
+    prefixes (``None`` = every pass).  Accepts an iterable or a comma-
+    separated string; tokens may be bands (``SP``), families (``SP10``)
+    or full rule ids (``SH902``).  Raises ``ValueError`` on a token that
+    matches no known rule."""
+    if only is None:
+        return None
+    if isinstance(only, str):
+        only = only.split(",")
+    from .findings import RULES
+
+    out = []
+    for tok in only:
+        tok = str(tok).strip().upper()
+        if not tok:
+            continue
+        if not any(r.startswith(tok) for r in RULES):
+            raise ValueError(
+                "unknown pass/rule selector %r (bands: %s)"
+                % (tok, ", ".join(PASS_BANDS)))
+        out.append(tok)
+    return tuple(out) or None
+
+
+def rule_selected(rule, only):
+    """True when ``rule`` survives a normalized ``only`` selection."""
+    return only is None or any(rule.startswith(t) for t in only)
+
+
+def _band_selected(band, only):
+    """True when a pass producing ``band``-rules could emit a selected
+    finding (prefix overlap in either direction: ``SP`` selects
+    ``SP1001``-producing passes, and so does ``SP1001``)."""
+    return only is None or any(t.startswith(band) or band.startswith(t)
+                               for t in only)
+
+
+def _run_static_passes(path, tree, registry_names, findings, strict, only):
+    if _band_selected("TS", only):
+        tracing_safety.run(path, tree, registry_names, findings)
+    if _band_selected("HS", only):
+        host_sync.run(path, tree, findings, strict=strict)
+    if _band_selected("CC", only):
+        collective_check.run(path, tree, findings)
+    if _band_selected("RB", only):
+        wait_loops.run(path, tree, findings)
+    if _band_selected("CS", only):
+        cache_keys.run(path, tree, findings, strict=strict)
+    if _band_selected("SH", only):
+        sharding_check.run(path, tree, findings, strict=strict)
+    if _band_selected("SP", only):
+        planner_check.run(path, tree, findings, strict=strict)
+    if only is not None:
+        findings[:] = [f for f in findings if rule_selected(f.rule, only)]
 
 
 def default_suppression_file():
@@ -87,16 +149,15 @@ def _filter(findings, source_lines, supp):
 
 
 def lint_source(source, path="<string>", registry_names=None, strict=False,
-                suppressions=None):
-    """Lint one source string; returns findings (suppression-filtered)."""
+                suppressions=None, only=None):
+    """Lint one source string; returns findings (suppression-filtered).
+
+    ``only``: a pass/rule selection (see :func:`normalize_only`) that
+    runs one pass family in isolation."""
+    only = normalize_only(only)
     tree = ast.parse(source, filename=path)
     findings = []
-    tracing_safety.run(path, tree, registry_names, findings)
-    host_sync.run(path, tree, findings, strict=strict)
-    collective_check.run(path, tree, findings)
-    wait_loops.run(path, tree, findings)
-    cache_keys.run(path, tree, findings, strict=strict)
-    sharding_check.run(path, tree, findings, strict=strict)
+    _run_static_passes(path, tree, registry_names, findings, strict, only)
     supp = suppressions if isinstance(suppressions, SuppressionFile) \
         else (SuppressionFile() if suppressions is None
               else _load_suppressions(suppressions))
@@ -104,7 +165,7 @@ def lint_source(source, path="<string>", registry_names=None, strict=False,
 
 
 def lint_paths(paths, registry_names=None, strict=False, suppressions=None,
-               relative_to=None):
+               relative_to=None, only=None):
     """Lint files/directories.  Returns sorted findings.
 
     ``registry_names``: pass a set to enable TS105 with it, ``None`` to
@@ -112,8 +173,10 @@ def lint_paths(paths, registry_names=None, strict=False, suppressions=None,
     fails).  ``suppressions``: a path, a ``SuppressionFile``, or ``None``
     for the repo default.  ``relative_to``: base dir findings' paths are
     reported (and glob-matched) against; defaults to the repo root when
-    linting inside it, else cwd.
+    linting inside it, else cwd.  ``only``: pass/rule selection
+    (:func:`normalize_only`) running one family in isolation.
     """
+    only = normalize_only(only)
     if registry_names is None:
         registry_names = registry_op_names()
     supp = _load_suppressions(suppressions)
@@ -134,12 +197,8 @@ def lint_paths(paths, registry_names=None, strict=False, suppressions=None,
             continue
         rel = _rel(fpath, relative_to)
         findings = []
-        tracing_safety.run(rel, tree, registry_names, findings)
-        host_sync.run(rel, tree, findings, strict=strict)
-        collective_check.run(rel, tree, findings)
-        wait_loops.run(rel, tree, findings)
-        cache_keys.run(rel, tree, findings, strict=strict)
-        sharding_check.run(rel, tree, findings, strict=strict)
+        _run_static_passes(rel, tree, registry_names, findings, strict,
+                           only)
         all_findings.extend(_filter(findings, source.splitlines(), supp))
     all_findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return all_findings

@@ -23,6 +23,10 @@ Rule ID bands (stable, documented in ``docs/static_analysis.md``):
 * ``SH9xx`` — sharding hygiene (static AST over ``PartitionSpec``
   literals and reshard call sites; the dynamic half of the same
   contract is ``MXNET_SHARDING_VERIFY`` — see ``docs/sharding.md``)
+* ``SP10xx`` — planner/cost diagnostics (static byte maths from
+  ``analysis/spmd_cost.py`` — the same model the sharding planner
+  scores candidates with — over AST-visible meshes, capacities and
+  placements; see ``docs/static_analysis.md`` Pass 10)
 """
 from __future__ import annotations
 
@@ -139,9 +143,27 @@ RULES = {
               "visible mesh defines — surfaces only as an async XLA "
               "error far from the typo"),
     "SH902": ("reshard-in-loop", True,
-              "reshard()/nd.shard() inside a loop — cross-device data "
-              "movement every iteration; shard once outside, or use "
-              "with_sharding_constraint (an annotation) in traced code"),
+              "reshard()/nd.shard()/eager with_sharding_constraint "
+              "inside a loop — cross-device data movement every "
+              "iteration; hoist the placement out of the loop (in "
+              "traced code a single with_sharding_constraint is a free "
+              "annotation and stays clean)"),
+    "SP1001": ("predicted-oom", True,
+               "a statically-visible placement needs more per-device "
+               "bytes than the module's declared capacity "
+               "(*CAPACITY* constant / MXNET_PLANNER_CAPACITY_BYTES / "
+               "capacity_bytes=) — a predicted OOM before anything "
+               "runs"),
+    "SP1002": ("replicated-dominant-param", True,
+               "a dominant parameter (>= a decile of the module's "
+               "statically-visible placement bytes, >= 1 MiB) is fully "
+               "replicated onto a multi-device mesh — shard a dim "
+               "(megatron_rule/pattern_rule) or use rules='auto'"),
+    "SP1003": ("conflicting-specs-in-loop", True,
+               "the same array is pinned to two different "
+               "with_sharding_constraint spec literals inside one loop "
+               "body — GSPMD inserts a reshard between the layouts "
+               "every iteration of the hot loop"),
 }
 
 # rule id -> severity; rules not listed are "error".  Ordering:
@@ -158,6 +180,8 @@ SEVERITY = {
     "CS803": "warn",
     "CS804": "note",
     "SH902": "warn",
+    "SP1002": "warn",
+    "SP1003": "warn",
 }
 
 _SEVERITY_RANK = {"note": 0, "warn": 1, "error": 2}

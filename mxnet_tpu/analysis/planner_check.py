@@ -1,0 +1,334 @@
+"""SP10xx — static planner/cost diagnostics (mxlint pass 10).
+
+The same byte maths the sharding planner scores candidates with
+(``spmd_cost``), run over what the AST makes statically visible: mesh
+literals (shared with SH9xx's ``_collect_mesh_axes``), declared
+capacity constants, and ``nd.shard(<ctor with literal shape>, P(...))``
+placements.  Three rules:
+
+* ``SP1001`` — a placement's predicted per-device bytes exceed the
+  module's declared capacity (a ``*CAPACITY*`` integer constant, an
+  ``os.environ["MXNET_PLANNER_CAPACITY_BYTES"]`` literal, or a
+  ``capacity_bytes=`` literal kwarg): a predicted OOM, caught before
+  anything runs.  Needs a statically-known mesh in the module.
+* ``SP1002`` — a *dominant* placement (≥ a decile — 10% — of the
+  module's statically-visible placement bytes, and ≥ 1 MiB) is fully
+  replicated onto a multi-device mesh: every device pays the full
+  array.  Shard it (``megatron_rule``/``pattern_rule``) or let
+  ``rules="auto"`` choose.
+* ``SP1003`` — the same array is pinned to two DIFFERENT
+  ``with_sharding_constraint`` spec literals inside one loop body:
+  GSPMD must insert a reshard between them every iteration of the hot
+  loop.  Fires in traced and eager code alike — conflicting specs are
+  data movement even where a single constraint would be a free
+  annotation.
+
+Like SH901, everything here is conservative: non-literal shapes,
+specs, meshes or capacities are never guessed at.
+"""
+from __future__ import annotations
+
+import ast
+
+from .findings import Finding
+from .sharding_check import _collect_mesh_axes
+from .spmd_cost import itemsize, partition_factor
+from .tracing_safety import _dotted
+
+_CTOR_NAMES = frozenset({"zeros", "ones", "empty", "full"})
+_CAPACITY_ENV = "MXNET_PLANNER_CAPACITY_BYTES"
+_DOMINANT_SHARE = 10        # dominant = >= total/_DOMINANT_SHARE bytes
+_FLOOR_BYTES = 1 << 20      # never flag replication under 1 MiB
+
+
+def _const_int(node):
+    """Fold an integer-literal expression (``64 * 2**20``), else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _const_int(node.operand)
+        return None if v is None else -v
+    if isinstance(node, ast.BinOp):
+        left, right = _const_int(node.left), _const_int(node.right)
+        if left is None or right is None:
+            return None
+        try:
+            if isinstance(node.op, ast.Add):
+                return left + right
+            if isinstance(node.op, ast.Sub):
+                return left - right
+            if isinstance(node.op, ast.Mult):
+                return left * right
+            if isinstance(node.op, ast.FloorDiv):
+                return left // right
+            if isinstance(node.op, ast.Pow) and 0 <= right <= 64:
+                return left ** right
+        except (ZeroDivisionError, OverflowError):
+            return None
+    return None
+
+
+def _shape_of(node):
+    """``(4096, 1024)`` / ``[...]`` literal → shape tuple, else None."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        v = _const_int(node)
+        return (v,) if v is not None and v >= 0 else None
+    dims = []
+    for e in node.elts:
+        v = _const_int(e)
+        if v is None or v < 0:
+            return None
+        dims.append(v)
+    return tuple(dims)
+
+
+def _dtype_of(call):
+    for kw in call.keywords:
+        if kw.arg == "dtype":
+            if isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                return kw.value.value
+            name = _dotted(kw.value).rsplit(".", 1)[-1]
+            return name or None
+    return "float32"
+
+
+def _ctor_shape(node):
+    """``nd/np/jnp.zeros((a, b))``-style call → (shape, dtype), else None."""
+    if not isinstance(node, ast.Call) or not node.args:
+        return None
+    short = _dotted(node.func).rsplit(".", 1)[-1]
+    if short not in _CTOR_NAMES:
+        return None
+    shape = _shape_of(node.args[0])
+    if shape is None:
+        return None
+    return shape, _dtype_of(node)
+
+
+def _spec_entries(node):
+    """``P("data", None)`` / ``PartitionSpec(...)`` literal → entries
+    tuple, else None (non-literal entries make the spec unknowable)."""
+    if not isinstance(node, ast.Call):
+        return None
+    if _dotted(node.func).rsplit(".", 1)[-1] not in ("P", "PartitionSpec"):
+        return None
+    entries = []
+    for a in node.args:
+        if isinstance(a, ast.Constant) and (a.value is None
+                                            or isinstance(a.value, str)):
+            entries.append(a.value)
+        elif isinstance(a, (ast.Tuple, ast.List)):
+            names = []
+            for e in a.elts:
+                if not (isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)):
+                    return None
+            names = tuple(e.value for e in a.elts)
+            entries.append(names)
+        else:
+            return None
+    return tuple(entries)
+
+
+def _collect_capacity(tree):
+    """The module's declared per-device budget: the MINIMUM over every
+    statically-evaluable declaration (conservative)."""
+    caps = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            v = _const_int(node.value)
+            if v is not None and v > 0:
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and "CAPACITY" in t.id.upper():
+                        caps.append(v)
+                    elif (isinstance(t, ast.Subscript)
+                          and _env_key(t) == _CAPACITY_ENV):
+                        caps.append(v)
+            # os.environ["MXNET_PLANNER_CAPACITY_BYTES"] = "1024"
+            if isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str) \
+                    and node.value.value.isdigit():
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) \
+                            and _env_key(t) == _CAPACITY_ENV:
+                        caps.append(int(node.value.value))
+        elif isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg == "capacity_bytes":
+                    v = _const_int(kw.value)
+                    if v is not None and v > 0:
+                        caps.append(v)
+    return min(caps) if caps else None
+
+
+def _env_key(sub):
+    """``os.environ["K"]`` subscript → "K", else None."""
+    if _dotted(sub.value).rsplit(".", 1)[-1] != "environ":
+        return None
+    sl = sub.slice
+    if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+        return sl.value
+    return None
+
+
+def _placements(tree):
+    """Statically-visible placements: ``nd.shard(<literal ctor>,
+    P(<literal>))`` calls → [(call_node, shape, dtype, entries)]."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _dotted(node.func).rsplit(".", 1)[-1] != "shard":
+            continue
+        if not node.args:
+            continue
+        ctor = _ctor_shape(node.args[0])
+        if ctor is None:
+            continue
+        spec = None
+        if len(node.args) >= 2:
+            spec = _spec_entries(node.args[1])
+        if spec is None:
+            for kw in node.keywords:
+                if kw.arg == "spec":
+                    spec = _spec_entries(kw.value)
+        if spec is None:
+            continue
+        shape, dtype = ctor
+        out.append((node, shape, dtype, spec))
+    return out
+
+
+def _nbytes(shape, dtype):
+    n = itemsize(dtype)
+    for d in shape:
+        n *= d
+    return n
+
+
+def _human(n):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return ("%d%s" % (n, unit) if unit == "B"
+                    else "%.1f%s" % (n, unit))
+        n /= 1024.0
+    return "%d" % n
+
+
+class _HotLoopSpecs(ast.NodeVisitor):
+    """SP1003: per innermost loop body, track the last spec literal each
+    receiver was constrained to; a second, different literal means a
+    GSPMD reshard every iteration."""
+
+    def __init__(self, path, findings):
+        self.path = path
+        self.findings = findings
+        self._frames = []
+
+    def _loop(self, node):
+        self._frames.append({})
+        self.generic_visit(node)
+        self._frames.pop()
+
+    visit_For = visit_AsyncFor = visit_While = _loop
+
+    def visit_Call(self, node):
+        fn = node.func
+        is_wsc = ((isinstance(fn, ast.Attribute)
+                   and fn.attr == "with_sharding_constraint")
+                  or (isinstance(fn, ast.Name)
+                      and fn.id == "with_sharding_constraint"))
+        if is_wsc and self._frames:
+            recv = _dotted(fn.value) if isinstance(fn, ast.Attribute) \
+                else (_dotted(node.args[0]) if node.args else "")
+            spec_node = None
+            if isinstance(fn, ast.Attribute) and node.args:
+                spec_node = node.args[0]
+            elif isinstance(fn, ast.Name) and len(node.args) >= 2:
+                spec_node = node.args[1]
+            spec = _spec_entries(spec_node) if spec_node is not None \
+                else None
+            if recv and spec is not None:
+                frame = self._frames[-1]
+                prev = frame.get(recv)
+                if prev is not None and prev[0] != spec:
+                    self.findings.append(Finding(
+                        self.path, node.lineno, node.col_offset, "SP1003",
+                        "%r is constrained to %s here but to %s at line "
+                        "%d inside the same loop body — GSPMD inserts a "
+                        "reshard between the two layouts on EVERY "
+                        "iteration of this hot loop; pick one layout "
+                        "for the loop (or reshard once outside it)"
+                        % (recv, _fmt_spec(spec), _fmt_spec(prev[0]),
+                           prev[1])))
+                frame[recv] = (spec, node.lineno)
+        self.generic_visit(node)
+
+
+def _fmt_spec(entries):
+    return "P(%s)" % ", ".join(repr(e) for e in entries)
+
+
+def run(path, tree, findings=None, strict=False):
+    """Run the SP pass over one parsed module; returns the findings."""
+    if findings is None:
+        findings = []
+    axes = _collect_mesh_axes(tree)
+    known = {a: s for a, s in (axes or {}).items()
+             if isinstance(s, int) and s > 1}
+    placements = _placements(tree) if axes is not None else []
+    capacity = _collect_capacity(tree)
+
+    def per_device(shape, dtype, entries):
+        try:
+            return _nbytes(shape, dtype) // partition_factor(
+                shape, entries, known)
+        except Exception:
+            return None     # unknown axis etc. — SH901's business
+
+    # -- SP1001: predicted per-device OOM ---------------------------------
+    if capacity is not None:
+        for node, shape, dtype, entries in placements:
+            pdb = per_device(shape, dtype, entries)
+            if pdb is not None and pdb > capacity:
+                findings.append(Finding(
+                    path, node.lineno, node.col_offset, "SP1001",
+                    "placement of shape %s %s under %s needs %s per "
+                    "device — over the declared capacity of %s: a "
+                    "predicted OOM before anything runs; shard more "
+                    "dims, shrink the array, or raise the budget"
+                    % (list(shape), dtype, _fmt_spec(entries),
+                       _human(pdb), _human(capacity))))
+
+    # -- SP1002: dominant parameter fully replicated ----------------------
+    n_devices = 1
+    for s in known.values():
+        n_devices *= s
+    if n_devices > 1 and placements:
+        total = sum(_nbytes(shape, dtype)
+                    for _n, shape, dtype, _e in placements)
+        threshold = max(_FLOOR_BYTES,
+                        total // _DOMINANT_SHARE)
+        for node, shape, dtype, entries in placements:
+            g = _nbytes(shape, dtype)
+            try:
+                replicated = partition_factor(shape, entries, known) == 1
+            except Exception:
+                continue    # unknown axis — SH901's business
+            if replicated and g >= threshold:
+                findings.append(Finding(
+                    path, node.lineno, node.col_offset, "SP1002",
+                    "a dominant parameter (%s, %s of the %s of "
+                    "statically-visible placement bytes here) is fully "
+                    "replicated onto a %d-device mesh — every device "
+                    "pays the whole array; shard a dim "
+                    "(megatron_rule/pattern_rule) or use rules='auto'"
+                    % (_human(g),
+                       "%d%%" % (100 * g // total) if total else "100%",
+                       _human(total), n_devices)))
+
+    # -- SP1003: conflicting specs in a hot loop --------------------------
+    _HotLoopSpecs(path, findings).visit(tree)
+    return findings

@@ -14,12 +14,16 @@ serializes dispatch.  This pass catches the statically visible cases:
   ``make_mesh({...})``, ``global_mesh({...})``, or the raw
   ``Mesh(devs, ("a", "b"))`` spelling) — variables and runtime-shaped
   meshes are never guessed at, same conservatism as CC601.
-* ``SH902`` — ``.reshard(...)`` or ``nd.shard(...)`` inside a
-  ``for``/``while`` body: resharding is cross-device data movement;
-  in a loop it is the new host-sync-in-loop.  Hoist the placement out
-  of the loop, or annotate intermediates with
-  ``with_sharding_constraint`` (a compile-time annotation, free at
-  runtime) instead.
+* ``SH902`` — ``.reshard(...)``, ``nd.shard(...)`` or an *eager*
+  ``with_sharding_constraint`` inside a ``for``/``while`` body:
+  resharding is cross-device data movement; in a loop it is the new
+  host-sync-in-loop.  Eager ``with_sharding_constraint`` counts
+  because outside a trace it is a registry op producing a re-placed
+  array per iteration; inside a traced body (``hybrid_forward``,
+  ``@jit`` — recognized via ``tracing_safety``'s traced-function
+  collector) it is a free compile-time annotation and stays clean.
+  Hoist the placement out of the loop, or move the loop under the
+  trace.
 
 Runtime counterpart: ``MXNET_SHARDING_VERIFY=1``
 (``sharding/verify.py``) pre-flights dynamically built spec/mesh pairs
@@ -97,11 +101,21 @@ def _spec_axis_nodes(call):
 
 
 class _ShardingChecker(ast.NodeVisitor):
-    def __init__(self, path, findings, mesh_axes):
+    def __init__(self, path, findings, mesh_axes, traced_ids=()):
         self.path = path
         self.findings = findings
         self.mesh_axes = mesh_axes  # None: no statically-known mesh
         self.loop_depth = 0
+        self.traced_ids = frozenset(traced_ids)
+        self.traced_depth = 0
+
+    def _funcdef(self, node):
+        traced = id(node) in self.traced_ids
+        self.traced_depth += traced
+        self.generic_visit(node)
+        self.traced_depth -= traced
+
+    visit_FunctionDef = visit_AsyncFunctionDef = _funcdef
 
     def _flag(self, node, rule, msg):
         self.findings.append(Finding(
@@ -154,6 +168,26 @@ class _ShardingChecker(ast.NodeVisitor):
                     "nd.shard() inside a loop: allocates and moves a "
                     "fresh distributed copy per iteration — shard once "
                     "before the loop")
+            elif (fn.attr == "with_sharding_constraint"
+                  and not self.traced_depth):
+                self._flag(
+                    node, "SH902",
+                    "eager with_sharding_constraint inside a loop: "
+                    "outside a trace it is a registry op that produces "
+                    "a re-placed array EVERY iteration — hoist the "
+                    "placement out of the loop, or move the loop under "
+                    "jit/hybrid_forward where the constraint is a free "
+                    "annotation")
+        elif (self.loop_depth > 0 and isinstance(fn, ast.Name)
+              and fn.id == "with_sharding_constraint"
+              and not self.traced_depth):
+            self._flag(
+                node, "SH902",
+                "eager with_sharding_constraint inside a loop: outside "
+                "a trace it is a registry op that produces a re-placed "
+                "array EVERY iteration — hoist the placement out of the "
+                "loop, or move the loop under jit/hybrid_forward where "
+                "the constraint is a free annotation")
         self.generic_visit(node)
 
 
@@ -162,5 +196,9 @@ def run(path, tree, findings=None, strict=False):
     if findings is None:
         findings = []
     mesh_axes = _collect_mesh_axes(tree)
-    _ShardingChecker(path, findings, mesh_axes).visit(tree)
+    from .tracing_safety import collect_traced_functions
+
+    traced_ids = [id(fd) for fd, _f, _names in
+                  collect_traced_functions(tree)]
+    _ShardingChecker(path, findings, mesh_axes, traced_ids).visit(tree)
     return findings

@@ -49,11 +49,19 @@ class JitTrainStep:
     data_axis : mesh axis name carrying the batch dimension
     param_rule : fn(param_name, shape) -> PartitionSpec or None
         tensor-parallel sharding rule; None replicates parameters.
+    rules : the declarative spelling of ``param_rule`` — ``"auto"``
+        (the cost-model planner picks; see ``mxnet_tpu/planner/``),
+        ``"dp"``/``"replicated"``, ``"megatron"``, or a callable
+        (identical to ``param_rule``).  ``"auto"`` resolves at first
+        step (when parameter shapes exist): the plan is kept on
+        ``self.plan`` and ``MXNET_PLANNER_DRYRUN=1`` prints its
+        ``explain()`` report to stderr.
     """
 
     def __init__(self, net, loss=None, optimizer='sgd',
                  optimizer_params=None, mesh=None, data_axis='data',
-                 param_rule=None, donate=True, clip_global_norm=None):
+                 param_rule=None, donate=True, clip_global_norm=None,
+                 rules=None):
         self._net = net
         self._loss = loss
         # global-norm grad clip fused into the step executable (the jitted
@@ -70,6 +78,12 @@ class JitTrainStep:
             mesh = _sharding.current_mesh()
         self._mesh = _sharding.as_jax_mesh(mesh)
         self._data_axis = data_axis
+        if rules is not None and param_rule is not None:
+            raise MXNetError(
+                "pass either rules= or param_rule=, not both (rules is "
+                "the declarative spelling of the same knob)")
+        self._rules = rules
+        self.plan = None        # the planner's Plan under rules="auto"
         self._param_rule = param_rule
         self._params = None
         self._t = 0
@@ -117,8 +131,65 @@ class JitTrainStep:
                 self._opt.create_state(i, self._weights[i]))
             if i in self._train_set else None
             for i in range(len(self._params))]
+        if self._rules is not None:
+            self._param_rule = self._resolve_rules(batch_nd)
         if self._mesh is not None:
             self._place_on_mesh(self._param_rule)
+        self._tag_weights()
+
+    def _tag_weights(self):
+        """Attribute the live weight buffers to memdump (per-device param
+        accounting — the 10% prediction-agreement contract in
+        tests/test_planner.py).  Re-run after every step: donation frees
+        the tagged buffers and the updated weights are NEW allocations."""
+        from ..telemetry import memdump as _memdump
+
+        if not _memdump.enabled():
+            return
+        for p, w in zip(self._params, self._weights):
+            _memdump.tag(w, origin="param", label="train_step:%s" % p.name)
+
+    # -- rules= resolution -------------------------------------------------
+    def _optimizer_slots(self):
+        """Per-weight optimizer state arrays (0 sgd, 1 momentum, 2 adam)
+        — the planner prices optimizer residency with this."""
+        st = self._opt.create_state(0, jnp.zeros((2,), jnp.float32))
+        return len(jax.tree_util.tree_leaves(st))
+
+    def _resolve_rules(self, batch_nd):
+        rules = self._rules
+        if callable(rules):
+            return rules
+        if self._mesh is None:
+            raise MXNetError(
+                "rules=%r needs a mesh (pass mesh= or enter a Mesh "
+                "context)" % (rules,))
+        if rules in ("dp", "replicated"):
+            return None
+        if rules == "megatron":
+            from .tp_rules import megatron_rule
+
+            return megatron_rule(mesh=self._mesh)
+        if rules == "auto":
+            import os
+            import sys
+
+            from .. import planner as _planner
+
+            shape0 = tuple(batch_nd[0].shape)
+            tokens = (shape0[0] * shape0[1] if len(shape0) >= 2
+                      else (shape0[0] if shape0 else 1))
+            self.plan = _planner.plan(
+                self._params, self._mesh, data_axis=self._data_axis,
+                step_tokens=tokens,
+                optimizer_slots=self._optimizer_slots())
+            if os.environ.get(_planner.ENV_DRYRUN, "") not in (
+                    "", "0", "false", "False"):
+                print(self.plan.explain(), file=sys.stderr)
+            return self.plan.param_rule
+        raise MXNetError(
+            "unknown rules=%r (expected 'auto', 'dp'/'replicated', "
+            "'megatron', or a param_rule callable)" % (rules,))
 
     # -- mesh placement ----------------------------------------------------
     @staticmethod
@@ -321,6 +392,7 @@ class JitTrainStep:
             jnp.asarray(self._t, jnp.int32))
         self._weights, self._opt_state, loss = self._step_fn(
             key, lr, self._weights, self._opt_state, t, *arrays)
+        self._tag_weights()
         self._last_loss = loss
         return loss
 
@@ -414,6 +486,7 @@ class JitTrainStep:
             jnp.asarray(self._t, jnp.int32))
         self._weights, self._opt_state, loss = fn(
             key, lr, self._weights, self._opt_state, t, *arrays)
+        self._tag_weights()
         self._t += n
         self._last_loss = loss
         return loss

@@ -1,0 +1,35 @@
+"""Auto-parallelism: a cost-model-driven sharding planner (ROADMAP 4).
+
+SNIPPETS.md [3]'s promise — "scales from 8-chip pods to 6,000-chip
+superclusters without changing application code" — needs the framework
+to CHOOSE the sharding, the way GSPMD/Alpa-style systems derive specs
+from a cost model instead of hand annotations.  This package closes
+that loop over the PR-10 substrate:
+
+- :mod:`candidates` enumerates rule-sets over a mesh (replicated/dp,
+  megatron column-row pairings per model axis, embed-only variants) —
+  the same pattern tables ``parallel/tp_rules.py`` ships, so a chosen
+  candidate is *spec-identical* to the hand-picked rule (and therefore
+  compiles the identical executable: the bitwise-parity contract);
+- :func:`plan` scores every candidate with
+  ``analysis/spmd_cost.py`` under a device-memory capacity constraint
+  (``MXNET_PLANNER_CAPACITY_BYTES``) and returns a deterministic
+  :class:`Plan` whose ``explain()`` is the dry-run report.
+
+Three surfaces: ``JitTrainStep(mesh=..., rules="auto")``,
+``serve.export_serving_bundle(..., mesh=..., rules="auto")`` (plan
+recorded in the bundle meta), and ``tools/mxplan.py`` (plans from an
+``{axis: size}`` dict — no devices needed, a laptop can plan a pod).
+"""
+from __future__ import annotations
+
+from .candidates import Candidate, enumerate_candidates
+from .planner import (ENV_CAPACITY, ENV_DRYRUN, Plan,
+                      default_capacity_bytes, plan, plan_for_net,
+                      plan_serving)
+
+__all__ = [
+    "Candidate", "enumerate_candidates",
+    "Plan", "plan", "plan_for_net", "plan_serving",
+    "default_capacity_bytes", "ENV_CAPACITY", "ENV_DRYRUN",
+]

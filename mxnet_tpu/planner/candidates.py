@@ -1,0 +1,113 @@
+"""Candidate rule-set enumeration over a mesh.
+
+A candidate is a *pure* description — ordered ``(glob, spec-entries)``
+pairs resolved with first-match-wins and the same divisibility
+degradation as ``pattern_rule`` — evaluated against plain
+``{axis: size}`` dicts so planning needs no devices.  The pattern
+tables are imported from ``parallel/tp_rules.py`` (NOT copied): a
+``megatron[model]`` candidate resolves to exactly the specs
+``megatron_rule(axis="model", mesh=mesh)`` would produce, which is what
+makes the planner's pick bitwise-identical to the hand-picked rule.
+
+Enumeration is deterministic: candidates are emitted in a fixed order
+(dp first, then per model axis in mesh order), and the planner breaks
+score ties by that order — so dp/replication wins whenever sharding
+buys nothing (e.g. a small MLP no megatron pattern matches).
+"""
+from __future__ import annotations
+
+import fnmatch
+
+from ..parallel.tp_rules import (COLUMN_PATTERNS, EMBED_PATTERNS,
+                                 ROW_PATTERNS)
+
+__all__ = ["Candidate", "enumerate_candidates"]
+
+
+class Candidate:
+    """One named rule-set: ordered ``(glob, entries)`` pairs.
+
+    ``entries`` is a tuple of PartitionSpec entries (axis name, None,
+    or a tuple of names); params matching no pair replicate.
+    """
+
+    __slots__ = ("name", "pairs", "description")
+
+    def __init__(self, name, pairs, description):
+        self.name = name
+        self.pairs = tuple((str(g), tuple(e)) for g, e in pairs)
+        self.description = description
+
+    def spec_for(self, pname, shape, axes):
+        """Resolve one param: first matching glob wins; a named dim that
+        does not divide its axes (or exceeds the rank) degrades the
+        whole param to replication — ``pattern_rule`` semantics."""
+        for pat, entries in self.pairs:
+            if not fnmatch.fnmatch(pname, pat):
+                continue
+            entries = entries[:len(shape)]
+            for d, e in enumerate(entries):
+                if e is None:
+                    continue
+                size = 1
+                for name in (e if isinstance(e, tuple) else (e,)):
+                    size *= axes.get(name, 0)
+                if size <= 0 or shape[d] % size != 0:
+                    return ()
+            # drop trailing Nones: P("model", None) == P("model")
+            while entries and entries[-1] is None:
+                entries = entries[:-1]
+            return tuple(entries)
+        return ()
+
+    def specs(self, params, axes):
+        """``{name: entries}`` for a ``[(name, shape, dtype), ...]`` tree."""
+        return {name: self.spec_for(name, shape, axes)
+                for name, shape, _dtype in params}
+
+    def __repr__(self):
+        return "Candidate(%s)" % self.name
+
+
+def _megatron_pairs(axis, shard_embeddings=True):
+    pairs = [(p, (axis, None)) for p in COLUMN_PATTERNS]
+    pairs += [(p, (None, axis)) for p in ROW_PATTERNS]
+    if shard_embeddings:
+        pairs += [(p, (axis, None)) for p in EMBED_PATTERNS]
+    return pairs
+
+
+def enumerate_candidates(axes, data_axis="data"):
+    """The deterministic candidate list for a mesh.
+
+    ``axes`` is an ordered ``{axis: size}`` dict (``spmd_cost.
+    mesh_axes``).  Every axis other than ``data_axis`` with size > 1 is
+    a tensor-parallel assignment variant.
+    """
+    cands = []
+    if axes.get(data_axis, 1) > 1:
+        cands.append(Candidate(
+            "dp", (),
+            "replicate every parameter; batch sharded on %r (grad "
+            "all-reduce inside the step)" % data_axis))
+    else:
+        cands.append(Candidate(
+            "replicated", (), "replicate every parameter (no data axis "
+            "in this mesh)"))
+    for axis, size in axes.items():
+        if axis == data_axis or size <= 1:
+            continue
+        cands.append(Candidate(
+            "megatron[%s]" % axis, _megatron_pairs(axis),
+            "Megatron column/row pairing on axis %r (qkv/up/gate column,"
+            " o/down row, embeddings vocab-sharded)" % axis))
+        cands.append(Candidate(
+            "megatron[%s]-replicated-embed" % axis,
+            _megatron_pairs(axis, shard_embeddings=False),
+            "Megatron pairing on axis %r with embedding/head tables "
+            "replicated" % axis))
+        cands.append(Candidate(
+            "embed[%s]" % axis,
+            [(p, (axis, None)) for p in EMBED_PATTERNS],
+            "vocab-shard only the embedding tables on axis %r" % axis))
+    return cands

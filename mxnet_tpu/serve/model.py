@@ -437,7 +437,7 @@ def compile_serving_executables(net, geometry):
 
 def export_serving_bundle(net, path, page_size=None, num_pages=None,
                           max_batch=None, prefill_buckets=None,
-                          max_pages_per_seq=None):
+                          max_pages_per_seq=None, mesh=None):
     """Export ``net`` as a self-contained MXAOT1 serving bundle.
 
     The bundle carries the AOT-compiled decode + per-bucket prefill
@@ -445,6 +445,14 @@ def export_serving_bundle(net, path, page_size=None, num_pages=None,
     meta, so ``serve.LlamaServer(path)`` starts with zero live compiles.
     Paging knobs default from ``MXNET_SERVE_*`` (docs/env_vars.md).
     Returns the geometry.
+
+    ``mesh`` (a Mesh / axes dict — abstract, no devices needed) runs the
+    auto-sharding planner over the weight tree and stores its decision
+    under ``meta["planner"]`` — chosen per-weight specs plus a suggested
+    KV-arena spec — so a sharded server can be brought up from the
+    bundle with zero live jits AND zero hand-written specs
+    (``planner.plan_serving``).  The executables themselves stay
+    single-device; the planner meta is advisory placement data.
     """
     from .. import compile_cache as _ccache
 
@@ -452,12 +460,15 @@ def export_serving_bundle(net, path, page_size=None, num_pages=None,
                           max_batch=max_batch,
                           prefill_buckets=prefill_buckets,
                           max_pages_per_seq=max_pages_per_seq)
+    meta = {"kind": BUNDLE_KIND, "geometry": g.to_dict()}
+    if mesh is not None:
+        from .. import planner as _planner
+
+        meta["planner"] = _planner.plan_serving(net, g, mesh)
     exes = compile_serving_executables(net, g)
     entries = {name: _ccache.serialize_compiled(c)
                for name, c in exes.items()}
-    _ccache.save_bundle(path, entries,
-                        meta={"kind": BUNDLE_KIND,
-                              "geometry": g.to_dict()})
+    _ccache.save_bundle(path, entries, meta=meta)
     return g
 
 

@@ -42,8 +42,8 @@ from .metrics import gauge, register_collector
 
 __all__ = [
     "origin", "current_origin", "tag", "refresh", "device_bytes",
-    "peak_bytes", "topk", "reconcile", "is_oom", "oom_report",
-    "maybe_oom_report", "enabled", "reset",
+    "per_device_bytes", "peak_bytes", "topk", "reconcile", "is_oom",
+    "oom_report", "maybe_oom_report", "enabled", "reset",
 ]
 
 ORIGINS = ("param", "activation", "kv_page", "temp", "grad")
@@ -170,6 +170,42 @@ def refresh():
 def device_bytes():
     """Live device bytes by origin (runs a sweep)."""
     return refresh()[0]
+
+
+def per_device_bytes(device=None, label_prefix=None):
+    """Bytes resident on ONE device, by origin.
+
+    ``device_bytes()`` counts each array's *logical* ``nbytes`` — a
+    tp-sharded weight counts fully even though every device holds only
+    a slice.  This sums the actual shard bytes resident on ``device``
+    (default: the first local device), which is the quantity a
+    per-device capacity — and the planner's ``spmd_cost`` prediction —
+    is about.  ``label_prefix`` restricts the count to tags whose label
+    starts with it (e.g. ``"train_step:"``), excluding untagged
+    buffers; origins stay keyed as in :func:`device_bytes`.
+    """
+    if device is None:
+        device = jax.local_devices()[0]
+    with _lock:
+        tags = dict(_tags)
+    by = dict.fromkeys(_seen_origins, 0)
+    for a in jax.live_arrays():
+        try:
+            nbytes = sum(int(s.data.nbytes) for s in a.addressable_shards
+                         if s.device == device)
+        except Exception:
+            continue
+        if not nbytes:
+            continue
+        rec = tags.get(id(a))
+        if rec is not None and rec["ref"]() is a:
+            if label_prefix is not None \
+                    and not rec["label"].startswith(label_prefix):
+                continue
+            by[rec["origin"]] = by.get(rec["origin"], 0) + nbytes
+        elif label_prefix is None:
+            by["temp"] = by.get("temp", 0) + nbytes
+    return by
 
 
 def peak_bytes():

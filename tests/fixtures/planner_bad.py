@@ -1,0 +1,41 @@
+# flake8: noqa
+"""Known-bad placements for the SP10xx planner pass (tests/test_mxlint.py).
+
+Same contract as ``sharding_bad.py``: every deliberately-bad line carries
+a trailing ``# expect: RULE[,RULE]`` marker (a line CAN fire two rules —
+a dominant replicated placement that is also over budget is both SP1001
+and SP1002) and the test asserts the linter produces EXACTLY those
+findings.  Never imported by the framework.
+"""
+import jax
+
+from mxnet_tpu import nd
+from mxnet_tpu.sharding import Mesh, P
+
+mesh = Mesh({"data": 4, "model": 2})
+
+CAPACITY_BYTES = 64 * 2 ** 20       # 64 MiB per device
+
+
+def over_budget_placements():
+    # 1 GiB sharded over model=2 -> 512 MiB/device: over budget even sharded
+    big = nd.shard(nd.zeros((4096, 65536)), P("model"))        # expect: SP1001
+    # 256 MiB replicated: over budget AND a dominant fully-replicated param
+    rep = nd.shard(nd.ones((8192, 8192)), P())                 # expect: SP1001,SP1002
+    return big, rep
+
+
+def clean_placements():
+    ok = nd.shard(nd.zeros((256, 256)), P("data"))      # clean: 16KiB/device
+    small = nd.shard(nd.full((64, 64), 1.0), P())       # clean: under the 1MiB floor
+    return ok, small
+
+
+@jax.jit
+def conflicting_specs_in_hot_loop(h, g):
+    for _ in range(4):
+        h = h.with_sharding_constraint(P("data", None))
+        h = h.with_sharding_constraint(P("model", None))       # expect: SP1003
+        g = g.with_sharding_constraint(P("data", None))
+        g = g.with_sharding_constraint(P("data", None))  # clean: same layout
+    return h, g

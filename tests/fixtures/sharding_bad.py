@@ -27,8 +27,21 @@ def reshard_in_loops(arrs, nd, spec):
         x = nd.shard(arrs[0], spec)  # expect: SH902
         i += 1
     arrs[0].reshard(spec)           # clean: not in a loop
-    y = [a.with_sharding_constraint(spec) for a in arrs]  # clean: annotation
+    y = [a.with_sharding_constraint(spec) for a in arrs]  # expect: SH902  (eager: re-places per item)
     return x, y
+
+
+def traced_constraint_is_free(arrs, spec):
+    import jax
+
+    @jax.jit
+    def body(xs):
+        out = []
+        for x in xs:                # clean: inside a trace the constraint
+            out.append(x.with_sharding_constraint(spec))  # is an annotation
+        return out
+
+    return body(arrs)
 
 
 def suppressed_reshard(arrs, spec):

@@ -44,6 +44,10 @@ _STUB_VALUES = {"train": 100.0, "infer": 200.0, "bert": 300.0,
                           "ttft_p50_ms": 10.0, "ttft_p99_ms": 50.0,
                           "tpot_p50_ms": 2.0, "completed": 64,
                           "n_requests": 64, "live_compiles": 0},
+                # planner runner (ISSUE 11): median plan seconds as
+                # value, the ms-precision figure rides along
+                "planner": {"value": 0.0, "planner_ms": 0.9,
+                            "n_params": 21},
                 # cold-start runners return value + extra record fields
                 "cold_resnet50": {"value": 30.0, "warm_seconds": 2.0,
                                   "cold_warm_speedup": 15.0},
@@ -94,6 +98,7 @@ def test_default_mode_emits_all_metrics_in_one_line(monkeypatch, capsys):
                      "imperative_dispatch_bulked_train",
                      "imperative_dispatch_bulked_long",
                      "llama_serve_tok_s",
+                     "planner_seconds",
                      "resnet50_cold_start_seconds",
                      "bert_cold_start_seconds",
                      "llama_cold_start_seconds"]
@@ -124,6 +129,12 @@ def test_default_mode_emits_all_metrics_in_one_line(monkeypatch, capsys):
     assert srv["continuous_vs_static"] == 2.0
     assert srv["ttft_p50_ms"] == 10.0 and srv["ttft_p99_ms"] == 50.0
     assert srv["live_compiles"] == 0
+    # planner record (ISSUE 11): static analysis latency, LOWER better;
+    # the ms-precision figure survives the 2-decimal value rounding
+    plan = by_name["planner_seconds"]
+    assert plan["unit"] == "seconds"
+    assert plan["planner_ms"] == 0.9
+    assert plan["n_params"] == 21
 
 
 def test_budget_exhaustion_marks_skipped(monkeypatch, capsys):
@@ -136,7 +147,7 @@ def test_budget_exhaustion_marks_skipped(monkeypatch, capsys):
                       if ln.startswith("{")][-1])
     assert rec["value"] == 100.0  # headline always measured
     skipped = [m for m in rec["metrics"] if m.get("skipped")]
-    assert len(skipped) == 12
+    assert len(skipped) == 13
     assert all(m["value"] == 0.0 for m in skipped)
 
 
@@ -166,6 +177,7 @@ def test_failed_benchmark_emits_zero_not_crash(monkeypatch, capsys):
         "dispatch_bulked_long": (
             boom, "imperative_dispatch_bulked_long", "ops/sec", None),
         "serve": (boom, "llama_serve_tok_s", "tokens/sec", None),
+        "planner": (boom, "planner_seconds", "seconds", None),
         "cold_resnet50": (boom, "resnet50_cold_start_seconds", "seconds",
                           None),
         "cold_bert": (boom, "bert_cold_start_seconds", "seconds", None),
@@ -176,4 +188,4 @@ def test_failed_benchmark_emits_zero_not_crash(monkeypatch, capsys):
     rec = json.loads([ln for ln in capsys.readouterr().out.splitlines()
                       if ln.startswith("{")][-1])
     assert rec["value"] == 0.0 and rec["fallback"] is True
-    assert len(rec["metrics"]) == 13
+    assert len(rec["metrics"]) == 14

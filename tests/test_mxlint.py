@@ -16,6 +16,7 @@ from mxnet_tpu.ops import registry
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURE = os.path.join(REPO, "tests", "fixtures", "mxlint_bad.py")
+PLANNER_FIXTURE = os.path.join(REPO, "tests", "fixtures", "planner_bad.py")
 
 
 # ---------------------------------------------------------------------------
@@ -46,6 +47,84 @@ def test_fixture_findings_match_markers_exactly():
                                   "RB701"])
 def test_fixture_covers_rule(rule):
     assert rule in {r for _, r in _expected_markers()}
+
+
+# ---------------------------------------------------------------------------
+# SP10xx planner pass fixture: markers are comma lists because one line
+# can legitimately fire two rules (a dominant replicated placement that
+# is also over the capacity is SP1001 AND SP1002)
+# ---------------------------------------------------------------------------
+def _planner_markers():
+    out = []
+    with open(PLANNER_FIXTURE) as f:
+        for lineno, line in enumerate(f, 1):
+            m = re.search(r"#\s*expect:\s*([A-Z]+\d+(?:,[A-Z]+\d+)*)", line)
+            if m:
+                out.extend((lineno, rule)
+                           for rule in m.group(1).split(","))
+    return sorted(out)
+
+
+def test_planner_fixture_findings_match_markers_exactly():
+    expected = _planner_markers()
+    assert len(expected) >= 4, "planner fixture lost its markers"
+    findings = lint_paths([PLANNER_FIXTURE], relative_to=REPO,
+                          suppressions=SuppressionFile())
+    got = sorted((f.line, f.rule) for f in findings)
+    assert got == expected, "\n".join(str(f) for f in findings)
+
+
+@pytest.mark.parametrize("rule", ["SP1001", "SP1002", "SP1003"])
+def test_planner_fixture_covers_rule(rule):
+    assert rule in {r for _, r in _planner_markers()}
+
+
+# ---------------------------------------------------------------------------
+# --pass/--only selection: one pass family in isolation
+# ---------------------------------------------------------------------------
+def test_lint_source_only_filters_passes():
+    # a body that fires TS101 (data-dependent branch) AND HS201
+    # (asscalar in a loop)
+    src = ("def hybrid_forward(self, F, x):\n"
+           "    if x > 0:\n"
+           "        return x\n"
+           "    for b in [x]:\n"
+           "        v = b.asscalar()\n"
+           "    return v\n")
+    assert {f.rule for f in lint_source(src)} == {"TS101", "HS201"}
+    assert [f.rule for f in lint_source(src, only="TS")] == ["TS101"]
+    assert [f.rule for f in lint_source(src, only="HS201")] == ["HS201"]
+    both = {f.rule for f in lint_source(src, only="TS1,HS2")}
+    assert both == {"TS101", "HS201"}
+
+
+def test_lint_source_only_rejects_unknown_selector():
+    with pytest.raises(ValueError, match="unknown pass/rule selector"):
+        lint_source("x = 1\n", only="ZZ99")
+
+
+def test_cli_pass_selection_isolates_family():
+    bad = os.path.join(REPO, "tests", "fixtures", "sharding_bad.py")
+    # SH in isolation: SH findings only, nothing from other passes
+    r = _run_cli(bad, "--pass", "SH9", "--no-registry-check")
+    assert r.returncode == 1, r.stdout + r.stderr
+    rules = set(re.findall(r" ([A-Z]+\d+) \[", r.stdout))
+    assert rules and all(x.startswith("SH") for x in rules), r.stdout
+    # a family with no findings in this file: clean exit 0
+    r = _run_cli(bad, "--only", "TS", "--no-registry-check")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 findings" in r.stdout
+    # SP10 on the planner fixture
+    r = _run_cli(PLANNER_FIXTURE, "--pass", "SP10", "--no-registry-check")
+    assert r.returncode == 1, r.stdout + r.stderr
+    rules = set(re.findall(r" ([A-Z]+\d+) \[", r.stdout))
+    assert rules == {"SP1001", "SP1002", "SP1003"}, r.stdout
+
+
+def test_cli_pass_selection_rejects_unknown_exit_2():
+    r = _run_cli(FIXTURE, "--pass", "BOGUS")
+    assert r.returncode == 2
+    assert "unknown pass/rule selector" in r.stderr
 
 
 # ---------------------------------------------------------------------------

@@ -481,6 +481,41 @@ def test_verify_spec_unit():
     verify_spec(mesh, P(None, "model"))
 
 
+def test_verify_spec_error_paths_on_dict_mesh():
+    """Every verify_spec error path against a raw axes dict — no Mesh
+    object, no placement, resolved through as_jax_mesh the same as the
+    planner's abstract-mesh spelling."""
+    axes = {"data": 4, "model": 2}
+    with pytest.raises(MXNetError, match="axis 'expert' .*not an axis"):
+        verify_spec(axes, P("expert"))
+    with pytest.raises(MXNetError, match="rank"):
+        verify_spec(axes, P("data", None), shape=(8,))
+    with pytest.raises(MXNetError, match="dim 0 .*not divisible"):
+        verify_spec(axes, P(("data", "model")), shape=(12, 4))
+    # error message names the failing dim, not just the spec
+    with pytest.raises(MXNetError, match="dim 1"):
+        verify_spec(axes, P(None, "model"), shape=(8, 7))
+    verify_spec(axes, P("data", "model"), shape=(8, 8))   # clean
+
+
+def test_verify_spec_nested_ambient_meshes(eight_devices, monkeypatch):
+    """verify resolves against the INNERMOST ambient mesh; popping the
+    context restores the outer mesh's axis vocabulary."""
+    monkeypatch.setenv("MXNET_SHARDING_VERIFY", "1")
+    with Mesh({"data": 8}):
+        with Mesh({"data": 4, "model": 2}):
+            verify_spec(current_mesh(), P(None, "model"))
+            nd.shard(nd.ones((4, 2)), P("data", "model")).wait_to_read()
+            with pytest.raises(MXNetError, match="not divisible"):
+                nd.shard(nd.ones((6, 4)), P("data"))
+        # inner mesh popped: 'model' is no longer an axis out here
+        with pytest.raises(MXNetError, match="not an axis"):
+            verify_spec(current_mesh(), P("model"))
+        with pytest.raises(MXNetError, match="not divisible"):
+            nd.shard(nd.ones((6, 2)), P("data"))
+        nd.shard(nd.ones((8, 2)), P("data")).wait_to_read()   # clean
+
+
 def test_verify_env_gates_shard_calls(eight_devices, monkeypatch):
     mesh = Mesh({"data": 8})
     # off (default): the bad placement is jax's generic ValueError from

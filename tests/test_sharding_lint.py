@@ -88,13 +88,45 @@ def test_sh902_nd_shard_in_comprehension():
     assert [f.rule for f in lint_source(src)] == ["SH902"]
 
 
-def test_sh902_quiet_outside_loops_and_for_constraints():
+def test_sh902_quiet_outside_loops():
     src = ("def f(nd, arrs, spec):\n"
            "    a = arrs[0].reshard(spec)\n"
+           "    b = arrs[1].with_sharding_constraint(spec)\n"
+           "    return a, b\n")
+    assert lint_source(src) == []
+
+
+def test_sh902_eager_constraint_in_loop_fires():
+    # outside a trace with_sharding_constraint is a registry op — a
+    # re-placed copy every iteration, same cost shape as reshard
+    src = ("def f(arrs, spec):\n"
            "    for x in arrs:\n"
            "        x = x.with_sharding_constraint(spec)\n"
-           "    return a\n")
-    assert lint_source(src) == []
+           "    return x\n")
+    assert [f.rule for f in lint_source(src)] == ["SH902"]
+    # bare-name (functional jax spelling) form too
+    src2 = ("from jax.lax import with_sharding_constraint\n"
+            "def f(arrs, spec):\n"
+            "    return [with_sharding_constraint(a, spec) for a in arrs]\n")
+    assert [f.rule for f in lint_source(src2)] == ["SH902"]
+
+
+def test_sh902_traced_constraint_in_loop_is_quiet():
+    # under jit/hybrid_forward the constraint is a free annotation: the
+    # loop unrolls at trace time and GSPMD sees one placement
+    jit_src = ("import jax\n"
+               "@jax.jit\n"
+               "def f(arrs, spec):\n"
+               "    out = []\n"
+               "    for x in arrs:\n"
+               "        out.append(x.with_sharding_constraint(spec))\n"
+               "    return out\n")
+    assert lint_source(jit_src) == []
+    hf_src = ("def hybrid_forward(self, F, x, spec):\n"
+              "    for _ in range(2):\n"
+              "        x = x.with_sharding_constraint(spec)\n"
+              "    return x\n")
+    assert lint_source(hf_src) == []
 
 
 def test_sh902_inline_suppression():

@@ -2,9 +2,11 @@
 """mxlint — framework-aware static analysis for mxnet_tpu code.
 
 Runs the tracing-safety (TS1xx), host-sync (HS2xx), collective-
-consistency (CC6xx), cache-key (CS8xx) and sharding (SH9xx) passes over
-the given files/directories, plus the op-registry consistency pass
-(RC3xx) when the framework imports.
+consistency (CC6xx), cache-key (CS8xx), sharding (SH9xx) and planner
+(SP10xx) passes over the given files/directories, plus the op-registry
+consistency pass (RC3xx) when the framework imports.  ``--pass SP10``
+(alias ``--only``; comma-separated bands, families or rule ids) runs a
+selection in isolation.
 Explicitly-passed ``.json`` files are verified as serialized Symbol
 graphs with the per-node GS5xx pass.  The repo's own tree is a permanent
 lint target::
@@ -51,6 +53,13 @@ def main(argv=None):
     ap.add_argument("--no-probe", action="store_true",
                     help="registry pass: structural checks only, no "
                          "jax.eval_shape probing")
+    ap.add_argument("--pass", "--only", dest="only", default=None,
+                    metavar="SELECTION",
+                    help="run only the selected passes/rules: comma-"
+                         "separated bands (SH), families (SP10) or full "
+                         "rule ids (TS101).  Other passes don't run; the "
+                         "RC3xx registry pass runs only when RC is "
+                         "selected (or no selection is given).")
     ap.add_argument("--suppressions", default=None, metavar="FILE",
                     help="suppression file (default: "
                          "tools/mxlint_suppressions.txt if present)")
@@ -60,6 +69,7 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     from mxnet_tpu.analysis import (RULES, lint_paths, check_registry,
+                                    normalize_only, rule_selected,
                                     severity_at_least, verify_symbol_file)
 
     if args.list_rules:
@@ -72,6 +82,15 @@ def main(argv=None):
     if not args.paths:
         ap.error("no paths given (try: python tools/mxlint.py mxnet_tpu/)")
 
+    try:
+        only = normalize_only(args.only)
+    except ValueError as e:
+        ap.error(str(e))
+
+    def band_on(band):
+        return only is None or any(t.startswith(band) or band.startswith(t)
+                                   for t in only)
+
     # explicitly-passed .json files are serialized Symbol graphs (GS5xx);
     # directory walks stay .py-only
     sym_files = [p for p in args.paths
@@ -80,11 +99,14 @@ def main(argv=None):
 
     findings = lint_paths(py_paths, strict=args.strict,
                           suppressions=args.suppressions,
-                          relative_to=_REPO_ROOT) if py_paths else []
+                          relative_to=_REPO_ROOT,
+                          only=only) if py_paths else []
     for p in sym_files:
-        findings.extend(verify_symbol_file(
-            p, relative_to=_REPO_ROOT, suppressions=args.suppressions))
-    if not args.no_registry_check:
+        findings.extend(
+            f for f in verify_symbol_file(
+                p, relative_to=_REPO_ROOT, suppressions=args.suppressions)
+            if rule_selected(f.rule, only))
+    if not args.no_registry_check and band_on("RC"):
         try:
             findings.extend(check_registry(suppressions=args.suppressions,
                                            probe=not args.no_probe,

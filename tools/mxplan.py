@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""mxplan — dry-run the SPMD auto-sharding planner from the command line.
+
+Plans against ABSTRACT mesh axes (``--mesh data=4,model=2``): no
+accelerator (and no devices at all beyond host CPU) is needed, so a
+laptop can plan a pod.  The same cost model drives
+``JitTrainStep(rules="auto")``; this tool is the inspection surface::
+
+    python tools/mxplan.py --mesh data=4,model=2 --model llama_small
+    python tools/mxplan.py --mesh data=8 --model mlp --capacity 64MiB
+    python tools/mxplan.py --mesh data=4,model=2 --params params.json
+
+``--params`` takes a JSON list of ``[name, shape]`` or
+``[name, shape, dtype]`` entries.  ``--format json`` emits
+``Plan.as_dict()`` with sorted keys — byte-identical across runs for the
+same inputs (the CI determinism contract).  Exit status: 0 when the
+chosen plan fits the capacity, 3 when no candidate does (predicted
+per-device OOM — the runtime twin of mxlint SP1001), 2 on usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+# planning is pure byte maths over abstract axes; never touch accelerators
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_SIZE_SUFFIX = {"": 1, "B": 1, "KIB": 1 << 10, "MIB": 1 << 20,
+                "GIB": 1 << 30, "KB": 10 ** 3, "MB": 10 ** 6, "GB": 10 ** 9}
+
+
+def parse_mesh(s):
+    """``data=4,model=2`` -> {"data": 4, "model": 2} (order preserved)."""
+    axes = {}
+    for part in s.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, eq, size = part.partition("=")
+        if not eq or not size.strip().isdigit():
+            raise ValueError(
+                "bad mesh axis %r (expected name=size, e.g. data=4)" % part)
+        axes[name.strip()] = int(size.strip())
+    if not axes:
+        raise ValueError("empty mesh (expected e.g. data=4,model=2)")
+    return axes
+
+
+def parse_capacity(s):
+    """``64MiB`` / ``16GB`` / ``123456`` -> bytes."""
+    t = s.strip().upper()
+    for suf in sorted(_SIZE_SUFFIX, key=len, reverse=True):
+        if suf and t.endswith(suf):
+            num = t[:-len(suf)].strip()
+            if num.replace(".", "", 1).isdigit():
+                return int(float(num) * _SIZE_SUFFIX[suf])
+    if t.isdigit():
+        return int(t)
+    raise ValueError("bad capacity %r (expected bytes or e.g. 64MiB)" % s)
+
+
+def _model_params(name):
+    """Built-in parameter trees.  llama_small needs one throwaway forward
+    to resolve deferred shapes — host CPU, tiny batch."""
+    from mxnet_tpu import nd
+
+    if name == "mlp":
+        from mxnet_tpu.gluon import nn
+
+        net = nn.HybridSequential()
+        net.add(nn.Dense(64, activation="relu"), nn.Dense(10))
+        net.initialize()
+        net(nd.ones((1, 32)))
+    elif name == "llama_small":
+        from mxnet_tpu.gluon.model_zoo import llama
+
+        net = llama.llama_small()
+        net.initialize()
+        net(nd.array([[1, 2, 3, 4]], dtype="int32"))
+    else:
+        raise ValueError("unknown --model %r (llama_small, mlp)" % name)
+    return [(p.name, tuple(p.shape),
+             str(getattr(p, "dtype", "float32") or "float32"))
+            for p in net.collect_params().values()]
+
+
+def _json_params(path):
+    with open(path) as f:
+        doc = json.load(f)
+    out = []
+    for entry in doc:
+        name, shape = entry[0], tuple(int(d) for d in entry[1])
+        dtype = entry[2] if len(entry) > 2 else "float32"
+        out.append((name, shape, dtype))
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="mxplan", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--mesh", required=True, metavar="AXES",
+                    help="abstract mesh axes, e.g. data=4,model=2")
+    ap.add_argument("--model", default=None,
+                    choices=("llama_small", "mlp"),
+                    help="built-in parameter tree to plan")
+    ap.add_argument("--params", default=None, metavar="FILE",
+                    help="JSON [[name, shape, dtype?], ...] to plan "
+                         "instead of --model")
+    ap.add_argument("--capacity", default=None, metavar="BYTES",
+                    help="per-device budget (e.g. 64MiB); default: "
+                         "$MXNET_PLANNER_CAPACITY_BYTES, else "
+                         "unconstrained")
+    ap.add_argument("--tokens", type=int, default=None, metavar="N",
+                    help="tokens per step (sizes the tp activation "
+                         "all-reduces)")
+    ap.add_argument("--slots", type=int, default=0, metavar="N",
+                    help="optimizer state arrays per weight (0 sgd, "
+                         "1 momentum, 2 adam)")
+    ap.add_argument("--data-axis", default="data", metavar="AXIS")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+
+    try:
+        axes = parse_mesh(args.mesh)
+        capacity = parse_capacity(args.capacity) if args.capacity else None
+    except ValueError as e:
+        ap.error(str(e))
+    if (args.model is None) == (args.params is None):
+        ap.error("pass exactly one of --model or --params")
+
+    from mxnet_tpu import planner
+
+    try:
+        params = (_json_params(args.params) if args.params
+                  else _model_params(args.model))
+    except (OSError, ValueError, KeyError, IndexError) as e:
+        ap.error("could not load parameters: %s" % e)
+
+    pl = planner.plan(params, axes, data_axis=args.data_axis,
+                      capacity_bytes=capacity, step_tokens=args.tokens,
+                      optimizer_slots=args.slots)
+    if args.format == "json":
+        print(json.dumps(pl.as_dict(), indent=2, sort_keys=True))
+    else:
+        print(pl.explain())
+    return 0 if pl.feasible else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())
