@@ -11,6 +11,13 @@ event.  This example simulates a shrink (8→4 devices) and a regrow
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python examples/distributed/elastic_reshard.py
 
+This is the *live-array* half of elasticity: values already on devices
+move to a new mesh in place.  The *checkpoint* half — a dp=8
+``save_states`` file restoring onto a dp=4 mesh across a process
+boundary — is ``elastic_train.py`` next to this file, and the membership
+protocol that decides WHEN to resize is docs/fault_tolerance.md
+"Elastic training".
+
 Each event rebuilds the ``Mesh`` from the surviving devices and
 reshards every parameter onto it.  The reshard-per-event loop below is
 the one legitimate reshard-in-a-loop in the tree (suppressed in

@@ -296,33 +296,81 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def save_states(self, fname):
-        """Parity: Trainer.save_states."""
-        import pickle
+        """Parity: Trainer.save_states — written as a checksummed MXGC1
+        global checkpoint (sharding/checkpoint.py): every optimizer-state
+        leaf stored once with a per-entry crc32, atomically, so a torn or
+        bit-flipped file is DETECTED at load (named entry) instead of
+        surfacing a raw unpickling error."""
+        from .. import sharding as _shd
 
         assert self._optimizer is not None
         self._ensure_states()
-        payload = {
-            "states": [jax.device_get(s) if s is not None else None
-                       for s in self._states],
-            "num_update": self._optimizer.num_update,
-            "index_update_count": self._optimizer._index_update_count,
-        }
-        from ..base import atomic_path
 
-        # atomic: a preemption mid-dump must not corrupt the previous
-        # states file (docs/fault_tolerance.md)
-        with atomic_path(fname) as tmp:
-            with open(tmp, "wb") as f:
-                pickle.dump(payload, f)
+        def entries():
+            for i, st in enumerate(self._states):
+                if st is None:
+                    continue
+                for j, leaf in enumerate(jax.tree_util.tree_leaves(st)):
+                    yield "state/%d/%d" % (i, j), jax.device_get(leaf), \
+                        None
+        meta = {
+            "kind": "trainer",
+            "num_update": int(self._optimizer.num_update),
+            "index_update_count": {
+                str(k): int(v) for k, v in
+                self._optimizer._index_update_count.items()},
+        }
+        # atomic (inside save_global): a preemption mid-dump must not
+        # corrupt the previous states file (docs/fault_tolerance.md)
+        _shd.save_global(fname, entries(), meta=meta)
 
     def load_states(self, fname):
-        import pickle
+        from ..base import MXNetError
+        from .. import sharding as _shd
 
-        with open(fname, "rb") as f:
-            payload = pickle.load(f)
-        self._states = [
-            jax.tree_util.tree_map(jnp.asarray, s) if s is not None else None
-            for s in payload["states"]]
+        if _shd.is_global_checkpoint(fname):
+            # live treedefs rebuild the trees from flat leaves — the
+            # format stores arrays + names only, never code
+            self._ensure_states()
+            entries, meta = _shd.load_global(fname)
+            states = []
+            for i, st in enumerate(self._states):
+                if st is None:
+                    states.append(None)
+                    continue
+                treedef = jax.tree_util.tree_structure(st)
+                leaves = []
+                for j in range(treedef.num_leaves):
+                    name = "state/%d/%d" % (i, j)
+                    ent = entries.get(name)
+                    if ent is None:
+                        raise MXNetError(
+                            "trainer checkpoint %s: missing entry %r "
+                            "(optimizer config changed?)" % (fname, name))
+                    leaves.append(jnp.asarray(ent["array"]))
+                states.append(jax.tree_util.tree_unflatten(treedef,
+                                                           leaves))
+            self._states = states
+            self._optimizer.num_update = int(meta["num_update"])
+            self._optimizer._index_update_count = {
+                int(k): int(v)
+                for k, v in meta["index_update_count"].items()}
+        else:
+            import pickle
+
+            try:
+                with open(fname, "rb") as f:
+                    payload = pickle.load(f)
+            except Exception as e:  # noqa: BLE001 — torn legacy pickle
+                raise MXNetError(
+                    "trainer checkpoint %s is neither MXGC1 nor a "
+                    "loadable legacy pickle (%s: %s) — corrupt or "
+                    "truncated" % (fname, type(e).__name__, e))
+            self._states = [
+                jax.tree_util.tree_map(jnp.asarray, s)
+                if s is not None else None
+                for s in payload["states"]]
+            self._optimizer.num_update = payload["num_update"]
+            self._optimizer._index_update_count = \
+                payload["index_update_count"]
         self._states_created = [True] * len(self._states)
-        self._optimizer.num_update = payload["num_update"]
-        self._optimizer._index_update_count = payload["index_update_count"]

@@ -238,6 +238,19 @@ class KVStore(KVStoreBase):
     def barrier(self):
         pass
 
+    # -- elastic membership (API parity with DistKVStore) ------------------
+    # a single-process store has no membership plane: the roster is this
+    # process, forever at epoch 0 — harness code written against the
+    # elastic API (set_step/join/resync) runs unchanged on `local`
+    def set_step(self, step):
+        pass
+
+    def resync(self):
+        return {}
+
+    def join(self):
+        return {"step": 0, "roster": [self.rank]}
+
 
 @KVStoreBase.register
 class TestStore(KVStoreBase):

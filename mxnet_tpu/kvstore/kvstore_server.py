@@ -42,6 +42,16 @@ class KVStoreServer:
         _metrics.gauge("mxnet_kvstore_server_expected_workers",
                        help="worker ranks this server waits for"
                        ).set(self._num_workers)
+        # elastic-membership gauges exist from boot (scrapes before the
+        # first eviction/join must show epoch 0 + a full roster, not an
+        # absent family); DistServer keeps them current afterwards
+        _metrics.gauge("mxnet_membership_epoch",
+                       help="membership epoch of this kvstore shard "
+                            "(bumps on every eviction or admission)"
+                       ).set(0)
+        _metrics.gauge("mxnet_ranks_active",
+                       help="worker ranks currently in the membership "
+                            "roster").set(self._num_workers)
         if threading.current_thread() is threading.main_thread():
             prev = signal.getsignal(signal.SIGTERM)
 

@@ -27,19 +27,33 @@ scalar hyperparameters), not a code object; optimizers carrying an
 ``lr_scheduler`` must schedule worker-side (documented limitation —
 the reference shipped the whole pickled object, an RCE by design).
 
-Fault tolerance (``docs/fault_tolerance.md``): wire protocol v2 carries a
-``{rank, seq}`` header on every *mutating* command (init/push/barrier/
-set-optimizer/stop) — the per-worker monotonic sequence number lets the
-server deduplicate replays, so the client can retry any failed RPC with
-capped exponential backoff (``MXNET_KVSTORE_RETRIES`` ×
+Fault tolerance (``docs/fault_tolerance.md``): wire protocol v3 carries a
+``{rank, seq, epoch}`` header on every *mutating* command (init/push/
+barrier/set-optimizer/stop) — the per-worker monotonic sequence number
+lets the server deduplicate replays, so the client can retry any failed
+RPC with capped exponential backoff (``MXNET_KVSTORE_RETRIES`` ×
 ``MXNET_KVSTORE_BACKOFF``), evicting the dead socket, reconnecting,
 re-handshaking and replaying the in-flight request; the server applies
 each mutation exactly once (pulls are idempotent and retry freely).
 Sync rounds and barriers carry a hard deadline
 (``MXNET_KVSTORE_BARRIER_TIMEOUT``) after which the server *names the
 missing ranks* in an error reply instead of wedging every worker —
-optionally (``MXNET_KVSTORE_ALLOW_DEGRADED=1``) marking them dead and
-continuing with the survivors.  All of it is exercised by the seeded
+optionally (``MXNET_KVSTORE_ALLOW_DEGRADED=1`` or
+``MXNET_KVSTORE_EVICT_ON_TIMEOUT=1``) EVICTING them from the membership
+roster and continuing with the survivors.
+
+Elastic membership (wire v3): the server versions its rank roster with a
+monotonic *membership epoch*.  Every mutating request carries the
+sender's last-known epoch; a stale one is fenced with a typed ``CMD_ERR``
+(``{"code": "stale_epoch", epoch, roster, step}``) that the client
+answers by re-syncing its epoch and re-sending the SAME request —
+fencing happens before the seq-dedup claim, so the re-send still dedups
+against an already-applied original.  Deadline expiry evicts the missing
+ranks and bumps the epoch (the fence is how survivors learn the new
+roster); a recovered or new worker re-enters with ``CMD_JOIN``, admitted
+at the next round boundary (``MXNET_ELASTIC_JOIN_TIMEOUT``) with its
+stale seq cache cleared.  Every transition lands in the flight recorder
+as a ``membership.*`` event.  All of it is exercised by the seeded
 fault-injection harness (``mxnet_tpu.testing.faults``) hooked into
 ``_send``/``_recv``/``_sock``/``DistServer._handle``.
 
@@ -75,7 +89,7 @@ from ..testing.faults import maybe_inject as _inject, set_role as _set_role
 
 
 # ---------------------------------------------------------------------------
-# wire protocol v2: MAGIC | ver u8 | cmd u8 | nfields u8 | fields
+# wire protocol v3: MAGIC | ver u8 | cmd u8 | nfields u8 | fields
 # field := tag u8 | payload
 #   'S' string:  u32 len | utf8
 #   'B' bytes:   u32 len | raw
@@ -85,10 +99,15 @@ from ..testing.faults import maybe_inject as _inject, set_role as _set_role
 # v2 (over v1): every mutating command's FIRST field is a 'J' meta dict
 # {"rank": int, "seq": int} — the worker's monotonic sequence number the
 # server dedups replayed mutations on (docs/fault_tolerance.md).
+# v3 (over v2): the meta dict also carries "epoch" (the sender's
+# last-known membership epoch; stale values are fenced with a typed
+# CMD_ERR) and optionally "step" (training-step hint JOIN hands to
+# re-admitted workers); new commands JOIN (re-admission at a round
+# boundary) and EPOCH (roster/epoch/step query, non-mutating).
 # ---------------------------------------------------------------------------
 
 _MAGIC = b"MXKV"
-_VERSION = 2
+_VERSION = 3
 
 CMD_OK = 0
 CMD_INIT = 1
@@ -100,6 +119,8 @@ CMD_SET_OPTIMIZER = 6
 CMD_STOP = 7
 CMD_HELLO = 8
 CMD_PROFILER = 9
+CMD_JOIN = 10
+CMD_EPOCH = 11
 CMD_ERR = 255
 
 # commands that change server state: these carry the {rank, seq} meta
@@ -114,7 +135,8 @@ _CMD_NAMES = {
     CMD_OK: "ok", CMD_INIT: "init", CMD_PUSH: "push", CMD_PULL: "pull",
     CMD_ROW_SPARSE_PULL: "row_sparse_pull", CMD_BARRIER: "barrier",
     CMD_SET_OPTIMIZER: "set_optimizer", CMD_STOP: "stop",
-    CMD_HELLO: "hello", CMD_PROFILER: "profiler", CMD_ERR: "err",
+    CMD_HELLO: "hello", CMD_PROFILER: "profiler", CMD_JOIN: "join",
+    CMD_EPOCH: "epoch", CMD_ERR: "err",
 }
 
 
@@ -152,6 +174,27 @@ def _allow_degraded():
     completeness; dist_sync semantics become best-effort)."""
     return os.environ.get("MXNET_KVSTORE_ALLOW_DEGRADED", "0") \
         not in ("", "0")
+
+
+def _evict_on_timeout():
+    """MXNET_KVSTORE_EVICT_ON_TIMEOUT=1: deadline expiry on a sync round
+    or barrier EVICTS the missing ranks — roster shrink + membership
+    epoch bump, broadcast to survivors through the stale-epoch fence —
+    and the survivors complete the round degraded *by design* (elastic
+    training, docs/fault_tolerance.md).  The legacy ALLOW_DEGRADED knob
+    now routes through the same eviction path; this is the
+    elastic-training spelling."""
+    return os.environ.get("MXNET_KVSTORE_EVICT_ON_TIMEOUT", "0") \
+        not in ("", "0")
+
+
+def _join_timeout():
+    """Deadline (s) a JOIN waits for the next round boundary before the
+    server refuses admission (MXNET_ELASTIC_JOIN_TIMEOUT).  A worker is
+    only admitted BETWEEN rounds: admitting mid-round would change the
+    contributor count under a round already armed for the old roster."""
+    t = float(os.environ.get("MXNET_ELASTIC_JOIN_TIMEOUT", "60"))
+    return t if t > 0 else float("inf")
 
 
 def _wire_timeout():
@@ -517,10 +560,18 @@ class DistServer:
         # fault-tolerance state (docs/fault_tolerance.md)
         self._seq_cache = {}  # rank -> OrderedDict(seq -> (cmd, fields))
         self._seq_cv = threading.Condition()  # guards + signals _seq_cache
-        self._dead_ranks = set()  # ranks declared dead after a timeout
+        self._dead_ranks = set()  # ranks evicted from the roster
         self._replays = 0  # dedup'd (replayed) mutations served from cache
+        # elastic membership (wire v3): the roster is derived —
+        # set(range(num_workers)) - dead_ranks — and versioned by a
+        # monotonic epoch; every eviction/admission bumps it
+        self._epoch = 0
+        self._step = 0  # max training-step hint seen in mutating meta
+        self._member_lock = threading.Lock()
+        self._last_rpc = {}  # rank -> (cmd name, seq) of its last mutation
         self._srv_sock = None
         self._conns = []
+        self._member_gauges()
 
     # -- sequence-number dedup ---------------------------------------------
     def _seq_claim(self, rank, seq):
@@ -584,6 +635,98 @@ class DistServer:
             "(%d/%d workers remain)" % (sorted(ranks),
                                         self._live_workers(),
                                         self._num_workers))
+
+    # -- elastic membership (wire v3) --------------------------------------
+    def _roster(self):
+        return sorted(set(range(self._num_workers)) - self._dead_ranks)
+
+    def _membership_info(self):
+        """The dict a fence / JOIN / EPOCH reply carries."""
+        return {"epoch": self._epoch, "roster": self._roster(),
+                "step": self._step}
+
+    def _member_gauges(self):
+        _metrics.gauge(
+            "mxnet_membership_epoch",
+            help="membership epoch of this kvstore shard (bumps on every "
+                 "eviction or admission)").set(self._epoch)
+        _metrics.gauge(
+            "mxnet_ranks_active",
+            help="worker ranks currently in the membership roster"
+        ).set(self._live_workers())
+
+    def _evict_ranks(self, ranks, reason):
+        """Evict ranks from the roster: mark dead, bump the membership
+        epoch, and leave a forensic trail — one ``membership.evict``
+        flight event per rank naming its LAST RPC (command + seq), so a
+        post-mortem dump shows what the lost rank was doing when the
+        deadline fired."""
+        ranks = sorted({int(r) for r in ranks if r is not None}
+                       - self._dead_ranks)
+        if not ranks:
+            return
+        self._mark_dead(ranks)
+        with self._member_lock:
+            self._epoch += 1
+            epoch = self._epoch
+        for r in ranks:
+            last_cmd, last_seq = self._last_rpc.get(r, ("", -1))
+            _flight.record("membership.evict", rank=r, epoch=epoch,
+                           reason=reason, last_rpc=last_cmd,
+                           last_seq=last_seq)
+            _metrics.counter(
+                "mxnet_rank_evictions_total",
+                help="worker ranks evicted from the membership roster",
+                reason=reason).inc()
+        _flight.record("membership.epoch", epoch=epoch, reason=reason,
+                       ranks_active=self._live_workers())
+        self._member_gauges()
+
+    def _do_join(self, rank):
+        """Admit (or re-admit) ``rank`` at the next round boundary.
+
+        Blocks (poll, not wedge: MXNET_ELASTIC_JOIN_TIMEOUT) until no
+        sync round or barrier is mid-flight, then shrinks ``_dead_ranks``
+        (growing ``_num_workers`` for a genuinely new rank), bumps the
+        epoch, and CLEARS the rank's seq-dedup cache — a re-admitted
+        worker is a fresh incarnation restarting its sequence numbers at
+        1, and the dead incarnation's cached replies must not answer it.
+        Idempotent: joining while already in the roster changes nothing.
+        """
+        rank = int(rank)
+        deadline = _time.monotonic() + _join_timeout()
+        while not self._stop.is_set():
+            with self._barrier_cv:
+                mid_barrier = self._barrier_count > 0
+            with self._keys_lock:
+                states = list(self._keys.values())
+            if not mid_barrier and not any(st.pending for st in states):
+                break
+            if _time.monotonic() >= deadline:
+                raise _RoundError(
+                    "join(rank %d): no round boundary within %gs "
+                    "(MXNET_ELASTIC_JOIN_TIMEOUT) — a sync round or "
+                    "barrier is still mid-flight" % (rank, _join_timeout()))
+            _time.sleep(0.005)
+        with self._member_lock:
+            rejoin = rank in self._dead_ranks
+            grew = rank >= self._num_workers
+            self._dead_ranks.discard(rank)
+            if grew:
+                self._num_workers = rank + 1
+            if rejoin or grew:
+                self._epoch += 1
+            with self._seq_cv:
+                self._seq_cache.pop(rank, None)
+            with self._stop_lock:
+                self._stopped_ranks.discard(str(rank))
+            info = self._membership_info()
+        if rejoin or grew:
+            _flight.record("membership.join", rank=rank,
+                           epoch=info["epoch"], rejoin=rejoin,
+                           ranks_active=self._live_workers())
+            self._member_gauges()
+        return info
 
     def _key(self, k):
         with self._keys_lock:
@@ -694,9 +837,31 @@ class DistServer:
                 rank = seq = span = None
                 if cmd in _MUTATING and f and isinstance(f[0], dict) \
                         and "seq" in f[0]:
-                    rank, seq = int(f[0].get("rank", 0)), int(f[0]["seq"])
-                    span = f[0].get("span")  # trace correlation id
+                    meta = f[0]
+                    rank, seq = int(meta.get("rank", 0)), int(meta["seq"])
+                    span = meta.get("span")  # trace correlation id
                     f = f[1:]
+                    if "step" in meta:
+                        self._step = max(self._step, int(meta["step"]))
+                    self._last_rpc[rank] = (_CMD_NAMES.get(cmd, str(cmd)),
+                                            seq)
+                    # membership fencing (wire v3) — BEFORE the seq
+                    # claim, so a fenced request re-sent with a fresh
+                    # epoch and the SAME seq still dedups against an
+                    # already-applied original
+                    epoch = meta.get("epoch")
+                    if epoch is not None and int(epoch) != self._epoch:
+                        _send(sock, CMD_ERR,
+                              dict(self._membership_info(),
+                                   code="stale_epoch"))
+                        continue
+                    if rank in self._dead_ranks:
+                        # an evicted rank must JOIN, not mutate: its
+                        # contributions would corrupt survivor rounds
+                        _send(sock, CMD_ERR,
+                              dict(self._membership_info(),
+                                   code="evicted", rank=rank))
+                        continue
                     replay, cached = self._seq_claim(rank, seq)
                     if replay:
                         # the original may still be mid-apply on another
@@ -774,6 +939,18 @@ class DistServer:
                     self._optimizer = _optimizer_from_config(f[0])
                     self._updater = opt_mod.get_updater(self._optimizer)
                     reply(CMD_OK)
+                elif cmd == CMD_JOIN:
+                    # deliberately NOT in _MUTATING: a joining worker is
+                    # a fresh incarnation whose seq numbers restart, so
+                    # it cannot carry a dedup header — the operation is
+                    # idempotent instead
+                    try:
+                        _send(sock, CMD_OK,
+                              self._do_join(f[0].get("rank", 0)))
+                    except _RoundError as e:
+                        _send(sock, CMD_ERR, str(e))
+                elif cmd == CMD_EPOCH:
+                    _send(sock, CMD_OK, self._membership_info())
                 elif cmd == CMD_PROFILER:
                     # remote profiling (parity: the reference's
                     # kSetProfilerParams server command,
@@ -913,8 +1090,9 @@ class DistServer:
                            "rank(s) %s — %d/%d contributions arrived"
                            % (key, _barrier_timeout(), missing,
                               len(st.pending), self._live_workers()))
-                    if _allow_degraded() and st.pending:
-                        self._mark_dead(missing)
+                    if (_allow_degraded() or _evict_on_timeout()) \
+                            and st.pending:
+                        self._evict_ranks(missing, reason="round_timeout")
                         self._complete_round(st, key)
                         return
                     st.last_error = (gen, msg)
@@ -951,8 +1129,9 @@ class DistServer:
                     msg = ("barrier timed out after %gs "
                            "(MXNET_KVSTORE_BARRIER_TIMEOUT) waiting on "
                            "rank(s) %s" % (_barrier_timeout(), missing))
-                    if _allow_degraded():
-                        self._mark_dead(missing)
+                    if _allow_degraded() or _evict_on_timeout():
+                        self._evict_ranks(missing,
+                                          reason="barrier_timeout")
                         self._barrier_count = 0
                         self._barrier_ranks = set()
                         self._barrier_gen += 1
@@ -1046,6 +1225,12 @@ class DistKVStore(KVStoreBase):
         # (wire protocol v2, docs/fault_tolerance.md)
         self._seq = 0
         self._seq_lock = threading.Lock()
+        # elastic membership (wire v3): last-known membership epoch PER
+        # SERVER SHARD (each DistServer versions its own roster) plus an
+        # optional training-step hint stamped into mutating meta so a
+        # later JOIN can hand re-admitted workers the current step
+        self._epochs = {}
+        self._step_hint = None
         # keys this worker has init()ed — every worker runs the same init
         # sequence, so the local schema mirrors the cluster's and push/
         # pull key sets can be validated BEFORE any RPC (CC605)
@@ -1133,14 +1318,25 @@ class DistKVStore(KVStoreBase):
         span id ("rank:seq"); the server stamps the same id on its
         handler span, so ``telemetry.merge_traces`` correlates this
         worker-side RPC span with the server-side work it caused.
+
+        Membership fencing (wire v3): a typed ``stale_epoch`` CMD_ERR is
+        answered by adopting the epoch/roster the fence carries and
+        re-sending the SAME request (same seq — the server's dedup cache
+        keeps it exactly-once); a bounded resync budget, separate from
+        the transport-retry budget, stops an epoch ping-pong.  A typed
+        ``evicted`` CMD_ERR is terminal: this rank must ``join()``.
         """
         from .. import profiler as _prof
 
         _set_role("worker", rank=self._rank)
         cmd_name = _CMD_NAMES.get(cmd, str(cmd))
         span_id = None
+        meta = None
         if mutating:
-            meta = {"rank": self._rank, "seq": self._next_seq()}
+            meta = {"rank": self._rank, "seq": self._next_seq(),
+                    "epoch": self._epochs.get(server_id, 0)}
+            if self._step_hint is not None:
+                meta["step"] = self._step_hint
             if _prof._recording():
                 span_id = "%d:%d" % (self._rank, meta["seq"])
                 meta["span"] = span_id
@@ -1148,8 +1344,10 @@ class DistKVStore(KVStoreBase):
         t_us0 = _prof._now_us()
         t_rpc0 = _time.perf_counter()
         attempts = _retries() + 1
+        attempt = 0
+        resyncs = 0
         last_err = None
-        for attempt in range(attempts):
+        while attempt < attempts:
             s = None
             try:
                 s = self._sock(server_id)
@@ -1162,10 +1360,32 @@ class DistKVStore(KVStoreBase):
                 _flight.record("kv.recv", cmd=cmd_name, server=server_id,
                                ok=rcmd == CMD_OK)
                 if rcmd != CMD_OK:
+                    err = rfields[0] if rfields else "<no detail>"
+                    if meta is not None and isinstance(err, dict) \
+                            and err.get("code") == "stale_epoch" \
+                            and resyncs < 5:
+                        # membership changed under us: adopt the new
+                        # epoch and replay this request verbatim (NOT a
+                        # transport retry — the server is alive and
+                        # pointed us at the fresh roster)
+                        resyncs += 1
+                        new_epoch = int(err.get("epoch", 0))
+                        self._epochs[server_id] = new_epoch
+                        meta["epoch"] = new_epoch
+                        _flight.record("membership.resync",
+                                       rank=self._rank, server=server_id,
+                                       epoch=new_epoch, cmd=cmd_name)
+                        continue
+                    if meta is not None and isinstance(err, dict) \
+                            and err.get("code") == "evicted":
+                        raise MXNetError(
+                            "kvstore: rank %d was evicted from the "
+                            "membership roster (server %d, epoch %s) — "
+                            "re-admit with join() before mutating again"
+                            % (self._rank, server_id, err.get("epoch")))
                     raise MXNetError(
                         "kvstore rpc (cmd %d, server %d) failed: %s"
-                        % (cmd, server_id,
-                           rfields[0] if rfields else "<no detail>"))
+                        % (cmd, server_id, err))
                 if _metrics.enabled():
                     _metrics.histogram(
                         "mxnet_kvstore_rpc_seconds",
@@ -1183,13 +1403,14 @@ class DistKVStore(KVStoreBase):
                                final=attempt + 1 >= attempts)
                 if s is not None:
                     self._evict(server_id, s)
-                if attempt + 1 >= attempts:
+                attempt += 1
+                if attempt >= attempts:
                     break
                 _metrics.counter(
                     "mxnet_kvstore_rpc_retries_total",
                     help="transport-failure retries (backoff + replay)",
                     command=cmd_name).inc()
-                _backoff_sleep(attempt)
+                _backoff_sleep(attempt - 1)
         _flight.crash_dump("kv_rpc_failed")
         raise MXNetError(
             "kvstore rpc (cmd %d, server %d) failed after %d attempt(s): "
@@ -1384,6 +1605,49 @@ class DistKVStore(KVStoreBase):
                 "mxnet_kvstore_barrier_seconds",
                 help="time this rank waited in a global barrier",
             ).observe(_time.perf_counter() - t0)
+
+    # -- elastic membership (wire v3) --------------------------------------
+    def set_step(self, step):
+        """Stamp the current training step into later mutating meta; the
+        server keeps the max, and JOIN hands it to re-admitted workers so
+        they re-enter the loop at the right step boundary."""
+        self._step_hint = int(step)
+
+    def resync(self):
+        """Refresh this worker's per-shard membership epochs (CMD_EPOCH).
+
+        Normally unnecessary — the stale-epoch fence resyncs mutating
+        RPCs automatically — but useful for observability and for a
+        controller that wants the roster without mutating anything.
+        Returns ``{server_id: {"epoch", "roster", "step"}}``.
+        """
+        infos = {}
+        for sid in range(self._num_servers):
+            rf = self._rpc_to(sid, CMD_EPOCH)
+            info = rf[0] if rf else {}
+            self._epochs[sid] = int(info.get("epoch", 0))
+            infos[sid] = info
+        return infos
+
+    def join(self):
+        """(Re-)admission into a running job (wire v3 scale-up).
+
+        Sends JOIN to every server shard; each admits this rank at its
+        next round boundary (MXNET_ELASTIC_JOIN_TIMEOUT), bumps its
+        membership epoch, and returns the fresh epoch + roster + step.
+        Returns ``{"step", "roster"}`` — the max step across shards, so
+        the caller fast-forwards its loop before pulling resharded state
+        through :meth:`pull`.
+        """
+        step, roster = 0, []
+        for sid in range(self._num_servers):
+            rf = self._rpc_to(sid, CMD_JOIN, {"rank": self._rank})
+            info = rf[0] if rf else {}
+            self._epochs[sid] = int(info.get("epoch", 0))
+            step = max(step, int(info.get("step", 0)))
+            roster = info.get("roster", roster)
+        _flight.record("membership.join", rank=self._rank, step=step)
+        return {"step": step, "roster": roster}
 
     def set_optimizer(self, optimizer):
         """Run the optimizer server-side (parity: SendCommandToServers)."""

@@ -491,17 +491,10 @@ class JitTrainStep:
         self._last_loss = loss
         return loss
 
-    def save_states(self, fname):
-        """Checkpoint weights + optimizer state + update count
-        (resume-able mid-training; Trainer.save_states analogue for the
-        compiled path).  Multi-host: call on every process (each writes
-        identical replicated state; rank-suffix the fname if the
-        filesystem is shared)."""
-        import pickle
-
-        if self._params is None:
-            raise MXNetError("save_states before the first step")
-
+    def _checkpoint_entries(self):
+        """Yield ``(name, global host array, spec)`` for every weight and
+        optimizer-state leaf — each array ONCE in its logical shape, so
+        the file restores onto any mesh (sharding/checkpoint.py)."""
         def fetch(a):
             if self._multiprocess and not a.is_fully_addressable:
                 from jax.experimental import multihost_utils
@@ -509,54 +502,143 @@ class JitTrainStep:
                 a = multihost_utils.process_allgather(a, tiled=True)
             return jax.device_get(a)
 
-        payload = {
-            "weights": [fetch(w) for w in self._weights],
-            "opt_state": [None if s is None
-                          else jax.tree_util.tree_map(fetch, s)
-                          for s in self._opt_state],
-            "t": self._t,
-        }
-        from ..base import atomic_path
+        specs = [sh.spec for sh in self._param_shardings] \
+            if self._mesh is not None else [None] * len(self._params)
+        # entry keys are POSITIONAL (weights/<i>, opt/<i>/<leaf>), not
+        # name-keyed: gluon's auto-naming counter gives the same layer a
+        # different name in every process ("dense0" vs "dense2"), while
+        # parameter ORDER is a function of the net's structure alone;
+        # the human-readable names ride in the index meta instead
+        for i, (w, spec) in enumerate(zip(self._weights, specs)):
+            yield "weights/%d" % i, fetch(w), spec
+        for i, (st, spec) in enumerate(zip(self._opt_state, specs)):
+            if st is None:
+                continue
+            for j, leaf in enumerate(jax.tree_util.tree_leaves(st)):
+                yield "opt/%d/%d" % (i, j), fetch(leaf), spec
 
-        with atomic_path(fname) as tmp:
-            with open(tmp, "wb") as f:
-                pickle.dump(payload, f)
+    def save_states(self, fname):
+        """Checkpoint weights + optimizer state + update count
+        (resume-able mid-training; Trainer.save_states analogue for the
+        compiled path) in the mesh-shape-agnostic MXGC1 format: each
+        array stored once, globally, with its PartitionSpec and a
+        per-entry checksum — restore onto ANY mesh whose axes divide the
+        spec.  Multi-host: call on every process (each writes identical
+        global state; rank-suffix the fname if the filesystem is
+        shared)."""
+        from .. import sharding as _shd
+
+        if self._params is None:
+            raise MXNetError("save_states before the first step")
+        meta = {"kind": "jit_train_step", "t": int(self._t),
+                "param_names": [p.name for p in self._params],
+                "opt_leaves": [0 if st is None else len(
+                    jax.tree_util.tree_leaves(st))
+                    for st in self._opt_state]}
+        if self._mesh is not None:
+            meta["mesh_axes"] = {str(k): int(self._mesh.shape[k])
+                                 for k in self._mesh.axis_names}
+        _shd.save_global(fname, self._checkpoint_entries(), meta=meta)
 
     def load_states(self, fname):
-        """Restore a save_states checkpoint (same net/optimizer config).
+        """Restore a save_states checkpoint (same net/optimizer config)
+        onto the CURRENT placement — the checkpoint's mesh shape is
+        irrelevant (a dp=8 file restores at dp=4/dp=6/single-device:
+        global arrays are re-placed through this step's shardings).
 
         Requires placement to exist — run ONE step (any batch) first so
         shapes/shardings are established, then load; the loaded state
-        fully overwrites that step's effects."""
-        import pickle
+        fully overwrites that step's effects.  Legacy pickled
+        checkpoints still load (sniffed by magic); corruption in either
+        format surfaces as MXNetError, never a raw unpickling error."""
+        from .. import sharding as _shd
 
-        with open(fname, "rb") as f:
-            payload = pickle.load(f)
         if self._params is None:
             raise MXNetError(
                 "load_states needs initialized placement: run one step, "
                 "or call after net.initialize + a step")
+        if _shd.is_global_checkpoint(fname):
+            entries, meta = _shd.load_global(fname)
+            weights, opt_state = self._states_from_entries(fname, entries)
+            t = int(meta.get("t", 0))
+        else:
+            weights, opt_state, t = self._load_legacy_states(fname)
         if self._mesh is not None:
             put = (self._put_global if self._multiprocess
                    else jax.device_put)
             self._weights = [put(w, s) for w, s in
-                             zip(payload["weights"],
-                                 self._param_shardings)]
+                             zip(weights, self._param_shardings)]
             self._opt_state = [
                 None if st is None else jax.tree_util.tree_map(
                     lambda a, sh=sh: put(a, sh), st)
-                for st, sh in zip(payload["opt_state"],
-                                  self._param_shardings)]
+                for st, sh in zip(opt_state, self._param_shardings)]
         else:
             dev = self._device
-            self._weights = [jax.device_put(w, dev)
-                             for w in payload["weights"]]
+            self._weights = [jax.device_put(w, dev) for w in weights]
             self._opt_state = [
                 None if st is None else jax.tree_util.tree_map(
                     lambda a: jax.device_put(a, dev), st)
-                for st in payload["opt_state"]]
-        self._t = int(payload["t"])
+                for st in opt_state]
+        self._t = t
         self._opt.num_update = self._t
+
+    def _states_from_entries(self, fname, entries):
+        """Rebuild (weights list, opt_state trees) from MXGC1 entries,
+        validating logical shapes against the live placement."""
+        weights = []
+        for i, p in enumerate(self._params):
+            name = "weights/%d" % i
+            ent = entries.get(name)
+            if ent is None:
+                raise MXNetError(
+                    "checkpoint %s: missing entry %r (param %s) — the "
+                    "file was written by a different net"
+                    % (fname, name, p.name))
+            if tuple(ent["array"].shape) != tuple(p.shape):
+                raise MXNetError(
+                    "checkpoint %s: entry %r has logical shape %s, the "
+                    "live parameter %s wants %s"
+                    % (fname, name, ent["array"].shape, p.name,
+                       tuple(p.shape)))
+            weights.append(ent["array"])
+        opt_state = []
+        for i, st in enumerate(self._opt_state):
+            if st is None:
+                opt_state.append(None)
+                continue
+            treedef = jax.tree_util.tree_structure(st)
+            leaves = []
+            for j in range(treedef.num_leaves):
+                name = "opt/%d/%d" % (i, j)
+                ent = entries.get(name)
+                if ent is None:
+                    raise MXNetError(
+                        "checkpoint %s: missing optimizer entry %r "
+                        "(optimizer config changed?)" % (fname, name))
+                leaves.append(ent["array"])
+            opt_state.append(jax.tree_util.tree_unflatten(treedef,
+                                                          leaves))
+        return weights, opt_state
+
+    @staticmethod
+    def _load_legacy_states(fname):
+        """Pre-MXGC1 pickled payload; unpickling failures surface as
+        MXNetError (a torn legacy file must not raise a raw
+        UnpicklingError)."""
+        import pickle
+
+        try:
+            with open(fname, "rb") as f:
+                payload = pickle.load(f)
+            return (payload["weights"], payload["opt_state"],
+                    int(payload["t"]))
+        except MXNetError:
+            raise
+        except Exception as e:  # noqa: BLE001 — any torn-pickle shape
+            raise MXNetError(
+                "checkpoint %s is neither MXGC1 nor a loadable legacy "
+                "pickle (%s: %s) — the file is corrupt or truncated"
+                % (fname, type(e).__name__, e))
 
     def save_executable(self, fname):
         """AOT-export the compiled train step (compile_cache.py bundle).

@@ -156,8 +156,31 @@ class LlamaServer:
             "device_bytes_by_origin": by_origin,
             "peak_device_bytes": _memdump.peak_bytes(),
             "flight": _flight.status(),
+            "membership": self._membership_health(),
         })
         return st
+
+    @staticmethod
+    def _membership_health():
+        """Elastic-membership view from the metrics registry (zeros when
+        this process hosts no kvstore shard): the prober that pages on
+        queue depth also sees roster shrink without scraping /metrics."""
+        from ..telemetry import metrics as _metrics
+
+        snap = _metrics.snapshot()
+
+        def val(fam, default=0):
+            series = snap.get(fam, {}).get("series", [])
+            return series[0].get("value", default) if series else default
+
+        return {
+            "epoch": int(val("mxnet_membership_epoch")),
+            "ranks_active": int(val("mxnet_ranks_active")),
+            "evictions_total": int(sum(
+                s.get("value", 0) for s in
+                snap.get("mxnet_rank_evictions_total",
+                         {}).get("series", []))),
+        }
 
     # -- naive baseline (bench comparison) --------------------------------
     def static_generate(self, requests):

@@ -25,3 +25,7 @@ from .spec import (  # noqa: F401
 from .verify import enabled as verify_enabled  # noqa: F401
 from .verify import maybe_verify, verify_spec  # noqa: F401
 from .reshard import record_reshard  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    is_global_checkpoint, load_global, save_global,
+    spec_from_wire, spec_to_wire,
+)

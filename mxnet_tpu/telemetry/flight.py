@@ -7,9 +7,12 @@ failed was pushed long before the exception surfaces, and a serving
 request's life spans queue, prefill and dozens of decode flushes.  The
 flight recorder keeps the last N framework events (engine
 push/flush/sync, kvstore RPCs, fault injections, serve scheduler
-transitions, memory tags) in a preallocated ring and dumps them to disk
-when the process dies, so a post-mortem can read what *actually*
-happened instead of where the exception happened to surface.
+transitions, memory tags, elastic-membership transitions —
+``membership.evict`` / ``membership.join`` / ``membership.epoch`` /
+``membership.resync``, each eviction naming the lost rank's last RPC)
+in a preallocated ring and dumps them to disk when the process dies, so
+a post-mortem can read what *actually* happened instead of where the
+exception happened to surface.
 
 Design constraints:
 

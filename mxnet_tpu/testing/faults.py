@@ -48,6 +48,15 @@ Rule fields (all optional except ``site`` and ``action``):
     simulates an op failure / a crashing participant
   - ``"kill_server"`` call ``ctx['server'].shutdown()`` then raise
     ``ConnectionResetError`` — the whole server process "dies" mid-round
+  - ``"kill_worker"`` raise :class:`WorkerKilled` carrying the victim's
+    ``rank`` (from the thread ctx) and the rule's optional
+    ``rejoin_after`` — the elastic-training harness catches it, drops
+    the rank out of the round, and (if ``rejoin_after=N`` is set)
+    re-admits it N rounds later via ``DistKVStore.join()``; the rule is
+    pure data, so the whole kill/rejoin schedule replays from the seed
+
+* ``rejoin_after`` — (``kill_worker`` only) rounds to stay dead before
+  the harness re-admits the killed rank; ``null``/absent = stay dead.
 
 Every firing is appended to ``plan.events`` (site, action, rule index,
 ordinal, scalar ctx), so a test can assert the *exact* injection
@@ -66,6 +75,20 @@ from ..telemetry import flight as _flight
 class FaultInjected(RuntimeError):
     """Raised by ``action: "raise"`` rules (and used as the marker type
     for injected op failures in ``Engine.push`` chaos tests)."""
+
+
+class WorkerKilled(FaultInjected):
+    """Raised by ``action: "kill_worker"``: this worker "dies" mid-round.
+
+    Carries ``rank`` (the victim, from the thread ctx tagged by
+    ``set_role``) and ``rejoin_after`` (the rule's re-admission delay in
+    rounds, or None) so the chaos harness can schedule a deterministic
+    ``DistKVStore.join()`` without re-parsing the plan."""
+
+    def __init__(self, message, rank=None, rejoin_after=None):
+        super().__init__(message)
+        self.rank = rank
+        self.rejoin_after = rejoin_after
 
 
 _tls = threading.local()
@@ -195,6 +218,14 @@ class FaultPlan:
             if server is not None:
                 server.shutdown()
             raise ConnectionResetError("fault-injected server kill")
+        if act == "kill_worker":
+            rank = ctx.get("rank")
+            rejoin = rule.get("rejoin_after")
+            raise WorkerKilled(
+                "fault-injected worker kill (rank %s%s)"
+                % (rank, "" if rejoin is None
+                   else ", rejoins after %d round(s)" % int(rejoin)),
+                rank=rank, rejoin_after=rejoin)
         raise ValueError("unknown fault action %r" % (act,))
 
 

@@ -24,3 +24,10 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the budgeted tier-1 run (-m 'not slow'); "
+        "the CI chaos jobs run slow-marked suites explicitly")

@@ -1,0 +1,516 @@
+"""Elastic training: membership epochs, eviction, re-admission, and
+mesh-shape-agnostic checkpoints (docs/fault_tolerance.md, wire v3).
+
+The chaos matrix here is the ISSUE 12 acceptance scenario: train at
+dp=8, kill 2 ranks mid-round via a seeded FaultPlan (``kill_worker``
+with ``rejoin_after``), survivors complete the round degraded after a
+timeout eviction (ONE epoch bump), checkpoint, re-admit both ranks via
+JOIN (two more bumps), and final loss stays on trend vs an
+uninterrupted baseline.  CPU-only, in-process cluster (threads),
+deterministic under ``MXNET_CHAOS_SEED``.
+"""
+import os
+import struct
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, parallel
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.parallel.dist_kvstore import (
+    CMD_PUSH, DistKVStore, DistServer, _server_port)
+from mxnet_tpu.sharding import Mesh, P
+from mxnet_tpu.telemetry import flight
+from mxnet_tpu.testing import faults
+from mxnet_tpu.testing.faults import FaultPlan, WorkerKilled
+
+SEED = int(os.environ.get("MXNET_CHAOS_SEED", "1337"))
+
+_PORT_SEQ = [24310]
+
+
+def _probe_free(root_port, num_servers):
+    import socket as _socket
+
+    for sid in range(num_servers):
+        s = _socket.socket()
+        try:
+            s.bind(("", _server_port(root_port, sid)))
+        except OSError:
+            return False
+        finally:
+            s.close()
+    return True
+
+
+def _start_cluster(num_workers, sync=True, num_servers=1):
+    import random
+
+    for _ in range(50):
+        _PORT_SEQ[0] += 10
+        root_port = _PORT_SEQ[0]
+        if _probe_free(root_port, num_servers):
+            break
+        _PORT_SEQ[0] += random.randint(10, 200)
+    else:
+        raise RuntimeError("no free port range found")
+    servers = []
+    for sid in range(num_servers):
+        srv = DistServer(_server_port(root_port, sid), num_workers,
+                         sync=sync)
+        t = threading.Thread(target=srv.run, daemon=True)
+        t.start()
+        servers.append(srv)
+    time.sleep(0.2)
+
+    def make_worker(rank):
+        os.environ["DMLC_PS_ROOT_PORT"] = str(root_port)
+        os.environ["DMLC_NUM_WORKER"] = str(num_workers)
+        os.environ["DMLC_NUM_SERVER"] = str(num_servers)
+        kv = DistKVStore("dist_sync" if sync else "dist_async")
+        kv._rank = rank
+        return kv
+
+    return servers, make_worker
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    dmlc = {k: os.environ.get(k) for k in
+            ("DMLC_PS_ROOT_PORT", "DMLC_NUM_WORKER", "DMLC_NUM_SERVER")}
+    yield
+    faults.uninstall()
+    for k, v in dmlc.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+# ---------------------------------------------------------------------------
+# epoch fencing + resync
+# ---------------------------------------------------------------------------
+def test_stale_epoch_fence_resyncs_client_transparently():
+    """A mutating RPC carrying a stale epoch is fenced with a typed
+    CMD_ERR; the client adopts the fresh epoch and replays the SAME
+    request — the caller never sees an error."""
+    flight.reset()
+    servers, make_worker = _start_cluster(1, sync=False)
+    kv = make_worker(0)
+    kv.init("w", nd.zeros((3,)))
+    # membership changed behind this client's back (epoch 0 -> 5)
+    servers[0]._epoch = 5
+    kv.push("w", nd.array(np.ones((3,), np.float32)))  # fenced, resynced
+    assert kv._epochs[0] == 5
+    out = nd.zeros((3,))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.ones(3), rtol=1e-6)
+    resyncs = [e for e in flight.events()
+               if e["kind"] == "membership.resync"]
+    assert resyncs and resyncs[-1]["epoch"] == 5
+    kv.stop()
+
+
+def test_evicted_rank_gets_typed_error_and_join_readmits():
+    """An evicted rank's mutation fails with a clear 'evicted' error;
+    a fresh incarnation JOINs, the epoch bumps, and full-roster rounds
+    resume."""
+    flight.reset()
+    servers, make_worker = _start_cluster(2, sync=True)
+    srv = servers[0]
+    kv0, kv1 = make_worker(0), make_worker(1)
+
+    def par(fn0, fn1):
+        t0 = threading.Thread(target=fn0)
+        t1 = threading.Thread(target=fn1)
+        t0.start(), t1.start()
+        t0.join(), t1.join()
+
+    par(lambda: kv0.init("w", nd.zeros((2,))),
+        lambda: kv1.init("w", nd.zeros((2,))))
+    srv._evict_ranks([1], reason="test")
+    assert srv._epoch == 1 and srv._roster() == [0]
+    # the dead incarnation: first fenced (stale epoch), then refused
+    with pytest.raises(MXNetError, match="evicted.*join"):
+        kv1.push("w", nd.array(np.ones((2,), np.float32)))
+    # a fresh incarnation re-admits at the round boundary
+    kv1b = make_worker(1)
+    info = kv1b.join()
+    assert info["roster"] == [0, 1]
+    assert srv._epoch == 2 and srv._roster() == [0, 1]
+    # full-roster sync round works again (no optimizer: value = sum)
+    par(lambda: kv0.push("w", nd.array(np.full((2,), 2.0, np.float32))),
+        lambda: kv1b.push("w", nd.array(np.full((2,), 3.0, np.float32))))
+    out = nd.zeros((2,))
+    kv0.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), [5.0, 5.0], rtol=1e-6)
+    evs = [e["kind"] for e in flight.events()]
+    assert "membership.evict" in evs and "membership.join" in evs
+    par(kv0.stop, kv1b.stop)
+
+
+def test_join_is_idempotent_and_nonmutating():
+    """JOINing while already in the roster changes nothing (no epoch
+    bump) — a retried JOIN after a lost reply is harmless."""
+    servers, make_worker = _start_cluster(1, sync=True)
+    kv = make_worker(0)
+    kv.init("w", nd.zeros((2,)))
+    before = servers[0]._epoch
+    kv.join()
+    kv.join()
+    assert servers[0]._epoch == before
+    kv.stop()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance chaos matrix: kill 2 of 8, degraded round, rejoin
+# ---------------------------------------------------------------------------
+N_RANKS = 8
+DIM = 4
+TARGET = np.linspace(1.0, 2.5, DIM).astype(np.float32)
+KILL_ROUND = 2
+REJOIN_AFTER = 2
+N_ROUNDS = 6
+LR = 0.8
+
+
+def _run_elastic_training(chaos, monkeypatch, tmp_path=None):
+    """One controller-driven training run; returns (losses, servers,
+    kv handles).  ``chaos=True`` installs the seeded kill/rejoin plan."""
+    monkeypatch.setenv("MXNET_KVSTORE_BARRIER_TIMEOUT", "2")
+    monkeypatch.setenv("MXNET_KVSTORE_EVICT_ON_TIMEOUT", "1")
+    if chaos:
+        faults.install(FaultPlan(seed=SEED, rules=[
+            {"site": "send", "action": "kill_worker",
+             "match": {"cmd": CMD_PUSH, "rank": r},
+             "after": KILL_ROUND, "times": 1,
+             "rejoin_after": REJOIN_AFTER}
+            for r in (1, 2)]))
+    servers, make_worker = _start_cluster(N_RANKS, sync=True)
+    kvs = {r: make_worker(r) for r in range(N_RANKS)}
+
+    def par(fns):
+        ts = [threading.Thread(target=fn) for fn in fns]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+
+    par([lambda kv=kv: kv.init("w", nd.zeros((DIM,)))
+         for kv in kvs.values()])
+    opt = mx.optimizer.create("sgd", learning_rate=LR)
+    par([lambda kv=kv: kv.set_optimizer(opt) for kv in kvs.values()])
+
+    dead = {}     # rank -> round it may rejoin at (None = never)
+    losses = []
+
+    def worker_round(rank, rnd):
+        kv = kvs[rank]
+        try:
+            kv.set_step(rnd)
+            w = nd.zeros((DIM,))
+            kv.pull("w", out=w)
+            g = (w.asnumpy() - TARGET) / N_RANKS
+            kv.push("w", nd.array(g))
+        except WorkerKilled as e:
+            dead[rank] = (rnd + e.rejoin_after
+                          if e.rejoin_after is not None else None)
+
+    for rnd in range(N_ROUNDS):
+        # deterministic re-admission: rejoin_after rounds after the kill
+        for rank, at in sorted(dead.items()):
+            if at is not None and rnd >= at:
+                kvs[rank] = _rejoin(make_worker, rank)
+                del dead[rank]
+        live = [r for r in range(N_RANKS) if r not in dead]
+        par([lambda r=r: worker_round(r, rnd) for r in live])
+        w = nd.zeros((DIM,))
+        kvs[live[0]].pull("w", out=w)
+        losses.append(float(((w.asnumpy() - TARGET) ** 2).sum()))
+        if chaos and tmp_path is not None and rnd == KILL_ROUND + 1:
+            # mid-scenario checkpoint while degraded: global format,
+            # restores bitwise (the acceptance "checkpoint" step)
+            ck = str(tmp_path / "degraded.mxgc")
+            mx.sharding.save_global(
+                ck, [("w", w.asnumpy(), P())], meta={"round": rnd})
+            entries, meta = mx.sharding.load_global(ck)
+            assert meta["round"] == rnd
+            assert np.array_equal(entries["w"]["array"], w.asnumpy())
+    par([lambda kv=kv: kv.stop() for r, kv in kvs.items()
+         if r not in dead])
+    return losses, servers, kvs
+
+
+def _rejoin(make_worker, rank):
+    kv = make_worker(rank)
+    info = kv.join()
+    assert rank in info["roster"]
+    return kv
+
+
+@pytest.mark.slow  # full chaos matrix: CI elastic-chaos step runs it
+@pytest.mark.filterwarnings("ignore::UserWarning")
+def test_elastic_chaos_kill_two_of_eight_then_rejoin(monkeypatch,
+                                                     tmp_path):
+    flight.reset()
+    chaos_losses, servers, _ = _run_elastic_training(
+        True, monkeypatch, tmp_path)
+    srv = servers[0]
+
+    # ONE epoch bump covers both ranks lost in the same round timeout;
+    # each JOIN bumps once more
+    assert srv._epoch == 3
+    assert srv._roster() == list(range(N_RANKS))
+    assert srv._dead_ranks == set()
+
+    evs = flight.events()
+    evictions = [e for e in evs if e["kind"] == "membership.evict"]
+    assert sorted(e["rank"] for e in evictions) == [1, 2]
+    # forensics: each eviction names the lost rank's LAST RPC
+    assert all(e["last_rpc"] == "push" and e["last_seq"] > 0
+               for e in evictions)
+    joins = [e for e in evs if e["kind"] == "membership.join"
+             and "rejoin" in e]
+    assert sorted(e["rank"] for e in joins) == [1, 2]
+    assert all(e["rejoin"] for e in joins)
+    kills = [e for e in evs if e["kind"] == "fault"
+             and e["action"] == "kill_worker"]
+    assert sorted(k["rank"] for k in kills) == [1, 2]
+    # survivors resynced through the fence, not through errors
+    assert any(e["kind"] == "membership.resync" for e in evs)
+
+    # JOIN handed the re-admitted ranks the current step hint
+    # (survivors stamped set_step(rnd) each round; the join happened at
+    # the top of round KILL_ROUND + REJOIN_AFTER)
+    assert srv._step == N_ROUNDS - 1
+
+    # loss stays on trend: strictly decreasing every round (degraded
+    # rounds descend at 6/8 rate, never regress) ...
+    assert all(b < a for a, b in zip(chaos_losses, chaos_losses[1:]))
+
+    # ... and lands near the uninterrupted baseline
+    faults.uninstall()
+    flight.reset()
+    base_losses, _, _ = _run_elastic_training(False, monkeypatch)
+    assert all(b < a for a, b in zip(base_losses, base_losses[1:]))
+    # two 6/8-rate rounds cost (0.4/0.2)^2 in distance = 16x in loss;
+    # allow slack but stay the same order of trend
+    assert chaos_losses[-1] <= base_losses[-1] * 100 + 1e-8
+    assert chaos_losses[-1] < chaos_losses[0] * 1e-2
+
+
+@pytest.mark.slow  # full chaos matrix: CI elastic-chaos step runs it
+@pytest.mark.filterwarnings("ignore::UserWarning")
+def test_elastic_flight_dump_names_evicted_ranks_last_rpc(
+        monkeypatch, tmp_path):
+    """The CI elastic-chaos artifact contract: a flight dump written
+    after an eviction carries membership.evict events naming the lost
+    rank's last RPC, and tools/mxflight.py can filter them."""
+    flight.reset()
+    dump_path = tmp_path / "flight-elastic.json"
+    monkeypatch.setattr(flight, "_armed_path", str(dump_path))
+    losses, servers, _ = _run_elastic_training(True, monkeypatch,
+                                               tmp_path)
+    flight.dump(str(dump_path), reason="elastic_chaos")
+
+    doc = flight.load(str(dump_path))
+    assert doc["meta"]["reason"] == "elastic_chaos"
+    evictions = [e for e in doc["events"]
+                 if e["kind"] == "membership.evict"]
+    assert sorted(e["rank"] for e in evictions) == [1, 2]
+    for e in evictions:
+        assert e["last_rpc"] == "push"
+        assert e["reason"] == "round_timeout"
+
+    import subprocess
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "mxflight.py"),
+         "show", str(dump_path), "--kind", "membership"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "membership.evict" in r.stdout
+    assert "last_rpc=push" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# mesh-shape-agnostic checkpoints
+# ---------------------------------------------------------------------------
+def _mesh_step(dp):
+    mx.random.seed(7)
+    net = gluon.nn.Dense(8, in_units=8)
+    net.initialize(mx.init.Xavier())
+    return parallel.JitTrainStep(
+        net, gluon.loss.L2Loss(), "adam", {"learning_rate": 0.05},
+        mesh=Mesh({"data": dp}),
+        param_rule=lambda name, shape: P("data"))
+
+
+def _host_states(step):
+    import jax
+
+    ws = [np.asarray(jax.device_get(w)) for w in step._weights]
+    leaves = [np.asarray(jax.device_get(leaf))
+              for st in step._opt_state if st is not None
+              for leaf in jax.tree_util.tree_leaves(st)]
+    return ws, leaves
+
+
+@pytest.mark.slow  # acceptance matrix: CI elastic-chaos step runs it
+def test_checkpoint_restores_bitwise_across_mesh_shapes(tmp_path):
+    """A dp=8 save_states checkpoint restores bitwise-correct logical
+    values onto 4-way and 8-way meshes (the sharded dim divides both)."""
+    rs = np.random.RandomState(5)
+    x = rs.randn(8, 8).astype(np.float32)
+    y = rs.randn(8, 8).astype(np.float32)
+
+    a = _mesh_step(8)
+    for _ in range(3):
+        a.step(x, y)
+    ckpt = str(tmp_path / "dp8.mxgc")
+    a.save_states(ckpt)
+    ws_a, opt_a = _host_states(a)
+
+    assert mx.sharding.is_global_checkpoint(ckpt)
+    entries, meta = mx.sharding.load_global(ckpt)
+    assert meta["t"] == 3 and meta["mesh_axes"] == {"data": 8}
+    # stored ONCE in logical shape, with the spec (not per-rank shards)
+    assert tuple(entries["weights/0"]["array"].shape) in ((8, 8), (8,))
+    assert tuple(entries["weights/0"]["spec"]) == ("data",)
+
+    for dp in (4, 8):
+        b = _mesh_step(dp)
+        b.step(x, y)  # establish placement; overwritten by the load
+        b.load_states(ckpt)
+        assert b._t == 3
+        ws_b, opt_b = _host_states(b)
+        for wa, wb in zip(ws_a, ws_b):
+            assert np.array_equal(wa, wb), "dp=%d weights drifted" % dp
+        for la, lb in zip(opt_a, opt_b):
+            assert np.array_equal(la, lb), \
+                "dp=%d optimizer state drifted" % dp
+
+
+@pytest.mark.slow  # resume-on-smaller-mesh e2e: CI elastic-chaos runs it
+def test_dp_checkpoint_resumes_training_on_smaller_mesh(tmp_path):
+    """Resume-at-dp=4 from a dp=8 checkpoint TRAINS equivalently: the
+    next steps match the uninterrupted dp=8 run (same global batch)."""
+    rs = np.random.RandomState(9)
+    x = rs.randn(8, 8).astype(np.float32)
+    y = rs.randn(8, 8).astype(np.float32)
+
+    a = _mesh_step(8)
+    for _ in range(2):
+        a.step(x, y)
+    ckpt = str(tmp_path / "resume.mxgc")
+    a.save_states(ckpt)
+    for _ in range(3):
+        a.step(x, y)
+
+    c = _mesh_step(4)
+    c.step(x, y)
+    c.load_states(ckpt)
+    for _ in range(3):
+        c.step(x, y)
+    ws_a, _ = _host_states(a)
+    ws_c, _ = _host_states(c)
+    for wa, wc in zip(ws_a, ws_c):
+        np.testing.assert_allclose(wa, wc, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# corruption detection (per-entry checksums)
+# ---------------------------------------------------------------------------
+def _data_start(fname):
+    with open(fname, "rb") as f:
+        magic = f.read(6)
+        (index_len,) = struct.unpack("<Q", f.read(8))
+    return 6 + 8 + index_len
+
+
+def _simple_step():
+    mx.random.seed(3)
+    net = gluon.nn.Dense(3, in_units=5)
+    net.initialize(mx.init.Xavier())
+    step = parallel.JitTrainStep(net, gluon.loss.L2Loss(), "adam",
+                                 {"learning_rate": 0.05})
+    rs = np.random.RandomState(1)
+    step.step(rs.randn(4, 5).astype(np.float32),
+              rs.randn(4, 3).astype(np.float32))
+    return step
+
+
+def test_bit_flipped_checkpoint_raises_naming_the_entry(tmp_path):
+    step = _simple_step()
+    ckpt = str(tmp_path / "flip.mxgc")
+    step.save_states(ckpt)
+    raw = bytearray(open(ckpt, "rb").read())
+    raw[_data_start(ckpt) + 2] ^= 0xFF  # one flipped byte in weights/0
+    open(ckpt, "wb").write(bytes(raw))
+    with pytest.raises(MXNetError, match="'weights/0'.*checksum"):
+        step.load_states(ckpt)
+
+
+def test_truncated_checkpoint_raises_naming_the_entry(tmp_path):
+    step = _simple_step()
+    ckpt = str(tmp_path / "trunc.mxgc")
+    step.save_states(ckpt)
+    raw = open(ckpt, "rb").read()
+    open(ckpt, "wb").write(raw[:len(raw) - 7])  # cut the LAST entry short
+    with pytest.raises(MXNetError, match="truncated"):
+        step.load_states(ckpt)
+
+
+def test_torn_legacy_pickle_raises_mxneterror(tmp_path):
+    step = _simple_step()
+    bad = tmp_path / "torn.ckpt"
+    bad.write_bytes(b"\x80\x04\x95 torn mid-write")
+    with pytest.raises(MXNetError, match="corrupt or truncated"):
+        step.load_states(str(bad))
+
+
+def test_trainer_checkpoint_checksummed_roundtrip(tmp_path):
+    """Trainer.save_states writes MXGC1 now: roundtrips exactly, and a
+    bit flip is detected with the entry named."""
+    def make():
+        net = gluon.nn.Dense(1, in_units=3, use_bias=False)
+        net.initialize(mx.init.Constant(1.0))
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9})
+        return net, tr
+
+    net, tr = make()
+    for _ in range(2):
+        x = mx.nd.array([[1.0, -2.0, 3.0]])
+        with mx.autograd.record():
+            y = net(x)
+        y.backward()
+        tr.step(1)
+    fname = str(tmp_path / "trainer.mxgc")
+    tr.save_states(fname)
+    assert mx.sharding.is_global_checkpoint(fname)
+
+    import jax
+    want = [np.asarray(jax.device_get(leaf))
+            for st in tr._states if st is not None
+            for leaf in jax.tree_util.tree_leaves(st)]
+    net2, tr2 = make()
+    tr2.load_states(fname)
+    got = [np.asarray(jax.device_get(leaf))
+           for st in tr2._states if st is not None
+           for leaf in jax.tree_util.tree_leaves(st)]
+    assert len(want) == len(got)
+    for a, b in zip(want, got):
+        assert np.array_equal(a, b)
+    assert tr2._optimizer.num_update == tr._optimizer.num_update
+
+    raw = bytearray(open(fname, "rb").read())
+    raw[_data_start(fname) + 1] ^= 0x10
+    open(fname, "wb").write(bytes(raw))
+    _, tr3 = make()
+    with pytest.raises(MXNetError, match="'state/0/0'.*checksum"):
+        tr3.load_states(fname)

@@ -182,6 +182,44 @@ def test_dead_worker_mid_round_names_missing_rank(monkeypatch,
     servers[0].shutdown()
 
 
+def test_dead_worker_evicted_on_timeout_survivor_completes(
+        monkeypatch, _fast_retries):
+    """Same mid-round death as above, but with
+    MXNET_KVSTORE_EVICT_ON_TIMEOUT=1 the deadline EVICTS the dead rank
+    (epoch bump) and the survivor's round completes instead of erroring
+    — the elastic-membership half of the deadline story; the full
+    kill/rejoin matrix lives in tests/test_elastic.py."""
+    monkeypatch.setenv("MXNET_KVSTORE_BARRIER_TIMEOUT", "1")
+    monkeypatch.setenv("MXNET_KVSTORE_EVICT_ON_TIMEOUT", "1")
+    faults.install(FaultPlan(seed=SEED, rules=[
+        {"site": "send", "action": "raise", "times": 1,
+         "match": {"role": "worker", "rank": 1, "cmd": CMD_PUSH},
+         "message": "rank 1 preempted mid-round"}]))
+    servers, make_worker = _start_cluster(2, sync=True)
+    kvs = [make_worker(r) for r in range(2)]
+    errors = [None, None]
+
+    def worker(rank):
+        try:
+            kvs[rank].init("w", nd.zeros((2,)))
+            kvs[rank].push("w", nd.array(np.ones((2,), np.float32)))
+        except (MXNetError, FaultInjected) as e:
+            errors[rank] = e
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert isinstance(errors[1], FaultInjected)  # the injected death
+    assert errors[0] is None, errors[0]  # the survivor's round COMPLETED
+    assert servers[0]._epoch == 1 and servers[0]._roster() == [0]
+    out = nd.zeros((2,))
+    kvs[0].pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.ones(2), rtol=1e-6)
+    kvs[0].stop()
+
+
 def test_server_killed_mid_round_fails_fast(monkeypatch, _fast_retries):
     plan = faults.install(FaultPlan(seed=SEED, rules=[
         {"site": "server_handle", "action": "kill_server", "times": 1,

@@ -3,9 +3,15 @@
 
 A flight dump is the black box a process leaves behind when it dies (or
 when ``mx.telemetry.flight.dump()`` is called): the last N engine
-push/flush/sync events, kvstore RPCs, fault injections and serve
-scheduler transitions, with monotonic sequence numbers and a wall-clock
-anchor.  Arm crash dumps with ``MXNET_FLIGHT_DUMP=flight-{rank}.json``.
+push/flush/sync events, kvstore RPCs, fault injections, serve
+scheduler transitions and elastic-membership changes, with monotonic
+sequence numbers and a wall-clock anchor.  Arm crash dumps with
+``MXNET_FLIGHT_DUMP=flight-{rank}.json``.
+
+Post-mortem of an elastic job: ``show dump.json --kind membership``
+filters to the eviction/join/epoch timeline — each ``membership.evict``
+names the lost rank's last RPC (``last_rpc``/``last_seq``), which is
+usually the first question after a scale-down.
 
 Subcommands:
 
