@@ -716,6 +716,16 @@ def _run_cold_start(workload):
 _SERVE_N_REQUESTS = 64
 _SERVE_WORKLOAD = dict(rate_rps=2000.0, prompt_range=(2, 30),
                        max_new_range=(2, 64), vocab_size=512, seed=0)
+# a 64-request replay is sub-second on CPU — shorter than the
+# multi-second noisy windows shared-CPU hosts inject.  Every serve
+# throughput number is therefore the median of this many identical
+# replays, which keeps the workload definition fixed while damping the
+# host noise.
+_SERVE_REPLAYS = 3
+
+
+def _median(vals):
+    return sorted(vals)[len(vals) // 2]
 
 
 def _serve_export(path):
@@ -746,7 +756,9 @@ def _serve_probe(path):
     Static: replay the identical request set through static_generate
     (fixed groups, no mid-flight admission, each group at the pace of
     its slowest member) on the same runner and arena — the measured gap
-    is pure scheduling.  Also reports the process's live-compile count:
+    is pure scheduling.  Both sides report the median of
+    ``_SERVE_REPLAYS`` identical replays (see the constant's comment).
+    Also reports the process's live-compile count:
     nonzero means the AOT warm start regressed and the throughput
     numbers are polluted by jit time.
     """
@@ -754,26 +766,31 @@ def _serve_probe(path):
     from mxnet_tpu.telemetry import metrics as telemetry_metrics
 
     srv = serve.LlamaServer(path).start()
-    wl = serve.poisson_workload(_SERVE_N_REQUESTS, **_SERVE_WORKLOAD)
-    reqs, wall = serve.drive_workload(srv, wl, timeout=600)
+    rates = []
+    for _ in range(_SERVE_REPLAYS):
+        wl = serve.poisson_workload(_SERVE_N_REQUESTS, **_SERVE_WORKLOAD)
+        reqs, wall = serve.drive_workload(srv, wl, timeout=600)
+        done = [r for r in reqs if r.error is None]
+        rates.append(sum(len(r.tokens) for r in done) / wall)
     srv.stop()
-    done = [r for r in reqs if r.error is None]
-    tokens = sum(len(r.tokens) for r in done)
     sched = srv.scheduler
 
-    static_wl = serve.poisson_workload(_SERVE_N_REQUESTS, **_SERVE_WORKLOAD)
     static_srv = serve.LlamaServer(path)  # NOT started: caller-side loop
-    t0 = time.perf_counter()
-    outs = static_srv.static_generate([req for _, req in static_wl])
-    static_wall = time.perf_counter() - t0
-    static_tokens = sum(len(t) for t in outs)
+    static_rates = []
+    for _ in range(_SERVE_REPLAYS):
+        static_wl = serve.poisson_workload(_SERVE_N_REQUESTS,
+                                           **_SERVE_WORKLOAD)
+        t0 = time.perf_counter()
+        outs = static_srv.static_generate([req for _, req in static_wl])
+        static_rates.append(
+            sum(len(t) for t in outs) / (time.perf_counter() - t0))
 
     snap = telemetry_metrics.snapshot()
     compiles = sum(s["value"] for s in snap.get(
         "mxnet_compiles_total", {}).get("series", []))
     doc = {
-        "continuous_tok_s": round(tokens / wall, 2),
-        "static_tok_s": round(static_tokens / static_wall, 2),
+        "continuous_tok_s": round(_median(rates), 2),
+        "static_tok_s": round(_median(static_rates), 2),
         "completed": len(done),
         "n_requests": len(reqs),
         "ttft_p50_ms": round(sched.percentile("ttft", 0.50) * 1e3, 2),
@@ -821,6 +838,153 @@ def _run_serve(platform):
             "ttft_p50_ms": doc["ttft_p50_ms"],
             "ttft_p99_ms": doc["ttft_p99_ms"],
             "tpot_p50_ms": doc["tpot_p50_ms"],
+            "completed": doc["completed"],
+            "n_requests": doc["n_requests"],
+            "live_compiles": doc["live_compiles"]}
+
+
+def _serve_spec_export(path):
+    """Subprocess entry (`--serve-spec-export <path>`): AOT-compile the
+    llama_small serving bundle WITH the ISSUE 13 decode multipliers —
+    a compiled spec_k=2 verify signature and an int8 paged-KV arena —
+    at the same paging geometry as the plain serve bundle."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, serve
+    from mxnet_tpu.gluon.model_zoo import llama
+
+    mx.random.seed(0)
+    net = llama.llama_small()
+    net.initialize()
+    net(nd.array(np.zeros((1, 4), np.int32)))
+    # spec_k=2: verify cost grows with the compiled width faster than
+    # n-gram acceptance does on this workload (measured on the CPU
+    # backend), so the narrow block wins end-to-end
+    g = serve.export_serving_bundle(net, path, page_size=8, num_pages=512,
+                                    max_batch=8, prefill_buckets=(16, 32),
+                                    spec_k=2, kv_dtype="int8")
+    _log("serve spec export: %s" % g.describe())
+    print("SERVE_SPEC_EXPORT_OK", flush=True)
+
+
+def _serve_spec_probe(path):
+    """Subprocess entry (`--serve-spec-probe <bundle>`): speculative vs
+    plain decode on the SAME int8 bundle, same seeded workload.
+
+    Spec-on serves the 64-request Poisson workload with the n-gram
+    proposer feeding the compiled verify signature; spec-off replays the
+    identical workload through the same bundle with runtime spec_k=0
+    (plain decode path).  Greedy acceptance is exact, so the two runs
+    must produce token-for-token identical streams — asserted here, in
+    the same process that reports the speedup.  Each side's throughput
+    is the median of ``_SERVE_REPLAYS`` identical replays (see the
+    constant's comment).  Also reports the n-gram
+    acceptance rate, the kv_page device bytes vs an fp32 arena at
+    identical geometry, and the live-compile count (must stay 0)."""
+    from mxnet_tpu import serve
+    from mxnet_tpu.serve.model import KVGeometry
+    from mxnet_tpu.telemetry import metrics as telemetry_metrics
+
+    srv = serve.LlamaServer(path).start()
+    rates, reqs = [], None
+    for _ in range(_SERVE_REPLAYS):
+        wl = serve.poisson_workload(_SERVE_N_REQUESTS, **_SERVE_WORKLOAD)
+        run_reqs, wall = serve.drive_workload(srv, wl, timeout=600)
+        done = [r for r in run_reqs if r.error is None]
+        rates.append(sum(len(r.tokens) for r in done) / wall)
+        reqs = reqs if reqs is not None else run_reqs
+    st = srv.stats()
+    srv.stop()
+    kv_bytes_int8 = sum(int(b.nbytes) for b in srv.arena.buffers())
+
+    off_srv = serve.LlamaServer(path, spec_k=0).start()
+    off_rates, off_reqs = [], None
+    for _ in range(_SERVE_REPLAYS):
+        off_wl = serve.poisson_workload(_SERVE_N_REQUESTS,
+                                        **_SERVE_WORKLOAD)
+        run_reqs, off_wall = serve.drive_workload(off_srv, off_wl,
+                                                  timeout=600)
+        off_done = [r for r in run_reqs if r.error is None]
+        off_rates.append(sum(len(r.tokens) for r in off_done) / off_wall)
+        off_reqs = off_reqs if off_reqs is not None else run_reqs
+
+    off_srv.stop()
+
+    mismatched = sum(
+        1 for a, b in zip(reqs, off_reqs)
+        if a.error is None and b.error is None and a.tokens != b.tokens)
+    if mismatched:
+        raise AssertionError(
+            "speculative decoding changed %d/%d request token streams "
+            "vs spec-off on the same bundle" % (mismatched, len(reqs)))
+
+    g32 = KVGeometry(**dict(srv.geometry.to_dict(),
+                            kv_dtype=srv.geometry.dtype))
+    kv_bytes_fp32 = sum(int(b.nbytes)
+                        for b in serve.PagedKVArena(g32).buffers())
+
+    snap = telemetry_metrics.snapshot()
+    compiles = sum(s["value"] for s in snap.get(
+        "mxnet_compiles_total", {}).get("series", []))
+    parity_ok = sum(1 for r in reqs if r.error is None)
+    doc = {
+        "spec_tok_s": round(_median(rates), 2),
+        "spec_off_tok_s": round(_median(off_rates), 2),
+        "parity_checked": parity_ok,
+        "completed": parity_ok,
+        "n_requests": len(reqs),
+        "accept_rate": round(st["spec_accept_rate"], 4),
+        "spec_accepted_tokens": int(st["spec_accepted_tokens"]),
+        "kv_bytes_int8": kv_bytes_int8,
+        "kv_bytes_fp32": kv_bytes_fp32,
+        "kv_bytes_ratio": round(kv_bytes_int8 / kv_bytes_fp32, 4),
+        "live_compiles": int(compiles),
+    }
+    print("SERVE_SPEC_RESULT=%s" % json.dumps(doc), flush=True)
+
+
+def _run_serve_spec(platform):
+    """`llama_serve_spec_tok_s`: n-gram speculative decoding over the
+    int8-KV AOT bundle, on the same 64-request Poisson workload as
+    `llama_serve_tok_s`.
+
+    Two fresh subprocesses: ``--serve-spec-export`` compiles the
+    spec_k=2 / int8 bundle (paying every jit), then
+    ``--serve-spec-probe`` serves the workload spec-on and spec-off on
+    the same bundle with token-for-token parity asserted between the
+    two runs.  The metric value is spec-on tok/s; the spec-off
+    baseline, acceptance rate, and the int8/fp32 kv_page byte ratio
+    ride along."""
+    import shutil
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="mxnet-serve-spec-bench-")
+    bundle = os.path.join(tmp, "llama_small_spec.mxaot")
+    env = dict(os.environ)
+    try:
+        _probe_subprocess(["--serve-spec-export", bundle], env,
+                          "SERVE_SPEC_EXPORT_OK", "serve spec export")
+        doc = json.loads(_probe_subprocess(
+            ["--serve-spec-probe", bundle], env, "SERVE_SPEC_RESULT=",
+            "serve spec"))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    off = doc["spec_off_tok_s"]
+    speedup = round(doc["spec_tok_s"] / off, 2) if off else 0.0
+    _log("serve spec: %.1f tok/s spec-on vs %.1f spec-off (%.2fx), "
+         "accept rate %.2f, kv bytes int8/fp32 %.2f, %d/%d completed, "
+         "%d live compiles"
+         % (doc["spec_tok_s"], off, speedup, doc["accept_rate"],
+            doc["kv_bytes_ratio"], doc["completed"], doc["n_requests"],
+            doc["live_compiles"]))
+    return {"value": doc["spec_tok_s"],
+            "spec_off_tok_s": off,
+            "spec_vs_off": speedup,
+            "accept_rate": doc["accept_rate"],
+            "spec_accepted_tokens": doc["spec_accepted_tokens"],
+            "parity_checked": doc["parity_checked"],
+            "kv_bytes_int8": doc["kv_bytes_int8"],
+            "kv_bytes_fp32": doc["kv_bytes_fp32"],
+            "kv_bytes_ratio": doc["kv_bytes_ratio"],
             "completed": doc["completed"],
             "n_requests": doc["n_requests"],
             "live_compiles": doc["live_compiles"]}
@@ -909,6 +1073,8 @@ _SPECS = {
     # serving throughput: value is continuous-batching tok/s; the static
     # baseline, speedup and TTFT percentiles ride along as extra fields
     "serve": (_run_serve, "llama_serve_tok_s", "tokens/sec", None),
+    "serve_spec": (_run_serve_spec, "llama_serve_spec_tok_s",
+                   "tokens/sec", None),
     # auto-sharding planner latency: pure host-side static analysis,
     # LOWER is better (it is the rules="auto" first-step tax)
     "planner": (_run_planner, "planner_seconds", "seconds", None),
@@ -975,6 +1141,12 @@ def main():
     if len(sys.argv) >= 3 and sys.argv[1] == "--serve-probe":
         _serve_probe(sys.argv[2])  # subprocess mode: zero live compiles
         return
+    if len(sys.argv) >= 3 and sys.argv[1] == "--serve-spec-export":
+        _serve_spec_export(sys.argv[2])  # subprocess: spec_k=4/int8 jits
+        return
+    if len(sys.argv) >= 3 and sys.argv[1] == "--serve-spec-probe":
+        _serve_spec_probe(sys.argv[2])  # subprocess: spec on/off + parity
+        return
     t_start = time.perf_counter()
     requested = [a for a in sys.argv[1:] if a in _SPECS and a != "train"]
     try:
@@ -999,7 +1171,8 @@ def main():
     for name in ("infer", "bert", "llama", "dispatch_eager",
                  "dispatch_eager_notelemetry", "dispatch_bulked",
                  "dispatch_bulked_train", "dispatch_bulked_long",
-                 "serve", "planner", "cold_resnet50", "cold_bert",
+                 "serve", "serve_spec", "planner",
+                 "cold_resnet50", "cold_bert",
                  "cold_llama"):
         elapsed = time.perf_counter() - t_start
         if elapsed > budget:

@@ -11,6 +11,13 @@ Three coupled pieces (ISSUE 8 tentpole):
 - :mod:`.model` — AOT-compiled paged prefill/decode executables in a
   PR 7 ``MXAOT1`` bundle, so a serving process performs zero live jits.
 
+ISSUE 13 stacked two decode multipliers on top: n-gram self-speculative
+decoding (:mod:`.spec` proposes drafts, the bundle's compiled ``verify``
+signature scores them, acceptance is exact so greedy output is identical
+with speculation on or off) and an int8 paged-KV arena with per-page
+quantization scales (``export_serving_bundle(..., kv_dtype="int8",
+spec_k=4)``).
+
 Quick start::
 
     from mxnet_tpu import serve
@@ -29,10 +36,12 @@ from .model import (KVGeometry, check_geometry, export_serving_bundle,
 from .scheduler import Request, Scheduler, ServeQueueFull, greedy_sampler
 from .server import (AOTRunner, LlamaServer, drive_workload,
                      poisson_workload)
+from .spec import NgramProposer, propose_ngram
 
 __all__ = [
-    "AOTRunner", "KVGeometry", "LlamaServer", "PagedKVArena", "Request",
+    "AOTRunner", "KVGeometry", "LlamaServer", "NgramProposer",
+    "PagedKVArena", "Request",
     "Scheduler", "ServeQueueFull", "check_geometry", "drive_workload",
     "export_serving_bundle", "geometry_from_net", "greedy_sampler",
-    "load_serving_executables", "poisson_workload",
+    "load_serving_executables", "poisson_workload", "propose_ngram",
 ]

@@ -20,8 +20,15 @@ def main(argv=None):
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--queue-depth", type=int, default=None)
+    ap.add_argument("--spec-k", type=int, default=None,
+                    help="runtime speculative draft count (default: the "
+                         "bundle's compiled spec_k; 0 disables)")
+    ap.add_argument("--kv-dtype", default=None,
+                    help="assert the bundle's KV arena dtype (e.g. int8) "
+                         "— refuses to serve on mismatch")
     args = ap.parse_args(argv)
-    srv = LlamaServer(args.bundle, queue_depth=args.queue_depth).start()
+    srv = LlamaServer(args.bundle, queue_depth=args.queue_depth,
+                      spec_k=args.spec_k, kv_dtype=args.kv_dtype).start()
     host, port = srv.serve_http(port=args.port, host=args.host)
     print("serving %s on http://%s:%d  [%s]"
           % (args.bundle, host, port, srv.geometry.describe()))

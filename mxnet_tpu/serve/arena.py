@@ -41,7 +41,11 @@ class PagedKVArena:
 
         self.geometry = geometry
         shape = geometry.kv_shape()
-        dtype = np.dtype(geometry.dtype)
+        # int8 geometries store quantized pages plus one float32 scale
+        # per (layer, page) for each of K and V — the scales live on
+        # device too, as executable state alongside the kv buffers
+        self.quantized = geometry.quantized
+        dtype = np.dtype(geometry.kv_dtype)
         # device_put, NOT nd.zeros: a serving process must not push ops
         # (zero live compiles — the tentpole claim of the AOT warm start)
         # With mesh=/kv_spec= the arena buffers live sharded on the mesh
@@ -60,6 +64,18 @@ class PagedKVArena:
                                            placement))
         _memdump.tag(self.kv_k.data(), origin="kv_page", label="arena.k")
         _memdump.tag(self.kv_v.data(), origin="kv_page", label="arena.v")
+        self.k_scale = self.v_scale = None
+        if self.quantized:
+            # scales are tiny and replicated — never sharded
+            sshape = geometry.scale_shape()
+            self.k_scale = NDArray(jax.device_put(
+                np.zeros(sshape, np.float32)))
+            self.v_scale = NDArray(jax.device_put(
+                np.zeros(sshape, np.float32)))
+            _memdump.tag(self.k_scale.data(), origin="kv_page",
+                         label="arena.k_scale")
+            _memdump.tag(self.v_scale.data(), origin="kv_page",
+                         label="arena.v_scale")
         # page 0 is the null page — never allocated
         self._free = collections.deque(range(1, geometry.num_pages))
         self._owner = {}          # page id -> owner tag (request id)
@@ -136,7 +152,12 @@ class PagedKVArena:
 
     # -- engine liveness --------------------------------------------------
     def buffers(self):
-        """The concrete arena buffers (for liveness queries/donation)."""
+        """The concrete arena state buffers in executable argument order
+        (for liveness queries/donation): ``(k, v)``, or ``(k, v,
+        k_scale, v_scale)`` when the arena is quantized."""
+        if self.quantized:
+            return (self.kv_k.data(), self.kv_v.data(),
+                    self.k_scale.data(), self.v_scale.data())
         return (self.kv_k.data(), self.kv_v.data())
 
     def drain_pending_readers(self, origin):
@@ -159,17 +180,28 @@ class PagedKVArena:
                     help="bulk-segment flushes forced because a pending "
                          "segment still read the KV arena").inc()
 
-    def adopt(self, new_k, new_v):
+    def adopt(self, new_k, new_v, new_k_scale=None, new_v_scale=None):
         """Swap in the post-call arena buffers (when donation is on the
         executables delete the old ones, so this is the only live
         reference handoff; without donation the old buffers simply drop
-        their last reference here)."""
+        their last reference here).  Quantized arenas must hand the two
+        scale arrays back too — they are executable state."""
         self.kv_k._set_data(new_k)
         self.kv_v._set_data(new_v)
         # re-attribute: the swap is the only place fresh arena storage
         # appears, and an untagged buffer would sweep as "temp"
         _memdump.tag(new_k, origin="kv_page", label="arena.k")
         _memdump.tag(new_v, origin="kv_page", label="arena.v")
+        if self.quantized:
+            if new_k_scale is None or new_v_scale is None:
+                raise MXNetError("quantized arena adopt needs the scale "
+                                 "arrays back from the executable")
+            self.k_scale._set_data(new_k_scale)
+            self.v_scale._set_data(new_v_scale)
+            _memdump.tag(new_k_scale, origin="kv_page",
+                         label="arena.k_scale")
+            _memdump.tag(new_v_scale, origin="kv_page",
+                         label="arena.v_scale")
 
     def _gauges(self):
         if _metrics.enabled():

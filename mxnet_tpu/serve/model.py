@@ -9,7 +9,30 @@ two purpose-built graphs —
   paged arena, and returns the logits of the last real token;
 - ``decode``: one token per active slot, batched over the server's
   fixed ``max_batch`` — RoPE at the slot's position, scatter into the
-  page the block table names, then attention over the gathered pages.
+  page the block table names, then attention over the gathered pages;
+- ``verify`` (when the geometry carries ``spec_k > 0``): the
+  speculative-decoding signature — ``spec_k + 1`` tokens per lane (the
+  last accepted token plus ``spec_k`` n-gram drafts), scattered and
+  attended causally in one call, returning per-position logits so the
+  scheduler can accept the longest exactly-matching draft prefix
+  (ISSUE 13; Leviathan et al.).  Verify-K over tokens ``t..t+K`` is
+  *exactly* K+1 sequential decodes: each query position only attends
+  KV rows at or before its own position, rejected drafts' garbage rows
+  sit beyond every accepted query's mask and are overwritten by the
+  next call before anything reads them.
+
+The arena stores KV in the model dtype or — when the geometry says
+``kv_dtype="int8"`` — as int8 pages with one float32 scale per
+``(layer, page)``.  Quantization happens on append inside the compiled
+graphs: the row written to a page's **slot 0** fixes that page's scale
+(its own absmax with 2x headroom) and later rows in the page quantize
+against it, never rescaling what is already stored.  That makes the
+quantized arena state a pure function of the token sequence —
+independent of how tokens were grouped into prefill/decode/verify calls
+— which is what lets the spec-on and spec-off greedy outputs stay
+token-for-token identical at int8.  Page reuse is safe for free: a new
+owner's first write to a page is always that page's slot 0 (positions
+are written in order), which resets the scale.
 
 On accelerator backends both donate the KV arena buffers (argnums 0/1),
 so the steady-state decode loop updates the cache in place with zero
@@ -37,10 +60,20 @@ BUNDLE_KIND = "serving"
 
 # geometry fields a serving bundle must carry; the load-time validator
 # refuses a bundle missing any of them (satellite: fail at load, not
-# inside XLA on the first mismatched decode)
+# inside XLA on the first mismatched decode).  kv_dtype/spec_k are NOT
+# in this list: pre-PR-13 bundles lack them and must keep loading
+# (defaulting to an fp32 arena with speculation off).
 _GEOM_INT_FIELDS = ("num_layers", "num_heads", "num_kv_heads", "head_dim",
                     "units", "hidden_size", "vocab_size", "page_size",
                     "num_pages", "max_pages_per_seq", "max_batch")
+
+# int8 paged-KV quantization constants.  A page's scale is fixed by its
+# slot-0 row's absmax with this headroom (later rows clip past it);
+# 2x keeps one extra bit of range for K/V magnitude drift within a page
+# at the cost of one bit of precision.
+_INT8_QMAX = 127.0
+_INT8_SCALE_HEADROOM = 2.0
+_INT8_MIN_SCALE = 1e-8  # an all-zero slot-0 row must not divide by zero
 
 
 class KVGeometry:
@@ -57,7 +90,7 @@ class KVGeometry:
                  units, hidden_size, vocab_size, page_size, num_pages,
                  max_pages_per_seq, max_batch, prefill_buckets,
                  dtype="float32", rope_base=10000.0, eps=1e-6,
-                 tie_embeddings=False):
+                 tie_embeddings=False, kv_dtype=None, spec_k=0):
         self.num_layers = int(num_layers)
         self.num_heads = int(num_heads)
         self.num_kv_heads = int(num_kv_heads)
@@ -74,6 +107,10 @@ class KVGeometry:
         self.rope_base = float(rope_base)
         self.eps = float(eps)
         self.tie_embeddings = bool(tie_embeddings)
+        # PR 13 fields with pre-PR-13 defaults: an old bundle dict that
+        # carries neither loads as an fp32 arena with speculation off
+        self.kv_dtype = str(kv_dtype) if kv_dtype else self.dtype
+        self.spec_k = int(spec_k)
         self.validate()
 
     @property
@@ -100,6 +137,14 @@ class KVGeometry:
                    self.max_pages_per_seq, self.page_size))
         if self.num_heads % self.num_kv_heads:
             raise MXNetError("num_heads must be a multiple of num_kv_heads")
+        if self.kv_dtype not in (self.dtype, "int8"):
+            raise MXNetError(
+                "kv_dtype must be the model dtype (%r) or 'int8', got %r"
+                % (self.dtype, self.kv_dtype))
+        if not 0 <= self.spec_k <= 64:
+            raise MXNetError("spec_k must be in [0, 64] (draft tokens "
+                             "verified per decode call), got %d"
+                             % self.spec_k)
 
     def to_dict(self):
         return {
@@ -113,6 +158,7 @@ class KVGeometry:
             "prefill_buckets": list(self.prefill_buckets),
             "dtype": self.dtype, "rope_base": self.rope_base,
             "eps": self.eps, "tie_embeddings": self.tie_embeddings,
+            "kv_dtype": self.kv_dtype, "spec_k": self.spec_k,
         }
 
     @classmethod
@@ -130,12 +176,23 @@ class KVGeometry:
         return (self.num_layers, self.num_pages, self.page_size,
                 self.num_kv_heads, self.head_dim)
 
+    @property
+    def quantized(self):
+        """True when the arena stores int8 pages with per-page scales."""
+        return self.kv_dtype == "int8"
+
+    def scale_shape(self):
+        """Per-page quantization scale shape: (L, pages); one float32
+        scale per (layer, page) for each of K and V."""
+        return (self.num_layers, self.num_pages)
+
     def describe(self):
         return ("layers=%d heads=%d/%d head_dim=%d pages=%dx%d "
-                "max_batch=%d buckets=%s dtype=%s"
+                "max_batch=%d buckets=%s dtype=%s kv_dtype=%s spec_k=%d"
                 % (self.num_layers, self.num_heads, self.num_kv_heads,
                    self.head_dim, self.num_pages, self.page_size,
-                   self.max_batch, list(self.prefill_buckets), self.dtype))
+                   self.max_batch, list(self.prefill_buckets), self.dtype,
+                   self.kv_dtype, self.spec_k))
 
 
 def _env_int(name, default):
@@ -156,7 +213,8 @@ def default_buckets():
 
 
 def geometry_from_net(net, page_size=None, num_pages=None, max_batch=None,
-                      prefill_buckets=None, max_pages_per_seq=None):
+                      prefill_buckets=None, max_pages_per_seq=None,
+                      kv_dtype=None, spec_k=None):
     """Derive a :class:`KVGeometry` from a ``LlamaModel`` block tree,
     filling paging knobs from ``MXNET_SERVE_*`` env defaults."""
     blocks = list(net.blocks._children.values())
@@ -167,13 +225,20 @@ def geometry_from_net(net, page_size=None, num_pages=None, max_batch=None,
     page_size = page_size or _env_int("MXNET_SERVE_PAGE_SIZE", 16)
     num_pages = num_pages or _env_int("MXNET_SERVE_NUM_PAGES", 512)
     max_batch = max_batch or _env_int("MXNET_SERVE_MAX_BATCH", 8)
+    kv_dtype = kv_dtype \
+        or os.environ.get("MXNET_SERVE_KV_DTYPE", "").strip() or None
+    spec_k = spec_k if spec_k is not None \
+        else _env_int("MXNET_SERVE_SPEC_K", 0)
     buckets = tuple(prefill_buckets) if prefill_buckets \
         else default_buckets()
     if max_pages_per_seq is None:
-        # default: one sequence may address half the arena, capped so the
-        # bucket ladder always fits
+        # default: a full batch can at most address the whole arena. The
+        # block-table width is also the attention context every decode /
+        # verify call gathers, so an over-wide table (the old default let
+        # one lane claim half the arena) taxes every step with mostly-null
+        # pages. Floored so the bucket ladder always fits.
         need = -(-max(buckets) // page_size)
-        max_pages_per_seq = max(need + 1, (num_pages - 1) // 2)
+        max_pages_per_seq = max(need + 1, num_pages // max_batch)
     return KVGeometry(
         num_layers=len(blocks), num_heads=attn._heads,
         num_kv_heads=attn._kv_heads,
@@ -183,7 +248,8 @@ def geometry_from_net(net, page_size=None, num_pages=None, max_batch=None,
         num_pages=num_pages, max_pages_per_seq=max_pages_per_seq,
         max_batch=max_batch, prefill_buckets=buckets,
         dtype=str(embed_w.dtype), rope_base=attn._base,
-        eps=blocks[0].attn_norm._eps, tie_embeddings=net._tie)
+        eps=blocks[0].attn_norm._eps, tie_embeddings=net._tie,
+        kv_dtype=kv_dtype, spec_k=spec_k)
 
 
 def _pull(param):
@@ -251,17 +317,37 @@ def _rotate(x, cos, sin):
                            axis=-1)
 
 
-def build_decode_fn(weights, geometry):
-    """One batched decode step over the paged arena.
+def build_step_fn(weights, geometry, k1):
+    """``k1`` tokens per lane through the paged arena in one call.
 
-    Signature (all positional; kv buffers donated by the AOT compile
-    when the backend supports it — see ``_donate_kv``):
-    ``(kv_k, kv_v, tokens (B,) i32, positions (B,) i32,
-    block_table (B, maxp) i32) -> (kv_k, kv_v, logits (B, V) f32)``.
+    This is the shared body of ``decode`` (``k1=1``) and ``verify``
+    (``k1=spec_k+1``).  Signature (all positional; kv buffers — and the
+    scale arrays for int8 — donated by the AOT compile when the backend
+    supports it, see ``_donate_kv``):
 
-    Inactive slots point their block-table row at the reserved null page
-    0 with position 0 — their scatters land there harmlessly and their
-    logits are discarded by the scheduler.
+    - fp32: ``(kv_k, kv_v, tokens (B, k1) i32, positions (B,) i32,
+      block_table (B, maxp) i32) -> (kv_k, kv_v, logits (B, k1, V))``
+    - int8: ``(kv_k, kv_v, k_scale (L, P) f32, v_scale (L, P) f32,
+      tokens, positions, block_table) -> (kv_k, kv_v, k_scale, v_scale,
+      logits)``
+
+    Lane ``b``'s token ``j`` sits at position ``positions[b] + j``;
+    query ``j`` attends context ``<= positions[b] + j`` only, so the
+    per-position logits equal what ``k1`` sequential single-token
+    decodes would produce (the exactness speculative acceptance rides
+    on).  Inactive slots point their block-table row at the reserved
+    null page 0 with position 0 — their scatters land there harmlessly
+    (every lane writes the same pad-token rows, so even the duplicate
+    null-page scatters are deterministic) and their logits are
+    discarded by the scheduler.
+
+    Int8 append: the row landing on a page's slot 0 fixes the page
+    scale (own absmax x headroom / 127); rows landing further into a
+    page quantize against the page's current scale — the scale of its
+    slot-0 write, whether that write happened in this call (the
+    ``start >= 0`` branch below) or in an earlier one.  Nothing already
+    stored is ever requantized, so arena bytes after token t are
+    independent of call grouping.
     """
     import jax
     import jax.numpy as jnp
@@ -271,55 +357,131 @@ def build_decode_fn(weights, geometry):
     H, KV, D, S = g.num_heads, g.num_kv_heads, g.head_dim, g.page_size
     scale = 1.0 / math.sqrt(D)
     ctx = g.max_pages_per_seq * S
+    int8 = g.quantized
+    jidx = jnp.arange(k1)
 
-    def decode(kv_k, kv_v, tokens, positions, block_table):
+    def append(kv, sc, li, pid, slot, rows):
+        """Scatter ``rows`` (B, k1, KV, D) at (li, pid, slot); quantize
+        against per-page scales when the arena is int8."""
+        if not int8:
+            return kv.at[li, pid, slot].set(rows), sc
+        r32 = rows.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(r32), axis=(2, 3))            # (B, k1)
+        # in-call page starts: token j's page began at call offset
+        # j - slot[j]; negative means the page's slot 0 was written by
+        # an earlier call and its stored scale rules
+        start = jidx[None, :] - slot                         # (B, k1)
+        first = jnp.take_along_axis(amax, jnp.clip(start, 0, k1 - 1),
+                                    axis=1)
+        news = jnp.where(start >= 0,
+                         first * (_INT8_SCALE_HEADROOM / _INT8_QMAX),
+                         sc[li, pid])
+        news = jnp.maximum(news, _INT8_MIN_SCALE)
+        q = jnp.clip(jnp.round(r32 / news[..., None, None]),
+                     -_INT8_QMAX, _INT8_QMAX).astype(jnp.int8)
+        # rows of one page all write the page's resolved scale — equal
+        # values, so duplicate scatter order cannot matter
+        return kv.at[li, pid, slot].set(q), sc.at[li, pid].set(news)
+
+    def gather(kv, sc, li, block_table, b, dt):
+        """This lane's pages as (B, C, KV, D) in the model dtype."""
+        pages = kv[li, block_table]            # (B, maxp, S, KV, D)
+        if int8:
+            ps = sc[li, block_table]           # (B, maxp)
+            pages = (pages.astype(jnp.float32)
+                     * ps[..., None, None, None]).astype(dt)
+        return pages.reshape(b, ctx, KV, D)
+
+    def step(kv_k, kv_v, *rest):
+        if int8:
+            k_sc, v_sc, tokens, positions, block_table = rest
+        else:
+            tokens, positions, block_table = rest
+            k_sc = v_sc = None
         b = tokens.shape[0]
-        x = embed[tokens]                                    # (B, U)
-        cos, sin = _rope_tables(positions.astype(jnp.float32), D,
-                                g.rope_base)                 # (B, half)
-        cos, sin = cos[:, None, :], sin[:, None, :]          # (B, 1, half)
-        rows = jnp.arange(b)
-        pid = block_table[rows, positions // S]              # (B,)
-        slot = positions % S
-        valid = jnp.arange(ctx)[None, :] <= positions[:, None]  # (B, C)
+        x = embed[tokens]                                    # (B, k1, U)
+        pos = positions[:, None] + jidx[None, :]             # (B, k1)
+        cos, sin = _rope_tables(pos.astype(jnp.float32), D,
+                                g.rope_base)                 # (B, k1, half)
+        cos, sin = cos[:, :, None, :], sin[:, :, None, :]
+        rows_b = jnp.arange(b)
+        pid = block_table[rows_b[:, None], pos // S]         # (B, k1)
+        slot = pos % S
+        valid = jnp.arange(ctx)[None, None, :] <= pos[..., None]
         for li, lw in enumerate(layers):
             h = _rmsnorm(x, lw["attn_norm"], g.eps)
-            q = _rotate((h @ lw["q"].T).reshape(b, H, D), cos, sin)
-            k = _rotate((h @ lw["k"].T).reshape(b, KV, D), cos, sin)
-            v = (h @ lw["v"].T).reshape(b, KV, D)
-            kv_k = kv_k.at[li, pid, slot].set(k)
-            kv_v = kv_v.at[li, pid, slot].set(v)
-            # gather this sequence's pages: (B, maxp, S, KV, D) -> (B, C,…)
-            keys = kv_k[li, block_table].reshape(b, ctx, KV, D)
-            vals = kv_v[li, block_table].reshape(b, ctx, KV, D)
+            q = _rotate((h @ lw["q"].T).reshape(b, k1, H, D), cos, sin)
+            k = _rotate((h @ lw["k"].T).reshape(b, k1, KV, D), cos, sin)
+            v = (h @ lw["v"].T).reshape(b, k1, KV, D)
+            kv_k, k_sc = append(kv_k, k_sc, li, pid, slot, k)
+            kv_v, v_sc = append(kv_v, v_sc, li, pid, slot, v)
+            keys = gather(kv_k, k_sc, li, block_table, b, x.dtype)
+            vals = gather(kv_v, v_sc, li, block_table, b, x.dtype)
             keys = jnp.repeat(keys, H // KV, axis=2)         # GQA repeat
             vals = jnp.repeat(vals, H // KV, axis=2)
-            scores = jnp.einsum("bhd,bchd->bhc", q, keys) * scale
-            scores = jnp.where(valid[:, None, :],
+            scores = jnp.einsum("bkhd,bchd->bkhc", q, keys) * scale
+            scores = jnp.where(valid[:, :, None, :],
                                scores.astype(jnp.float32), -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-            att = jnp.einsum("bhc,bchd->bhd", probs, vals)
-            x = x + att.reshape(b, H * D) @ lw["o"].T
+            att = jnp.einsum("bkhc,bchd->bkhd", probs, vals)
+            x = x + att.reshape(b, k1, H * D) @ lw["o"].T
             h2 = _rmsnorm(x, lw["ffn_norm"], g.eps)
             x = x + (jax.nn.silu(h2 @ lw["gate"].T)
                      * (h2 @ lw["up"].T)) @ lw["down"].T
         xh = _rmsnorm(x, norm, g.eps)
         hw = embed if head is None else head
-        return kv_k, kv_v, (xh @ hw.T).astype(jnp.float32)
+        logits = (xh @ hw.T).astype(jnp.float32)             # (B, k1, V)
+        if int8:
+            return kv_k, kv_v, k_sc, v_sc, logits
+        return kv_k, kv_v, logits
+
+    return step
+
+
+def build_decode_fn(weights, geometry):
+    """One batched single-token decode step: the ``k1=1`` slice of
+    :func:`build_step_fn` with the historical external signature
+    (tokens ``(B,)``, logits ``(B, V)``); int8 geometries insert the
+    two scale arrays after the kv buffers."""
+    step = build_step_fn(weights, geometry, 1)
+    int8 = geometry.quantized
+
+    def decode(kv_k, kv_v, *rest):
+        scales, (tokens, positions, block_table) = \
+            (rest[:2], rest[2:]) if int8 else ((), rest)
+        outs = step(kv_k, kv_v, *scales, tokens[:, None], positions,
+                    block_table)
+        return outs[:-1] + (outs[-1][:, 0],)
 
     return decode
+
+
+def build_verify_fn(weights, geometry):
+    """The speculative-decoding signature: ``spec_k + 1`` tokens per
+    lane — ``tokens[:, 0]`` is the last accepted token, ``tokens[:,
+    1:]`` the drafts — returning logits at every position so the
+    scheduler accepts the longest draft prefix the model reproduces."""
+    if geometry.spec_k <= 0:
+        raise MXNetError("verify needs a geometry with spec_k > 0")
+    return build_step_fn(weights, geometry, geometry.spec_k + 1)
 
 
 def build_prefill_fn(weights, geometry, bucket):
     """Whole-prompt pass for one padded bucket length ``T``.
 
     ``(kv_k, kv_v, tokens (T,) i32, length () i32,
-    block_table (maxp,) i32) -> (kv_k, kv_v, logits (V,) f32)``.
+    block_table (maxp,) i32) -> (kv_k, kv_v, logits (V,) f32)``; int8
+    geometries insert ``k_scale``/``v_scale`` after the kv buffers in
+    both tuples, exactly as in :func:`build_step_fn`.
 
     Every position's K/V is scattered into the arena (pad positions land
     on the null page or on this sequence's own not-yet-read slots, both
-    harmless); the returned logits are the last REAL token's — the first
-    generated token comes straight out of prefill.
+    harmless: a pad-set page scale is reset by the sequence's own later
+    slot-0 write before any masked-in read); the returned logits are the
+    last REAL token's — the first generated token comes straight out of
+    prefill.  Attention here runs over the in-call full-precision K/V,
+    not the arena, so prefill logits are identical between fp32 and int8
+    bundles; only the *stored* pages are quantized.
     """
     import jax
     import jax.numpy as jnp
@@ -329,8 +491,14 @@ def build_prefill_fn(weights, geometry, bucket):
     H, KV, D, S = g.num_heads, g.num_kv_heads, g.head_dim, g.page_size
     scale = 1.0 / math.sqrt(D)
     t = int(bucket)
+    int8 = g.quantized
 
-    def prefill(kv_k, kv_v, tokens, length, block_table):
+    def prefill(kv_k, kv_v, *rest):
+        if int8:
+            k_sc, v_sc, tokens, length, block_table = rest
+        else:
+            tokens, length, block_table = rest
+            k_sc = v_sc = None
         x = embed[tokens]                                    # (T, U)
         pos = jnp.arange(t)
         cos, sin = _rope_tables(pos.astype(jnp.float32), D, g.rope_base)
@@ -339,13 +507,29 @@ def build_prefill_fn(weights, geometry, bucket):
         slot = pos % S
         causal = (pos[None, :] <= pos[:, None]) \
             & (pos[None, :] < length)                        # (T, T)
+
+        def append(kv, sc, li, rows):
+            if not int8:
+                return kv.at[li, pid, slot].set(rows), sc
+            r32 = rows.astype(jnp.float32)
+            amax = jnp.max(jnp.abs(r32), axis=(1, 2))        # (T,)
+            # every page start is in-call during prefill: row (p//S)*S
+            # fixes page p//S's scale, all rows of a page scatter the
+            # same value so duplicate null-page writes stay harmless
+            first = amax[(pos // S) * S]
+            news = jnp.maximum(first * (_INT8_SCALE_HEADROOM / _INT8_QMAX),
+                               _INT8_MIN_SCALE)
+            q = jnp.clip(jnp.round(r32 / news[:, None, None]),
+                         -_INT8_QMAX, _INT8_QMAX).astype(jnp.int8)
+            return kv.at[li, pid, slot].set(q), sc.at[li, pid].set(news)
+
         for li, lw in enumerate(layers):
             h = _rmsnorm(x, lw["attn_norm"], g.eps)
             q = _rotate((h @ lw["q"].T).reshape(t, H, D), cos, sin)
             k = _rotate((h @ lw["k"].T).reshape(t, KV, D), cos, sin)
             v = (h @ lw["v"].T).reshape(t, KV, D)
-            kv_k = kv_k.at[li, pid, slot].set(k)
-            kv_v = kv_v.at[li, pid, slot].set(v)
+            kv_k, k_sc = append(kv_k, k_sc, li, k)
+            kv_v, v_sc = append(kv_v, v_sc, li, v)
             keys = jnp.repeat(k, H // KV, axis=1)            # (T, H, D)
             vals = jnp.repeat(v, H // KV, axis=1)
             scores = jnp.einsum("thd,uhd->htu", q, keys) * scale
@@ -360,7 +544,10 @@ def build_prefill_fn(weights, geometry, bucket):
         xh = _rmsnorm(x, norm, g.eps)
         last = jnp.take(xh, length - 1, axis=0)              # (U,)
         hw = embed if head is None else head
-        return kv_k, kv_v, (last @ hw.T).astype(jnp.float32)
+        logits = (last @ hw.T).astype(jnp.float32)
+        if int8:
+            return kv_k, kv_v, k_sc, v_sc, logits
+        return kv_k, kv_v, logits
 
     return prefill
 
@@ -390,17 +577,20 @@ def _donate_kv():
     return jax.default_backend() != "cpu"
 
 
-def _aot_compile(fn, avals):
-    """jit → lower → compile, KV buffers (argnums 0, 1) donated when
-    the backend supports aliasing across serialization (_donate_kv)."""
+def _aot_compile(fn, avals, n_state=2):
+    """jit → lower → compile; the first ``n_state`` args (KV buffers,
+    plus the two scale arrays for int8) donated when the backend
+    supports aliasing across serialization (_donate_kv)."""
     import jax
 
-    kwargs = {"donate_argnums": (0, 1)} if _donate_kv() else {}
+    kwargs = {"donate_argnums": tuple(range(n_state))} \
+        if _donate_kv() else {}
     return jax.jit(fn, **kwargs).lower(*avals).compile()
 
 
 def compile_serving_executables(net, geometry):
-    """Build + AOT-compile the decode and per-bucket prefill graphs.
+    """Build + AOT-compile the decode, verify (when ``spec_k > 0``) and
+    per-bucket prefill graphs.
 
     Returns ``{name: jax.stages.Compiled}`` with weights baked in as
     constants — the bundle is self-contained, no .params sidecar.
@@ -418,32 +608,47 @@ def compile_serving_executables(net, geometry):
     weights = (dev(raw[0]), [{k: dev(v) for k, v in lw.items()}
                              for lw in raw[1]], dev(raw[2]),
                None if raw[3] is None else dev(raw[3]))
-    kv = jax.ShapeDtypeStruct(g.kv_shape(), np.dtype(g.dtype))
+    kv = jax.ShapeDtypeStruct(g.kv_shape(), np.dtype(g.kv_dtype))
     i32 = np.dtype(np.int32)
+    sc = jax.ShapeDtypeStruct(g.scale_shape(), np.dtype(np.float32))
+    state = (kv, kv, sc, sc) if g.quantized else (kv, kv)
     exes = {}
-    dec_avals = (kv, kv, jax.ShapeDtypeStruct((g.max_batch,), i32),
-                 jax.ShapeDtypeStruct((g.max_batch,), i32),
-                 jax.ShapeDtypeStruct((g.max_batch, g.max_pages_per_seq),
-                                      i32))
-    exes["decode"] = _aot_compile(build_decode_fn(weights, g), dec_avals)
+
+    def lane_avals(tok_shape):
+        return state + (
+            jax.ShapeDtypeStruct(tok_shape, i32),
+            jax.ShapeDtypeStruct((g.max_batch,), i32),
+            jax.ShapeDtypeStruct((g.max_batch, g.max_pages_per_seq), i32))
+
+    exes["decode"] = _aot_compile(build_decode_fn(weights, g),
+                                  lane_avals((g.max_batch,)),
+                                  n_state=len(state))
+    if g.spec_k > 0:
+        exes["verify"] = _aot_compile(
+            build_verify_fn(weights, g),
+            lane_avals((g.max_batch, g.spec_k + 1)), n_state=len(state))
     for b in g.prefill_buckets:
-        pf_avals = (kv, kv, jax.ShapeDtypeStruct((b,), i32),
-                    jax.ShapeDtypeStruct((), i32),
-                    jax.ShapeDtypeStruct((g.max_pages_per_seq,), i32))
+        pf_avals = state + (jax.ShapeDtypeStruct((b,), i32),
+                            jax.ShapeDtypeStruct((), i32),
+                            jax.ShapeDtypeStruct((g.max_pages_per_seq,),
+                                                 i32))
         exes["prefill_%d" % b] = _aot_compile(
-            build_prefill_fn(weights, g, b), pf_avals)
+            build_prefill_fn(weights, g, b), pf_avals, n_state=len(state))
     return exes
 
 
 def export_serving_bundle(net, path, page_size=None, num_pages=None,
                           max_batch=None, prefill_buckets=None,
-                          max_pages_per_seq=None, mesh=None):
+                          max_pages_per_seq=None, mesh=None,
+                          kv_dtype=None, spec_k=None):
     """Export ``net`` as a self-contained MXAOT1 serving bundle.
 
     The bundle carries the AOT-compiled decode + per-bucket prefill
     executables (weights baked in) and the :class:`KVGeometry` in its
     meta, so ``serve.LlamaServer(path)`` starts with zero live compiles.
-    Paging knobs default from ``MXNET_SERVE_*`` (docs/env_vars.md).
+    Paging knobs default from ``MXNET_SERVE_*`` (docs/env_vars.md);
+    ``kv_dtype="int8"`` quantizes the arena pages, ``spec_k=K`` adds the
+    compiled ``verify`` executable for n-gram speculative decoding.
     Returns the geometry.
 
     ``mesh`` (a Mesh / axes dict — abstract, no devices needed) runs the
@@ -459,7 +664,8 @@ def export_serving_bundle(net, path, page_size=None, num_pages=None,
     g = geometry_from_net(net, page_size=page_size, num_pages=num_pages,
                           max_batch=max_batch,
                           prefill_buckets=prefill_buckets,
-                          max_pages_per_seq=max_pages_per_seq)
+                          max_pages_per_seq=max_pages_per_seq,
+                          kv_dtype=kv_dtype, spec_k=spec_k)
     meta = {"kind": BUNDLE_KIND, "geometry": g.to_dict()}
     if mesh is not None:
         from .. import planner as _planner
@@ -504,6 +710,8 @@ def load_serving_executables(path, expect=None):
     if expect is not None:
         check_geometry(g, expect, origin=path)
     want = ["decode"] + ["prefill_%d" % b for b in g.prefill_buckets]
+    if g.spec_k > 0:
+        want.append("verify")
     entries = doc.get("entries", {})
     missing = [n for n in want if n not in entries]
     if missing:

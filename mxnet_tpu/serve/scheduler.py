@@ -8,22 +8,33 @@ One ``step()`` is the whole policy — admit, prefill, decode, complete:
    run prefill — the first generated token falls out of the prefill
    logits, which is when TTFT stops ticking;
 2. **decode**: one batched step over every active slot (inactive slots
-   ride along pointing at the arena's null page);
+   ride along pointing at the arena's null page) — or, when speculation
+   is on (``spec_k > 0``), one batched **verify** step: each lane
+   proposes ``spec_k`` n-gram drafts from its own history
+   (serve.spec.propose_ngram) and the compiled ``verify`` signature
+   scores all ``spec_k + 1`` positions in one call; the lane accepts
+   the longest draft prefix the sampler reproduces exactly, plus one
+   bonus token from the first disagreeing position.  Because logits at
+   position j only see context <= j, the accepted stream is
+   token-for-token what sequential decode would have produced — EOS and
+   budget truncation apply mid-block, after acceptance;
 3. **complete**: slots whose newest token hit EOS or the budget free
    their pages, fulfill their futures, and are immediately reusable —
    the next ``step()`` refills them from the queue (slot recycling).
 
-The class is jax-free: model execution hides behind a two-method runner
-(``prefill``/``decode``), so the scheduler tests drive ``step()`` with a
-scripted fake and no sleeps, while the server plugs in the AOT runner
-and a background thread.  Backpressure is a bounded admission queue —
-``submit`` raises :class:`ServeQueueFull` instead of buffering without
-limit (HTTP surfaces it as 503).
+The class is jax-free: model execution hides behind a small runner
+(``prefill``/``decode``, plus ``verify`` when speculating), so the
+scheduler tests drive ``step()`` with a scripted fake and no sleeps,
+while the server plugs in the AOT runner and a background thread.
+Backpressure is a bounded admission queue — ``submit`` raises
+:class:`ServeQueueFull` instead of buffering without limit (HTTP
+surfaces it as 503).
 """
 from __future__ import annotations
 
 import collections
 import itertools
+import math
 import os
 import threading
 import time
@@ -33,6 +44,7 @@ import numpy as np
 from ..base import MXNetError
 from ..telemetry import flight as _flight
 from ..telemetry import metrics as _metrics
+from . import spec as _spec
 
 # TTFT/TPOT bucket ladders (seconds): decode steps sit well under the
 # engine's default op buckets, so the serve histograms get their own
@@ -40,6 +52,28 @@ _TTFT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                  0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
 _TPOT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                  0.025, 0.05, 0.1, 0.25, 1.0)
+# accepted-drafts-per-verify ladder (tokens; spec_k is capped at 64)
+_ACCEPT_BUCKETS = (0, 1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64)
+
+# hybrid-policy match gate: only lanes whose proposer found a real
+# n-gram match earn acceptance, so a batch is only *eligible* for the
+# verify path when at least this fraction of active lanes matched —
+# below it a plain decode emits more tokens per second regardless of
+# call costs
+_SPEC_MATCH_MIN_FRAC = 0.625
+# cost-aware gate on top of the match gate: verify costs more than a
+# decode (how much more depends on backend, geometry, and compiled
+# width), and pays only when the measured acceptance covers that
+# premium.  The scheduler tracks EMAs of both call durations and of
+# accepted drafts per lane, fires verify when
+#   (1 + acc) * t_decode >= t_verify
+# and otherwise decodes plainly — re-probing an apparently-losing
+# verify path every N eligible steps so the estimates track workload
+# drift.  Zero-duration test clocks make the check degenerate to the
+# pure match-gate policy (0 >= 0), so deterministic tests are
+# unaffected.
+_SPEC_PROBE_EVERY = 32
+_SPEC_EMA = 0.2
 
 
 class ServeQueueFull(MXNetError):
@@ -109,13 +143,14 @@ class Request:
 class _Slot:
     """One in-flight decode lane: request + position + block-table row."""
 
-    __slots__ = ("req", "pages", "row", "position")
+    __slots__ = ("req", "pages", "row", "position", "proposer")
 
     def __init__(self, req, pages, row, position):
         self.req = req
         self.pages = pages
         self.row = row            # np (maxp,) int32 block-table row
         self.position = position  # next token's position (0-based)
+        self.proposer = None      # lazy spec.NgramProposer (spec_k > 0)
 
 
 def _env_int(name, default):
@@ -134,12 +169,20 @@ class Scheduler:
     ``runner`` needs two methods (numpy in, numpy out):
     ``prefill(bucket, tokens (Lp,), length, block_row) -> logits (V,)``
     and ``decode(tokens (B,), positions (B,), block_tables (B, maxp))
-    -> logits (B, V)``.  ``clock`` is injectable so tests measure
-    nothing real.
+    -> logits (B, V)`` — plus ``verify(tokens (B, K+1), positions,
+    block_tables) -> logits (B, K+1, V)`` when the scheduler runs with
+    ``spec_k > 0``.  ``clock`` is injectable so tests measure nothing
+    real.
+
+    ``spec_k`` is the *runtime* draft count: defaults to the bundle's
+    compiled ``geometry.spec_k``, may be lowered (drafts are padded up
+    to the compiled verify width, extra positions never accepted), and
+    ``spec_k=0`` turns speculation off entirely (plain decode path) —
+    the parity knob the e2e matrix flips.
     """
 
     def __init__(self, runner, arena, queue_depth=None, sampler=None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, spec_k=None):
         self.runner = runner
         self.arena = arena
         self.geometry = arena.geometry
@@ -147,6 +190,17 @@ class Scheduler:
                                else _env_int("MXNET_SERVE_QUEUE_DEPTH", 64))
         self.sampler = sampler or greedy_sampler
         self.clock = clock
+        spec_k = self.geometry.spec_k if spec_k is None else int(spec_k)
+        if not 0 <= spec_k <= self.geometry.spec_k:
+            raise MXNetError(
+                "runtime spec_k=%d out of range for this bundle "
+                "(compiled verify width spec_k=%d; 0 disables "
+                "speculation)" % (spec_k, self.geometry.spec_k))
+        self.spec_k = spec_k
+        # verify scatters the full compiled draft width past the lane's
+        # position even when the runtime spec_k is lower, so pages must
+        # cover that many extra slots beyond prompt + budget
+        self._spec_headroom = self.geometry.spec_k if spec_k > 0 else 0
         self._lock = threading.Lock()
         self._queue = collections.deque()
         self._slots = [None] * self.geometry.max_batch
@@ -158,6 +212,16 @@ class Scheduler:
         self.tokens_generated = 0
         self.decode_steps = 0
         self.prefills = 0
+        self.spec_proposed = 0    # draft tokens sent to verify
+        self.spec_accepted = 0    # draft tokens the sampler reproduced
+        # cost-model EMAs for the verify/decode policy (see the
+        # _SPEC_PROBE_EVERY comment).  Acceptance starts at the compiled
+        # width — optimism makes the first eligible steps verify, which
+        # is what seeds the duration estimates with real measurements.
+        self._t_decode = 0.0
+        self._t_verify = 0.0
+        self._spec_acc_lane = float(self.spec_k)
+        self._spec_skipped = 0    # eligible steps since the last verify
         self._ttfts = collections.deque(maxlen=4096)
         self._tpots = collections.deque(maxlen=4096)
         # per-request traces (GET /v1/trace/<id>): bounded FIFO so a
@@ -220,12 +284,14 @@ class Scheduler:
                 "(%d) this bundle was exported with"
                 % (len(req.prompt), self.geometry.prefill_buckets[-1])))
             return req
-        total = len(req.prompt) + req.max_new_tokens
+        total = len(req.prompt) + req.max_new_tokens + self._spec_headroom
         if total > self.geometry.max_context:
             self._reject(req, MXNetError(
-                "prompt %d + max_new %d exceeds max context %d (= "
+                "prompt %d + max_new %d%s exceeds max context %d (= "
                 "max_pages_per_seq x page_size)"
                 % (len(req.prompt), req.max_new_tokens,
+                   " + spec_k headroom %d" % self._spec_headroom
+                   if self._spec_headroom else "",
                    self.geometry.max_context)))
             return req
         with self._lock:
@@ -272,7 +338,8 @@ class Scheduler:
                 req = self._queue[0]
                 pages = self.arena.alloc(
                     self.arena.pages_needed(
-                        len(req.prompt) + req.max_new_tokens), req.rid)
+                        len(req.prompt) + req.max_new_tokens
+                        + self._spec_headroom), req.rid)
                 if pages is None:
                     break  # head-of-line waits for pages, not forever slots
                 self._queue.popleft()
@@ -334,6 +401,38 @@ class Scheduler:
                       if s is not None]
         if not active:
             return False
+        if self.spec_k > 0:
+            proposals = {}
+            matched = 0
+            for i, s in active:
+                if s.proposer is None:
+                    s.proposer = _spec.NgramProposer(
+                        s.req.prompt + s.req.tokens)
+                d, n = s.proposer.propose(self.spec_k)
+                proposals[i] = d
+                matched += 1 if n > 0 else 0
+            if matched >= max(1, math.ceil(
+                    _SPEC_MATCH_MIN_FRAC * len(active))):
+                # until both call types have real duration samples the
+                # gate stays open — never conclude verify loses from a
+                # cold estimate.  The 1.05 margin demands a strict win:
+                # an EV-neutral verify still pays per-call host-side
+                # acceptance work, and a borderline estimate would
+                # otherwise oscillate with measurement noise.
+                pays = (self._t_decode == 0.0 or self._t_verify == 0.0
+                        or (1.0 + self._spec_acc_lane) * self._t_decode
+                        >= 1.05 * self._t_verify)
+                if pays or self._spec_skipped >= _SPEC_PROBE_EVERY:
+                    self._spec_skipped = 0
+                    return self._verify_once(active, proposals)
+                self._spec_skipped += 1
+            # hybrid policy: too few lanes have a real n-gram match
+            # (unmatched lanes ride a verify call at full cost but
+            # accept ~nothing), or the measured acceptance doesn't
+            # cover the measured verify premium at this geometry — the
+            # batch earns more from a plain decode this step.  Output
+            # is identical either way: acceptance is exact (see
+            # _verify_once).
         g = self.geometry
         tokens = np.zeros(g.max_batch, dtype=np.int32)
         positions = np.zeros(g.max_batch, dtype=np.int32)
@@ -352,6 +451,7 @@ class Scheduler:
             return True
         self.decode_steps += 1
         dt = self.clock() - t0
+        self._t_decode += _SPEC_EMA * (dt - self._t_decode)
         # one flight event per batched step, not per request — decode is
         # the serve hot loop and the ring must outlast a request's life
         _flight.record("serve.decode", batch=len(active), dur=round(dt, 6))
@@ -359,6 +459,8 @@ class Scheduler:
             s.position += 1
             tok = self.sampler(logits[i], s.req)
             s.req.tokens.append(tok)
+            if s.proposer is not None:  # keep the n-gram index in sync
+                s.proposer.append(tok)
             self.tokens_generated += 1
             self._tpots.append(dt)
             req = s.req
@@ -387,6 +489,126 @@ class Scheduler:
                 "mxnet_serve_tokens_total",
                 help="tokens generated across all requests",
             ).inc(len(active))
+        return True
+
+    def _verify_once(self, active, proposals):
+        """One speculative round: score each lane's proposed drafts at
+        all ``spec_k + 1`` positions in one compiled verify call, accept
+        the longest exactly-matching prefix + one bonus token.  Only
+        reached when some lane's proposer found a real n-gram match
+        (``_decode_once``'s hybrid policy); matchless steps use the
+        cheaper plain decode call.
+
+        Exactness: position j's logits only attend context <= j, so
+        ``sampler(logits[i, j])`` equals what a plain decode at that
+        position would sample.  Draft j+1 is accepted iff it equals that
+        sample; the first disagreement's sample is emitted instead
+        (never wasted — it is exactly the next sequential token).  EOS /
+        budget truncation run over the emitted block in order, so a
+        mid-block stop leaves the same tokens a sequential loop would.
+        """
+        g = self.geometry
+        K = g.spec_k              # compiled verify width (>= runtime)
+        tokens = np.zeros((g.max_batch, K + 1), dtype=np.int32)
+        positions = np.zeros(g.max_batch, dtype=np.int32)
+        tables = np.zeros((g.max_batch, g.max_pages_per_seq),
+                          dtype=np.int32)
+        drafts = {}
+        for i, s in active:
+            req = s.req
+            d = list(proposals[i])
+            d += [d[-1]] * (K - len(d))   # pad to the compiled width
+            drafts[i] = d
+            tokens[i, 0] = req.tokens[-1]
+            tokens[i, 1:] = d
+            positions[i] = s.position
+            tables[i] = s.row
+        t0 = self.clock()
+        try:
+            logits = self.runner.verify(tokens, positions, tables)
+        except Exception as e:
+            for _, s in active:
+                self._fail_slot(s, e)
+            return True
+        self.decode_steps += 1
+        dt = self.clock() - t0
+        total_accepted = total_took = 0
+        for i, s in active:
+            req, d = s.req, drafts[i]
+            emitted, j = [], 0
+            while True:
+                tok = self.sampler(logits[i, j], req)
+                emitted.append(tok)
+                # padded positions past the runtime spec_k never accept
+                if j < self.spec_k and d[j] == tok:
+                    j += 1
+                    continue
+                break
+            accepted = len(emitted) - 1
+            self.spec_proposed += self.spec_k
+            self.spec_accepted += accepted
+            total_accepted += accepted
+            took = 0
+            for tok in emitted:
+                if len(req.tokens) >= req.max_new_tokens:
+                    break
+                req.tokens.append(tok)
+                took += 1
+                if req.eos_id is not None and tok == req.eos_id:
+                    break
+            self.tokens_generated += took
+            total_took += took
+            if took and s.proposer is not None:
+                # index only the tokens that landed: EOS/budget-dropped
+                # block tails must not pollute future proposals
+                s.proposer.extend(req.tokens[-took:])
+            # invariant: position = where the NEXT call's input token
+            # (req.tokens[-1]) sits in the stream
+            s.position = len(req.prompt) + len(req.tokens) - 1
+            self._tpots.append(dt / max(1, took))
+            if req.first_decode_t is None and len(req.tokens) >= 2:
+                req.first_decode_t = self.clock()
+                self._trace_event(
+                    req, "first_decode",
+                    first_decode_s=req.first_decode_t - req.first_token_t)
+                if _metrics.enabled() and req.first_token_t is not None:
+                    _metrics.histogram(
+                        "mxnet_serve_first_decode_seconds",
+                        help="first token -> first decode-step token "
+                             "(TTFT breakdown: decode pipeline entry)",
+                        buckets=_TPOT_BUCKETS,
+                    ).observe(req.first_decode_t - req.first_token_t)
+            if _metrics.enabled():
+                _metrics.histogram(
+                    "mxnet_serve_spec_accept_length",
+                    help="draft tokens accepted per lane per verify call",
+                    buckets=_ACCEPT_BUCKETS).observe(accepted)
+            self._maybe_complete(s)
+        self._t_verify += _SPEC_EMA * (dt - self._t_verify)
+        self._spec_acc_lane += _SPEC_EMA * (
+            total_accepted / len(active) - self._spec_acc_lane)
+        _flight.record("serve.verify", batch=len(active),
+                       accepted=total_accepted, dur=round(dt, 6))
+        if _metrics.enabled():
+            _metrics.histogram(
+                "mxnet_serve_tpot_seconds",
+                help="wall time of one batched decode step",
+                buckets=_TPOT_BUCKETS).observe(dt)
+            _metrics.counter(
+                "mxnet_serve_decode_steps_total",
+                help="batched decode steps executed").inc()
+            _metrics.counter(
+                "mxnet_serve_spec_proposed_tokens_total",
+                help="n-gram draft tokens sent to verify",
+            ).inc(self.spec_k * len(active))
+            _metrics.counter(
+                "mxnet_serve_spec_accepted_tokens_total",
+                help="draft tokens accepted by exact-match verification",
+            ).inc(total_accepted)
+            _metrics.counter(
+                "mxnet_serve_tokens_total",
+                help="tokens generated across all requests",
+            ).inc(total_took)
         return True
 
     # -- completion -------------------------------------------------------
@@ -470,6 +692,12 @@ class Scheduler:
             "ttft_p50_s": self.percentile("ttft", 0.50),
             "ttft_p99_s": self.percentile("ttft", 0.99),
             "tpot_p50_s": self.percentile("tpot", 0.50),
+            "spec_k": self.spec_k, "kv_dtype": self.geometry.kv_dtype,
+            "spec_proposed_tokens": self.spec_proposed,
+            "spec_accepted_tokens": self.spec_accepted,
+            "spec_accept_rate": (self.spec_accepted
+                                 / float(self.spec_proposed)
+                                 if self.spec_proposed else 0.0),
         }
 
     def _count_req(self, status):

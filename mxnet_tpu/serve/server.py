@@ -45,6 +45,15 @@ class AOTRunner:
         self._pad = {b: np.zeros(b, dtype=np.int32)
                      for b in g.prefill_buckets}
 
+    def _call(self, exe, origin, *args):
+        """Drain pending readers, run ``exe`` over the arena state (kv
+        buffers, plus scale arrays for int8), adopt the returned state,
+        hand back the trailing logits output."""
+        self.arena.drain_pending_readers(origin)
+        outs = exe(*self.arena.buffers(), *args)
+        self.arena.adopt(*outs[:-1])
+        return outs[-1]
+
     def prefill(self, bucket, tokens, length, block_row):
         exe = self._exes.get("prefill_%d" % bucket)
         if exe is None:
@@ -52,22 +61,33 @@ class AOTRunner:
                              "bucket %d" % bucket)
         padded = self._pad[bucket].copy()
         padded[:length] = tokens
-        self.arena.drain_pending_readers("serve_prefill")
-        k, v, logits = exe(self.arena.kv_k.data(), self.arena.kv_v.data(),
-                           padded, np.int32(length),
-                           block_row.astype(np.int32))
-        self.arena.adopt(k, v)
+        logits = self._call(exe, "serve_prefill", padded, np.int32(length),
+                            block_row.astype(np.int32))
         _memdump.tag(logits, origin="activation", label="prefill_logits")
         return np.asarray(logits)  # mxlint: allow-host-sync
 
     def decode(self, tokens, positions, block_tables):
-        self.arena.drain_pending_readers("serve_decode")
-        k, v, logits = self._exes["decode"](
-            self.arena.kv_k.data(), self.arena.kv_v.data(),
-            tokens.astype(np.int32), positions.astype(np.int32),
-            block_tables.astype(np.int32))
-        self.arena.adopt(k, v)
+        logits = self._call(self._exes["decode"], "serve_decode",
+                            tokens.astype(np.int32),
+                            positions.astype(np.int32),
+                            block_tables.astype(np.int32))
         _memdump.tag(logits, origin="activation", label="decode_logits")
+        return np.asarray(logits)  # mxlint: allow-host-sync
+
+    def verify(self, tokens, positions, block_tables):
+        """Speculative verify: tokens (B, spec_k+1) -> logits
+        (B, spec_k+1, V), from the bundle's compiled ``verify``
+        executable — still zero live jits."""
+        exe = self._exes.get("verify")
+        if exe is None:
+            raise MXNetError(
+                "bundle has no verify executable — re-export with "
+                "spec_k > 0 to enable speculative decoding")
+        logits = self._call(exe, "serve_verify",
+                            tokens.astype(np.int32),
+                            positions.astype(np.int32),
+                            block_tables.astype(np.int32))
+        _memdump.tag(logits, origin="activation", label="verify_logits")
         return np.asarray(logits)  # mxlint: allow-host-sync
 
 
@@ -78,18 +98,27 @@ class LlamaServer:
     ``generate(prompt) -> tokens``.  Geometry validation happens at
     load (``expect_geometry`` pins fields); admission backpressure
     raises ``ServeQueueFull``.
+
+    ``spec_k`` picks the runtime speculation width (default: whatever
+    the bundle was compiled with; 0 turns it off).  ``kv_dtype`` is an
+    assertion, not a conversion — pass it to refuse a bundle whose
+    arena dtype isn't what the deployment expects.
     """
 
     def __init__(self, bundle_path, expect_geometry=None, queue_depth=None,
-                 sampler=None):
-        from .model import load_serving_executables
+                 sampler=None, spec_k=None, kv_dtype=None):
+        from .model import check_geometry, load_serving_executables
 
         self.geometry, exes = load_serving_executables(
             bundle_path, expect=expect_geometry)
+        if kv_dtype is not None:
+            check_geometry(self.geometry, {"kv_dtype": str(kv_dtype)},
+                           origin=bundle_path)
         self.arena = PagedKVArena(self.geometry)
         self.runner = AOTRunner(exes, self.arena)
         self.scheduler = Scheduler(self.runner, self.arena,
-                                   queue_depth=queue_depth, sampler=sampler)
+                                   queue_depth=queue_depth, sampler=sampler,
+                                   spec_k=spec_k)
         self._stop = threading.Event()
         self._thread = None
         self._http = None

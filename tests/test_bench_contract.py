@@ -44,6 +44,16 @@ _STUB_VALUES = {"train": 100.0, "infer": 200.0, "bert": 300.0,
                           "ttft_p50_ms": 10.0, "ttft_p99_ms": 50.0,
                           "tpot_p50_ms": 2.0, "completed": 64,
                           "n_requests": 64, "live_compiles": 0},
+                # speculative serving runner (ISSUE 13): spec-on tok/s
+                # as value, spec-off baseline + acceptance + int8 kv
+                # byte ratio as extras (parity asserted in the probe)
+                "serve_spec": {"value": 1500.0, "spec_off_tok_s": 1000.0,
+                               "spec_vs_off": 1.5, "accept_rate": 0.3,
+                               "spec_accepted_tokens": 400,
+                               "parity_checked": 64,
+                               "kv_bytes_int8": 1000, "kv_bytes_fp32": 4000,
+                               "kv_bytes_ratio": 0.25, "completed": 64,
+                               "n_requests": 64, "live_compiles": 0},
                 # planner runner (ISSUE 11): median plan seconds as
                 # value, the ms-precision figure rides along
                 "planner": {"value": 0.0, "planner_ms": 0.9,
@@ -98,6 +108,7 @@ def test_default_mode_emits_all_metrics_in_one_line(monkeypatch, capsys):
                      "imperative_dispatch_bulked_train",
                      "imperative_dispatch_bulked_long",
                      "llama_serve_tok_s",
+                     "llama_serve_spec_tok_s",
                      "planner_seconds",
                      "resnet50_cold_start_seconds",
                      "bert_cold_start_seconds",
@@ -129,6 +140,18 @@ def test_default_mode_emits_all_metrics_in_one_line(monkeypatch, capsys):
     assert srv["continuous_vs_static"] == 2.0
     assert srv["ttft_p50_ms"] == 10.0 and srv["ttft_p99_ms"] == 50.0
     assert srv["live_compiles"] == 0
+    # speculative serving record (ISSUE 13): spec-on tok/s is the
+    # value; the spec-off baseline from the SAME bundle, the n-gram
+    # acceptance rate, and the int8/fp32 kv_page byte ratio ride along
+    # (the >=1.3x and <=0.55x claims are checked against these fields)
+    sspec = by_name["llama_serve_spec_tok_s"]
+    assert sspec["value"] == 1500.0 and sspec["unit"] == "tokens/sec"
+    assert sspec["spec_off_tok_s"] == 1000.0
+    assert sspec["spec_vs_off"] == 1.5
+    assert sspec["accept_rate"] == 0.3
+    assert sspec["kv_bytes_ratio"] == 0.25
+    assert sspec["parity_checked"] == 64
+    assert sspec["live_compiles"] == 0
     # planner record (ISSUE 11): static analysis latency, LOWER better;
     # the ms-precision figure survives the 2-decimal value rounding
     plan = by_name["planner_seconds"]
@@ -147,7 +170,7 @@ def test_budget_exhaustion_marks_skipped(monkeypatch, capsys):
                       if ln.startswith("{")][-1])
     assert rec["value"] == 100.0  # headline always measured
     skipped = [m for m in rec["metrics"] if m.get("skipped")]
-    assert len(skipped) == 13
+    assert len(skipped) == 14
     assert all(m["value"] == 0.0 for m in skipped)
 
 
@@ -177,6 +200,8 @@ def test_failed_benchmark_emits_zero_not_crash(monkeypatch, capsys):
         "dispatch_bulked_long": (
             boom, "imperative_dispatch_bulked_long", "ops/sec", None),
         "serve": (boom, "llama_serve_tok_s", "tokens/sec", None),
+        "serve_spec": (boom, "llama_serve_spec_tok_s", "tokens/sec",
+                       None),
         "planner": (boom, "planner_seconds", "seconds", None),
         "cold_resnet50": (boom, "resnet50_cold_start_seconds", "seconds",
                           None),
@@ -188,4 +213,4 @@ def test_failed_benchmark_emits_zero_not_crash(monkeypatch, capsys):
     rec = json.loads([ln for ln in capsys.readouterr().out.splitlines()
                       if ln.startswith("{")][-1])
     assert rec["value"] == 0.0 and rec["fallback"] is True
-    assert len(rec["metrics"]) == 14
+    assert len(rec["metrics"]) == 15

@@ -426,6 +426,361 @@ def test_arena_stress_never_reuses_live_page():
         assert float(p.asnumpy()) == 0.0
 
 
+# -- n-gram proposer (ISSUE 13) ------------------------------------------
+
+def test_propose_ngram_replays_longest_match():
+    from mxnet_tpu.serve import propose_ngram
+
+    # 2-gram [1, 2] matched at the start; continuation replayed
+    assert propose_ngram([1, 2, 3, 1, 2], 3) == [3, 1, 2]
+
+
+def test_propose_ngram_prefers_most_recent_match():
+    from mxnet_tpu.serve import propose_ngram
+
+    # [1, 2] occurs twice; the recent occurrence continues with 9, not 5
+    assert propose_ngram([7, 1, 2, 5, 1, 2, 9, 1, 2], 1) == [9]
+
+
+def test_propose_ngram_pads_match_near_the_end():
+    from mxnet_tpu.serve import propose_ngram
+
+    # 1-gram [4] matches at index 0, continuation [9, 4] pads to k=3
+    assert propose_ngram([4, 9, 4], 3) == [9, 4, 4]
+
+
+def test_propose_ngram_fallback_repeats_last_token():
+    from mxnet_tpu.serve import propose_ngram
+
+    assert propose_ngram([1, 2, 3], 2) == [3, 3]
+    assert propose_ngram([5], 4) == [5, 5, 5, 5]
+
+
+def test_propose_ngram_validates_inputs():
+    from mxnet_tpu.serve import propose_ngram
+
+    with pytest.raises(MXNetError, match="k > 0"):
+        propose_ngram([1, 2], 0)
+    with pytest.raises(MXNetError, match="non-empty"):
+        propose_ngram([], 2)
+
+
+def test_ngram_proposer_matches_scan_proposer():
+    # the incremental index the scheduler uses must reproduce the scan
+    # version exactly — drafts AND match length — under incremental
+    # appends, across random repetitive streams
+    from mxnet_tpu.serve import NgramProposer, propose_ngram
+
+    rng = np.random.default_rng(13)
+    for _ in range(20):
+        hist = [int(t) for t in rng.integers(0, 6, size=40)]
+        inc = NgramProposer(hist[:3])
+        for i in range(3, len(hist)):
+            inc.append(hist[i])
+            got = inc.propose(4)
+            want = propose_ngram(hist[:i + 1], 4, with_match=True)
+            assert got == tuple(want) or list(got) == list(want), \
+                (hist[:i + 1], got, want)
+
+
+def test_ngram_proposer_validates_inputs():
+    from mxnet_tpu.serve import NgramProposer
+
+    with pytest.raises(MXNetError, match="k > 0"):
+        NgramProposer([1, 2]).propose(0)
+    with pytest.raises(MXNetError, match="non-empty"):
+        NgramProposer([]).propose(2)
+
+
+# -- speculative scheduling (ISSUE 13) ------------------------------------
+
+class ScriptedSpecRunner:
+    """Position-indexed ground truth: the model's output after the token
+    at stream position p is ``seq[p + 1]`` (one-hot logits), regardless
+    of how positions are grouped into prefill/decode/verify calls —
+    exactly the property the compiled verify graph guarantees."""
+
+    def __init__(self, geometry, seq):
+        self.g = geometry
+        self.seq = seq
+        self.prefills = []
+        self.decodes = []
+        self.verifies = []
+
+    def _onehot(self, tok):
+        v = np.zeros(self.g.vocab_size, np.float32)
+        v[int(tok)] = 1.0
+        return v
+
+    def prefill(self, bucket, tokens, length, block_row):
+        self.prefills.append(int(length))
+        return self._onehot(self.seq[int(length)])
+
+    def decode(self, tokens, positions, block_tables):
+        self.decodes.append(np.array(positions))
+        out = np.zeros((self.g.max_batch, self.g.vocab_size), np.float32)
+        for i, p in enumerate(positions):
+            out[i] = self._onehot(self.seq[int(p) + 1])
+        return out
+
+    def verify(self, tokens, positions, block_tables):
+        self.verifies.append((np.array(tokens), np.array(positions)))
+        k1 = tokens.shape[1]
+        out = np.zeros((self.g.max_batch, k1, self.g.vocab_size),
+                       np.float32)
+        for i in range(tokens.shape[0]):
+            for j in range(k1):
+                out[i, j] = self._onehot(self.seq[int(positions[i]) + j + 1])
+        return out
+
+
+class _CostClock:
+    """Clock the runner advances by a scripted amount per call, so a
+    test can make verify arbitrarily more expensive than decode."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class CostedSpecRunner(ScriptedSpecRunner):
+    def __init__(self, geometry, seq, clk, decode_cost=1.0,
+                 verify_cost=1.0):
+        super().__init__(geometry, seq)
+        self.clk = clk
+        self.decode_cost = decode_cost
+        self.verify_cost = verify_cost
+
+    def decode(self, *a):
+        self.clk.t += self.decode_cost
+        return super().decode(*a)
+
+    def verify(self, *a):
+        self.clk.t += self.verify_cost
+        return super().verify(*a)
+
+
+def test_spec_cost_gate_prefers_decode_when_verify_is_expensive():
+    # cost-aware hybrid policy: identical workload under two cost
+    # regimes.  When a verify call costs more than its acceptance
+    # repays, the scheduler must settle back to plain decode (modulo
+    # cold-start and re-probe verifies) — with output unchanged.
+    seq = list(range(10, 20)) + [5, 6, 7] * 30
+    outs, calls = {}, {}
+    for vcost in (1.0, 10.0):
+        g = tiny_geometry(spec_k=4, num_pages=32, max_pages_per_seq=14)
+        arena = PagedKVArena(g)
+        clk = _CostClock()
+        runner = CostedSpecRunner(g, seq, clk, verify_cost=vcost)
+        sched = Scheduler(runner, arena, queue_depth=8, clock=clk)
+        req = sched.submit(Request(seq[:4], max_new_tokens=40))
+        run_to_completion(sched)
+        outs[vcost] = req.result(timeout=0)
+        calls[vcost] = (len(runner.decodes), len(runner.verifies))
+    assert outs[1.0] == outs[10.0] == seq[4:44]
+    # verify at decode cost: speculation carries the stream
+    assert calls[1.0][1] > calls[10.0][1]
+    # 10x verify: the gate learns the premium never pays here
+    assert calls[10.0][0] > calls[10.0][1]
+
+
+def make_spec_sched(seq, geom=None, spec_k=None):
+    g = geom or tiny_geometry(spec_k=4)
+    arena = PagedKVArena(g)
+    runner = ScriptedSpecRunner(g, seq)
+    sched = Scheduler(runner, arena, queue_depth=8, spec_k=spec_k,
+                      clock=counter_clock())
+    return sched, runner, arena
+
+
+def test_spec_accepts_repeating_sequence_in_blocks():
+    # period-3 ground truth: the n-gram proposer locks on after a few
+    # tokens and verify accepts multi-token blocks
+    seq = [5, 6, 7] * 20
+    sched, runner, _ = make_spec_sched(seq)
+    req = sched.submit(Request(seq[:4], max_new_tokens=8))
+    run_to_completion(sched)
+    assert req.result(timeout=0) == seq[4:12]
+    assert sched.spec_accepted > 0
+    assert runner.decodes == [], "spec_k>0 must use verify, not decode"
+    # speculation must beat one-token-per-step: 8 tokens, 1 from
+    # prefill, the rest in fewer than 7 verify calls
+    assert len(runner.verifies) < 7
+
+
+def test_spec_output_identical_to_spec_off():
+    seq = [3, 1, 4, 1, 5, 9] * 12
+    outs = {}
+    for spec_k in (0, 2, 4):
+        sched, _, _ = make_spec_sched(seq, spec_k=spec_k)
+        req = sched.submit(Request(seq[:5], max_new_tokens=7))
+        run_to_completion(sched)
+        outs[spec_k] = req.result(timeout=0)
+    assert outs[0] == outs[2] == outs[4] == seq[5:12]
+
+
+def test_spec_mid_block_eos_truncates_exactly():
+    seq = [5, 6, 7] * 20
+    sched, runner, _ = make_spec_sched(seq)
+    # eos (=5) falls in the middle of the first accepted verify block
+    req = sched.submit(Request(seq[:4], max_new_tokens=8, eos_id=5))
+    run_to_completion(sched)
+    assert req.result(timeout=0) == [6, 7, 5]
+    assert len(runner.verifies) == 1, \
+        "EOS inside the first block must stop the lane there"
+    # and the truncation point matches plain decode exactly
+    sched0, _, _ = make_spec_sched(seq, spec_k=0)
+    req0 = sched0.submit(Request(seq[:4], max_new_tokens=8, eos_id=5))
+    run_to_completion(sched0)
+    assert req0.result(timeout=0) == req.result(timeout=0)
+
+
+def test_spec_mid_block_budget_truncates_exactly():
+    seq = [5, 6, 7] * 20
+    sched, _, _ = make_spec_sched(seq)
+    req = sched.submit(Request(seq[:4], max_new_tokens=4))
+    run_to_completion(sched)
+    # prefill emits 1, the verify block offers 4 more, budget takes 3
+    assert req.result(timeout=0) == seq[4:8]
+    sched0, _, _ = make_spec_sched(seq, spec_k=0)
+    req0 = sched0.submit(Request(seq[:4], max_new_tokens=4))
+    run_to_completion(sched0)
+    assert req0.result(timeout=0) == req.result(timeout=0)
+
+
+def test_spec_full_rejection_falls_back_to_bonus_token():
+    # the prompt's repeated bigram [1,2] baits the proposer into a
+    # verify block, but the ground truth diverges to fresh tokens —
+    # every draft is rejected and the verify still emits exactly the
+    # one (bonus) token plain decode would have produced
+    seq = [1, 2, 3, 1, 2] + list(range(10, 40))
+    sched, runner, _ = make_spec_sched(seq)
+    req = sched.submit(Request(seq[:4], max_new_tokens=6))
+    run_to_completion(sched)
+    assert req.result(timeout=0) == seq[4:10]
+    assert sched.spec_accepted == 0
+    assert sched.spec_proposed > 0
+    assert len(runner.verifies) == 1  # the baited block, fully rejected
+    assert len(runner.decodes) == 4  # matchless tail uses plain decode
+
+
+def test_spec_matchless_history_uses_plain_decode_path():
+    # hybrid policy: chain ground truth t -> t+1 never repeats an
+    # n-gram, so the scheduler never pays for a verify call at all —
+    # and the output still matches spec-off exactly
+    seq = list(range(32))
+    sched, runner, _ = make_spec_sched(seq)
+    req = sched.submit(Request(seq[:4], max_new_tokens=6))
+    run_to_completion(sched)
+    assert req.result(timeout=0) == seq[4:10]
+    assert runner.verifies == []
+    assert sched.spec_proposed == 0 and sched.spec_accepted == 0
+
+
+def test_spec_headroom_tightens_submit_context_check():
+    # max_context=16; prompt 6 + budget 8 fits plain but not with the
+    # compiled spec_k=4 scatter headroom
+    sched, _, _ = make_spec_sched(list(range(32)))
+    req = sched.submit(Request(list(range(6)), max_new_tokens=8))
+    assert req.done()
+    with pytest.raises(MXNetError, match="spec_k headroom"):
+        req.result(timeout=0)
+    # runtime spec_k=0 on the same bundle geometry restores the old limit
+    sched0, _, _ = make_spec_sched(list(range(32)), spec_k=0)
+    req0 = sched0.submit(Request(list(range(6)), max_new_tokens=8))
+    run_to_completion(sched0)
+    assert req0.result(timeout=0) == list(range(6, 14))
+
+
+def test_runtime_spec_k_validation():
+    g = tiny_geometry(spec_k=4)
+    arena = PagedKVArena(g)
+    with pytest.raises(MXNetError, match="spec_k=5 out of range"):
+        Scheduler(ScriptedSpecRunner(g, []), arena, spec_k=5)
+    g0 = tiny_geometry()  # compiled without speculation
+    with pytest.raises(MXNetError, match="out of range"):
+        Scheduler(FakeRunner(g0), PagedKVArena(g0), spec_k=2)
+
+
+def test_spec_counters_and_stats():
+    seq = [5, 6, 7] * 20
+    sched, _, _ = make_spec_sched(seq)
+    sched.submit(Request(seq[:4], max_new_tokens=8))
+    run_to_completion(sched)
+    st = sched.stats()
+    assert st["spec_k"] == 4 and st["kv_dtype"] == "float32"
+    assert st["spec_proposed_tokens"] == sched.spec_proposed > 0
+    assert st["spec_accepted_tokens"] == sched.spec_accepted > 0
+    assert 0.0 < st["spec_accept_rate"] <= 1.0
+    from mxnet_tpu import telemetry
+
+    snap = telemetry.snapshot()
+    for fam in ("mxnet_serve_spec_proposed_tokens_total",
+                "mxnet_serve_spec_accepted_tokens_total"):
+        assert fam in snap, fam
+    (series,) = snap["mxnet_serve_spec_accept_length"]["series"]
+    assert series["count"] >= 1
+
+
+# -- int8 arena (ISSUE 13) ------------------------------------------------
+
+def test_int8_arena_stores_quantized_pages_and_scales():
+    g = tiny_geometry(kv_dtype="int8")
+    arena = PagedKVArena(g)
+    assert arena.quantized
+    bufs = arena.buffers()
+    assert len(bufs) == 4
+    assert bufs[0].dtype == np.int8 and bufs[1].dtype == np.int8
+    assert bufs[2].shape == g.scale_shape() == (1, 9)
+    assert bufs[2].dtype == np.float32
+    # fp32 arena keeps the historical 2-tuple contract
+    assert len(PagedKVArena(tiny_geometry()).buffers()) == 2
+
+
+def test_int8_arena_adopt_requires_scales():
+    import jax
+
+    g = tiny_geometry(kv_dtype="int8")
+    arena = PagedKVArena(g)
+    k, v, ks, vs = arena.buffers()
+    with pytest.raises(MXNetError, match="scale"):
+        arena.adopt(k, v)
+    arena.adopt(k, v, jax.device_put(np.ones(g.scale_shape(), np.float32)),
+                vs)
+    assert float(np.asarray(arena.k_scale.data())[0, 0]) == 1.0
+
+
+def test_geometry_kv_dtype_and_spec_k_validation():
+    with pytest.raises(MXNetError, match="int8"):
+        tiny_geometry(kv_dtype="int4")
+    with pytest.raises(MXNetError, match="spec_k"):
+        tiny_geometry(spec_k=-1)
+    with pytest.raises(MXNetError, match="spec_k"):
+        tiny_geometry(spec_k=65)
+
+
+def test_old_schema_geometry_dict_defaults_fp32_no_spec():
+    # a pre-PR-13 bundle dict has neither kv_dtype nor spec_k: it must
+    # load as an fp32 arena with speculation off (backward compat)
+    d = tiny_geometry().to_dict()
+    del d["kv_dtype"], d["spec_k"]
+    g = KVGeometry.from_dict(d, origin="old-bundle")
+    assert g.kv_dtype == "float32" and g.spec_k == 0 and not g.quantized
+
+
+def test_check_geometry_names_kv_dtype_and_spec_k():
+    from mxnet_tpu.serve import check_geometry
+
+    got = tiny_geometry(kv_dtype="int8", spec_k=4)
+    with pytest.raises(MXNetError) as ei:
+        check_geometry(got, {"kv_dtype": "float32", "spec_k": 0})
+    msg = str(ei.value)
+    assert "kv_dtype" in msg and "spec_k" in msg
+    assert "int8" in msg and "refusing to serve" in msg
+
+
 # -- request surface -----------------------------------------------------
 
 def test_request_validates_inputs():

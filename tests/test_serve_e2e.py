@@ -174,6 +174,204 @@ def test_fresh_process_serves_with_zero_live_compiles(bundle):
     assert doc["aot_loads"] >= 3  # decode + both prefill buckets
 
 
+# -- speculative decoding + int8 KV (ISSUE 13) ---------------------------
+
+SPEC_K = 4
+
+
+@pytest.fixture(scope="module")
+def spec_bundle(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("serve_spec") / "spec.mxaot")
+    net = micro_llama()
+    geometry = serve.export_serving_bundle(net, path, spec_k=SPEC_K,
+                                           **GEOM_KW)
+    return path, net, geometry
+
+
+@pytest.fixture(scope="module")
+def int8_bundle(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("serve_int8") / "int8.mxaot")
+    net = micro_llama()
+    geometry = serve.export_serving_bundle(net, path, spec_k=SPEC_K,
+                                           kv_dtype="int8", **GEOM_KW)
+    return path, net, geometry
+
+
+def _mixed_prompts(seed, n, max_len=12):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 64, size=int(rng.integers(1, max_len))).tolist()
+            for _ in range(n)]
+
+
+@pytest.mark.parametrize("spec_k", [0, 2, 4])
+def test_spec_parity_fp32_matches_reference(spec_bundle, spec_k):
+    """Greedy output must be token-for-token the full-forward reference
+    at every runtime speculation width — acceptance is exact."""
+    path, net, _ = spec_bundle
+    prompts = _mixed_prompts(7, 8)
+    with serve.LlamaServer(path, spec_k=spec_k) as srv:
+        reqs = [srv.submit(p, max_new_tokens=6) for p in prompts]
+        outs = [r.result(timeout=180) for r in reqs]
+        st = srv.stats()
+    for p, o in zip(prompts, outs):
+        assert o == greedy_reference(net, p, 6), (spec_k, p)
+    if spec_k:
+        assert st["spec_proposed_tokens"] > 0
+
+
+def test_spec_parity_int8_on_off_identical(int8_bundle):
+    """Same int8 bundle, speculation on vs off: identical tokens.  The
+    per-page scale is fixed at each page's slot-0 write and never
+    requantized, so the arena state — and hence every logit — is
+    independent of how tokens were grouped into verify blocks."""
+    path, _, _ = int8_bundle
+    prompts = _mixed_prompts(11, 8)
+    outs = {}
+    for spec_k in (0, 2, 4):
+        with serve.LlamaServer(path, spec_k=spec_k) as srv:
+            reqs = [srv.submit(p, max_new_tokens=8) for p in prompts]
+            outs[spec_k] = [r.result(timeout=180) for r in reqs]
+    assert outs[0] == outs[2] == outs[4]
+
+
+def test_int8_bounded_divergence_from_fp32(int8_bundle):
+    """Int8 is a numerics change, not a correctness bug: the first
+    generated token comes out of prefill (which attends full-precision
+    in-call K/V, so it is EXACT), and the quantized decode tail must
+    track the fp32 reference closely on a micro model."""
+    path, net, _ = int8_bundle
+    prompts = _mixed_prompts(13, 6)
+    with serve.LlamaServer(path, spec_k=0) as srv:
+        outs = [srv.generate(p, max_new_tokens=8) for p in prompts]
+    agree = total = 0
+    for p, o in zip(prompts, outs):
+        ref = greedy_reference(net, p, 8)
+        assert o[0] == ref[0], "prefill token must be exact under int8"
+        agree += sum(a == b for a, b in zip(o, ref))
+        total += len(ref)
+    assert agree / total >= 0.5, \
+        "int8 diverged from fp32 on %d/%d tokens" % (total - agree, total)
+
+
+def test_int8_page_reuse_resets_scales(int8_bundle):
+    """FIFO page recycling: a page freed by one sequence and handed to
+    another must quantize against the NEW owner's slot-0 scale.  Churn
+    the arena through several reuse cycles, then check a fresh server
+    (virgin pages, zero scales) produces the identical sequence."""
+    path, _, geometry = int8_bundle
+    prompts = _mixed_prompts(3, 10, max_len=9)
+    final = [9, 8, 7, 6, 5, 4, 3, 2]
+    with serve.LlamaServer(path) as srv:
+        for p in prompts:
+            srv.generate(p, max_new_tokens=8)
+        used = srv.generate(final, max_new_tokens=8)
+        assert srv.arena.free_pages == srv.arena.total_pages
+    with serve.LlamaServer(path) as srv2:
+        fresh = srv2.generate(final, max_new_tokens=8)
+    assert used == fresh, "a recycled page leaked its previous scale"
+
+
+def test_old_schema_bundle_serves_with_defaults(bundle, tmp_path):
+    """A pre-PR-13 bundle meta carries neither kv_dtype nor spec_k —
+    it must load as fp32 with speculation off and serve identically."""
+    from mxnet_tpu import compile_cache
+
+    path, net, _ = bundle
+    doc = compile_cache.load_bundle(path)
+    meta = dict(doc["meta"])
+    geom = dict(meta["geometry"])
+    del geom["kv_dtype"], geom["spec_k"]
+    meta["geometry"] = geom
+    old = str(tmp_path / "old-schema.mxaot")
+    compile_cache.save_bundle(old, doc["entries"], meta=meta)
+    with serve.LlamaServer(old) as srv:
+        assert srv.geometry.kv_dtype == "float32"
+        assert srv.geometry.spec_k == 0
+        got = srv.generate([3, 1, 4], max_new_tokens=4)
+    assert got == greedy_reference(net, [3, 1, 4], 4)
+
+
+def test_kv_dtype_mismatch_named_at_load(int8_bundle):
+    path, _, _ = int8_bundle
+    with pytest.raises(MXNetError) as ei:
+        serve.LlamaServer(path, kv_dtype="float32")
+    msg = str(ei.value)
+    assert "kv_dtype" in msg and "int8" in msg
+    assert "refusing to serve" in msg
+
+
+def test_healthz_reports_kv_dtype_and_spec(int8_bundle):
+    path, _, _ = int8_bundle
+    with serve.LlamaServer(path) as srv:
+        st = srv.healthz()
+    assert st["kv_dtype"] == "int8" and st["spec_k"] == SPEC_K
+
+
+def test_memdump_kv_page_bytes_roughly_halve(spec_bundle, int8_bundle):
+    """The tentpole memory claim, at identical geometry: int8 pages +
+    two f32 scale arrays must come in at <= 0.55x the fp32 arena."""
+    _, _, g32 = spec_bundle
+    _, _, g8 = int8_bundle
+    a32 = serve.PagedKVArena(g32)
+    a8 = serve.PagedKVArena(g8)
+    bytes32 = sum(b.nbytes for b in a32.buffers())
+    bytes8 = sum(b.nbytes for b in a8.buffers())
+    assert bytes8 <= 0.55 * bytes32, (bytes8, bytes32)
+
+
+_SPEC_PROC = r"""
+import json, os, sys
+import numpy as np
+from mxnet_tpu import serve
+from mxnet_tpu.telemetry import metrics as M
+
+srv = serve.LlamaServer(sys.argv[1]).start()
+wl = serve.poisson_workload(6, rate_rps=1e9, prompt_range=(1, 12),
+                            max_new_range=(16, 32), vocab_size=64, seed=2)
+reqs, _ = serve.drive_workload(srv, wl, timeout=180)
+st = srv.stats()
+srv.stop()
+snap = M.snapshot()
+
+
+def fam(name):
+    return sum(s["value"] for s in snap.get(name, {}).get("series", []))
+
+
+doc = {
+    "completed": sum(1 for r in reqs if r.error is None),
+    "compiles": fam("mxnet_compiles_total"),
+    "aot_loads": fam("mxnet_compile_cache_aot_loads_total"),
+    "spec_proposed": fam("mxnet_serve_spec_proposed_tokens_total"),
+    "spec_accepted": fam("mxnet_serve_spec_accepted_tokens_total"),
+    "kv_dtype": st["kv_dtype"],
+}
+print("RESULT " + json.dumps(doc))
+"""
+
+
+def test_spec_int8_process_zero_live_compiles(int8_bundle):
+    """The ISSUE 13 zero-live-jit claim: a fresh process serving the
+    spec_k=4/int8 bundle runs verify from the MXAOT1 bundle, accepts
+    drafts, and never jits."""
+    path, _, _ = int8_bundle
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXNET_TELEMETRY"] = "1"
+    r = subprocess.run([sys.executable, "-c", _SPEC_PROC, path],
+                       cwd=REPO, env=env, capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 0, r.stderr
+    doc = json.loads(r.stdout.split("RESULT ", 1)[1])
+    assert doc["completed"] == 6
+    assert doc["compiles"] == 0, \
+        "a serving process must never jit, even with verify in the loop"
+    assert doc["aot_loads"] >= 4  # decode + verify + both prefill buckets
+    assert doc["spec_accepted"] > 0, \
+        "n-gram speculation accepted nothing on a cyclic greedy stream"
+    assert doc["kv_dtype"] == "int8"
+
+
 # -- HTTP front ----------------------------------------------------------
 
 def test_http_generate_metrics_healthz(bundle):
