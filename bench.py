@@ -990,6 +990,140 @@ def _run_serve_spec(platform):
             "live_compiles": doc["live_compiles"]}
 
 
+def _serve_paged_export(dirpath):
+    """Subprocess entry (`--serve-paged-export <dir>`): AOT-compile TWO
+    llama_small serving bundles from the SAME seeded net at the SAME
+    spec_k=2 / int8 paging geometry — one with the paged-attention
+    kernel baked in (``paged_kernel="1"``: compiled Pallas on TPU, the
+    interpreter trace elsewhere) and one on the gather + grouped-einsum
+    reference (``"0"``).  The choice lives in the bundle's geometry
+    meta, so the probe process picks a path by picking a file."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, serve
+    from mxnet_tpu.gluon.model_zoo import llama
+
+    mx.random.seed(0)
+    net = llama.llama_small()
+    net.initialize()
+    net(nd.array(np.zeros((1, 4), np.int32)))
+    for mode, fname in (("1", "paged_on.mxaot"), ("0", "paged_off.mxaot")):
+        g = serve.export_serving_bundle(
+            net, os.path.join(dirpath, fname), page_size=8, num_pages=512,
+            max_batch=8, prefill_buckets=(16, 32), spec_k=2,
+            kv_dtype="int8", paged_kernel=mode)
+        assert g.paged_kernel == mode, g.describe()
+        _log("serve paged export (%s): %s" % (fname, g.describe()))
+    print("SERVE_PAGED_EXPORT_OK", flush=True)
+
+
+def _serve_paged_probe(dirpath):
+    """Subprocess entry (`--serve-paged-probe <dir>`): kernel-on vs
+    kernel-off on the same seeded workload, token parity asserted here.
+
+    Serves the 64-request Poisson workload through the ``paged_on``
+    bundle, then through ``paged_off``; greedy decoding means the two
+    bundles must emit token-for-token identical streams — asserted in
+    this process, so a parity break zeroes the metric instead of
+    shipping a wrong speedup.  Each side is the median of
+    ``_SERVE_REPLAYS`` replays.  The memdump peak watermark is reset
+    between the sides: the on/off byte ratio is the kernel's HBM story
+    (the reference gathers + dequantizes every lane's full context per
+    step; the kernel streams page tiles)."""
+    from mxnet_tpu import serve
+    from mxnet_tpu.telemetry import memdump
+    from mxnet_tpu.telemetry import metrics as telemetry_metrics
+
+    def one_side(fname):
+        memdump.reset()
+        srv = serve.LlamaServer(os.path.join(dirpath, fname)).start()
+        rates, reqs = [], None
+        for _ in range(_SERVE_REPLAYS):
+            wl = serve.poisson_workload(_SERVE_N_REQUESTS,
+                                        **_SERVE_WORKLOAD)
+            run_reqs, wall = serve.drive_workload(srv, wl, timeout=600)
+            done = [r for r in run_reqs if r.error is None]
+            rates.append(sum(len(r.tokens) for r in done) / wall)
+            reqs = reqs if reqs is not None else run_reqs
+        srv.stop()
+        memdump.refresh()
+        return _median(rates), reqs, int(memdump.peak_bytes())
+
+    on_rate, on_reqs, on_peak = one_side("paged_on.mxaot")
+    off_rate, off_reqs, off_peak = one_side("paged_off.mxaot")
+
+    mismatched = sum(
+        1 for a, b in zip(on_reqs, off_reqs)
+        if a.error is None and b.error is None and a.tokens != b.tokens)
+    if mismatched:
+        raise AssertionError(
+            "paged-attention kernel changed %d/%d request token streams "
+            "vs the reference path" % (mismatched, len(on_reqs)))
+
+    snap = telemetry_metrics.snapshot()
+    compiles = sum(s["value"] for s in snap.get(
+        "mxnet_compiles_total", {}).get("series", []))
+    parity_ok = sum(1 for r in on_reqs if r.error is None)
+    doc = {
+        "paged_tok_s": round(on_rate, 2),
+        "paged_off_tok_s": round(off_rate, 2),
+        "parity_checked": parity_ok,
+        "completed": parity_ok,
+        "n_requests": len(on_reqs),
+        "paged_peak_bytes": on_peak,
+        "ref_peak_bytes": off_peak,
+        "paged_attn_hbm_bytes_ratio":
+            round(on_peak / off_peak, 4) if off_peak else 0.0,
+        "live_compiles": int(compiles),
+    }
+    print("SERVE_PAGED_RESULT=%s" % json.dumps(doc), flush=True)
+
+
+def _run_serve_paged(platform):
+    """`llama_serve_paged_tok_s`: the paged-attention decode kernel vs
+    the gather + grouped-einsum reference, same int8/spec_k=2 bundle
+    geometry, same 64-request Poisson workload as `llama_serve_tok_s`.
+
+    Two fresh subprocesses: ``--serve-paged-export`` compiles BOTH
+    bundles (kernel choice is baked at export, recorded in geometry
+    meta), then ``--serve-paged-probe`` serves the workload through
+    each with token parity asserted between the sides.  The metric
+    value is kernel-on tok/s; the kernel-off baseline and the memdump
+    peak-byte ratio ride along.  Off-TPU the "kernel" side is the
+    interpreter trace (CI parity path), so the CPU number is a
+    correctness canary, not the TPU speedup."""
+    import shutil
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="mxnet-serve-paged-bench-")
+    env = dict(os.environ)
+    try:
+        _probe_subprocess(["--serve-paged-export", tmp], env,
+                          "SERVE_PAGED_EXPORT_OK", "serve paged export")
+        doc = json.loads(_probe_subprocess(
+            ["--serve-paged-probe", tmp], env, "SERVE_PAGED_RESULT=",
+            "serve paged"))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    off = doc["paged_off_tok_s"]
+    speedup = round(doc["paged_tok_s"] / off, 2) if off else 0.0
+    _log("serve paged: %.1f tok/s kernel-on vs %.1f kernel-off (%.2fx), "
+         "peak bytes on/off %.2f, %d/%d completed, %d live compiles"
+         % (doc["paged_tok_s"], off, speedup,
+            doc["paged_attn_hbm_bytes_ratio"], doc["completed"],
+            doc["n_requests"], doc["live_compiles"]))
+    return {"value": doc["paged_tok_s"],
+            "paged_off_tok_s": off,
+            "paged_vs_off": speedup,
+            "parity_checked": doc["parity_checked"],
+            "paged_peak_bytes": doc["paged_peak_bytes"],
+            "ref_peak_bytes": doc["ref_peak_bytes"],
+            "paged_attn_hbm_bytes_ratio":
+                doc["paged_attn_hbm_bytes_ratio"],
+            "completed": doc["completed"],
+            "n_requests": doc["n_requests"],
+            "live_compiles": doc["live_compiles"]}
+
+
 def _run_planner(platform):
     """`python bench.py planner`: wall-clock seconds for one auto-sharding
     plan of the llama_small parameter tree on an abstract 4x2 mesh
@@ -1075,6 +1209,10 @@ _SPECS = {
     "serve": (_run_serve, "llama_serve_tok_s", "tokens/sec", None),
     "serve_spec": (_run_serve_spec, "llama_serve_spec_tok_s",
                    "tokens/sec", None),
+    # paged-attention kernel vs reference on the same workload; value is
+    # kernel-on tok/s, the off baseline + memdump byte ratio ride along
+    "serve_paged": (_run_serve_paged, "llama_serve_paged_tok_s",
+                    "tokens/sec", None),
     # auto-sharding planner latency: pure host-side static analysis,
     # LOWER is better (it is the rules="auto" first-step tax)
     "planner": (_run_planner, "planner_seconds", "seconds", None),
@@ -1147,6 +1285,12 @@ def main():
     if len(sys.argv) >= 3 and sys.argv[1] == "--serve-spec-probe":
         _serve_spec_probe(sys.argv[2])  # subprocess: spec on/off + parity
         return
+    if len(sys.argv) >= 3 and sys.argv[1] == "--serve-paged-export":
+        _serve_paged_export(sys.argv[2])  # subprocess: kernel + ref jits
+        return
+    if len(sys.argv) >= 3 and sys.argv[1] == "--serve-paged-probe":
+        _serve_paged_probe(sys.argv[2])  # subprocess: on/off + parity
+        return
     t_start = time.perf_counter()
     requested = [a for a in sys.argv[1:] if a in _SPECS and a != "train"]
     try:
@@ -1171,7 +1315,7 @@ def main():
     for name in ("infer", "bert", "llama", "dispatch_eager",
                  "dispatch_eager_notelemetry", "dispatch_bulked",
                  "dispatch_bulked_train", "dispatch_bulked_long",
-                 "serve", "serve_spec", "planner",
+                 "serve", "serve_spec", "serve_paged", "planner",
                  "cold_resnet50", "cold_bert",
                  "cold_llama"):
         elapsed = time.perf_counter() - t_start

@@ -90,7 +90,8 @@ class KVGeometry:
                  units, hidden_size, vocab_size, page_size, num_pages,
                  max_pages_per_seq, max_batch, prefill_buckets,
                  dtype="float32", rope_base=10000.0, eps=1e-6,
-                 tie_embeddings=False, kv_dtype=None, spec_k=0):
+                 tie_embeddings=False, kv_dtype=None, spec_k=0,
+                 paged_kernel=None):
         self.num_layers = int(num_layers)
         self.num_heads = int(num_heads)
         self.num_kv_heads = int(num_kv_heads)
@@ -111,6 +112,17 @@ class KVGeometry:
         # carries neither loads as an fp32 arena with speculation off
         self.kv_dtype = str(kv_dtype) if kv_dtype else self.dtype
         self.spec_k = int(spec_k)
+        # PR 14: which decode/verify attention the executables were
+        # BUILT with — "auto" (Pallas kernel on TPU, XLA reference
+        # elsewhere), "1" (kernel forced; interpreter off-TPU), "0"
+        # (reference forced).  Baked at export: a bundle records the
+        # choice in its meta, a loaded server inherits it.  Old bundle
+        # dicts lack the field and load as "auto".
+        if paged_kernel is None or paged_kernel == "":
+            paged_kernel = "auto"
+        if isinstance(paged_kernel, bool) or isinstance(paged_kernel, int):
+            paged_kernel = str(int(paged_kernel))
+        self.paged_kernel = str(paged_kernel).lower()
         self.validate()
 
     @property
@@ -145,6 +157,11 @@ class KVGeometry:
             raise MXNetError("spec_k must be in [0, 64] (draft tokens "
                              "verified per decode call), got %d"
                              % self.spec_k)
+        if self.paged_kernel not in ("auto", "0", "1"):
+            raise MXNetError(
+                "paged_kernel must be 'auto', '0' or '1' (see "
+                "MXNET_SERVE_PAGED_KERNEL in docs/env_vars.md), got %r"
+                % self.paged_kernel)
 
     def to_dict(self):
         return {
@@ -159,6 +176,7 @@ class KVGeometry:
             "dtype": self.dtype, "rope_base": self.rope_base,
             "eps": self.eps, "tie_embeddings": self.tie_embeddings,
             "kv_dtype": self.kv_dtype, "spec_k": self.spec_k,
+            "paged_kernel": self.paged_kernel,
         }
 
     @classmethod
@@ -188,11 +206,12 @@ class KVGeometry:
 
     def describe(self):
         return ("layers=%d heads=%d/%d head_dim=%d pages=%dx%d "
-                "max_batch=%d buckets=%s dtype=%s kv_dtype=%s spec_k=%d"
+                "max_batch=%d buckets=%s dtype=%s kv_dtype=%s spec_k=%d "
+                "paged_kernel=%s"
                 % (self.num_layers, self.num_heads, self.num_kv_heads,
                    self.head_dim, self.num_pages, self.page_size,
                    self.max_batch, list(self.prefill_buckets), self.dtype,
-                   self.kv_dtype, self.spec_k))
+                   self.kv_dtype, self.spec_k, self.paged_kernel))
 
 
 def _env_int(name, default):
@@ -214,7 +233,7 @@ def default_buckets():
 
 def geometry_from_net(net, page_size=None, num_pages=None, max_batch=None,
                       prefill_buckets=None, max_pages_per_seq=None,
-                      kv_dtype=None, spec_k=None):
+                      kv_dtype=None, spec_k=None, paged_kernel=None):
     """Derive a :class:`KVGeometry` from a ``LlamaModel`` block tree,
     filling paging knobs from ``MXNET_SERVE_*`` env defaults."""
     blocks = list(net.blocks._children.values())
@@ -229,6 +248,9 @@ def geometry_from_net(net, page_size=None, num_pages=None, max_batch=None,
         or os.environ.get("MXNET_SERVE_KV_DTYPE", "").strip() or None
     spec_k = spec_k if spec_k is not None \
         else _env_int("MXNET_SERVE_SPEC_K", 0)
+    if paged_kernel is None:
+        paged_kernel = os.environ.get("MXNET_SERVE_PAGED_KERNEL",
+                                      "").strip() or None
     buckets = tuple(prefill_buckets) if prefill_buckets \
         else default_buckets()
     if max_pages_per_seq is None:
@@ -249,7 +271,7 @@ def geometry_from_net(net, page_size=None, num_pages=None, max_batch=None,
         max_batch=max_batch, prefill_buckets=buckets,
         dtype=str(embed_w.dtype), rope_base=attn._base,
         eps=blocks[0].attn_norm._eps, tie_embeddings=net._tie,
-        kv_dtype=kv_dtype, spec_k=spec_k)
+        kv_dtype=kv_dtype, spec_k=spec_k, paged_kernel=paged_kernel)
 
 
 def _pull(param):
@@ -352,6 +374,8 @@ def build_step_fn(weights, geometry, k1):
     import jax
     import jax.numpy as jnp
 
+    from ..ops.paged_attention import paged_attention as _paged_attn
+
     embed, layers, norm, head = weights
     g = geometry
     H, KV, D, S = g.num_heads, g.num_kv_heads, g.head_dim, g.page_size
@@ -359,6 +383,14 @@ def build_step_fn(weights, geometry, k1):
     ctx = g.max_pages_per_seq * S
     int8 = g.quantized
     jidx = jnp.arange(k1)
+    # attention path, resolved at BUILD time (the executable is AOT-
+    # compiled for the default backend, so there is nothing to defer):
+    # "1" forces the Pallas kernel (interpreter off-TPU — it traces to
+    # plain jax ops and serializes into the bundle, the CI parity
+    # path), "0" forces the gather + grouped-einsum reference, "auto"
+    # takes the kernel on TPU and the reference elsewhere.
+    kernel = g.paged_kernel == "1" or (
+        g.paged_kernel == "auto" and jax.default_backend() == "tpu")
 
     def append(kv, sc, li, pid, slot, rows):
         """Scatter ``rows`` (B, k1, KV, D) at (li, pid, slot); quantize
@@ -415,15 +447,30 @@ def build_step_fn(weights, geometry, k1):
             v = (h @ lw["v"].T).reshape(b, k1, KV, D)
             kv_k, k_sc = append(kv_k, k_sc, li, pid, slot, k)
             kv_v, v_sc = append(kv_v, v_sc, li, pid, slot, v)
-            keys = gather(kv_k, k_sc, li, block_table, b, x.dtype)
-            vals = gather(kv_v, v_sc, li, block_table, b, x.dtype)
-            keys = jnp.repeat(keys, H // KV, axis=2)         # GQA repeat
-            vals = jnp.repeat(vals, H // KV, axis=2)
-            scores = jnp.einsum("bkhd,bchd->bkhc", q, keys) * scale
-            scores = jnp.where(valid[:, :, None, :],
-                               scores.astype(jnp.float32), -1e30)
-            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-            att = jnp.einsum("bkhc,bchd->bkhd", probs, vals)
+            if kernel:
+                # fused gather + dequant + online-softmax attention
+                # straight off the arena's pages — no (B, ctx, KV, D)
+                # HBM materialization, no fp32 dequant copy, no GQA
+                # replication (ops/paged_attention.py)
+                sc_args = (k_sc[li], v_sc[li]) if int8 else ()
+                att = _paged_attn(q, kv_k[li], kv_v[li], block_table,
+                                  positions, *sc_args, scale=scale,
+                                  use_kernel=1)
+            else:
+                # XLA reference: still gathers the context, but attends
+                # grouped heads (B, k1, KV, G, ctx) directly — K/V are
+                # never replicated H/KV-fold (bitwise-identical logits
+                # to the old jnp.repeat form, tests/test_paged_attention
+                # .py::test_grouped_einsum_matches_repeat_bitwise)
+                keys = gather(kv_k, k_sc, li, block_table, b, x.dtype)
+                vals = gather(kv_v, v_sc, li, block_table, b, x.dtype)
+                qg = q.reshape(b, k1, KV, H // KV, D)
+                scores = jnp.einsum("bkvgd,bcvd->bkvgc", qg, keys) * scale
+                scores = jnp.where(valid[:, :, None, None, :],
+                                   scores.astype(jnp.float32), -1e30)
+                probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+                att = jnp.einsum("bkvgc,bcvd->bkvgd", probs, vals) \
+                    .reshape(b, k1, H, D)
             x = x + att.reshape(b, k1, H * D) @ lw["o"].T
             h2 = _rmsnorm(x, lw["ffn_norm"], g.eps)
             x = x + (jax.nn.silu(h2 @ lw["gate"].T)
@@ -530,13 +577,15 @@ def build_prefill_fn(weights, geometry, bucket):
             v = (h @ lw["v"].T).reshape(t, KV, D)
             kv_k, k_sc = append(kv_k, k_sc, li, k)
             kv_v, v_sc = append(kv_v, v_sc, li, v)
-            keys = jnp.repeat(k, H // KV, axis=1)            # (T, H, D)
-            vals = jnp.repeat(v, H // KV, axis=1)
-            scores = jnp.einsum("thd,uhd->htu", q, keys) * scale
-            scores = jnp.where(causal[None, :, :],
+            # grouped-head attention: queries fold to (T, KV, G, D) so
+            # K/V are never replicated H/KV-fold (bitwise-identical to
+            # the old jnp.repeat form; head h = kv*G + g ordering)
+            qg = q.reshape(t, KV, H // KV, D)
+            scores = jnp.einsum("tvgd,uvd->vgtu", qg, k) * scale
+            scores = jnp.where(causal[None, None, :, :],
                                scores.astype(jnp.float32), -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-            att = jnp.einsum("htu,uhd->thd", probs, vals)
+            att = jnp.einsum("vgtu,uvd->tvgd", probs, v)
             x = x + att.reshape(t, H * D) @ lw["o"].T
             h2 = _rmsnorm(x, lw["ffn_norm"], g.eps)
             x = x + (jax.nn.silu(h2 @ lw["gate"].T)
@@ -640,7 +689,7 @@ def compile_serving_executables(net, geometry):
 def export_serving_bundle(net, path, page_size=None, num_pages=None,
                           max_batch=None, prefill_buckets=None,
                           max_pages_per_seq=None, mesh=None,
-                          kv_dtype=None, spec_k=None):
+                          kv_dtype=None, spec_k=None, paged_kernel=None):
     """Export ``net`` as a self-contained MXAOT1 serving bundle.
 
     The bundle carries the AOT-compiled decode + per-bucket prefill
@@ -648,8 +697,11 @@ def export_serving_bundle(net, path, page_size=None, num_pages=None,
     meta, so ``serve.LlamaServer(path)`` starts with zero live compiles.
     Paging knobs default from ``MXNET_SERVE_*`` (docs/env_vars.md);
     ``kv_dtype="int8"`` quantizes the arena pages, ``spec_k=K`` adds the
-    compiled ``verify`` executable for n-gram speculative decoding.
-    Returns the geometry.
+    compiled ``verify`` executable for n-gram speculative decoding, and
+    ``paged_kernel`` ("auto"/"1"/"0", default from
+    ``MXNET_SERVE_PAGED_KERNEL``) picks the decode/verify attention the
+    executables are built with — the choice is baked into the compiled
+    graphs and recorded in the geometry meta.  Returns the geometry.
 
     ``mesh`` (a Mesh / axes dict — abstract, no devices needed) runs the
     auto-sharding planner over the weight tree and stores its decision
@@ -665,7 +717,8 @@ def export_serving_bundle(net, path, page_size=None, num_pages=None,
                           max_batch=max_batch,
                           prefill_buckets=prefill_buckets,
                           max_pages_per_seq=max_pages_per_seq,
-                          kv_dtype=kv_dtype, spec_k=spec_k)
+                          kv_dtype=kv_dtype, spec_k=spec_k,
+                          paged_kernel=paged_kernel)
     meta = {"kind": BUNDLE_KIND, "geometry": g.to_dict()}
     if mesh is not None:
         from .. import planner as _planner

@@ -74,6 +74,17 @@ _SPEC_MATCH_MIN_FRAC = 0.625
 # unaffected.
 _SPEC_PROBE_EVERY = 32
 _SPEC_EMA = 0.2
+# verify's fixed overhead is real even at K=1 (~1.4x a decode on CPU,
+# ROADMAP item 2).  When the EMAs are warm, the measured acceptance has
+# settled below the compiled draft width, and the cost gate has rejected
+# the verify path for this many consecutive (probe) verifies, the
+# scheduler goes DORMANT: it stops running the per-lane n-gram proposers
+# entirely instead of padding too-short drafts to the compiled width, so
+# a converged non-speculative phase pays zero speculation overhead per
+# step.  Re-probes still fire every _SPEC_PROBE_EVERY steps and one
+# winning probe wakes the path back up, so workload drift is tracked
+# exactly as before — dormancy can only ever cost probe overhead.
+_SPEC_DORMANT_AFTER = 3
 
 
 class ServeQueueFull(MXNetError):
@@ -222,6 +233,8 @@ class Scheduler:
         self._t_verify = 0.0
         self._spec_acc_lane = float(self.spec_k)
         self._spec_skipped = 0    # eligible steps since the last verify
+        self._spec_lose_streak = 0  # consecutive gate-rejected verifies
+        self._spec_dormant = False  # proposers parked until a probe wins
         self._ttfts = collections.deque(maxlen=4096)
         self._tpots = collections.deque(maxlen=4096)
         # per-request traces (GET /v1/trace/<id>): bounded FIFO so a
@@ -401,7 +414,14 @@ class Scheduler:
                       if s is not None]
         if not active:
             return False
-        if self.spec_k > 0:
+        if self.spec_k > 0 and self._spec_dormant \
+                and self._spec_skipped < _SPEC_PROBE_EVERY:
+            # dormant: the path converged below the compiled width and
+            # kept losing — skip the per-lane proposers entirely (the
+            # verify call's fixed overhead AND the draft padding are
+            # gone, not just the acceptance) until the next re-probe
+            self._spec_skipped += 1
+        elif self.spec_k > 0:
             proposals = {}
             matched = 0
             for i, s in active:
@@ -587,6 +607,20 @@ class Scheduler:
         self._t_verify += _SPEC_EMA * (dt - self._t_verify)
         self._spec_acc_lane += _SPEC_EMA * (
             total_accepted / len(active) - self._spec_acc_lane)
+        # dormancy bookkeeping (see _SPEC_DORMANT_AFTER): a verify that
+        # the warm cost gate would now reject, with acceptance settled
+        # below the compiled width, extends the losing streak; any
+        # winning verify resets it and wakes a dormant path immediately
+        warm = self._t_decode > 0.0 and self._t_verify > 0.0
+        loses = warm and (1.0 + self._spec_acc_lane) * self._t_decode \
+            < 1.05 * self._t_verify
+        if loses and self._spec_acc_lane < float(self.geometry.spec_k):
+            self._spec_lose_streak += 1
+            if self._spec_lose_streak >= _SPEC_DORMANT_AFTER:
+                self._spec_dormant = True
+        else:
+            self._spec_lose_streak = 0
+            self._spec_dormant = False
         _flight.record("serve.verify", batch=len(active),
                        accepted=total_accepted, dur=round(dt, 6))
         if _metrics.enabled():

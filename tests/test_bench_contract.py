@@ -54,6 +54,18 @@ _STUB_VALUES = {"train": 100.0, "infer": 200.0, "bert": 300.0,
                                "kv_bytes_int8": 1000, "kv_bytes_fp32": 4000,
                                "kv_bytes_ratio": 0.25, "completed": 64,
                                "n_requests": 64, "live_compiles": 0},
+                # paged-attention serving runner (ISSUE 14): kernel-on
+                # tok/s as value, kernel-off baseline + memdump peak
+                # byte ratio as extras (parity asserted in the probe)
+                "serve_paged": {"value": 1200.0,
+                                "paged_off_tok_s": 1000.0,
+                                "paged_vs_off": 1.2,
+                                "parity_checked": 64,
+                                "paged_peak_bytes": 3000,
+                                "ref_peak_bytes": 5000,
+                                "paged_attn_hbm_bytes_ratio": 0.6,
+                                "completed": 64, "n_requests": 64,
+                                "live_compiles": 0},
                 # planner runner (ISSUE 11): median plan seconds as
                 # value, the ms-precision figure rides along
                 "planner": {"value": 0.0, "planner_ms": 0.9,
@@ -109,6 +121,7 @@ def test_default_mode_emits_all_metrics_in_one_line(monkeypatch, capsys):
                      "imperative_dispatch_bulked_long",
                      "llama_serve_tok_s",
                      "llama_serve_spec_tok_s",
+                     "llama_serve_paged_tok_s",
                      "planner_seconds",
                      "resnet50_cold_start_seconds",
                      "bert_cold_start_seconds",
@@ -152,6 +165,16 @@ def test_default_mode_emits_all_metrics_in_one_line(monkeypatch, capsys):
     assert sspec["kv_bytes_ratio"] == 0.25
     assert sspec["parity_checked"] == 64
     assert sspec["live_compiles"] == 0
+    # paged-attention serving record (ISSUE 14): kernel-on tok/s is the
+    # value; the kernel-off baseline from the SAME net and geometry and
+    # the memdump peak-byte ratio ride along (parity asserted in-probe)
+    spag = by_name["llama_serve_paged_tok_s"]
+    assert spag["value"] == 1200.0 and spag["unit"] == "tokens/sec"
+    assert spag["paged_off_tok_s"] == 1000.0
+    assert spag["paged_vs_off"] == 1.2
+    assert spag["paged_attn_hbm_bytes_ratio"] == 0.6
+    assert spag["parity_checked"] == 64
+    assert spag["live_compiles"] == 0
     # planner record (ISSUE 11): static analysis latency, LOWER better;
     # the ms-precision figure survives the 2-decimal value rounding
     plan = by_name["planner_seconds"]
@@ -170,7 +193,7 @@ def test_budget_exhaustion_marks_skipped(monkeypatch, capsys):
                       if ln.startswith("{")][-1])
     assert rec["value"] == 100.0  # headline always measured
     skipped = [m for m in rec["metrics"] if m.get("skipped")]
-    assert len(skipped) == 14
+    assert len(skipped) == 15
     assert all(m["value"] == 0.0 for m in skipped)
 
 
@@ -202,6 +225,8 @@ def test_failed_benchmark_emits_zero_not_crash(monkeypatch, capsys):
         "serve": (boom, "llama_serve_tok_s", "tokens/sec", None),
         "serve_spec": (boom, "llama_serve_spec_tok_s", "tokens/sec",
                        None),
+        "serve_paged": (boom, "llama_serve_paged_tok_s", "tokens/sec",
+                        None),
         "planner": (boom, "planner_seconds", "seconds", None),
         "cold_resnet50": (boom, "resnet50_cold_start_seconds", "seconds",
                           None),
@@ -213,4 +238,4 @@ def test_failed_benchmark_emits_zero_not_crash(monkeypatch, capsys):
     rec = json.loads([ln for ln in capsys.readouterr().out.splitlines()
                       if ln.startswith("{")][-1])
     assert rec["value"] == 0.0 and rec["fallback"] is True
-    assert len(rec["metrics"]) == 15
+    assert len(rec["metrics"]) == 16

@@ -671,6 +671,38 @@ SPECS["_contrib_flash_attention"] = S(
     {"block_q": 8, "block_k": 8},
     ref=_flash_ref, rtol=1e-3, atol=1e-4)
 
+
+def _paged_attn_ref(q, kp, vp, tbl, pos):
+    b, k1, h, d = q.shape
+    s_page, kv = kp.shape[1], kp.shape[2]
+    grp, ctx = h // kv, tbl.shape[1] * s_page
+    keys = kp[tbl].reshape(b, ctx, kv, d)
+    vals = vp[tbl].reshape(b, ctx, kv, d)
+    s = np.einsum("bkvgd,bcvd->bkvgc", q.reshape(b, k1, kv, grp, d),
+                  keys) / np.sqrt(d)
+    posk = pos[:, None] + np.arange(k1)[None, :]
+    ok = (np.arange(ctx)[None, None, :] <= posk[..., None]) \
+        & np.repeat(tbl != 0, s_page, axis=1)[:, None, :]
+    s = np.where(ok[:, :, None, None, :], s, -np.inf)
+    m = np.max(s, axis=-1, keepdims=True)
+    p = np.exp(s - np.where(np.isfinite(m), m, 0.0))
+    l = p.sum(-1, keepdims=True)
+    att = np.einsum("bkvgc,bcvd->bkvgd",
+                    p / np.where(l == 0, 1.0, l), vals)
+    return att.reshape(b, k1, h, d).astype(np.float32)
+
+
+# use_kernel=1 forces the Pallas kernel (interpreter on CPU): the sweep
+# exercises the real kernel path, not the jnp reference it would pick on
+# auto.  Table row 0 is the reserved null page — masked by contract.
+SPECS["_contrib_paged_attention"] = S(
+    [randn((2, 2, 4, 4), 138), randn((6, 2, 2, 4), 139),
+     randn((6, 2, 2, 4), 140),
+     np.array([[1, 2, 0], [3, 4, 5]], np.int32),
+     np.array([2, 4], np.int32)],
+    {"use_kernel": 1},
+    ref=_paged_attn_ref, rtol=1e-3, atol=1e-4)
+
 # ---------------------------------------------------------------------------
 # optimizer update ops (golden numpy re-implementations)
 # ---------------------------------------------------------------------------

@@ -586,6 +586,67 @@ def test_spec_cost_gate_prefers_decode_when_verify_is_expensive():
     assert calls[10.0][0] > calls[10.0][1]
 
 
+def test_spec_dormancy_stops_proposing_and_still_reprobes(monkeypatch):
+    # ISSUE 14 satellite: runtime spec_k (2) below the compiled width
+    # (4) plus a 10x verify premium that never pays — after
+    # _SPEC_DORMANT_AFTER losing re-probes the scheduler must stop
+    # running the proposers on ordinary steps (dormant), while the
+    # probe cadence keeps firing real verifies so a workload shift
+    # could still wake the path.  Output stays exactly the plain-decode
+    # stream.
+    from mxnet_tpu.serve import scheduler as sched_mod
+    from mxnet_tpu.serve import spec as spec_mod
+
+    monkeypatch.setattr(sched_mod, "_SPEC_PROBE_EVERY", 4)
+    seq = list(range(10, 20)) + [5, 6, 7] * 40
+
+    class SpyRunner(CostedSpecRunner):
+        sched = None
+
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self.verify_dormant = []
+
+        def verify(self, *a):
+            self.verify_dormant.append(self.sched._spec_dormant)
+            return super().verify(*a)
+
+    outs = {}
+    for spec_k in (0, 2):
+        g = tiny_geometry(spec_k=4, num_pages=64, max_pages_per_seq=30)
+        arena = PagedKVArena(g)
+        clk = _CostClock()
+        runner = SpyRunner(g, seq, clk, verify_cost=10.0)
+        sched = Scheduler(runner, arena, queue_depth=8, spec_k=spec_k,
+                          clock=clk)
+        runner.sched = sched
+        proposed_dormant = []
+        orig_propose = spec_mod.NgramProposer.propose
+
+        def propose(self, k, _s=sched, _rec=proposed_dormant,
+                    _o=orig_propose):
+            _rec.append(_s._spec_dormant)
+            return _o(self, k)
+
+        monkeypatch.setattr(spec_mod.NgramProposer, "propose", propose)
+        req = sched.submit(Request(seq[:4], max_new_tokens=100))
+        run_to_completion(sched)
+        outs[spec_k] = req.result(timeout=0)
+        if spec_k == 0:
+            continue
+        assert sched._spec_dormant, \
+            "losing verify path must park the proposers"
+        # dormant steps skip the proposers entirely: strictly fewer
+        # propose calls than scheduler steps (pre-dormancy it is 1:1)
+        assert len(proposed_dormant) < sched.decode_steps, \
+            (len(proposed_dormant), sched.decode_steps)
+        # ...but the cost gate still re-probes with real verify calls
+        # after going dormant
+        assert any(runner.verify_dormant), \
+            "dormancy must not kill the re-probe cadence"
+    assert outs[0] == outs[2] == seq[4:104]
+
+
 def make_spec_sched(seq, geom=None, spec_k=None):
     g = geom or tiny_geometry(spec_k=4)
     arena = PagedKVArena(g)
