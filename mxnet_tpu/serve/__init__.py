@@ -18,6 +18,12 @@ with speculation on or off) and an int8 paged-KV arena with per-page
 quantization scales (``export_serving_bundle(..., kv_dtype="int8",
 spec_k=4)``).
 
+ISSUE 15 closed the request lifecycle under failure (docs/serving.md
+"Robustness & deploys"): per-request deadlines and cancellation with
+typed errors, graceful drain (503 + Retry-After), AOT bundle hot-swap
+(``LlamaServer.reload``), serve-loop crash containment, and seeded
+chaos coverage (``tests/test_serve_chaos.py``).
+
 Quick start::
 
     from mxnet_tpu import serve
@@ -33,7 +39,10 @@ Quick start::
 from .arena import PagedKVArena
 from .model import (KVGeometry, check_geometry, export_serving_bundle,
                     geometry_from_net, load_serving_executables)
-from .scheduler import Request, Scheduler, ServeQueueFull, greedy_sampler
+from .scheduler import (Request, Scheduler, ServeCancelled,
+                        ServeDeadlineExceeded, ServeDraining,
+                        ServeInternalError, ServeQueueFull, ServeShutdown,
+                        greedy_sampler)
 from .server import (AOTRunner, LlamaServer, drive_workload,
                      poisson_workload)
 from .spec import NgramProposer, propose_ngram
@@ -41,7 +50,9 @@ from .spec import NgramProposer, propose_ngram
 __all__ = [
     "AOTRunner", "KVGeometry", "LlamaServer", "NgramProposer",
     "PagedKVArena", "Request",
-    "Scheduler", "ServeQueueFull", "check_geometry", "drive_workload",
+    "Scheduler", "ServeCancelled", "ServeDeadlineExceeded",
+    "ServeDraining", "ServeInternalError", "ServeQueueFull",
+    "ServeShutdown", "check_geometry", "drive_workload",
     "export_serving_bundle", "geometry_from_net", "greedy_sampler",
     "load_serving_executables", "poisson_workload", "propose_ngram",
 ]

@@ -4,11 +4,17 @@ Loads the AOT serving bundle (zero live compiles), starts the
 continuous-batching loop, and exposes the stdlib HTTP front:
 ``POST /v1/generate {"prompt": [...ids], "max_new_tokens": n}``,
 ``GET /metrics`` (Prometheus), ``GET /healthz`` (scheduler stats).
+
+SIGTERM (what an orchestrator sends on pod eviction / rollout) triggers
+the graceful path: stop admission (503 + Retry-After), finish in-flight
+work within ``--drain-timeout`` (default ``MXNET_SERVE_DRAIN_TIMEOUT``),
+fail stragglers typed, then exit.  Ctrl-C takes the same path.
 """
 from __future__ import annotations
 
 import argparse
-import time
+import signal
+import threading
 
 from .server import LlamaServer
 
@@ -26,17 +32,28 @@ def main(argv=None):
     ap.add_argument("--kv-dtype", default=None,
                     help="assert the bundle's KV arena dtype (e.g. int8) "
                          "— refuses to serve on mismatch")
+    ap.add_argument("--drain-timeout", type=float, default=None,
+                    help="seconds to let in-flight work finish on "
+                         "SIGTERM/Ctrl-C (default: "
+                         "MXNET_SERVE_DRAIN_TIMEOUT or 30)")
     args = ap.parse_args(argv)
     srv = LlamaServer(args.bundle, queue_depth=args.queue_depth,
                       spec_k=args.spec_k, kv_dtype=args.kv_dtype).start()
     host, port = srv.serve_http(port=args.port, host=args.host)
+    term = threading.Event()
+    # registered before the banner: the orchestrator (or a test) may
+    # SIGTERM the moment it sees the port
+    signal.signal(signal.SIGTERM, lambda *a: term.set())
     print("serving %s on http://%s:%d  [%s]"
           % (args.bundle, host, port, srv.geometry.describe()))
     try:
-        while True:
-            time.sleep(60)
+        term.wait()
     except KeyboardInterrupt:
-        srv.stop()
+        pass
+    stragglers = srv.drain(timeout=args.drain_timeout)
+    srv.stop()
+    if stragglers:
+        print("drain timed out: %d request(s) failed typed" % stragglers)
 
 
 if __name__ == "__main__":

@@ -143,6 +143,67 @@ class PagedKVArena:
     def owner_of(self, page):
         return self._owner.get(page)
 
+    def assert_quiescent(self):
+        """Leak check: every allocatable page is back on the free list
+        and nothing but the null page is live.  Raises ``MXNetError``
+        naming the leaked pages and their owners — the serve
+        chaos/expiry/cancel/drain tests call this after every scenario
+        (ISSUE 15: a robustness path that loses pages is a slow death).
+        """
+        problems = []
+        if self._owner:
+            by_owner = {}
+            for p, o in sorted(self._owner.items()):
+                by_owner.setdefault(o, []).append(p)
+            problems.append("%d live page(s): %s" % (
+                len(self._owner),
+                ", ".join("owner %r holds %s" % (o, pages)
+                          for o, pages in sorted(by_owner.items(),
+                                                 key=lambda kv: str(kv[0])))))
+        free = list(self._free)
+        expect = set(range(1, self.geometry.num_pages))
+        if len(free) != len(set(free)):
+            problems.append("free list has duplicates")
+        if set(free) - expect:
+            problems.append("free list holds invalid pages %s"
+                            % sorted(set(free) - expect))
+        missing = expect - set(free) - set(self._owner)
+        if missing:
+            problems.append("page(s) %s neither free nor owned (leaked)"
+                            % sorted(missing))
+        if problems:
+            raise MXNetError("arena not quiescent: "
+                             + "; ".join(problems))
+
+    def reset(self):
+        """Hard reset after loop-crash containment: rebuild the free
+        list and re-zero the buffers with plain ``device_put`` (no ops —
+        zero live compiles holds even through a crash).  Only legal once
+        every request was failed (``Scheduler.fail_all``): resetting
+        under a live sequence would be silent KV corruption."""
+        import jax
+
+        if self._owner:
+            raise MXNetError(
+                "arena reset with %d live page(s) — fail the in-flight "
+                "requests first" % len(self._owner))
+        self._free = collections.deque(range(1, self.geometry.num_pages))
+        dtype = np.dtype(self.geometry.kv_dtype)
+        zeros = np.zeros(self.geometry.kv_shape(), dtype)
+        self.kv_k._set_data(jax.device_put(zeros))
+        self.kv_v._set_data(jax.device_put(zeros))
+        _memdump.tag(self.kv_k.data(), origin="kv_page", label="arena.k")
+        _memdump.tag(self.kv_v.data(), origin="kv_page", label="arena.v")
+        if self.quantized:
+            szeros = np.zeros(self.geometry.scale_shape(), np.float32)
+            self.k_scale._set_data(jax.device_put(szeros))
+            self.v_scale._set_data(jax.device_put(szeros))
+            _memdump.tag(self.k_scale.data(), origin="kv_page",
+                         label="arena.k_scale")
+            _memdump.tag(self.v_scale.data(), origin="kv_page",
+                         label="arena.v_scale")
+        self._gauges()
+
     def block_row(self, pages):
         """Block-table row (maxp,) int32 for a page list; unused entries
         point at the null page."""

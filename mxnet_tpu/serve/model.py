@@ -194,6 +194,24 @@ class KVGeometry:
         return (self.num_layers, self.num_pages, self.page_size,
                 self.num_kv_heads, self.head_dim)
 
+    # the fields a replacement bundle must agree on for an in-place
+    # hot-swap (``LlamaServer.reload``): everything the scheduler and
+    # the queued requests already depend on — paging layout, batch
+    # width, bucket ladder, vocabulary, arena dtype and verify width.
+    # Model internals (layers, heads, weights) are free to change: the
+    # arena is rebuilt from the new geometry and the executables are
+    # self-contained.
+    HOT_SWAP_FIELDS = ("page_size", "num_pages", "max_pages_per_seq",
+                       "max_batch", "prefill_buckets", "vocab_size",
+                       "kv_dtype", "spec_k")
+
+    def hot_swap_pins(self):
+        """The geometry subset ``reload()`` pins (``check_geometry``
+        dict) — a candidate bundle mismatching any of these would strand
+        queued requests or tear live block tables."""
+        d = self.to_dict()
+        return {f: d[f] for f in self.HOT_SWAP_FIELDS}
+
     @property
     def quantized(self):
         """True when the arena stores int8 pages with per-page scales."""

@@ -29,6 +29,18 @@ while the server plugs in the AOT runner and a background thread.
 Backpressure is a bounded admission queue — ``submit`` raises
 :class:`ServeQueueFull` instead of buffering without limit (HTTP
 surfaces it as 503).
+
+Request lifecycle robustness (ISSUE 15): every request can carry a
+relative ``deadline_s`` (default ``MXNET_SERVE_DEFAULT_DEADLINE``),
+enforced at admission, in-queue and mid-decode — an expired request
+fails with :class:`ServeDeadlineExceeded` and frees its pages at the
+next step boundary.  ``Request.cancel()`` (or ``DELETE
+/v1/generate/<id>``) recycles the lane the same way with
+:class:`ServeCancelled`.  ``drain()`` stops admission
+(:class:`ServeDraining`, HTTP 503 + ``Retry-After`` estimated from
+queue depth and the TPOT EMA) and ``fail_all()`` is the typed-failure
+sweep the server's drain timeout, ``stop()`` and loop-crash containment
+all use — no future is ever left unresolved.
 """
 from __future__ import annotations
 
@@ -44,6 +56,7 @@ import numpy as np
 from ..base import MXNetError
 from ..telemetry import flight as _flight
 from ..telemetry import metrics as _metrics
+from ..testing import faults as _faults
 from . import spec as _spec
 
 # TTFT/TPOT bucket ladders (seconds): decode steps sit well under the
@@ -88,7 +101,38 @@ _SPEC_DORMANT_AFTER = 3
 
 
 class ServeQueueFull(MXNetError):
-    """Admission queue at MXNET_SERVE_QUEUE_DEPTH — shed load upstream."""
+    """Admission queue at MXNET_SERVE_QUEUE_DEPTH — shed load upstream.
+    Carries ``retry_after_s`` (queue-depth x TPOT estimate)."""
+
+    retry_after_s = 1
+
+
+class ServeDraining(MXNetError):
+    """Submit refused: the server is draining for shutdown or swap.
+    Carries ``retry_after_s`` — HTTP surfaces it as 503 + Retry-After."""
+
+    retry_after_s = 1
+
+
+class ServeDeadlineExceeded(MXNetError):
+    """The request's ``deadline_s`` elapsed before completion; its pages
+    were freed at the step boundary that noticed."""
+
+
+class ServeCancelled(MXNetError):
+    """The request was cancelled (``Request.cancel()`` or ``DELETE
+    /v1/generate/<id>``); the lane recycled at the next step boundary."""
+
+
+class ServeShutdown(MXNetError):
+    """The server stopped or the drain timeout expired while this
+    request was still queued or in flight."""
+
+
+class ServeInternalError(MXNetError):
+    """The serve loop hit an unexpected step exception; the affected
+    requests are failed with this (naming the cause) instead of hanging
+    their futures while the loop restarts."""
 
 
 class Request:
@@ -96,7 +140,8 @@ class Request:
 
     _ids = itertools.count()
 
-    def __init__(self, prompt, max_new_tokens=None, eos_id=None):
+    def __init__(self, prompt, max_new_tokens=None, eos_id=None,
+                 deadline_s=None):
         self.rid = next(Request._ids)
         # globally-unique-enough id stamped into flight events and served
         # back by GET /v1/trace/<id> (pid disambiguates across ranks)
@@ -110,6 +155,15 @@ class Request:
         if self.max_new_tokens <= 0:
             raise MXNetError("max_new_tokens must be positive")
         self.eos_id = None if eos_id is None else int(eos_id)
+        # relative wall-clock budget (submit -> finish); the env default
+        # applies to requests that don't set one, 0/unset = no deadline
+        if deadline_s is None:
+            deadline_s = _env_float("MXNET_SERVE_DEFAULT_DEADLINE", 0.0)
+        self.deadline_s = float(deadline_s) if deadline_s else None
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise MXNetError("deadline_s must be positive")
+        self.deadline_t = None    # absolute (scheduler clock), at submit
+        self._cancel = False
         self.tokens = []          # generated ids (never includes prompt)
         self.submit_t = None      # clock() at admission-queue entry
         self.admit_t = None       # clock() when a decode slot was assigned
@@ -147,6 +201,16 @@ class Request:
             raise self.error
         return list(self.tokens)
 
+    def cancel(self):
+        """Request cancellation from any thread.  The scheduler notices
+        at the next step boundary: the lane recycles, pages free, and
+        ``result()`` raises :class:`ServeCancelled`.  No-op once done."""
+        self._cancel = True
+
+    @property
+    def cancelled(self):
+        return self._cancel
+
     def done(self):
         return self._done.is_set()
 
@@ -167,6 +231,11 @@ class _Slot:
 def _env_int(name, default):
     v = os.environ.get(name, "")
     return int(v) if v.strip() else default
+
+
+def _env_float(name, default):
+    v = os.environ.get(name, "")
+    return float(v) if v.strip() else default
 
 
 def greedy_sampler(logits, req):
@@ -216,6 +285,9 @@ class Scheduler:
         self._queue = collections.deque()
         self._slots = [None] * self.geometry.max_batch
         self._work = threading.Condition(self._lock)
+        self._draining = False      # drain(): no new admissions, ever
+        self._hold_admission = False  # hot-swap: queue keeps, slots wait
+        self._refuse_error = None   # loop gave up: fail submits fast
         # aggregate counters (served through stats()/telemetry)
         self.admitted = 0
         self.rejected = 0
@@ -308,16 +380,37 @@ class Scheduler:
                    self.geometry.max_context)))
             return req
         with self._lock:
+            if self._refuse_error is not None:
+                self.rejected += 1
+                self._count_req("rejected")
+                self._trace_event(req, "rejected", status="rejected",
+                                  reason="loop_dead")
+                raise type(self._refuse_error)(str(self._refuse_error))
+            if self._draining:
+                self.rejected += 1
+                self._count_req("rejected")
+                self._trace_event(req, "rejected", status="rejected",
+                                  reason="draining")
+                err = ServeDraining(
+                    "server is draining — not accepting new requests "
+                    "(retry against another replica, or after ~%ds)"
+                    % self._retry_after_locked())
+                err.retry_after_s = self._retry_after_locked()
+                raise err
             if len(self._queue) >= self.queue_depth:
                 self.rejected += 1
                 self._count_req("rejected")
                 self._trace_event(req, "rejected", status="rejected",
                                   reason="queue_full")
-                raise ServeQueueFull(
+                err = ServeQueueFull(
                     "admission queue full (%d waiting, "
                     "MXNET_SERVE_QUEUE_DEPTH=%d)"
                     % (len(self._queue), self.queue_depth))
+                err.retry_after_s = self._retry_after_locked()
+                raise err
             req.submit_t = self.clock()
+            if req.deadline_s is not None:
+                req.deadline_t = req.submit_t + req.deadline_s
             self._queue.append(req)
             self._trace_event(req, "submit", prompt_len=len(req.prompt))
             self._gauges_locked()
@@ -335,16 +428,202 @@ class Scheduler:
 
     # -- the scheduling step ---------------------------------------------
     def step(self):
-        """One admit→prefill→decode→complete round; True if any work ran."""
-        worked = self._admit()
+        """One reap→admit→prefill→decode→complete round; True if any
+        work ran.  The reap phase is where deadlines, cancellations and
+        injected client disconnects take effect — pages free and futures
+        resolve at step boundaries, never mid-call."""
+        self._poll_disconnects()
+        worked = self._reap()
+        if self._admit():
+            worked = True
         if self._decode_once():
             worked = True
         return worked
+
+    # -- lifecycle: deadlines + cancellation ------------------------------
+    def _lifecycle_error(self, req, now):
+        """(error, status) if ``req`` should stop now, else (None, None).
+        Cancellation wins over expiry — the client asked first."""
+        if req._cancel:
+            return ServeCancelled(
+                "request %s cancelled after %d token(s)"
+                % (req.trace_id, len(req.tokens))), "cancelled"
+        if req.deadline_t is not None and now > req.deadline_t:
+            return ServeDeadlineExceeded(
+                "request %s exceeded deadline_s=%.3f with %d token(s) "
+                "generated" % (req.trace_id, req.deadline_s,
+                               len(req.tokens))), "expired"
+        return None, None
+
+    def _poll_disconnects(self):
+        """Chaos seam: the ``client_disconnect`` site fires once per
+        step per live request, and a raising action becomes a cancel —
+        the deterministic stand-in for a vanished client."""
+        if _faults.current() is None:
+            return
+        with self._lock:
+            live = list(self._queue) + [s.req for s in self._slots
+                                        if s is not None]
+        for req in live:
+            try:
+                _faults.maybe_inject("client_disconnect", rid=req.rid,
+                                     tid=req.trace_id)
+            except _faults.LoopKilled:
+                raise
+            except Exception:
+                req.cancel()
+
+    def _reap(self):
+        """Fail every queued/in-flight request whose deadline passed or
+        that was cancelled; frees pages immediately.  True if any died."""
+        now = self.clock()
+        dead_q, dead_s = [], []
+        with self._lock:
+            if self._queue:
+                keep = collections.deque()
+                for req in self._queue:
+                    err, status = self._lifecycle_error(req, now)
+                    if err is None:
+                        keep.append(req)
+                    else:
+                        dead_q.append((req, err, status))
+                if dead_q:
+                    self._queue = keep
+                    self._gauges_locked()
+            for s in self._slots:
+                if s is None:
+                    continue
+                err, status = self._lifecycle_error(s.req, now)
+                if err is not None:
+                    dead_s.append((s, err, status))
+        for req, err, status in dead_q:
+            self._fail_queued(req, err, status)
+        for s, err, status in dead_s:
+            self._finish_slot(s, error=err, status=status)
+        return bool(dead_q or dead_s)
+
+    def _fail_queued(self, req, err, status):
+        """Resolve a request that never reached a slot (reaped from the
+        queue, drained, or shut down) — no pages to free."""
+        req.error = err
+        req.finish_t = self.clock()
+        self._count_req(status)
+        self._trace_event(req, "finish", status=status, tokens=0,
+                          error=type(err).__name__)
+        with self._trace_lock:
+            tr = self._traces.get(req.trace_id)
+            if tr is not None:
+                tr["tokens"] = []
+                tr["breakdown"] = req.breakdown()
+                tr["error"] = str(err)
+        req._done.set()
+
+    def cancel(self, trace_id):
+        """Cancel by trace id (``DELETE /v1/generate/<id>``): True if
+        the request is queued or in flight; the lane recycles at the
+        next step boundary."""
+        with self._lock:
+            for req in self._queue:
+                if req.trace_id == trace_id:
+                    req.cancel()
+                    self._work.notify()
+                    return True
+            for s in self._slots:
+                if s is not None and s.req.trace_id == trace_id:
+                    s.req.cancel()
+                    return True
+        return False
+
+    # -- drain / shutdown -------------------------------------------------
+    def drain(self):
+        """Stop admission permanently: every subsequent submit raises
+        :class:`ServeDraining` (HTTP 503 + Retry-After).  Queued and
+        in-flight requests keep being served — the server's ``drain()``
+        gives them ``MXNET_SERVE_DRAIN_TIMEOUT`` to finish."""
+        with self._lock:
+            self._draining = True
+
+    @property
+    def draining(self):
+        return self._draining
+
+    def refuse(self, err):
+        """Fail every subsequent submit fast with a copy of ``err`` —
+        the give-up state after repeated loop crashes, so no client ever
+        blocks on a server that cannot serve."""
+        with self._lock:
+            self._refuse_error = err
+
+    def hold_admission(self, hold):
+        """Pause (True) / resume (False) slot admission while keeping
+        the queue intact — the hot-swap window: old lanes drain on the
+        old runner, queued requests wait for the new arena, nothing is
+        dropped."""
+        with self._lock:
+            self._hold_admission = bool(hold)
+
+    def swap(self, runner, arena):
+        """Atomically repoint the scheduler at a new runner + arena.
+        Only legal at a step boundary with zero active slots (the
+        server's reload path holds admission and drains lanes first) —
+        live block tables must never cross arenas."""
+        with self._lock:
+            busy = sum(1 for s in self._slots if s is not None)
+            if busy:
+                raise MXNetError(
+                    "runner/arena swap with %d active slot(s) — drain "
+                    "lanes first" % busy)
+            self.runner = runner
+            self.arena = arena
+            self.geometry = arena.geometry
+
+    def fail_all(self, error, status="failed"):
+        """Resolve EVERY queued and in-flight request with ``error``
+        (pages freed, futures set); returns how many were failed.  The
+        drain timeout, ``stop()`` and loop-crash containment land here
+        — the no-hung-futures guarantee."""
+        with self._lock:
+            queued = list(self._queue)
+            self._queue.clear()
+            slots = [s for s in self._slots if s is not None]
+            self._gauges_locked()
+        for req in queued:
+            self._fail_queued(req, error, status)
+        for slot in slots:
+            # _finish_slot skips slots a racing completion already closed
+            self._finish_slot(slot, error=error, status=status)
+        return len(queued) + len(slots)
+
+    def kick(self):
+        """Wake a parked serve loop (drain/reload want a step now)."""
+        with self._work:
+            self._work.notify_all()
+
+    def _retry_after_locked(self):
+        """Seconds until the backlog plausibly clears: queued requests x
+        mean budget x TPOT EMA / batch width (>= 1; callers hold _lock)."""
+        budgets = [r.max_new_tokens for r in self._queue]
+        tpot = self._t_decode
+        if tpot <= 0.0 and self._tpots:
+            data = sorted(self._tpots)
+            tpot = data[len(data) // 2]
+        if not budgets or tpot <= 0.0:
+            return 1
+        est = (len(budgets) * (sum(budgets) / len(budgets)) * tpot
+               / max(1, self.geometry.max_batch))
+        return max(1, int(math.ceil(est)))
+
+    def retry_after_s(self):
+        """Public Retry-After estimate (see ``_retry_after_locked``)."""
+        with self._lock:
+            return self._retry_after_locked()
 
     def _admit(self):
         admitted = False
         while True:
             with self._lock:
+                if self._hold_admission:
+                    break
                 free = [i for i, s in enumerate(self._slots) if s is None]
                 if not free or not self._queue:
                     break
@@ -382,9 +661,15 @@ class Scheduler:
         bucket = self.pick_bucket(len(req.prompt))
         t0 = self.clock()
         try:
+            _faults.maybe_inject("serve_prefill", rid=req.rid,
+                                 bucket=bucket)
             logits = self.runner.prefill(
                 bucket, np.asarray(req.prompt, dtype=np.int32),
                 len(req.prompt), slot.row)
+        except _faults.LoopKilled:  # chaos: escapes to loop containment
+            self._fail_slot(slot, ServeInternalError(
+                "serve loop killed during prefill"))
+            raise
         except Exception as e:  # poison the request, free the lane
             self._fail_slot(slot, e)
             return
@@ -464,7 +749,13 @@ class Scheduler:
             tables[i] = s.row
         t0 = self.clock()
         try:
+            _faults.maybe_inject("serve_decode", batch=len(active))
             logits = self.runner.decode(tokens, positions, tables)
+        except _faults.LoopKilled:  # chaos: escapes to loop containment
+            for _, s in active:
+                self._fail_slot(s, ServeInternalError(
+                    "serve loop killed during decode"))
+            raise
         except Exception as e:
             for _, s in active:
                 self._fail_slot(s, e)
@@ -545,7 +836,13 @@ class Scheduler:
             tables[i] = s.row
         t0 = self.clock()
         try:
+            _faults.maybe_inject("serve_decode", batch=len(active))
             logits = self.runner.verify(tokens, positions, tables)
+        except _faults.LoopKilled:  # chaos: escapes to loop containment
+            for _, s in active:
+                self._fail_slot(s, ServeInternalError(
+                    "serve loop killed during verify"))
+            raise
         except Exception as e:
             for _, s in active:
                 self._fail_slot(s, e)
@@ -648,6 +945,13 @@ class Scheduler:
     # -- completion -------------------------------------------------------
     def _maybe_complete(self, slot):
         req = slot.req
+        # mid-decode lifecycle enforcement: a deadline crossed during
+        # the step that just ran (or a cancel that raced it) frees the
+        # pages NOW, not at the next reap
+        err, status = self._lifecycle_error(req, self.clock())
+        if err is not None:
+            self._finish_slot(slot, error=err, status=status)
+            return
         done = len(req.tokens) >= req.max_new_tokens
         if req.eos_id is not None and req.tokens \
                 and req.tokens[-1] == req.eos_id:
@@ -658,20 +962,25 @@ class Scheduler:
     def _fail_slot(self, slot, err):
         self._finish_slot(slot, error=err)
 
-    def _finish_slot(self, slot, error):
+    def _finish_slot(self, slot, error, status=None):
         req = slot.req
         with self._lock:
+            live = False
             for i, s in enumerate(self._slots):
                 if s is slot:
                     self._slots[i] = None
+                    live = True
                     break
+            if not live:
+                return  # a racing fail_all/complete already closed it
             self.arena.free(slot.pages, owner=req.rid)
             self.completed += 1
-            self._count_req("failed" if error is not None else "completed")
+            if status is None:
+                status = "failed" if error is not None else "completed"
+            self._count_req(status)
             self._gauges_locked()
         req.error = error
         req.finish_t = self.clock()
-        status = "failed" if error is not None else "completed"
         self._trace_event(req, "finish", status=status,
                           tokens=len(req.tokens),
                           error=(type(error).__name__ if error else ""))
@@ -722,6 +1031,7 @@ class Scheduler:
             "tokens_generated": self.tokens_generated,
             "decode_steps": self.decode_steps, "prefills": self.prefills,
             "active_slots": active, "queue_len": qlen,
+            "draining": self._draining,
             "arena_utilization": self.arena.utilization(),
             "ttft_p50_s": self.percentile("ttft", 0.50),
             "ttft_p99_s": self.percentile("ttft", 0.99),
@@ -735,10 +1045,25 @@ class Scheduler:
         }
 
     def _count_req(self, status):
-        if _metrics.enabled():
+        if not _metrics.enabled():
+            return
+        _metrics.counter(
+            "mxnet_serve_requests_total",
+            help="requests by outcome", status=status).inc()
+        # dedicated lifecycle families (ISSUE 15) so dashboards alert on
+        # them without label math over requests_total
+        if status == "expired":
             _metrics.counter(
-                "mxnet_serve_requests_total",
-                help="requests by outcome", status=status).inc()
+                "mxnet_serve_expired_total",
+                help="requests failed by deadline expiry").inc()
+        elif status == "cancelled":
+            _metrics.counter(
+                "mxnet_serve_cancelled_total",
+                help="requests cancelled before completion").inc()
+        elif status == "drained":
+            _metrics.counter(
+                "mxnet_serve_drained_total",
+                help="requests failed by drain timeout or shutdown").inc()
 
     def _gauges_locked(self):
         if _metrics.enabled():

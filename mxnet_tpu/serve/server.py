@@ -31,8 +31,12 @@ from ..base import MXNetError
 from ..telemetry import flight as _flight
 from ..telemetry import memdump as _memdump
 from ..telemetry import metrics as _metrics
+from ..testing import faults as _faults
 from .arena import PagedKVArena
-from .scheduler import Request, Scheduler
+from .scheduler import (Request, Scheduler, ServeCancelled,
+                        ServeDeadlineExceeded, ServeDraining,
+                        ServeInternalError, ServeQueueFull, ServeShutdown,
+                        _env_float, _env_int)
 
 
 class AOTRunner:
@@ -103,25 +107,65 @@ class LlamaServer:
     the bundle was compiled with; 0 turns it off).  ``kv_dtype`` is an
     assertion, not a conversion — pass it to refuse a bundle whose
     arena dtype isn't what the deployment expects.
+
+    Robustness (ISSUE 15, docs/serving.md "Robustness & deploys"): the
+    loop is crash-contained (a step exception fails only the affected
+    requests with :class:`ServeInternalError`, dumps the flight
+    recorder, flips ``/healthz`` ``ok`` and restarts the loop over a
+    reset arena), ``drain()``/SIGTERM stops admission and gives
+    in-flight work ``MXNET_SERVE_DRAIN_TIMEOUT`` to finish, and
+    ``reload(bundle)`` hot-swaps the executables + arena at a step
+    boundary without dropping a request.
     """
 
     def __init__(self, bundle_path, expect_geometry=None, queue_depth=None,
                  sampler=None, spec_k=None, kv_dtype=None):
         from .model import check_geometry, load_serving_executables
 
-        self.geometry, exes = load_serving_executables(
+        geometry, exes = load_serving_executables(
             bundle_path, expect=expect_geometry)
         if kv_dtype is not None:
-            check_geometry(self.geometry, {"kv_dtype": str(kv_dtype)},
+            check_geometry(geometry, {"kv_dtype": str(kv_dtype)},
                            origin=bundle_path)
-        self.arena = PagedKVArena(self.geometry)
-        self.runner = AOTRunner(exes, self.arena)
-        self.scheduler = Scheduler(self.runner, self.arena,
-                                   queue_depth=queue_depth, sampler=sampler,
-                                   spec_k=spec_k)
+        arena = PagedKVArena(geometry)
+        self._init_core(AOTRunner(exes, arena), arena,
+                        queue_depth=queue_depth, sampler=sampler,
+                        spec_k=spec_k)
+        self.bundle_path = bundle_path
+
+    def _init_core(self, runner, arena, queue_depth=None, sampler=None,
+                   spec_k=None, clock=time.monotonic):
+        self.geometry = arena.geometry
+        self.arena = arena
+        self.runner = runner
+        self.scheduler = Scheduler(runner, arena, queue_depth=queue_depth,
+                                   sampler=sampler, spec_k=spec_k,
+                                   clock=clock)
+        self.bundle_path = None
         self._stop = threading.Event()
         self._thread = None
         self._http = None
+        self._healthy = True          # flips (sticky) on loop death
+        self._last_loop_error = None
+        self._loop_restarts = 0
+        self._loop_steps = 0
+        self._draining = False
+        self._swap_lock = threading.Lock()
+        self._pending_swap = None     # (geometry, runner, arena, path, evt)
+        self._max_restarts = _env_int("MXNET_SERVE_LOOP_MAX_RESTARTS", 16)
+
+    @classmethod
+    def from_parts(cls, runner, arena, queue_depth=None, sampler=None,
+                   spec_k=None, clock=time.monotonic):
+        """Assemble a server around an existing runner + arena, no
+        bundle load — the seam the serve-chaos suite drives with
+        scripted runners and an injected clock (the loop machinery —
+        containment, drain, hot-swap — is exactly the production
+        path)."""
+        self = cls.__new__(cls)
+        self._init_core(runner, arena, queue_depth=queue_depth,
+                        sampler=sampler, spec_k=spec_k, clock=clock)
+        return self
 
     # -- lifecycle --------------------------------------------------------
     def start(self):
@@ -135,17 +179,179 @@ class LlamaServer:
 
     def _loop(self):
         while not self._stop.is_set():
-            if not self.scheduler.step():
+            if not self._loop_tick():
                 self.scheduler.wait_for_work(0.005)
+
+    def _loop_tick(self):
+        """One crash-contained scheduler round (False = idle).  Tests
+        drive this synchronously; the background thread just loops it."""
+        try:
+            _faults.maybe_inject("serve_step", step=self._loop_steps)
+            self._loop_steps += 1
+            self._maybe_swap()
+            return self.scheduler.step()
+        except Exception as e:  # noqa: BLE001 — containment IS the point
+            self._contain_loop_failure(e)
+            return True
+
+    def _contain_loop_failure(self, exc):
+        """An unexpected step exception must not kill the serve thread
+        silently (the pre-PR failure mode: every pending future hung
+        until client timeout).  Fail the affected requests typed, dump
+        the flight recorder, mark /healthz not-ok, reset the arena and
+        keep serving — up to MXNET_SERVE_LOOP_MAX_RESTARTS, after which
+        submits are refused fast instead of queueing into a dead loop."""
+        self._healthy = False
+        self._last_loop_error = "%s: %s" % (type(exc).__name__, exc)
+        _flight.record("serve.loop_died", error=type(exc).__name__,
+                       detail=str(exc)[:200])
+        _flight.crash_dump("serve_loop:%s" % type(exc).__name__)
+        failed = self.scheduler.fail_all(ServeInternalError(
+            "serve loop died (%s: %s) — request failed, loop restarting"
+            % (type(exc).__name__, exc)), status="failed")
+        self._loop_restarts += 1
+        if _metrics.enabled():
+            _metrics.counter(
+                "mxnet_serve_loop_restarts_total",
+                help="serve-loop restarts after a contained crash").inc()
+        try:
+            self.arena.reset()
+        except Exception as e2:
+            # a poisoned arena that cannot even reset means no future
+            # request can be served correctly: refuse, stop, stay not-ok
+            err = ServeInternalError(
+                "serve loop died and the arena failed to reset (%s) — "
+                "server is down" % e2)
+            self.scheduler.refuse(err)
+            self.scheduler.fail_all(err, status="failed")
+            self._stop.set()
+            _flight.record("serve.loop_gave_up", restarts=self._loop_restarts)
+            return
+        _flight.record("serve.loop_restart", n=self._loop_restarts,
+                       failed=failed)
+        if self._loop_restarts >= self._max_restarts:
+            err = ServeInternalError(
+                "serve loop died %d times (MXNET_SERVE_LOOP_MAX_RESTARTS"
+                "=%d) — giving up; last error: %s"
+                % (self._loop_restarts, self._max_restarts,
+                   self._last_loop_error))
+            self.scheduler.refuse(err)
+            self.scheduler.fail_all(err, status="failed")
+            self._stop.set()
+            _flight.record("serve.loop_gave_up", restarts=self._loop_restarts)
 
     def stop(self):
         self._stop.set()
+        self.scheduler.kick()
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        with self._swap_lock:
+            self._pending_swap = None  # a waiting reload() times out
+        # never abandon futures (ISSUE 15 satellite): anything still
+        # queued or in flight fails typed instead of hanging clients
+        if self.scheduler.has_work():
+            self.scheduler.fail_all(
+                ServeShutdown("server stopped with the request still "
+                              "queued or in flight"), status="drained")
         if self._http is not None:
             self._http.shutdown()
             self._http = None
+
+    def drain(self, timeout=None):
+        """Graceful shutdown, phase 1: stop admission (new submits get
+        503 + Retry-After), let queued + in-flight work finish within
+        ``timeout`` (default ``MXNET_SERVE_DRAIN_TIMEOUT``), then fail
+        stragglers with :class:`ServeShutdown`.  Returns the straggler
+        count (0 = clean drain).  Call ``stop()`` after."""
+        if timeout is None:
+            timeout = _env_float("MXNET_SERVE_DRAIN_TIMEOUT", 30.0)
+        timeout = float(timeout)
+        self._draining = True
+        self.scheduler.drain()
+        _flight.record("serve.drain", timeout_s=timeout,
+                       queued=self.scheduler.queue_len(),
+                       active=self.scheduler.active_slots())
+        deadline = time.monotonic() + timeout
+        while self.scheduler.has_work() and time.monotonic() < deadline:
+            if self._thread is None:
+                if not self.scheduler.step():
+                    break  # no loop and no progress possible
+            else:
+                time.sleep(0.005)
+        self.scheduler.hold_admission(True)
+        stragglers = 0
+        if self.scheduler.has_work():
+            stragglers = self.scheduler.fail_all(ServeShutdown(
+                "drain timed out after %.1fs "
+                "(MXNET_SERVE_DRAIN_TIMEOUT) with the request still "
+                "queued or in flight" % timeout), status="drained")
+        _flight.record("serve.drained", stragglers=stragglers)
+        return stragglers
+
+    # -- bundle hot-swap --------------------------------------------------
+    def reload(self, bundle_path, timeout=60):
+        """Hot-swap to a new serving bundle with zero dropped requests
+        and zero live jits: deserialize the MXAOT1 executables on the
+        CALLING thread (the loop keeps serving), pin the geometry fields
+        live traffic depends on (``KVGeometry.hot_swap_pins``), then
+        hand runner + fresh arena to the loop, which swaps them at the
+        first step boundary with no active lanes — in-flight requests
+        finish on the old executables, queued requests wait (admission
+        held, never dropped) and prefill into the new arena."""
+        from .model import check_geometry, load_serving_executables
+
+        g2, exes2 = load_serving_executables(bundle_path)
+        check_geometry(g2, self.geometry.hot_swap_pins(),
+                       origin=bundle_path)
+        arena2 = PagedKVArena(g2)
+        runner2 = AOTRunner(exes2, arena2)
+        done = threading.Event()
+        with self._swap_lock:
+            if self._pending_swap is not None:
+                raise MXNetError("a reload is already in flight")
+            self._pending_swap = (g2, runner2, arena2, bundle_path, done)
+        if self._thread is None:
+            # no background loop: drain lanes and swap on this thread
+            while self.scheduler.active_slots():
+                self.scheduler.step()
+            self._maybe_swap()
+        else:
+            self.scheduler.kick()
+            if not done.wait(timeout):
+                with self._swap_lock:
+                    self._pending_swap = None
+                self.scheduler.hold_admission(False)
+                raise MXNetError(
+                    "reload of %r timed out after %ss (loop stalled or "
+                    "lanes never drained)" % (bundle_path, timeout))
+        return self
+
+    def _maybe_swap(self):
+        """Loop-side half of ``reload()``: runs at every step boundary,
+        holds admission while old lanes drain, then swaps atomically."""
+        if self._pending_swap is None:
+            return
+        self.scheduler.hold_admission(True)
+        if self.scheduler.active_slots():
+            return  # old lanes still decoding on the old runner
+        with self._swap_lock:
+            pend = self._pending_swap
+            if pend is None:  # reload() timed out and withdrew
+                self.scheduler.hold_admission(False)
+                return
+            self._pending_swap = None
+        g2, runner2, arena2, path, done = pend
+        self.scheduler.swap(runner2, arena2)
+        self.geometry, self.runner, self.arena = g2, runner2, arena2
+        self.bundle_path = path
+        self.scheduler.hold_admission(False)
+        if _metrics.enabled():
+            _metrics.counter(
+                "mxnet_serve_reloads_total",
+                help="bundle hot-swaps completed").inc()
+        _flight.record("serve.reload", bundle=str(path))
+        done.set()
 
     def __enter__(self):
         return self.start()
@@ -154,20 +360,34 @@ class LlamaServer:
         self.stop()
 
     # -- request surface --------------------------------------------------
-    def submit(self, prompt, max_new_tokens=None, eos_id=None):
+    def submit(self, prompt, max_new_tokens=None, eos_id=None,
+               deadline_s=None):
         """Enqueue; returns the Request future (``.result(timeout)``)."""
         if self._thread is None:
             raise MXNetError("server not started — call start() first")
         return self.scheduler.submit(
-            Request(prompt, max_new_tokens=max_new_tokens, eos_id=eos_id))
+            Request(prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
+                    deadline_s=deadline_s))
 
     def generate(self, prompt, max_new_tokens=None, eos_id=None,
-                 timeout=300):
+                 timeout=300, deadline_s=None):
         return self.submit(prompt, max_new_tokens=max_new_tokens,
-                           eos_id=eos_id).result(timeout)
+                           eos_id=eos_id,
+                           deadline_s=deadline_s).result(timeout)
+
+    def cancel(self, trace_id):
+        """Cancel a queued or in-flight request by trace id (the HTTP
+        front's ``DELETE /v1/generate/<id>``); True if it was found."""
+        return self.scheduler.cancel(trace_id)
 
     def stats(self):
         return self.scheduler.stats()
+
+    def healthy(self):
+        """Readiness: False once the loop has died (sticky — the flight
+        dump names why) or while draining — the signal a load balancer
+        routes away on."""
+        return self._healthy and not self._draining
 
     def healthz(self):
         """The GET /healthz body: scheduler stats plus the operational
@@ -179,7 +399,10 @@ class LlamaServer:
         except Exception:           # health must not 500 on accounting
             by_origin, total = {}, 0
         st.update({
-            "ok": True,
+            "ok": self.healthy(),
+            "draining": self._draining,
+            "loop_restarts": self._loop_restarts,
+            "last_loop_error": self._last_loop_error,
             "queue_depth": st["queue_len"],
             "live_device_bytes": total,
             "device_bytes_by_origin": by_origin,
@@ -266,24 +489,42 @@ class LlamaServer:
     # -- HTTP front -------------------------------------------------------
     def serve_http(self, port=0, host="127.0.0.1"):
         """Minimal stdlib HTTP front (POST /v1/generate, GET /metrics,
-        GET /healthz, GET /v1/trace/<id>).  Returns the bound
-        (host, port)."""
+        GET /healthz, GET /v1/trace/<id>, DELETE /v1/generate/<id>).
+        Returns the bound (host, port).
+
+        Status mapping (ISSUE 15): draining / queue-full → 503 with a
+        ``Retry-After`` header derived from queue depth × decode-pace
+        EMA; deadline exceeded → 504; cancelled → 409; shutdown /
+        internal → 503; anything else → 500.  /healthz returns 503 once
+        the loop has died or while draining, so probers flip without
+        parsing the body."""
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-        from .scheduler import ServeQueueFull
-
         server = self
+
+        def _error_code(err):
+            if isinstance(err, ServeDeadlineExceeded):
+                return 504
+            if isinstance(err, ServeCancelled):
+                return 409
+            if isinstance(err, (ServeShutdown, ServeInternalError,
+                                ServeDraining, ServeQueueFull)):
+                return 503
+            return 500
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet: telemetry is the record
                 pass
 
-            def _send(self, code, body, ctype="application/json"):
+            def _send(self, code, body, ctype="application/json",
+                      headers=None):
                 payload = body.encode() if isinstance(body, str) \
                     else json.dumps(body).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(payload)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(payload)
 
@@ -292,7 +533,8 @@ class LlamaServer:
                     self._send(200, _metrics.prometheus_text(),
                                ctype="text/plain; version=0.0.4")
                 elif self.path == "/healthz":
-                    self._send(200, server.healthz())
+                    body = server.healthz()
+                    self._send(200 if body["ok"] else 503, body)
                 elif self.path.startswith("/v1/trace/"):
                     tid = self.path[len("/v1/trace/"):]
                     tr = server.scheduler.trace(tid)
@@ -315,8 +557,14 @@ class LlamaServer:
                     req = server.submit(
                         doc["prompt"],
                         max_new_tokens=doc.get("max_new_tokens"),
-                        eos_id=doc.get("eos_id"))
-                except ServeQueueFull as e:
+                        eos_id=doc.get("eos_id"),
+                        deadline_s=doc.get("deadline_s"))
+                except (ServeDraining, ServeQueueFull) as e:
+                    self._send(503, {"error": str(e)},
+                               headers={"Retry-After":
+                                        str(getattr(e, "retry_after_s", 1))})
+                    return
+                except ServeInternalError as e:  # loop gave up: refusing
                     self._send(503, {"error": str(e)})
                     return
                 except (MXNetError, KeyError, ValueError) as e:
@@ -330,12 +578,26 @@ class LlamaServer:
                 try:
                     tokens = req.result(timeout=doc.get("timeout", 300))
                 except MXNetError as e:
-                    self._send(500, {"error": str(e)})
+                    self._send(_error_code(req.error or e),
+                               {"error": str(e),
+                                "trace_id": req.trace_id})
                     return
                 self._send(200, {"tokens": tokens,
                                  "ttft_s": req.ttft,
                                  "trace_id": req.trace_id,
                                  "breakdown": req.breakdown()})
+
+            def do_DELETE(self):
+                if not self.path.startswith("/v1/generate/"):
+                    self._send(404, {"error": "not found"})
+                    return
+                tid = self.path[len("/v1/generate/"):]
+                if server.cancel(tid):
+                    self._send(200, {"cancelled": tid})
+                else:
+                    self._send(404, {"error": "no queued or in-flight "
+                                              "request with trace id %r"
+                                              % tid})
 
         self._http = ThreadingHTTPServer((host, port), Handler)
         threading.Thread(target=self._http.serve_forever,

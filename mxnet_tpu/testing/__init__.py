@@ -10,10 +10,10 @@ their hot paths, so this package must never pull in jax/numpy.
 """
 from __future__ import annotations
 
-from .faults import (FaultInjected, FaultPlan, current, install,
-                     maybe_inject, set_role, uninstall)
+from .faults import (FaultInjected, FaultPlan, LoopKilled, current,
+                     install, maybe_inject, set_role, uninstall)
 
 __all__ = [
-    "FaultInjected", "FaultPlan", "current", "install", "maybe_inject",
-    "set_role", "uninstall",
+    "FaultInjected", "FaultPlan", "LoopKilled", "current", "install",
+    "maybe_inject", "set_role", "uninstall",
 ]

@@ -23,6 +23,20 @@ site               where / ctx
 ``server_handle``  ``DistServer._handle`` after each decoded frame;
                    ctx: ``cmd``, ``server`` (the DistServer), role
 ``engine_push``    ``Engine.push`` before running the op; ctx: ``op``
+``serve_step``     ``LlamaServer._loop_tick`` before each scheduler round;
+                   ctx: ``step`` (loop iteration count) — the site for
+                   ``kill_loop`` (crash-containment tests)
+``serve_prefill``  ``Scheduler._prefill`` before the runner call; ctx:
+                   ``rid``, ``bucket`` — an injected raise fails only
+                   that request (slot poisoning path)
+``serve_decode``   ``Scheduler._decode_once``/``_verify_once`` before the
+                   batched runner call; ctx: ``batch`` — an injected
+                   raise fails every active lane
+``client_disconnect``  polled once per scheduler step for every queued and
+                   in-flight request; ctx: ``rid``, ``tid``.  A raising
+                   action is swallowed and turned into
+                   ``Request.cancel()`` — the deterministic stand-in for
+                   "the client went away"
 =================  ==========================================================
 
 Rule fields (all optional except ``site`` and ``action``):
@@ -48,6 +62,12 @@ Rule fields (all optional except ``site`` and ``action``):
     simulates an op failure / a crashing participant
   - ``"kill_server"`` call ``ctx['server'].shutdown()`` then raise
     ``ConnectionResetError`` — the whole server process "dies" mid-round
+  - ``"kill_loop"`` raise :class:`LoopKilled` — simulates the serve
+    loop's thread dying mid-step.  The scheduler's per-slot exception
+    handlers deliberately re-raise it, so wherever it is injected
+    (``serve_step``, ``serve_prefill``, ``serve_decode``) it escapes to
+    ``LlamaServer``'s crash containment, which must fail the in-flight
+    work with a typed error and restart the loop
   - ``"kill_worker"`` raise :class:`WorkerKilled` carrying the victim's
     ``rank`` (from the thread ctx) and the rule's optional
     ``rejoin_after`` — the elastic-training harness catches it, drops
@@ -75,6 +95,15 @@ from ..telemetry import flight as _flight
 class FaultInjected(RuntimeError):
     """Raised by ``action: "raise"`` rules (and used as the marker type
     for injected op failures in ``Engine.push`` chaos tests)."""
+
+
+class LoopKilled(FaultInjected):
+    """Raised by ``action: "kill_loop"``: the serve loop "dies" mid-step.
+
+    The serving tier's per-slot exception handlers re-raise this type
+    instead of containing it as a single-request failure, so an injected
+    kill always reaches ``LlamaServer``'s loop-level crash containment —
+    the path tests/test_serve_chaos.py exercises."""
 
 
 class WorkerKilled(FaultInjected):
@@ -218,6 +247,9 @@ class FaultPlan:
             if server is not None:
                 server.shutdown()
             raise ConnectionResetError("fault-injected server kill")
+        if act == "kill_loop":
+            raise LoopKilled(rule.get("message",
+                                      "fault-injected serve-loop kill"))
         if act == "kill_worker":
             rank = ctx.get("rank")
             rejoin = rule.get("rejoin_after")

@@ -855,3 +855,214 @@ def test_request_result_timeout_message():
     req = Request([1], max_new_tokens=1)
     with pytest.raises(MXNetError, match="in flight"):
         req.result(timeout=0)
+
+
+# -- lifecycle: deadlines, cancellation, drain, shutdown (ISSUE 15) ------
+
+def _lifecycle_imports():
+    from mxnet_tpu.serve import (ServeCancelled, ServeDeadlineExceeded,
+                                 ServeDraining, ServeInternalError,
+                                 ServeShutdown)
+    return (ServeCancelled, ServeDeadlineExceeded, ServeDraining,
+            ServeInternalError, ServeShutdown)
+
+
+def test_deadline_must_be_positive():
+    with pytest.raises(MXNetError, match="positive"):
+        Request([1], max_new_tokens=1, deadline_s=-2)
+
+
+def test_deadline_expires_in_queue():
+    _, ServeDeadlineExceeded, _, _, _ = _lifecycle_imports()
+    g = tiny_geometry(max_batch=1)
+    sched, _, arena = make_sched(g)
+    hog = sched.submit(Request([1, 2], max_new_tokens=8))
+    late = sched.submit(Request([3], max_new_tokens=2, deadline_s=0.02))
+    run_to_completion(sched)      # counter clock: queue wait >> 0.02s
+    assert hog.error is None
+    with pytest.raises(ServeDeadlineExceeded, match="deadline_s"):
+        late.result(timeout=0)
+    assert late.tokens == []      # never admitted: reaped from the queue
+    arena.assert_quiescent()
+
+
+def test_deadline_expires_mid_decode_and_frees_pages():
+    _, ServeDeadlineExceeded, _, _, _ = _lifecycle_imports()
+    sched, _, arena = make_sched()
+    req = sched.submit(Request([1, 2], max_new_tokens=14, deadline_s=0.2))
+    sched.step()                  # admit + prefill: one token exists
+    assert sched.active_slots() == 1
+    for _ in range(200):          # counter clock marches past deadline_t
+        if req.done():
+            break
+        sched.step()
+    with pytest.raises(ServeDeadlineExceeded, match="token"):
+        req.result(timeout=0)
+    assert 1 <= len(req.tokens) < 14   # partial progress, then the axe
+    assert sched.active_slots() == 0   # lane recycled immediately
+    arena.assert_quiescent()
+
+
+def test_default_deadline_env(monkeypatch):
+    monkeypatch.setenv("MXNET_SERVE_DEFAULT_DEADLINE", "12.5")
+    req = Request([1], max_new_tokens=1)
+    assert req.deadline_s == 12.5
+    # explicit per-request value wins over the env default
+    assert Request([1], max_new_tokens=1, deadline_s=3.0).deadline_s == 3.0
+    monkeypatch.setenv("MXNET_SERVE_DEFAULT_DEADLINE", "0")
+    assert Request([1], max_new_tokens=1).deadline_s is None
+
+
+def test_cancel_queued_request():
+    ServeCancelled, _, _, _, _ = _lifecycle_imports()
+    g = tiny_geometry(max_batch=1)
+    sched, runner, arena = make_sched(g)
+    hog = sched.submit(Request([1, 2], max_new_tokens=8))
+    victim = sched.submit(Request([3], max_new_tokens=2))
+    assert sched.cancel(victim.trace_id) is True
+    run_to_completion(sched)
+    assert hog.error is None
+    with pytest.raises(ServeCancelled, match="cancelled"):
+        victim.result(timeout=0)
+    assert len(runner.prefills) == 1   # the victim never touched the model
+    arena.assert_quiescent()
+
+
+def test_cancel_in_flight_recycles_lane_at_step_boundary():
+    ServeCancelled, _, _, _, _ = _lifecycle_imports()
+    sched, _, arena = make_sched()
+    req = sched.submit(Request([1, 2], max_new_tokens=10))
+    sched.step()
+    assert sched.active_slots() == 1
+    assert req.cancel() is None or True   # API returns None; just call it
+    sched.step()                          # reap runs at the boundary
+    with pytest.raises(ServeCancelled):
+        req.result(timeout=0)
+    assert sched.active_slots() == 0
+    arena.assert_quiescent()
+
+
+def test_cancel_unknown_trace_id_returns_false():
+    sched, _, _ = make_sched()
+    assert sched.cancel("req-nope") is False
+
+
+def test_cancellation_wins_over_expiry():
+    ServeCancelled, _, _, _, _ = _lifecycle_imports()
+    sched, _, arena = make_sched()
+    req = sched.submit(Request([1, 2], max_new_tokens=4, deadline_s=0.01))
+    req.cancel()
+    for _ in range(50):
+        if req.done():
+            break
+        sched.step()
+    with pytest.raises(ServeCancelled):   # not ServeDeadlineExceeded
+        req.result(timeout=0)
+    arena.assert_quiescent()
+
+
+def test_drain_refuses_new_submits_with_retry_after():
+    _, _, ServeDraining, _, _ = _lifecycle_imports()
+    sched, _, arena = make_sched()
+    served = sched.submit(Request([1, 2], max_new_tokens=4))
+    sched.drain()
+    with pytest.raises(ServeDraining) as ei:
+        sched.submit(Request([3], max_new_tokens=2))
+    assert ei.value.retry_after_s >= 1
+    run_to_completion(sched)              # in-flight work still finishes
+    assert served.error is None
+    assert sched.stats()["draining"] is True
+    arena.assert_quiescent()
+
+
+def test_server_stop_fails_queued_requests_typed():
+    _, _, _, _, ServeShutdown = _lifecycle_imports()
+    from mxnet_tpu.serve.server import LlamaServer
+
+    g = tiny_geometry()
+    arena = PagedKVArena(g)
+    srv = LlamaServer.from_parts(FakeRunner(g), arena, queue_depth=8,
+                                 clock=counter_clock())
+    req = srv.scheduler.submit(Request([1, 2], max_new_tokens=4))
+    srv.stop()                            # never started: queue non-empty
+    with pytest.raises(ServeShutdown, match="stopped"):
+        req.result(timeout=0)
+    arena.assert_quiescent()
+
+
+def test_retry_after_scales_with_backlog():
+    sched, _, _ = make_sched()
+    assert sched.retry_after_s() == 1     # empty queue, cold EMA
+    # warm the TPOT EMA, then pile a backlog on
+    first = sched.submit(Request([1, 2], max_new_tokens=8))
+    run_to_completion(sched)
+    assert first.error is None
+    for i in range(6):
+        sched.submit(Request([1 + i], max_new_tokens=12))
+    assert sched.retry_after_s() >= 1
+
+
+# -- arena quiescence + lifecycle stress ---------------------------------
+
+def test_assert_quiescent_names_the_leak():
+    g = tiny_geometry()
+    arena = PagedKVArena(g)
+    arena.assert_quiescent()              # fresh arena is clean
+    pages = arena.alloc(2, owner="req-leaky")
+    with pytest.raises(MXNetError, match="req-leaky"):
+        arena.assert_quiescent()
+    arena.free(pages, owner="req-leaky")
+    arena.assert_quiescent()
+
+
+def test_arena_reset_refuses_live_pages_then_rebuilds():
+    g = tiny_geometry()
+    arena = PagedKVArena(g)
+    pages = arena.alloc(3, owner="req-live")
+    with pytest.raises(MXNetError, match="live page"):
+        arena.reset()
+    arena.free(pages, owner="req-live")
+    arena.reset()
+    assert arena.free_pages == arena.total_pages
+    arena.assert_quiescent()
+
+
+def test_expire_cancel_stress_no_leaks_no_hangs():
+    """200 seeded iterations of mixed deadline/cancel/normal traffic;
+    after each drain the arena must be quiescent and every future
+    resolved — the slow-death leak check (ISSUE 15 satellite)."""
+    import os as _os
+
+    (ServeCancelled, ServeDeadlineExceeded, _, _,
+     _) = _lifecycle_imports()
+    rng = np.random.default_rng(
+        int(_os.environ.get("MXNET_CHAOS_SEED", "1337")))
+    sched, _, arena = make_sched()
+    for it in range(200):
+        reqs = []
+        for _ in range(int(rng.integers(1, 5))):
+            kind = rng.integers(0, 3)
+            deadline = 0.05 * float(rng.integers(1, 30)) \
+                if kind == 1 else None
+            req = Request([1 + int(rng.integers(0, 8))],
+                          max_new_tokens=int(rng.integers(1, 8)),
+                          deadline_s=deadline)
+            try:
+                sched.submit(req)
+            except MXNetError:
+                continue          # queue-full backpressure: fine
+            reqs.append((kind, req))
+        for kind, req in reqs:
+            if kind == 2 and rng.random() < 0.7:
+                sched.cancel(req.trace_id)
+        steps = 0
+        while sched.has_work():
+            sched.step()
+            steps += 1
+            assert steps < 5000, "stress hung at iteration %d" % it
+        for _, req in reqs:
+            assert req.done(), "unresolved future at iteration %d" % it
+            if req.error is not None:
+                assert isinstance(req.error, (ServeCancelled,
+                                              ServeDeadlineExceeded))
+        arena.assert_quiescent()

@@ -1,0 +1,335 @@
+"""Serve-tier chaos matrix (ISSUE 15, docs/serving.md "Robustness").
+
+Every scenario drives the REAL server loop body — ``LlamaServer.
+from_parts`` + ``_loop_tick()`` on the calling thread, scripted runner,
+injected counter clock — under a seeded :class:`FaultPlan`.  The seed
+comes from ``MXNET_CHAOS_SEED`` (CI pins and echoes it, so a red run
+replays locally from the log line).  No threads, no sleeps: a scenario
+is deterministic per seed, and the matrix asserts exactly that by
+running each one twice and comparing outcomes AND the plan's injection
+event log.
+
+Invariants checked after every scenario:
+- every future resolves (completed or typed error — never hung);
+- the arena is quiescent (zero page leaks — ``assert_quiescent``);
+- a second run with the same seed reproduces the same outcomes.
+"""
+import itertools
+import os
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serve import (PagedKVArena, Request, Scheduler,
+                             ServeCancelled, ServeInternalError,
+                             ServeShutdown)
+from mxnet_tpu.serve.model import KVGeometry
+from mxnet_tpu.serve.server import LlamaServer
+from mxnet_tpu.telemetry import flight as _flight
+from mxnet_tpu.testing import faults
+from mxnet_tpu.testing.faults import FaultInjected, FaultPlan, LoopKilled
+
+SEED = int(os.environ.get("MXNET_CHAOS_SEED", "1337"))
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    yield
+    faults.uninstall()
+
+
+def tiny_geometry(**over):
+    kw = dict(num_layers=1, num_heads=2, num_kv_heads=1, head_dim=4,
+              units=8, hidden_size=16, vocab_size=32, page_size=4,
+              num_pages=9, max_pages_per_seq=4, max_batch=2,
+              prefill_buckets=(4, 8))
+    kw.update(over)
+    return KVGeometry(**kw)
+
+
+class ChaosRunner:
+    """Deterministic scripted runner whose logits depend only on the
+    call sequence — so greedy output is a reproducible token pattern
+    and the no-fault parity test can compare exact sequences."""
+
+    def __init__(self, geometry):
+        self.g = geometry
+        self.calls = 0
+
+    def _logits(self, n):
+        out = np.zeros((n, self.g.vocab_size), dtype=np.float32)
+        for i in range(n):
+            out[i, (self.calls + i) % self.g.vocab_size] = 1.0
+        self.calls += 1
+        return out
+
+    def prefill(self, bucket, tokens, length, block_row):
+        return self._logits(1)[0]
+
+    def decode(self, tokens, positions, block_tables):
+        return self._logits(self.g.max_batch)
+
+
+def counter_clock(step=0.01):
+    c = itertools.count()
+    return lambda: next(c) * step
+
+
+def make_server(g=None, queue_depth=8):
+    g = g or tiny_geometry()
+    arena = PagedKVArena(g)
+    runner = ChaosRunner(g)
+    srv = LlamaServer.from_parts(runner, arena, queue_depth=queue_depth,
+                                 clock=counter_clock())
+    return srv, arena
+
+
+def drive(srv, max_ticks=2000):
+    """Tick the real loop body until the scheduler drains (or the loop
+    gave up and stopped itself)."""
+    for _ in range(max_ticks):
+        if srv._stop.is_set():
+            return
+        srv._loop_tick()
+        if not srv.scheduler.has_work() and srv._pending_swap is None:
+            return
+    raise AssertionError("scenario failed to drain in %d ticks"
+                         % max_ticks)
+
+
+def run_scenario(rules, n_requests=4, max_new=4):
+    """Install a seeded plan, serve ``n_requests``, return the outcome
+    fingerprint: per-request (status, error type, token sequence) plus
+    the plan's exact injection event log."""
+    srv, arena = make_server()
+    plan = FaultPlan(seed=SEED, rules=rules)
+    faults.install(plan)
+    try:
+        reqs = [srv.scheduler.submit(
+            Request([1 + i, 2 + i], max_new_tokens=max_new))
+            for i in range(n_requests)]
+        drive(srv)
+    finally:
+        faults.uninstall()
+    outcomes = []
+    for r in reqs:
+        assert r.done(), "future left hanging: %s" % r.trace_id
+        outcomes.append((type(r.error).__name__ if r.error else "ok",
+                         list(r.tokens)))
+    # the robustness invariant: whatever the fault did, every page came
+    # home (containment resets the arena; per-slot failure frees pages)
+    srv.arena.assert_quiescent()
+    events = [(e["rule"], e["n"], e["site"]) for e in plan.events]
+    return outcomes, events, srv
+
+
+# ---------------------------------------------------------------------------
+# no-fault parity: the chaos seams must be invisible when no plan matches
+# ---------------------------------------------------------------------------
+def test_no_fault_parity_with_and_without_plan():
+    def run(with_plan):
+        srv, _ = make_server()
+        if with_plan:  # installed but matching a site serving never hits
+            faults.install(FaultPlan(seed=SEED, rules=[
+                {"site": "send", "action": "raise", "times": 1}]))
+        try:
+            reqs = [srv.scheduler.submit(
+                Request([1 + i, 2 + i], max_new_tokens=4))
+                for i in range(4)]
+            drive(srv)
+        finally:
+            faults.uninstall()
+        return [list(r.result(timeout=0)) for r in reqs]
+
+    assert run(False) == run(True)
+
+
+# ---------------------------------------------------------------------------
+# the matrix: site x action, each run twice, outcomes must replay exactly
+# ---------------------------------------------------------------------------
+SCENARIOS = {
+    "prefill_raise": [
+        {"site": "serve_prefill", "action": "raise", "times": 1}],
+    "prefill_raise_second": [
+        {"site": "serve_prefill", "action": "raise", "after": 1,
+         "times": 1}],
+    "decode_raise": [
+        {"site": "serve_decode", "action": "raise", "after": 2,
+         "times": 1}],
+    "decode_delay": [
+        {"site": "serve_decode", "action": "delay", "delay": 0.0,
+         "times": 3}],
+    "disconnect_coinflip": [
+        {"site": "client_disconnect", "action": "raise", "prob": 0.3,
+         "times": 2}],
+    "kill_loop_step": [
+        {"site": "serve_step", "action": "kill_loop", "after": 2,
+         "times": 1}],
+    "kill_loop_mid_decode": [
+        {"site": "serve_decode", "action": "kill_loop", "after": 1,
+         "times": 1}],
+    "mixed": [
+        {"site": "serve_prefill", "action": "raise", "after": 1,
+         "times": 1},
+        {"site": "client_disconnect", "action": "raise", "prob": 0.2,
+         "times": 1}],
+}
+
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_chaos_scenario_deterministic_and_leak_free(name):
+    rules = SCENARIOS[name]
+    out_a, ev_a, _ = run_scenario(rules)
+    out_b, ev_b, _ = run_scenario(rules)
+    assert out_a == out_b, "same seed, different outcomes (%s)" % name
+    assert ev_a == ev_b, "same seed, different injections (%s)" % name
+    assert ev_a, "scenario %s never injected — dead rule" % name
+
+
+def test_prefill_fault_poisons_only_that_request():
+    outcomes, _, srv = run_scenario(SCENARIOS["prefill_raise"])
+    errs = [e for e, _ in outcomes]
+    assert errs.count("FaultInjected") == 1
+    assert errs.count("ok") == 3          # the lane recycled and served
+    assert srv.healthy()                  # a request fault is not a crash
+
+
+def test_decode_fault_fails_active_lanes_but_not_queue():
+    outcomes, _, srv = run_scenario(SCENARIOS["decode_raise"])
+    errs = [e for e, _ in outcomes]
+    assert "FaultInjected" in errs
+    assert "ok" in errs                   # queued requests still served
+    assert srv.healthy()
+
+
+def test_delay_fault_changes_nothing_observable():
+    outcomes, events, _ = run_scenario(SCENARIOS["decode_delay"])
+    assert all(e == "ok" for e, _ in outcomes)
+    assert len(events) == 3
+
+
+def test_disconnect_becomes_typed_cancel():
+    outcomes, events, srv = run_scenario(SCENARIOS["disconnect_coinflip"])
+    errs = [e for e, _ in outcomes]
+    assert errs.count("ServeCancelled") == len(events)
+    assert events, "the coin never landed — adjust prob for this seed"
+
+
+def test_kill_loop_contains_restarts_and_keeps_serving():
+    outcomes, _, srv = run_scenario(SCENARIOS["kill_loop_step"])
+    errs = [e for e, _ in outcomes]
+    assert "ServeInternalError" in errs   # in-flight failed typed
+    assert srv._loop_restarts == 1
+    assert not srv.healthy()              # sticky not-ok for the prober
+    assert srv.healthz()["ok"] is False
+    assert any(e["kind"] == "serve.loop_died"
+               for e in _flight.events(last=200))
+    # the loop restarted over a reset arena: new work still completes
+    r = srv.scheduler.submit(Request([7, 8], max_new_tokens=3))
+    drive(srv)
+    assert r.result(timeout=0) is not None and r.error is None
+    srv.arena.assert_quiescent()
+
+
+def test_kill_loop_mid_decode_frees_pages_before_containment():
+    outcomes, _, srv = run_scenario(SCENARIOS["kill_loop_mid_decode"])
+    assert any(e == "ServeInternalError" for e, _ in outcomes)
+    assert srv._loop_restarts == 1
+    srv.arena.assert_quiescent()
+
+
+def test_loop_gives_up_after_max_restarts(monkeypatch):
+    monkeypatch.setenv("MXNET_SERVE_LOOP_MAX_RESTARTS", "3")
+    g = tiny_geometry()
+    srv = LlamaServer.from_parts(ChaosRunner(g), PagedKVArena(g),
+                                 queue_depth=8, clock=counter_clock())
+    faults.install(FaultPlan(seed=SEED, rules=[
+        {"site": "serve_step", "action": "kill_loop", "times": 0}]))
+    try:
+        req = srv.scheduler.submit(Request([1, 2], max_new_tokens=4))
+        for _ in range(20):
+            if srv._stop.is_set():
+                break
+            srv._loop_tick()
+    finally:
+        faults.uninstall()
+    assert srv._stop.is_set() and srv._loop_restarts == 3
+    assert req.done()
+    # the request died at the FIRST crash (typed, not hung)
+    with pytest.raises(ServeInternalError, match="loop died"):
+        req.result(timeout=0)
+    # refusal: submits fail FAST instead of queueing into a dead loop
+    with pytest.raises(ServeInternalError, match="giving up"):
+        srv.scheduler.submit(Request([3], max_new_tokens=2))
+    assert any(e["kind"] == "serve.loop_gave_up"
+               for e in _flight.events(last=200))
+    srv.arena.assert_quiescent()
+
+
+# ---------------------------------------------------------------------------
+# drain + hot-swap under chaos
+# ---------------------------------------------------------------------------
+def test_drain_under_decode_delay_finishes_in_flight():
+    srv, arena = make_server()
+    faults.install(FaultPlan(seed=SEED, rules=[
+        {"site": "serve_decode", "action": "delay", "delay": 0.0,
+         "times": 0}]))
+    try:
+        reqs = [srv.scheduler.submit(Request([1 + i], max_new_tokens=3))
+                for i in range(3)]
+        srv._loop_tick()               # some in flight, some queued
+        stragglers = srv.drain(timeout=30)
+    finally:
+        faults.uninstall()
+    assert stragglers == 0
+    assert all(r.error is None for r in reqs)
+    with pytest.raises(MXNetError):    # admission is closed for good
+        srv.scheduler.submit(Request([9], max_new_tokens=1))
+    arena.assert_quiescent()
+
+
+def test_drain_timeout_fails_stragglers_typed():
+    # a runner that never finishes: decode keeps producing non-EOS
+    # tokens, and the budget is huge — drain must cut it off typed
+    srv, arena = make_server()
+    req = srv.scheduler.submit(Request([1, 2], max_new_tokens=14))
+    srv._loop_tick()
+    # timeout=0: the deadline is already past, so the synchronous drain
+    # path fails the in-flight request immediately
+    stragglers = srv.drain(timeout=0)
+    assert stragglers == 1
+    with pytest.raises(ServeShutdown, match="drain timed out"):
+        req.result(timeout=0)
+    arena.assert_quiescent()
+
+
+def test_hot_swap_mid_stream_drops_nothing():
+    g = tiny_geometry()
+    srv, arena_a = make_server(g=g)
+    first = srv.scheduler.submit(Request([1, 2], max_new_tokens=4))
+    srv._loop_tick()                    # first is mid-decode on arena A
+    arena_b = PagedKVArena(g)
+    runner_b = ChaosRunner(g)
+    import threading
+    done = threading.Event()
+    with srv._swap_lock:
+        srv._pending_swap = (g, runner_b, arena_b, "bundle-b", done)
+    second = srv.scheduler.submit(Request([3, 4], max_new_tokens=4))
+    drive(srv)
+    assert done.is_set() and srv.arena is arena_b
+    assert first.error is None and len(first.tokens) == 4
+    assert second.error is None and len(second.tokens) == 4
+    # the second request was served by the NEW runner over the NEW arena
+    assert runner_b.calls > 0
+    arena_a.assert_quiescent()
+    arena_b.assert_quiescent()
+
+
+def test_hot_swap_refuses_geometry_drift():
+    from mxnet_tpu.serve.model import check_geometry
+
+    g = tiny_geometry()
+    g2 = tiny_geometry(page_size=8)
+    with pytest.raises(MXNetError, match="page_size"):
+        check_geometry(g2, g.hot_swap_pins(), origin="bundle-b")

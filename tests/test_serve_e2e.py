@@ -468,3 +468,133 @@ def test_static_generate_matches_continuous_tokens(bundle):
     for prompt, out in zip(prompts, outs):
         assert out == greedy_reference(net, prompt, 3)
     assert srv.arena.free_pages == srv.arena.total_pages
+
+
+# -- robustness: deadlines, cancel, drain, hot-swap (ISSUE 15) -----------
+
+@pytest.fixture(scope="module")
+def bundle_b(tmp_path_factory):
+    """A second bundle, same geometry, DIFFERENT weights (seed) — the
+    hot-swap target.  Post-swap outputs must match THIS net."""
+    path = str(tmp_path_factory.mktemp("serve_b") / "micro-b.mxaot")
+    net = micro_llama(seed=21)
+    geometry = serve.export_serving_bundle(net, path, **GEOM_KW)
+    return path, net, geometry
+
+
+def test_hot_swap_mid_stream_zero_dropped(bundle, bundle_b):
+    path_a, net_a, _ = bundle
+    path_b, net_b, _ = bundle_b
+    prompts = _mixed_prompts(17, 6)
+    with serve.LlamaServer(path_a) as srv:
+        # traffic in flight on bundle A...
+        inflight = [srv.submit(p, max_new_tokens=6) for p in prompts]
+        # ...reload blocks until the loop swaps at a step boundary
+        srv.reload(path_b, timeout=120)
+        assert srv.bundle_path == path_b
+        # in-flight requests finished on the OLD executables, none dropped
+        outs_a = [r.result(timeout=120) for r in inflight]
+        assert all(r.error is None for r in inflight)
+        for p, o in zip(prompts, outs_a):
+            assert o == greedy_reference(net_a, p, 6), \
+                "hot swap corrupted an in-flight sequence"
+        # post-swap traffic is served by bundle B's weights
+        for p in prompts[:3]:
+            assert srv.generate(p, max_new_tokens=6) == \
+                greedy_reference(net_b, p, 6), \
+                "post-swap output does not match the new bundle"
+        assert srv.arena.free_pages == srv.arena.total_pages
+
+
+def test_reload_refuses_incompatible_geometry(bundle, tmp_path):
+    path_a, _, _ = bundle
+    net = micro_llama(seed=3)
+    other = str(tmp_path / "wide.mxaot")
+    kw = dict(GEOM_KW)
+    kw["page_size"] = 8
+    serve.export_serving_bundle(net, other, **kw)
+    with serve.LlamaServer(path_a) as srv:
+        with pytest.raises(MXNetError) as ei:
+            srv.reload(other)
+        assert "page_size" in str(ei.value)
+        assert srv.bundle_path == path_a  # still serving the old bundle
+        assert srv.generate([3, 1], max_new_tokens=2)
+
+
+def test_http_delete_cancels_queued_request(bundle):
+    path, _, _ = bundle
+    srv = serve.LlamaServer(path)     # loop NOT started: deterministic
+    host, port = srv.serve_http(port=0)
+    base = "http://%s:%d" % (host, port)
+    req = srv.scheduler.submit(serve.Request([3, 1], max_new_tokens=4))
+    delete = urllib.request.Request(
+        base + "/v1/generate/" + req.trace_id, method="DELETE")
+    with urllib.request.urlopen(delete) as resp:
+        assert json.loads(resp.read())["cancelled"] == req.trace_id
+    srv.scheduler.step()              # cancel lands at the step boundary
+    assert req.done()
+    with pytest.raises(serve.ServeCancelled):
+        req.result(timeout=0)
+    # unknown id: 404, not 500
+    delete = urllib.request.Request(
+        base + "/v1/generate/req-doesnotexist", method="DELETE")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(delete)
+    assert ei.value.code == 404
+    srv.arena.assert_quiescent()
+    srv.stop()
+
+
+def test_http_deadline_returns_504(bundle):
+    path, _, _ = bundle
+    with serve.LlamaServer(path) as srv:
+        host, port = srv.serve_http(port=0)
+        base = "http://%s:%d" % (host, port)
+        body = json.dumps({"prompt": [3, 1], "max_new_tokens": 4,
+                           "deadline_s": 1e-9}).encode()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                base + "/v1/generate", data=body))
+        assert ei.value.code == 504
+        assert b"deadline" in ei.value.read()
+        srv.arena.assert_quiescent()
+
+
+def test_http_drain_503_with_retry_after_and_healthz_flip(bundle):
+    path, _, _ = bundle
+    with serve.LlamaServer(path) as srv:
+        host, port = srv.serve_http(port=0)
+        base = "http://%s:%d" % (host, port)
+        assert srv.drain(timeout=5) == 0     # nothing in flight
+        body = json.dumps({"prompt": [1], "max_new_tokens": 2}).encode()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                base + "/v1/generate", data=body))
+        assert ei.value.code == 503
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        # /healthz goes 503 so probers flip without parsing the body
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/healthz")
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["draining"] is True
+
+
+def test_sigterm_drains_and_exits_clean(bundle):
+    import signal as _signal
+    import time as _time
+
+    path, _, _ = bundle
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-m", "mxnet_tpu.serve",
+         "--bundle", path, "--port", "0", "--drain-timeout", "10"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert "serving" in line, line
+        proc.send_signal(_signal.SIGTERM)
+        assert proc.wait(timeout=120) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
