@@ -758,12 +758,17 @@ def _serve_probe(path):
     its slowest member) on the same runner and arena — the measured gap
     is pure scheduling.  Both sides report the median of
     ``_SERVE_REPLAYS`` identical replays (see the constant's comment).
+    A third pass replays the continuous workload with the runtime lock
+    sanitizer installed (MXNET_LOCKCHECK, lint pass 11) so its overhead
+    is a tracked number (acceptance: <= 3% off the unproxied rate, like
+    the telemetry on/off gate; docs/static_analysis.md).
     Also reports the process's live-compile count:
     nonzero means the AOT warm start regressed and the throughput
     numbers are polluted by jit time.
     """
     from mxnet_tpu import serve
     from mxnet_tpu.telemetry import metrics as telemetry_metrics
+    from mxnet_tpu.testing import lockcheck
 
     srv = serve.LlamaServer(path).start()
     rates = []
@@ -785,12 +790,33 @@ def _serve_probe(path):
         static_rates.append(
             sum(len(t) for t in outs) / (time.perf_counter() - t0))
 
+    # lockcheck overhead: install() only proxies locks created AFTER it
+    # runs, so a FRESH server is built under the sanitizer and the
+    # identical seeded workload replayed on it.  The continuous number
+    # above stays the headline metric; this one rides as an extra.
+    lockcheck.install()
+    try:
+        lc_srv = serve.LlamaServer(path).start()
+        lc_rates = []
+        for _ in range(_SERVE_REPLAYS):
+            lc_wl = serve.poisson_workload(_SERVE_N_REQUESTS,
+                                           **_SERVE_WORKLOAD)
+            lc_reqs, lc_wall = serve.drive_workload(lc_srv, lc_wl,
+                                                    timeout=600)
+            lc_done = [r for r in lc_reqs if r.error is None]
+            lc_rates.append(sum(len(r.tokens) for r in lc_done) / lc_wall)
+        lc_srv.stop()
+    finally:
+        lockcheck.uninstall()
+        lockcheck.reset()
+
     snap = telemetry_metrics.snapshot()
     compiles = sum(s["value"] for s in snap.get(
         "mxnet_compiles_total", {}).get("series", []))
     doc = {
         "continuous_tok_s": round(_median(rates), 2),
         "static_tok_s": round(_median(static_rates), 2),
+        "lockcheck_tok_s": round(_median(lc_rates), 2),
         "completed": len(done),
         "n_requests": len(reqs),
         "ttft_p50_ms": round(sched.percentile("ttft", 0.50) * 1e3, 2),
@@ -827,11 +853,15 @@ def _run_serve(platform):
         shutil.rmtree(tmp, ignore_errors=True)
     static = doc["static_tok_s"]
     speedup = round(doc["continuous_tok_s"] / static, 2) if static else 0.0
+    cont = doc["continuous_tok_s"]
+    lc_overhead = (round((1.0 - doc["lockcheck_tok_s"] / cont) * 100.0, 2)
+                   if cont else 0.0)
     _log("serve: %.1f tok/s continuous vs %.1f static (%.2fx), "
-         "ttft p50/p99 %.1f/%.1f ms, %d/%d completed, %d live compiles"
+         "ttft p50/p99 %.1f/%.1f ms, %d/%d completed, %d live compiles, "
+         "lockcheck %.1f tok/s (%.1f%% overhead)"
          % (doc["continuous_tok_s"], static, speedup, doc["ttft_p50_ms"],
             doc["ttft_p99_ms"], doc["completed"], doc["n_requests"],
-            doc["live_compiles"]))
+            doc["live_compiles"], doc["lockcheck_tok_s"], lc_overhead))
     return {"value": doc["continuous_tok_s"],
             "static_tok_s": static,
             "continuous_vs_static": speedup,
@@ -840,7 +870,9 @@ def _run_serve(platform):
             "tpot_p50_ms": doc["tpot_p50_ms"],
             "completed": doc["completed"],
             "n_requests": doc["n_requests"],
-            "live_compiles": doc["live_compiles"]}
+            "live_compiles": doc["live_compiles"],
+            "lockcheck_tok_s": doc["lockcheck_tok_s"],
+            "lockcheck_overhead_pct": lc_overhead}
 
 
 def _serve_spec_export(path):

@@ -10,7 +10,8 @@ and framework code keeps two contracts:
 2. every device→host sync on the eager path is *intentional*, because each
    one stalls the PJRT stream the engine relies on for overlap.
 
-This package enforces both, statically and at runtime, with ten passes:
+This package enforces both, statically and at runtime, with eleven
+passes:
 
 * **tracing-safety lint** (``TS1xx``, ``tracing_safety``) — AST pass over
   ``hybrid_forward`` bodies and jit-wrapped functions: data-dependent
@@ -57,6 +58,14 @@ This package enforces both, statically and at runtime, with ten passes:
   placements predicted to exceed a declared per-device capacity,
   dominant parameters fully replicated onto a multi-device mesh,
   conflicting spec constraints inside one hot loop.
+* **concurrency discipline** (``CD11xx``, ``concurrency_check``) — per
+  class that owns locks: guarded fields accessed unlocked on
+  thread-reachable paths, lock-order inversions across call edges,
+  blocking calls and user-visible callbacks under a lock, manual
+  ``acquire()`` without try/finally.  Runtime half:
+  ``MXNET_LOCKCHECK=1`` (``testing/lockcheck.py``) proxies the
+  framework's named locks, builds the acquisition-order graph live and
+  raises ``LockCycleError`` on deadlock *potential*.
 
 CLI: ``python tools/mxlint.py mxnet_tpu/ examples/`` (the repo's own source
 is a permanent lint target; intentional syncs carry

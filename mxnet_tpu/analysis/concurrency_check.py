@@ -1,0 +1,528 @@
+"""CD11xx — concurrency discipline (pass 11, static side).
+
+The five threaded tiers (serve scheduler/loop, HTTP front, dist kvstore
+client+server, engine, telemetry) share one discipline: every piece of
+cross-thread state has exactly one guarding lock, locks nest in one
+global order, and nothing blocking or user-visible runs while a lock is
+held.  This pass checks what the AST makes visible; the runtime half is
+``mxnet_tpu/testing/lockcheck.py`` (``MXNET_LOCKCHECK=1``), which
+watches the same contracts on live interleavings.
+
+Per class, the pass first collects **lock attributes** — ``self._x =
+threading.Lock()/RLock()/Condition(...)`` or the instrumented
+``lockcheck.named_lock/named_rlock/named_condition`` forms.  A
+``Condition(self._lock)`` sharing an existing lock attribute is an
+*alias* of that lock (``with self._work`` holds ``self._lock``).  Then:
+
+* **CD1101** ``unguarded-field-access`` — a *guarded* field (a
+  majority of its non-``__init__`` accesses, two at minimum, hold a
+  lock) is accessed with no lock held, in a method reachable
+  from a thread entry point (``Thread(target=self.m)``, ``_loop_tick``,
+  an HTTP ``do_*`` handler, or a server ``handle``/``_handle``).
+* **CD1102** ``lock-order-inversion`` — the class's nested-``with``
+  acquisition graph (including acquisitions reached through
+  ``self.m()`` call edges, to a fixpoint) contains a cycle; reported
+  once per cycle with both conflicting paths and their lines.
+* **CD1103** ``blocking-call-under-lock`` — a blocking call while any
+  lock is held: socket ``recv``/``recv_into``/``accept``,
+  ``Future.result``, the host-sync set (``asnumpy``/``asscalar``/
+  ``wait_to_read``/``block_until_ready``/``waitall`` — HS2xx's table),
+  ``time.sleep``, or a condition ``.wait()`` **without a timeout**.  A
+  *timed* wait on a condition is the one legitimate block-under-lock
+  (it releases the lock; RB701 owns the no-deadline loop shape).
+* **CD1104** ``acquire-without-finally`` — a manual ``<lock>.acquire()``
+  statement not immediately followed by a ``try`` whose ``finally``
+  releases the same lock: any exception in between leaks the lock
+  forever.  ``with`` is the fix (or the canonical acquire/try/finally).
+* **CD1105** ``callback-under-lock`` — resolving a user-visible future
+  (``set_result``/``set_exception``), waking a user-facing done-event
+  (``<x>_done.set()``/``<x>_event.set()``), or invoking a hook/callback
+  while holding a lock: user code runs inside the critical section and
+  can re-enter the scheduler (deadlock) or stretch the hold time
+  unboundedly.  Resolve outside the lock, as
+  ``serve/scheduler.py::_finish_slot`` does.
+
+Everything is conservative in the usual mxlint way: locks, fields and
+call edges are only believed when literally visible (``self.<attr>``
+receivers, same-class calls), so dynamic dispatch and cross-object
+locking produce no findings — the runtime sanitizer covers those.
+"""
+from __future__ import annotations
+
+import ast
+
+from .findings import Finding
+
+# lock-constructor spellings recognized in `self._x = <ctor>(...)`
+_LOCK_CTORS = frozenset({"Lock", "RLock", "named_lock", "named_rlock"})
+_COND_CTORS = frozenset({"Condition", "named_condition"})
+
+# CD1103 vocabulary: RB701/HS2xx's blocking tables, plus the wire calls
+_BLOCKING_METHODS = frozenset({
+    "recv", "recv_into", "accept", "result",           # socket / Future
+    "asnumpy", "asscalar", "wait_to_read",             # host-sync pulls
+    "block_until_ready",
+})
+_BLOCKING_FUNCS = frozenset({"waitall", "sleep"})
+
+# CD1105 vocabulary
+_CALLBACK_METHODS = frozenset({"set_result", "set_exception"})
+_HOOK_WORDS = ("hook", "callback")
+_EVENT_SUFFIXES = ("_done", "_event", "_ready")
+
+# thread entry points: name-shaped (the serve loop, HTTP handlers, the
+# socket server's per-connection handler)
+_ENTRY_NAMES = frozenset({"_loop", "_loop_tick", "handle", "_handle",
+                          "run", "serve_forever"})
+
+_LOCKISH_WORDS = ("lock", "_lk", "mutex", "_cv", "cond", "sem")
+
+
+def _call_name(call):
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _self_attr(node):
+    """``self.<attr>`` -> attr name, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _lockish_name(name):
+    low = name.lower()
+    return any(w in low for w in _LOCKISH_WORDS)
+
+
+class _ClassInfo:
+    def __init__(self, node):
+        self.node = node
+        self.locks = {}         # attr -> canonical lock attr (aliases)
+        self.methods = {}       # name -> FunctionDef
+        self.entry_methods = set()
+
+
+def _collect_class(cls):
+    info = _ClassInfo(cls)
+    for item in cls.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info.methods[item.name] = item
+    # lock attributes + condition aliases, wherever assigned
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        attr = _self_attr(node.targets[0])
+        if attr is None or not isinstance(node.value, ast.Call):
+            continue
+        ctor = _call_name(node.value)
+        if ctor in _LOCK_CTORS:
+            info.locks[attr] = attr
+        elif ctor in _COND_CTORS:
+            # Condition(self._lock) aliases the shared lock; a bare
+            # Condition() (or named_condition("x")) owns its own
+            shared = None
+            for arg in node.value.args:
+                a = _self_attr(arg)
+                if a is not None:
+                    shared = a
+                    break
+            info.locks[attr] = shared if shared is not None else attr
+    # thread entry points: name-shaped, Thread(target=self.m), and
+    # HTTP do_* handlers
+    for name, fn in info.methods.items():
+        if name in _ENTRY_NAMES or name.startswith("do_"):
+            info.entry_methods.add(name)
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call) and _call_name(node) == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = _self_attr(kw.value)
+                    if target in info.methods:
+                        info.entry_methods.add(target)
+    return info
+
+
+def _canonical(info, attr):
+    """Resolve a lock attr through condition aliasing (one level)."""
+    seen = set()
+    while attr in info.locks and info.locks[attr] != attr \
+            and attr not in seen:
+        seen.add(attr)
+        attr = info.locks[attr]
+    return attr
+
+
+class _MethodScan:
+    """One pass over a method body tracking the held-lock stack."""
+
+    def __init__(self, info, fn):
+        self.info = info
+        self.fn = fn
+        # direct acquisitions: (lock, held_tuple, lineno, col)
+        self.acquisitions = []
+        # self-method calls: (name, held_tuple, lineno, col)
+        self.calls = []
+        # self.<field> accesses: (field, held?, lineno, col, is_store)
+        self.accesses = []
+        # blocking / callback calls under a held lock:
+        # (kind_rule, lineno, col, detail)
+        self.flagged = []
+        # manual acquire statements: (node index context handled later)
+        self._walk_body(fn.body, ())
+
+    # -- helpers ---------------------------------------------------------
+    def _lock_of_with_item(self, item):
+        attr = _self_attr(item.context_expr)
+        if attr is not None and attr in self.info.locks:
+            return _canonical(self.info, attr)
+        return None
+
+    def _scan_expr(self, node, held):
+        """Collect field accesses + flag blocking/callback calls in an
+        expression subtree (no with/statement structure below here)."""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute):
+                attr = _self_attr(sub)
+                if attr is not None and attr not in self.info.locks:
+                    store = isinstance(sub.ctx, (ast.Store, ast.Del))
+                    self.accesses.append(
+                        (attr, bool(held), sub.lineno, sub.col_offset,
+                         store))
+            if isinstance(sub, ast.Call):
+                self._check_call(sub, held)
+
+    def _check_call(self, call, held):
+        name = _call_name(call)
+        if name is None:
+            return
+        # self-method call edges (for CD1101 reachability + CD1102)
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and \
+                _self_attr(fn) is not None and name in self.info.methods:
+            self.calls.append((name, tuple(held), call.lineno,
+                               call.col_offset))
+        if not held:
+            return
+        # CD1103: blocking call while holding a lock
+        if isinstance(fn, ast.Attribute):
+            if name in _BLOCKING_METHODS:
+                self.flagged.append(("CD1103", call.lineno,
+                                     call.col_offset,
+                                     ".%s()" % name))
+            elif name == "wait" and not call.args and \
+                    not any(kw.arg == "timeout" for kw in call.keywords):
+                # an untimed wait never comes back if the notifier died;
+                # Event.wait() under someone ELSE's lock blocks it too
+                self.flagged.append(("CD1103", call.lineno,
+                                     call.col_offset,
+                                     ".wait() with no timeout"))
+        if name in _BLOCKING_FUNCS:
+            self.flagged.append(("CD1103", call.lineno, call.col_offset,
+                                 "%s()" % name))
+        # CD1105: user-visible callback while holding a lock
+        if isinstance(fn, ast.Attribute):
+            if name in _CALLBACK_METHODS:
+                self.flagged.append(("CD1105", call.lineno,
+                                     call.col_offset, ".%s()" % name))
+            elif name == "set" and any(
+                    fn.value.attr.endswith(s) if isinstance(
+                        fn.value, ast.Attribute) else
+                    fn.value.id.endswith(s) if isinstance(
+                        fn.value, ast.Name) else False
+                    for s in _EVENT_SUFFIXES):
+                self.flagged.append(
+                    ("CD1105", call.lineno, call.col_offset,
+                     "done-event .set()"))
+            elif any(w in name.lower() for w in _HOOK_WORDS):
+                self.flagged.append(("CD1105", call.lineno,
+                                     call.col_offset, "%s()" % name))
+        elif isinstance(fn, ast.Name) and \
+                any(w in name.lower() for w in _HOOK_WORDS):
+            self.flagged.append(("CD1105", call.lineno, call.col_offset,
+                                 "%s()" % name))
+
+    # -- statement walk --------------------------------------------------
+    def _walk_body(self, body, held):
+        for stmt in body:
+            self._walk_stmt(stmt, held)
+
+    def _walk_stmt(self, stmt, held):
+        if isinstance(stmt, ast.With):
+            inner = list(held)
+            scanned = []
+            for item in stmt.items:
+                lock = self._lock_of_with_item(item)
+                if lock is not None:
+                    self.acquisitions.append(
+                        (lock, tuple(inner), item.context_expr.lineno,
+                         item.context_expr.col_offset))
+                    inner.append(lock)
+                else:
+                    scanned.append(item.context_expr)
+                if item.optional_vars is not None:
+                    scanned.append(item.optional_vars)
+            for expr in scanned:
+                self._scan_expr(expr, held)
+            self._walk_body(stmt.body, tuple(inner))
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested defs run later, not under this lock scope
+            self._walk_body(stmt.body, ())
+        elif isinstance(stmt, (ast.If, ast.While)):
+            self._scan_expr(stmt.test, held)
+            self._walk_body(stmt.body, held)
+            self._walk_body(stmt.orelse, held)
+        elif isinstance(stmt, ast.For):
+            self._scan_expr(stmt.target, held)
+            self._scan_expr(stmt.iter, held)
+            self._walk_body(stmt.body, held)
+            self._walk_body(stmt.orelse, held)
+        elif isinstance(stmt, ast.Try):
+            self._walk_body(stmt.body, held)
+            for h in stmt.handlers:
+                self._walk_body(h.body, held)
+            self._walk_body(stmt.orelse, held)
+            self._walk_body(stmt.finalbody, held)
+        else:
+            for node in ast.iter_child_nodes(stmt):
+                self._scan_expr(node, held)
+
+
+def _acquire_target(stmt):
+    """``<x>.acquire(...)`` as a statement (Expr or single Assign):
+    returns the receiver AST node, else None."""
+    if isinstance(stmt, ast.Expr):
+        call = stmt.value
+    elif isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+        call = stmt.value
+    else:
+        return None
+    if not isinstance(call, ast.Call) or \
+            not isinstance(call.func, ast.Attribute) or \
+            call.func.attr != "acquire":
+        return None
+    return call.func.value
+
+
+def _releases_in_finally(stmt, recv_dump):
+    """Does ``stmt`` (expected: Try) release ``recv_dump`` in finally?"""
+    if not isinstance(stmt, ast.Try) or not stmt.finalbody:
+        return False
+    for node in ast.walk(ast.Module(body=stmt.finalbody,
+                                    type_ignores=[])):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "release" and \
+                ast.dump(node.func.value) == recv_dump:
+            return True
+    return False
+
+
+class _Cd1104Checker(ast.NodeVisitor):
+    """Module-wide: manual acquire() without the try/finally shape."""
+
+    def __init__(self, path, findings, class_lock_attrs):
+        self.path = path
+        self.findings = findings
+        self.class_lock_attrs = class_lock_attrs  # set of known attrs
+
+    def _check_body(self, body):
+        for i, stmt in enumerate(body):
+            recv = _acquire_target(stmt)
+            if recv is not None and self._lockish(recv):
+                nxt = body[i + 1] if i + 1 < len(body) else None
+                if nxt is None or not _releases_in_finally(
+                        nxt, ast.dump(recv)):
+                    self.findings.append(Finding(
+                        self.path, stmt.lineno, stmt.col_offset,
+                        "CD1104",
+                        "manual %s.acquire() without an immediate "
+                        "try/finally release: any exception before the "
+                        "release leaks the lock forever — use `with`, "
+                        "or `acquire(); try: ... finally: release()`"
+                        % _recv_label(recv)))
+        for stmt in body:
+            for child_body in _child_bodies(stmt):
+                self._check_body(child_body)
+
+    def _lockish(self, recv):
+        attr = _self_attr(recv)
+        if attr is not None:
+            return attr in self.class_lock_attrs or _lockish_name(attr)
+        if isinstance(recv, ast.Name):
+            return _lockish_name(recv.id)
+        if isinstance(recv, ast.Attribute):
+            return _lockish_name(recv.attr)
+        return False
+
+    def run(self, tree):
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_body(node.body)
+
+
+def _child_bodies(stmt):
+    for field in ("body", "orelse", "finalbody"):
+        body = getattr(stmt, field, None)
+        if body:
+            yield body
+    for h in getattr(stmt, "handlers", ()):
+        yield h.body
+
+
+def _recv_label(recv):
+    attr = _self_attr(recv)
+    if attr is not None:
+        return "self.%s" % attr
+    if isinstance(recv, ast.Name):
+        return recv.id
+    if isinstance(recv, ast.Attribute):
+        return recv.attr
+    return "<lock>"
+
+
+def _check_class(path, cls, findings):
+    info = _collect_class(cls)
+    if not info.locks:
+        return
+    scans = {name: _MethodScan(info, fn)
+             for name, fn in info.methods.items()}
+
+    # ---- CD1103 / CD1105: flagged calls under a held lock --------------
+    for scan in scans.values():
+        for rule, lineno, col, detail in scan.flagged:
+            if rule == "CD1103":
+                findings.append(Finding(
+                    path, lineno, col, "CD1103",
+                    "blocking call %s while holding a lock: every other "
+                    "thread needing that lock stalls behind the block "
+                    "(and a dead peer wedges them forever) — move the "
+                    "blocking call outside the critical section" % detail))
+            else:
+                findings.append(Finding(
+                    path, lineno, col, "CD1105",
+                    "user-visible callback (%s) while holding a lock: "
+                    "user code runs inside the critical section and can "
+                    "re-enter it (deadlock) or stretch the hold time — "
+                    "collect under the lock, invoke after release"
+                    % detail))
+
+    # ---- CD1102: acquisition-order cycles ------------------------------
+    # method -> all locks it (transitively) acquires, to a fixpoint
+    acquires = {name: {lock for lock, _h, _l, _c in scan.acquisitions}
+                for name, scan in scans.items()}
+    changed = True
+    while changed:
+        changed = False
+        for name, scan in scans.items():
+            for callee, _held, _l, _c in scan.calls:
+                extra = acquires.get(callee, set()) - acquires[name]
+                if extra:
+                    acquires[name] |= extra
+                    changed = True
+    edges = {}   # (src, dst) -> (lineno, col, method)
+    for name, scan in scans.items():
+        for lock, heldt, lineno, col in scan.acquisitions:
+            for src in heldt:
+                if src != lock and (src, lock) not in edges:
+                    edges[(src, lock)] = (lineno, col, name)
+        for callee, heldt, lineno, col in scan.calls:
+            for lock in acquires.get(callee, ()):
+                for src in heldt:
+                    if src != lock and (src, lock) not in edges:
+                        edges[(src, lock)] = (lineno, col, name)
+    adj = {}
+    for (src, dst) in edges:
+        adj.setdefault(src, set()).add(dst)
+    reported = set()
+    for (src, dst), (lineno, col, method) in sorted(
+            edges.items(), key=lambda kv: (kv[1][0], kv[1][1])):
+        back = _bfs_path(adj, dst, src)
+        if back is None:
+            continue
+        cycle_key = frozenset(back)
+        if cycle_key in reported:
+            continue
+        reported.add(cycle_key)
+        bl, bc, bm = edges[(back[0], back[1])]
+        findings.append(Finding(
+            path, lineno, col, "CD1102",
+            "lock-order inversion in %s: %s takes self.%s -> self.%s "
+            "here, but %s takes %s (line %d) — two threads running "
+            "these paths deadlock"
+            % (cls.name, method, src, dst, bm,
+               " -> ".join("self.%s" % n for n in back), bl)))
+
+    # ---- CD1101: guarded fields accessed unlocked on thread paths ------
+    if not info.entry_methods:
+        return
+    # methods reachable from entry points via self-calls
+    reach = set(info.entry_methods)
+    frontier = list(reach)
+    while frontier:
+        m = frontier.pop()
+        for callee, _h, _l, _c in scans[m].calls:
+            if callee not in reach and callee in scans:
+                reach.add(callee)
+                frontier.append(callee)
+    locked_n = {}
+    unlocked = {}   # field -> [(method, lineno, col)]
+    total_n = {}
+    for name, scan in scans.items():
+        init = name == "__init__"
+        for field, under, lineno, col, _store in scan.accesses:
+            if init:
+                continue
+            total_n[field] = total_n.get(field, 0) + 1
+            if under:
+                locked_n[field] = locked_n.get(field, 0) + 1
+            else:
+                unlocked.setdefault(field, []).append(
+                    (name, lineno, col))
+    for field, n_locked in locked_n.items():
+        outside = unlocked.get(field, ())
+        if n_locked < 2 or not outside or n_locked <= len(outside):
+            continue                       # not predominantly guarded
+        for method, lineno, col in outside:
+            if method not in reach:
+                continue
+            findings.append(Finding(
+                path, lineno, col, "CD1101",
+                "self.%s is guarded (%d of %d accesses hold a lock) "
+                "but this thread-reachable access in %s.%s holds none "
+                "— a racing writer can interleave; take the lock or "
+                "copy the value out under it"
+                % (field, n_locked, total_n[field], cls.name, method)))
+
+
+def _bfs_path(adj, src, dst):
+    frontier = [[src]]
+    seen = {src}
+    while frontier:
+        p = frontier.pop(0)
+        for nxt in adj.get(p[-1], ()):
+            if nxt == dst:
+                return p + [nxt]
+            if nxt not in seen:
+                seen.add(nxt)
+                frontier.append(p + [nxt])
+    return None
+
+
+def run(path, tree, findings=None):
+    """Run the CD pass over one parsed module; returns the findings."""
+    if findings is None:
+        findings = []
+    lock_attrs = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            _check_class(path, node, findings)
+            info = _collect_class(node)
+            lock_attrs.update(info.locks)
+    _Cd1104Checker(path, findings, lock_attrs).run(tree)
+    return findings

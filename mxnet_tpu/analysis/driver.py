@@ -16,8 +16,8 @@ import ast
 import os
 import textwrap
 
-from . import (cache_keys, collective_check, host_sync, planner_check,
-               sharding_check, tracing_safety, wait_loops)
+from . import (cache_keys, collective_check, concurrency_check, host_sync,
+               planner_check, sharding_check, tracing_safety, wait_loops)
 from .suppressions import SuppressionFile, inline_suppressed
 
 _SKIP_DIRS = frozenset({"__pycache__", ".git", "node_modules", "build",
@@ -26,7 +26,8 @@ _SKIP_DIRS = frozenset({"__pycache__", ".git", "node_modules", "build",
 # rule-band prefix -> pass family, for --pass/--only selection.  RC/EA/GS
 # bands don't run through lint_source but are still valid selectors (the
 # CLI gates the registry check / symbol files on them).
-PASS_BANDS = ("TS", "HS", "RC", "EA", "GS", "CC", "RB", "CS", "SH", "SP")
+PASS_BANDS = ("TS", "HS", "RC", "EA", "GS", "CC", "RB", "CS", "SH", "SP",
+              "CD")
 
 
 def normalize_only(only):
@@ -82,6 +83,8 @@ def _run_static_passes(path, tree, registry_names, findings, strict, only):
         sharding_check.run(path, tree, findings, strict=strict)
     if _band_selected("SP", only):
         planner_check.run(path, tree, findings, strict=strict)
+    if _band_selected("CD", only):
+        concurrency_check.run(path, tree, findings)
     if only is not None:
         findings[:] = [f for f in findings if rule_selected(f.rule, only)]
 

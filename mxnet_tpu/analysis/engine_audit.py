@@ -31,6 +31,7 @@ from __future__ import annotations
 import threading
 
 from ..base import MXNetError
+from ..testing import lockcheck as _lockcheck
 from .findings import rule_doc
 
 
@@ -53,7 +54,7 @@ class EngineAudit:
     def __init__(self, strict=True):
         self.strict = strict
         self.violations = []  # (rule, message) when strict=False
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.named_lock("engine.audit")
         self._published = {}  # vid -> version as last seen by the engine
         self._writing = {}    # vid -> thread ident currently writing it
         self.checked_pushes = 0

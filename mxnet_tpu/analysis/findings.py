@@ -27,6 +27,12 @@ Rule ID bands (stable, documented in ``docs/static_analysis.md``):
   ``analysis/spmd_cost.py`` — the same model the sharding planner
   scores candidates with — over AST-visible meshes, capacities and
   placements; see ``docs/static_analysis.md`` Pass 10)
+* ``CD11xx`` — concurrency discipline (static AST over classes that
+  own locks: guarded-field races, lock-order inversions, blocking
+  calls and user-visible callbacks under a lock, leaked manual
+  acquires; the dynamic half is ``MXNET_LOCKCHECK=1`` —
+  ``testing/lockcheck.py`` — which enforces the same acquisition-order
+  contract on live interleavings)
 """
 from __future__ import annotations
 
@@ -164,6 +170,28 @@ RULES = {
                "with_sharding_constraint spec literals inside one loop "
                "body — GSPMD inserts a reshard between the layouts "
                "every iteration of the hot loop"),
+    "CD1101": ("unguarded-field-access", True,
+               "a field predominantly accessed under a lock is read or "
+               "written with no lock held on a thread-reachable path — "
+               "a racing writer can interleave mid-operation"),
+    "CD1102": ("lock-order-inversion", True,
+               "two code paths acquire the same pair of locks in "
+               "opposite orders — some thread interleaving deadlocks; "
+               "reported with both acquisition paths"),
+    "CD1103": ("blocking-call-under-lock", True,
+               "socket recv/accept, Future.result, host-sync pulls, "
+               "time.sleep or an untimed condition-wait while holding a "
+               "lock — every thread needing that lock stalls behind the "
+               "block, forever if the peer is dead"),
+    "CD1104": ("acquire-without-finally", True,
+               "manual lock.acquire() not immediately followed by "
+               "try/finally release — any exception in between leaks "
+               "the lock permanently; use `with`"),
+    "CD1105": ("callback-under-lock", True,
+               "set_result/set_exception, a done-event .set(), or a "
+               "hook/callback invoked while holding a lock — user code "
+               "runs inside the critical section and can re-enter it "
+               "(deadlock) or stretch the hold time unboundedly"),
 }
 
 # rule id -> severity; rules not listed are "error".  Ordering:
@@ -182,6 +210,12 @@ SEVERITY = {
     "SH902": "warn",
     "SP1002": "warn",
     "SP1003": "warn",
+    # CD1101/CD1103/CD1105 are heuristic (guarded-majority inference,
+    # blocking/callback vocabularies) -> warn; CD1102 (a provable
+    # inversion) and CD1104 (a provable leak path) stay errors.
+    "CD1101": "warn",
+    "CD1103": "warn",
+    "CD1105": "warn",
 }
 
 _SEVERITY_RANK = {"note": 0, "warn": 1, "error": 2}

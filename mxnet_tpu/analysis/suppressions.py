@@ -23,8 +23,11 @@ from __future__ import annotations
 import fnmatch
 import re
 
-_INLINE = re.compile(r"#\s*mxlint:\s*(allow-host-sync|disable=([A-Z]{2}\d{3}"
-                     r"(?:\s*,\s*[A-Z]{2}\d{3})*))")
+# \d{3,4}: rule ids are 2 letters + 3 digits up to the SH9xx band and
+# 4 digits from SP10xx/CD11xx on — a 3-digit-only pattern would silently
+# truncate `disable=SP1001` to SP100 and never match the finding
+_INLINE = re.compile(r"#\s*mxlint:\s*(allow-host-sync|disable="
+                     r"([A-Z]{2}\d{3,4}(?:\s*,\s*[A-Z]{2}\d{3,4})*))")
 
 _ALLOW_HOST_SYNC = frozenset({"HS201", "HS202", "HS203", "HS204", "TS103"})
 

@@ -44,10 +44,11 @@ import pickle
 import threading
 
 from .base import MXNetError, atomic_path
+from .testing import lockcheck as _lockcheck
 
 _AOT_MAGIC = b"MXAOT1\n"
 
-_lock = threading.Lock()
+_lock = _lockcheck.named_lock("compile.cache")
 # raw monitoring-event tallies; "misses" is derived (requests - hits)
 _stats = {"hits": 0, "writes": 0, "requests": 0, "evictions": 0,
           "aot_loads": 0, "aot_saves": 0}

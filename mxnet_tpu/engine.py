@@ -479,7 +479,17 @@ class Engine:
         self._inflight = collections.deque()  # recent output buffers (ring)
         self._inflight_cap = int(os.environ.get("MXNET_ENGINE_INFLIGHT_CAP", "512"))
         # op bulking knobs (reference: MXNET_EXEC_BULK_EXEC_*,
-        # docs/env_vars.md) — segments are per-thread
+        # docs/env_vars.md) — segments are per-thread.
+        #
+        # Concurrency contract (CD11xx / docs/static_analysis.md): the
+        # engine owns NO locks by design.  Mutable state is either
+        # per-thread (this threading.local), append-only counters read
+        # for monitoring, or var version/pending maps whose cross-thread
+        # discipline EngineAudit (MXNET_ENGINE_AUDIT=1) checks at every
+        # push — serialization is the caller's (stream's) job, exactly
+        # like the reference engine's per-var queues.  Keep it that way:
+        # a lock on the push path would serialize dispatch against the
+        # device and show up directly in mxnet_lock_hold_seconds.
         self._bulk_tls = threading.local()
         self._bulk_train = os.environ.get(
             "MXNET_EXEC_BULK_EXEC_TRAIN", "1") not in ("", "0")

@@ -41,41 +41,38 @@ def _platform_pick(run, *args):
     """Compiled kernel ONLY on tpu; every other platform (cpu, and
     untested cuda/rocm) goes through the interpreter.
 
-    Under a trace, ``jax.lax.platform_dependent`` resolves per lowering
-    platform, so the same traced computation runs the real kernel on TPU
-    and the interpreter on the host — regardless of where the surrounding
-    jit ends up placed (a cpu-committed input must never see the compiled
-    TPU kernel).  With CONCRETE (eager) arguments the platform is decided
-    up front instead: eager cond lowering builds every branch, which
-    would lower the TPU pallas branch on a CPU backend and fail.
+    The platform is resolved from the backend at TRACE time, NOT via
+    ``jax.lax.platform_dependent``: on this jax version the cond over
+    the platform index still LOWERS every branch, and the compiled-
+    pallas branch refuses to lower for cpu — so a traced
+    ``platform_dependent`` poisons every CPU jit that touches the op
+    (the same bug ``ops/paged_attention.py`` works around, and the
+    exact failure ``tests/test_forward[_contrib_flash_attention]``
+    used to hit).  ``jax.default_backend()`` is a host-side query,
+    safe under trace; committed-device placement off the default
+    backend is not a supported mix for these kernels.
     """
     from jax import core as _core
 
+    interpret = jax.default_backend() != "tpu"
     if not any(isinstance(a, _core.Tracer) for a in args):
-        plat = None
         for a in args:
             devs = getattr(a, "devices", None)
             if callable(devs):
                 ds = list(devs())
                 if ds:
-                    plat = ds[0].platform
+                    interpret = ds[0].platform != "tpu"
                     break
-        if plat is None:
-            plat = jax.default_backend()
         # jit the eager call (cached per kernel+attrs): un-jitted
         # interpret-mode pallas dispatches one tiny executable per inner
         # op per grid point — minutes instead of milliseconds
-        key = (run.func, tuple(sorted(run.keywords.items())),
-               plat != "tpu")
+        key = (run.func, tuple(sorted(run.keywords.items())), interpret)
         fn = _EAGER_JIT_CACHE.get(key)
         if fn is None:
-            fn = jax.jit(functools.partial(run, interpret=plat != "tpu"))
+            fn = jax.jit(functools.partial(run, interpret=interpret))
             _EAGER_JIT_CACHE[key] = fn
         return fn(*args)
-    return jax.lax.platform_dependent(
-        *args,
-        tpu=functools.partial(run, interpret=False),
-        default=functools.partial(run, interpret=True))
+    return run(*args, interpret=interpret)
 
 
 # ---------------------------------------------------------------------------

@@ -86,6 +86,7 @@ from ..ndarray import sparse as _sp
 from ..telemetry import flight as _flight
 from ..telemetry import metrics as _metrics
 from ..testing.faults import maybe_inject as _inject, set_role as _set_role
+from ..testing import lockcheck as _lockcheck
 
 
 # ---------------------------------------------------------------------------
@@ -515,9 +516,9 @@ class _KeyState:
         self.pending = []  # accumulated pushes this round
         self.contributors = set()  # worker ranks that pushed this round
         self.round = 0
-        self.round_done = threading.Condition()
+        self.round_done = _lockcheck.named_condition("kv.srv.round")
         self.last_error = None  # (generation, message) of a timed-out round
-        self.lock = threading.Lock()
+        self.lock = _lockcheck.named_lock("kv.srv.key")
 
 
 class _RoundError(MXNetError):
@@ -545,21 +546,22 @@ class DistServer:
         self._num_workers = int(num_workers)
         self._sync = sync
         self._keys = {}
-        self._keys_lock = threading.Lock()
+        self._keys_lock = _lockcheck.named_lock("kv.srv.keys")
         self._updater = None
         self._optimizer = None
         self._barrier_count = 0
         self._barrier_ranks = set()
         self._barrier_gen = 0
         self._barrier_error = None  # (generation, message)
-        self._barrier_cv = threading.Condition()
+        self._barrier_cv = _lockcheck.named_condition("kv.srv.barrier")
         self._stop = threading.Event()
         self._stop_count = 0
         self._stopped_ranks = set()
-        self._stop_lock = threading.Lock()
+        self._stop_lock = _lockcheck.named_lock("kv.srv.stop")
         # fault-tolerance state (docs/fault_tolerance.md)
         self._seq_cache = {}  # rank -> OrderedDict(seq -> (cmd, fields))
-        self._seq_cv = threading.Condition()  # guards + signals _seq_cache
+        # guards + signals _seq_cache
+        self._seq_cv = _lockcheck.named_condition("kv.srv.seq")
         self._dead_ranks = set()  # ranks evicted from the roster
         self._replays = 0  # dedup'd (replayed) mutations served from cache
         # elastic membership (wire v3): the roster is derived —
@@ -567,7 +569,7 @@ class DistServer:
         # monotonic epoch; every eviction/admission bumps it
         self._epoch = 0
         self._step = 0  # max training-step hint seen in mutating meta
-        self._member_lock = threading.Lock()
+        self._member_lock = _lockcheck.named_lock("kv.srv.member")
         self._last_rpc = {}  # rank -> (cmd name, seq) of its last mutation
         self._srv_sock = None
         self._conns = []
@@ -912,9 +914,12 @@ class DistServer:
                     (key,) = f
                     st = self._key(key)
                     with st.lock:
-                        # server wire send needs host bytes
-                        val = st.value if isinstance(st.value, np.ndarray) \
-                            else st.value.asnumpy()  # mxlint: allow-host-sync
+                        val = st.value
+                    # server wire send needs host bytes; the pull runs
+                    # AFTER the lock drops — a device sync under st.lock
+                    # would stall every pusher to this key (CD1103)
+                    if not isinstance(val, np.ndarray):
+                        val = val.asnumpy()  # mxlint: allow-host-sync
                     self._prof_span("KVStoreServer::pull", t0,
                                     rank=rank, span=span, command="pull")
                     _send(sock, CMD_OK, val)
@@ -922,10 +927,14 @@ class DistServer:
                     key, row_ids = f
                     st = self._key(key)
                     with st.lock:
-                        # server wire send needs host bytes
-                        base = st.value if isinstance(st.value, np.ndarray) \
-                            else st.value.asnumpy()  # mxlint: allow-host-sync
-                        rows = base[np.asarray(row_ids)]
+                        base = st.value
+                    # host pull + row gather outside the lock (CD1103):
+                    # we gather from a consistent snapshot reference; a
+                    # racing round replaces st.value wholesale, it never
+                    # mutates the array we captured
+                    if not isinstance(base, np.ndarray):
+                        base = base.asnumpy()  # mxlint: allow-host-sync
+                    rows = base[np.asarray(row_ids)]
                     _send(sock, CMD_OK, rows)
                 elif cmd == CMD_BARRIER:
                     try:
@@ -1217,14 +1226,21 @@ class DistKVStore(KVStoreBase):
         self._root = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
         self._root_port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
         self._socks = {}
-        self._lock = threading.Lock()
+        # _lock guards the socket/conn-lock MAPS only (short holds).
+        # Per-server _conn_locks serialize the wire exchange on one
+        # connection (send+recv pair — replies are matched by ordering)
+        # and the connect/retry path; a shard that is slow to accept or
+        # mid-reconnect must not stall RPCs to every OTHER shard behind
+        # a single client-wide lock (CD1103).
+        self._lock = _lockcheck.named_lock("kv.cli.socks")
+        self._conn_locks = {}
         self._gc = None
         self._optimizer = None
         # per-worker monotonic sequence number stamped on every mutating
         # RPC — the server dedups replays on it, making retries safe
         # (wire protocol v2, docs/fault_tolerance.md)
         self._seq = 0
-        self._seq_lock = threading.Lock()
+        self._seq_lock = _lockcheck.named_lock("kv.cli.seq")
         # elastic membership (wire v3): last-known membership epoch PER
         # SERVER SHARD (each DistServer versions its own roster) plus an
         # optional training-step hint stamped into mutating meta so a
@@ -1256,39 +1272,58 @@ class DistKVStore(KVStoreBase):
             return int(k) % self._num_servers
         return zlib.crc32(k.encode()) % self._num_servers
 
+    def _conn_lock(self, server_id):
+        """Per-server connection lock (created on first use).  The map
+        lock is held only for the lookup, never across I/O."""
+        with self._lock:
+            lk = self._conn_locks.get(server_id)
+            if lk is None:
+                lk = self._conn_locks[server_id] = \
+                    _lockcheck.named_lock("kv.cli.conn")
+            return lk
+
     def _sock(self, server_id):
+        """Cached connection to one server shard.
+
+        Caller holds that shard's ``_conn_lock`` — it serializes both
+        the connect below and the send/recv exchange that follows, so
+        the map lock covers only the dict lookups and the connect-retry
+        sleeps stall nothing but RPCs to this (unreachable) shard.
+        """
         with self._lock:
             s = self._socks.get(server_id)
-            if s is None:
-                _inject("connect", server=server_id)
-                addr = (self._root,
-                        _server_port(self._root_port, server_id))
-                # retry refused connects: at job start the server process
-                # may still be importing/binding (ps-lite retries the van
-                # connect the same way).  The connect phase gets its OWN
-                # short deadline — the wire-read timeout is sized for
-                # sync-round reads waiting on slow compiles (30min); a dead
-                # or misaddressed server must fail in seconds, not that
-                deadline = _time.monotonic() + float(os.environ.get(
-                    "MXNET_KVSTORE_CONNECT_TIMEOUT",
-                    min(_wire_timeout() or 60, 60)))
-                while True:
-                    try:
-                        s = socket.create_connection(addr, timeout=60)
-                        break
-                    except (ConnectionRefusedError, socket.timeout,
-                            OSError):
-                        if _time.monotonic() >= deadline:
-                            raise
-                        _time.sleep(0.2)
-                _tune_socket(s)
-                # every later read inherits the wire deadline: a wedged
-                # server raises a diagnosable MXNetError instead of
-                # blocking this worker forever
-                s.settimeout(_wire_timeout())
-                _client_handshake(s)
-                self._socks[server_id] = s
+        if s is not None:
             return s
+        _inject("connect", server=server_id)
+        addr = (self._root,
+                _server_port(self._root_port, server_id))
+        # retry refused connects: at job start the server process
+        # may still be importing/binding (ps-lite retries the van
+        # connect the same way).  The connect phase gets its OWN
+        # short deadline — the wire-read timeout is sized for
+        # sync-round reads waiting on slow compiles (30min); a dead
+        # or misaddressed server must fail in seconds, not that
+        deadline = _time.monotonic() + float(os.environ.get(
+            "MXNET_KVSTORE_CONNECT_TIMEOUT",
+            min(_wire_timeout() or 60, 60)))
+        while True:
+            try:
+                s = socket.create_connection(addr, timeout=60)
+                break
+            except (ConnectionRefusedError, socket.timeout,
+                    OSError):
+                if _time.monotonic() >= deadline:
+                    raise
+                _time.sleep(0.2)
+        _tune_socket(s)
+        # every later read inherits the wire deadline: a wedged
+        # server raises a diagnosable MXNetError instead of
+        # blocking this worker forever
+        s.settimeout(_wire_timeout())
+        _client_handshake(s)
+        with self._lock:
+            self._socks[server_id] = s
+        return s
 
     def _evict(self, server_id, sock=None):
         """Drop a (dead) cached socket so the next RPC reconnects.  A
@@ -1350,11 +1385,16 @@ class DistKVStore(KVStoreBase):
         while attempt < attempts:
             s = None
             try:
-                s = self._sock(server_id)
-                _flight.record("kv.send", cmd=cmd_name, server=server_id,
-                               attempt=attempt,
-                               **({"span": span_id} if span_id else {}))
-                with self._lock:
+                # per-SERVER serialization: the exchange (and any
+                # reconnect inside _sock) holds only this shard's conn
+                # lock, so a slow or dead shard can't head-of-line block
+                # RPCs bound for the others
+                with self._conn_lock(server_id):
+                    s = self._sock(server_id)
+                    _flight.record("kv.send", cmd=cmd_name,
+                                   server=server_id, attempt=attempt,
+                                   **({"span": span_id} if span_id
+                                      else {}))
                     _send(s, cmd, *fields)
                     rcmd, rfields = _recv(s)
                 _flight.record("kv.recv", cmd=cmd_name, server=server_id,

@@ -9,6 +9,14 @@ is popping page ids off a free list, finishing one is pushing them back.
 Page 0 is reserved as the **null page**: inactive decode slots point
 their block-table row at it and scribble there harmlessly.
 
+The arena is **loop-thread-only and lock-free by contract** (CD11xx):
+every mutator — allocate, append, finish, defrag — runs on the serve
+loop thread (or the caller's thread before ``start()``), never
+concurrently.  Cross-thread visibility goes through the scheduler,
+whose lock (``serve.sched`` under ``MXNET_LOCKCHECK=1``) is dropped
+before any arena call.  Do not add locks here; add state to the
+scheduler if another thread ever needs it.
+
 Reuse safety rides on the engine's var-dependency tracking.  The decode
 /prefill executables *donate* the KV buffers on accelerator backends
 (XLA deletes them; see model._donate_kv for the CPU exception), and a

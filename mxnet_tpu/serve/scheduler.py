@@ -57,6 +57,7 @@ from ..base import MXNetError
 from ..telemetry import flight as _flight
 from ..telemetry import metrics as _metrics
 from ..testing import faults as _faults
+from ..testing import lockcheck as _lockcheck
 from . import spec as _spec
 
 # TTFT/TPOT bucket ladders (seconds): decode steps sit well under the
@@ -281,10 +282,10 @@ class Scheduler:
         # position even when the runtime spec_k is lower, so pages must
         # cover that many extra slots beyond prompt + budget
         self._spec_headroom = self.geometry.spec_k if spec_k > 0 else 0
-        self._lock = threading.Lock()
+        self._lock = _lockcheck.named_lock("serve.sched")
         self._queue = collections.deque()
         self._slots = [None] * self.geometry.max_batch
-        self._work = threading.Condition(self._lock)
+        self._work = _lockcheck.named_condition("serve.sched", self._lock)
         self._draining = False      # drain(): no new admissions, ever
         self._hold_admission = False  # hot-swap: queue keeps, slots wait
         self._refuse_error = None   # loop gave up: fail submits fast
@@ -312,7 +313,7 @@ class Scheduler:
         # per-request traces (GET /v1/trace/<id>): bounded FIFO so a
         # long-lived server can't grow without limit.  Own lock — trace
         # events are appended while self._lock is held (non-reentrant).
-        self._trace_lock = threading.Lock()
+        self._trace_lock = _lockcheck.named_lock("serve.trace")
         self._traces = collections.OrderedDict()
         self._trace_cap = _env_int("MXNET_SERVE_TRACE_CAP", 512)
 

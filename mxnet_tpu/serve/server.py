@@ -32,6 +32,7 @@ from ..telemetry import flight as _flight
 from ..telemetry import memdump as _memdump
 from ..telemetry import metrics as _metrics
 from ..testing import faults as _faults
+from ..testing import lockcheck as _lockcheck
 from .arena import PagedKVArena
 from .scheduler import (Request, Scheduler, ServeCancelled,
                         ServeDeadlineExceeded, ServeDraining,
@@ -150,7 +151,7 @@ class LlamaServer:
         self._loop_restarts = 0
         self._loop_steps = 0
         self._draining = False
-        self._swap_lock = threading.Lock()
+        self._swap_lock = _lockcheck.named_lock("serve.swap")
         self._pending_swap = None     # (geometry, runner, arena, path, evt)
         self._max_restarts = _env_int("MXNET_SERVE_LOOP_MAX_RESTARTS", 16)
 
@@ -330,8 +331,9 @@ class LlamaServer:
     def _maybe_swap(self):
         """Loop-side half of ``reload()``: runs at every step boundary,
         holds admission while old lanes drain, then swaps atomically."""
-        if self._pending_swap is None:
-            return
+        with self._swap_lock:
+            if self._pending_swap is None:
+                return
         self.scheduler.hold_admission(True)
         if self.scheduler.active_slots():
             return  # old lanes still decoding on the old runner

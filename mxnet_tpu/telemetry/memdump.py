@@ -39,6 +39,9 @@ import jax
 from ..base import atomic_path, env_flag
 from . import flight
 from .metrics import gauge, register_collector
+# safe here (and only here in this package): telemetry/__init__ imports
+# metrics and flight BEFORE memdump, and lockcheck needs exactly those
+from ..testing import lockcheck as _lockcheck
 
 __all__ = [
     "origin", "current_origin", "tag", "refresh", "device_bytes",
@@ -52,7 +55,7 @@ _ENABLED = env_flag("MXNET_MEMDUMP", True)
 
 _origin_var = contextvars.ContextVar("mxnet_memdump_origin", default="temp")
 
-_lock = threading.Lock()
+_lock = _lockcheck.named_lock("telemetry.memdump")
 _tags = {}          # id(jax.Array) -> dict(ref, origin, nbytes, seq, ...)
 _seen_origins = set(ORIGINS)
 _peak = 0

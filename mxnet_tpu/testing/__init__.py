@@ -7,13 +7,21 @@ their hot paths, so this package must never pull in jax/numpy.
 * ``faults`` — seeded, replayable fault injection for the distributed
   tier and the engine (``FaultPlan``, ``MXNET_FAULT_PLAN``).  See
   ``docs/fault_tolerance.md``.
+* ``lockcheck`` — the runtime lock sanitizer (``MXNET_LOCKCHECK=1``):
+  instrumented proxies for the framework's named locks maintaining
+  per-thread held-sets and the global acquisition-order graph, raising
+  ``LockCycleError`` on deadlock *potential*.  The runtime half of the
+  CD11xx concurrency-discipline pass (``docs/static_analysis.md``).
 """
 from __future__ import annotations
 
 from .faults import (FaultInjected, FaultPlan, LoopKilled, current,
                      install, maybe_inject, set_role, uninstall)
+from .lockcheck import LockCycleError
+from . import lockcheck
 
 __all__ = [
     "FaultInjected", "FaultPlan", "LoopKilled", "current", "install",
     "maybe_inject", "set_role", "uninstall",
+    "LockCycleError", "lockcheck",
 ]

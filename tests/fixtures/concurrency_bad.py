@@ -1,0 +1,96 @@
+"""Corpus for mxlint pass 11 (CD11xx concurrency discipline).
+
+Every ``# expect: RULE`` marker line must produce exactly that finding
+and nothing else may fire anywhere in the file (tests/
+test_concurrency_check.py asserts exact equality across ALL passes).
+The clean methods are as load-bearing as the flagged ones: they pin the
+pass's false-positive behaviour — timed condition-waits, the canonical
+acquire/try/finally shape, callbacks invoked after release, and
+unlocked access from methods no thread reaches.
+"""
+# flake8: noqa
+import threading
+import time
+
+
+class BadScheduler:
+    """One lock-owning class exercising all five CD rules."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._aux_lock = threading.Lock()
+        # Condition over an existing lock: holding self._work IS
+        # holding self._lock (the pass tracks the alias)
+        self._work = threading.Condition(self._lock)
+        self._queue = []
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def submit(self, item):
+        with self._lock:
+            self._queue.append(item)
+            self._work.notify()
+
+    def _loop(self):
+        while True:
+            with self._work:
+                item = self._queue.pop()
+            self._handle_one(item)
+
+    def _handle_one(self, item):
+        # reachable from the Thread target via _loop; _queue is
+        # predominantly lock-guarded elsewhere
+        depth = len(self._queue)  # expect: CD1101
+        with self._lock:
+            self._queue.append(depth)
+
+    def reverse_order(self):
+        with self._aux_lock:
+            with self._lock:  # expect: CD1102
+                pass
+
+    def forward_order(self):
+        # the other half of the inversion: opposite nesting order
+        with self._lock:
+            with self._aux_lock:
+                pass
+
+    def blocking_under_lock(self, sock, fut):
+        with self._lock:
+            data = sock.recv(4)  # expect: CD1103
+            out = fut.result()  # expect: CD1103
+            time.sleep(0.5)  # expect: CD1103
+            self._work.wait()  # expect: CD1103
+        return data, out
+
+    def timed_wait_is_fine(self):
+        # wait WITH a timeout releases the lock and comes back: the one
+        # legitimate block-under-lock (deadline discipline is RB701's)
+        with self._lock:
+            self._work.wait(timeout=1.0)
+
+    def leaky_manual(self):
+        self._lock.acquire()  # expect: CD1104
+        self._queue.append(1)
+        self._lock.release()
+
+    def careful_manual(self):
+        self._lock.acquire()
+        try:
+            self._queue.append(1)
+        finally:
+            self._lock.release()
+
+    def callback_under_lock(self, fut):
+        with self._lock:
+            fut.set_result(self._queue[-1])  # expect: CD1105
+
+    def callback_after_release(self, fut):
+        with self._lock:
+            out = self._queue[-1]
+        fut.set_result(out)
+
+    def suppressed_leak(self):
+        # inline pragma (4-digit rule id) silences a deliberate leak
+        self._lock.acquire()  # mxlint: disable=CD1104
+        self._queue.append(2)
+        self._lock.release()

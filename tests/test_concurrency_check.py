@@ -1,0 +1,238 @@
+"""CD11xx static pass: fixture corpus, per-rule behaviour, CLI selection
+(docs/static_analysis.md Pass 11).  The runtime half is tests/
+test_lockcheck.py."""
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from mxnet_tpu.analysis import lint_paths, lint_source
+from mxnet_tpu.analysis.suppressions import SuppressionFile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "concurrency_bad.py")
+
+
+# ---------------------------------------------------------------------------
+# fixture corpus: every `# expect: RULE` marker produces exactly that
+# finding on that line, and nothing else fires anywhere in the file
+# ---------------------------------------------------------------------------
+def _markers():
+    out = []
+    with open(FIXTURE) as f:
+        for lineno, line in enumerate(f, 1):
+            m = re.search(r"#\s*expect:\s*([A-Z]+\d+)", line)
+            if m:
+                out.append((lineno, m.group(1)))
+    return sorted(out)
+
+
+def test_fixture_findings_match_markers_exactly():
+    expected = _markers()
+    assert len(expected) >= 8, "fixture corpus lost its markers"
+    findings = lint_paths([FIXTURE], relative_to=REPO,
+                          suppressions=SuppressionFile())
+    got = sorted((f.line, f.rule) for f in findings)
+    assert got == expected, "\n".join(str(f) for f in findings)
+
+
+@pytest.mark.parametrize("rule", ["CD1101", "CD1102", "CD1103", "CD1104",
+                                  "CD1105"])
+def test_fixture_covers_rule(rule):
+    assert rule in {r for _, r in _markers()}
+
+
+# ---------------------------------------------------------------------------
+# per-rule behaviour on minimal sources
+# ---------------------------------------------------------------------------
+_CLASS_HEAD = (
+    "import threading\n"
+    "class C:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._queue = []\n"
+)
+
+
+def test_cd1101_needs_thread_reachability():
+    # same unguarded access, but no thread entry point -> silent
+    src = (_CLASS_HEAD +
+           "    def a(self):\n"
+           "        with self._lock:\n"
+           "            self._queue.append(1)\n"
+           "    def b(self):\n"
+           "        with self._lock:\n"
+           "            self._queue.pop()\n"
+           "    def c(self):\n"
+           "        return len(self._queue)\n")
+    assert lint_source(src) == []
+    # a Thread(target=self.c) makes c() a thread path -> CD1101
+    threaded = src + ("    def start(self):\n"
+                      "        threading.Thread(target=self.c).start()\n")
+    assert [f.rule for f in lint_source(threaded)] == ["CD1101"]
+
+
+def test_cd1102_reports_one_finding_per_cycle_with_both_paths():
+    src = (_CLASS_HEAD.replace("self._queue = []",
+                               "self._b = threading.Lock()") +
+           "    def fwd(self):\n"
+           "        with self._lock:\n"
+           "            with self._b:\n"
+           "                pass\n"
+           "    def rev(self):\n"
+           "        with self._b:\n"
+           "            with self._lock:\n"
+           "                pass\n")
+    findings = lint_source(src)
+    assert [f.rule for f in findings] == ["CD1102"]
+    # both conflicting orders are named in the message
+    assert "_lock -> self._b" in findings[0].message
+    assert "_b -> self._lock" in findings[0].message
+
+
+def test_cd1102_sees_inversion_through_call_edges():
+    src = (_CLASS_HEAD.replace("self._queue = []",
+                               "self._b = threading.Lock()") +
+           "    def fwd(self):\n"
+           "        with self._lock:\n"
+           "            self._inner()\n"
+           "    def _inner(self):\n"
+           "        with self._b:\n"
+           "            pass\n"
+           "    def rev(self):\n"
+           "        with self._b:\n"
+           "            with self._lock:\n"
+           "                pass\n")
+    assert [f.rule for f in lint_source(src)] == ["CD1102"]
+
+
+def test_cd1103_untimed_wait_flagged_timed_wait_clean():
+    head = _CLASS_HEAD.replace(
+        "self._queue = []",
+        "self._cv = threading.Condition(self._lock)")
+    bad = head + ("    def f(self):\n"
+                  "        with self._lock:\n"
+                  "            self._cv.wait()\n")
+    ok = head + ("    def f(self):\n"
+                 "        with self._lock:\n"
+                 "            self._cv.wait(timeout=5)\n")
+    assert [f.rule for f in lint_source(bad)] == ["CD1103"]
+    assert lint_source(ok) == []
+
+
+def test_cd1103_quiet_outside_lock():
+    src = (_CLASS_HEAD +
+           "    def f(self, sock):\n"
+           "        data = sock.recv(4)\n"
+           "        with self._lock:\n"
+           "            self._queue.append(data)\n")
+    assert lint_source(src) == []
+
+
+def test_cd1104_try_finally_shape_is_clean():
+    bad = (_CLASS_HEAD +
+           "    def f(self):\n"
+           "        self._lock.acquire()\n"
+           "        self._queue.append(1)\n"
+           "        self._lock.release()\n")
+    ok = (_CLASS_HEAD +
+          "    def f(self):\n"
+          "        self._lock.acquire()\n"
+          "        try:\n"
+          "            self._queue.append(1)\n"
+          "        finally:\n"
+          "            self._lock.release()\n")
+    assert [f.rule for f in lint_source(bad)] == ["CD1104"]
+    assert lint_source(ok) == []
+
+
+def test_cd1105_callback_after_release_is_clean():
+    bad = (_CLASS_HEAD +
+           "    def f(self, fut):\n"
+           "        with self._lock:\n"
+           "            fut.set_result(1)\n")
+    ok = (_CLASS_HEAD +
+          "    def f(self, fut):\n"
+          "        with self._lock:\n"
+          "            out = 1\n"
+          "        fut.set_result(out)\n")
+    assert [f.rule for f in lint_source(bad)] == ["CD1105"]
+    assert lint_source(ok) == []
+
+
+def test_named_lock_ctors_recognized():
+    # the framework's own lockcheck spellings count as lock attributes
+    src = ("from mxnet_tpu.testing import lockcheck\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = lockcheck.named_lock('x')\n"
+           "    def f(self, fut):\n"
+           "        with self._lock:\n"
+           "            fut.set_result(1)\n")
+    assert [f.rule for f in lint_source(src)] == ["CD1105"]
+
+
+def test_classes_without_locks_are_skipped():
+    src = ("class C:\n"
+           "    def __init__(self):\n"
+           "        self._queue = []\n"
+           "    def f(self, fut, sock):\n"
+           "        fut.set_result(sock.recv(4))\n")
+    assert lint_source(src) == []
+
+
+def test_inline_disable_four_digit_rule_id():
+    # the suppression regex must match 4-digit ids (CD11xx, SP10xx) —
+    # a 3-digit-only pattern silently truncates and never suppresses
+    src = (_CLASS_HEAD +
+           "    def f(self):\n"
+           "        self._lock.acquire()  # mxlint: disable=CD1104\n"
+           "        self._queue.append(1)\n"
+           "        self._lock.release()\n")
+    assert lint_source(src) == []
+
+
+# ---------------------------------------------------------------------------
+# severity + CLI selection
+# ---------------------------------------------------------------------------
+def test_cd_severities():
+    from mxnet_tpu.analysis import SEVERITY
+
+    assert SEVERITY["CD1101"] == "warn"
+    assert SEVERITY["CD1103"] == "warn"
+    assert SEVERITY["CD1105"] == "warn"
+    # provable inversion/leak stay errors (absent = error)
+    assert "CD1102" not in SEVERITY
+    assert "CD1104" not in SEVERITY
+
+
+def _run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mxlint.py")]
+        + list(argv),
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+
+def test_cli_pass_cd_isolates_family():
+    r = _run_cli(FIXTURE, "--pass", "CD", "--no-registry-check")
+    assert r.returncode == 1, r.stdout + r.stderr
+    rules = set(re.findall(r" ([A-Z]+\d+) \[", r.stdout))
+    assert rules == {"CD1101", "CD1102", "CD1103", "CD1104", "CD1105"}, \
+        r.stdout
+
+
+def test_cli_list_rules_includes_cd():
+    r = _run_cli("--list-rules")
+    assert r.returncode == 0, r.stderr
+    for rule in ("CD1101", "CD1102", "CD1103", "CD1104", "CD1105"):
+        assert rule in r.stdout
+
+
+def test_repo_source_is_cd_clean():
+    """Dogfood gate: the framework's own threaded tiers stay CD-clean
+    (suppressions allowed only via the justified repo file/pragmas)."""
+    r = _run_cli("mxnet_tpu", "--pass", "CD", "--no-registry-check")
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -1,0 +1,280 @@
+"""Runtime lock sanitizer (MXNET_LOCKCHECK=1, testing/lockcheck.py):
+cycle detection, held-set accuracy across threads, proxy transparency
+under with/acquire-release/Condition, contention + flight telemetry.
+The static half is tests/test_concurrency_check.py."""
+import threading
+import time
+
+import pytest
+
+from mxnet_tpu.telemetry import flight
+from mxnet_tpu.testing import LockCycleError, lockcheck
+
+
+@pytest.fixture(autouse=True)
+def _sanitizer_on():
+    was = lockcheck.enabled()
+    lockcheck.install()
+    lockcheck.reset()
+    flight.reset()
+    yield
+    lockcheck.reset()
+    if not was:
+        lockcheck.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# proxy transparency
+# ---------------------------------------------------------------------------
+def test_disabled_returns_bare_lock():
+    lockcheck.uninstall()
+    try:
+        lk = lockcheck.named_lock("bare")
+        assert isinstance(lk, type(threading.Lock()))
+        rl = lockcheck.named_rlock("bare")
+        assert isinstance(rl, type(threading.RLock()))
+    finally:
+        lockcheck.install()
+
+
+def test_with_and_acquire_release_and_locked():
+    lk = lockcheck.named_lock("t")
+    assert not lk.locked()
+    with lk:
+        assert lk.locked()
+        assert lockcheck.held() == ["t"]
+    assert not lk.locked()
+    assert lockcheck.held() == []
+    assert lk.acquire()
+    try:
+        assert lk.locked()
+    finally:
+        lk.release()
+    assert not lk.locked()
+
+
+def test_nonblocking_and_timeout_acquire():
+    lk = lockcheck.named_lock("nb")
+    lk.acquire()
+    try:
+        got = []
+        t = threading.Thread(target=lambda: got.append(
+            lk.acquire(blocking=False)))
+        t.start(); t.join()
+        assert got == [False]
+        t0 = time.monotonic()
+        got2 = []
+        t = threading.Thread(target=lambda: got2.append(
+            lk.acquire(timeout=0.1)))
+        t.start(); t.join()
+        assert got2 == [False]
+        assert time.monotonic() - t0 >= 0.05
+    finally:
+        lk.release()
+
+
+def test_rlock_reentrancy_counts_once_in_held_set():
+    rl = lockcheck.named_rlock("re")
+    with rl:
+        with rl:
+            assert lockcheck.held() == ["re"]
+            assert rl.locked()
+        assert rl.locked()  # outer hold survives inner release
+        assert lockcheck.held() == ["re"]
+    assert not rl.locked()
+    assert lockcheck.held() == []
+
+
+def test_condition_over_proxy_wait_notify():
+    cv = lockcheck.named_condition("cv")
+    woke = []
+
+    def waiter():
+        with cv:
+            woke.append(cv.wait(timeout=5))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        cv.notify()
+    t.join()
+    assert woke == [True]
+    # wait() released and re-acquired cleanly: nothing left held
+    assert lockcheck.held() == []
+
+
+def test_condition_sharing_a_proxy_lock():
+    lk = lockcheck.named_lock("shared")
+    cv = lockcheck.named_condition("shared", lk)
+    with cv:
+        assert lk.locked()
+        assert lockcheck.held() == ["shared"]
+    assert not lk.locked()
+
+
+# ---------------------------------------------------------------------------
+# held-set accuracy across threads
+# ---------------------------------------------------------------------------
+def test_held_sets_are_per_thread():
+    a = lockcheck.named_lock("a")
+    b = lockcheck.named_lock("b")
+    seen = {}
+    ready = threading.Event()
+    done = threading.Event()
+
+    def other():
+        with b:
+            seen["other"] = lockcheck.held()
+            ready.set()
+            done.wait(timeout=5)
+
+    t = threading.Thread(target=other)
+    with a:
+        t.start()
+        assert ready.wait(timeout=5)
+        seen["main"] = lockcheck.held()
+        done.set()
+    t.join()
+    assert seen["main"] == ["a"]
+    assert seen["other"] == ["b"]
+
+
+def test_held_reports_outermost_first():
+    a = lockcheck.named_lock("outer")
+    b = lockcheck.named_lock("inner")
+    with a:
+        with b:
+            assert lockcheck.held() == ["outer", "inner"]
+
+
+# ---------------------------------------------------------------------------
+# acquisition-order graph + cycle detection
+# ---------------------------------------------------------------------------
+def test_order_edges_recorded():
+    a = lockcheck.named_lock("src")
+    b = lockcheck.named_lock("dst")
+    with a:
+        with b:
+            pass
+    assert "dst" in lockcheck.order_edges().get("src", set())
+
+
+def test_cycle_raises_and_records_flight_event():
+    a = lockcheck.named_lock("x")
+    b = lockcheck.named_lock("y")
+    with a:
+        with b:
+            pass
+    err = []
+
+    def rev():
+        try:
+            with b:
+                with a:
+                    pass
+        except LockCycleError as e:
+            err.append(e)
+
+    t = threading.Thread(target=rev)
+    t.start(); t.join()
+    assert len(err) == 1
+    assert "x" in str(err[0]) and "y" in str(err[0])
+    events = flight.events(kind="lock.cycle")
+    assert len(events) == 1
+    assert events[0]["name"] == "x"
+    # the raising thread holds nothing extra afterwards
+    assert lockcheck.held() == []
+
+
+def test_three_lock_cycle_detected():
+    a = lockcheck.named_lock("l1")
+    b = lockcheck.named_lock("l2")
+    c = lockcheck.named_lock("l3")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with pytest.raises(LockCycleError):
+        with c:
+            with a:
+                pass
+
+
+def test_consistent_order_never_raises():
+    a = lockcheck.named_lock("o1")
+    b = lockcheck.named_lock("o2")
+    errs = []
+
+    def worker():
+        try:
+            for _ in range(50):
+                with a:
+                    with b:
+                        pass
+        except LockCycleError as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs
+
+
+def test_same_name_nesting_out_of_scope():
+    # instances sharing a name share one graph node; nesting them is
+    # documented as out of scope, not a false cycle
+    a1 = lockcheck.named_lock("kv.key")
+    a2 = lockcheck.named_lock("kv.key")
+    with a1:
+        with a2:
+            assert lockcheck.held() == ["kv.key", "kv.key"]
+
+
+def test_reset_clears_graph():
+    a = lockcheck.named_lock("r1")
+    b = lockcheck.named_lock("r2")
+    with a:
+        with b:
+            pass
+    lockcheck.reset()
+    assert lockcheck.order_edges() == {}
+    # reverse order after reset: first-seen again, no cycle
+    with b:
+        with a:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# contention + hold-time telemetry
+# ---------------------------------------------------------------------------
+def test_contention_counter_and_blocked_event():
+    from mxnet_tpu.telemetry import metrics
+
+    lk = lockcheck.named_lock("busy")
+    lk.acquire()
+    got = []
+    t = threading.Thread(target=lambda: got.append(lk.acquire(timeout=5)))
+    t.start()
+    time.sleep(0.05)
+    lk.release()
+    t.join()
+    assert got == [True]
+    lk.release()
+    blocked = flight.events(kind="lock.blocked")
+    assert any(e["name"] == "busy" for e in blocked)
+    snap = metrics.snapshot()
+    assert "mxnet_lock_contention_total" in snap
+    assert "mxnet_lock_hold_seconds" in snap
+
+
+def test_uncontended_acquire_records_no_contention():
+    flight.reset()
+    lk = lockcheck.named_lock("quiet")
+    with lk:
+        pass
+    assert flight.events(kind="lock.blocked") == []
