@@ -761,14 +761,18 @@ def _serve_probe(path):
     A third pass replays the continuous workload with the runtime lock
     sanitizer installed (MXNET_LOCKCHECK, lint pass 11) so its overhead
     is a tracked number (acceptance: <= 3% off the unproxied rate, like
-    the telemetry on/off gate; docs/static_analysis.md).
+    the telemetry on/off gate; docs/static_analysis.md), and a fourth
+    does the same for the resource-leak sanitizer (MXNET_RESCHECK, lint
+    pass 12) under the same <= 3% gate — every request acquires and
+    releases one future token plus arena page tokens, so this is the
+    sanitizer's worst-case path.
     Also reports the process's live-compile count:
     nonzero means the AOT warm start regressed and the throughput
     numbers are polluted by jit time.
     """
     from mxnet_tpu import serve
     from mxnet_tpu.telemetry import metrics as telemetry_metrics
-    from mxnet_tpu.testing import lockcheck
+    from mxnet_tpu.testing import lockcheck, rescheck
 
     srv = serve.LlamaServer(path).start()
     rates = []
@@ -810,6 +814,26 @@ def _serve_probe(path):
         lockcheck.uninstall()
         lockcheck.reset()
 
+    # rescheck overhead: same fresh-server discipline — install() only
+    # tracks handles acquired after it runs, and stop() asserts the
+    # tracked scopes quiescent, so a leak anywhere in the replayed
+    # workload fails the bench rather than skewing it.
+    rescheck.install()
+    try:
+        rc_srv = serve.LlamaServer(path).start()
+        rc_rates = []
+        for _ in range(_SERVE_REPLAYS):
+            rc_wl = serve.poisson_workload(_SERVE_N_REQUESTS,
+                                           **_SERVE_WORKLOAD)
+            rc_reqs, rc_wall = serve.drive_workload(rc_srv, rc_wl,
+                                                    timeout=600)
+            rc_done = [r for r in rc_reqs if r.error is None]
+            rc_rates.append(sum(len(r.tokens) for r in rc_done) / rc_wall)
+        rc_srv.stop()
+    finally:
+        rescheck.uninstall()
+        rescheck.reset()
+
     snap = telemetry_metrics.snapshot()
     compiles = sum(s["value"] for s in snap.get(
         "mxnet_compiles_total", {}).get("series", []))
@@ -817,6 +841,7 @@ def _serve_probe(path):
         "continuous_tok_s": round(_median(rates), 2),
         "static_tok_s": round(_median(static_rates), 2),
         "lockcheck_tok_s": round(_median(lc_rates), 2),
+        "rescheck_tok_s": round(_median(rc_rates), 2),
         "completed": len(done),
         "n_requests": len(reqs),
         "ttft_p50_ms": round(sched.percentile("ttft", 0.50) * 1e3, 2),
@@ -842,9 +867,9 @@ def _run_serve(platform):
     import tempfile
 
     tmp = tempfile.mkdtemp(prefix="mxnet-serve-bench-")
-    bundle = os.path.join(tmp, "llama_small.mxaot")
-    env = dict(os.environ)
     try:
+        bundle = os.path.join(tmp, "llama_small.mxaot")
+        env = dict(os.environ)
         _probe_subprocess(["--serve-export", bundle], env,
                           "SERVE_EXPORT_OK", "serve export")
         doc = json.loads(_probe_subprocess(
@@ -856,12 +881,16 @@ def _run_serve(platform):
     cont = doc["continuous_tok_s"]
     lc_overhead = (round((1.0 - doc["lockcheck_tok_s"] / cont) * 100.0, 2)
                    if cont else 0.0)
+    rc_overhead = (round((1.0 - doc["rescheck_tok_s"] / cont) * 100.0, 2)
+                   if cont else 0.0)
     _log("serve: %.1f tok/s continuous vs %.1f static (%.2fx), "
          "ttft p50/p99 %.1f/%.1f ms, %d/%d completed, %d live compiles, "
-         "lockcheck %.1f tok/s (%.1f%% overhead)"
+         "lockcheck %.1f tok/s (%.1f%% overhead), "
+         "rescheck %.1f tok/s (%.1f%% overhead)"
          % (doc["continuous_tok_s"], static, speedup, doc["ttft_p50_ms"],
             doc["ttft_p99_ms"], doc["completed"], doc["n_requests"],
-            doc["live_compiles"], doc["lockcheck_tok_s"], lc_overhead))
+            doc["live_compiles"], doc["lockcheck_tok_s"], lc_overhead,
+            doc["rescheck_tok_s"], rc_overhead))
     return {"value": doc["continuous_tok_s"],
             "static_tok_s": static,
             "continuous_vs_static": speedup,
@@ -872,7 +901,9 @@ def _run_serve(platform):
             "n_requests": doc["n_requests"],
             "live_compiles": doc["live_compiles"],
             "lockcheck_tok_s": doc["lockcheck_tok_s"],
-            "lockcheck_overhead_pct": lc_overhead}
+            "lockcheck_overhead_pct": lc_overhead,
+            "rescheck_tok_s": doc["rescheck_tok_s"],
+            "rescheck_overhead_pct": rc_overhead}
 
 
 def _serve_spec_export(path):
@@ -990,9 +1021,9 @@ def _run_serve_spec(platform):
     import tempfile
 
     tmp = tempfile.mkdtemp(prefix="mxnet-serve-spec-bench-")
-    bundle = os.path.join(tmp, "llama_small_spec.mxaot")
-    env = dict(os.environ)
     try:
+        bundle = os.path.join(tmp, "llama_small_spec.mxaot")
+        env = dict(os.environ)
         _probe_subprocess(["--serve-spec-export", bundle], env,
                           "SERVE_SPEC_EXPORT_OK", "serve spec export")
         doc = json.loads(_probe_subprocess(

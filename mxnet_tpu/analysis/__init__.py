@@ -10,7 +10,7 @@ and framework code keeps two contracts:
 2. every device→host sync on the eager path is *intentional*, because each
    one stalls the PJRT stream the engine relies on for overlap.
 
-This package enforces both, statically and at runtime, with eleven
+This package enforces both, statically and at runtime, with twelve
 passes:
 
 * **tracing-safety lint** (``TS1xx``, ``tracing_safety``) — AST pass over
@@ -66,6 +66,16 @@ passes:
   ``MXNET_LOCKCHECK=1`` (``testing/lockcheck.py``) proxies the
   framework's named locks, builds the acquisition-order graph live and
   raises ``LockCycleError`` on deadlock *potential*.
+* **ownership & lifecycle discipline** (``RL12xx``, ``lifecycle_check``)
+  — path-sensitive acquire/release tracking over the repo's handle
+  kinds (arena pages, sockets, temp files/dirs, request futures,
+  threads): leaks on early returns/raises, uses in the unprotected
+  window between acquire and cleanup registration, futures with
+  reachable never-resolved paths, double-free / use-after-release,
+  broad swallows inside cleanup scopes.  Runtime half:
+  ``MXNET_RESCHECK=1`` (``testing/rescheck.py``) — a tracked-handle
+  registry reporting live handles at ``drain()``/``stop()``/atexit as
+  ``ResourceLeakError`` with creation stacks.
 
 CLI: ``python tools/mxlint.py mxnet_tpu/ examples/`` (the repo's own source
 is a permanent lint target; intentional syncs carry

@@ -17,7 +17,8 @@ import os
 import textwrap
 
 from . import (cache_keys, collective_check, concurrency_check, host_sync,
-               planner_check, sharding_check, tracing_safety, wait_loops)
+               lifecycle_check, planner_check, sharding_check,
+               tracing_safety, wait_loops)
 from .suppressions import SuppressionFile, inline_suppressed
 
 _SKIP_DIRS = frozenset({"__pycache__", ".git", "node_modules", "build",
@@ -27,7 +28,7 @@ _SKIP_DIRS = frozenset({"__pycache__", ".git", "node_modules", "build",
 # bands don't run through lint_source but are still valid selectors (the
 # CLI gates the registry check / symbol files on them).
 PASS_BANDS = ("TS", "HS", "RC", "EA", "GS", "CC", "RB", "CS", "SH", "SP",
-              "CD")
+              "CD", "RL")
 
 
 def normalize_only(only):
@@ -85,6 +86,8 @@ def _run_static_passes(path, tree, registry_names, findings, strict, only):
         planner_check.run(path, tree, findings, strict=strict)
     if _band_selected("CD", only):
         concurrency_check.run(path, tree, findings)
+    if _band_selected("RL", only):
+        lifecycle_check.run(path, tree, findings)
     if only is not None:
         findings[:] = [f for f in findings if rule_selected(f.rule, only)]
 

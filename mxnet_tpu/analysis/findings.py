@@ -33,6 +33,15 @@ Rule ID bands (stable, documented in ``docs/static_analysis.md``):
   acquires; the dynamic half is ``MXNET_LOCKCHECK=1`` —
   ``testing/lockcheck.py`` — which enforces the same acquisition-order
   contract on live interleavings)
+* ``RL12xx`` — ownership & lifecycle discipline (path-sensitive
+  acquire/release tracking over the repo's handle kinds: arena page
+  lists, sockets, temp files/dirs, request futures, threads — leaks
+  on early exits, unprotected raise windows between acquire and
+  cleanup registration, hung-future paths, double-free /
+  use-after-release, broad swallows inside cleanup scopes; the
+  dynamic half is ``MXNET_RESCHECK=1`` — ``testing/rescheck.py`` —
+  a tracked-handle registry reporting live handles at drain/stop/
+  atexit with creation stacks)
 """
 from __future__ import annotations
 
@@ -192,6 +201,33 @@ RULES = {
                "hook/callback invoked while holding a lock — user code "
                "runs inside the critical section and can re-enter it "
                "(deadlock) or stretch the hold time unboundedly"),
+    "RL1201": ("acquire-without-release", True,
+               "a handle (arena pages, socket, temp file/dir, thread) "
+               "is acquired but a reachable early return/raise exits "
+               "the function with it neither released nor handed off — "
+               "the resource leaks on that path"),
+    "RL1202": ("unprotected-acquire-window", True,
+               "statements that can raise run between acquiring an OS "
+               "resource (socket, temp file/dir) and registering its "
+               "cleanup (try/finally or an except that closes and "
+               "re-raises) — an exception in the window leaks the "
+               "handle; move the try up to the acquire"),
+    "RL1203": ("future-neither-resolved-nor-cancelled", True,
+               "a Request/Future is created but some reachable path "
+               "exits without set_result/set_exception/cancel and "
+               "without handing it off — a waiter on that path hangs "
+               "forever"),
+    "RL1204": ("double-free-or-use-after-release", True,
+               "the same handle is released twice, or used after its "
+               "release, along one path — the second owner (page "
+               "reuse, fd recycling) sees the corruption, far from "
+               "this line"),
+    "RL1205": ("swallow-in-cleanup", True,
+               "a bare/broad `except: pass` inside a cleanup scope (a "
+               "finally block, a release-calling try, or a close/stop/"
+               "drain-shaped method) — a failed release looks exactly "
+               "like a successful one; catch the narrow OSError or "
+               "record the failure"),
 }
 
 # rule id -> severity; rules not listed are "error".  Ordering:
@@ -216,6 +252,11 @@ SEVERITY = {
     "CD1101": "warn",
     "CD1103": "warn",
     "CD1105": "warn",
+    # RL1203 (hung-future risk) and RL1205 (swallow heuristics) infer
+    # intent from vocabularies -> warn; RL1201/RL1202/RL1204 are
+    # provable leak/corruption paths and stay errors.
+    "RL1203": "warn",
+    "RL1205": "warn",
 }
 
 _SEVERITY_RANK = {"note": 0, "warn": 1, "error": 2}

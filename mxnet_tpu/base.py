@@ -72,12 +72,18 @@ def atomic_path(fname):
     fname = _os.fspath(fname)
     d, base = _os.path.split(_os.path.abspath(fname))
     tmp = _os.path.join(d, ".%s.tmp.%d" % (base, _os.getpid()))
+    # lazy: base is imported by everything, testing.rescheck imports base
+    from .testing import rescheck as _rescheck
+    tok = _rescheck.acquire("tempfile", tmp)
     try:
-        yield tmp
-        _os.replace(tmp, fname)
-    except BaseException:
         try:
-            _os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+            yield tmp
+            _os.replace(tmp, fname)
+        except BaseException:
+            try:
+                _os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    finally:
+        _rescheck.release(tok)

@@ -87,6 +87,7 @@ from ..telemetry import flight as _flight
 from ..telemetry import metrics as _metrics
 from ..testing.faults import maybe_inject as _inject, set_role as _set_role
 from ..testing import lockcheck as _lockcheck
+from ..testing import rescheck as _rescheck
 
 
 # ---------------------------------------------------------------------------
@@ -1177,12 +1178,19 @@ class DistServer:
 
     def run(self):
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        # all interfaces: workers on OTHER hosts reach this server via
-        # DMLC_PS_ROOT_URI (loopback-only would break true multi-host)
-        srv.bind(("", self._port))
-        srv.listen(64)
-        srv.settimeout(1.0)
+        try:
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            # all interfaces: workers on OTHER hosts reach this server
+            # via DMLC_PS_ROOT_URI (loopback-only would break true
+            # multi-host)
+            srv.bind(("", self._port))
+            srv.listen(64)
+            srv.settimeout(1.0)
+        except BaseException:
+            # a bind/listen failure (port taken) must not leak the FD —
+            # shutdown() only closes the socket once _srv_sock is set
+            srv.close()
+            raise
         self._srv_sock = srv
         threads = []
         while not self._stop.is_set():
@@ -1226,6 +1234,7 @@ class DistKVStore(KVStoreBase):
         self._root = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
         self._root_port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
         self._socks = {}
+        self._sock_res = {}   # server_id -> rescheck token (under _lock)
         # _lock guards the socket/conn-lock MAPS only (short holds).
         # Per-server _conn_locks serialize the wire exchange on one
         # connection (send+recv pair — replies are matched by ordering)
@@ -1315,14 +1324,24 @@ class DistKVStore(KVStoreBase):
                 if _time.monotonic() >= deadline:
                     raise
                 _time.sleep(0.2)
-        _tune_socket(s)
-        # every later read inherits the wire deadline: a wedged
-        # server raises a diagnosable MXNetError instead of
-        # blocking this worker forever
-        s.settimeout(_wire_timeout())
-        _client_handshake(s)
+        try:
+            _tune_socket(s)
+            # every later read inherits the wire deadline: a wedged
+            # server raises a diagnosable MXNetError instead of
+            # blocking this worker forever
+            s.settimeout(_wire_timeout())
+            _client_handshake(s)
+        except BaseException:
+            # a mid-handshake failure (version skew, server dying while
+            # we connect) must not leak the connected FD — only sockets
+            # that reach _socks are ever evicted/closed by stop()
+            s.close()
+            raise
         with self._lock:
             self._socks[server_id] = s
+            self._sock_res[server_id] = _rescheck.acquire(
+                "socket", "server%d" % server_id,
+                scope="kvclient:%x" % id(self))
         return s
 
     def _evict(self, server_id, sock=None):
@@ -1333,10 +1352,12 @@ class DistKVStore(KVStoreBase):
             cached = self._socks.get(server_id)
             if cached is not None and (sock is None or cached is sock):
                 del self._socks[server_id]
+                tok = self._sock_res.pop(server_id, None)
                 try:
                     cached.close()
                 except OSError:
                     pass
+                _rescheck.release(tok)
 
     def _rpc_to(self, server_id, cmd, *fields, mutating=False):
         """One request/reply exchange with retry.
@@ -1418,6 +1439,10 @@ class DistKVStore(KVStoreBase):
                         continue
                     if meta is not None and isinstance(err, dict) \
                             and err.get("code") == "evicted":
+                        # terminal for this incarnation: a successor
+                        # join()s as a fresh client — drop our cached
+                        # connections instead of leaking them
+                        self.close()
                         raise MXNetError(
                             "kvstore: rank %d was evicted from the "
                             "membership roster (server %d, epoch %s) — "
@@ -1704,6 +1729,15 @@ class DistKVStore(KVStoreBase):
     def load_optimizer_states(self, fname):
         raise MXNetError("server-side optimizer states live on the server")
 
+    def close(self):
+        """Drop every cached connection WITHOUT the ``stop()`` goodbye
+        RPCs — teardown for an incarnation that is dead to the roster
+        (evicted, or a harness-simulated kill): the server learns via
+        timeout/eviction, never from us, and an abandoned incarnation
+        must not sit on open FDs (MXNET_RESCHECK found exactly this)."""
+        for sid in range(self._num_servers):
+            self._evict(sid)
+
     def stop(self):
         # EVERY server shard gets this worker's stop (even ones this
         # worker never pushed to): the server quits once each distinct
@@ -1718,3 +1752,7 @@ class DistKVStore(KVStoreBase):
             self._evict(sid)
         with self._lock:
             self._socks.clear()
+            stale = list(self._sock_res.values())
+            self._sock_res.clear()
+        for tok in stale:
+            _rescheck.release(tok)

@@ -37,6 +37,7 @@ from ..base import MXNetError
 from ..engine import Engine
 from ..telemetry import memdump as _memdump
 from ..telemetry import metrics as _metrics
+from ..testing import rescheck as _rescheck
 
 
 class PagedKVArena:
@@ -87,6 +88,10 @@ class PagedKVArena:
         # page 0 is the null page — never allocated
         self._free = collections.deque(range(1, geometry.num_pages))
         self._owner = {}          # page id -> owner tag (request id)
+        # MXNET_RESCHECK: one token per live allocation, keyed by its
+        # first page (plain dict — loop-thread-only like _owner)
+        self.res_scope = "arena:%x" % id(self)
+        self._res = {}
         self.liveness_flushes = 0  # times a pending segment forced a flush
 
     # -- capacity ---------------------------------------------------------
@@ -131,6 +136,9 @@ class PagedKVArena:
         pages = [self._free.popleft() for _ in range(n_pages)]
         for p in pages:
             self._owner[p] = owner
+        if _rescheck.enabled():
+            self._res[pages[0]] = _rescheck.acquire(
+                "arena", owner, scope=self.res_scope)
         self._gauges()
         return pages
 
@@ -146,6 +154,8 @@ class PagedKVArena:
                     "page %d is owned by %r, not %r — double free or "
                     "block-table corruption" % (p, have, owner))
             self._free.append(p)
+        if pages:
+            _rescheck.release(self._res.pop(pages[0], None))
         self._gauges()
 
     def owner_of(self, page):
@@ -196,6 +206,9 @@ class PagedKVArena:
                 "arena reset with %d live page(s) — fail the in-flight "
                 "requests first" % len(self._owner))
         self._free = collections.deque(range(1, self.geometry.num_pages))
+        for tok in self._res.values():
+            _rescheck.release(tok)
+        self._res.clear()
         dtype = np.dtype(self.geometry.kv_dtype)
         zeros = np.zeros(self.geometry.kv_shape(), dtype)
         self.kv_k._set_data(jax.device_put(zeros))

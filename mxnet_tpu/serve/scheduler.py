@@ -58,6 +58,7 @@ from ..telemetry import flight as _flight
 from ..telemetry import metrics as _metrics
 from ..testing import faults as _faults
 from ..testing import lockcheck as _lockcheck
+from ..testing import rescheck as _rescheck
 from . import spec as _spec
 
 # TTFT/TPOT bucket ladders (seconds): decode steps sit well under the
@@ -173,6 +174,7 @@ class Request:
         self.finish_t = None
         self.error = None
         self._done = threading.Event()
+        self._res = None          # rescheck token, set at queue entry
 
     @property
     def ttft(self):
@@ -283,6 +285,10 @@ class Scheduler:
         # cover that many extra slots beyond prompt + budget
         self._spec_headroom = self.geometry.spec_k if spec_k > 0 else 0
         self._lock = _lockcheck.named_lock("serve.sched")
+        # MXNET_RESCHECK: futures tracked from queue entry to resolution
+        # are scoped per scheduler so one server's quiescence check
+        # ignores another's live requests
+        self.res_scope = "sched:%x" % id(self)
         self._queue = collections.deque()
         self._slots = [None] * self.geometry.max_batch
         self._work = _lockcheck.named_condition("serve.sched", self._lock)
@@ -413,6 +419,8 @@ class Scheduler:
             if req.deadline_s is not None:
                 req.deadline_t = req.submit_t + req.deadline_s
             self._queue.append(req)
+            req._res = _rescheck.acquire("future", req.trace_id,
+                                         scope=self.res_scope)
             self._trace_event(req, "submit", prompt_len=len(req.prompt))
             self._gauges_locked()
             self._work.notify()
@@ -518,6 +526,8 @@ class Scheduler:
                 tr["breakdown"] = req.breakdown()
                 tr["error"] = str(err)
         req._done.set()
+        _rescheck.release(req._res)
+        req._res = None
 
     def cancel(self, trace_id):
         """Cancel by trace id (``DELETE /v1/generate/<id>``): True if
@@ -550,8 +560,9 @@ class Scheduler:
 
     def refuse(self, err):
         """Fail every subsequent submit fast with a copy of ``err`` —
-        the give-up state after repeated loop crashes, so no client ever
-        blocks on a server that cannot serve."""
+        the give-up state after repeated loop crashes (and the stopped
+        state, so a submit racing ``stop()`` cannot queue a future
+        nobody resolves).  ``None`` reopens the window."""
         with self._lock:
             self._refuse_error = err
 
@@ -993,6 +1004,8 @@ class Scheduler:
                 if error is not None:
                     tr["error"] = str(error)
         req._done.set()
+        _rescheck.release(req._res)
+        req._res = None
 
     # -- introspection ----------------------------------------------------
     def active_slots(self):

@@ -33,6 +33,7 @@ from ..telemetry import memdump as _memdump
 from ..telemetry import metrics as _metrics
 from ..testing import faults as _faults
 from ..testing import lockcheck as _lockcheck
+from ..testing import rescheck as _rescheck
 from .arena import PagedKVArena
 from .scheduler import (Request, Scheduler, ServeCancelled,
                         ServeDeadlineExceeded, ServeDraining,
@@ -145,6 +146,7 @@ class LlamaServer:
         self.bundle_path = None
         self._stop = threading.Event()
         self._thread = None
+        self._res_thread = None       # rescheck token for the loop thread
         self._http = None
         self._healthy = True          # flips (sticky) on loop death
         self._last_loop_error = None
@@ -173,9 +175,15 @@ class LlamaServer:
         if self._thread is not None:
             return self
         self._stop.clear()
+        # a previous stop() closed the submit window; reopen it (a
+        # loop-gave-up refusal is NOT a ServeShutdown and stays sticky)
+        if isinstance(self.scheduler._refuse_error, ServeShutdown):
+            self.scheduler.refuse(None)
         self._thread = threading.Thread(target=self._loop,
                                         name="mxnet-serve", daemon=True)
         self._thread.start()
+        self._res_thread = _rescheck.acquire("thread", "mxnet-serve",
+                                             scope="serve:%x" % id(self))
         return self
 
     def _loop(self):
@@ -243,10 +251,19 @@ class LlamaServer:
 
     def stop(self):
         self._stop.set()
+        # close the submit window BEFORE the straggler sweep: a submit
+        # racing the has_work() check below would otherwise queue a
+        # future nobody ever resolves (the loop is gone and fail_all
+        # already ran) — with the refusal set it fails typed instead.
+        # start() reopens the window.
+        self.scheduler.refuse(
+            ServeShutdown("server is stopped — not accepting requests"))
         self.scheduler.kick()
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        _rescheck.release(self._res_thread)
+        self._res_thread = None
         with self._swap_lock:
             self._pending_swap = None  # a waiting reload() times out
         # never abandon futures (ISSUE 15 satellite): anything still
@@ -258,6 +275,11 @@ class LlamaServer:
         if self._http is not None:
             self._http.shutdown()
             self._http = None
+        if _rescheck.enabled():
+            # the every-handle-kind generalization of
+            # arena.assert_quiescent(): no live futures, no live pages
+            _rescheck.assert_quiescent(scope=self.scheduler.res_scope)
+            _rescheck.assert_quiescent(scope=self.arena.res_scope)
 
     def drain(self, timeout=None):
         """Graceful shutdown, phase 1: stop admission (new submits get
@@ -288,6 +310,9 @@ class LlamaServer:
                 "(MXNET_SERVE_DRAIN_TIMEOUT) with the request still "
                 "queued or in flight" % timeout), status="drained")
         _flight.record("serve.drained", stragglers=stragglers)
+        if _rescheck.enabled():
+            _rescheck.assert_quiescent(scope=self.scheduler.res_scope)
+            _rescheck.assert_quiescent(scope=self.arena.res_scope)
         return stragglers
 
     # -- bundle hot-swap --------------------------------------------------
@@ -454,6 +479,10 @@ class LlamaServer:
                     self.arena.pages_needed(
                         len(req.prompt) + req.max_new_tokens), req.rid)
                 if pages is None:
+                    # earlier members of this group already hold pages —
+                    # give them back or the arena leaks them for good
+                    for prev, prev_pages, _ in slots:
+                        self.arena.free(prev_pages, owner=prev.rid)
                     raise MXNetError("arena too small for a static batch")
                 row = self.arena.block_row(pages)
                 logits = self.runner.prefill(

@@ -89,6 +89,7 @@ _WALL_T0 = time.time()
 _MONO_T0 = time.monotonic()
 
 _armed_path = os.environ.get("MXNET_FLIGHT_DUMP") or None
+_res_token = None   # rescheck handle for the armed dump registration
 _hooks_installed = False
 _crash_lock = threading.Lock()
 _in_crash = False
@@ -241,9 +242,19 @@ def armed():
 def arm(path):
     """Arm crash dumps to ``path`` and install the exception/SIGTERM
     hooks (idempotent).  ``MXNET_FLIGHT_DUMP`` does this at import."""
-    global _armed_path
+    global _armed_path, _res_token
     _armed_path = os.fspath(path)
     _install_crash_hooks()
+    if _res_token is None:
+        try:
+            # lazy (testing imports this module); exempt from quiescence
+            # — a dump hook legitimately outlives every drain, but a
+            # second registration still trips double-free detection
+            from ..testing import rescheck as _rescheck
+            _res_token = _rescheck.acquire("flight", _armed_path,
+                                           exempt=True)
+        except ImportError:  # mid-bootstrap arm during circular import
+            pass
     return _armed_path
 
 

@@ -370,8 +370,12 @@ def reset():
 def _atexit_dump():
     try:
         dump(os.environ["MXNET_TELEMETRY_DUMP"])
-    except Exception:
-        pass  # never turn interpreter exit into a traceback
+    except Exception as exc:
+        # never turn interpreter exit into a traceback — but a silently
+        # missing dump file costs hours; leave one line of evidence
+        import sys
+        print("mxnet_tpu: telemetry dump failed: %s" % (exc,),
+              file=sys.stderr)
 
 
 if os.environ.get("MXNET_TELEMETRY_DUMP"):

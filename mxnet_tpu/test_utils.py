@@ -13,7 +13,7 @@ TPU-native mechanism: instead of perturbing executor buffers in place
 (the reference mutates ``executor.arg_arrays``), both sides are pure
 functions built from the Symbol; the finite-difference loop re-runs ONE
 jitted scalar projection ``f(args) = Σ out·proj`` under
-``jax.enable_x64`` so the FD arithmetic happens in float64
+``jax.experimental.enable_x64`` so the FD arithmetic happens in float64
 even though the framework default is float32, and the analytic side is
 the very same ``jax.vjp`` path the real executors use.
 """
@@ -263,7 +263,11 @@ def _parse_aux_states(sym, aux_states, dtype=np.float64):
 
 @contextlib.contextmanager
 def _x64():
-    with jax.enable_x64(True):
+    # jax moved/removed the top-level alias; the supported spelling is
+    # jax.experimental.enable_x64 (present since 0.4.x).
+    from jax.experimental import enable_x64
+
+    with enable_x64(True):
         yield
 
 

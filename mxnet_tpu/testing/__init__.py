@@ -12,16 +12,24 @@ their hot paths, so this package must never pull in jax/numpy.
   per-thread held-sets and the global acquisition-order graph, raising
   ``LockCycleError`` on deadlock *potential*.  The runtime half of the
   CD11xx concurrency-discipline pass (``docs/static_analysis.md``).
+* ``rescheck`` — the runtime resource-leak sanitizer
+  (``MXNET_RESCHECK=1``): a tracked-handle registry over arena pages,
+  sockets, futures, threads and temp files, reporting live handles at
+  ``drain()``/``stop()``/atexit as ``ResourceLeakError`` with creation
+  stacks.  The runtime half of the RL12xx lifecycle pass.
 """
 from __future__ import annotations
 
 from .faults import (FaultInjected, FaultPlan, LoopKilled, current,
                      install, maybe_inject, set_role, uninstall)
 from .lockcheck import LockCycleError
+from .rescheck import ResourceLeakError
 from . import lockcheck
+from . import rescheck
 
 __all__ = [
     "FaultInjected", "FaultPlan", "LoopKilled", "current", "install",
     "maybe_inject", "set_role", "uninstall",
     "LockCycleError", "lockcheck",
+    "ResourceLeakError", "rescheck",
 ]

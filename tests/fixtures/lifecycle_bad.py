@@ -1,0 +1,151 @@
+"""Fixture exercising every RL12xx lifecycle rule — not real code.
+
+Each ``# expect: RLxxxx`` marker sits on the exact line the analyzer
+reports for that rule (RL1201/RL1203 at the acquire, RL1202 at the
+first unprotected use, RL1204 at the offending second release or
+post-release use, RL1205 at the ``except`` handler).  The clean
+shapes at the bottom must produce zero findings: they are the repair
+the error messages prescribe.
+"""
+import os
+import shutil
+import socket
+import tempfile
+import threading
+
+
+def handshake(sock):
+    sock.sendall(b"hello")
+
+
+def write_blob(path):
+    with open(path, "wb") as f:
+        f.write(b"\x00")
+
+
+# -- RL1201: acquire not released on every path -------------------------
+
+def leak_on_error_path(addr, strict):
+    s = socket.create_connection(addr)  # expect: RL1201
+    if strict:
+        raise ValueError("refusing plaintext peer")
+    s.close()
+    return True
+
+
+def fire_and_forget(work):
+    t = threading.Thread(target=work)  # expect: RL1201
+    t.start()
+
+
+# -- RL1202: unprotected window between acquire and cleanup -------------
+
+def unprotected_window(addr):
+    s = socket.create_connection(addr)
+    s.settimeout(5.0)  # expect: RL1202
+    try:
+        handshake(s)
+    finally:
+        s.close()
+
+
+def stage_unprotected():
+    tmp = tempfile.mkdtemp()
+    path = os.path.join(tmp, "out.bin")  # expect: RL1202
+    try:
+        write_blob(path)
+    finally:
+        shutil.rmtree(tmp)
+
+
+# -- RL1203: future neither resolved nor cancelled ----------------------
+
+class Request(object):
+    def __init__(self, tokens):
+        self.tokens = tokens
+
+
+def abandoned_request(queue, closed):
+    req = Request([1, 2, 3])  # expect: RL1203
+    if closed:
+        return False  # nobody ever resolves req on this path
+    queue.append(req)
+    return True
+
+
+# -- RL1204: double free / use after release ----------------------------
+
+def double_free(arena, owner):
+    pages = arena.alloc(4, owner)
+    arena.free(pages, owner=owner)
+    arena.free(pages, owner=owner)  # expect: RL1204
+
+
+def use_after_free(arena, owner):
+    pages = arena.alloc(4, owner)
+    arena.free(pages, owner=owner)
+    return arena.block_tables(pages)  # expect: RL1204
+
+
+# -- RL1205: broad swallow inside cleanup --------------------------------
+
+def close_all(conns):
+    for c in conns:
+        try:
+            c.close()
+        except Exception:  # expect: RL1205
+            pass
+
+
+# -- clean shapes: zero findings below this line -------------------------
+
+def protected_window(addr):
+    """The repair for unprotected_window: try starts right after."""
+    s = socket.create_connection(addr)
+    try:
+        s.settimeout(5.0)
+        handshake(s)
+    finally:
+        s.close()
+
+
+def close_and_reraise(addr):
+    """close-and-reraise except protects the handshake window too."""
+    s = socket.create_connection(addr)
+    try:
+        handshake(s)
+    except BaseException:
+        s.close()
+        raise
+    return s
+
+
+def clean_try_finally(fname):
+    tmp = tempfile.mkdtemp()
+    try:
+        write_blob(os.path.join(tmp, fname))
+    finally:
+        shutil.rmtree(tmp)
+
+
+def resolved_request(closed):
+    req = Request([1])
+    if closed:
+        req.cancel()
+        return None
+    return req  # ownership handed to the caller: not a leak
+
+
+def run_to_completion(work):
+    t = threading.Thread(target=work)
+    t.start()
+    t.join()
+    return True
+
+
+def narrow_swallow(conns):
+    for c in conns:
+        try:
+            c.close()
+        except OSError:
+            pass

@@ -45,7 +45,9 @@ _STUB_VALUES = {"train": 100.0, "infer": 200.0, "bert": 300.0,
                           "tpot_p50_ms": 2.0, "completed": 64,
                           "n_requests": 64, "live_compiles": 0,
                           "lockcheck_tok_s": 980.0,
-                          "lockcheck_overhead_pct": 2.0},
+                          "lockcheck_overhead_pct": 2.0,
+                          "rescheck_tok_s": 985.0,
+                          "rescheck_overhead_pct": 1.5},
                 # speculative serving runner (ISSUE 13): spec-on tok/s
                 # as value, spec-off baseline + acceptance + int8 kv
                 # byte ratio as extras (parity asserted in the probe)
@@ -160,6 +162,11 @@ def test_default_mode_emits_all_metrics_in_one_line(monkeypatch, capsys):
     # in docs/static_analysis.md is checked against these two fields
     assert srv["lockcheck_tok_s"] == 980.0
     assert srv["lockcheck_overhead_pct"] == 2.0
+    # rescheck sanitizer overhead (lint pass 12 runtime half): a fresh
+    # tracked server replays the same workload; <=3% is the acceptance
+    # gate, checked against these two fields like lockcheck's
+    assert srv["rescheck_tok_s"] == 985.0
+    assert srv["rescheck_overhead_pct"] == 1.5
     # speculative serving record (ISSUE 13): spec-on tok/s is the
     # value; the spec-off baseline from the SAME bundle, the n-gram
     # acceptance rate, and the int8/fp32 kv_page byte ratio ride along

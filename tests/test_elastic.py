@@ -217,6 +217,9 @@ def _run_elastic_training(chaos, monkeypatch, tmp_path=None):
             g = (w.asnumpy() - TARGET) / N_RANKS
             kv.push("w", nd.array(g))
         except WorkerKilled as e:
+            # a dead process would have its FDs closed by the OS — the
+            # simulated death must do the same or the sockets leak
+            kv.close()
             dead[rank] = (rnd + e.rejoin_after
                           if e.rejoin_after is not None else None)
 

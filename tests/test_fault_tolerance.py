@@ -179,7 +179,9 @@ def test_dead_worker_mid_round_names_missing_rank(monkeypatch,
     # the injection sequence is exactly the planned one
     assert [(e["site"], e["action"]) for e in plan.events] == \
         [("send", "raise")]
-    servers[0].shutdown()
+    for kv in kvs:
+        kv.close()  # both incarnations are dead to the roster — no
+    servers[0].shutdown()  # goodbye RPCs, just give back the FDs
 
 
 def test_dead_worker_evicted_on_timeout_survivor_completes(
@@ -218,6 +220,7 @@ def test_dead_worker_evicted_on_timeout_survivor_completes(
     kvs[0].pull("w", out=out)
     np.testing.assert_allclose(out.asnumpy(), np.ones(2), rtol=1e-6)
     kvs[0].stop()
+    kvs[1].close()  # the evicted incarnation's FDs (no goodbye RPCs)
 
 
 def test_server_killed_mid_round_fails_fast(monkeypatch, _fast_retries):

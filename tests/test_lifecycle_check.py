@@ -1,0 +1,277 @@
+"""RL12xx static pass: fixture corpus, per-rule behaviour, CLI selection
+(docs/static_analysis.md Pass 12).  The runtime half is tests/
+test_rescheck.py."""
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from mxnet_tpu.analysis import lint_paths, lint_source
+from mxnet_tpu.analysis.suppressions import SuppressionFile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "lifecycle_bad.py")
+
+_RL_RULES = ("RL1201", "RL1202", "RL1203", "RL1204", "RL1205")
+
+
+# ---------------------------------------------------------------------------
+# fixture corpus: every `# expect: RULE` marker produces exactly that
+# finding on that line, and nothing else fires anywhere in the file —
+# including the clean try/finally shapes at the bottom
+# ---------------------------------------------------------------------------
+def _markers():
+    out = []
+    with open(FIXTURE) as f:
+        for lineno, line in enumerate(f, 1):
+            m = re.search(r"#\s*expect:\s*([A-Z]+\d+)", line)
+            if m:
+                out.append((lineno, m.group(1)))
+    return sorted(out)
+
+
+def test_fixture_findings_match_markers_exactly():
+    expected = _markers()
+    assert len(expected) >= 8, "fixture corpus lost its markers"
+    findings = lint_paths([FIXTURE], relative_to=REPO,
+                          suppressions=SuppressionFile())
+    got = sorted((f.line, f.rule) for f in findings)
+    assert got == expected, "\n".join(str(f) for f in findings)
+
+
+@pytest.mark.parametrize("rule", list(_RL_RULES))
+def test_fixture_covers_rule(rule):
+    assert rule in {r for _, r in _markers()}
+
+
+# ---------------------------------------------------------------------------
+# per-rule behaviour on minimal sources
+# ---------------------------------------------------------------------------
+def test_rl1201_leak_on_raise_path_and_try_finally_clean():
+    bad = ("import socket\n"
+           "def f(addr, flag):\n"
+           "    s = socket.create_connection(addr)\n"
+           "    if flag:\n"
+           "        raise ValueError('no')\n"
+           "    s.close()\n")
+    ok = ("import socket\n"
+          "def f(addr, flag):\n"
+          "    s = socket.create_connection(addr)\n"
+          "    try:\n"
+          "        if flag:\n"
+          "            raise ValueError('no')\n"
+          "    finally:\n"
+          "        s.close()\n")
+    assert [f.rule for f in lint_source(bad)] == ["RL1201"]
+    assert lint_source(ok) == []
+
+
+def test_rl1201_unjoined_thread_flagged_joined_and_daemon_clean():
+    bad = ("import threading\n"
+           "def f(work):\n"
+           "    t = threading.Thread(target=work)\n"
+           "    t.start()\n")
+    ok = ("import threading\n"
+          "def f(work):\n"
+          "    t = threading.Thread(target=work)\n"
+          "    t.start()\n"
+          "    t.join()\n")
+    daemon = ("import threading\n"
+              "def f(work):\n"
+              "    t = threading.Thread(target=work, daemon=True)\n"
+              "    t.start()\n")
+    assert [f.rule for f in lint_source(bad)] == ["RL1201"]
+    assert lint_source(ok) == []
+    assert lint_source(daemon) == []  # daemon threads may outlive us
+
+
+def test_rl1201_handing_ownership_to_the_caller_is_clean():
+    src = ("import socket\n"
+           "def connect(addr):\n"
+           "    s = socket.create_connection(addr)\n"
+           "    return s\n")
+    assert lint_source(src) == []
+
+
+def test_rl1202_use_in_window_flagged_protected_use_clean():
+    bad = ("import socket\n"
+           "def f(addr):\n"
+           "    s = socket.create_connection(addr)\n"
+           "    s.settimeout(5.0)\n"
+           "    s.close()\n")
+    ok = ("import socket\n"
+          "def f(addr):\n"
+          "    s = socket.create_connection(addr)\n"
+          "    try:\n"
+          "        s.settimeout(5.0)\n"
+          "    finally:\n"
+          "        s.close()\n")
+    findings = lint_source(bad)
+    assert [f.rule for f in findings] == ["RL1202"]
+    assert findings[0].line == 4  # reported at the use, not the acquire
+    assert lint_source(ok) == []
+
+
+def test_rl1202_close_and_reraise_except_counts_as_protection():
+    src = ("import socket\n"
+           "def f(addr):\n"
+           "    s = socket.create_connection(addr)\n"
+           "    try:\n"
+           "        s.settimeout(5.0)\n"
+           "    except BaseException:\n"
+           "        s.close()\n"
+           "        raise\n"
+           "    return s\n")
+    assert lint_source(src) == []
+
+
+def test_rl1203_abandoned_future_flagged_cancelled_clean():
+    bad = ("def f(q, closed):\n"
+           "    r = Request([1])\n"
+           "    if closed:\n"
+           "        return None\n"
+           "    q.append(r)\n")
+    ok = ("def f(q, closed):\n"
+          "    r = Request([1])\n"
+          "    if closed:\n"
+          "        r.cancel()\n"
+          "        return None\n"
+          "    q.append(r)\n")
+    assert [f.rule for f in lint_source(bad)] == ["RL1203"]
+    assert lint_source(ok) == []
+
+
+def test_rl1204_double_free_and_use_after_free():
+    double = ("def f(a, o):\n"
+              "    p = a.alloc(4, o)\n"
+              "    a.free(p, owner=o)\n"
+              "    a.free(p, owner=o)\n")
+    uaf = ("def f(a, o):\n"
+           "    p = a.alloc(4, o)\n"
+           "    a.free(p, owner=o)\n"
+           "    return a.rows(p)\n")
+    ok = ("def f(a, o):\n"
+          "    p = a.alloc(4, o)\n"
+          "    a.free(p, owner=o)\n")
+    assert [f.rule for f in lint_source(double)] == ["RL1204"]
+    assert [f.rule for f in lint_source(uaf)] == ["RL1204"]
+    assert lint_source(ok) == []
+
+
+def test_rl1204_none_narrowed_alloc_is_clean():
+    # the admission-failure shape in serve/server.py: on the None arm
+    # there is nothing to free, so the raise path must not flag
+    src = ("def f(a, o):\n"
+           "    p = a.alloc(4, o)\n"
+           "    if p is None:\n"
+           "        raise MemoryError('arena full')\n"
+           "    a.free(p, owner=o)\n")
+    assert lint_source(src) == []
+
+
+def test_rl1205_broad_swallow_flagged_narrow_clean():
+    bad = ("def close_all(conns):\n"
+           "    for c in conns:\n"
+           "        try:\n"
+           "            c.close()\n"
+           "        except Exception:\n"
+           "            pass\n")
+    ok = ("def close_all(conns):\n"
+          "    for c in conns:\n"
+          "        try:\n"
+          "            c.close()\n"
+          "        except OSError:\n"
+          "            pass\n")
+    assert [f.rule for f in lint_source(bad)] == ["RL1205"]
+    assert lint_source(ok) == []
+
+
+def test_rl1205_needs_cleanup_scope():
+    # a broad swallow around non-release work is other passes' business
+    src = ("def parse_all(lines):\n"
+           "    out = []\n"
+           "    for ln in lines:\n"
+           "        try:\n"
+           "            out.append(int(ln))\n"
+           "        except Exception:\n"
+           "            pass\n"
+           "    return out\n")
+    assert "RL1205" not in [f.rule for f in lint_source(src)]
+
+
+def test_inline_disable_four_digit_rule_id():
+    src = ("import socket\n"
+           "def f(addr, flag):\n"
+           "    s = socket.create_connection(addr)"
+           "  # mxlint: disable=RL1201\n"
+           "    if flag:\n"
+           "        raise ValueError('no')\n"
+           "    s.close()\n")
+    assert lint_source(src) == []
+
+
+# ---------------------------------------------------------------------------
+# severity + CLI selection + JSON output contract
+# ---------------------------------------------------------------------------
+def test_rl_severities():
+    from mxnet_tpu.analysis import SEVERITY
+
+    # heuristic rules warn; provable leak/double-free stay errors
+    # (absent = error)
+    assert SEVERITY["RL1203"] == "warn"
+    assert SEVERITY["RL1205"] == "warn"
+    assert "RL1201" not in SEVERITY
+    assert "RL1202" not in SEVERITY
+    assert "RL1204" not in SEVERITY
+
+
+def _run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mxlint.py")]
+        + list(argv),
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+
+def test_cli_pass_rl_isolates_family():
+    r = _run_cli(FIXTURE, "--pass", "RL", "--no-registry-check")
+    assert r.returncode == 1, r.stdout + r.stderr
+    rules = set(re.findall(r" ([A-Z]+\d+) \[", r.stdout))
+    assert rules == set(_RL_RULES), r.stdout
+
+
+def test_cli_format_json_contract():
+    """--format json emits a parseable array of finding dicts with the
+    documented keys — scripted against by CI tooling, so it must not
+    grow the human summary line."""
+    r = _run_cli(FIXTURE, "--pass", "RL", "--no-registry-check",
+                 "--format", "json")
+    assert r.returncode == 1, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert isinstance(doc, list) and len(doc) == len(_markers())
+    for entry in doc:
+        assert set(entry) == {"path", "line", "col", "rule", "slug",
+                              "severity", "message"}, entry
+        assert entry["rule"] in _RL_RULES
+        assert entry["path"].endswith("lifecycle_bad.py")
+    by_rule = {e["rule"]: e["severity"] for e in doc}
+    assert by_rule["RL1203"] == "warn"
+    assert by_rule["RL1201"] == "error"
+
+
+def test_cli_list_rules_includes_rl():
+    r = _run_cli("--list-rules")
+    assert r.returncode == 0, r.stderr
+    for rule in _RL_RULES:
+        assert rule in r.stdout
+
+
+def test_repo_source_is_rl_clean():
+    """Dogfood gate: the framework's own handle-owning tiers stay
+    RL-clean (suppressions allowed only via the justified repo
+    file/pragmas)."""
+    r = _run_cli("mxnet_tpu", "--pass", "RL", "--no-registry-check")
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -1,0 +1,211 @@
+"""Runtime resource-leak sanitizer (MXNET_RESCHECK=1,
+testing/rescheck.py): tracked acquire/release transparency, leak
+reports naming the creation site, double-free detection, quiescence
+assertions with scope filtering, telemetry, and the mxflight
+``--kind res`` post-mortem filter.  The static half is
+tests/test_lifecycle_check.py."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mxnet_tpu.telemetry import flight, metrics
+from mxnet_tpu.testing import ResourceLeakError, rescheck
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _sanitizer_on():
+    was = rescheck.enabled()
+    rescheck.install()
+    rescheck.reset()
+    flight.reset()
+    yield
+    rescheck.reset()
+    if not was:
+        rescheck.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# transparency: zero-cost when off, exact pairing when on
+# ---------------------------------------------------------------------------
+def test_disabled_acquire_returns_none_and_release_tolerates_it():
+    rescheck.uninstall()
+    try:
+        tok = rescheck.acquire("socket", "server0")
+        assert tok is None
+        rescheck.release(tok)  # no-op, never raises
+        rescheck.assert_quiescent(grace_s=0)
+    finally:
+        rescheck.install()
+
+
+def test_acquire_release_pairing():
+    tok = rescheck.acquire("socket", "server0", scope="kv:1")
+    assert tok is not None
+    assert [h.owner for h in rescheck.live(kind="socket")] == ["server0"]
+    rescheck.release(tok)
+    assert rescheck.live() == []
+    rescheck.assert_quiescent(grace_s=0)
+
+
+def test_live_filters_by_kind_and_scope():
+    a = rescheck.acquire("socket", "s0", scope="kv:1")
+    b = rescheck.acquire("arena", "req-1", scope="arena:1")
+    try:
+        assert {h.kind for h in rescheck.live()} == {"socket", "arena"}
+        assert [h.owner for h in rescheck.live(kind="arena")] == ["req-1"]
+        assert [h.owner for h in rescheck.live(scope="kv:1")] == ["s0"]
+    finally:
+        rescheck.release(a)
+        rescheck.release(b)
+
+
+# ---------------------------------------------------------------------------
+# leak reporting: the creation site is in the message
+# ---------------------------------------------------------------------------
+def _leaky_helper():
+    return rescheck.acquire("tempfile", "/tmp/leaked", scope="test")
+
+
+def test_leak_report_names_creation_site():
+    tok = _leaky_helper()
+    with pytest.raises(ResourceLeakError) as ei:
+        rescheck.assert_quiescent(grace_s=0)
+    msg = str(ei.value)
+    assert "tempfile" in msg and "/tmp/leaked" in msg
+    # the creation stack points at the acquiring helper, not at
+    # rescheck internals — that is the whole point of the report
+    assert "_leaky_helper" in msg
+    assert "test_rescheck.py" in msg
+    assert list(ei.value.leaks) == [tok]
+    # a res.leak flight event landed, attributable the same way
+    (ev,) = flight.events(kind="res.leak")
+    assert ev["resource"] == "tempfile"
+    assert ev["owner"] == "/tmp/leaked"
+    assert "_leaky_helper" in ev["site"]
+    snap = metrics.snapshot()
+    assert "mxnet_resource_leaks_total" in snap
+    rescheck.release(tok)
+
+
+def test_double_free_raises_and_records_flight_event():
+    tok = rescheck.acquire("arena", "req-9")
+    rescheck.release(tok)
+    with pytest.raises(ResourceLeakError, match="double release"):
+        rescheck.release(tok)
+    (ev,) = flight.events(kind="res.double_free")
+    assert ev["resource"] == "arena"
+    assert ev["owner"] == "req-9"
+
+
+def test_quiescence_scoping_checks_one_component():
+    mine = rescheck.acquire("future", "trace-1", scope="sched:A")
+    other = rescheck.acquire("socket", "s3", scope="kv:B")
+    try:
+        rescheck.release(mine)
+        # scope A is drained even though scope B still has live handles
+        rescheck.assert_quiescent(scope="sched:A", grace_s=0)
+        with pytest.raises(ResourceLeakError):
+            rescheck.assert_quiescent(scope="kv:B", grace_s=0)
+    finally:
+        rescheck.release(other)
+
+
+def test_exempt_handles_skip_quiescence_but_not_double_free():
+    tok = rescheck.acquire("flight", "dump-hook", exempt=True)
+    # a dump hook legitimately outlives every drain
+    rescheck.assert_quiescent(grace_s=0)
+    assert rescheck.live() == []  # exempt: invisible to snapshots
+    rescheck.release(tok)
+    with pytest.raises(ResourceLeakError):
+        rescheck.release(tok)
+
+
+def test_live_gauge_tracks_acquire_release():
+    tok = rescheck.acquire("socket", "gauge-probe")
+    snap = metrics.snapshot()
+    assert "mxnet_resource_live" in snap
+    rescheck.release(tok)
+
+
+# ---------------------------------------------------------------------------
+# serve integration: a stopped server is quiescent, not just page-clean
+# ---------------------------------------------------------------------------
+def _tiny_parts():
+    import itertools
+
+    import numpy as np
+
+    from mxnet_tpu.serve import PagedKVArena
+    from mxnet_tpu.serve.model import KVGeometry
+
+    g = KVGeometry(num_layers=1, num_heads=2, num_kv_heads=1, head_dim=4,
+                   units=8, hidden_size=16, vocab_size=32, page_size=4,
+                   num_pages=9, max_pages_per_seq=4, max_batch=2,
+                   prefill_buckets=(4, 8))
+
+    class Runner:
+        def prefill(self, bucket, tokens, length, block_row):
+            return np.zeros(g.vocab_size, dtype=np.float32)
+
+        def decode(self, tokens, positions, block_tables):
+            return np.zeros((g.max_batch, g.vocab_size), dtype=np.float32)
+
+    counter = itertools.count()
+    return Runner(), PagedKVArena(g), lambda: next(counter) * 0.01
+
+
+def test_scheduler_completion_releases_future_tokens():
+    from mxnet_tpu.serve import Request, Scheduler
+
+    runner, arena, clock = _tiny_parts()
+    sched = Scheduler(runner, arena, queue_depth=8, clock=clock)
+    req = sched.submit(Request([1, 2], max_new_tokens=4))
+    steps = 0
+    while sched.has_work():
+        sched.step()
+        steps += 1
+        assert steps < 10_000
+    assert req.error is None
+    rescheck.assert_quiescent(scope=sched.res_scope, grace_s=0)
+    arena.assert_quiescent()
+
+
+def test_server_stop_is_resource_quiescent():
+    from mxnet_tpu.serve import Request
+    from mxnet_tpu.serve.server import LlamaServer
+
+    runner, arena, clock = _tiny_parts()
+    srv = LlamaServer.from_parts(runner, arena, queue_depth=8, clock=clock)
+    req = srv.scheduler.submit(Request([1, 2], max_new_tokens=4))
+    # stop() fails the queued request — its future token must be
+    # released too, and stop() itself asserts quiescence when the
+    # sanitizer is on (so a leak here raises out of stop())
+    srv.stop()
+    assert req.done()
+    assert rescheck.live(scope=srv.scheduler.res_scope) == []
+    assert rescheck.live(scope=srv.arena.res_scope) == []
+
+
+# ---------------------------------------------------------------------------
+# mxflight --kind res: post-mortem filter over sanitizer events
+# ---------------------------------------------------------------------------
+def test_mxflight_kind_res_filters_sanitizer_events(tmp_path):
+    tok = rescheck.acquire("socket", "leaky-server")
+    rescheck.release(tok)
+    with pytest.raises(ResourceLeakError):
+        rescheck.release(tok)  # plants a res.double_free event
+    flight.record("kv.push", key="w0")  # noise the filter must drop
+    path = flight.dump(str(tmp_path / "f.json"), reason="unit")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mxflight.py"),
+         "show", path, "--kind", "res"],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "res.double_free" in r.stdout
+    assert "leaky-server" in r.stdout
+    assert "kv.push" not in r.stdout

@@ -42,20 +42,22 @@ import time
 
 def _free_port():
     s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    try:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
 
 
 def _default_root_uri():
     """An address of this host that remote workers can reach."""
     try:
         s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        s.connect(("8.8.8.8", 53))  # no traffic sent; just picks the route
-        addr = s.getsockname()[0]
-        s.close()
-        return addr
+        try:
+            s.connect(("8.8.8.8", 53))  # no traffic; just picks the route
+            return s.getsockname()[0]
+        finally:
+            s.close()
     except OSError:
         return socket.gethostbyname(socket.gethostname())
 

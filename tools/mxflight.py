@@ -13,6 +13,12 @@ filters to the eviction/join/epoch timeline — each ``membership.evict``
 names the lost rank's last RPC (``last_rpc``/``last_seq``), which is
 usually the first question after a scale-down.
 
+Resource-leak triage: ``show dump.json --kind res`` keeps the
+``res.leak`` / ``res.double_free`` events the ``MXNET_RESCHECK=1``
+sanitizer records — each names the handle kind, owner, scope and the
+acquisition site, so a leak found by chaos CI is attributable without
+re-running the job.
+
 Subcommands:
 
   show    Pretty-print one or more dumps, newest last::
@@ -105,7 +111,8 @@ def main(argv=None):
     sp = sub.add_parser("show", help="pretty-print flight dumps")
     sp.add_argument("dumps", nargs="+", help="flight-recorder JSON dumps")
     sp.add_argument("--kind", default=None,
-                    help="filter: exact kind or dotted prefix (kv, engine)")
+                    help="filter: exact kind or dotted prefix (kv, "
+                         "engine, res)")
     sp.add_argument("--last", type=int, default=None,
                     help="keep only the N most recent events per dump")
     sp.set_defaults(fn=_cmd_show)

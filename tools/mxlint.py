@@ -3,11 +3,11 @@
 
 Runs the tracing-safety (TS1xx), host-sync (HS2xx), collective-
 consistency (CC6xx), robustness (RB7xx), cache-key (CS8xx), sharding
-(SH9xx), planner (SP10xx) and concurrency-discipline (CD11xx) passes
-over the given files/directories, plus the op-registry consistency pass
-(RC3xx) when the framework imports.  ``--pass SP10`` or ``--pass CD``
-(alias ``--only``; comma-separated bands, families or rule ids) runs a
-selection in isolation.
+(SH9xx), planner (SP10xx), concurrency-discipline (CD11xx) and
+lifecycle (RL12xx) passes over the given files/directories, plus the
+op-registry consistency pass (RC3xx) when the framework imports.
+``--pass SP10`` or ``--pass RL`` (alias ``--only``; comma-separated
+bands, families or rule ids) runs a selection in isolation.
 Explicitly-passed ``.json`` files are verified as serialized Symbol
 graphs with the per-node GS5xx pass.  The repo's own tree is a permanent
 lint target::
