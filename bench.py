@@ -1187,6 +1187,133 @@ def _run_serve_paged(platform):
             "live_compiles": doc["live_compiles"]}
 
 
+def _fleet_probe(path):
+    """Subprocess entry (`--fleet-probe <bundle>`): fleet-front serving
+    throughput over N=3 in-process replicas of the SAME AOT bundle.
+
+    The seeded 64-request Poisson workload is replayed through a
+    ``FleetRouter`` (queue-aware power-of-two routing, live prober) via
+    ``fleet_drive_workload`` — the fleet twin of the `serve` bench.
+    Aggregate tok/s is the headline; TTFT p99 across the fleet rides
+    along.  A second pass measures the ROUTING TAX: the same workload
+    through a router fronting ONE replica vs directly through that
+    replica's scheduler (acceptance: within 5%).  The process must
+    perform zero live compiles — nonzero means the AOT warm start
+    regressed and every number here is polluted by jit time."""
+    from mxnet_tpu import serve
+    from mxnet_tpu.telemetry import metrics as telemetry_metrics
+
+    def fleet_rates(n_replicas):
+        servers = [serve.LlamaServer(path).start()
+                   for _ in range(n_replicas)]
+        router = serve.FleetRouter(servers, probe_interval=0.2, seed=0)
+        router.start()
+        rates, ttfts, futs = [], [], None
+        try:
+            for _ in range(_SERVE_REPLAYS):
+                wl = serve.poisson_workload(_SERVE_N_REQUESTS,
+                                            **_SERVE_WORKLOAD)
+                run_futs, wall = serve.fleet_drive_workload(router, wl,
+                                                            timeout=600)
+                done = [f for f in run_futs if f.error is None]
+                rates.append(sum(len(f.tokens) for f in done) / wall)
+                ttfts.extend(f.ttft for f in done if f.ttft is not None)
+                futs = futs if futs is not None else run_futs
+        finally:
+            router.stop()
+            for srv in servers:
+                srv.drain(timeout=60)
+                srv.stop()
+        stats = router.healthz()
+        p99 = sorted(ttfts)[int(0.99 * (len(ttfts) - 1))] if ttfts else 0.0
+        return _median(rates), p99, futs, stats
+
+    fleet_rate, ttft_p99, futs, stats = fleet_rates(3)
+
+    # routing tax at N=1: the router's pick/retry machinery + future
+    # thread vs the same replica driven directly
+    direct_srv = serve.LlamaServer(path).start()
+    direct_rates = []
+    for _ in range(_SERVE_REPLAYS):
+        wl = serve.poisson_workload(_SERVE_N_REQUESTS, **_SERVE_WORKLOAD)
+        reqs, wall = serve.drive_workload(direct_srv, wl, timeout=600)
+        done = [r for r in reqs if r.error is None]
+        direct_rates.append(sum(len(r.tokens) for r in done) / wall)
+    direct_srv.stop()
+    direct_rate = _median(direct_rates)
+
+    router1_rate, _, _, _ = fleet_rates(1)
+    overhead_pct = (round((1.0 - router1_rate / direct_rate) * 100.0, 2)
+                    if direct_rate else 0.0)
+
+    snap = telemetry_metrics.snapshot()
+    compiles = sum(s["value"] for s in snap.get(
+        "mxnet_compiles_total", {}).get("series", []))
+    completed = len([f for f in futs if f.error is None])
+    doc = {
+        "fleet_tok_s": round(fleet_rate, 2),
+        "n_replicas": 3,
+        "ttft_p99_ms": round(ttft_p99 * 1e3, 2),
+        "completed": completed,
+        "n_requests": len(futs),
+        "retried": stats["retried"],
+        "ejections": stats["ejections"],
+        "dropped": stats["dropped"],
+        "direct_tok_s": round(direct_rate, 2),
+        "router1_tok_s": round(router1_rate, 2),
+        "routing_overhead_pct": overhead_pct,
+        "live_compiles": int(compiles),
+    }
+    print("FLEET_RESULT=%s" % json.dumps(doc), flush=True)
+
+
+def _run_fleet(platform):
+    """`fleet_serve_tok_s`: aggregate continuous-batching throughput of
+    a 3-replica fleet behind the ISSUE 18 FleetRouter, on the same
+    seeded 64-request Poisson workload as `llama_serve_tok_s`.
+
+    Two fresh subprocesses: ``--serve-export`` compiles the one bundle
+    every replica loads (paying every jit), then ``--fleet-probe``
+    serves the workload through the router with zero live compiles.
+    The metric value is fleet-aggregate tok/s; the N=1 router-vs-direct
+    routing overhead (acceptance: within 5%) and the fleet TTFT p99
+    ride along."""
+    import shutil
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="mxnet-fleet-bench-")
+    try:
+        bundle = os.path.join(tmp, "llama_small.mxaot")
+        env = dict(os.environ)
+        _probe_subprocess(["--serve-export", bundle], env,
+                          "SERVE_EXPORT_OK", "fleet export")
+        doc = json.loads(_probe_subprocess(
+            ["--fleet-probe", bundle], env, "FLEET_RESULT=", "fleet"))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    _log("fleet: %.1f tok/s over %d replicas, ttft p99 %.1f ms, "
+         "%d/%d completed (%d retried, %d ejections, %d dropped), "
+         "routing overhead %.1f%% (router@1 %.1f vs direct %.1f tok/s), "
+         "%d live compiles"
+         % (doc["fleet_tok_s"], doc["n_replicas"], doc["ttft_p99_ms"],
+            doc["completed"], doc["n_requests"], doc["retried"],
+            doc["ejections"], doc["dropped"],
+            doc["routing_overhead_pct"], doc["router1_tok_s"],
+            doc["direct_tok_s"], doc["live_compiles"]))
+    return {"value": doc["fleet_tok_s"],
+            "n_replicas": doc["n_replicas"],
+            "ttft_p99_ms": doc["ttft_p99_ms"],
+            "completed": doc["completed"],
+            "n_requests": doc["n_requests"],
+            "retried": doc["retried"],
+            "ejections": doc["ejections"],
+            "dropped": doc["dropped"],
+            "direct_tok_s": doc["direct_tok_s"],
+            "router1_tok_s": doc["router1_tok_s"],
+            "routing_overhead_pct": doc["routing_overhead_pct"],
+            "live_compiles": doc["live_compiles"]}
+
+
 def _run_planner(platform):
     """`python bench.py planner`: wall-clock seconds for one auto-sharding
     plan of the llama_small parameter tree on an abstract 4x2 mesh
@@ -1276,6 +1403,9 @@ _SPECS = {
     # kernel-on tok/s, the off baseline + memdump byte ratio ride along
     "serve_paged": (_run_serve_paged, "llama_serve_paged_tok_s",
                     "tokens/sec", None),
+    # fleet front over 3 replicas of the same bundle; value is aggregate
+    # tok/s, the N=1 routing-overhead comparison rides along
+    "fleet": (_run_fleet, "fleet_serve_tok_s", "tokens/sec", None),
     # auto-sharding planner latency: pure host-side static analysis,
     # LOWER is better (it is the rules="auto" first-step tax)
     "planner": (_run_planner, "planner_seconds", "seconds", None),
@@ -1354,6 +1484,9 @@ def main():
     if len(sys.argv) >= 3 and sys.argv[1] == "--serve-paged-probe":
         _serve_paged_probe(sys.argv[2])  # subprocess: on/off + parity
         return
+    if len(sys.argv) >= 3 and sys.argv[1] == "--fleet-probe":
+        _fleet_probe(sys.argv[2])  # subprocess: 3-replica fleet front
+        return
     t_start = time.perf_counter()
     requested = [a for a in sys.argv[1:] if a in _SPECS and a != "train"]
     try:
@@ -1378,7 +1511,7 @@ def main():
     for name in ("infer", "bert", "llama", "dispatch_eager",
                  "dispatch_eager_notelemetry", "dispatch_bulked",
                  "dispatch_bulked_train", "dispatch_bulked_long",
-                 "serve", "serve_spec", "serve_paged", "planner",
+                 "serve", "serve_spec", "serve_paged", "fleet", "planner",
                  "cold_resnet50", "cold_bert",
                  "cold_llama"):
         elapsed = time.perf_counter() - t_start

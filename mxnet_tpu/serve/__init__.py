@@ -24,6 +24,13 @@ typed errors, graceful drain (503 + Retry-After), AOT bundle hot-swap
 (``LlamaServer.reload``), serve-loop crash containment, and seeded
 chaos coverage (``tests/test_serve_chaos.py``).
 
+ISSUE 18 lifted those per-replica primitives to a fleet
+(:mod:`.fleet`, docs/serving.md "Fleet serving"): a
+:class:`FleetRouter` HTTP front over N replicas with queue-depth-aware
+power-of-two-choices routing, bounded retries + opt-in hedging,
+circuit-breaker ejection/re-admission, and chaos-verified
+``rolling_deploy`` with zero dropped requests.
+
 Quick start::
 
     from mxnet_tpu import serve
@@ -37,22 +44,26 @@ Quick start::
         tokens = srv.generate([1, 2, 3], max_new_tokens=16)
 """
 from .arena import PagedKVArena
+from .fleet import (FleetNoHealthyReplica, FleetRouter, HttpReplica,
+                    LocalReplica, fleet_drive_workload)
 from .model import (KVGeometry, check_geometry, export_serving_bundle,
                     geometry_from_net, load_serving_executables)
 from .scheduler import (Request, Scheduler, ServeCancelled,
                         ServeDeadlineExceeded, ServeDraining,
                         ServeInternalError, ServeQueueFull, ServeShutdown,
-                        greedy_sampler)
+                        clamp_retry_after, greedy_sampler)
 from .server import (AOTRunner, LlamaServer, drive_workload,
                      poisson_workload)
 from .spec import NgramProposer, propose_ngram
 
 __all__ = [
-    "AOTRunner", "KVGeometry", "LlamaServer", "NgramProposer",
+    "AOTRunner", "FleetNoHealthyReplica", "FleetRouter", "HttpReplica",
+    "KVGeometry", "LlamaServer", "LocalReplica", "NgramProposer",
     "PagedKVArena", "Request",
     "Scheduler", "ServeCancelled", "ServeDeadlineExceeded",
     "ServeDraining", "ServeInternalError", "ServeQueueFull",
-    "ServeShutdown", "check_geometry", "drive_workload",
-    "export_serving_bundle", "geometry_from_net", "greedy_sampler",
+    "ServeShutdown", "check_geometry", "clamp_retry_after",
+    "drive_workload", "export_serving_bundle", "fleet_drive_workload",
+    "geometry_from_net", "greedy_sampler",
     "load_serving_executables", "poisson_workload", "propose_ngram",
 ]

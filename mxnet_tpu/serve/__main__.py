@@ -5,6 +5,12 @@ continuous-batching loop, and exposes the stdlib HTTP front:
 ``POST /v1/generate {"prompt": [...ids], "max_new_tokens": n}``,
 ``GET /metrics`` (Prometheus), ``GET /healthz`` (scheduler stats).
 
+``--fleet N`` (N > 1) starts N in-process replicas behind a
+:class:`FleetRouter` front instead — queue-depth-aware routing, bounded
+retries, circuit-breaker ejection — on the same HTTP surface
+(``tools/mxfleet.py`` is the richer fleet CLI: remote replicas, status,
+rolling deploys).
+
 SIGTERM (what an orchestrator sends on pod eviction / rollout) triggers
 the graceful path: stop admission (503 + Retry-After), finish in-flight
 work within ``--drain-timeout`` (default ``MXNET_SERVE_DRAIN_TIMEOUT``),
@@ -16,6 +22,7 @@ import argparse
 import signal
 import threading
 
+from .fleet import FleetRouter
 from .server import LlamaServer
 
 
@@ -25,6 +32,9 @@ def main(argv=None):
                     help="MXAOT1 serving bundle (export_serving_bundle)")
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--fleet", type=int, default=1, metavar="N",
+                    help="serve N in-process replicas behind a "
+                         "FleetRouter front (default 1: plain server)")
     ap.add_argument("--queue-depth", type=int, default=None)
     ap.add_argument("--spec-k", type=int, default=None,
                     help="runtime speculative draft count (default: the "
@@ -37,21 +47,51 @@ def main(argv=None):
                          "SIGTERM/Ctrl-C (default: "
                          "MXNET_SERVE_DRAIN_TIMEOUT or 30)")
     args = ap.parse_args(argv)
-    srv = LlamaServer(args.bundle, queue_depth=args.queue_depth,
-                      spec_k=args.spec_k, kv_dtype=args.kv_dtype).start()
-    host, port = srv.serve_http(port=args.port, host=args.host)
+    if args.fleet < 1:
+        ap.error("--fleet must be >= 1")
+
+    def _make_server():
+        return LlamaServer(args.bundle, queue_depth=args.queue_depth,
+                           spec_k=args.spec_k,
+                           kv_dtype=args.kv_dtype).start()
+
     term = threading.Event()
-    # registered before the banner: the orchestrator (or a test) may
-    # SIGTERM the moment it sees the port
+    if args.fleet == 1:
+        srv = _make_server()
+        host, port = srv.serve_http(port=args.port, host=args.host)
+        # registered before the banner: the orchestrator (or a test) may
+        # SIGTERM the moment it sees the port
+        signal.signal(signal.SIGTERM, lambda *a: term.set())
+        print("serving %s on http://%s:%d  [%s]"
+              % (args.bundle, host, port, srv.geometry.describe()))
+        try:
+            term.wait()
+        except KeyboardInterrupt:
+            pass
+        stragglers = srv.drain(timeout=args.drain_timeout)
+        srv.stop()
+        if stragglers:
+            print("drain timed out: %d request(s) failed typed"
+                  % stragglers)
+        return
+
+    servers = [_make_server() for _ in range(args.fleet)]
+    router = FleetRouter(servers).start()
+    host, port = router.serve_http(port=args.port, host=args.host)
     signal.signal(signal.SIGTERM, lambda *a: term.set())
-    print("serving %s on http://%s:%d  [%s]"
-          % (args.bundle, host, port, srv.geometry.describe()))
+    print("serving fleet n=%d %s on http://%s:%d  [%s]"
+          % (args.fleet, args.bundle, host, port,
+             servers[0].geometry.describe()))
     try:
         term.wait()
     except KeyboardInterrupt:
         pass
-    stragglers = srv.drain(timeout=args.drain_timeout)
-    srv.stop()
+    stragglers = 0
+    for srv in servers:  # drain one at a time: the router steers away
+        stragglers += srv.drain(timeout=args.drain_timeout)
+    router.stop()
+    for srv in servers:
+        srv.stop()
     if stragglers:
         print("drain timed out: %d request(s) failed typed" % stragglers)
 

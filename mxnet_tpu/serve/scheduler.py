@@ -101,19 +101,34 @@ _SPEC_EMA = 0.2
 # exactly as before — dormancy can only ever cost probe overhead.
 _SPEC_DORMANT_AFTER = 3
 
+# Retry-After hints are clamped to [floor, ceiling]: a cold TPOT EMA can
+# emit a ~0s hint (an immediate-stampede invitation to every backed-off
+# client at once) and a deep queue x pathological EMA can emit minutes
+# (clients give up on a backlog that clears in seconds).  0.05 s is one
+# router backoff step; 30 s is the longest a drain/deploy should gate a
+# replica (MXNET_SERVE_DRAIN_TIMEOUT's magnitude).
+_RETRY_AFTER_FLOOR_S = 0.05
+_RETRY_AFTER_CEIL_S = 30.0
+
+
+def clamp_retry_after(x):
+    """Clamp a Retry-After hint (seconds) to the sane band — applied to
+    every hint the serve tier emits and every hint the fleet honors."""
+    return max(_RETRY_AFTER_FLOOR_S, min(float(x), _RETRY_AFTER_CEIL_S))
+
 
 class ServeQueueFull(MXNetError):
     """Admission queue at MXNET_SERVE_QUEUE_DEPTH — shed load upstream.
     Carries ``retry_after_s`` (queue-depth x TPOT estimate)."""
 
-    retry_after_s = 1
+    retry_after_s = 1.0
 
 
 class ServeDraining(MXNetError):
     """Submit refused: the server is draining for shutdown or swap.
     Carries ``retry_after_s`` — HTTP surfaces it as 503 + Retry-After."""
 
-    retry_after_s = 1
+    retry_after_s = 1.0
 
 
 class ServeDeadlineExceeded(MXNetError):
@@ -613,17 +628,20 @@ class Scheduler:
 
     def _retry_after_locked(self):
         """Seconds until the backlog plausibly clears: queued requests x
-        mean budget x TPOT EMA / batch width (>= 1; callers hold _lock)."""
+        mean budget x TPOT EMA / batch width, clamped to the
+        [0.05 s, 30 s] band (callers hold _lock).  The clamp matters at
+        both ends: a cold EMA (est ~0) must not invite an immediate
+        stampede, and a deep queue must not emit a minutes-long hint."""
         budgets = [r.max_new_tokens for r in self._queue]
         tpot = self._t_decode
         if tpot <= 0.0 and self._tpots:
             data = sorted(self._tpots)
             tpot = data[len(data) // 2]
         if not budgets or tpot <= 0.0:
-            return 1
+            return 1.0
         est = (len(budgets) * (sum(budgets) / len(budgets)) * tpot
                / max(1, self.geometry.max_batch))
-        return max(1, int(math.ceil(est)))
+        return clamp_retry_after(est)
 
     def retry_after_s(self):
         """Public Retry-After estimate (see ``_retry_after_locked``)."""

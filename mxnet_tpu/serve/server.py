@@ -21,7 +21,13 @@ gap is pure scheduling.
 """
 from __future__ import annotations
 
+import hashlib
+import itertools
 import json
+import math
+import os
+import select
+import socket
 import threading
 import time
 
@@ -39,6 +45,33 @@ from .scheduler import (Request, Scheduler, ServeCancelled,
                         ServeDeadlineExceeded, ServeDraining,
                         ServeInternalError, ServeQueueFull, ServeShutdown,
                         _env_float, _env_int)
+
+_SERVER_IDS = itertools.count()
+
+
+def _bundle_sha(path):
+    """Short content hash of the loaded bundle — the /healthz field a
+    fleet router uses to detect version drift and assert convergence
+    after a rolling deploy.  Hashes file bytes when ``path`` is a real
+    bundle; falls back to hashing the string for the scripted swaps the
+    chaos suite performs (``from_parts`` servers have no file)."""
+    if path is None:
+        return None
+    try:
+        with open(path, "rb") as f:
+            digest = hashlib.sha256(f.read())
+    except OSError:
+        digest = hashlib.sha256(str(path).encode())
+    return digest.hexdigest()[:16]
+
+
+def _retry_after_header(retry_after_s):
+    """HTTP Retry-After is delta-seconds as a non-negative integer; the
+    scheduler's hint is a clamped float — round up, floor at 1."""
+    try:
+        return str(max(1, int(math.ceil(float(retry_after_s)))))
+    except (TypeError, ValueError):
+        return "1"
 
 
 class AOTRunner:
@@ -134,6 +167,7 @@ class LlamaServer:
                         queue_depth=queue_depth, sampler=sampler,
                         spec_k=spec_k)
         self.bundle_path = bundle_path
+        self.bundle_sha = _bundle_sha(bundle_path)
 
     def _init_core(self, runner, arena, queue_depth=None, sampler=None,
                    spec_k=None, clock=time.monotonic):
@@ -144,6 +178,9 @@ class LlamaServer:
                                    sampler=sampler, spec_k=spec_k,
                                    clock=clock)
         self.bundle_path = None
+        self.bundle_sha = None
+        self.server_id = "srv-%x-%x" % (os.getpid(), next(_SERVER_IDS))
+        self._start_t = time.monotonic()
         self._stop = threading.Event()
         self._thread = None
         self._res_thread = None       # rescheck token for the loop thread
@@ -372,6 +409,7 @@ class LlamaServer:
         self.scheduler.swap(runner2, arena2)
         self.geometry, self.runner, self.arena = g2, runner2, arena2
         self.bundle_path = path
+        self.bundle_sha = _bundle_sha(path)
         self.scheduler.hold_admission(False)
         if _metrics.enabled():
             _metrics.counter(
@@ -428,6 +466,9 @@ class LlamaServer:
         st.update({
             "ok": self.healthy(),
             "draining": self._draining,
+            "bundle_sha": self.bundle_sha,
+            "server_id": self.server_id,
+            "uptime_s": round(time.monotonic() - self._start_t, 3),
             "loop_restarts": self._loop_restarts,
             "last_loop_error": self._last_loop_error,
             "queue_depth": st["queue_len"],
@@ -559,13 +600,42 @@ class LlamaServer:
                 self.end_headers()
                 self.wfile.write(payload)
 
+            def _await_or_cancel(self, req, timeout):
+                """Wait for the result while watching the client socket:
+                a connection closed mid-decode cancels the request (its
+                pages free at the next step boundary) instead of burning
+                decode steps for a reader that is gone.  True = settled
+                or timed out, False = client disconnected."""
+                t_end = time.monotonic() + timeout
+                while not req._done.wait(0.05):
+                    if time.monotonic() >= t_end:
+                        return True
+                    try:
+                        r, _, _ = select.select([self.connection], [], [],
+                                                0)
+                        gone = bool(r) and self.connection.recv(
+                            1, socket.MSG_PEEK) == b""
+                    except (OSError, ValueError):
+                        gone = True
+                    if gone:
+                        server.cancel(req.trace_id)
+                        return False
+                return True
+
             def do_GET(self):
                 if self.path == "/metrics":
                     self._send(200, _metrics.prometheus_text(),
                                ctype="text/plain; version=0.0.4")
                 elif self.path == "/healthz":
                     body = server.healthz()
-                    self._send(200 if body["ok"] else 503, body)
+                    if body["ok"]:
+                        self._send(200, body)
+                    else:
+                        # not-ok/draining 503s back off external load
+                        # balancers exactly like queue-full ones do
+                        self._send(503, body, headers={
+                            "Retry-After": _retry_after_header(
+                                server.scheduler.retry_after_s())})
                 elif self.path.startswith("/v1/trace/"):
                     tid = self.path[len("/v1/trace/"):]
                     tr = server.scheduler.trace(tid)
@@ -592,8 +662,8 @@ class LlamaServer:
                         deadline_s=doc.get("deadline_s"))
                 except (ServeDraining, ServeQueueFull) as e:
                     self._send(503, {"error": str(e)},
-                               headers={"Retry-After":
-                                        str(getattr(e, "retry_after_s", 1))})
+                               headers={"Retry-After": _retry_after_header(
+                                   getattr(e, "retry_after_s", 1))})
                     return
                 except ServeInternalError as e:  # loop gave up: refusing
                     self._send(503, {"error": str(e)})
@@ -606,8 +676,11 @@ class LlamaServer:
                     # budget over max context): client error, not a 500
                     self._send(400, {"error": str(req.error)})
                     return
+                if not self._await_or_cancel(req,
+                                             doc.get("timeout", 300)):
+                    return  # client went away: request cancelled
                 try:
-                    tokens = req.result(timeout=doc.get("timeout", 300))
+                    tokens = req.result(timeout=0.001)
                 except MXNetError as e:
                     self._send(_error_code(req.error or e),
                                {"error": str(e),

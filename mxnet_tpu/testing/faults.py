@@ -37,6 +37,27 @@ site               where / ctx
                    action is swallowed and turned into
                    ``Request.cancel()`` — the deterministic stand-in for
                    "the client went away"
+``fleet_probe``    ``FleetRouter._probe_one`` before calling the replica's
+                   healthz; ctx: ``replica``.  A raising action is one
+                   failed probe — enough of them in a row trip the
+                   per-replica circuit breaker
+``fleet_forward``  ``FleetRouter._generate`` after picking a replica,
+                   before forwarding; ctx: ``replica``, ``attempt``.  A
+                   raising action exercises the retry-on-a-different-
+                   replica path
+``replica_kill``   ``LocalReplica.submit`` before enqueueing; ctx:
+                   ``replica``.  ``kill_loop`` here is the deterministic
+                   stand-in for the replica *process* dying: the wrapper
+                   routes it through loop-crash containment (in-flight
+                   work fails typed, healthz flips sticky not-ok) and
+                   raises a transport error to the router
+``replica_hang``   ``LocalReplica.submit``; ctx: ``replica``.  A raising
+                   action makes the replica swallow the request — it is
+                   "accepted" but never completes, the scenario hedging
+                   exists for
+``replica_slow``   ``LocalReplica.submit``; ctx: ``replica``.  Pair with
+                   ``delay`` to model a straggler replica the router
+                   should route away from
 =================  ==========================================================
 
 Rule fields (all optional except ``site`` and ``action``):

@@ -70,6 +70,17 @@ _STUB_VALUES = {"train": 100.0, "infer": 200.0, "bert": 300.0,
                                 "paged_attn_hbm_bytes_ratio": 0.6,
                                 "completed": 64, "n_requests": 64,
                                 "live_compiles": 0},
+                # fleet runner (ISSUE 18): aggregate 3-replica tok/s as
+                # value, the N=1 router-vs-direct routing overhead and
+                # fleet TTFT p99 as extras
+                "fleet": {"value": 2800.0, "n_replicas": 3,
+                          "ttft_p99_ms": 60.0, "completed": 64,
+                          "n_requests": 64, "retried": 0,
+                          "ejections": 0, "dropped": 0,
+                          "direct_tok_s": 1000.0,
+                          "router1_tok_s": 980.0,
+                          "routing_overhead_pct": 2.0,
+                          "live_compiles": 0},
                 # planner runner (ISSUE 11): median plan seconds as
                 # value, the ms-precision figure rides along
                 "planner": {"value": 0.0, "planner_ms": 0.9,
@@ -126,6 +137,7 @@ def test_default_mode_emits_all_metrics_in_one_line(monkeypatch, capsys):
                      "llama_serve_tok_s",
                      "llama_serve_spec_tok_s",
                      "llama_serve_paged_tok_s",
+                     "fleet_serve_tok_s",
                      "planner_seconds",
                      "resnet50_cold_start_seconds",
                      "bert_cold_start_seconds",
@@ -189,6 +201,17 @@ def test_default_mode_emits_all_metrics_in_one_line(monkeypatch, capsys):
     assert spag["paged_attn_hbm_bytes_ratio"] == 0.6
     assert spag["parity_checked"] == 64
     assert spag["live_compiles"] == 0
+    # fleet record (ISSUE 18): aggregate tok/s over 3 replicas is the
+    # value; the N=1 router-vs-direct overhead (acceptance: within 5%)
+    # and the zero-loss counters ride along
+    fleet = by_name["fleet_serve_tok_s"]
+    assert fleet["value"] == 2800.0 and fleet["unit"] == "tokens/sec"
+    assert fleet["n_replicas"] == 3
+    assert fleet["routing_overhead_pct"] == 2.0
+    assert fleet["direct_tok_s"] == 1000.0
+    assert fleet["router1_tok_s"] == 980.0
+    assert fleet["dropped"] == 0 and fleet["ejections"] == 0
+    assert fleet["live_compiles"] == 0
     # planner record (ISSUE 11): static analysis latency, LOWER better;
     # the ms-precision figure survives the 2-decimal value rounding
     plan = by_name["planner_seconds"]
@@ -207,7 +230,7 @@ def test_budget_exhaustion_marks_skipped(monkeypatch, capsys):
                       if ln.startswith("{")][-1])
     assert rec["value"] == 100.0  # headline always measured
     skipped = [m for m in rec["metrics"] if m.get("skipped")]
-    assert len(skipped) == 15
+    assert len(skipped) == 16
     assert all(m["value"] == 0.0 for m in skipped)
 
 
@@ -241,6 +264,7 @@ def test_failed_benchmark_emits_zero_not_crash(monkeypatch, capsys):
                        None),
         "serve_paged": (boom, "llama_serve_paged_tok_s", "tokens/sec",
                         None),
+        "fleet": (boom, "fleet_serve_tok_s", "tokens/sec", None),
         "planner": (boom, "planner_seconds", "seconds", None),
         "cold_resnet50": (boom, "resnet50_cold_start_seconds", "seconds",
                           None),
@@ -252,4 +276,4 @@ def test_failed_benchmark_emits_zero_not_crash(monkeypatch, capsys):
     rec = json.loads([ln for ln in capsys.readouterr().out.splitlines()
                       if ln.startswith("{")][-1])
     assert rec["value"] == 0.0 and rec["fallback"] is True
-    assert len(rec["metrics"]) == 16
+    assert len(rec["metrics"]) == 17

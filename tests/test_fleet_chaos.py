@@ -1,0 +1,249 @@
+"""Seeded fleet chaos matrix (ISSUE 18): deterministic fault injection
+across a 3-replica fleet, every scenario run TWICE per seed asserting
+identical outcomes — same completions, same typed failures, same
+injection event log — plus leak-free arenas on every replica.
+
+Determinism recipe (mirrors ``tests/test_serve_chaos.py``):
+``from_parts`` servers with a one-hot numpy runner, a counter clock on
+the router, ``poller=False`` (the test drives ``probe_all()``), a no-op
+``sleep``, a seeded router RNG, and fault rules that use ``times`` /
+``match`` only (no wall-clock, no probability coins).  Requests are
+issued sequentially and blocking, so routing decisions depend only on
+probe state and the seeded RNG.
+
+Override the seed with ``MXNET_CHAOS_SEED`` (the CI chaos job pins it);
+any failure reproduces from the seed alone.
+"""
+import itertools
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serve import (FleetRouter, LocalReplica, PagedKVArena)
+from mxnet_tpu.serve.model import KVGeometry
+from mxnet_tpu.serve.server import LlamaServer
+from mxnet_tpu.testing import faults
+from mxnet_tpu.testing.faults import FaultPlan
+
+SEED = int(os.environ.get("MXNET_CHAOS_SEED", "1337"))
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    yield
+    faults.uninstall()
+
+
+def tiny_geometry(**over):
+    kw = dict(num_layers=1, num_heads=2, num_kv_heads=1, head_dim=4,
+              units=8, hidden_size=16, vocab_size=32, page_size=4,
+              num_pages=9, max_pages_per_seq=4, max_batch=2,
+              prefill_buckets=(4, 8))
+    kw.update(over)
+    return KVGeometry(**kw)
+
+
+class ChaosRunner:
+    """One-hot logits at (calls + lane) % vocab: token streams are a
+    pure function of how many runner calls came before."""
+
+    def __init__(self, g):
+        self.g = g
+        self.calls = 0
+
+    def _logits(self, n):
+        out = np.zeros((n, self.g.vocab_size), dtype=np.float32)
+        for i in range(n):
+            out[i, (self.calls + i) % self.g.vocab_size] = 1.0
+        self.calls += 1
+        return out
+
+    def prefill(self, bucket, tokens, length, block_row):
+        return self._logits(1)[0]
+
+    def decode(self, tokens, positions, block_tables):
+        return self._logits(self.g.max_batch)
+
+
+def counter_clock(step=0.01):
+    counter = itertools.count()
+    return lambda: next(counter) * step
+
+
+def chaos_reload(srv):
+    """Scripted hot-swap (from_parts servers have no bundle file)."""
+    def fn(path, timeout):
+        g = srv.geometry
+        done = threading.Event()
+        with srv._swap_lock:
+            srv._pending_swap = (g, ChaosRunner(g), PagedKVArena(g),
+                                 path, done)
+        srv.scheduler.kick()
+        assert done.wait(timeout), "swap never landed"
+    return fn
+
+
+def run_fleet_scenario(rules, n_requests=12, hedge=False, deploy_at=None,
+                       eject_after=2, readmit_after_s=0.1, retries=2):
+    """One fleet chaos run.  Returns (outcomes, events, counters) —
+    everything the run-twice identity assertions compare."""
+    servers, reps = [], []
+    for i in range(3):
+        g = tiny_geometry()
+        srv = LlamaServer.from_parts(ChaosRunner(g), PagedKVArena(g),
+                                     queue_depth=8)
+        srv.start()
+        servers.append(srv)
+        reps.append(LocalReplica(srv, name="r%d" % i,
+                                 reload_fn=chaos_reload(srv)))
+    router = FleetRouter(
+        reps, probe_interval=0, retries=retries, backoff_s=0.001,
+        hedge=hedge, hedge_delay_s=0.01 if hedge else None,
+        eject_after=eject_after, readmit_after_s=readmit_after_s,
+        seed=SEED, clock=counter_clock(), sleep=lambda s: None)
+    plan = faults.install(FaultPlan(seed=SEED, rules=rules))
+    outcomes = []
+    try:
+        router.start(poller=False)
+        for i in range(n_requests):
+            if deploy_at is not None and i == deploy_at:
+                report = router.rolling_deploy("bundle-b", timeout=30)
+                outcomes.append(("deploy", report["converged"],
+                                 report["dropped"]))
+            try:
+                toks = router.generate([1 + (i % 8), 2],
+                                       max_new_tokens=3, timeout=60)
+                outcomes.append(("ok", tuple(toks)))
+            except (MXNetError, faults.FaultInjected) as e:
+                outcomes.append((type(e).__name__,))
+            router.probe_all()
+        events = [(e["site"], e["action"], e["rule"],
+                   e["ctx"].get("replica")) for e in plan.events]
+        counters = dict(completed=router.completed, failed=router.failed,
+                        retried=router.retried, hedged=router.hedged,
+                        ejections=router.ejections, dropped=router.dropped)
+    finally:
+        faults.uninstall()
+        router.stop()
+        for srv in servers:
+            srv.drain(timeout=10)
+            srv.stop()
+            srv.arena.assert_quiescent()   # leak-free under chaos
+    return outcomes, events, counters
+
+
+def assert_twice_identical(**kw):
+    """The headline guarantee: the whole run is a pure function of the
+    seed.  Returns the (shared) first run for further assertions."""
+    a = run_fleet_scenario(**kw)
+    b = run_fleet_scenario(**kw)
+    assert a == b, "chaos run diverged for seed %d" % SEED
+    return a
+
+
+# -- scenarios -----------------------------------------------------------
+
+def test_baseline_no_faults_all_complete():
+    outcomes, events, counters = assert_twice_identical(rules=[])
+    assert events == []
+    assert all(o[0] == "ok" for o in outcomes)
+    assert counters["completed"] == len(outcomes)
+    assert counters["failed"] == counters["dropped"] == 0
+
+
+def test_replica_kill_retries_and_ejects():
+    outcomes, events, counters = assert_twice_identical(
+        rules=[{"site": "replica_kill", "action": "kill_loop",
+                "match": {"replica": "r1"}, "times": 1}],
+        eject_after=1)
+    # the kill is retried on another replica: no request is lost
+    assert all(o[0] == "ok" for o in outcomes)
+    assert counters["retried"] >= 1
+    assert counters["ejections"] == 1   # dead transport tripped breaker
+    assert counters["failed"] == 0
+    assert [e[0] for e in events] == ["replica_kill"]
+
+
+def test_replica_hang_hedge_completes_request():
+    outcomes, events, counters = assert_twice_identical(
+        rules=[{"site": "replica_hang", "action": "raise",
+                "match": {"replica": "r0"}, "times": 1}],
+        hedge=True)
+    assert all(o[0] == "ok" for o in outcomes)
+    assert counters["hedged"] >= 1   # the hang forced exactly this path
+    assert counters["failed"] == 0
+    assert [e[0] for e in events] == ["replica_hang"]
+
+
+def test_replica_slow_delays_but_completes():
+    outcomes, events, counters = assert_twice_identical(
+        rules=[{"site": "replica_slow", "action": "delay",
+                "delay": 0.02, "times": 3}])
+    assert all(o[0] == "ok" for o in outcomes)
+    assert counters["retried"] == 0   # slow is not broken
+    assert len(events) == 3
+
+
+def test_probe_faults_eject_then_readmit():
+    outcomes, events, counters = assert_twice_identical(
+        rules=[{"site": "fleet_probe", "action": "raise",
+                "match": {"replica": "r2"}, "times": 3}],
+        n_requests=16)
+    assert all(o[0] == "ok" for o in outcomes)   # fleet absorbs it
+    assert counters["ejections"] >= 1
+    # after the rule dries up, the half-open probe re-admitted r2: the
+    # last requests still complete and nothing was dropped
+    assert counters["dropped"] == 0
+    assert all(e[0] == "fleet_probe" for e in events) and len(events) == 3
+
+
+def test_forward_faults_are_retried_on_other_replicas():
+    outcomes, events, counters = assert_twice_identical(
+        rules=[{"site": "fleet_forward", "action": "raise", "times": 2}])
+    assert all(o[0] == "ok" for o in outcomes)
+    assert counters["retried"] == 2
+    assert counters["failed"] == 0
+    assert [e[0] for e in events] == ["fleet_forward", "fleet_forward"]
+
+
+def test_rolling_deploy_under_load_drops_nothing():
+    outcomes, events, counters = assert_twice_identical(
+        rules=[], deploy_at=6, n_requests=12)
+    deploys = [o for o in outcomes if o[0] == "deploy"]
+    assert deploys == [("deploy", True, 0)]   # converged, zero dropped
+    assert all(o[0] in ("ok", "deploy") for o in outcomes)
+    assert counters["failed"] == counters["dropped"] == 0
+
+
+def test_fleet_wide_outage_fails_typed_then_recovers():
+    # every probe fails twice: the whole fleet ejects, requests fail
+    # *typed* (FleetNoHealthyReplica), and the half-open breakers
+    # re-admit replicas so later requests complete
+    outcomes, events, counters = assert_twice_identical(
+        rules=[{"site": "fleet_probe", "action": "raise", "times": 6}],
+        n_requests=16, eject_after=2, readmit_after_s=0.05, retries=1)
+    assert all(o[0] in ("ok", "FleetNoHealthyReplica") for o in outcomes)
+    assert counters["ejections"] == 3
+    assert outcomes[-1][0] == "ok"   # the fleet came back
+    assert counters["dropped"] == 0
+
+
+def test_compound_storm_every_request_settles():
+    outcomes, events, counters = assert_twice_identical(
+        rules=[
+            {"site": "replica_kill", "action": "kill_loop",
+             "match": {"replica": "r0"}, "times": 1},
+            {"site": "fleet_probe", "action": "raise",
+             "match": {"replica": "r1"}, "times": 2},
+            {"site": "fleet_forward", "action": "raise", "times": 1},
+        ],
+        n_requests=16, eject_after=2)
+    # no hung futures, no silent losses: every request settled, and the
+    # surviving capacity completed them all
+    assert len([o for o in outcomes if o[0] == "ok"]) \
+        + counters["failed"] == 16
+    assert counters["dropped"] == 0
+    assert len(events) == 4

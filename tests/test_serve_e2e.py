@@ -579,6 +579,94 @@ def test_http_drain_503_with_retry_after_and_healthz_flip(bundle):
         assert json.loads(ei.value.read())["draining"] is True
 
 
+# -- fleet front over real bundles (ISSUE 18) ----------------------------
+
+def test_fleet_routes_with_greedy_parity_and_shared_sha(bundle):
+    path, net, _ = bundle
+    servers = [serve.LlamaServer(path).start() for _ in range(2)]
+    router = serve.FleetRouter(servers, probe_interval=0, seed=0)
+    try:
+        router.start(poller=False)
+        for p in ([3, 1, 4], [2, 7], [5]):
+            assert router.generate(p, max_new_tokens=5, timeout=120) \
+                == greedy_reference(net, p, 5)
+        body = router.healthz()
+        shas = {st["bundle_sha"] for st in body["replicas"].values()}
+        assert len(shas) == 1 and None not in shas   # one bundle, fleetwide
+        assert body["replicas_healthy"] == 2
+    finally:
+        router.stop()
+        for srv in servers:
+            srv.drain(timeout=30)
+            srv.stop()
+            srv.arena.assert_quiescent()
+
+
+def test_fleet_rolling_deploy_real_bundles_mid_stream(bundle, bundle_b):
+    path_a, net_a, _ = bundle
+    path_b, net_b, _ = bundle_b
+    servers = [serve.LlamaServer(path_a).start() for _ in range(2)]
+    router = serve.FleetRouter(servers, probe_interval=0, seed=0)
+    try:
+        router.start(poller=False)
+        prompts = _mixed_prompts(8, 6)
+        inflight = [router.submit(p, max_new_tokens=6, timeout=120)
+                    for p in prompts]
+        report = router.rolling_deploy(path_b, timeout=120)
+        assert report["converged"] and report["dropped"] == 0
+        # in-flight work settled — some on A's weights (pre-swap), the
+        # rest routed around the deploy — but NOTHING was dropped
+        outs = [f.result(timeout=120) for f in inflight]
+        for p, o in zip(prompts, outs):
+            assert o in (greedy_reference(net_a, p, 6),
+                         greedy_reference(net_b, p, 6))
+        # post-deploy traffic runs on bundle B's weights everywhere
+        for p in prompts[:3]:
+            assert router.generate(p, max_new_tokens=6, timeout=120) \
+                == greedy_reference(net_b, p, 6)
+    finally:
+        router.stop()
+        for srv in servers:
+            srv.drain(timeout=30)
+            srv.stop()
+            srv.arena.assert_quiescent()
+
+
+def test_healthz_identity_fields_over_http(bundle):
+    path, _, _ = bundle
+    with serve.LlamaServer(path) as srv:
+        host, port = srv.serve_http(port=0)
+        with urllib.request.urlopen(
+                "http://%s:%d/healthz" % (host, port), timeout=30) as r:
+            body = json.loads(r.read())
+        assert body["server_id"].startswith("srv-")
+        assert body["uptime_s"] >= 0.0
+        sha = body["bundle_sha"]
+        assert isinstance(sha, str) and len(sha) == 16
+        int(sha, 16)   # hex digest prefix
+
+
+def test_fleet_cli_sigterm_drains_and_exits_clean(bundle):
+    import signal as _signal
+
+    path, _, _ = bundle
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-m", "mxnet_tpu.serve",
+         "--bundle", path, "--port", "0", "--fleet", "2",
+         "--drain-timeout", "10"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert "serving fleet n=2" in line, line
+        proc.send_signal(_signal.SIGTERM)
+        assert proc.wait(timeout=120) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
 def test_sigterm_drains_and_exits_clean(bundle):
     import signal as _signal
     import time as _time
