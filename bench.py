@@ -1187,6 +1187,190 @@ def _run_serve_paged(platform):
             "live_compiles": doc["live_compiles"]}
 
 
+# prefix-cache bench workload: the chat-service shape the radix cache
+# exists for — most requests open with the SAME long system prompt
+_PREFIX_SYSTEM_TOKENS = 2048
+_PREFIX_SHARE = 0.8
+
+
+def _serve_prefix_export(path):
+    """Subprocess entry (`--serve-prefix-export <path>`): AOT-compile
+    the llama_small bundle for the prefix-cache bench.  Chunked prefill
+    (``prefill_chunk=32``) is what makes the 2k system prompt servable
+    at all here: the bucket ladder stops at 32, so over-bucket prompts
+    prefill in fixed-shape chunks and the radix cache splices everything
+    but the per-request tail.  The arena is sized so the CACHE-OFF side
+    can hold a full batch of unshared 2k contexts — the comparison must
+    measure splicing, not cache-off page starvation."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, serve
+    from mxnet_tpu.gluon.model_zoo import llama
+
+    mx.random.seed(0)
+    net = llama.llama_small()
+    net.initialize()
+    net(nd.array(np.zeros((1, 4), np.int32)))
+    g = serve.export_serving_bundle(net, path, page_size=16,
+                                    num_pages=1400, max_batch=8,
+                                    prefill_buckets=(16, 32),
+                                    prefill_chunk=32)
+    _log("serve prefix export: %s" % g.describe())
+    print("SERVE_PREFIX_EXPORT_OK", flush=True)
+
+
+def _prefix_workload(seed=0):
+    """Seeded 64-request workload: 80% open with the same 2048-token
+    system prompt plus a short unique tail, 20% are fully unique.
+    Returns ``[(arrival_s, Request, is_shared)]``."""
+    from mxnet_tpu.serve import Request
+
+    rng = np.random.default_rng(seed)
+    system = rng.integers(0, 512, size=_PREFIX_SYSTEM_TOKENS).tolist()
+    arrivals = np.cumsum(rng.exponential(1.0 / 2000.0,
+                                         size=_SERVE_N_REQUESTS))
+    out = []
+    for i in range(_SERVE_N_REQUESTS):
+        shared = bool(rng.random() < _PREFIX_SHARE)
+        if shared:
+            tail = rng.integers(0, 512,
+                                size=int(rng.integers(4, 9))).tolist()
+            prompt = system + tail
+        else:
+            prompt = rng.integers(0, 512,
+                                  size=int(rng.integers(16, 33))).tolist()
+        out.append((float(arrivals[i]),
+                    Request(prompt, max_new_tokens=int(
+                        rng.integers(4, 9))), shared))
+    return out
+
+
+def _serve_prefix_probe(path):
+    """Subprocess entry (`--serve-prefix-probe <bundle>`): radix prefix
+    cache on vs off on the SAME bundle, same seeded shared-prefix
+    workload, token-for-token parity asserted here.
+
+    Each side replays the workload ``_SERVE_REPLAYS`` times on a FRESH
+    server (cold cache every replay, so the cache-on numbers include
+    the first request's cold miss) and reports the median.  The TTFT
+    split is the headline latency story: cache-on shared requests after
+    the first (splice + tail-only prefill) vs cache-off shared requests
+    (full 2k chunked prefill).  Greedy decoding plus the arena purity
+    invariant mean the two sides must emit identical streams — a parity
+    break zeroes the metric instead of shipping a wrong speedup.  The
+    process must perform zero live compiles."""
+    from mxnet_tpu import serve
+    from mxnet_tpu.telemetry import metrics as telemetry_metrics
+
+    def one_side(cache_on):
+        os.environ["MXNET_SERVE_PREFIX_CACHE"] = "1" if cache_on else "0"
+        rates, shared_ttfts, streams, stats = [], [], None, None
+        for _ in range(_SERVE_REPLAYS):
+            srv = serve.LlamaServer(path).start()  # fresh: cold cache
+            wl = _prefix_workload(seed=0)
+            reqs, wall = serve.drive_workload(
+                srv, [(a, r) for a, r, _ in wl], timeout=600)
+            done = [r for r in reqs if r.error is None]
+            rates.append(sum(len(r.tokens) for r in done) / wall)
+            shared_done = [r for _, r, s in wl
+                           if s and r.error is None
+                           and r.first_token_t is not None]
+            # the first shared request pays the cold miss that fills
+            # the cache: it belongs to the cold sample, not the cached
+            sample = shared_done[1:] if cache_on else shared_done
+            shared_ttfts.extend(r.first_token_t - r.submit_t
+                                for r in sample)
+            if streams is None:
+                streams = [list(r.tokens) for r in reqs]
+            stats = srv.stats()
+            srv.stop()
+        return _median(rates), shared_ttfts, streams, stats
+
+    on_rate, on_ttfts, on_streams, on_stats = one_side(True)
+    off_rate, off_ttfts, off_streams, _ = one_side(False)
+
+    mismatched = sum(1 for a, b in zip(on_streams, off_streams)
+                     if a != b)
+    if mismatched:
+        raise AssertionError(
+            "prefix cache changed %d/%d request token streams vs "
+            "cache-off on the same bundle"
+            % (mismatched, len(on_streams)))
+
+    snap = telemetry_metrics.snapshot()
+    compiles = sum(s["value"] for s in snap.get(
+        "mxnet_compiles_total", {}).get("series", []))
+
+    def p50(vals):
+        return sorted(vals)[len(vals) // 2] if vals else 0.0
+
+    doc = {
+        "prefix_tok_s": round(on_rate, 2),
+        "prefix_off_tok_s": round(off_rate, 2),
+        "hit_rate": round(on_stats["prefix_hit_rate"], 4),
+        "cached_tokens": int(on_stats["prefix_cached_tokens"]),
+        "ttft_cached_p50_ms": round(p50(on_ttfts) * 1e3, 2),
+        "ttft_cold_p50_ms": round(p50(off_ttfts) * 1e3, 2),
+        "parity_checked": len(on_streams),
+        "completed": sum(1 for t in on_streams if t),
+        "n_requests": _SERVE_N_REQUESTS,
+        "live_compiles": int(compiles),
+    }
+    print("SERVE_PREFIX_RESULT=%s" % json.dumps(doc), flush=True)
+
+
+def _run_serve_prefix(platform):
+    """`llama_serve_prefix_tok_s`: cross-request KV reuse (ISSUE 19) on
+    a shared-prefix workload — 64 requests, 80% opening with the same
+    2048-token system prompt — cache-on vs cache-off on the same
+    bundle.
+
+    Two fresh subprocesses: ``--serve-prefix-export`` compiles the
+    chunk-capable bundle (paying every jit), then
+    ``--serve-prefix-probe`` serves the workload both ways with token
+    parity asserted between the sides.  The metric value is cache-on
+    tok/s; the off baseline, the hit rate, and the cached-vs-cold TTFT
+    p50 split ride along."""
+    import shutil
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="mxnet-serve-prefix-bench-")
+    try:
+        bundle = os.path.join(tmp, "llama_small_prefix.mxaot")
+        env = dict(os.environ)
+        env.pop("MXNET_SERVE_PREFIX_CACHE", None)  # probe owns the knob
+        _probe_subprocess(["--serve-prefix-export", bundle], env,
+                          "SERVE_PREFIX_EXPORT_OK", "serve prefix export")
+        doc = json.loads(_probe_subprocess(
+            ["--serve-prefix-probe", bundle], env, "SERVE_PREFIX_RESULT=",
+            "serve prefix"))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    off = doc["prefix_off_tok_s"]
+    speedup = round(doc["prefix_tok_s"] / off, 2) if off else 0.0
+    cached = doc["ttft_cached_p50_ms"]
+    ttft_speedup = (round(doc["ttft_cold_p50_ms"] / cached, 2)
+                    if cached else 0.0)
+    _log("serve prefix: %.1f tok/s cache-on vs %.1f cache-off (%.2fx), "
+         "hit rate %.2f, ttft p50 cached/cold %.1f/%.1f ms (%.1fx), "
+         "%d/%d completed, %d live compiles"
+         % (doc["prefix_tok_s"], off, speedup, doc["hit_rate"],
+            doc["ttft_cached_p50_ms"], doc["ttft_cold_p50_ms"],
+            ttft_speedup, doc["completed"], doc["n_requests"],
+            doc["live_compiles"]))
+    return {"value": doc["prefix_tok_s"],
+            "prefix_off_tok_s": off,
+            "prefix_vs_off": speedup,
+            "hit_rate": doc["hit_rate"],
+            "cached_tokens": doc["cached_tokens"],
+            "ttft_cached_p50_ms": doc["ttft_cached_p50_ms"],
+            "ttft_cold_p50_ms": doc["ttft_cold_p50_ms"],
+            "ttft_cached_vs_cold": ttft_speedup,
+            "parity_checked": doc["parity_checked"],
+            "completed": doc["completed"],
+            "n_requests": doc["n_requests"],
+            "live_compiles": doc["live_compiles"]}
+
+
 def _fleet_probe(path):
     """Subprocess entry (`--fleet-probe <bundle>`): fleet-front serving
     throughput over N=3 in-process replicas of the SAME AOT bundle.
@@ -1403,6 +1587,10 @@ _SPECS = {
     # kernel-on tok/s, the off baseline + memdump byte ratio ride along
     "serve_paged": (_run_serve_paged, "llama_serve_paged_tok_s",
                     "tokens/sec", None),
+    # radix prefix cache on vs off on a shared-prefix workload; value is
+    # cache-on tok/s, the off baseline + hit rate + TTFT split ride along
+    "prefix": (_run_serve_prefix, "llama_serve_prefix_tok_s",
+               "tokens/sec", None),
     # fleet front over 3 replicas of the same bundle; value is aggregate
     # tok/s, the N=1 routing-overhead comparison rides along
     "fleet": (_run_fleet, "fleet_serve_tok_s", "tokens/sec", None),
@@ -1484,6 +1672,12 @@ def main():
     if len(sys.argv) >= 3 and sys.argv[1] == "--serve-paged-probe":
         _serve_paged_probe(sys.argv[2])  # subprocess: on/off + parity
         return
+    if len(sys.argv) >= 3 and sys.argv[1] == "--serve-prefix-export":
+        _serve_prefix_export(sys.argv[2])  # subprocess: chunk-bundle jits
+        return
+    if len(sys.argv) >= 3 and sys.argv[1] == "--serve-prefix-probe":
+        _serve_prefix_probe(sys.argv[2])  # subprocess: cache on/off+parity
+        return
     if len(sys.argv) >= 3 and sys.argv[1] == "--fleet-probe":
         _fleet_probe(sys.argv[2])  # subprocess: 3-replica fleet front
         return
@@ -1511,7 +1705,8 @@ def main():
     for name in ("infer", "bert", "llama", "dispatch_eager",
                  "dispatch_eager_notelemetry", "dispatch_bulked",
                  "dispatch_bulked_train", "dispatch_bulked_long",
-                 "serve", "serve_spec", "serve_paged", "fleet", "planner",
+                 "serve", "serve_spec", "serve_paged", "prefix", "fleet",
+                 "planner",
                  "cold_resnet50", "cold_bert",
                  "cold_llama"):
         elapsed = time.perf_counter() - t_start

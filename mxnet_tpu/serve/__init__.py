@@ -24,6 +24,15 @@ typed errors, graceful drain (503 + Retry-After), AOT bundle hot-swap
 (``LlamaServer.reload``), serve-loop crash containment, and seeded
 chaos coverage (``tests/test_serve_chaos.py``).
 
+ISSUE 19 added cross-request KV reuse (docs/serving.md "Prefix caching,
+sessions & chunked prefill"): a radix-tree :class:`PrefixCache` splices
+already-prefilled prompt pages into new requests' block tables
+(refcounted sharing over the arena's owner-checked free list), pinned
+multi-turn chat sessions (``POST /v1/chat``) that prefill only each
+turn's delta, and chunked prefill (``prefill_chunk``) that interleaves
+long prompts with decode steps — greedy output stays token-for-token
+identical cache-on vs cache-off.
+
 ISSUE 18 lifted those per-replica primitives to a fleet
 (:mod:`.fleet`, docs/serving.md "Fleet serving"): a
 :class:`FleetRouter` HTTP front over N replicas with queue-depth-aware
@@ -48,9 +57,11 @@ from .fleet import (FleetNoHealthyReplica, FleetRouter, HttpReplica,
                     LocalReplica, fleet_drive_workload)
 from .model import (KVGeometry, check_geometry, export_serving_bundle,
                     geometry_from_net, load_serving_executables)
+from .prefix import PrefixCache
 from .scheduler import (Request, Scheduler, ServeCancelled,
                         ServeDeadlineExceeded, ServeDraining,
-                        ServeInternalError, ServeQueueFull, ServeShutdown,
+                        ServeInternalError, ServeQueueFull,
+                        ServeSessionBusy, ServeSessionUnknown, ServeShutdown,
                         clamp_retry_after, greedy_sampler)
 from .server import (AOTRunner, LlamaServer, drive_workload,
                      poisson_workload)
@@ -59,9 +70,10 @@ from .spec import NgramProposer, propose_ngram
 __all__ = [
     "AOTRunner", "FleetNoHealthyReplica", "FleetRouter", "HttpReplica",
     "KVGeometry", "LlamaServer", "LocalReplica", "NgramProposer",
-    "PagedKVArena", "Request",
+    "PagedKVArena", "PrefixCache", "Request",
     "Scheduler", "ServeCancelled", "ServeDeadlineExceeded",
     "ServeDraining", "ServeInternalError", "ServeQueueFull",
+    "ServeSessionBusy", "ServeSessionUnknown",
     "ServeShutdown", "check_geometry", "clamp_retry_after",
     "drive_workload", "export_serving_bundle", "fleet_drive_workload",
     "geometry_from_net", "greedy_sampler",

@@ -3,7 +3,8 @@
 Loads the AOT serving bundle (zero live compiles), starts the
 continuous-batching loop, and exposes the stdlib HTTP front:
 ``POST /v1/generate {"prompt": [...ids], "max_new_tokens": n}``,
-``GET /metrics`` (Prometheus), ``GET /healthz`` (scheduler stats).
+``POST /v1/chat`` (multi-turn, pinned sessions), ``GET /metrics``
+(Prometheus), ``GET /healthz`` (scheduler stats).
 
 ``--fleet N`` (N > 1) starts N in-process replicas behind a
 :class:`FleetRouter` front instead — queue-depth-aware routing, bounded
@@ -19,6 +20,7 @@ fail stragglers typed, then exit.  Ctrl-C takes the same path.
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import threading
 
@@ -46,7 +48,18 @@ def main(argv=None):
                     help="seconds to let in-flight work finish on "
                          "SIGTERM/Ctrl-C (default: "
                          "MXNET_SERVE_DRAIN_TIMEOUT or 30)")
+    ap.add_argument("--no-prefix-cache", action="store_true",
+                    help="disable cross-request KV prefix sharing "
+                         "(sets MXNET_SERVE_PREFIX_CACHE=0)")
+    ap.add_argument("--session-ttl", type=float, default=None,
+                    help="idle seconds before a pinned /v1/chat session "
+                         "is evicted (default: MXNET_SERVE_SESSION_TTL "
+                         "or 600)")
     args = ap.parse_args(argv)
+    if args.no_prefix_cache:
+        os.environ["MXNET_SERVE_PREFIX_CACHE"] = "0"
+    if args.session_ttl is not None:
+        os.environ["MXNET_SERVE_SESSION_TTL"] = str(args.session_ttl)
     if args.fleet < 1:
         ap.error("--fleet must be >= 1")
 

@@ -87,7 +87,12 @@ class PagedKVArena:
                          label="arena.v_scale")
         # page 0 is the null page — never allocated
         self._free = collections.deque(range(1, geometry.num_pages))
-        self._owner = {}          # page id -> owner tag (request id)
+        # page id -> LIST of owner tags.  One entry per reference: the
+        # allocating request, plus (ISSUE 19) the prefix cache and any
+        # session or spliced request sharing the page.  refcount ==
+        # len(list); the page recycles only when the list empties, so
+        # ``free`` under sharing decrements instead of recycling.
+        self._owner = {}
         # MXNET_RESCHECK: one token per live allocation, keyed by its
         # first page (plain dict — loop-thread-only like _owner)
         self.res_scope = "arena:%x" % id(self)
@@ -135,31 +140,65 @@ class PagedKVArena:
         self.drain_pending_readers("serve_arena_alloc")
         pages = [self._free.popleft() for _ in range(n_pages)]
         for p in pages:
-            self._owner[p] = owner
+            self._owner[p] = [owner]
         if _rescheck.enabled():
             self._res[pages[0]] = _rescheck.acquire(
                 "arena", owner, scope=self.res_scope)
         self._gauges()
         return pages
 
-    def free(self, pages, owner=None):
-        """Return ``pages`` to the free list (idempotence guarded)."""
+    def retain(self, pages, owner):
+        """Add one reference per page for ``owner`` (prefix-cache splice,
+        session pin).  The pages must already be allocated — retaining a
+        free or null page is block-table corruption, not a cache miss."""
         for p in pages:
-            have = self._owner.pop(p, None)
-            if have is None or p == 0:
+            owners = self._owner.get(p)
+            if owners is None or p == 0:
+                raise MXNetError("retaining page %d that is not allocated"
+                                 % p)
+            owners.append(owner)
+        self._gauges()
+
+    def free(self, pages, owner=None):
+        """Drop one reference per page; recycle pages whose count hits 0.
+
+        Double frees stay guarded under sharing: the ``owner`` tag must
+        hold a reference on every page it frees, and a page recycles
+        exactly once — when its last reference goes (refcounted free
+        must not confuse the RL12xx page tracking, so the rescheck token
+        for an allocation group releases only when its first page truly
+        returns to the free list).
+        """
+        for p in pages:
+            owners = self._owner.get(p)
+            if owners is None or p == 0:
                 raise MXNetError("freeing page %d that is not allocated"
                                  % p)
-            if owner is not None and have != owner:
-                raise MXNetError(
-                    "page %d is owned by %r, not %r — double free or "
-                    "block-table corruption" % (p, have, owner))
-            self._free.append(p)
-        if pages:
-            _rescheck.release(self._res.pop(pages[0], None))
+            if owner is not None:
+                if owner not in owners:
+                    raise MXNetError(
+                        "page %d is owned by %r, not %r — double free or "
+                        "block-table corruption" % (p, owners, owner))
+                owners.remove(owner)
+            else:
+                owners.pop()
+            if not owners:
+                del self._owner[p]
+                self._free.append(p)
+                _rescheck.release(self._res.pop(p, None))
         self._gauges()
 
     def owner_of(self, page):
-        return self._owner.get(page)
+        owners = self._owner.get(page)
+        return owners[0] if owners else None
+
+    def refcount(self, page):
+        """Live references on ``page`` (0 when free / null)."""
+        return len(self._owner.get(page, ()))
+
+    def shared_pages(self):
+        """Pages currently referenced by more than one owner."""
+        return sum(1 for o in self._owner.values() if len(o) > 1)
 
     def assert_quiescent(self):
         """Leak check: every allocatable page is back on the free list
@@ -171,8 +210,9 @@ class PagedKVArena:
         problems = []
         if self._owner:
             by_owner = {}
-            for p, o in sorted(self._owner.items()):
-                by_owner.setdefault(o, []).append(p)
+            for p, owners in sorted(self._owner.items()):
+                for o in owners:
+                    by_owner.setdefault(o, []).append(p)
             problems.append("%d live page(s): %s" % (
                 len(self._owner),
                 ", ".join("owner %r holds %s" % (o, pages)
@@ -295,3 +335,8 @@ class PagedKVArena:
                 "mxnet_serve_arena_pages_in_use",
                 help="allocated KV pages (null page excluded)",
             ).set(self.total_pages - len(self._free))
+            _metrics.gauge(
+                "mxnet_serve_prefix_shared_pages",
+                help="arena pages held by more than one reference "
+                     "(prefix-cache hits, pinned sessions)",
+            ).set(self.shared_pages())

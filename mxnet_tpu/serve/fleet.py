@@ -14,7 +14,11 @@ shipped (drain + Retry-After, ``reload()`` hot-swap, sticky not-ok
   lower.  Two random choices beat both round-robin (ignores load) and
   global-minimum (herds onto one replica between probes).  A replica's
   ``Retry-After`` hint gates it out of the candidate set until the hint
-  expires.
+  expires.  ``submit(session=...)`` carries an ISSUE 19 chat-session
+  affinity hint: the turn prefers the replica whose arena pins the
+  session's pages (routing it anywhere else guarantees a
+  ``ServeSessionUnknown``), falling back to p2c when that replica is
+  ejected, draining, or gated.
 
 * **Bounded retries + hedging.**  Submit-time refusals (queue full,
   draining, dead loop, connection errors) retry on a *different*
@@ -75,8 +79,8 @@ from ..testing import lockcheck as _lockcheck
 from ..testing import rescheck as _rescheck
 from .scheduler import (Request, ServeCancelled, ServeDeadlineExceeded,
                         ServeDraining, ServeInternalError, ServeQueueFull,
-                        ServeShutdown, _env_float, _env_int,
-                        clamp_retry_after)
+                        ServeSessionBusy, ServeSessionUnknown, ServeShutdown,
+                        _env_float, _env_int, clamp_retry_after)
 
 __all__ = [
     "FleetRouter", "FleetNoHealthyReplica", "LocalReplica", "HttpReplica",
@@ -178,7 +182,7 @@ class LocalReplica:
         return self.server.healthz()
 
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
-               deadline_s=None):
+               deadline_s=None, session=None):
         _faults.maybe_inject("replica_slow", replica=self.name)
         try:
             _faults.maybe_inject("replica_kill", replica=self.name)
@@ -195,7 +199,7 @@ class LocalReplica:
             return _HungHandle(self.name)
         req = self.server.scheduler.submit(
             Request(prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
-                    deadline_s=deadline_s))
+                    deadline_s=deadline_s, session_id=session))
         return _LocalHandle(self, req)
 
     def cancel(self, trace_id):
@@ -213,8 +217,9 @@ class _HttpHandle:
     """An in-flight request on a remote replica: one daemon thread owns
     the blocking POST; the handle mirrors the Request-future surface."""
 
-    def __init__(self, replica, doc, timeout):
+    def __init__(self, replica, doc, timeout, path="/v1/generate"):
         self._replica = replica
+        self._path = path
         self.trace_id = None
         self.error = None
         self.ttft = None
@@ -229,7 +234,7 @@ class _HttpHandle:
         try:
             body = json.dumps(doc).encode()
             req = urllib.request.Request(
-                self._replica.base_url + "/v1/generate", data=body,
+                self._replica.base_url + self._path, data=body,
                 headers={"Content-Type": "application/json"})
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 out = json.loads(resp.read())
@@ -271,8 +276,11 @@ def _error_from_http(e):
     msg = "%s (HTTP %d)" % (detail or e.reason, e.code)
     if e.code == 504:
         return ServeDeadlineExceeded(msg)
+    if e.code == 404 and "session" in detail:
+        return ServeSessionUnknown(msg)
     if e.code == 409:
-        return ServeCancelled(msg)
+        return ServeSessionBusy(msg) if "session" in detail \
+            else ServeCancelled(msg)
     if e.code == 503:
         err = ServeDraining(msg) if "draining" in detail \
             else ServeQueueFull(msg)
@@ -303,9 +311,12 @@ class HttpReplica:
             return json.loads(e.read())
 
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
-               deadline_s=None):
+               deadline_s=None, session=None):
         doc = {"prompt": prompt, "max_new_tokens": max_new_tokens,
                "eos_id": eos_id, "deadline_s": deadline_s}
+        if session is not None:
+            doc["session"] = session
+            return _HttpHandle(self, doc, timeout=300, path="/v1/chat")
         return _HttpHandle(self, doc, timeout=300)
 
     def cancel(self, trace_id):
@@ -446,6 +457,11 @@ class FleetRouter:
         self._rng = random.Random(seed)
         self._lock = _lockcheck.named_lock("fleet.router")
         self._lat = collections.deque(maxlen=512)  # ok latencies (hedge p99)
+        # session -> replica-name affinity (bounded LRU): a pinned chat
+        # session's pages live on ONE replica, so routing its next turn
+        # anywhere else is a guaranteed ServeSessionUnknown
+        self._affinity = collections.OrderedDict()
+        self._affinity_cap = _env_int("MXNET_FLEET_AFFINITY_CAP", 4096)
         self._stop = threading.Event()
         self._poll_thread = None
         self._res_thread = None
@@ -600,12 +616,19 @@ class FleetRouter:
         # reacts between probes.  Unknown pace scores by depth alone.
         return (st.queue_depth + st.inflight) * max(st.tpot, 1e-3)
 
-    def _pick(self, exclude=()):
+    def _pick(self, exclude=(), prefer=None):
         now = self._clock()
         with self._lock:
             cands = [r for r in self._replicas
                      if r.name not in exclude
                      and self._routable(self._states[r.name], now)]
+            # session affinity: the pinning replica wins over p2c
+            # whenever it is routable at all (its cached pages beat a
+            # shorter queue elsewhere); ejected/draining falls through
+            preferred = None
+            if prefer is not None:
+                preferred = next((r for r in cands if r.name == prefer),
+                                 None)
             if not cands:
                 gates = [st.not_before_route - now
                          for st in self._states.values()
@@ -618,7 +641,9 @@ class FleetRouter:
                 err.retry_after_s = clamp_retry_after(
                     min(gates) if gates else 1.0)
                 raise err
-            if len(cands) == 1:
+            if preferred is not None:
+                chosen = preferred
+            elif len(cands) == 1:
                 chosen = cands[0]
             else:
                 a, b = self._rng.sample(cands, 2)
@@ -657,15 +682,48 @@ class FleetRouter:
                 st.not_before_route,
                 self._clock() + clamp_retry_after(retry_after_s))
 
+    # -- session affinity --------------------------------------------------
+    def _affinity_hint(self, session):
+        if session is None:
+            return None
+        with self._lock:
+            name = self._affinity.get(session)
+            if name is not None:
+                self._affinity.move_to_end(session)
+            return name
+
+    def _affinity_note(self, session, name):
+        if session is None:
+            return
+        with self._lock:
+            self._affinity[session] = name
+            self._affinity.move_to_end(session)
+            while len(self._affinity) > self._affinity_cap:
+                self._affinity.popitem(last=False)
+
+    def pin_session(self, session, replica_name):
+        """Register where a chat session lives — the caller opened it on
+        that replica (``LlamaServer.open_session``), so its turns should
+        route there.  Later successful turns refresh the pin."""
+        if replica_name not in self._states:
+            raise MXNetError("unknown replica %r (have %r)"
+                             % (replica_name, sorted(self._states)))
+        self._affinity_note(session, replica_name)
+
     # -- request path -----------------------------------------------------
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
-               deadline_s=None, timeout=300, idempotent=True):
+               deadline_s=None, timeout=300, idempotent=True,
+               session=None):
         """Enqueue; routes and submits to a replica before returning, so
         decode starts immediately.  Returns a future whose
-        ``.result(timeout)`` drives the retry/hedge state machine."""
+        ``.result(timeout)`` drives the retry/hedge state machine.
+        ``session`` is a chat-session affinity hint: the turn routes to
+        the replica that pinned the session's pages when that replica is
+        routable, falling back to p2c otherwise."""
         return _FleetFuture(self, dict(
             prompt=prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
-            deadline_s=deadline_s, timeout=timeout, idempotent=idempotent))
+            deadline_s=deadline_s, timeout=timeout, idempotent=idempotent,
+            session=session))
 
     def _eager_submit(self, kwargs, deadline_t):
         """Attempt 0 on the submitter's thread: route and enqueue now so
@@ -679,7 +737,8 @@ class FleetRouter:
             if remaining <= 0:
                 return None  # the loop raises ServeDeadlineExceeded
         try:
-            replica = self._pick()
+            replica = self._pick(
+                prefer=self._affinity_hint(kwargs.get("session")))
         except FleetNoHealthyReplica as e:
             return (None, None, e)
         try:
@@ -688,17 +747,20 @@ class FleetRouter:
             handle = replica.submit(
                 kwargs["prompt"],
                 max_new_tokens=kwargs.get("max_new_tokens"),
-                eos_id=kwargs.get("eos_id"), deadline_s=remaining)
+                eos_id=kwargs.get("eos_id"), deadline_s=remaining,
+                session=kwargs.get("session"))
             return (replica, handle, None)
         except Exception as e:  # noqa: BLE001 — classified in _generate
             return (replica, None, e)
 
     def generate(self, prompt, max_new_tokens=None, eos_id=None,
-                 deadline_s=None, timeout=300, idempotent=True):
+                 deadline_s=None, timeout=300, idempotent=True,
+                 session=None):
         """Blocking request through the full route/retry/hedge path."""
         return self._generate(None, prompt, max_new_tokens=max_new_tokens,
                               eos_id=eos_id, deadline_s=deadline_s,
-                              timeout=timeout, idempotent=idempotent)
+                              timeout=timeout, idempotent=idempotent,
+                              session=session)
 
     @staticmethod
     def _retry_reason(err):
@@ -727,7 +789,7 @@ class FleetRouter:
 
     def _generate(self, future, prompt, max_new_tokens=None, eos_id=None,
                   deadline_s=None, timeout=300, idempotent=True,
-                  _first=None, _deadline_t=None, _t0=None):
+                  session=None, _first=None, _deadline_t=None, _t0=None):
         if _deadline_t is not None:
             deadline_t = _deadline_t
         else:
@@ -765,7 +827,9 @@ class FleetRouter:
                 continue
             if first is None:
                 try:
-                    replica = self._pick(exclude=tried)
+                    replica = self._pick(
+                        exclude=tried,
+                        prefer=self._affinity_hint(session))
                 except FleetNoHealthyReplica as e:
                     last_err = e
                     if attempt >= self.retries:
@@ -793,12 +857,14 @@ class FleetRouter:
                     handle = replica.submit(prompt,
                                             max_new_tokens=max_new_tokens,
                                             eos_id=eos_id,
-                                            deadline_s=remaining)
+                                            deadline_s=remaining,
+                                            session=session)
                 tokens, winner = self._await(handle, replica, tried,
                                              remaining, timeout,
                                              dict(prompt=prompt,
                                                   max_new_tokens=max_new_tokens,
-                                                  eos_id=eos_id))
+                                                  eos_id=eos_id,
+                                                  session=session))
             except (MXNetError, _faults.FaultInjected) as e:
                 self._release(replica)
                 reason = self._retry_reason(e)
@@ -841,6 +907,7 @@ class FleetRouter:
                 continue
             self._release(replica)
             self._count_request(winner.name, "ok")
+            self._affinity_note(session, winner.name)
             with self._lock:
                 self.completed += 1
                 self._lat.append(self._clock() - t0)
@@ -856,7 +923,9 @@ class FleetRouter:
         first winner (cancelling the loser).  Returns (tokens, winner
         replica)."""
         budget = timeout if remaining is None else min(timeout, remaining)
-        if not self.hedge:
+        if not self.hedge or spec.get("session") is not None:
+            # a session turn can only run where its pages are pinned —
+            # hedging it to another replica is a guaranteed 404
             return handle.result(budget), replica
         if handle.wait(self._hedge_delay()):
             return handle.result(budget), replica

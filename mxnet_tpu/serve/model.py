@@ -91,7 +91,7 @@ class KVGeometry:
                  max_pages_per_seq, max_batch, prefill_buckets,
                  dtype="float32", rope_base=10000.0, eps=1e-6,
                  tie_embeddings=False, kv_dtype=None, spec_k=0,
-                 paged_kernel=None):
+                 paged_kernel=None, prefill_chunk=0):
         self.num_layers = int(num_layers)
         self.num_heads = int(num_heads)
         self.num_kv_heads = int(num_kv_heads)
@@ -112,6 +112,13 @@ class KVGeometry:
         # carries neither loads as an fp32 arena with speculation off
         self.kv_dtype = str(kv_dtype) if kv_dtype else self.dtype
         self.spec_k = int(spec_k)
+        # ISSUE 19: chunked-prefill width.  > 0 additionally compiles a
+        # batched mid-sequence ``chunk`` executable (the step graph at
+        # k1=prefill_chunk) so long / over-bucket prompts prefill in
+        # ladder-sized chunks interleaved with decode steps, and cached
+        # prefix splices resume mid-sequence.  0 = off; old bundle
+        # dicts lack the field and load with it off.
+        self.prefill_chunk = int(prefill_chunk)
         # PR 14: which decode/verify attention the executables were
         # BUILT with — "auto" (Pallas kernel on TPU, XLA reference
         # elsewhere), "1" (kernel forced; interpreter off-TPU), "0"
@@ -162,6 +169,11 @@ class KVGeometry:
                 "paged_kernel must be 'auto', '0' or '1' (see "
                 "MXNET_SERVE_PAGED_KERNEL in docs/env_vars.md), got %r"
                 % self.paged_kernel)
+        if self.prefill_chunk < 0 or self.prefill_chunk > self.max_context:
+            raise MXNetError(
+                "prefill_chunk must be in [0, max_context=%d] (0 "
+                "disables chunked prefill), got %d"
+                % (self.max_context, self.prefill_chunk))
 
     def to_dict(self):
         return {
@@ -177,6 +189,7 @@ class KVGeometry:
             "eps": self.eps, "tie_embeddings": self.tie_embeddings,
             "kv_dtype": self.kv_dtype, "spec_k": self.spec_k,
             "paged_kernel": self.paged_kernel,
+            "prefill_chunk": self.prefill_chunk,
         }
 
     @classmethod
@@ -203,7 +216,7 @@ class KVGeometry:
     # self-contained.
     HOT_SWAP_FIELDS = ("page_size", "num_pages", "max_pages_per_seq",
                        "max_batch", "prefill_buckets", "vocab_size",
-                       "kv_dtype", "spec_k")
+                       "kv_dtype", "spec_k", "prefill_chunk")
 
     def hot_swap_pins(self):
         """The geometry subset ``reload()`` pins (``check_geometry``
@@ -225,11 +238,12 @@ class KVGeometry:
     def describe(self):
         return ("layers=%d heads=%d/%d head_dim=%d pages=%dx%d "
                 "max_batch=%d buckets=%s dtype=%s kv_dtype=%s spec_k=%d "
-                "paged_kernel=%s"
+                "paged_kernel=%s prefill_chunk=%d"
                 % (self.num_layers, self.num_heads, self.num_kv_heads,
                    self.head_dim, self.num_pages, self.page_size,
                    self.max_batch, list(self.prefill_buckets), self.dtype,
-                   self.kv_dtype, self.spec_k, self.paged_kernel))
+                   self.kv_dtype, self.spec_k, self.paged_kernel,
+                   self.prefill_chunk))
 
 
 def _env_int(name, default):
@@ -251,7 +265,8 @@ def default_buckets():
 
 def geometry_from_net(net, page_size=None, num_pages=None, max_batch=None,
                       prefill_buckets=None, max_pages_per_seq=None,
-                      kv_dtype=None, spec_k=None, paged_kernel=None):
+                      kv_dtype=None, spec_k=None, paged_kernel=None,
+                      prefill_chunk=None):
     """Derive a :class:`KVGeometry` from a ``LlamaModel`` block tree,
     filling paging knobs from ``MXNET_SERVE_*`` env defaults."""
     blocks = list(net.blocks._children.values())
@@ -266,6 +281,8 @@ def geometry_from_net(net, page_size=None, num_pages=None, max_batch=None,
         or os.environ.get("MXNET_SERVE_KV_DTYPE", "").strip() or None
     spec_k = spec_k if spec_k is not None \
         else _env_int("MXNET_SERVE_SPEC_K", 0)
+    prefill_chunk = prefill_chunk if prefill_chunk is not None \
+        else _env_int("MXNET_SERVE_PREFILL_CHUNK", 0)
     if paged_kernel is None:
         paged_kernel = os.environ.get("MXNET_SERVE_PAGED_KERNEL",
                                       "").strip() or None
@@ -289,7 +306,8 @@ def geometry_from_net(net, page_size=None, num_pages=None, max_batch=None,
         max_batch=max_batch, prefill_buckets=buckets,
         dtype=str(embed_w.dtype), rope_base=attn._base,
         eps=blocks[0].attn_norm._eps, tie_embeddings=net._tie,
-        kv_dtype=kv_dtype, spec_k=spec_k, paged_kernel=paged_kernel)
+        kv_dtype=kv_dtype, spec_k=spec_k, paged_kernel=paged_kernel,
+        prefill_chunk=prefill_chunk)
 
 
 def _pull(param):
@@ -694,6 +712,15 @@ def compile_serving_executables(net, geometry):
         exes["verify"] = _aot_compile(
             build_verify_fn(weights, g),
             lane_avals((g.max_batch, g.spec_k + 1)), n_state=len(state))
+    if g.prefill_chunk > 0:
+        # mid-sequence chunked prefill: the step graph at
+        # k1=prefill_chunk — scatters a chunk of prompt tokens into the
+        # arena and attends causally over arena context, so a prompt
+        # resumes at any position (cached-prefix splice, chunk N of M)
+        exes["chunk"] = _aot_compile(
+            build_step_fn(weights, g, g.prefill_chunk),
+            lane_avals((g.max_batch, g.prefill_chunk)),
+            n_state=len(state))
     for b in g.prefill_buckets:
         pf_avals = state + (jax.ShapeDtypeStruct((b,), i32),
                             jax.ShapeDtypeStruct((), i32),
@@ -707,7 +734,8 @@ def compile_serving_executables(net, geometry):
 def export_serving_bundle(net, path, page_size=None, num_pages=None,
                           max_batch=None, prefill_buckets=None,
                           max_pages_per_seq=None, mesh=None,
-                          kv_dtype=None, spec_k=None, paged_kernel=None):
+                          kv_dtype=None, spec_k=None, paged_kernel=None,
+                          prefill_chunk=None):
     """Export ``net`` as a self-contained MXAOT1 serving bundle.
 
     The bundle carries the AOT-compiled decode + per-bucket prefill
@@ -736,7 +764,8 @@ def export_serving_bundle(net, path, page_size=None, num_pages=None,
                           prefill_buckets=prefill_buckets,
                           max_pages_per_seq=max_pages_per_seq,
                           kv_dtype=kv_dtype, spec_k=spec_k,
-                          paged_kernel=paged_kernel)
+                          paged_kernel=paged_kernel,
+                          prefill_chunk=prefill_chunk)
     meta = {"kind": BUNDLE_KIND, "geometry": g.to_dict()}
     if mesh is not None:
         from .. import planner as _planner
@@ -783,6 +812,8 @@ def load_serving_executables(path, expect=None):
     want = ["decode"] + ["prefill_%d" % b for b in g.prefill_buckets]
     if g.spec_k > 0:
         want.append("verify")
+    if g.prefill_chunk > 0:
+        want.append("chunk")
     entries = doc.get("entries", {})
     missing = [n for n in want if n not in entries]
     if missing:

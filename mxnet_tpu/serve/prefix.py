@@ -1,0 +1,243 @@
+"""Radix-tree prefix cache over the paged KV arena (ISSUE 19).
+
+Cross-request KV reuse: a token-id radix tree maps prompt prefixes to
+arena pages so a request whose prompt starts with an already-served
+prefix *splices* the cached pages into its block table instead of
+re-prefilling them.  The tree is page-granular — every node is exactly
+one FULL page (``page_size`` token ids) — which is what makes sharing
+safe without copy-on-write machinery: a full page is immutable (its
+``page_size`` slots were written by the prefill that produced it and
+never touched again), so a spliced request only ever *reads* shared
+pages and writes its own fresh tail.  The "COW fork" of a partially
+filled tail page is recompute-on-write: the tail's few tokens are
+simply not cached, and each request recomputes them in its own pages
+via chunked prefill.  PR 13's purity property (arena state is a pure
+function of the token stream — slot-0-fixed int8 scales, never
+requantized) is what makes a cached page byte-identical to the page a
+cold prefill would have produced, so greedy output is token-for-token
+identical cache-on vs cache-off.
+
+Reference counting lives in the arena (``retain``/``free`` with owner
+tags): the cache holds one reference per cached page under the
+``"prefix-cache"`` tag, every spliced request holds its own reference,
+and a page recycles only when the last reference goes.  Eviction is LRU
+over refcount-1 leaves (pages only the cache still holds) and runs
+under arena pressure — ``Scheduler._admit`` calls ``evict`` when
+``alloc`` comes back empty-handed.
+
+Loop-thread-only and lock-free by contract, like the arena it wraps
+(CD11xx): every mutator runs on the serve loop thread.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..telemetry import flight as _flight
+from ..telemetry import metrics as _metrics
+from ..testing import rescheck as _rescheck
+
+#: Arena owner tag for every reference the cache holds.
+CACHE_OWNER = "prefix-cache"
+
+
+class _Node:
+    """One full page of cached prefix: ``tokens`` is the page's
+    ``page_size`` token ids (the radix edge label), ``page`` the arena
+    page holding their KV."""
+
+    __slots__ = ("page", "tokens", "parent", "children", "last_used")
+
+    def __init__(self, page, tokens, parent, tick):
+        self.page = page
+        self.tokens = tokens
+        self.parent = parent
+        self.children = {}
+        self.last_used = tick
+
+
+class PrefixCache:
+    """Radix tree of full KV pages keyed by their token ids."""
+
+    def __init__(self, arena):
+        self.arena = arena
+        self._root = _Node(None, (), None, 0)
+        # deterministic LRU clock: a counter, not wall time, so seeded
+        # chaos runs evict identically twice
+        self._tick = 0
+        self.hits = 0
+        self.misses = 0
+        self.cached_tokens = 0
+        self.evictions = 0
+        self.inserts = 0
+        self.pages = 0            # live nodes (== cached pages)
+        self._res = None          # rescheck token while non-empty
+
+    # -- lookup -----------------------------------------------------------
+    def match(self, tokens):
+        """Longest cached prefix of ``tokens``, page-aligned.
+
+        Returns ``(pages, hit_tokens)``.  The hit is capped so at least
+        one prompt token remains to prefill — the last prompt position's
+        logits seed the first generated token, so a 100% hit must still
+        recompute its final page's worth of tokens.
+        """
+        ps = self.arena.geometry.page_size
+        self._tick += 1
+        node, pages = self._root, []
+        for d in range(len(tokens) // ps):
+            child = node.children.get(tuple(tokens[d * ps:(d + 1) * ps]))
+            if child is None:
+                break
+            child.last_used = self._tick
+            pages.append(child.page)
+            node = child
+        hit = len(pages) * ps
+        while pages and hit >= len(tokens):
+            pages.pop()
+            hit -= ps
+        return list(pages), hit
+
+    def record_hit(self, hit_tokens, n_pages):
+        """Count a splice that actually happened (the scheduler calls
+        this at admission, after the arena paged the request — a match
+        stalled on arena pressure is re-tried, not double-counted)."""
+        self.hits += 1
+        self.cached_tokens += hit_tokens
+        if _metrics.enabled():
+            _metrics.counter(
+                "mxnet_serve_prefix_hits_total",
+                help="prefill requests that spliced at least one cached "
+                     "prefix page").inc()
+            _metrics.counter(
+                "mxnet_serve_prefix_cached_tokens_total",
+                help="prompt tokens served from the prefix cache "
+                     "instead of being re-prefilled").inc(hit_tokens)
+        _flight.record("prefix.hit", tokens=hit_tokens, pages=n_pages)
+
+    def record_miss(self):
+        self.misses += 1
+        if _metrics.enabled():
+            _metrics.counter(
+                "mxnet_serve_prefix_misses_total",
+                help="prefill requests that found no cached prefix "
+                     "page").inc()
+
+    # -- population -------------------------------------------------------
+    def insert(self, tokens, pages):
+        """Cache the full pages of a just-prefilled prompt.
+
+        ``pages`` is the owning request's page list; for each full page
+        of ``tokens`` not already in the tree the cache takes its own
+        reference (``retain``) on the request's page — the request's
+        later ``free`` then decrements instead of recycling.  Depths
+        already cached keep the existing page (first writer wins; the
+        duplicate page stays private to its request).
+        """
+        ps = self.arena.geometry.page_size
+        self._tick += 1
+        node, added = self._root, 0
+        for d in range(len(tokens) // ps):
+            key = tuple(tokens[d * ps:(d + 1) * ps])
+            child = node.children.get(key)
+            if child is None:
+                page = pages[d]
+                self.arena.retain([page], CACHE_OWNER)
+                child = _Node(page, key, node, self._tick)
+                node.children[key] = child
+                self.pages += 1
+                self.inserts += 1
+                added += 1
+            else:
+                child.last_used = self._tick
+            node = child
+        if added:
+            if self._res is None and _rescheck.enabled():
+                self._res = _rescheck.acquire(
+                    "prefix", CACHE_OWNER, scope=self.arena.res_scope)
+            _flight.record("prefix.insert", pages=added)
+        return added
+
+    # -- pressure ---------------------------------------------------------
+    def evict(self, n_needed):
+        """Free up to ``n_needed`` pages, LRU over evictable leaves.
+
+        A node is evictable when it has no children (evicting an inner
+        node would orphan its suffix) and the arena refcount of its page
+        is 1 — only the cache holds it; pages a live request or session
+        still references are never evicted.  Evicting a leaf can expose
+        its parent as the next candidate, so the scan repeats until the
+        target is met or nothing is evictable.
+        """
+        freed = 0
+        while freed < n_needed:
+            victim = None
+            stack = list(self._root.children.values())
+            while stack:
+                n = stack.pop()
+                if n.children:
+                    stack.extend(n.children.values())
+                elif self.arena.refcount(n.page) == 1:
+                    if victim is None or n.last_used < victim.last_used:
+                        victim = n
+            if victim is None:
+                break
+            del victim.parent.children[victim.tokens]
+            self.arena.free([victim.page], owner=CACHE_OWNER)
+            self.pages -= 1
+            self.evictions += 1
+            freed += 1
+        if freed:
+            if _metrics.enabled():
+                _metrics.counter(
+                    "mxnet_serve_prefix_evictions_total",
+                    help="cached prefix pages evicted (LRU) under arena "
+                         "pressure").inc(freed)
+            _flight.record("prefix.evict", pages=freed)
+        if self.pages == 0 and self._res is not None:
+            _rescheck.release(self._res)
+            self._res = None
+        return freed
+
+    # -- teardown ---------------------------------------------------------
+    def release_all(self):
+        """Drop every cache reference (drain / stop / swap / fail_all).
+
+        Shared pages simply decrement — a live request or session still
+        holding them keeps them allocated; cache-only pages recycle.
+        """
+        dropped = 0
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            self.arena.free([n.page], owner=CACHE_OWNER)
+            dropped += 1
+        self._root = _Node(None, (), None, self._tick)
+        self.pages = 0
+        if self._res is not None:
+            _rescheck.release(self._res)
+            self._res = None
+        if dropped:
+            _flight.record("prefix.release", pages=dropped)
+        return dropped
+
+    # -- introspection ----------------------------------------------------
+    def hit_rate(self):
+        seen = self.hits + self.misses
+        return self.hits / seen if seen else 0.0
+
+    def stats(self):
+        return {
+            "prefix_hits": self.hits,
+            "prefix_misses": self.misses,
+            "prefix_hit_rate": round(self.hit_rate(), 4),
+            "prefix_cached_tokens": self.cached_tokens,
+            "prefix_pages": self.pages,
+            "prefix_evictions": self.evictions,
+        }
+
+    def assert_quiescent(self):
+        """The cache holds no pages (used after ``release_all`` in
+        drain/stop paths before the arena's own quiescence check)."""
+        if self.pages or self._root.children:
+            raise MXNetError("prefix cache not quiescent: %d page(s) "
+                             "still cached" % self.pages)

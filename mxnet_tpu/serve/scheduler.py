@@ -60,6 +60,7 @@ from ..testing import faults as _faults
 from ..testing import lockcheck as _lockcheck
 from ..testing import rescheck as _rescheck
 from . import spec as _spec
+from .prefix import PrefixCache
 
 # TTFT/TPOT bucket ladders (seconds): decode steps sit well under the
 # engine's default op buckets, so the serve histograms get their own
@@ -152,13 +153,25 @@ class ServeInternalError(MXNetError):
     their futures while the loop restarts."""
 
 
+class ServeSessionUnknown(MXNetError):
+    """The request names a session this server doesn't hold (never
+    opened, expired by TTL, or flushed by a drain/swap) — HTTP 404;
+    the client reopens with a full-history prompt."""
+
+
+class ServeSessionBusy(MXNetError):
+    """A turn for this session is already queued or in flight —
+    sessions are strictly serial (their pinned pages are written by one
+    turn at a time); HTTP 409."""
+
+
 class Request:
     """One generation request and its (thread-safe) result future."""
 
     _ids = itertools.count()
 
     def __init__(self, prompt, max_new_tokens=None, eos_id=None,
-                 deadline_s=None):
+                 deadline_s=None, session_id=None):
         self.rid = next(Request._ids)
         # globally-unique-enough id stamped into flight events and served
         # back by GET /v1/trace/<id> (pid disambiguates across ranks)
@@ -180,6 +193,11 @@ class Request:
         if self.deadline_s is not None and self.deadline_s <= 0:
             raise MXNetError("deadline_s must be positive")
         self.deadline_t = None    # absolute (scheduler clock), at submit
+        # chat-session turn (ISSUE 19): the prompt is the DELTA — only
+        # the new turn's tokens — and prefill resumes over the session's
+        # pinned pages.  None = ordinary stateless request.
+        self.session_id = None if session_id is None else str(session_id)
+        self.cache_hit_tokens = 0  # prompt tokens spliced from the cache
         self._cancel = False
         self.tokens = []          # generated ids (never includes prompt)
         self.submit_t = None      # clock() at admission-queue entry
@@ -208,6 +226,7 @@ class Request:
             "prefill_s": _d(self.admit_t, self.first_token_t),
             "first_decode_s": _d(self.first_token_t, self.first_decode_t),
             "ttft_s": self.ttft,
+            "cache_hit_tokens": self.cache_hit_tokens,
         }
 
     def result(self, timeout=None):
@@ -236,14 +255,51 @@ class Request:
 class _Slot:
     """One in-flight decode lane: request + position + block-table row."""
 
-    __slots__ = ("req", "pages", "row", "position", "proposer")
+    __slots__ = ("req", "pages", "row", "position", "proposer",
+                 "base", "pf_rem", "pf_pos")
 
-    def __init__(self, req, pages, row, position):
+    def __init__(self, req, pages, row, position, base=0, pf_rem=None,
+                 pf_pos=0):
         self.req = req
         self.pages = pages
         self.row = row            # np (maxp,) int32 block-table row
         self.position = position  # next token's position (0-based)
         self.proposer = None      # lazy spec.NgramProposer (spec_k > 0)
+        # ISSUE 19: history length already in the arena before this
+        # request's prompt (session turns; 0 for stateless requests) —
+        # every position in the lane is offset by it
+        self.base = base
+        # chunked-prefill state: tokens still to write (None/[] = the
+        # lane is decoding) and the next absolute write position.  A
+        # cache splice starts pf_pos past the hit; a session turn past
+        # the pinned history's written coverage.
+        self.pf_rem = pf_rem
+        self.pf_pos = pf_pos
+
+
+class _Session:
+    """One pinned chat conversation: committed token stream + the arena
+    pages holding its KV between turns (owner tag ``sess:<id>``).
+
+    ``written`` is the KV *coverage* — positions ``[0, written)`` are
+    correct in the arena.  It trails ``len(tokens)`` by at least one:
+    the final sampled token of a turn is never fed back as decode input,
+    so its KV was never written; the next turn's chunked prefill rewrites
+    the stream from ``written`` (purity makes the rewrite exact).
+    """
+
+    __slots__ = ("sid", "owner", "tokens", "written", "pages", "busy",
+                 "deadline_t", "res")
+
+    def __init__(self, sid, deadline_t):
+        self.sid = sid
+        self.owner = "sess:%s" % sid
+        self.tokens = []          # full committed history, all turns
+        self.written = 0          # arena KV coverage (tokens, not pages)
+        self.pages = []           # pinned pages covering `written`
+        self.busy = None          # rid of the queued/active turn
+        self.deadline_t = deadline_t
+        self.res = None           # rescheck token while pinned
 
 
 def _env_int(name, default):
@@ -317,6 +373,20 @@ class Scheduler:
         self.tokens_generated = 0
         self.decode_steps = 0
         self.prefills = 0
+        self.chunk_steps = 0      # batched chunked-prefill calls
+        # ISSUE 19: chunked prefill + prefix cache + sessions.  All
+        # three need the mid-sequence `chunk` executable, so a bundle
+        # exported with prefill_chunk=0 serves exactly as before; with
+        # it compiled, MXNET_SERVE_PREFIX_CACHE (default on) gates the
+        # radix cache and POST /v1/chat sessions come alive.
+        self.chunk_size = int(self.geometry.prefill_chunk)
+        cache_on = os.environ.get("MXNET_SERVE_PREFIX_CACHE",
+                                  "1").strip() not in ("0", "false", "")
+        self.prefix_cache = PrefixCache(arena) \
+            if self.chunk_size > 0 and cache_on else None
+        self.session_ttl = _env_float("MXNET_SERVE_SESSION_TTL", 600.0)
+        self._sessions = {}       # sid -> _Session (under _lock)
+        self._session_seq = itertools.count()
         self.spec_proposed = 0    # draft tokens sent to verify
         self.spec_accepted = 0    # draft tokens the sampler reproduced
         # cost-model EMAs for the verify/decode policy (see the
@@ -385,14 +455,19 @@ class Scheduler:
     def submit(self, req):
         """Queue ``req``; backpressure + obvious rejections happen NOW."""
         self._trace_new(req)
-        if self.pick_bucket(len(req.prompt)) is None:
+        # over-ladder prompts are only fatal without the chunk
+        # executable: with prefill_chunk > 0 any prompt that fits the
+        # context prefills in ladder-sized chunks instead
+        if self.pick_bucket(len(req.prompt)) is None \
+                and self.chunk_size <= 0 and req.session_id is None:
             self._reject(req, MXNetError(
                 "prompt of %d tokens exceeds the largest prefill bucket "
-                "(%d) this bundle was exported with"
+                "(%d) this bundle was exported with (export with "
+                "prefill_chunk > 0 to serve over-bucket prompts)"
                 % (len(req.prompt), self.geometry.prefill_buckets[-1])))
             return req
         total = len(req.prompt) + req.max_new_tokens + self._spec_headroom
-        if total > self.geometry.max_context:
+        if req.session_id is None and total > self.geometry.max_context:
             self._reject(req, MXNetError(
                 "prompt %d + max_new %d%s exceeds max context %d (= "
                 "max_pages_per_seq x page_size)"
@@ -430,6 +505,44 @@ class Scheduler:
                     % (len(self._queue), self.queue_depth))
                 err.retry_after_s = self._retry_after_locked()
                 raise err
+            if req.session_id is not None:
+                sess = self._sessions.get(req.session_id)
+                if sess is None:
+                    self.rejected += 1
+                    self._count_req("rejected")
+                    self._trace_event(req, "rejected", status="rejected",
+                                      reason="session_unknown")
+                    raise ServeSessionUnknown(
+                        "unknown session %r (never opened, expired after "
+                        "MXNET_SERVE_SESSION_TTL, or flushed by a "
+                        "drain/swap) — reopen with the full history"
+                        % req.session_id)
+                if sess.busy is not None:
+                    self.rejected += 1
+                    self._count_req("rejected")
+                    self._trace_event(req, "rejected", status="rejected",
+                                      reason="session_busy")
+                    raise ServeSessionBusy(
+                        "session %r already has a turn in flight "
+                        "(request %d) — sessions are serial"
+                        % (req.session_id, sess.busy))
+                total = (len(sess.tokens) + len(req.prompt)
+                         + req.max_new_tokens + self._spec_headroom)
+                if total > self.geometry.max_context:
+                    self.rejected += 1
+                    self._count_req("rejected")
+                    self._trace_event(req, "rejected", status="rejected",
+                                      reason="over_context")
+                    raise MXNetError(
+                        "session %r history %d + turn prompt %d + "
+                        "max_new %d exceeds max context %d"
+                        % (req.session_id, len(sess.tokens),
+                           len(req.prompt), req.max_new_tokens,
+                           self.geometry.max_context))
+                # serialize the session NOW: a second turn submitted
+                # while this one is queued gets ServeSessionBusy, and
+                # the TTL reaper skips busy sessions
+                sess.busy = req.rid
             req.submit_t = self.clock()
             if req.deadline_s is not None:
                 req.deadline_t = req.submit_t + req.deadline_s
@@ -452,13 +565,18 @@ class Scheduler:
 
     # -- the scheduling step ---------------------------------------------
     def step(self):
-        """One reap→admit→prefill→decode→complete round; True if any
+        """One reap→admit→chunk→decode→complete round; True if any
         work ran.  The reap phase is where deadlines, cancellations and
         injected client disconnects take effect — pages free and futures
-        resolve at step boundaries, never mid-call."""
+        resolve at step boundaries, never mid-call.  Chunked prefill
+        interleaves with decode at exactly one chunk call per step, so a
+        long prompt costs every active lane one extra call per chunk
+        instead of one monolithic bucket-sized stall."""
         self._poll_disconnects()
         worked = self._reap()
         if self._admit():
+            worked = True
+        if self._chunk_once():
             worked = True
         if self._decode_once():
             worked = True
@@ -520,11 +638,32 @@ class Scheduler:
                 err, status = self._lifecycle_error(s.req, now)
                 if err is not None:
                     dead_s.append((s, err, status))
+            expired = self._reap_sessions_locked(now)
         for req, err, status in dead_q:
             self._fail_queued(req, err, status)
         for s, err, status in dead_s:
             self._finish_slot(s, error=err, status=status)
-        return bool(dead_q or dead_s)
+        return bool(dead_q or dead_s or expired)
+
+    def _reap_sessions_locked(self, now):
+        """TTL eviction over idle sessions (the PR 15 deadline pattern):
+        a session whose ``deadline_t`` passed with no turn in flight
+        unpins its pages — shared pages decrement, exclusive ones
+        recycle.  Busy sessions never expire mid-turn."""
+        expired = [s for s in self._sessions.values()
+                   if s.busy is None and now > s.deadline_t]
+        for sess in expired:
+            del self._sessions[sess.sid]
+            if sess.pages:
+                self.arena.free(sess.pages, owner=sess.owner)
+            _rescheck.release(sess.res)
+            sess.res = None
+            _flight.record("session.expire", sid=sess.sid,
+                           tokens=len(sess.tokens), pages=len(sess.pages),
+                           reason="ttl")
+        if expired:
+            self._gauges_locked()
+        return len(expired)
 
     def _fail_queued(self, req, err, status):
         """Resolve a request that never reached a slot (reaped from the
@@ -543,6 +682,12 @@ class Scheduler:
         req._done.set()
         _rescheck.release(req._res)
         req._res = None
+        if req.session_id is not None:
+            with self._lock:
+                sess = self._sessions.get(req.session_id)
+                if sess is not None and sess.busy == req.rid:
+                    sess.busy = None
+                    sess.deadline_t = self.clock() + self.session_ttl
 
     def cancel(self, trace_id):
         """Cancel by trace id (``DELETE /v1/generate/<id>``): True if
@@ -559,6 +704,75 @@ class Scheduler:
                     s.req.cancel()
                     return True
         return False
+
+    # -- chat sessions (ISSUE 19) -----------------------------------------
+    def open_session(self):
+        """Create a pinned multi-turn session; returns its id.
+
+        Needs the mid-sequence ``chunk`` executable: a later turn's
+        delta prefills from the pinned history's write coverage, which
+        a position-0 bucket prefill cannot do.
+        """
+        if self.chunk_size <= 0:
+            raise MXNetError(
+                "sessions need a bundle exported with prefill_chunk > 0 "
+                "(MXNET_SERVE_PREFILL_CHUNK) — turn deltas prefill "
+                "mid-sequence")
+        with self._lock:
+            sid = "s%x-%x" % (os.getpid(), next(self._session_seq))
+            self._sessions[sid] = _Session(
+                sid, self.clock() + self.session_ttl)
+            self._gauges_locked()
+        _flight.record("session.create", sid=sid)
+        return sid
+
+    def close_session(self, session_id):
+        """Explicitly unpin a session's pages (``DELETE /v1/chat/<id>``).
+        True if it existed; raises :class:`ServeSessionBusy` while a
+        turn is in flight."""
+        with self._lock:
+            sess = self._sessions.get(str(session_id))
+            if sess is None:
+                return False
+            if sess.busy is not None:
+                raise ServeSessionBusy(
+                    "session %r has a turn in flight — cancel it first"
+                    % session_id)
+            del self._sessions[sess.sid]
+            if sess.pages:
+                self.arena.free(sess.pages, owner=sess.owner)
+            _rescheck.release(sess.res)
+            sess.res = None
+            self._gauges_locked()
+        _flight.record("session.expire", sid=str(session_id),
+                       reason="closed")
+        return True
+
+    def session_count(self):
+        with self._lock:
+            return len(self._sessions)
+
+    def release_shared(self):
+        """Drop every cross-request reference — the whole prefix cache
+        and every session pin.  The flush step of ``fail_all``, drain,
+        ``stop()`` and hot-swap: after it (and after in-flight requests
+        resolve) the arena owes pages to nobody, so quiescence asserts
+        and ``arena.reset()`` hold."""
+        with self._lock:
+            self._release_shared_locked()
+
+    def _release_shared_locked(self):
+        if self.prefix_cache is not None:
+            self.prefix_cache.release_all()
+        for sess in list(self._sessions.values()):
+            if sess.pages:
+                self.arena.free(sess.pages, owner=sess.owner)
+            _rescheck.release(sess.res)
+            sess.res = None
+            _flight.record("session.expire", sid=sess.sid,
+                           reason="flush")
+        self._sessions.clear()
+        self._gauges_locked()
 
     # -- drain / shutdown -------------------------------------------------
     def drain(self):
@@ -600,9 +814,16 @@ class Scheduler:
                 raise MXNetError(
                     "runner/arena swap with %d active slot(s) — drain "
                     "lanes first" % busy)
+            # cached prefixes and session pins point into the OLD
+            # arena — flush them (sessions die across a swap; clients
+            # get ServeSessionUnknown and reopen with full history)
+            self._release_shared_locked()
             self.runner = runner
             self.arena = arena
             self.geometry = arena.geometry
+            self.chunk_size = int(arena.geometry.prefill_chunk)
+            if self.prefix_cache is not None:
+                self.prefix_cache = PrefixCache(arena)
 
     def fail_all(self, error, status="failed"):
         """Resolve EVERY queued and in-flight request with ``error``
@@ -619,6 +840,10 @@ class Scheduler:
         for slot in slots:
             # _finish_slot skips slots a racing completion already closed
             self._finish_slot(slot, error=error, status=status)
+        # with every request resolved (its page refs dropped), flush the
+        # cross-request refs too — containment's arena.reset() needs
+        # zero owners, and no future is left to revive a session anyway
+        self.release_shared()
         return len(queued) + len(slots)
 
     def kick(self):
@@ -651,6 +876,7 @@ class Scheduler:
     def _admit(self):
         admitted = False
         while True:
+            dead = None
             with self._lock:
                 if self._hold_admission:
                     break
@@ -658,33 +884,104 @@ class Scheduler:
                 if not free or not self._queue:
                     break
                 req = self._queue[0]
-                pages = self.arena.alloc(
-                    self.arena.pages_needed(
-                        len(req.prompt) + req.max_new_tokens
-                        + self._spec_headroom), req.rid)
-                if pages is None:
-                    break  # head-of-line waits for pages, not forever slots
-                self._queue.popleft()
-                slot_i = free[0]
-                slot = _Slot(req, pages, self.arena.block_row(pages),
-                             position=len(req.prompt))
-                self._slots[slot_i] = slot
-                self.admitted += 1
-                req.admit_t = self.clock()
-                self._count_req("admitted")
-                self._trace_event(req, "admit", status="active",
-                                  slot=slot_i, pages=len(pages))
-                if _metrics.enabled() and req.submit_t is not None:
-                    _metrics.histogram(
-                        "mxnet_serve_queue_wait_seconds",
-                        help="submit -> decode-slot assignment "
-                             "(TTFT breakdown: time spent queued)",
-                        buckets=_TTFT_BUCKETS,
-                    ).observe(req.admit_t - req.submit_t)
-                self._gauges_locked()
-            self._prefill(slot)
+                sess = None
+                if req.session_id is not None:
+                    sess = self._sessions.get(req.session_id)
+                    if sess is None or sess.busy != req.rid:
+                        # the session was flushed (drain/swap raced the
+                        # queue) — fail the turn outside the lock
+                        self._queue.popleft()
+                        dead = req
+                if dead is None:
+                    slot = self._admit_head_locked(req, sess, free[0])
+                    if slot is None:
+                        break  # head-of-line waits for pages, not slots
+            if dead is not None:
+                self._fail_queued(dead, ServeSessionUnknown(
+                    "session %r vanished before this turn was admitted "
+                    "(flushed by a drain or swap)" % dead.session_id),
+                    "failed")
+                continue
+            if slot.pf_rem is None:
+                self._prefill(slot)
             admitted = True
         return admitted
+
+    def _admit_head_locked(self, req, sess, slot_i):
+        """Page + splice the queue head into ``slot_i``; None when the
+        arena can't page it yet.
+
+        The splice: cached prefix pages (or the session's pinned pages)
+        enter the block table by reference — ``retain`` under the
+        request's tag — and only the *uncovered* tail allocates fresh
+        pages.  Under pressure the prefix cache evicts LRU cache-only
+        pages once before the head gives up for this step.
+        """
+        base = start = hit = 0
+        shared, hit_pages = [], []
+        if sess is not None:
+            base, start, shared = len(sess.tokens), sess.written, sess.pages
+        elif self.prefix_cache is not None:
+            hit_pages, hit = self.prefix_cache.match(req.prompt)
+            try:
+                _faults.maybe_inject("serve_splice", rid=req.rid,
+                                     pages=len(hit_pages))
+            except _faults.LoopKilled:
+                raise
+            except Exception:
+                # chaos seam: a raising splice fault abandons the hit
+                # (nothing was retained yet) — the request admits cold
+                hit_pages, hit = [], 0
+            start = hit
+        total = (base + len(req.prompt) + req.max_new_tokens
+                 + self._spec_headroom)
+        need = self.arena.pages_needed(total)
+        fresh_n = need - len(shared) - len(hit_pages)
+        fresh = []
+        if fresh_n > 0:
+            fresh = self.arena.alloc(fresh_n, req.rid)
+            if fresh is None:
+                if self.prefix_cache is not None and self.prefix_cache.evict(
+                        fresh_n - self.arena.free_pages):
+                    fresh = self.arena.alloc(fresh_n, req.rid)
+                if fresh is None:
+                    return None
+        if hit_pages:
+            self.arena.retain(hit_pages, req.rid)
+            self.prefix_cache.record_hit(hit, len(hit_pages))
+            req.cache_hit_tokens = hit
+        elif sess is None and self.prefix_cache is not None:
+            self.prefix_cache.record_miss()
+        if shared:
+            self.arena.retain(shared, req.rid)
+        pages = list(shared) + list(hit_pages) + list(fresh)
+        pf_rem = None
+        if sess is not None:
+            # rewrite the history's unwritten tail (at least the last
+            # sampled token of the previous turn) plus this turn's delta
+            pf_rem = (sess.tokens + req.prompt)[start:]
+        elif hit or self.pick_bucket(len(req.prompt)) is None:
+            pf_rem = req.prompt[hit:]
+        slot = _Slot(req, pages, self.arena.block_row(pages),
+                     position=base + len(req.prompt), base=base,
+                     pf_rem=pf_rem, pf_pos=start)
+        self._queue.popleft()
+        self._slots[slot_i] = slot
+        self.admitted += 1
+        req.admit_t = self.clock()
+        self._count_req("admitted")
+        self._trace_event(req, "admit", status="active",
+                          slot=slot_i, pages=len(pages), cache_hit=hit,
+                          session=req.session_id or "")
+        if _metrics.enabled() and req.submit_t is not None:
+            _metrics.histogram(
+                "mxnet_serve_queue_wait_seconds",
+                help="submit -> decode-slot assignment "
+                     "(TTFT breakdown: time spent queued)",
+                buckets=_TTFT_BUCKETS,
+            ).observe(req.admit_t - req.submit_t)
+        self._gauges_locked()
+        return slot
 
     def _prefill(self, slot):
         req = slot.req
@@ -721,12 +1018,101 @@ class Scheduler:
                 "mxnet_serve_prefill_seconds",
                 help="wall time of one bucketed prefill call",
                 buckets=_TTFT_BUCKETS).observe(req.first_token_t - t0)
+        if self.prefix_cache is not None:
+            with self._lock:
+                self.prefix_cache.insert(req.prompt, slot.pages)
+        self._maybe_complete(slot)
+
+    def _chunk_once(self):
+        """One batched chunked-prefill call over every lane still
+        writing its prompt (or session-history tail).  Runs once per
+        step, interleaved with the decode call, so prompt ingestion
+        shares the loop fairly with token generation.  Lanes whose last
+        chunk lands sample their first token from the chunk's logits —
+        same contract as bucket prefill."""
+        with self._lock:
+            filling = [(i, s) for i, s in enumerate(self._slots)
+                       if s is not None and s.pf_rem]
+        if not filling:
+            return False
+        g = self.geometry
+        C = self.chunk_size
+        tokens = np.zeros((g.max_batch, C), dtype=np.int32)
+        positions = np.zeros(g.max_batch, dtype=np.int32)
+        tables = np.zeros((g.max_batch, g.max_pages_per_seq),
+                          dtype=np.int32)
+        take = {}
+        for i, s in filling:
+            n = min(C, len(s.pf_rem))
+            take[i] = n
+            # a partial final chunk pads with token 0: the pad rows land
+            # at positions past the lane's real stream and every such
+            # position is rewritten (and its page's slot-0 scale reset)
+            # by a real row before any query can attend it — the same
+            # purity argument that makes pages shareable at all
+            tokens[i, :n] = s.pf_rem[:n]
+            positions[i] = s.pf_pos
+            tables[i] = s.row
+        t0 = self.clock()
+        try:
+            _faults.maybe_inject("serve_chunk", batch=len(filling))
+            logits = self.runner.chunk(tokens, positions, tables)
+        except _faults.LoopKilled:  # chaos: escapes to loop containment
+            for _, s in filling:
+                self._fail_slot(s, ServeInternalError(
+                    "serve loop killed during chunked prefill"))
+            raise
+        except Exception as e:
+            for _, s in filling:
+                self._fail_slot(s, e)
+            return True
+        self.chunk_steps += 1
+        dt = self.clock() - t0
+        _flight.record("serve.chunk", batch=len(filling),
+                       dur=round(dt, 6))
+        for i, s in filling:
+            n = take[i]
+            s.pf_rem = s.pf_rem[n:]
+            s.pf_pos += n
+            if not s.pf_rem:
+                s.pf_rem = None
+                self._finish_prefill(s, logits[i, n - 1])
+        return True
+
+    def _finish_prefill(self, slot, last_logits):
+        """The lane's last prompt token just landed: sample the first
+        generated token and close out TTFT — the chunked twin of the
+        ``_prefill`` tail."""
+        req = slot.req
+        self.prefills += 1
+        first = self.sampler(last_logits, req)
+        req.tokens.append(first)
+        self.tokens_generated += 1
+        req.first_token_t = self.clock()
+        ttft = req.first_token_t - req.submit_t
+        self._ttfts.append(ttft)
+        prefill_s = req.first_token_t - req.admit_t
+        self._trace_event(req, "prefill", chunked=True,
+                          cache_hit=req.cache_hit_tokens,
+                          prefill_s=prefill_s, ttft_s=ttft)
+        if _metrics.enabled():
+            _metrics.histogram(
+                "mxnet_serve_ttft_seconds",
+                help="submit -> first generated token (prefill included)",
+                buckets=_TTFT_BUCKETS).observe(ttft)
+            _metrics.histogram(
+                "mxnet_serve_prefill_seconds",
+                help="wall time of one bucketed prefill call",
+                buckets=_TTFT_BUCKETS).observe(prefill_s)
+        if self.prefix_cache is not None and req.session_id is None:
+            with self._lock:
+                self.prefix_cache.insert(req.prompt, slot.pages)
         self._maybe_complete(slot)
 
     def _decode_once(self):
         with self._lock:
             active = [(i, s) for i, s in enumerate(self._slots)
-                      if s is not None]
+                      if s is not None and not s.pf_rem]
         if not active:
             return False
         if self.spec_k > 0 and self._spec_dormant \
@@ -910,8 +1296,9 @@ class Scheduler:
                 # block tails must not pollute future proposals
                 s.proposer.extend(req.tokens[-took:])
             # invariant: position = where the NEXT call's input token
-            # (req.tokens[-1]) sits in the stream
-            s.position = len(req.prompt) + len(req.tokens) - 1
+            # (req.tokens[-1]) sits in the stream (base = session
+            # history already in the arena)
+            s.position = s.base + len(req.prompt) + len(req.tokens) - 1
             self._tpots.append(dt / max(1, took))
             if req.first_decode_t is None and len(req.tokens) >= 2:
                 req.first_decode_t = self.clock()
@@ -1003,6 +1390,35 @@ class Scheduler:
                     break
             if not live:
                 return  # a racing fail_all/complete already closed it
+            if req.session_id is not None:
+                sess = self._sessions.get(req.session_id)
+                if sess is not None and sess.busy == req.rid:
+                    if error is None and req.tokens:
+                        # commit the turn: tokens join the history, and
+                        # the pages covering the written KV get a
+                        # session reference before the request's refs
+                        # drop.  A FAILED turn commits nothing — its
+                        # garbage rows past `written` are rewritten by
+                        # the next turn's chunked prefill before any
+                        # query can attend them (purity).
+                        sess.tokens.extend(req.prompt + req.tokens)
+                        sess.written = (slot.base + len(req.prompt)
+                                        + len(req.tokens) - 1)
+                        keep = self.arena.pages_needed(sess.written)
+                        grown = slot.pages[len(sess.pages):keep]
+                        if grown:
+                            self.arena.retain(grown, sess.owner)
+                            sess.pages = sess.pages + list(grown)
+                        if sess.res is None:
+                            sess.res = _rescheck.acquire(
+                                "session", sess.owner,
+                                scope=self.arena.res_scope)
+                        _flight.record("session.turn", sid=sess.sid,
+                                       tid=req.trace_id,
+                                       tokens=len(sess.tokens),
+                                       pages=len(sess.pages))
+                    sess.busy = None
+                    sess.deadline_t = self.clock() + self.session_ttl
             self.arena.free(slot.pages, owner=req.rid)
             self.completed += 1
             if status is None:
@@ -1057,7 +1473,21 @@ class Scheduler:
         with self._lock:
             active = sum(1 for s in self._slots if s is not None)
             qlen = len(self._queue)
-        return {
+            sessions = len(self._sessions)
+            prefix = self.prefix_cache.stats() if self.prefix_cache \
+                else {"prefix_hits": 0, "prefix_misses": 0,
+                      "prefix_hit_rate": 0.0, "prefix_cached_tokens": 0,
+                      "prefix_pages": 0, "prefix_evictions": 0}
+            shared = self.arena.shared_pages()
+        out = {
+            "prefix_enabled": self.prefix_cache is not None,
+            "prefill_chunk": self.chunk_size,
+            "chunk_steps": self.chunk_steps,
+            "sessions": sessions,
+            "shared_pages": shared,
+        }
+        out.update(prefix)
+        out.update({
             "admitted": self.admitted, "rejected": self.rejected,
             "completed": self.completed,
             "tokens_generated": self.tokens_generated,
@@ -1074,7 +1504,8 @@ class Scheduler:
             "spec_accept_rate": (self.spec_accepted
                                  / float(self.spec_proposed)
                                  if self.spec_proposed else 0.0),
-        }
+        })
+        return out
 
     def _count_req(self, status):
         if not _metrics.enabled():
@@ -1106,3 +1537,7 @@ class Scheduler:
                 "mxnet_serve_batch_occupancy",
                 help="active decode slots (of max_batch)",
             ).set(sum(1 for s in self._slots if s is not None))
+            _metrics.gauge(
+                "mxnet_serve_sessions_active",
+                help="pinned chat sessions holding arena pages between "
+                     "turns").set(len(self._sessions))

@@ -43,7 +43,8 @@ from ..testing import rescheck as _rescheck
 from .arena import PagedKVArena
 from .scheduler import (Request, Scheduler, ServeCancelled,
                         ServeDeadlineExceeded, ServeDraining,
-                        ServeInternalError, ServeQueueFull, ServeShutdown,
+                        ServeInternalError, ServeQueueFull,
+                        ServeSessionBusy, ServeSessionUnknown, ServeShutdown,
                         _env_float, _env_int)
 
 _SERVER_IDS = itertools.count()
@@ -127,6 +128,23 @@ class AOTRunner:
                             positions.astype(np.int32),
                             block_tables.astype(np.int32))
         _memdump.tag(logits, origin="activation", label="verify_logits")
+        return np.asarray(logits)  # mxlint: allow-host-sync
+
+    def chunk(self, tokens, positions, block_tables):
+        """Chunked prefill: tokens (B, prefill_chunk) -> logits
+        (B, prefill_chunk, V) from the bundle's ``chunk`` executable —
+        the same multi-token shape as verify, compiled at the chunk
+        width instead of spec_k+1."""
+        exe = self._exes.get("chunk")
+        if exe is None:
+            raise MXNetError(
+                "bundle has no chunk executable — re-export with "
+                "prefill_chunk > 0 to enable chunked prefill")
+        logits = self._call(exe, "serve_chunk",
+                            tokens.astype(np.int32),
+                            positions.astype(np.int32),
+                            block_tables.astype(np.int32))
+        _memdump.tag(logits, origin="activation", label="chunk_logits")
         return np.asarray(logits)  # mxlint: allow-host-sync
 
 
@@ -312,6 +330,9 @@ class LlamaServer:
         if self._http is not None:
             self._http.shutdown()
             self._http = None
+        # shared pages (prefix cache, pinned sessions) are not "work" —
+        # flush them explicitly or the quiescence asserts below see them
+        self.scheduler.release_shared()
         if _rescheck.enabled():
             # the every-handle-kind generalization of
             # arena.assert_quiescent(): no live futures, no live pages
@@ -347,6 +368,10 @@ class LlamaServer:
                 "(MXNET_SERVE_DRAIN_TIMEOUT) with the request still "
                 "queued or in flight" % timeout), status="drained")
         _flight.record("serve.drained", stragglers=stragglers)
+        # in-flight turns are finished (or failed) by now: unpin every
+        # session and drop the prefix cache so the arena reaches true
+        # quiescence — a drained server holds zero pages
+        self.scheduler.release_shared()
         if _rescheck.enabled():
             _rescheck.assert_quiescent(scope=self.scheduler.res_scope)
             _rescheck.assert_quiescent(scope=self.arena.res_scope)
@@ -426,19 +451,29 @@ class LlamaServer:
 
     # -- request surface --------------------------------------------------
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
-               deadline_s=None):
-        """Enqueue; returns the Request future (``.result(timeout)``)."""
+               deadline_s=None, session=None):
+        """Enqueue; returns the Request future (``.result(timeout)``).
+        ``session`` is a session id from :meth:`open_session` — the turn
+        prefills only its delta on top of the pinned history."""
         if self._thread is None:
             raise MXNetError("server not started — call start() first")
         return self.scheduler.submit(
             Request(prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
-                    deadline_s=deadline_s))
+                    deadline_s=deadline_s, session_id=session))
 
     def generate(self, prompt, max_new_tokens=None, eos_id=None,
-                 timeout=300, deadline_s=None):
+                 timeout=300, deadline_s=None, session=None):
         return self.submit(prompt, max_new_tokens=max_new_tokens,
-                           eos_id=eos_id,
-                           deadline_s=deadline_s).result(timeout)
+                           eos_id=eos_id, deadline_s=deadline_s,
+                           session=session).result(timeout)
+
+    def open_session(self):
+        """Create a pinned multi-turn chat session; returns its id."""
+        return self.scheduler.open_session()
+
+    def close_session(self, session_id):
+        """Unpin a session's pages; True if it existed."""
+        return self.scheduler.close_session(session_id)
 
     def cancel(self, trace_id):
         """Cancel a queued or in-flight request by trace id (the HTTP
@@ -560,8 +595,9 @@ class LlamaServer:
 
     # -- HTTP front -------------------------------------------------------
     def serve_http(self, port=0, host="127.0.0.1"):
-        """Minimal stdlib HTTP front (POST /v1/generate, GET /metrics,
-        GET /healthz, GET /v1/trace/<id>, DELETE /v1/generate/<id>).
+        """Minimal stdlib HTTP front (POST /v1/generate, POST /v1/chat,
+        GET /metrics, GET /healthz, GET /v1/trace/<id>,
+        DELETE /v1/generate/<id>, DELETE /v1/chat/<id>).
         Returns the bound (host, port).
 
         Status mapping (ISSUE 15): draining / queue-full → 503 with a
@@ -577,7 +613,9 @@ class LlamaServer:
         def _error_code(err):
             if isinstance(err, ServeDeadlineExceeded):
                 return 504
-            if isinstance(err, ServeCancelled):
+            if isinstance(err, ServeSessionUnknown):
+                return 404
+            if isinstance(err, (ServeCancelled, ServeSessionBusy)):
                 return 409
             if isinstance(err, (ServeShutdown, ServeInternalError,
                                 ServeDraining, ServeQueueFull)):
@@ -649,17 +687,30 @@ class LlamaServer:
                     self._send(404, {"error": "not found"})
 
             def do_POST(self):
-                if self.path != "/v1/generate":
+                if self.path not in ("/v1/generate", "/v1/chat"):
                     self._send(404, {"error": "not found"})
                     return
+                chat = self.path == "/v1/chat"
+                sid = None
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     doc = json.loads(self.rfile.read(n) or b"{}")
+                    if chat:
+                        # no "session" field = first turn: open one and
+                        # return its id so the client can keep it warm
+                        sid = doc.get("session") or server.open_session()
                     req = server.submit(
                         doc["prompt"],
                         max_new_tokens=doc.get("max_new_tokens"),
                         eos_id=doc.get("eos_id"),
-                        deadline_s=doc.get("deadline_s"))
+                        deadline_s=doc.get("deadline_s"),
+                        session=sid)
+                except ServeSessionUnknown as e:
+                    self._send(404, {"error": str(e)})
+                    return
+                except ServeSessionBusy as e:
+                    self._send(409, {"error": str(e)})
+                    return
                 except (ServeDraining, ServeQueueFull) as e:
                     self._send(503, {"error": str(e)},
                                headers={"Retry-After": _retry_after_header(
@@ -684,14 +735,34 @@ class LlamaServer:
                 except MXNetError as e:
                     self._send(_error_code(req.error or e),
                                {"error": str(e),
+                                "trace_id": req.trace_id,
+                                "session": sid} if chat else
+                               {"error": str(e),
                                 "trace_id": req.trace_id})
                     return
-                self._send(200, {"tokens": tokens,
-                                 "ttft_s": req.ttft,
-                                 "trace_id": req.trace_id,
-                                 "breakdown": req.breakdown()})
+                body = {"tokens": tokens,
+                        "ttft_s": req.ttft,
+                        "trace_id": req.trace_id,
+                        "breakdown": req.breakdown()}
+                if chat:
+                    body["session"] = sid
+                self._send(200, body)
 
             def do_DELETE(self):
+                if self.path.startswith("/v1/chat/"):
+                    sid = self.path[len("/v1/chat/"):]
+                    try:
+                        closed = server.close_session(sid)
+                    except ServeSessionBusy as e:
+                        self._send(409, {"error": str(e)})
+                        return
+                    if closed:
+                        self._send(200, {"closed": sid})
+                    else:
+                        self._send(404, {"error": "no session %r "
+                                                  "(expired or never "
+                                                  "opened)" % sid})
+                    return
                 if not self.path.startswith("/v1/generate/"):
                     self._send(404, {"error": "not found"})
                     return

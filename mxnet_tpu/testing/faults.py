@@ -32,6 +32,15 @@ site               where / ctx
 ``serve_decode``   ``Scheduler._decode_once``/``_verify_once`` before the
                    batched runner call; ctx: ``batch`` — an injected
                    raise fails every active lane
+``serve_splice``   ``Scheduler._admit_head_locked`` after a prefix-cache
+                   match, before the block-table splice; ctx: ``rid``,
+                   ``pages``.  A raising action falls back to the cold
+                   prefill path (the hit is abandoned, not the request);
+                   ``kill_loop`` here dies with refcounted pages live —
+                   containment must free them exactly once
+``serve_chunk``    ``Scheduler._chunk_once`` before the batched chunk
+                   executable call; ctx: ``batch`` — an injected raise
+                   fails every mid-prefill lane
 ``client_disconnect``  polled once per scheduler step for every queued and
                    in-flight request; ctx: ``rid``, ``tid``.  A raising
                    action is swallowed and turned into

@@ -70,6 +70,18 @@ _STUB_VALUES = {"train": 100.0, "infer": 200.0, "bert": 300.0,
                                 "paged_attn_hbm_bytes_ratio": 0.6,
                                 "completed": 64, "n_requests": 64,
                                 "live_compiles": 0},
+                # prefix-cache runner (ISSUE 19): cache-on tok/s as
+                # value, the cache-off baseline + hit rate + the
+                # cached-vs-cold TTFT p50 split as extras (parity
+                # asserted in the probe)
+                "prefix": {"value": 1800.0, "prefix_off_tok_s": 1000.0,
+                           "prefix_vs_off": 1.8, "hit_rate": 0.78,
+                           "cached_tokens": 100000,
+                           "ttft_cached_p50_ms": 12.0,
+                           "ttft_cold_p50_ms": 48.0,
+                           "ttft_cached_vs_cold": 4.0,
+                           "parity_checked": 64, "completed": 64,
+                           "n_requests": 64, "live_compiles": 0},
                 # fleet runner (ISSUE 18): aggregate 3-replica tok/s as
                 # value, the N=1 router-vs-direct routing overhead and
                 # fleet TTFT p99 as extras
@@ -137,6 +149,7 @@ def test_default_mode_emits_all_metrics_in_one_line(monkeypatch, capsys):
                      "llama_serve_tok_s",
                      "llama_serve_spec_tok_s",
                      "llama_serve_paged_tok_s",
+                     "llama_serve_prefix_tok_s",
                      "fleet_serve_tok_s",
                      "planner_seconds",
                      "resnet50_cold_start_seconds",
@@ -201,6 +214,20 @@ def test_default_mode_emits_all_metrics_in_one_line(monkeypatch, capsys):
     assert spag["paged_attn_hbm_bytes_ratio"] == 0.6
     assert spag["parity_checked"] == 64
     assert spag["live_compiles"] == 0
+    # prefix-cache record (ISSUE 19): cache-on tok/s is the value; the
+    # cache-off baseline from the SAME bundle, the hit rate, and the
+    # cached-vs-cold TTFT p50 split ride along (the >=1.5x and >=3x
+    # claims are checked against these fields; parity asserted in-probe)
+    spfx = by_name["llama_serve_prefix_tok_s"]
+    assert spfx["value"] == 1800.0 and spfx["unit"] == "tokens/sec"
+    assert spfx["prefix_off_tok_s"] == 1000.0
+    assert spfx["prefix_vs_off"] == 1.8
+    assert spfx["hit_rate"] == 0.78
+    assert spfx["ttft_cached_p50_ms"] == 12.0
+    assert spfx["ttft_cold_p50_ms"] == 48.0
+    assert spfx["ttft_cached_vs_cold"] == 4.0
+    assert spfx["parity_checked"] == 64
+    assert spfx["live_compiles"] == 0
     # fleet record (ISSUE 18): aggregate tok/s over 3 replicas is the
     # value; the N=1 router-vs-direct overhead (acceptance: within 5%)
     # and the zero-loss counters ride along
@@ -230,7 +257,7 @@ def test_budget_exhaustion_marks_skipped(monkeypatch, capsys):
                       if ln.startswith("{")][-1])
     assert rec["value"] == 100.0  # headline always measured
     skipped = [m for m in rec["metrics"] if m.get("skipped")]
-    assert len(skipped) == 16
+    assert len(skipped) == 17
     assert all(m["value"] == 0.0 for m in skipped)
 
 
@@ -264,6 +291,8 @@ def test_failed_benchmark_emits_zero_not_crash(monkeypatch, capsys):
                        None),
         "serve_paged": (boom, "llama_serve_paged_tok_s", "tokens/sec",
                         None),
+        "prefix": (boom, "llama_serve_prefix_tok_s", "tokens/sec",
+                   None),
         "fleet": (boom, "fleet_serve_tok_s", "tokens/sec", None),
         "planner": (boom, "planner_seconds", "seconds", None),
         "cold_resnet50": (boom, "resnet50_cold_start_seconds", "seconds",
@@ -276,4 +305,4 @@ def test_failed_benchmark_emits_zero_not_crash(monkeypatch, capsys):
     rec = json.loads([ln for ln in capsys.readouterr().out.splitlines()
                       if ln.startswith("{")][-1])
     assert rec["value"] == 0.0 and rec["fallback"] is True
-    assert len(rec["metrics"]) == 17
+    assert len(rec["metrics"]) == 18

@@ -23,7 +23,8 @@ from mxnet_tpu.base import MXNetError
 from mxnet_tpu.serve import (FleetNoHealthyReplica, FleetRouter,
                              LocalReplica, PagedKVArena, Request,
                              ServeCancelled, ServeDeadlineExceeded,
-                             ServeDraining, ServeQueueFull, ServeShutdown,
+                             ServeDraining, ServeQueueFull,
+                             ServeSessionUnknown, ServeShutdown,
                              clamp_retry_after)
 from mxnet_tpu.serve.model import KVGeometry
 from mxnet_tpu.serve.scheduler import ServeInternalError
@@ -70,6 +71,15 @@ class StubRunner:
 
     def decode(self, tokens, positions, block_tables):
         return self._logits(self.g.max_batch)
+
+    def chunk(self, tokens, positions, block_tables):
+        b, c = tokens.shape
+        out = np.zeros((b, c, self.g.vocab_size), dtype=np.float32)
+        for i in range(b):
+            for j in range(c):
+                out[i, j, (self.calls + i + j) % self.g.vocab_size] = 1.0
+        self.calls += 1
+        return out
 
 
 def make_server(start=True, step_delay=0.0, **geom):
@@ -648,3 +658,78 @@ def test_http_client_disconnect_cancels_and_frees_pages():
         srv.drain(timeout=10)
         srv.stop()
     srv.arena.assert_quiescent()   # cancelled request's pages came back
+
+
+# -- satellite: chat-session affinity routing (ISSUE 19) -----------------
+
+def test_pick_prefers_affinity_replica_over_p2c():
+    servers, router = make_fleet(3)
+    try:
+        # the pinned replica looks WORSE than everyone else on the p2c
+        # score — its cached session pages must win anyway
+        router._states["r1"].queue_depth = 64
+        router._states["r1"].tpot = 0.05
+        router.pin_session("sess-a", "r1")
+        for _ in range(6):
+            r = router._pick(prefer=router._affinity_hint("sess-a"))
+            assert r.name == "r1"
+            router._release(r)
+    finally:
+        shutdown(router, servers)
+
+
+def test_affinity_falls_back_to_p2c_when_pinned_unroutable():
+    servers, router = make_fleet(3)
+    try:
+        router.pin_session("sess-a", "r1")
+        router._states["r1"].ejected = True
+        for _ in range(6):
+            r = router._pick(prefer=router._affinity_hint("sess-a"))
+            assert r.name in ("r0", "r2")
+            router._release(r)
+    finally:
+        shutdown(router, servers)
+
+
+def test_pin_session_rejects_unknown_replica():
+    servers, router = make_fleet(2)
+    try:
+        with pytest.raises(MXNetError, match="unknown replica"):
+            router.pin_session("sess-a", "nope")
+    finally:
+        shutdown(router, servers)
+
+
+def test_session_turns_route_to_pinning_replica():
+    servers, router = make_fleet(3, prefill_chunk=2)
+    try:
+        sid = servers[1].open_session()
+        router.pin_session(sid, "r1")
+        out1 = router.generate([1, 2, 3], max_new_tokens=2, session=sid)
+        out2 = router.generate([4, 5], max_new_tokens=2, session=sid)
+        assert len(out1) == 2 and len(out2) == 2
+        # both turns landed on the pinning replica: its scheduler holds
+        # the whole history, the other replicas served nothing
+        sess = servers[1].scheduler._sessions[sid]
+        assert len(sess.tokens) == 3 + 2 + 2 + 2
+        assert servers[0].scheduler.admitted == 0
+        assert servers[2].scheduler.admitted == 0
+        assert servers[1].close_session(sid) is True
+    finally:
+        shutdown(router, servers)
+
+
+def test_session_turn_fails_typed_when_pinned_replica_ejected():
+    servers, router = make_fleet(3, prefill_chunk=2)
+    try:
+        sid = servers[1].open_session()
+        router.pin_session(sid, "r1")
+        router._states["r1"].ejected = True
+        # p2c fallback lands on a replica without the session's pages —
+        # the failure is typed (404 semantics), never a hang or retry
+        # storm (session errors are terminal, not retryable)
+        with pytest.raises(ServeSessionUnknown):
+            router.generate([1, 2], max_new_tokens=2, session=sid)
+        assert servers[1].close_session(sid) is True
+    finally:
+        shutdown(router, servers)

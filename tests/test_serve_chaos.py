@@ -23,7 +23,7 @@ import pytest
 from mxnet_tpu.base import MXNetError
 from mxnet_tpu.serve import (PagedKVArena, Request, Scheduler,
                              ServeCancelled, ServeInternalError,
-                             ServeShutdown)
+                             ServeSessionUnknown, ServeShutdown)
 from mxnet_tpu.serve.model import KVGeometry
 from mxnet_tpu.serve.server import LlamaServer
 from mxnet_tpu.telemetry import flight as _flight
@@ -69,6 +69,15 @@ class ChaosRunner:
 
     def decode(self, tokens, positions, block_tables):
         return self._logits(self.g.max_batch)
+
+    def chunk(self, tokens, positions, block_tables):
+        b, c = tokens.shape
+        out = np.zeros((b, c, self.g.vocab_size), dtype=np.float32)
+        for i in range(b):
+            for j in range(c):
+                out[i, j, (self.calls + i + j) % self.g.vocab_size] = 1.0
+        self.calls += 1
+        return out
 
 
 def counter_clock(step=0.01):
@@ -333,3 +342,181 @@ def test_hot_swap_refuses_geometry_drift():
     g2 = tiny_geometry(page_size=8)
     with pytest.raises(MXNetError, match="page_size"):
         check_geometry(g2, g.hot_swap_pins(), origin="bundle-b")
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 19: prefix cache, chunked prefill & sessions under chaos.  The
+# shared-state faults have their own matrix because the workload is
+# different — requests must SHARE a prefix for the splice seam to carry
+# weight — and because quiescence is asserted the way the server does
+# it: flush the shared pool (cache + sessions) first, then the arena
+# must be empty.
+# ---------------------------------------------------------------------------
+SHARED = [1, 2, 3, 4, 5, 6, 7, 8]     # two full pages of common prefix
+
+
+def make_prefix_server(num_pages=12, **over):
+    g = tiny_geometry(prefill_chunk=2, num_pages=num_pages, **over)
+    arena = PagedKVArena(g)
+    srv = LlamaServer.from_parts(ChaosRunner(g), arena, queue_depth=8,
+                                 clock=counter_clock())
+    return srv, arena
+
+
+def run_prefix_scenario(rules, n_requests=6, max_new=3, num_pages=12):
+    """A shared-prefix workload (every request opens with SHARED) under
+    a seeded plan: request 0 populates the radix cache, the rest splice
+    against it — so splice/evict faults actually land on hits."""
+    srv, arena = make_prefix_server(num_pages=num_pages)
+    plan = FaultPlan(seed=SEED, rules=rules)
+    faults.install(plan)
+    try:
+        reqs = [srv.scheduler.submit(
+            Request(SHARED + [20 + i], max_new_tokens=max_new))
+            for i in range(n_requests)]
+        # one long divergent prompt rides along: it shares nothing, so
+        # paging it forces the cache to give pages back under pressure
+        # (and exercises multi-chunk prefill besides)
+        reqs.append(srv.scheduler.submit(
+            Request([10 + i for i in range(13)], max_new_tokens=max_new)))
+        drive(srv)
+    finally:
+        faults.uninstall()
+    outcomes = []
+    for r in reqs:
+        assert r.done(), "future left hanging: %s" % r.trace_id
+        outcomes.append((type(r.error).__name__ if r.error else "ok",
+                         list(r.tokens)))
+    stats = srv.scheduler.stats()
+    # shared pages (cache + pinned sessions) are released the way
+    # stop()/drain() do it — THEN every page must be home
+    srv.scheduler.release_shared()
+    srv.arena.assert_quiescent()
+    events = [(e["rule"], e["n"], e["site"]) for e in plan.events]
+    return outcomes, events, srv, stats
+
+
+PREFIX_SCENARIOS = {
+    # rules, arena num_pages
+    "splice_raise_on_hit": (
+        [{"site": "serve_splice", "action": "raise", "after": 1,
+          "times": 1}], 12),
+    "chunk_raise_mid_prefill": (
+        [{"site": "serve_chunk", "action": "raise", "after": 1,
+          "times": 1}], 12),
+    "kill_loop_shared_pages_live": (
+        [{"site": "serve_chunk", "action": "kill_loop", "after": 2,
+          "times": 1}], 12),
+    # 5 usable pages: admission must evict LRU cache pages mid-splice
+    # to page the next request, with a raise-fault coinflip on top
+    "evict_under_pressure_mid_splice": (
+        [{"site": "serve_splice", "action": "raise", "prob": 0.4,
+          "times": 2}], 6),
+}
+
+
+@pytest.mark.parametrize("name", sorted(PREFIX_SCENARIOS))
+def test_prefix_chaos_deterministic_and_leak_free(name):
+    rules, num_pages = PREFIX_SCENARIOS[name]
+    out_a, ev_a, _, _ = run_prefix_scenario(rules, num_pages=num_pages)
+    out_b, ev_b, _, _ = run_prefix_scenario(rules, num_pages=num_pages)
+    assert out_a == out_b, "same seed, different outcomes (%s)" % name
+    assert ev_a == ev_b, "same seed, different injections (%s)" % name
+    assert ev_a, "scenario %s never injected — dead rule" % name
+
+
+def test_splice_fault_falls_back_cold_and_serves():
+    rules, num_pages = PREFIX_SCENARIOS["splice_raise_on_hit"]
+    outcomes, events, srv, stats = run_prefix_scenario(
+        rules, num_pages=num_pages)
+    # abandoning the hit is invisible to the client: the request simply
+    # prefills its whole prompt cold
+    assert all(e == "ok" for e, _ in outcomes)
+    assert events and srv.healthy()
+    assert stats["prefix_hits"] >= 3      # the other hits still spliced
+    assert stats["prefix_misses"] >= 2    # the cold miss + the fallback
+
+
+def test_chunk_fault_fails_only_mid_prefill_lanes():
+    rules, num_pages = PREFIX_SCENARIOS["chunk_raise_mid_prefill"]
+    outcomes, events, srv, _ = run_prefix_scenario(
+        rules, num_pages=num_pages)
+    errs = [e for e, _ in outcomes]
+    assert "FaultInjected" in errs        # the lane(s) in the chunk call
+    assert "ok" in errs                   # queued work still served
+    assert events and srv.healthy()
+
+
+def test_kill_loop_with_refcounted_pages_contains_once():
+    rules, num_pages = PREFIX_SCENARIOS["kill_loop_shared_pages_live"]
+    outcomes, _, srv, _ = run_prefix_scenario(rules, num_pages=num_pages)
+    assert any(e == "ServeInternalError" for e, _ in outcomes)
+    assert srv._loop_restarts == 1
+    # containment reset the arena AND flushed the cache exactly once —
+    # run_prefix_scenario's release_shared + assert_quiescent would have
+    # thrown on any double-free.  The restarted loop serves cold:
+    r = srv.scheduler.submit(Request(SHARED + [30], max_new_tokens=2))
+    drive(srv)
+    assert r.error is None
+    srv.scheduler.release_shared()
+    srv.arena.assert_quiescent()
+
+
+def test_evict_under_pressure_keeps_every_page_accounted():
+    rules, num_pages = PREFIX_SCENARIOS["evict_under_pressure_mid_splice"]
+    outcomes, events, _, stats = run_prefix_scenario(
+        rules, num_pages=num_pages)
+    assert all(e in ("ok", "FaultInjected") for e, _ in outcomes)
+    assert stats["prefix_evictions"] >= 1, \
+        "5-page arena never pressured the cache — dead scenario"
+    assert events, "the coin never landed — adjust prob for this seed"
+
+
+# ---------------------------------------------------------------------------
+# sessions under chaos: TTL expiry racing drain, kill_loop with a
+# pinned session live
+# ---------------------------------------------------------------------------
+def test_session_ttl_expiry_during_drain_is_clean():
+    srv, arena = make_prefix_server()
+    sched = srv.scheduler
+    sched.session_ttl = 0.05              # a handful of counter ticks
+    sid = sched.open_session()
+    r1 = sched.submit(Request([1, 2, 3], max_new_tokens=2,
+                              session_id=sid))
+    drive(srv)
+    assert r1.error is None and sched.session_count() == 1
+    # the TTL lapses while drain is still completing in-flight work:
+    # the turn must finish (busy sessions are not reaped mid-turn) and
+    # the drain flush must then release the pinned pages exactly once
+    r2 = sched.submit(Request([7], max_new_tokens=6, session_id=sid))
+    stragglers = srv.drain(timeout=30)
+    assert stragglers == 0 and r2.error is None
+    assert sched.session_count() == 0, "drain left a session pinned"
+    assert any(e["kind"] == "session.expire"
+               for e in _flight.events(last=200))
+    arena.assert_quiescent()
+
+
+def test_kill_loop_flushes_pinned_session_typed():
+    srv, arena = make_prefix_server()
+    sched = srv.scheduler
+    sid = sched.open_session()
+    r1 = sched.submit(Request([1, 2, 3], max_new_tokens=2,
+                              session_id=sid))
+    drive(srv)
+    assert r1.error is None
+    faults.install(FaultPlan(seed=SEED, rules=[
+        {"site": "serve_step", "action": "kill_loop", "times": 1}]))
+    try:
+        r2 = sched.submit(Request([7], max_new_tokens=2,
+                                  session_id=sid))
+        drive(srv)
+    finally:
+        faults.uninstall()
+    assert isinstance(r2.error, ServeInternalError)
+    assert sched.session_count() == 0, "containment must flush sessions"
+    # the session died with the loop: the next turn is a typed 404,
+    # not a hang or a silent cold-start
+    with pytest.raises(ServeSessionUnknown):
+        sched.submit(Request([9], max_new_tokens=1, session_id=sid))
+    srv.arena.assert_quiescent()

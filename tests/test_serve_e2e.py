@@ -391,7 +391,7 @@ def test_http_generate_metrics_healthz(bundle):
         assert doc["trace_id"]
         bd = doc["breakdown"]
         assert set(bd) == {"queue_wait_s", "prefill_s", "first_decode_s",
-                           "ttft_s"}
+                           "ttft_s", "cache_hit_tokens"}
         assert bd["queue_wait_s"] >= 0 and bd["prefill_s"] >= 0
         with urllib.request.urlopen(base + "/healthz") as resp:
             stats = json.loads(resp.read())
@@ -665,6 +665,178 @@ def test_fleet_cli_sigterm_drains_and_exits_clean(bundle):
     finally:
         if proc.poll() is None:
             proc.kill()
+
+
+# -- prefix cache, chunked prefill & sessions over real bundles (ISSUE 19)
+
+@pytest.fixture(scope="module")
+def chunk_bundle(tmp_path_factory):
+    """A chunk-capable bundle: same micro net, prefill_chunk=4 adds the
+    fixed-shape chunk executable next to the bucket ladder."""
+    path = str(tmp_path_factory.mktemp("serve_chunk") / "chunk.mxaot")
+    net = micro_llama()
+    geometry = serve.export_serving_bundle(net, path, prefill_chunk=4,
+                                           **GEOM_KW)
+    return path, net, geometry
+
+
+def test_chunked_greedy_matches_full_forward(chunk_bundle):
+    """Over-bucket prompts are accepted and chunk-prefilled — and every
+    path (bucket, chunked, spliced re-run) reproduces the full-sequence
+    forward token-for-token."""
+    path, net, _ = chunk_bundle
+    with serve.LlamaServer(path) as srv:
+        assert srv.geometry.prefill_chunk == 4
+        for prompt in ([3, 1, 4, 1, 5], list(range(20)), [2] * 17):
+            got = srv.generate(prompt, max_new_tokens=6)
+            assert got == greedy_reference(net, prompt, 6), prompt
+        # a second pass over the same prompts hits the radix cache —
+        # splicing cached pages must not change a single token
+        st0 = srv.stats()
+        for prompt in ([3, 1, 4, 1, 5], list(range(20)), [2] * 17):
+            got = srv.generate(prompt, max_new_tokens=6)
+            assert got == greedy_reference(net, prompt, 6), \
+                "spliced prefix changed greedy output"
+        st1 = srv.stats()
+        assert st1["prefix_hits"] > st0["prefix_hits"]
+        assert st1["prefix_cached_tokens"] > 0
+
+
+def test_prefix_cache_on_off_token_parity(chunk_bundle, monkeypatch):
+    """The acceptance gate: greedy output identical cache-on vs
+    cache-off for a shared-prefix workload on the same bundle."""
+    path, net, _ = chunk_bundle
+    system = list(range(16))              # 4 full pages of shared prefix
+    deltas = [[20 + i] for i in range(5)]
+
+    def run(cache_on):
+        monkeypatch.setenv("MXNET_SERVE_PREFIX_CACHE",
+                           "1" if cache_on else "0")
+        with serve.LlamaServer(path) as srv:
+            outs = [srv.generate(system + d, max_new_tokens=5)
+                    for d in deltas]
+            st = srv.stats()
+        assert st["prefix_enabled"] is cache_on
+        if cache_on:
+            assert st["prefix_hits"] >= len(deltas) - 1
+        return outs
+
+    on, off = run(True), run(False)
+    assert on == off
+    for d, o in zip(deltas, on):
+        assert o == greedy_reference(net, system + d, 5)
+
+
+def test_chat_session_matches_full_transcript(chunk_bundle):
+    """A pinned session prefills only each turn's delta, yet must be
+    numerically indistinguishable from replaying the whole transcript."""
+    path, net, _ = chunk_bundle
+    with serve.LlamaServer(path) as srv:
+        sid = srv.open_session()
+        p1, p2, p3 = [3, 1, 4, 1, 5], [9, 2, 6], [5, 3]
+        out1 = srv.generate(p1, max_new_tokens=4, session=sid)
+        assert out1 == greedy_reference(net, p1, 4)
+        out2 = srv.generate(p2, max_new_tokens=4, session=sid)
+        assert out2 == greedy_reference(net, p1 + out1 + p2, 4), \
+            "turn 2 over pinned pages diverged from the full transcript"
+        out3 = srv.generate(p3, max_new_tokens=4, session=sid)
+        assert out3 == greedy_reference(
+            net, p1 + out1 + p2 + out2 + p3, 4)
+        assert srv.scheduler.session_count() == 1
+        assert srv.close_session(sid) is True
+    # stop() flushed shared state; the context manager asserted quiescence
+
+
+def test_http_chat_sessions_and_prefix_healthz(chunk_bundle):
+    path, net, _ = chunk_bundle
+    with serve.LlamaServer(path) as srv:
+        host, port = srv.serve_http(port=0)
+        base = "http://%s:%d" % (host, port)
+
+        def chat(doc):
+            body = json.dumps(doc).encode()
+            with urllib.request.urlopen(urllib.request.Request(
+                    base + "/v1/chat", data=body,
+                    headers={"Content-Type": "application/json"})) as r:
+                return json.loads(r.read())
+
+        # first turn: no session id -> the server opens one
+        d1 = chat({"prompt": [3, 1, 4], "max_new_tokens": 4})
+        sid = d1["session"]
+        assert sid and d1["tokens"] == greedy_reference(net, [3, 1, 4], 4)
+        # second turn continues the pinned session
+        d2 = chat({"prompt": [9, 2], "max_new_tokens": 4,
+                   "session": sid})
+        assert d2["session"] == sid
+        assert d2["tokens"] == greedy_reference(
+            net, [3, 1, 4] + d1["tokens"] + [9, 2], 4)
+        # the trace shows what the splice saved
+        with urllib.request.urlopen(
+                base + "/v1/trace/" + d2["trace_id"]) as r:
+            tr = json.loads(r.read())
+        assert tr["breakdown"]["cache_hit_tokens"] == 0  # session turn
+        # healthz surfaces the prefix + session telemetry
+        with urllib.request.urlopen(base + "/healthz") as r:
+            hz = json.loads(r.read())
+        assert hz["sessions"] == 1
+        assert 0.0 <= hz["prefix_hit_rate"] <= 1.0
+        assert hz["prefill_chunk"] == 4
+        # unknown session id: typed 404, not a 500
+        bad = json.dumps({"prompt": [1], "max_new_tokens": 2,
+                          "session": "nope"}).encode()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                base + "/v1/chat", data=bad))
+        assert ei.value.code == 404
+        # DELETE closes the session and releases its pages
+        close = urllib.request.Request(base + "/v1/chat/" + sid,
+                                       method="DELETE")
+        with urllib.request.urlopen(close) as r:
+            assert json.loads(r.read())["closed"] == sid
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                base + "/v1/chat/" + sid, method="DELETE"))
+        assert ei.value.code == 404
+        assert srv.scheduler.session_count() == 0
+
+
+def test_chunk_process_zero_live_compiles(chunk_bundle):
+    """The zero-live-jit claim holds with the chunk executable in the
+    loop: a fresh process serving shared-prefix traffic never compiles."""
+    path, _, _ = chunk_bundle
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXNET_TELEMETRY"] = "1"
+    proc = r"""
+import json, sys
+from mxnet_tpu import serve
+from mxnet_tpu.telemetry import metrics as M
+
+srv = serve.LlamaServer(sys.argv[1]).start()
+system = list(range(16))
+outs = [srv.generate(system + [20 + i], max_new_tokens=4, timeout=120)
+        for i in range(4)]
+st = srv.stats()
+srv.stop()
+snap = M.snapshot()
+doc = {
+    "completed": len(outs),
+    "hits": st["prefix_hits"],
+    "compiles": sum(s["value"]
+                    for s in snap.get("mxnet_compiles_total",
+                                      {}).get("series", [])),
+}
+print("RESULT " + json.dumps(doc))
+"""
+    r = subprocess.run([sys.executable, "-c", proc, path],
+                       cwd=REPO, env=env, capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 0, r.stderr
+    doc = json.loads(r.stdout.split("RESULT ", 1)[1])
+    assert doc["completed"] == 4
+    assert doc["hits"] >= 3, "shared prefix never hit the radix cache"
+    assert doc["compiles"] == 0, \
+        "a serving process must never jit, chunked prefill included"
 
 
 def test_sigterm_drains_and_exits_clean(bundle):

@@ -1,0 +1,482 @@
+"""Cross-request KV reuse unit tests (ISSUE 19): arena refcounts, the
+radix prefix cache, splice-on-admit, chunked prefill, and pinned chat
+sessions.
+
+Like test_serve.py these are deterministic and jax-light: the scheduler
+runs on the calling thread with an injected counter clock, and the
+runner is a scripted *pure* one — its logits are a function of (input
+token, position) only, the unit-level stand-in for PR 13's purity
+property (arena state is a pure function of the token stream).  That is
+what lets the parity tests assert token-for-token identical greedy
+output across the bucket-prefill, chunked, and spliced paths.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serve import (PagedKVArena, PrefixCache, Request, Scheduler,
+                             ServeSessionBusy, ServeSessionUnknown)
+from mxnet_tpu.serve.model import KVGeometry
+from mxnet_tpu.serve.prefix import CACHE_OWNER
+
+
+def tiny_geometry(**over):
+    kw = dict(num_layers=1, num_heads=2, num_kv_heads=1, head_dim=4,
+              units=8, hidden_size=16, vocab_size=32, page_size=4,
+              num_pages=9, max_pages_per_seq=4, max_batch=2,
+              prefill_buckets=(4, 8), prefill_chunk=4)
+    kw.update(over)
+    return KVGeometry(**kw)
+
+
+class PureRunner:
+    """Logits are a pure function of (input token, position) — the same
+    stream always greedy-decodes to the same tokens no matter which
+    path (bucket prefill, chunk, splice) wrote its KV."""
+
+    def __init__(self, g):
+        self.g = g
+        self.chunk_calls = []     # (positions, real-token counts) log
+        self.order = []           # call-kind sequence for interleaving
+
+    def _tok(self, token, position):
+        return (int(token) * 7 + int(position) + 3) % self.g.vocab_size
+
+    def _onehot(self, idx):
+        out = np.zeros(self.g.vocab_size, dtype=np.float32)
+        out[idx] = 1.0
+        return out
+
+    def prefill(self, bucket, tokens, length, block_row):
+        self.order.append("prefill")
+        return self._onehot(self._tok(tokens[length - 1], length - 1))
+
+    def decode(self, tokens, positions, block_tables):
+        self.order.append("decode")
+        out = np.zeros((self.g.max_batch, self.g.vocab_size),
+                       dtype=np.float32)
+        for i in range(self.g.max_batch):
+            out[i] = self._onehot(self._tok(tokens[i], positions[i]))
+        return out
+
+    def chunk(self, tokens, positions, block_tables):
+        self.order.append("chunk")
+        b, c = tokens.shape
+        self.chunk_calls.append([int(p) for p in positions])
+        out = np.zeros((b, c, self.g.vocab_size), dtype=np.float32)
+        for i in range(b):
+            for j in range(c):
+                out[i, j] = self._onehot(
+                    self._tok(tokens[i, j], positions[i] + j))
+        return out
+
+
+def counter_clock(step=0.01):
+    c = itertools.count()
+    return lambda: next(c) * step
+
+
+def make_sched(queue_depth=8, **over):
+    g = tiny_geometry(**over)
+    arena = PagedKVArena(g)
+    runner = PureRunner(g)
+    sched = Scheduler(runner, arena, queue_depth=queue_depth,
+                      clock=counter_clock())
+    return sched, runner, arena
+
+
+def run_to_completion(sched, max_steps=10_000):
+    steps = 0
+    while sched.has_work():
+        sched.step()
+        steps += 1
+        assert steps < max_steps, "scheduler failed to drain"
+    return steps
+
+
+# -- arena refcounts ------------------------------------------------------
+
+def test_retain_free_refcounted_sharing():
+    arena = PagedKVArena(tiny_geometry())
+    pages = arena.alloc(2, "req-a")
+    free0 = arena.free_pages
+    arena.retain(pages, CACHE_OWNER)
+    assert arena.refcount(pages[0]) == 2
+    assert arena.shared_pages() == 2
+    arena.free(pages, owner="req-a")
+    assert arena.free_pages == free0, "cache ref must keep pages live"
+    assert arena.shared_pages() == 0
+    arena.free(pages, owner=CACHE_OWNER)
+    assert arena.free_pages == free0 + 2, "last ref recycles"
+    arena.assert_quiescent()
+
+
+def test_free_wrong_owner_and_double_free_raise():
+    arena = PagedKVArena(tiny_geometry())
+    pages = arena.alloc(1, "req-a")
+    with pytest.raises(MXNetError, match="owned by"):
+        arena.free(pages, owner="req-b")
+    arena.retain(pages, CACHE_OWNER)
+    arena.free(pages, owner=CACHE_OWNER)
+    with pytest.raises(MXNetError, match="owned by"):
+        arena.free(pages, owner=CACHE_OWNER)  # that ref already dropped
+    arena.free(pages, owner="req-a")
+    with pytest.raises(MXNetError, match="not allocated"):
+        arena.free(pages, owner="req-a")
+    arena.assert_quiescent()
+
+
+def test_retain_unallocated_or_null_page_raises():
+    arena = PagedKVArena(tiny_geometry())
+    with pytest.raises(MXNetError, match="not allocated"):
+        arena.retain([2], CACHE_OWNER)
+    with pytest.raises(MXNetError, match="not allocated"):
+        arena.retain([0], CACHE_OWNER)  # page 0 is the reserved null page
+
+
+# -- radix prefix cache (direct) -----------------------------------------
+
+def test_radix_match_insert_and_full_hit_cap():
+    arena = PagedKVArena(tiny_geometry())   # page_size 4
+    cache = PrefixCache(arena)
+    prompt = list(range(8))
+    pages = arena.alloc(3, "req-a")         # 2 full pages + growth tail
+    assert cache.insert(prompt, pages) == 2
+    assert arena.refcount(pages[0]) == 2 and arena.refcount(pages[2]) == 1
+    hit_pages, hit = cache.match(prompt + [9, 9])
+    assert hit == 8 and hit_pages == pages[:2]
+    hit_pages, hit = cache.match(prompt[:6])   # partial second page
+    assert hit == 4 and hit_pages == pages[:1]
+    # a 100% hit is capped: the last prompt position's logits seed the
+    # first generated token, so at least one token must re-prefill
+    hit_pages, hit = cache.match(list(prompt))
+    assert hit == 4 and hit_pages == pages[:1]
+    assert cache.match([5, 5, 5, 5, 5])[1] == 0   # diverges at page 0
+    arena.free(pages, owner="req-a")
+    cache.release_all()
+    cache.assert_quiescent()
+    arena.assert_quiescent()
+
+
+def test_insert_is_idempotent_first_writer_wins():
+    arena = PagedKVArena(tiny_geometry())
+    cache = PrefixCache(arena)
+    prompt = list(range(8))
+    a = arena.alloc(2, "ra")
+    b = arena.alloc(2, "rb")
+    assert cache.insert(prompt, a) == 2
+    assert cache.insert(prompt, b) == 0   # already cached: b keeps its own
+    assert cache.match(prompt + [1])[0] == a
+    assert arena.refcount(b[0]) == 1
+    arena.free(a, owner="ra")
+    arena.free(b, owner="rb")
+    cache.release_all()
+    arena.assert_quiescent()
+
+
+def test_evict_lru_frees_only_cache_held_leaves():
+    arena = PagedKVArena(tiny_geometry())
+    cache = PrefixCache(arena)
+    a = arena.alloc(2, "ra")
+    cache.insert(list(range(8)), a)             # chain of 2
+    b = arena.alloc(1, "rb")
+    cache.insert([9, 9, 9, 9], b)               # single leaf
+    arena.free(a, owner="ra")                   # a-chain is cache-only now
+    cache.match(list(range(8)) + [1])           # touch a
+    assert cache.evict(1) == 1
+    # b would be LRU, but rb still holds its page (refcount 2) so it is
+    # NOT evictable — the evictor took the oldest refcount-1 leaf,
+    # a's tail page, instead
+    assert cache.match([9, 9, 9, 9, 1])[1] == 4
+    assert cache.match(list(range(8)) + [1])[1] == 4, "a lost its leaf"
+    assert arena.refcount(b[0]) == 2
+    arena.free(b, owner="rb")
+    cache.release_all()
+    arena.assert_quiescent()
+
+
+def test_evict_order_is_least_recently_matched():
+    arena = PagedKVArena(tiny_geometry())
+    cache = PrefixCache(arena)
+    a = arena.alloc(1, "ra")
+    cache.insert([1, 1, 1, 1], a)
+    b = arena.alloc(1, "rb")
+    cache.insert([2, 2, 2, 2], b)
+    arena.free(a, owner="ra")
+    arena.free(b, owner="rb")
+    cache.match([1, 1, 1, 1, 9])                # a is now MRU
+    assert cache.evict(1) == 1
+    assert cache.match([2, 2, 2, 2, 9])[1] == 0, "LRU chain b evicted"
+    assert cache.match([1, 1, 1, 1, 9])[1] == 4, "MRU chain a survives"
+    cache.release_all()
+    arena.assert_quiescent()
+
+
+def test_evict_refcount2_pages_are_skipped():
+    arena = PagedKVArena(tiny_geometry())
+    cache = PrefixCache(arena)
+    a = arena.alloc(2, "ra")
+    cache.insert(list(range(8)), a)
+    # the request still holds its pages: nothing is evictable
+    assert cache.evict(5) == 0
+    arena.free(a, owner="ra")
+    # leaf first, then the exposed parent
+    assert cache.evict(5) == 2
+    cache.assert_quiescent()
+    arena.assert_quiescent()
+
+
+# -- splice-on-admit ------------------------------------------------------
+
+def test_second_request_splices_cached_prefix():
+    sched, runner, arena = make_sched()
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+    a = sched.submit(Request(list(prompt), max_new_tokens=2))
+    run_to_completion(sched)
+    assert a.error is None
+    assert sched.prefix_cache.pages == 2     # both full pages cached
+    b = sched.submit(Request(prompt + [9, 10], max_new_tokens=2))
+    run_to_completion(sched)
+    assert b.error is None
+    st = sched.stats()
+    assert st["prefix_hits"] == 1 and st["prefix_misses"] == 1
+    assert st["prefix_cached_tokens"] == 8
+    assert b.cache_hit_tokens == 8
+    # the splice left only the 2-token tail to prefill: one chunk call
+    # at position 8
+    assert runner.chunk_calls and 8 in runner.chunk_calls[-1]
+    # trace surfaces the hit for the TTFT breakdown
+    tr = sched.trace(b.trace_id)
+    assert tr["breakdown"]["cache_hit_tokens"] == 8
+    sched.release_shared()
+    arena.assert_quiescent()
+
+
+def test_greedy_token_parity_cache_on_vs_off(monkeypatch):
+    prompt = list(range(8))
+
+    def serve(cache_on):
+        monkeypatch.setenv("MXNET_SERVE_PREFIX_CACHE",
+                           "1" if cache_on else "0")
+        sched, _, arena = make_sched()
+        assert (sched.prefix_cache is not None) is cache_on
+        outs = []
+        for delta in ([9, 10], [11], [12, 13], [9, 10]):
+            r = sched.submit(Request(prompt + delta, max_new_tokens=3))
+            run_to_completion(sched)
+            assert r.error is None
+            outs.append(list(r.tokens))
+        if cache_on:
+            assert sched.stats()["prefix_hits"] >= 3
+        sched.release_shared()
+        arena.assert_quiescent()
+        return outs
+
+    assert serve(True) == serve(False), \
+        "prefix cache changed greedy output"
+
+
+def test_spliced_requests_never_write_shared_pages():
+    # two concurrent requests share the same cached prefix pages: each
+    # writes only its OWN fresh tail pages (disjoint), so refcounts +
+    # full-page immutability stand in for COW
+    sched, _, arena = make_sched(num_pages=12, max_batch=2)
+    prompt = list(range(8))
+    warm = sched.submit(Request(list(prompt), max_new_tokens=1))
+    run_to_completion(sched)
+    assert warm.error is None
+    a = sched.submit(Request(prompt + [20], max_new_tokens=6))
+    b = sched.submit(Request(prompt + [21], max_new_tokens=6))
+    sched.step()                          # admit both; still decoding
+    shared = [p for p in range(1, arena.total_pages + 1)
+              if arena.refcount(p) >= 3]
+    assert len(shared) == 2, "both requests + cache share the 2 pages"
+    run_to_completion(sched)
+    assert a.error is None and b.error is None
+    assert len(a.tokens) == 6 and len(b.tokens) == 6
+    sched.release_shared()
+    arena.assert_quiescent()
+
+
+def test_admission_pressure_evicts_lru_cache_pages():
+    # usable pages = 5; the first request leaves 2 cached; the second
+    # needs 4 fresh -> the cache must give one back
+    sched, _, arena = make_sched(num_pages=6)
+    a = sched.submit(Request(list(range(8)), max_new_tokens=2))
+    run_to_completion(sched)
+    assert a.error is None and sched.prefix_cache.pages == 2
+    c = sched.submit(Request([20 + i for i in range(14)],
+                             max_new_tokens=2))
+    run_to_completion(sched)
+    assert c.error is None
+    assert sched.stats()["prefix_evictions"] >= 1
+    sched.release_shared()
+    arena.assert_quiescent()
+
+
+# -- chunked prefill ------------------------------------------------------
+
+def test_over_bucket_prompt_accepted_and_chunked():
+    sched, runner, arena = make_sched(prefill_chunk=2)
+    prompt = list(range(12))              # > max bucket (8)
+    r = sched.submit(Request(prompt, max_new_tokens=2))
+    run_to_completion(sched)
+    assert r.error is None and len(r.tokens) == 2
+    assert sched.chunk_steps == 6         # 12 tokens / chunk of 2
+    assert "prefill" not in runner.order, "no bucket call on this path"
+    sched.release_shared()
+    arena.assert_quiescent()
+
+
+def test_over_bucket_prompt_still_rejected_without_chunking():
+    sched, _, _ = make_sched(prefill_chunk=0)
+    r = sched.submit(Request(list(range(12)), max_new_tokens=2))
+    assert r.done()
+    with pytest.raises(MXNetError, match="prefill_chunk"):
+        r.result(timeout=0)
+
+
+def test_chunks_interleave_with_decode_steps():
+    sched, runner, arena = make_sched(prefill_chunk=2)
+    a = sched.submit(Request([1, 2], max_new_tokens=8))
+    sched.step()                          # a admitted + bucket-prefilled
+    b = sched.submit(Request(list(range(12)), max_new_tokens=2))
+    run_to_completion(sched)
+    assert a.error is None and b.error is None
+    chunks = [i for i, k in enumerate(runner.order) if k == "chunk"]
+    decodes = [i for i, k in enumerate(runner.order) if k == "decode"]
+    assert chunks and decodes
+    between = [d for d in decodes if chunks[0] < d < chunks[-1]]
+    assert between, ("decode steps must run BETWEEN chunk steps — the "
+                     "long prompt stalled every active lane")
+    sched.release_shared()
+    arena.assert_quiescent()
+
+
+# -- chat sessions --------------------------------------------------------
+
+def test_session_turns_prefill_only_the_delta():
+    sched, runner, arena = make_sched()
+    sid = sched.open_session()
+    r1 = sched.submit(Request([1, 2, 3], max_new_tokens=2,
+                              session_id=sid))
+    run_to_completion(sched)
+    assert r1.error is None
+    sess = sched._sessions[sid]
+    assert sess.tokens == [1, 2, 3] + r1.tokens
+    assert sess.written == 4              # final sampled token unwritten
+    n_calls = len(runner.chunk_calls)
+    r2 = sched.submit(Request([7, 8], max_new_tokens=2, session_id=sid))
+    run_to_completion(sched)
+    assert r2.error is None
+    # turn 2 prefilled the unwritten tail (1 token) + delta (2) = 3
+    # tokens in ONE chunk starting at position 4 — not the whole history
+    assert len(runner.chunk_calls) == n_calls + 1
+    assert 4 in runner.chunk_calls[-1]
+    assert sess.tokens == [1, 2, 3] + r1.tokens + [7, 8] + r2.tokens
+    assert sched.close_session(sid) is True
+    sched.release_shared()
+    arena.assert_quiescent()
+
+
+def test_session_parity_with_stateless_full_history():
+    # a chat turn over pinned pages must produce the same greedy tokens
+    # as a stateless request carrying the full transcript
+    sched, _, arena = make_sched()
+    sid = sched.open_session()
+    r1 = sched.submit(Request([1, 2, 3], max_new_tokens=2,
+                              session_id=sid))
+    run_to_completion(sched)
+    r2 = sched.submit(Request([7, 8], max_new_tokens=3, session_id=sid))
+    run_to_completion(sched)
+    assert r1.error is None and r2.error is None
+    sched2, _, arena2 = make_sched()
+    full = [1, 2, 3] + list(r1.tokens) + [7, 8]
+    ref = sched2.submit(Request(full, max_new_tokens=3))
+    run_to_completion(sched2)
+    assert ref.error is None
+    assert list(r2.tokens) == list(ref.tokens), \
+        "session delta-prefill diverged from full-history prefill"
+    for s, a in ((sched, arena), (sched2, arena2)):
+        s.release_shared()
+        a.assert_quiescent()
+
+
+def test_session_is_serial_and_unknown_is_typed():
+    sched, _, arena = make_sched()
+    sid = sched.open_session()
+    sched.submit(Request([1, 2], max_new_tokens=4, session_id=sid))
+    with pytest.raises(ServeSessionBusy, match="serial"):
+        sched.submit(Request([3], max_new_tokens=2, session_id=sid))
+    run_to_completion(sched)
+    with pytest.raises(ServeSessionUnknown, match="unknown session"):
+        sched.submit(Request([3], max_new_tokens=2, session_id="nope"))
+    assert sched.close_session(sid) is True
+    assert sched.close_session(sid) is False
+    sched.release_shared()
+    arena.assert_quiescent()
+
+
+def test_sessions_need_chunked_bundle():
+    sched, _, _ = make_sched(prefill_chunk=0)
+    with pytest.raises(MXNetError, match="prefill_chunk"):
+        sched.open_session()
+
+
+def test_session_ttl_reaps_idle_sessions():
+    sched, _, arena = make_sched()
+    sched.session_ttl = 0.05              # ~5 counter-clock ticks
+    sid = sched.open_session()
+    r = sched.submit(Request([1, 2, 3], max_new_tokens=2,
+                             session_id=sid))
+    run_to_completion(sched)
+    assert r.error is None and sched.session_count() == 1
+    held = arena.total_pages - arena.free_pages
+    assert held > 0, "an idle session must pin its pages"
+    for _ in range(30):
+        sched.step()
+    assert sched.session_count() == 0, "TTL reaper missed the session"
+    with pytest.raises(ServeSessionUnknown, match="expired"):
+        sched.submit(Request([9], max_new_tokens=1, session_id=sid))
+    sched.release_shared()
+    arena.assert_quiescent()
+
+
+def test_busy_session_never_expires_mid_turn():
+    sched, _, arena = make_sched()
+    sched.session_ttl = 0.01              # expires after ONE clock tick
+    sid = sched.open_session()
+    r = sched.submit(Request([1, 2, 3], max_new_tokens=4,
+                             session_id=sid))
+    run_to_completion(sched)              # many ticks pass mid-turn
+    assert r.error is None, "the reaper must skip busy sessions"
+    sched.release_shared()
+    arena.assert_quiescent()
+
+
+def test_swap_and_release_shared_flush_everything():
+    sched, _, arena = make_sched()
+    sid = sched.open_session()
+    r1 = sched.submit(Request([1, 2, 3], max_new_tokens=2,
+                              session_id=sid))
+    warm = sched.submit(Request(list(range(8)), max_new_tokens=1))
+    run_to_completion(sched)
+    assert r1.error is None and warm.error is None
+    assert sched.session_count() == 1 and sched.prefix_cache.pages > 0
+    sched.release_shared()
+    assert sched.session_count() == 0 and sched.prefix_cache.pages == 0
+    arena.assert_quiescent()
+
+
+def test_stats_expose_prefix_and_session_fields():
+    sched, _, _ = make_sched()
+    st = sched.stats()
+    for key in ("prefix_enabled", "prefill_chunk", "chunk_steps",
+                "sessions", "shared_pages", "prefix_hits",
+                "prefix_misses", "prefix_hit_rate",
+                "prefix_cached_tokens", "prefix_pages",
+                "prefix_evictions"):
+        assert key in st, key
+    assert st["prefix_enabled"] is True and st["prefill_chunk"] == 4
