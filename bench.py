@@ -1381,7 +1381,10 @@ def _fleet_probe(path):
     Aggregate tok/s is the headline; TTFT p99 across the fleet rides
     along.  A second pass measures the ROUTING TAX: the same workload
     through a router fronting ONE replica vs directly through that
-    replica's scheduler (acceptance: within 5%).  The process must
+    replica's scheduler (acceptance: within 5%).  A third pass measures
+    the OBSERVABILITY TAX: the same 3-replica fleet with telemetry +
+    flight recorder disabled (acceptance: on/off gap <= 3%, the
+    standing gate from docs/observability.md).  The process must
     perform zero live compiles — nonzero means the AOT warm start
     regressed and every number here is polluted by jit time."""
     from mxnet_tpu import serve
@@ -1430,9 +1433,31 @@ def _fleet_probe(path):
     overhead_pct = (round((1.0 - router1_rate / direct_rate) * 100.0, 2)
                     if direct_rate else 0.0)
 
+    # compile census BEFORE the observability-off pass: a disabled
+    # registry records nothing, so this snapshot covers every pass that
+    # could have compiled (all replicas load the same warm bundle)
     snap = telemetry_metrics.snapshot()
     compiles = sum(s["value"] for s in snap.get(
         "mxnet_compiles_total", {}).get("series", []))
+
+    # OBSERVABILITY TAX: the same 3-replica fleet with metrics + flight
+    # recorder OFF — the fleet twin of dispatch_eager_notelemetry, and
+    # the number the standing <=3% observability-overhead gate tracks
+    from mxnet_tpu import telemetry as _telemetry
+    from mxnet_tpu.telemetry import flight as _flight
+    was_on, flight_on = _telemetry.enabled(), _flight.enabled()
+    _telemetry.disable()
+    _flight.disable()
+    try:
+        notel_rate, _, _, _ = fleet_rates(3)
+    finally:
+        if was_on:
+            _telemetry.enable()
+        if flight_on:
+            _flight.enable()
+    obs_overhead_pct = (round((1.0 - fleet_rate / notel_rate) * 100.0, 2)
+                        if notel_rate else 0.0)
+
     completed = len([f for f in futs if f.error is None])
     doc = {
         "fleet_tok_s": round(fleet_rate, 2),
@@ -1446,6 +1471,8 @@ def _fleet_probe(path):
         "direct_tok_s": round(direct_rate, 2),
         "router1_tok_s": round(router1_rate, 2),
         "routing_overhead_pct": overhead_pct,
+        "fleet_notelemetry_tok_s": round(notel_rate, 2),
+        "obs_overhead_pct": obs_overhead_pct,
         "live_compiles": int(compiles),
     }
     print("FLEET_RESULT=%s" % json.dumps(doc), flush=True)
@@ -1478,12 +1505,14 @@ def _run_fleet(platform):
     _log("fleet: %.1f tok/s over %d replicas, ttft p99 %.1f ms, "
          "%d/%d completed (%d retried, %d ejections, %d dropped), "
          "routing overhead %.1f%% (router@1 %.1f vs direct %.1f tok/s), "
-         "%d live compiles"
+         "observability overhead %.1f%% (vs %.1f tok/s with telemetry "
+         "off), %d live compiles"
          % (doc["fleet_tok_s"], doc["n_replicas"], doc["ttft_p99_ms"],
             doc["completed"], doc["n_requests"], doc["retried"],
             doc["ejections"], doc["dropped"],
             doc["routing_overhead_pct"], doc["router1_tok_s"],
-            doc["direct_tok_s"], doc["live_compiles"]))
+            doc["direct_tok_s"], doc["obs_overhead_pct"],
+            doc["fleet_notelemetry_tok_s"], doc["live_compiles"]))
     return {"value": doc["fleet_tok_s"],
             "n_replicas": doc["n_replicas"],
             "ttft_p99_ms": doc["ttft_p99_ms"],
@@ -1495,6 +1524,8 @@ def _run_fleet(platform):
             "direct_tok_s": doc["direct_tok_s"],
             "router1_tok_s": doc["router1_tok_s"],
             "routing_overhead_pct": doc["routing_overhead_pct"],
+            "fleet_notelemetry_tok_s": doc["fleet_notelemetry_tok_s"],
+            "obs_overhead_pct": doc["obs_overhead_pct"],
             "live_compiles": doc["live_compiles"]}
 
 

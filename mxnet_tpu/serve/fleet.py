@@ -57,6 +57,28 @@ Telemetry: ``mxnet_fleet_requests_total{replica,status}``,
 ``mxnet_fleet_replicas_healthy``, ``mxnet_fleet_route_queue_depth``,
 plus ``fleet.*`` flight events (retry/hedge/eject/readmit/deploy).
 
+The fleet observability plane (ISSUE 20, docs/observability.md "Fleet
+observability") rides on three seams here:
+
+* **Distributed tracing.**  ``submit()`` mints a fleet trace id
+  (``f<pid>-<n>``) that replicas stamp into ``Request.trace_id``
+  (in-process) or receive via an ``X-MXNet-Trace`` header (HTTP), so
+  one id correlates router and replica flight events.  Every attempt —
+  retry, hedge, cancellation-of-loser — records an attributed
+  ``fleet.attempt``/``fleet.hedge``/``fleet.cancel`` event (attempt
+  index, replica, role, duration), and ``GET /v1/trace/<id>`` prepends
+  the routing breakdown to the owning replica's stored trace.
+* **Metric aggregation.**  The prober scrapes each replica's metrics
+  every ``MXNET_FLEET_METRICS_EVERY``-th probe;
+  ``fleet_metrics_snapshot()`` merges them via
+  ``telemetry.aggregate`` (counters sum, gauges keep per-replica
+  series, histograms merge bucket-wise) and the fleet ``GET /metrics``
+  serves the merged exposition.
+* **SLO engine.**  ``attach_slo()`` (or ``MXNET_FLEET_SLO``) evaluates
+  declarative objectives over the aggregated stream each probe sweep;
+  with ``MXNET_FLEET_SLO_SHED`` the fast-window burn alert sheds
+  optional work — hedging turns off until the alert clears.
+
 Replicas can be in-process ``LlamaServer`` objects (the bench and chaos
 matrix run 3 in one process) or ``http://host:port`` bases fronting
 remote servers; both hide behind the same probe/submit/cancel surface.
@@ -64,7 +86,9 @@ remote servers; both hide behind the same probe/submit/cancel surface.
 from __future__ import annotations
 
 import collections
+import itertools
 import json
+import os
 import random
 import threading
 import time
@@ -72,8 +96,10 @@ import urllib.error
 import urllib.request
 
 from ..base import MXNetError, env_flag
+from ..telemetry import aggregate as _aggregate
 from ..telemetry import flight as _flight
 from ..telemetry import metrics as _metrics
+from ..telemetry import slo as _slo
 from ..testing import faults as _faults
 from ..testing import lockcheck as _lockcheck
 from ..testing import rescheck as _rescheck
@@ -181,8 +207,18 @@ class LocalReplica:
     def probe(self):
         return self.server.healthz()
 
+    def metrics(self):
+        """Per-replica metrics scrape.  In-process replicas share ONE
+        registry, so scraping it per replica would multiply every count
+        by N — the per-server scheduler aggregates are the only honest
+        per-replica numbers here (``aggregate.snapshot_from_stats``)."""
+        return _aggregate.snapshot_from_stats(self.server.stats())
+
+    def trace(self, trace_id):
+        return self.server.scheduler.trace(trace_id)
+
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
-               deadline_s=None, session=None):
+               deadline_s=None, session=None, trace_id=None):
         _faults.maybe_inject("replica_slow", replica=self.name)
         try:
             _faults.maybe_inject("replica_kill", replica=self.name)
@@ -199,7 +235,8 @@ class LocalReplica:
             return _HungHandle(self.name)
         req = self.server.scheduler.submit(
             Request(prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
-                    deadline_s=deadline_s, session_id=session))
+                    deadline_s=deadline_s, session_id=session,
+                    trace_id=trace_id))
         return _LocalHandle(self, req)
 
     def cancel(self, trace_id):
@@ -217,10 +254,14 @@ class _HttpHandle:
     """An in-flight request on a remote replica: one daemon thread owns
     the blocking POST; the handle mirrors the Request-future surface."""
 
-    def __init__(self, replica, doc, timeout, path="/v1/generate"):
+    def __init__(self, replica, doc, timeout, path="/v1/generate",
+                 fleet_trace_id=None):
         self._replica = replica
         self._path = path
-        self.trace_id = None
+        # the fleet trace id is addressable for cancellation even before
+        # the response echoes one back (hedging cancels losers mid-POST)
+        self.trace_id = fleet_trace_id
+        self._fleet_trace_id = fleet_trace_id
         self.error = None
         self.ttft = None
         self.tokens = None
@@ -233,13 +274,16 @@ class _HttpHandle:
     def _run(self, doc, timeout):
         try:
             body = json.dumps(doc).encode()
+            headers = {"Content-Type": "application/json"}
+            if self._fleet_trace_id:
+                headers["X-MXNet-Trace"] = self._fleet_trace_id
             req = urllib.request.Request(
                 self._replica.base_url + self._path, data=body,
-                headers={"Content-Type": "application/json"})
+                headers=headers)
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 out = json.loads(resp.read())
             self.tokens = out["tokens"]
-            self.trace_id = out.get("trace_id")
+            self.trace_id = out.get("trace_id") or self._fleet_trace_id
             self.ttft = out.get("ttft_s")
         except urllib.error.HTTPError as e:
             self.error = _error_from_http(e)
@@ -310,14 +354,33 @@ class HttpReplica:
             # 503 still carries the healthz body (ok=False / draining)
             return json.loads(e.read())
 
+    def metrics(self):
+        """Scrape the replica's full registry snapshot
+        (``GET /metrics.json``) — a remote replica is its own process,
+        so the whole registry is honestly per-replica."""
+        with urllib.request.urlopen(self.base_url + "/metrics.json",
+                                    timeout=self._probe_timeout) as r:
+            return json.loads(r.read())
+
+    def trace(self, trace_id):
+        """The replica's stored per-request trace; None when unknown."""
+        try:
+            with urllib.request.urlopen(
+                    self.base_url + "/v1/trace/" + trace_id,
+                    timeout=self._probe_timeout) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError:
+            return None
+
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
-               deadline_s=None, session=None):
+               deadline_s=None, session=None, trace_id=None):
         doc = {"prompt": prompt, "max_new_tokens": max_new_tokens,
                "eos_id": eos_id, "deadline_s": deadline_s}
         if session is not None:
             doc["session"] = session
-            return _HttpHandle(self, doc, timeout=300, path="/v1/chat")
-        return _HttpHandle(self, doc, timeout=300)
+            return _HttpHandle(self, doc, timeout=300, path="/v1/chat",
+                               fleet_trace_id=trace_id)
+        return _HttpHandle(self, doc, timeout=300, fleet_trace_id=trace_id)
 
     def cancel(self, trace_id):
         if trace_id is None:
@@ -347,7 +410,8 @@ class _ReplicaState:
 
     __slots__ = ("ok", "draining", "deploying", "ejected", "failures",
                  "queue_depth", "tpot", "inflight", "not_before_route",
-                 "half_open_at", "bundle_sha", "last_error", "probes")
+                 "half_open_at", "bundle_sha", "last_error", "probes",
+                 "arena_util", "metrics_snap", "metrics_t")
 
     def __init__(self):
         self.ok = True            # optimistic until the first probe
@@ -363,6 +427,9 @@ class _ReplicaState:
         self.bundle_sha = None
         self.last_error = None
         self.probes = 0
+        self.arena_util = 0.0
+        self.metrics_snap = None  # last /metrics scrape (lower cadence)
+        self.metrics_t = 0.0
 
 
 class _FleetFuture:
@@ -382,6 +449,7 @@ class _FleetFuture:
         self.error = None
         self.replica = None
         self.ttft = None
+        self.trace_id = kwargs.get("trace_id")
         self._lock = threading.Lock()
         self._resolved = False
         self._res = _rescheck.acquire("future", "fleet-req",
@@ -474,6 +542,16 @@ class FleetRouter:
         self.hedged = 0
         self.ejections = 0
         self.dropped = 0   # requests failed by a drain sweep (shutdown)
+        # observability plane (ISSUE 20): trace store, metrics-scrape
+        # cadence, optional SLO engine + shed state
+        self.metrics_every = max(1, _env_int("MXNET_FLEET_METRICS_EVERY",
+                                             4))
+        self._trace_ids = itertools.count()
+        self._trace_cap = _env_int("MXNET_FLEET_TRACE_CAP", 512)
+        self._rtraces = collections.OrderedDict()
+        self._slo = None
+        self._shedding = False
+        self._hedge_saved = None
 
     @staticmethod
     def _wrap(replica, index):
@@ -489,6 +567,12 @@ class FleetRouter:
         first request), then start the background prober — unless the
         caller drives ``probe_all()`` itself (the chaos matrix does,
         for determinism)."""
+        spec = os.environ.get("MXNET_FLEET_SLO")
+        if spec and self._slo is None:
+            objectives = _slo.parse_objectives(spec)
+            if objectives:
+                self.attach_slo(_slo.SLOEngine(objectives=objectives,
+                                               clock=self._clock))
         self.probe_all()
         if poller and self.probe_interval > 0 and self._poll_thread is None:
             self._stop.clear()
@@ -524,12 +608,16 @@ class FleetRouter:
         self.stop()
 
     # -- probing + circuit breaker ---------------------------------------
-    def probe_all(self):
+    def probe_all(self, metrics=False):
+        """One probe sweep.  ``metrics=True`` forces the lower-cadence
+        metrics scrape on every replica this sweep (tests and the fleet
+        ``/metrics`` endpoint's first serve use it)."""
         for r in self._replicas:
-            self._probe_one(r)
+            self._probe_one(r, force_metrics=metrics)
         self._update_healthy_gauge()
+        self._slo_tick()
 
-    def _probe_one(self, replica):
+    def _probe_one(self, replica, force_metrics=False):
         now = self._clock()
         with self._lock:
             st = self._states[replica.name]
@@ -550,6 +638,7 @@ class FleetRouter:
             st.probes += 1
             st.queue_depth = int(doc.get("queue_depth", 0))
             st.tpot = float(doc.get("tpot_p50_s") or 0.0)
+            st.arena_util = float(doc.get("arena_utilization") or 0.0)
             st.draining = bool(doc.get("draining", False))
             st.bundle_sha = doc.get("bundle_sha")
             ok = bool(doc.get("ok", False))
@@ -568,11 +657,40 @@ class FleetRouter:
                 st.last_error = doc.get("last_loop_error")
                 readmitted = False
             draining = st.draining
+            # metrics ride the healthz prober at 1/Nth cadence: a scrape
+            # is heavier than a probe (full registry vs one doc), and
+            # gauges staler than a few probe intervals still aggregate
+            scrape = ok and (force_metrics or st.metrics_snap is None
+                             or st.probes % self.metrics_every == 0)
         if readmitted:
             _flight.record("fleet.readmit", replica=replica.name)
+        if scrape:
+            self._scrape_metrics(replica)
         if ok and not draining:
             return
         self._maybe_eject(replica, "unhealthy")
+
+    def _scrape_metrics(self, replica):
+        try:
+            snap = replica.metrics()
+        except Exception as e:  # noqa: BLE001 — scrape must never kill probe
+            _flight.record("fleet.scrape_error", replica=replica.name,
+                           error="%s: %s" % (type(e).__name__, e))
+            return
+        with self._lock:
+            st = self._states[replica.name]
+            st.metrics_snap = snap
+            st.metrics_t = self._clock()
+
+    def _slo_tick(self):
+        if self._slo is None:
+            return
+        try:
+            self._slo.observe(self.fleet_metrics_snapshot(),
+                              now=self._clock())
+        except Exception as e:  # noqa: BLE001 — the prober must survive
+            _flight.record("slo.error",
+                           error="%s: %s" % (type(e).__name__, e))
 
     def _maybe_eject(self, replica, reason):
         with self._lock:
@@ -605,6 +723,164 @@ class FleetRouter:
             "mxnet_fleet_replicas_healthy",
             help="replicas currently routable (not ejected/draining)"
         ).set(n)
+
+    # -- fleet metric aggregation -----------------------------------------
+    def fleet_metrics_snapshot(self):
+        """The fleet-wide merged snapshot: per-replica scrapes merged
+        with aggregate semantics (counters sum, gauges per-replica,
+        histograms bucket-wise), overlaid with the router's own
+        registry for families no scrape carries (``mxnet_fleet_*``,
+        ``mxnet_slo_*``, and — in-process — the shared histograms)."""
+        missing = []
+        with self._lock:
+            snaps = {}
+            now = self._clock()
+            for r in self._replicas:
+                st = self._states[r.name]
+                if st.metrics_snap is not None:
+                    snaps[r.name] = st.metrics_snap
+                elif self._routable(st, now):
+                    missing.append(r)
+        for r in missing:   # first serve before any prober pass
+            self._scrape_metrics(r)
+        if missing:
+            with self._lock:
+                for r in missing:
+                    snap = self._states[r.name].metrics_snap
+                    if snap is not None:
+                        snaps[r.name] = snap
+        merged = _aggregate.merge_snapshots(snaps)
+        return _aggregate.overlay(merged, _metrics.snapshot())
+
+    # -- SLO engine --------------------------------------------------------
+    def attach_slo(self, engine, shed=None):
+        """Evaluate ``engine`` over the aggregated stream on every probe
+        sweep.  ``shed`` (default ``MXNET_FLEET_SLO_SHED``) turns on the
+        shed hook: hedging — optional work — is disabled while any
+        objective's fast window burns, restored when the alert clears.
+        Returns the engine."""
+        if shed is None:
+            shed = env_flag("MXNET_FLEET_SLO_SHED", False)
+        self._slo = engine
+        if shed:
+            prev_burn, prev_clear = engine._on_burn, engine._on_clear
+
+            def on_burn(name):
+                self._shed(True, name)
+                if prev_burn is not None:
+                    prev_burn(name)
+
+            def on_clear(name):
+                self._shed(False, name)
+                if prev_clear is not None:
+                    prev_clear(name)
+
+            engine._on_burn, engine._on_clear = on_burn, on_clear
+        return engine
+
+    def _shed(self, burning, slo_name):
+        with self._lock:
+            if burning and not self._shedding:
+                self._shedding = True
+                self._hedge_saved = self.hedge
+                self.hedge = False
+            elif not burning and self._shedding \
+                    and not self._slo.burning():
+                self._shedding = False
+                self.hedge = self._hedge_saved
+            else:
+                return
+            hedge = self.hedge
+        _flight.record("fleet.shed", slo=slo_name,
+                       shedding=bool(burning), hedge=bool(hedge))
+
+    # -- distributed tracing ----------------------------------------------
+    def _mint_trace(self):
+        """Mint a fleet trace id and open its routing-breakdown record.
+        The id flows to replicas (in-process / ``X-MXNet-Trace``) so
+        ONE id correlates router spans and replica scheduler events."""
+        tid = "f%x-%x" % (os.getpid(), next(self._trace_ids))
+        with self._lock:
+            self._rtraces[tid] = {
+                "trace_id": tid, "t0": self._clock(), "status": "submitted",
+                "replica": None, "queue_at_router_s": None,
+                "total_s": None, "attempts": [], "hedge": None,
+            }
+            while len(self._rtraces) > self._trace_cap:
+                self._rtraces.popitem(last=False)
+        _flight.record("fleet.submit", tid=tid)
+        return tid
+
+    def _rtrace(self, tid):
+        return self._rtraces.get(tid) if tid else None
+
+    def _trace_attempt(self, tid, replica, attempt, role, outcome, t_att):
+        """One settled attempt — retry, hedge, or winner — as an
+        attributed span: a ``fleet.attempt`` flight event carrying
+        ``dur_s`` (rendered as a chrome span on the replica's row) and
+        a row in the routing breakdown."""
+        now = self._clock()
+        dur = max(0.0, now - t_att)
+        _flight.record("fleet.attempt", tid=tid or "", replica=replica,
+                       attempt=attempt, role=role, outcome=outcome,
+                       dur_s=round(dur, 6))
+        with self._lock:
+            tr = self._rtrace(tid)
+            if tr is not None:
+                tr["attempts"].append(
+                    {"t": round(t_att - tr["t0"], 6), "replica": replica,
+                     "attempt": attempt, "role": role, "outcome": outcome,
+                     "dur_s": round(dur, 6)})
+
+    def _trace_routed(self, tid):
+        """First successful hand-off to a replica: the queue-at-router
+        segment of the breakdown ends here."""
+        with self._lock:
+            tr = self._rtrace(tid)
+            if tr is not None and tr["queue_at_router_s"] is None:
+                tr["queue_at_router_s"] = round(
+                    self._clock() - tr["t0"], 6)
+                tr["status"] = "routed"
+
+    def _finish_trace(self, tid, status, winner=None):
+        """Terminal state of the fleet-side request: stamps the
+        breakdown and records the router-row ``fleet.request`` span."""
+        total = None
+        with self._lock:
+            tr = self._rtrace(tid)
+            if tr is not None:
+                total = round(self._clock() - tr["t0"], 6)
+                tr["status"] = status
+                tr["replica"] = winner
+                tr["total_s"] = total
+        if total is not None:
+            _flight.record("fleet.request", tid=tid, status=status,
+                           winner=winner or "", dur_s=total)
+
+    def trace(self, trace_id):
+        """Fleet-level ``GET /v1/trace/<id>``: the routing breakdown
+        (queue-at-router, every attempt, hedge fire time) prepended to
+        the owning replica's stored trace.  None when unknown."""
+        with self._lock:
+            tr = self._rtrace(trace_id)
+            if tr is None:
+                return None
+            tr = dict(tr)
+            tr["attempts"] = [dict(a) for a in tr["attempts"]]
+            if tr["hedge"] is not None:
+                tr["hedge"] = dict(tr["hedge"])
+        owner = tr.get("replica")
+        if owner is None and tr["attempts"]:
+            owner = tr["attempts"][-1]["replica"]
+        doc = {"trace_id": trace_id, "fleet": tr, "replica": owner,
+               "replica_trace": None}
+        rep = next((r for r in self._replicas if r.name == owner), None)
+        if rep is not None:
+            try:
+                doc["replica_trace"] = rep.trace(trace_id)
+            except Exception:  # noqa: BLE001 — breakdown still useful alone
+                pass
+        return doc
 
     # -- routing ----------------------------------------------------------
     def _routable(self, st, now):
@@ -719,11 +995,12 @@ class FleetRouter:
         ``.result(timeout)`` drives the retry/hedge state machine.
         ``session`` is a chat-session affinity hint: the turn routes to
         the replica that pinned the session's pages when that replica is
-        routable, falling back to p2c otherwise."""
+        routable, falling back to p2c otherwise.  The future carries the
+        fleet trace id (``.trace_id``) for ``GET /v1/trace/<id>``."""
         return _FleetFuture(self, dict(
             prompt=prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
             deadline_s=deadline_s, timeout=timeout, idempotent=idempotent,
-            session=session))
+            session=session, trace_id=self._mint_trace()))
 
     def _eager_submit(self, kwargs, deadline_t):
         """Attempt 0 on the submitter's thread: route and enqueue now so
@@ -748,7 +1025,9 @@ class FleetRouter:
                 kwargs["prompt"],
                 max_new_tokens=kwargs.get("max_new_tokens"),
                 eos_id=kwargs.get("eos_id"), deadline_s=remaining,
-                session=kwargs.get("session"))
+                session=kwargs.get("session"),
+                trace_id=kwargs.get("trace_id"))
+            self._trace_routed(kwargs.get("trace_id"))
             return (replica, handle, None)
         except Exception as e:  # noqa: BLE001 — classified in _generate
             return (replica, None, e)
@@ -760,7 +1039,8 @@ class FleetRouter:
         return self._generate(None, prompt, max_new_tokens=max_new_tokens,
                               eos_id=eos_id, deadline_s=deadline_s,
                               timeout=timeout, idempotent=idempotent,
-                              session=session)
+                              session=session,
+                              trace_id=self._mint_trace())
 
     @staticmethod
     def _retry_reason(err):
@@ -789,7 +1069,10 @@ class FleetRouter:
 
     def _generate(self, future, prompt, max_new_tokens=None, eos_id=None,
                   deadline_s=None, timeout=300, idempotent=True,
-                  session=None, _first=None, _deadline_t=None, _t0=None):
+                  session=None, trace_id=None, _first=None,
+                  _deadline_t=None, _t0=None):
+        if trace_id is None:
+            trace_id = self._mint_trace()
         if _deadline_t is not None:
             deadline_t = _deadline_t
         else:
@@ -808,6 +1091,7 @@ class FleetRouter:
                         self._release(first[0])
                     with self._lock:
                         self.failed += 1
+                    self._finish_trace(trace_id, "deadline")
                     raise last_err if isinstance(
                         last_err, ServeDeadlineExceeded) else \
                         ServeDeadlineExceeded(
@@ -820,8 +1104,9 @@ class FleetRouter:
                 if attempt >= self.retries:
                     with self._lock:
                         self.failed += 1
+                    self._finish_trace(trace_id, "no_replica")
                     raise e
-                self._count_retry("no_replica", None, attempt)
+                self._count_retry("no_replica", None, attempt, trace_id)
                 self._sleep(self._backoff(attempt))
                 tried = set()
                 continue
@@ -835,8 +1120,10 @@ class FleetRouter:
                     if attempt >= self.retries:
                         with self._lock:
                             self.failed += 1
+                        self._finish_trace(trace_id, "no_replica")
                         raise
-                    self._count_retry("no_replica", None, attempt)
+                    self._count_retry("no_replica", None, attempt,
+                                      trace_id)
                     self._sleep(self._backoff(attempt))
                     # a fully-gated fleet may recover: forget per-attempt
                     # exclusions so a re-admitted replica is pickable
@@ -845,6 +1132,9 @@ class FleetRouter:
             else:
                 replica = first[0]
             tried.add(replica.name)
+            # the eager attempt's span starts at submit() time (t0);
+            # a retry attempt starts here
+            t_att = t0 if first is not None else self._clock()
             try:
                 if first is not None:
                     handle = first[1]
@@ -858,16 +1148,23 @@ class FleetRouter:
                                             max_new_tokens=max_new_tokens,
                                             eos_id=eos_id,
                                             deadline_s=remaining,
-                                            session=session)
+                                            session=session,
+                                            trace_id=trace_id)
+                    self._trace_routed(trace_id)
                 tokens, winner = self._await(handle, replica, tried,
                                              remaining, timeout,
                                              dict(prompt=prompt,
                                                   max_new_tokens=max_new_tokens,
                                                   eos_id=eos_id,
-                                                  session=session))
+                                                  session=session,
+                                                  trace_id=trace_id,
+                                                  attempt=attempt))
             except (MXNetError, _faults.FaultInjected) as e:
                 self._release(replica)
                 reason = self._retry_reason(e)
+                self._trace_attempt(trace_id, replica.name, attempt,
+                                    "primary", reason or type(e).__name__,
+                                    t_att)
                 retry_after = getattr(e, "retry_after_s", None)
                 if retry_after is not None:
                     self._gate(replica, retry_after)
@@ -883,13 +1180,16 @@ class FleetRouter:
                         if isinstance(e, ServeShutdown):
                             self.dropped += 1
                     last_err = e
+                    self._finish_trace(trace_id, type(e).__name__)
                     raise
                 last_err = e
-                self._count_retry(reason, replica.name, attempt)
+                self._count_retry(reason, replica.name, attempt, trace_id)
                 self._sleep(self._backoff(attempt))
                 continue
             except (ConnectionError, TimeoutError, OSError) as e:
                 self._release(replica)
+                self._trace_attempt(trace_id, replica.name, attempt,
+                                    "primary", "connection", t_att)
                 self._note_transport_failure(
                     replica, "%s: %s" % (type(e).__name__, e))
                 self._count_request(replica.name, "connection")
@@ -898,14 +1198,20 @@ class FleetRouter:
                 if attempt >= self.retries or not idempotent:
                     with self._lock:
                         self.failed += 1
+                    self._finish_trace(trace_id, "unreachable")
                     raise MXNetError(
                         "replica %s unreachable after %d attempt(s): %s"
                         % (replica.name, attempt + 1, e))
                 last_err = e
-                self._count_retry("connection", replica.name, attempt)
+                self._count_retry("connection", replica.name, attempt,
+                                  trace_id)
                 self._sleep(self._backoff(attempt))
                 continue
             self._release(replica)
+            self._trace_attempt(trace_id, replica.name, attempt, "primary",
+                                "ok" if winner.name == replica.name
+                                else "lost_to_hedge", t_att)
+            self._finish_trace(trace_id, "ok", winner.name)
             self._count_request(winner.name, "ok")
             self._affinity_note(session, winner.name)
             with self._lock:
@@ -921,13 +1227,20 @@ class FleetRouter:
         """Wait for ``handle``; with hedging on, fire a second attempt
         on another replica after the p99-derived delay and return the
         first winner (cancelling the loser).  Returns (tokens, winner
-        replica)."""
+        replica).  Every hedge-path transition is an attributed flight
+        event — ``fleet.hedge`` when the duplicate fires,
+        ``fleet.cancel`` when a loser is cancelled, ``fleet.attempt``
+        (role=hedge) when the duplicate settles — all carrying the
+        fleet trace id and attempt index."""
         budget = timeout if remaining is None else min(timeout, remaining)
+        tid = spec.get("trace_id") or ""
+        attempt = spec.get("attempt", 0)
         if not self.hedge or spec.get("session") is not None:
             # a session turn can only run where its pages are pinned —
             # hedging it to another replica is a guaranteed 404
             return handle.result(budget), replica
-        if handle.wait(self._hedge_delay()):
+        delay = self._hedge_delay()
+        if handle.wait(delay):
             return handle.result(budget), replica
         try:
             other = self._pick(exclude=tried | {replica.name})
@@ -936,11 +1249,34 @@ class FleetRouter:
             return handle.result(budget), replica
         with self._lock:
             self.hedged += 1
-        _flight.record("fleet.hedge", primary=replica.name,
-                       hedge=other.name)
+        _flight.record("fleet.hedge", tid=tid, attempt=attempt,
+                       primary=replica.name, hedge=other.name,
+                       delay_s=round(delay, 6))
+        with self._lock:
+            tr = self._rtrace(tid)
+            if tr is not None:
+                tr["hedge"] = {"t": round(self._clock() - tr["t0"], 6),
+                               "primary": replica.name,
+                               "hedge": other.name,
+                               "delay_s": round(delay, 6)}
+        t_h2 = self._clock()
         h2 = other.submit(spec["prompt"],
                           max_new_tokens=spec["max_new_tokens"],
-                          eos_id=spec["eos_id"], deadline_s=remaining)
+                          eos_id=spec["eos_id"], deadline_s=remaining,
+                          trace_id=spec.get("trace_id"))
+
+        def _hedge_settled(outcome):
+            self._trace_attempt(tid, other.name, attempt, "hedge",
+                                outcome, t_h2)
+
+        def _cancel_loser(lh, lr):
+            lh.cancel()
+            _flight.record("fleet.cancel", tid=tid, attempt=attempt,
+                           replica=lr.name,
+                           role="hedge" if lh is h2 else "primary")
+            if lh is h2:
+                _hedge_settled("cancelled")
+
         try:
             pairs = [(handle, replica, "primary_won"),
                      (h2, other, "hedge_won")]
@@ -951,17 +1287,21 @@ class FleetRouter:
                     if not h.done():
                         continue
                     if h.error is None:
+                        if h is h2:
+                            _hedge_settled("ok")
                         for lh, lr, _ in pairs[:i] + pairs[i + 1:]:
-                            lh.cancel()
+                            _cancel_loser(lh, lr)
                         self._count_hedge(outcome)
                         return h.result(0.001), r
                     errors.append(h.error)
+                    if h is h2:
+                        _hedge_settled(type(h.error).__name__)
                     pairs.pop(i)
                     break
                 else:
                     if self._clock() >= deadline:
-                        for lh, _, _ in pairs:
-                            lh.cancel()
+                        for lh, lr, _ in pairs:
+                            _cancel_loser(lh, lr)
                         self._count_hedge("timeout")
                         raise errors[0] if errors else MXNetError(
                             "hedged request timed out after %ss" % budget)
@@ -989,14 +1329,14 @@ class FleetRouter:
                 help="fleet requests by replica and final status",
                 replica=replica, status=status).inc()
 
-    def _count_retry(self, reason, replica, attempt):
+    def _count_retry(self, reason, replica, attempt, trace_id=None):
         with self._lock:
             self.retried += 1
         if _metrics.enabled():
             _metrics.counter(
                 "mxnet_fleet_retries_total",
                 help="fleet request retries by reason", reason=reason).inc()
-        _flight.record("fleet.retry", reason=reason,
+        _flight.record("fleet.retry", tid=trace_id or "", reason=reason,
                        replica=replica or "", attempt=attempt)
 
     @staticmethod
@@ -1057,12 +1397,15 @@ class FleetRouter:
                        "queue_depth": st.queue_depth,
                        "inflight": st.inflight,
                        "failures": st.failures,
+                       "tpot_p50_s": st.tpot,
+                       "arena_utilization": st.arena_util,
                        "bundle_sha": st.bundle_sha,
                        "last_error": st.last_error, "probes": st.probes}
                 for name, st in self._states.items()}
             healthy = sum(1 for st in self._states.values()
                           if self._routable(st, now))
-        return {
+            shedding = self._shedding
+        body = {
             "ok": healthy > 0,
             "replicas_healthy": healthy,
             "replicas_total": len(self._replicas),
@@ -1071,6 +1414,11 @@ class FleetRouter:
             "ejections": self.ejections, "dropped": self.dropped,
             "replicas": replicas,
         }
+        if self._slo is not None:
+            body["slo"] = {"burning": sorted(
+                name for name, b in self._slo._burning.items() if b),
+                "shedding": shedding}
+        return body
 
     def stats(self):
         return self.healthz()
@@ -1080,7 +1428,10 @@ class FleetRouter:
         """The fleet's own stdlib HTTP front: POST /v1/generate routes
         through the retry/hedge path; GET /healthz is the fleet view
         (503 + Retry-After when nothing is routable); GET /metrics
-        exposes the whole registry, fleet families included."""
+        (and /metrics.json) serves the AGGREGATED fleet snapshot —
+        per-replica scrapes merged with a ``replica`` label, router
+        families overlaid; GET /v1/trace/<id> is the fleet trace —
+        routing breakdown prepended to the owning replica's trace."""
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         router = self
@@ -1114,8 +1465,11 @@ class FleetRouter:
 
             def do_GET(self):
                 if self.path == "/metrics":
-                    self._send(200, _metrics.prometheus_text(),
-                               ctype="text/plain; version=0.0.4")
+                    self._send(200, _metrics.render_text(
+                        router.fleet_metrics_snapshot()),
+                        ctype="text/plain; version=0.0.4")
+                elif self.path == "/metrics.json":
+                    self._send(200, router.fleet_metrics_snapshot())
                 elif self.path == "/healthz":
                     body = router.healthz()
                     if body["ok"]:
@@ -1123,6 +1477,15 @@ class FleetRouter:
                     else:
                         self._send(503, body,
                                    headers={"Retry-After": "1"})
+                elif self.path.startswith("/v1/trace/"):
+                    tid = self.path[len("/v1/trace/"):]
+                    tr = router.trace(tid)
+                    if tr is None:
+                        self._send(404, {"error": "unknown trace id %r "
+                                                  "(evicted or never seen)"
+                                                  % tid})
+                    else:
+                        self._send(200, tr)
                 else:
                     self._send(404, {"error": "not found"})
 
@@ -1155,7 +1518,8 @@ class FleetRouter:
                     return
                 self._send(200, {"tokens": tokens,
                                  "replica": fut.replica,
-                                 "ttft_s": fut.ttft})
+                                 "ttft_s": fut.ttft,
+                                 "trace_id": fut.trace_id})
 
         self._http = ThreadingHTTPServer((host, port), Handler)
         threading.Thread(target=self._http.serve_forever,

@@ -171,11 +171,15 @@ class Request:
     _ids = itertools.count()
 
     def __init__(self, prompt, max_new_tokens=None, eos_id=None,
-                 deadline_s=None, session_id=None):
+                 deadline_s=None, session_id=None, trace_id=None):
         self.rid = next(Request._ids)
         # globally-unique-enough id stamped into flight events and served
-        # back by GET /v1/trace/<id> (pid disambiguates across ranks)
-        self.trace_id = "%x-%x" % (os.getpid(), self.rid)
+        # back by GET /v1/trace/<id> (pid disambiguates across ranks).
+        # A caller-supplied id (the FleetRouter's fleet trace id, carried
+        # in-process or via X-MXNet-Trace) overrides the self-minted one
+        # so router and replica spans correlate on ONE id.
+        self.trace_id = str(trace_id) if trace_id \
+            else "%x-%x" % (os.getpid(), self.rid)
         self.prompt = [int(t) for t in prompt]
         if not self.prompt:
             raise MXNetError("empty prompt")

@@ -451,15 +451,18 @@ class LlamaServer:
 
     # -- request surface --------------------------------------------------
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
-               deadline_s=None, session=None):
+               deadline_s=None, session=None, trace_id=None):
         """Enqueue; returns the Request future (``.result(timeout)``).
         ``session`` is a session id from :meth:`open_session` — the turn
-        prefills only its delta on top of the pinned history."""
+        prefills only its delta on top of the pinned history.
+        ``trace_id`` overrides the self-minted id (the FleetRouter's
+        fleet trace id, or an ``X-MXNet-Trace`` header value)."""
         if self._thread is None:
             raise MXNetError("server not started — call start() first")
         return self.scheduler.submit(
             Request(prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
-                    deadline_s=deadline_s, session_id=session))
+                    deadline_s=deadline_s, session_id=session,
+                    trace_id=trace_id))
 
     def generate(self, prompt, max_new_tokens=None, eos_id=None,
                  timeout=300, deadline_s=None, session=None):
@@ -596,9 +599,12 @@ class LlamaServer:
     # -- HTTP front -------------------------------------------------------
     def serve_http(self, port=0, host="127.0.0.1"):
         """Minimal stdlib HTTP front (POST /v1/generate, POST /v1/chat,
-        GET /metrics, GET /healthz, GET /v1/trace/<id>,
-        DELETE /v1/generate/<id>, DELETE /v1/chat/<id>).
-        Returns the bound (host, port).
+        GET /metrics, GET /metrics.json, GET /healthz,
+        GET /v1/trace/<id>, DELETE /v1/generate/<id>,
+        DELETE /v1/chat/<id>).  Returns the bound (host, port).
+        A POST may carry an ``X-MXNet-Trace`` header (the FleetRouter's
+        fleet trace id): it becomes the request's ``trace_id``, so
+        router and replica flight events correlate on one id.
 
         Status mapping (ISSUE 15): draining / queue-full → 503 with a
         ``Retry-After`` header derived from queue depth × decode-pace
@@ -664,6 +670,11 @@ class LlamaServer:
                 if self.path == "/metrics":
                     self._send(200, _metrics.prometheus_text(),
                                ctype="text/plain; version=0.0.4")
+                elif self.path == "/metrics.json":
+                    # full registry snapshot — the fleet aggregator's
+                    # scrape format (labels survive as structure, not
+                    # re-parsed exposition text)
+                    self._send(200, _metrics.snapshot())
                 elif self.path == "/healthz":
                     body = server.healthz()
                     if body["ok"]:
@@ -704,7 +715,8 @@ class LlamaServer:
                         max_new_tokens=doc.get("max_new_tokens"),
                         eos_id=doc.get("eos_id"),
                         deadline_s=doc.get("deadline_s"),
-                        session=sid)
+                        session=sid,
+                        trace_id=self.headers.get("X-MXNet-Trace"))
                 except ServeSessionUnknown as e:
                     self._send(404, {"error": str(e)})
                     return

@@ -19,13 +19,19 @@ See docs/observability.md.  Quick tour::
 
     # who owns the device memory?
     mx.telemetry.memdump.device_bytes()   # {"param": ..., "kv_page": ...}
+
+    # fleet-wide: merge N replica snapshots, evaluate SLO burn rates
+    mx.telemetry.aggregate.merge_snapshots({"r0": snap0, "r1": snap1})
+    mx.telemetry.slo.SLOEngine().observe(merged)
 """
 from .metrics import (  # noqa: F401
     counter, gauge, histogram,
     enabled, enable, disable,
-    snapshot, prometheus_text, dump, reset,
+    snapshot, prometheus_text, render_text, dump, reset,
     register_collector, record_compile,
 )
 from .trace import merge_traces  # noqa: F401
+from . import aggregate  # noqa: F401
 from . import flight  # noqa: F401
 from . import memdump  # noqa: F401
+from . import slo  # noqa: F401

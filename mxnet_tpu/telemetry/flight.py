@@ -216,15 +216,30 @@ def load(path):
 
 
 def to_trace(doc, pid=0):
-    """Convert a loaded dump into a chrome-trace dict (instant events,
-    µs timestamps) carrying the dump's wall anchor — directly mergeable
-    with profiler dumps via :func:`telemetry.merge_traces`."""
+    """Convert a loaded dump into a chrome-trace dict (µs timestamps)
+    carrying the dump's wall anchor — directly mergeable with profiler
+    dumps via :func:`telemetry.merge_traces`.
+
+    Events are instants by default; an event carrying ``dur_s`` (the
+    fleet router's attempt/request spans) renders as a complete "X"
+    span ending at its record time.  Span events land on one thread row
+    per ``replica`` field (row 0 = the router itself), so a hedged
+    request is visible as overlapping spans on two replica rows."""
     evs = []
+    tids = {"": 0}   # replica name -> chrome tid (row per replica)
     for e in doc.get("events", []):
         args = {k: v for k, v in e.items() if k not in ("ts", "kind")}
-        evs.append({"name": e.get("kind", "?"), "ph": "i", "s": "p",
-                    "ts": float(e.get("ts", 0.0)) * 1e6,
-                    "pid": pid, "tid": 0, "args": args})
+        ts_us = float(e.get("ts", 0.0)) * 1e6
+        dur_s = e.get("dur_s")
+        if isinstance(dur_s, (int, float)) and dur_s > 0:
+            tid = tids.setdefault(str(e.get("replica", "")), len(tids))
+            evs.append({"name": e.get("kind", "?"), "ph": "X",
+                        "ts": ts_us - float(dur_s) * 1e6,
+                        "dur": float(dur_s) * 1e6,
+                        "pid": pid, "tid": tid, "args": args})
+        else:
+            evs.append({"name": e.get("kind", "?"), "ph": "i", "s": "p",
+                        "ts": ts_us, "pid": pid, "tid": 0, "args": args})
     other = {}
     anchor = doc.get("meta", {}).get("wall_t0_us")
     if anchor is not None:

@@ -304,16 +304,27 @@ def histogram_quantile(series, q):
     return finite[-1][0] if finite else 0.0
 
 
+def _escape_label_value(v):
+    """Exposition-format label-value escaping: exactly backslash, double
+    quote and newline get escape sequences; every other byte (tabs,
+    non-ASCII UTF-8) passes through raw.  ``json.dumps`` is NOT a valid
+    substitute — it emits ``\\t``/``\\uXXXX`` sequences the Prometheus
+    parser rejects."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                  .replace("\n", "\\n"))
+
+
 def _fmt_labels(labels, extra=None):
-    parts = ["%s=%s" % (k, json.dumps(str(v))) for k, v in labels.items()]
+    parts = ['%s="%s"' % (k, _escape_label_value(v))
+             for k, v in labels.items()]
     if extra:
         parts.append("%s=%s" % extra)
     return "{%s}" % ",".join(parts) if parts else ""
 
 
-def prometheus_text():
-    """Prometheus text exposition format (scrape-able / pushgateway-able)."""
-    snap = snapshot()
+def render_text(snap):
+    """Render one :func:`snapshot`-shaped dict (this registry's or a
+    fleet-merged one from ``telemetry.aggregate``) as Prometheus text."""
     lines = []
     for name, fam in snap.items():
         if fam["help"]:
@@ -334,6 +345,11 @@ def prometheus_text():
                 lines.append("%s%s %g"
                              % (name, _fmt_labels(labels), s["value"]))
     return "\n".join(lines) + "\n"
+
+
+def prometheus_text():
+    """Prometheus text exposition format (scrape-able / pushgateway-able)."""
+    return render_text(snapshot())
 
 
 def dump(path=None):

@@ -83,8 +83,9 @@ _STUB_VALUES = {"train": 100.0, "infer": 200.0, "bert": 300.0,
                            "parity_checked": 64, "completed": 64,
                            "n_requests": 64, "live_compiles": 0},
                 # fleet runner (ISSUE 18): aggregate 3-replica tok/s as
-                # value, the N=1 router-vs-direct routing overhead and
-                # fleet TTFT p99 as extras
+                # value, the N=1 router-vs-direct routing overhead,
+                # fleet TTFT p99 and (ISSUE 20) the telemetry-off
+                # observability overhead as extras
                 "fleet": {"value": 2800.0, "n_replicas": 3,
                           "ttft_p99_ms": 60.0, "completed": 64,
                           "n_requests": 64, "retried": 0,
@@ -92,6 +93,8 @@ _STUB_VALUES = {"train": 100.0, "infer": 200.0, "bert": 300.0,
                           "direct_tok_s": 1000.0,
                           "router1_tok_s": 980.0,
                           "routing_overhead_pct": 2.0,
+                          "fleet_notelemetry_tok_s": 2850.0,
+                          "obs_overhead_pct": 1.75,
                           "live_compiles": 0},
                 # planner runner (ISSUE 11): median plan seconds as
                 # value, the ms-precision figure rides along
@@ -238,6 +241,9 @@ def test_default_mode_emits_all_metrics_in_one_line(monkeypatch, capsys):
     assert fleet["direct_tok_s"] == 1000.0
     assert fleet["router1_tok_s"] == 980.0
     assert fleet["dropped"] == 0 and fleet["ejections"] == 0
+    # ISSUE 20: the observability tax rides along (<=3% standing gate)
+    assert fleet["fleet_notelemetry_tok_s"] == 2850.0
+    assert fleet["obs_overhead_pct"] == 1.75
     assert fleet["live_compiles"] == 0
     # planner record (ISSUE 11): static analysis latency, LOWER better;
     # the ms-precision figure survives the 2-decimal value rounding

@@ -80,6 +80,30 @@ def test_snapshot_and_prometheus_shapes():
     json.dumps(snap)  # snapshot must be JSON-able as-is
 
 
+def test_prometheus_label_value_escaping():
+    # the exposition format allows exactly three label-value escapes:
+    # backslash, double-quote and newline — regression for the old
+    # json.dumps-based quoting that emitted \t and \uXXXX, which
+    # Prometheus parsers reject
+    telemetry.counter("t_unit_esc_total",
+                      path='a\\b', quoted='say "hi"', multi="l1\nl2",
+                      tab="a\tb", uni="café").inc()
+    text = telemetry.prometheus_text()
+    (line,) = [l for l in text.splitlines()
+               if l.startswith("t_unit_esc_total{")]
+    assert 'path="a\\\\b"' in line
+    assert 'quoted="say \\"hi\\""' in line
+    assert 'multi="l1\\nl2"' in line
+    # a literal tab and non-ASCII pass through unescaped (valid UTF-8
+    # label values); no JSON-style \t or é may appear
+    assert 'tab="a\tb"' in line
+    assert 'uni="café"' in line
+    assert "\\t" not in line and "\\u" not in line
+    # render_text is the reusable half: same bytes from a snapshot dict
+    from mxnet_tpu.telemetry.metrics import render_text
+    assert line in render_text(telemetry.snapshot())
+
+
 def test_reset_zeroes_in_place():
     c = telemetry.counter("t_unit_reset_total")
     c.inc(7)

@@ -30,6 +30,19 @@ Subcommands:
           Columns: ok, draining, queue depth, TPOT p50, uptime,
           bundle_sha — a version-drift check across the fleet is one
           glance at the last column.
+
+  top     Live fleet view off a running router front's ``/healthz``
+          (docs/observability.md "Fleet observability")::
+
+              python tools/mxfleet.py top --router http://localhost:9000
+
+          Redraws every ``--interval`` seconds (``--once`` prints a
+          single frame and exits — the scriptable form): one row per
+          replica with breaker state (ok / EJECTED / draining /
+          deploying), queue depth, in-flight count, TPOT EMA, arena
+          utilization and consecutive failures, under a fleet header
+          with completed/retried/hedged/dropped totals and any burning
+          SLOs.
 """
 from __future__ import annotations
 
@@ -118,6 +131,73 @@ def _cmd_status(args):
     return 0
 
 
+def _replica_state(doc):
+    if doc.get("ejected"):
+        return "EJECTED"
+    if doc.get("draining"):
+        return "draining"
+    if doc.get("deploying"):
+        return "deploying"
+    return "ok" if doc.get("ok") else "NOT-OK"
+
+
+def _top_frame(body):
+    slo = body.get("slo") or {}
+    burning = slo.get("burning") or []
+    lines = ["fleet: %d/%d healthy  completed=%s failed=%s retried=%s "
+             "hedged=%s ejections=%s dropped=%s%s%s"
+             % (body.get("replicas_healthy", 0),
+                body.get("replicas_total", 0),
+                body.get("completed", 0), body.get("failed", 0),
+                body.get("retried", 0), body.get("hedged", 0),
+                body.get("ejections", 0), body.get("dropped", 0),
+                "  SHEDDING" if slo.get("shedding") else "",
+                "  BURNING:" + ",".join(burning) if burning else "")]
+    fmt = "%-28s %-10s %6s %9s %9s %7s %9s"
+    lines.append(fmt % ("replica", "state", "queue", "inflight",
+                        "tpot(s)", "arena", "failures"))
+    for name in sorted(body.get("replicas", {})):
+        doc = body["replicas"][name]
+        lines.append(fmt % (
+            name, _replica_state(doc),
+            str(doc.get("queue_depth", "?")),
+            str(doc.get("inflight", "?")),
+            "%.4f" % float(doc.get("tpot_p50_s") or 0.0),
+            "%3.0f%%" % (100.0 * float(doc.get("arena_utilization")
+                                       or 0.0)),
+            str(doc.get("failures", 0))))
+    return "\n".join(lines)
+
+
+def _cmd_top(args):
+    import json
+    import time
+    import urllib.request
+
+    url = args.router.rstrip("/") + "/healthz"
+    while True:
+        try:
+            # the fleet front answers /healthz with 503 when nothing is
+            # routable — that is still a frame worth rendering
+            try:
+                with urllib.request.urlopen(url, timeout=5) as resp:
+                    body = json.loads(resp.read().decode())
+            except urllib.error.HTTPError as e:
+                body = json.loads(e.read().decode())
+        except Exception as e:  # noqa: BLE001 — a dead router is a frame
+            body = None
+            frame = "router %s unreachable: %s: %s" \
+                % (args.router, type(e).__name__, e)
+        if body is not None:
+            frame = _top_frame(body)
+        if args.once:
+            print(frame)
+            return 0 if body is not None and body.get("ok") else 1
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="mxfleet", description=__doc__,
                                  formatter_class=argparse.
@@ -142,6 +222,15 @@ def main(argv=None):
     st.add_argument("--replica", action="append", required=True,
                     metavar="URL", help="replica base URL (repeatable)")
     st.set_defaults(fn=_cmd_status)
+
+    tp = sub.add_parser("top", help="live fleet view off a router front")
+    tp.add_argument("--router", required=True, metavar="URL",
+                    help="FleetRouter HTTP front base URL")
+    tp.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between redraws (default 2)")
+    tp.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no screen clears)")
+    tp.set_defaults(fn=_cmd_top)
 
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -27,7 +27,11 @@ Subcommands:
 
           ``--kind`` filters by exact event kind or dotted prefix
           (``engine`` matches ``engine.push``/``engine.flush``/...),
-          ``--last N`` keeps the N most recent events per dump.
+          ``--trace ID`` slices to one request's events (the ``tid``
+          every serve/fleet event carries — a fleet trace id follows
+          one request across router retries, hedges and the winning
+          replica), ``--last N`` keeps the N most recent events per
+          dump.
 
   merge   Merge multi-rank dumps into ONE chrome://tracing file on a
           correlated timeline (each dump's wall anchor aligns it, the
@@ -68,6 +72,8 @@ def _cmd_show(args):
             evs = [e for e in evs
                    if e.get("kind") == args.kind
                    or str(e.get("kind", "")).startswith(args.kind + ".")]
+        if args.trace:
+            evs = [e for e in evs if str(e.get("tid", "")) == args.trace]
         if args.last is not None:
             evs = evs[-args.last:]
         print("== %s  (pid %s, rank %s, reason %r, %d/%d events, "
@@ -113,6 +119,9 @@ def main(argv=None):
     sp.add_argument("--kind", default=None,
                     help="filter: exact kind or dotted prefix (kv, "
                          "engine, res)")
+    sp.add_argument("--trace", default=None, metavar="ID",
+                    help="keep only events stamped with this trace id "
+                         "(serve/fleet 'tid' field)")
     sp.add_argument("--last", type=int, default=None,
                     help="keep only the N most recent events per dump")
     sp.set_defaults(fn=_cmd_show)

@@ -17,6 +17,14 @@ Subcommands:
           directly over the server handler span it triggered (both
           carry the same args.span id).  See docs/observability.md.
 
+          Flight-recorder dumps are accepted in place (auto-detected by
+          their ``meta``/``events`` shape and converted via
+          ``flight.to_trace``), so one command lines the fleet router's
+          attempt spans up against each replica's serve timeline::
+
+              python tools/mxtrace.py merge router_flight.json \\
+                  replica0_flight.json -o fleet.json --labels router r0
+
   summary Per-op aggregate table (count/total/avg/min/max us) from one
           or more trace files, like ``mx.profiler.dumps()`` but offline.
 """
@@ -32,9 +40,22 @@ sys.path.insert(0, _REPO_ROOT)
 
 
 def _cmd_merge(args):
-    from mxnet_tpu.telemetry import merge_traces
+    from mxnet_tpu.telemetry import flight, merge_traces
 
-    merged = merge_traces(args.traces, out=args.output, labels=args.labels)
+    inputs = []
+    for path in args.traces:
+        with open(path) as f:
+            doc = json.load(f)
+        if isinstance(doc, dict) and "meta" in doc and "events" in doc:
+            # a flight-recorder dump, not a chrome trace: convert it —
+            # router fleet.attempt/fleet.request events become spans on
+            # per-replica rows, so one `mxtrace merge router.json
+            # replica0.json replica1.json` shows a hedged request
+            # spanning two replicas next to each replica's own timeline
+            inputs.append(flight.to_trace(flight.load(path)))
+        else:
+            inputs.append(path)
+    merged = merge_traces(inputs, out=args.output, labels=args.labels)
     n = sum(1 for e in merged["traceEvents"] if e.get("ph") != "M")
     print("merged %d events from %d trace(s) -> %s"
           % (n, len(args.traces), args.output))
